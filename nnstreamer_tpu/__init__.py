@@ -1,0 +1,15 @@
+"""nnstreamer_tpu — a TPU-native streaming-AI framework.
+
+A ground-up re-design of the NNStreamer capability set (typed tensor streams,
+negotiated schemas, composable pipeline elements, pluggable model backends,
+among-device offload, in-pipeline training) around JAX/XLA/pjit/Pallas instead
+of GStreamer.  See SURVEY.md for the blueprint and the reference mapping.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    StreamSpec,
+    TensorSpec,
+    TensorFrame,
+)

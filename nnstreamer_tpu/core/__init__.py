@@ -1,0 +1,35 @@
+"""Core runtime (L1): tensor type system, schemas, buffers, events, sync,
+subplugin registry, config, logging."""
+
+from .types import (  # noqa: F401
+    ANY,
+    FORMAT_FLEXIBLE,
+    FORMAT_SPARSE,
+    FORMAT_STATIC,
+    FORMATS,
+    RANK_LIMIT,
+    TENSOR_COUNT_LIMIT,
+    StreamSpec,
+    TensorSpec,
+    all_type_names,
+    dims_to_string,
+    dtype_from_name,
+    dtype_to_name,
+    pack_flex_header,
+    parse_dims_string,
+    sparse_decode,
+    sparse_encode,
+    unpack_flex_header,
+)
+from .buffer import (  # noqa: F401
+    EOS,
+    CapsEvent,
+    CustomEvent,
+    Event,
+    Flush,
+    SegmentEvent,
+    TensorFrame,
+)
+from .sync import Collator, SyncPolicy  # noqa: F401
+from . import config, registry  # noqa: F401
+from .log import get_logger  # noqa: F401

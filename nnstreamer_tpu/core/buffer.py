@@ -1,0 +1,125 @@
+"""Stream payload and event objects.
+
+Reference analogs:
+
+- ``TensorFrame`` ≙ a GstBuffer holding up to 256 GstMemory tensor chunks plus
+  pts/dts/duration timestamps (reference
+  ``gst/nnstreamer/nnstreamer_plugin_api_impl.c:1541`` nth-memory access).
+- ``meta`` dict ≙ GstMeta attachments; key ``"client_id"`` mirrors the query
+  meta that routes answers back to the right client
+  (reference ``gst/nnstreamer/tensor_meta.c``).
+- Event classes ≙ GstEvent EOS / FLUSH / SEGMENT / CAPS.
+
+TPU-first notes: tensor payloads may be numpy arrays *or* ``jax.Array``s —
+elements that chain JAX computation keep data on device between elements
+(the zero-copy analog of mapped GstMemory), and only sinks/serializers pull
+to host.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .types import StreamSpec, TensorSpec, FORMAT_STATIC
+
+# monotonic frame sequence for debugging/tracing
+_seq = itertools.count()
+
+
+@dataclass
+class TensorFrame:
+    """One frame of a tensor stream: N tensors + timestamps + metadata."""
+
+    tensors: List[Any]  # np.ndarray | jax.Array, len <= TENSOR_COUNT_LIMIT
+    pts: Optional[float] = None  # presentation timestamp, seconds
+    duration: Optional[float] = None
+    meta: Dict[str, Any] = field(default_factory=dict)
+    seq: int = field(default_factory=lambda: next(_seq))
+
+    def __len__(self) -> int:
+        return len(self.tensors)
+
+    def nth(self, i: int):
+        """Reference: gst_tensor_buffer_get_nth_memory."""
+        return self.tensors[i]
+
+    def pick(self, indices: Sequence[int]) -> "TensorFrame":
+        """input-combination / tensorpick subset-reorder."""
+        return replace(self, tensors=[self.tensors[i] for i in indices])
+
+    def with_tensors(self, tensors: Sequence[Any]) -> "TensorFrame":
+        """New frame with same timestamps/meta, different payload."""
+        return replace(self, tensors=list(tensors))
+
+    def spec(self) -> StreamSpec:
+        """Derive the concrete schema of this frame."""
+        return StreamSpec(
+            tuple(TensorSpec(tuple(t.shape), np.dtype(t.dtype)) for t in self.tensors),
+            FORMAT_STATIC,
+        )
+
+    def nbytes(self) -> int:
+        return sum(int(np.prod(t.shape)) * np.dtype(t.dtype).itemsize for t in self.tensors)
+
+    def to_host(self) -> "TensorFrame":
+        """Materialize all payloads as numpy arrays (device -> host)."""
+        return self.with_tensors([np.asarray(t) for t in self.tensors])
+
+
+# ---------------------------------------------------------------------------
+# In-band events (flow through the same queues as frames, in order)
+# ---------------------------------------------------------------------------
+class Event:
+    """Base class for in-band stream events (≙ GstEvent)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return f"<{type(self).__name__}>"
+
+
+class EOS(Event):
+    """End of stream: no more frames will follow (≙ GST_EVENT_EOS)."""
+
+
+class Flush(Event):
+    """Drop queued data, reset element state (≙ FLUSH_START/STOP)."""
+
+
+@dataclass(repr=True)
+class SegmentEvent(Event):
+    """New time segment (≙ GST_EVENT_SEGMENT)."""
+
+    start: float = 0.0
+    rate: float = 1.0
+
+
+@dataclass(repr=True)
+class CapsEvent(Event):
+    """Announce the downstream schema (≙ GST_EVENT_CAPS).
+
+    Sent before the first frame and whenever the schema changes; elements
+    negotiate by intersecting with what they accept.
+    """
+
+    spec: StreamSpec = field(default_factory=StreamSpec)
+
+
+@dataclass(repr=True)
+class CustomEvent(Event):
+    """Application/element-defined event (e.g. model RELOAD, epoch stats)."""
+
+    name: str = ""
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+StreamItem = Any  # TensorFrame | Event
+
+
+def now() -> float:
+    return time.monotonic()

@@ -1,0 +1,134 @@
+"""Configuration system: ini file + environment-variable overrides.
+
+Reference: ``gst/nnstreamer/nnstreamer_conf.{h,c}`` + ``nnstreamer.ini.in`` —
+subplugin search paths per kind, framework-priority-per-model-extension,
+per-subplugin custom value strings, env overrides gated by ``enable_envvar``.
+
+TPU-native shape: an ``nnstreamer_tpu.ini`` (searched in $NNS_TPU_CONF,
+./nnstreamer_tpu.ini, ~/.config/nnstreamer_tpu.ini) with sections::
+
+    [common]
+    enable_envvar = True
+    [filter]
+    modules = mypkg.backends            ; extra modules scanned for backends
+    [framework-priority]
+    tflite = jax-xla,tflite             ; model-extension -> backend priority
+    [jax-xla]
+    default_batch = 8                   ; per-subplugin custom values
+
+Environment overrides use ``NNS_TPU_<SECTION>_<KEY>`` (uppercased).
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import threading
+from typing import Dict, List, Optional
+
+_ENV_PREFIX = "NNS_TPU_"
+_lock = threading.RLock()
+_parser: Optional[configparser.ConfigParser] = None
+_loaded_from: Optional[str] = None
+
+
+def _candidate_paths() -> List[str]:
+    paths = []
+    env = os.environ.get("NNS_TPU_CONF")
+    if env:
+        paths.append(env)
+    paths.append(os.path.join(os.getcwd(), "nnstreamer_tpu.ini"))
+    paths.append(os.path.expanduser("~/.config/nnstreamer_tpu.ini"))
+    return paths
+
+
+def load(path: Optional[str] = None, *, force: bool = False) -> None:
+    """Load the ini file (first existing candidate). Idempotent unless force."""
+    global _parser, _loaded_from
+    with _lock:
+        if _parser is not None and not force and path is None:
+            return
+        cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
+        src = None
+        for p in [path] if path else _candidate_paths():
+            if p and os.path.isfile(p):
+                cp.read(p)
+                src = p
+                break
+        _parser = cp
+        _loaded_from = src
+
+
+def reset() -> None:
+    global _parser, _loaded_from
+    with _lock:
+        _parser = None
+        _loaded_from = None
+
+
+def loaded_from() -> Optional[str]:
+    load()
+    return _loaded_from
+
+
+def _envvar_enabled() -> bool:
+    # reference: conf value enable_envvar gates env overrides
+    raw = _parser.get("common", "enable_envvar", fallback="true") if _parser else "true"
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_value(section: str, key: str, default: Optional[str] = None) -> Optional[str]:
+    """Config lookup with env override NNS_TPU_<SECTION>_<KEY>.
+
+    Reference: ``nnsconf_get_custom_value_string``.
+    """
+    load()
+    with _lock:
+        if _envvar_enabled():
+            env_key = f"{_ENV_PREFIX}{section}_{key}".upper().replace("-", "_")
+            env = os.environ.get(env_key)
+            if env is not None:
+                return env
+        assert _parser is not None
+        return _parser.get(section, key, fallback=default)
+
+
+def get_bool(section: str, key: str, default: bool = False) -> bool:
+    v = get_value(section, key, None)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(section: str, key: str, default: int = 0) -> int:
+    v = get_value(section, key, None)
+    return default if v is None else int(v)
+
+
+def get_list(section: str, key: str) -> List[str]:
+    v = get_value(section, key, None)
+    if not v:
+        return []
+    return [s.strip() for s in v.replace(";", ",").split(",") if s.strip()]
+
+
+def framework_priority(model_ext: str) -> List[str]:
+    """Backend priority for a model file extension.
+
+    Reference: ini ``framework_priority_<ext>`` consulted by framework=auto
+    detection (``tensor_filter_common.c:1171-1196``).
+    """
+    ext = model_ext.lstrip(".").lower()
+    pri = get_list("framework-priority", ext)
+    if pri:
+        return pri
+    defaults: Dict[str, List[str]] = {
+        "tflite": ["jax-xla", "tflite"],
+        "msgpack": ["jax-xla"],
+        "orbax": ["jax-xla"],
+        "jax": ["jax-xla"],
+        "pt": ["torch"],
+        "pth": ["torch"],
+        "py": ["python3"],
+    }
+    return defaults.get(ext, [])

@@ -1,0 +1,35 @@
+"""Logging shims.
+
+Reference: ``gst/nnstreamer/nnstreamer_log.{h,c}`` — ``ml_logi/w/e/d/f``
+macros routed to the platform logger, with backtraces attached on fatal
+paths.  Here: one stdlib logger per element/category, fatal helper raising
+with traceback, env-tunable level (NNS_TPU_LOG=debug).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import traceback
+
+_root = logging.getLogger("nnstreamer_tpu")
+if not _root.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S")
+    )
+    _root.addHandler(_h)
+    _root.setLevel(
+        getattr(logging, os.environ.get("NNS_TPU_LOG", "INFO").upper(), logging.INFO)
+    )
+
+
+def get_logger(category: str) -> logging.Logger:
+    return _root.getChild(category)
+
+
+def fatal(logger: logging.Logger, msg: str, *args) -> "NoReturn":  # noqa: F821
+    """Log with backtrace and raise (reference: ml_logf + _backtrace_to_string)."""
+    text = msg % args if args else msg
+    logger.error("%s\n%s", text, "".join(traceback.format_stack(limit=12)))
+    raise RuntimeError(text)

@@ -1,0 +1,444 @@
+"""Tensor type system and stream-schema ("caps") negotiation.
+
+This is the TPU-native re-design of the reference's L1 core type layer:
+
+- element types / formats / rank+count limits:
+  reference ``gst/nnstreamer/include/tensor_typedef.h:34-298``
+- info init/copy/validate/equality + dim/type string parse/print:
+  reference ``gst/nnstreamer/nnstreamer_plugin_api_util_impl.c:121-710``
+- caps intersection / negotiation:
+  reference ``gst/nnstreamer/nnstreamer_plugin_api_impl.c:1092-1159``
+- flexible-tensor self-describing meta header:
+  reference ``tensor_typedef.h`` (GstTensorMetaInfo) and
+  ``nnstreamer_plugin_api_impl.c:1464-1539``
+
+Design notes (TPU-first, not a port):
+
+* Shapes are stored in standard row-major (outermost-first) order, the order
+  JAX/XLA and numpy use.  The reference stores dimensions innermost-first
+  ("3:224:224:1" = C:W:H:N); the string parse/print helpers below speak that
+  dialect so reference pipeline descriptions map 1:1, but everything internal
+  is numpy order.
+* ``None`` in a shape marks a run-time-variable ("flexible") dimension.  XLA
+  wants static shapes, so the filter layer buckets/pads flexible dims before
+  compilation; the type layer only carries the declaration.
+* dtypes are numpy dtypes (shared vocabulary with JAX).  bfloat16 is a
+  first-class citizen here (TPU native) even though the reference has no such
+  type — it is an extension, flagged so schemas stay round-trippable.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from dataclasses import dataclass, field, replace
+from fractions import Fraction
+from typing import Iterable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:  # bfloat16 rides on ml_dtypes (always present with jax)
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BFLOAT16 = None
+
+# ---------------------------------------------------------------------------
+# Limits — reference tensor_typedef.h:
+#   NNS_TENSOR_RANK_LIMIT = 16, NNS_TENSOR_SIZE_LIMIT = 16 (+240 extra)
+# ---------------------------------------------------------------------------
+RANK_LIMIT = 16
+TENSOR_COUNT_LIMIT = 256  # 16 primary + 240 "extra" in the reference
+
+# Element types (reference tensor_typedef.h enum _nns_tensor_type, 11 types).
+# bfloat16 is a TPU-native extension (not in the reference).
+_TYPE_NAMES = {
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "uint16": np.dtype(np.uint16),
+    "int32": np.dtype(np.int32),
+    "uint32": np.dtype(np.uint32),
+    "int64": np.dtype(np.int64),
+    "uint64": np.dtype(np.uint64),
+    "float16": np.dtype(np.float16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+}
+if _BFLOAT16 is not None:
+    _TYPE_NAMES["bfloat16"] = _BFLOAT16
+
+_NAME_BY_DTYPE = {v: k for k, v in _TYPE_NAMES.items()}
+
+# Formats (reference tensor_typedef.h enum _tensor_format)
+FORMAT_STATIC = "static"
+FORMAT_FLEXIBLE = "flexible"
+FORMAT_SPARSE = "sparse"
+FORMATS = (FORMAT_STATIC, FORMAT_FLEXIBLE, FORMAT_SPARSE)
+
+DimsT = Tuple[Optional[int], ...]
+
+
+def dtype_from_name(name: str) -> np.dtype:
+    """Map a type name ("float32") to a numpy dtype.
+
+    Reference: ``gst_tensor_get_type`` in nnstreamer_plugin_api_util_impl.c.
+    """
+    key = name.strip().lower()
+    if key not in _TYPE_NAMES:
+        raise ValueError(f"unknown tensor element type: {name!r}")
+    return _TYPE_NAMES[key]
+
+
+def dtype_to_name(dtype) -> str:
+    """Map a numpy/JAX dtype to its canonical name.
+
+    Reference: ``gst_tensor_get_type_string``.
+    """
+    dt = np.dtype(dtype)
+    if dt not in _NAME_BY_DTYPE:
+        raise ValueError(f"unsupported tensor element type: {dtype!r}")
+    return _NAME_BY_DTYPE[dt]
+
+
+def all_type_names() -> Tuple[str, ...]:
+    return tuple(_TYPE_NAMES)
+
+
+def parse_dims_string(text: str) -> DimsT:
+    """Parse a reference-dialect dimension string into a numpy-order shape.
+
+    "3:224:224:1" (innermost-first, reference
+    ``gst_tensor_parse_dimension`` / ``..._parse_dimensions_string``
+    nnstreamer_plugin_api_util_impl.c:572) becomes ``(1, 224, 224, 3)``.
+    A 0 or '?' component marks a flexible (unknown) dimension -> ``None``.
+    """
+    parts = [p.strip() for p in text.strip().split(":") if p.strip() != ""]
+    if not parts:
+        raise ValueError(f"empty dimension string: {text!r}")
+    if len(parts) > RANK_LIMIT:
+        raise ValueError(f"rank {len(parts)} exceeds limit {RANK_LIMIT}")
+    dims: list = []
+    for p in parts:
+        if p in ("?", "*"):
+            dims.append(None)
+            continue
+        v = int(p)
+        if v < 0:
+            raise ValueError(f"negative dimension in {text!r}")
+        dims.append(None if v == 0 else v)
+    return tuple(reversed(dims))
+
+
+def dims_to_string(shape: Sequence[Optional[int]]) -> str:
+    """Inverse of :func:`parse_dims_string` (innermost-first, reference
+    ``gst_tensor_get_dimension_string``)."""
+    return ":".join("0" if d is None else str(d) for d in reversed(tuple(shape)))
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    """Static description of one tensor in a stream.
+
+    Reference analog: ``GstTensorInfo`` (tensor_typedef.h) — name, type, dims.
+    """
+
+    shape: DimsT
+    dtype: np.dtype = np.dtype(np.float32)
+    name: str = ""
+
+    def __post_init__(self):
+        norm = []
+        for d in self.shape:
+            if d is None:
+                norm.append(None)
+                continue
+            if isinstance(d, bool) or (
+                not isinstance(d, (int, np.integer)) or int(d) <= 0
+            ):
+                raise ValueError(f"bad dimension {d!r} in shape {tuple(self.shape)!r}")
+            norm.append(int(d))
+        object.__setattr__(self, "shape", tuple(norm))
+        object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        if len(self.shape) > RANK_LIMIT:
+            raise ValueError(f"rank {len(self.shape)} exceeds limit {RANK_LIMIT}")
+        if np.dtype(self.dtype) not in _NAME_BY_DTYPE:
+            raise ValueError(f"unsupported dtype {self.dtype!r}")
+
+    # -- predicates ---------------------------------------------------------
+    @property
+    def is_static(self) -> bool:
+        return all(d is not None for d in self.shape)
+
+    @property
+    def rank(self) -> int:
+        return len(self.shape)
+
+    @property
+    def num_elements(self) -> Optional[int]:
+        """prod(dims); None if any dim is flexible."""
+        if not self.is_static:
+            return None
+        return int(math.prod(self.shape)) if self.shape else 1
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        """Byte size of one frame of this tensor.
+
+        Reference: ``gst_tensor_info_get_size``
+        (nnstreamer_plugin_api_util_impl.c:156).
+        """
+        n = self.num_elements
+        return None if n is None else n * self.dtype.itemsize
+
+    # -- negotiation --------------------------------------------------------
+    def is_compatible(self, other: "TensorSpec") -> bool:
+        """True if a buffer described by `other` can flow where `self` is
+        expected (flexible dims act as wildcards)."""
+        if np.dtype(self.dtype) != np.dtype(other.dtype):
+            return False
+        if len(self.shape) != len(other.shape):
+            return False
+        return all(
+            a is None or b is None or a == b for a, b in zip(self.shape, other.shape)
+        )
+
+    def intersect(self, other: "TensorSpec") -> Optional["TensorSpec"]:
+        """Most-specific common spec, or None if incompatible.
+
+        Reference analog: caps intersection
+        (``gst_tensor_caps_can_intersect`` nnstreamer_plugin_api_impl.c:1092).
+        """
+        if not self.is_compatible(other):
+            return None
+        shape = tuple(a if a is not None else b for a, b in zip(self.shape, other.shape))
+        return TensorSpec(shape, self.dtype, self.name or other.name)
+
+    def matches(self, array) -> bool:
+        """True if a concrete array conforms to this spec."""
+        if np.dtype(array.dtype) != np.dtype(self.dtype):
+            return False
+        if len(array.shape) != len(self.shape):
+            return False
+        return all(s is None or s == a for s, a in zip(self.shape, array.shape))
+
+    # -- strings ------------------------------------------------------------
+    def to_string(self) -> str:
+        return f"{dtype_to_name(self.dtype)}:{dims_to_string(self.shape)}"
+
+    @classmethod
+    def from_string(cls, text: str, name: str = "") -> "TensorSpec":
+        """Parse "float32:3:224:224:1" (type:dims, reference dialect)."""
+        head, _, rest = text.strip().partition(":")
+        return cls(parse_dims_string(rest), dtype_from_name(head), name)
+
+    def with_batch(self, batch: int) -> "TensorSpec":
+        """Prepend a batch dimension (micro-batching helper)."""
+        return replace(self, shape=(batch,) + self.shape)
+
+
+@dataclass(frozen=True)
+class StreamSpec:
+    """Schema of a tensor stream: N tensors per frame + format + rate.
+
+    Reference analog: ``GstTensorsConfig`` = ``GstTensorsInfo`` + format +
+    framerate (tensor_typedef.h), rendered as `other/tensors` caps.
+    """
+
+    tensors: Tuple[TensorSpec, ...] = ()
+    fmt: str = FORMAT_STATIC
+    framerate: Optional[Fraction] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "tensors", tuple(self.tensors))
+        if self.fmt not in FORMATS:
+            raise ValueError(f"unknown stream format {self.fmt!r}")
+        if len(self.tensors) > TENSOR_COUNT_LIMIT:
+            raise ValueError(
+                f"{len(self.tensors)} tensors exceeds limit {TENSOR_COUNT_LIMIT}"
+            )
+        if self.framerate is not None:
+            object.__setattr__(self, "framerate", Fraction(self.framerate))
+
+    # -- basics -------------------------------------------------------------
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    @property
+    def is_static(self) -> bool:
+        return self.fmt == FORMAT_STATIC and all(t.is_static for t in self.tensors)
+
+    @property
+    def is_flexible(self) -> bool:
+        return self.fmt == FORMAT_FLEXIBLE
+
+    def validate(self) -> bool:
+        """Reference: ``gst_tensors_config_validate``."""
+        if self.fmt == FORMAT_STATIC:
+            return self.num_tensors > 0 and all(t.is_static for t in self.tensors)
+        return True  # flexible/sparse: schema resolved per-buffer via header
+
+    # -- negotiation --------------------------------------------------------
+    @property
+    def is_any(self) -> bool:
+        """A zero-tensor flexible schema is the wildcard (≙ ANY caps)."""
+        return self.fmt == FORMAT_FLEXIBLE and not self.tensors
+
+    def is_compatible(self, other: "StreamSpec") -> bool:
+        if self.is_any or other.is_any:
+            return True
+        if self.fmt != other.fmt:
+            return False
+        if self.is_flexible or self.fmt == FORMAT_SPARSE:
+            return True
+        if self.num_tensors != other.num_tensors:
+            return False
+        return all(a.is_compatible(b) for a, b in zip(self.tensors, other.tensors))
+
+    def intersect(self, other: "StreamSpec") -> Optional["StreamSpec"]:
+        if self.is_any:
+            return other
+        if other.is_any:
+            return self
+        if not self.is_compatible(other):
+            return None
+        if self.fmt != FORMAT_STATIC:
+            return self
+        merged = []
+        for a, b in zip(self.tensors, other.tensors):
+            m = a.intersect(b)
+            if m is None:
+                return None
+            merged.append(m)
+        fr = self.framerate if self.framerate is not None else other.framerate
+        return StreamSpec(tuple(merged), self.fmt, fr)
+
+    def __eq__(self, other) -> bool:  # reference: gst_tensors_config_is_equal
+        return (
+            isinstance(other, StreamSpec)
+            and self.fmt == other.fmt
+            and self.tensors == other.tensors
+            and self.framerate == other.framerate
+        )
+
+    def __hash__(self):
+        return hash((self.tensors, self.fmt, self.framerate))
+
+    # -- strings ------------------------------------------------------------
+    def to_string(self) -> str:
+        """Render reference-caps-like text, e.g.
+        ``tensors,format=static,num=2,dimensions=3:224:224:1.10:1,types=uint8.float32,framerate=30/1``
+        """
+        parts = [f"tensors,format={self.fmt}", f"num={self.num_tensors}"]
+        if self.tensors:
+            parts.append(
+                "dimensions=" + ".".join(dims_to_string(t.shape) for t in self.tensors)
+            )
+            parts.append("types=" + ".".join(dtype_to_name(t.dtype) for t in self.tensors))
+        if self.framerate is not None:
+            parts.append(
+                f"framerate={self.framerate.numerator}/{self.framerate.denominator}"
+            )
+        return ",".join(parts)
+
+    @classmethod
+    def from_string(cls, text: str) -> "StreamSpec":
+        fields = {}
+        head, *rest = [p.strip() for p in text.strip().split(",")]
+        if head not in ("tensors", "other/tensors"):
+            raise ValueError(f"not a tensors schema: {text!r}")
+        for item in rest:
+            k, _, v = item.partition("=")
+            fields[k.strip()] = v.strip()
+        fmt = fields.get("format", FORMAT_STATIC)
+        fr = None
+        if "framerate" in fields:
+            n, _, d = fields["framerate"].partition("/")
+            fr = Fraction(int(n), int(d or "1"))
+        tensors: Tuple[TensorSpec, ...] = ()
+        if "dimensions" in fields:
+            dims = [parse_dims_string(s) for s in fields["dimensions"].split(".")]
+            types = [dtype_from_name(s) for s in fields.get("types", "").split(".")]
+            if len(dims) != len(types):
+                raise ValueError("dimensions/types count mismatch")
+            tensors = tuple(TensorSpec(d, t) for d, t in zip(dims, types))
+        return cls(tensors, fmt, fr)
+
+    # -- helpers ------------------------------------------------------------
+    def pick(self, indices: Iterable[int]) -> "StreamSpec":
+        """Subset/reorder tensors — `input-combination` semantics
+        (reference tensor_filter.c:723-765)."""
+        return replace(self, tensors=tuple(self.tensors[i] for i in indices))
+
+    def nbytes(self) -> Optional[int]:
+        sizes = [t.nbytes for t in self.tensors]
+        return None if any(s is None for s in sizes) else sum(sizes)
+
+
+# Wildcard schema: matches anything (reference: ANY caps).
+ANY = StreamSpec((), FORMAT_FLEXIBLE, None)
+
+
+# ---------------------------------------------------------------------------
+# Flexible-tensor self-describing header
+# Reference: GstTensorMetaInfo (tensor_typedef.h) serialized per-memory for
+# format=flexible streams; append/parse at nnstreamer_plugin_api_impl.c:1464.
+# ---------------------------------------------------------------------------
+_FLEX_MAGIC = 0x5450534E  # "NSPT"
+_FLEX_VERSION = 1
+# layout: magic u32 | version u32 | dtype-name-len u8 | rank u8 | pad u16 |
+#         dims i32 * rank | dtype-name bytes
+_FLEX_FIXED = struct.Struct("<IIBBH")
+
+
+def pack_flex_header(spec: TensorSpec) -> bytes:
+    """Serialize a per-tensor self-describing header (flexible streams)."""
+    if not spec.is_static:
+        raise ValueError("flex header requires concrete shape")
+    name = dtype_to_name(spec.dtype).encode()
+    head = _FLEX_FIXED.pack(_FLEX_MAGIC, _FLEX_VERSION, len(name), spec.rank, 0)
+    dims = struct.pack(f"<{spec.rank}i", *spec.shape) if spec.rank else b""
+    return head + dims + name
+
+
+def unpack_flex_header(buf: bytes) -> Tuple[TensorSpec, int]:
+    """Parse a flex header; returns (spec, header_size)."""
+    try:
+        magic, version, nlen, rank, _ = _FLEX_FIXED.unpack_from(buf, 0)
+        if magic != _FLEX_MAGIC:
+            raise ValueError("bad flexible-tensor header magic")
+        if version != _FLEX_VERSION:
+            raise ValueError(f"unsupported flex header version {version}")
+        off = _FLEX_FIXED.size
+        dims = struct.unpack_from(f"<{rank}i", buf, off) if rank else ()
+        off += 4 * rank
+        name = buf[off : off + nlen]
+        if len(name) != nlen:
+            raise ValueError("truncated flexible-tensor header: dtype name")
+        dtype = dtype_from_name(name.decode())
+        off += nlen
+    except struct.error as e:
+        raise ValueError(f"truncated flexible-tensor header: {e}") from None
+    return TensorSpec(tuple(dims), dtype), off
+
+
+# ---------------------------------------------------------------------------
+# Sparse payload (CSR-like flat encoding)
+# Reference: gsttensor_sparseutil.c:27-153 — values + linear indices + nnz.
+# ---------------------------------------------------------------------------
+def sparse_encode(dense: np.ndarray) -> Tuple[np.ndarray, np.ndarray, TensorSpec]:
+    """Dense array -> (values, linear_indices) + original spec."""
+    flat = np.ascontiguousarray(dense).reshape(-1)
+    idx = np.flatnonzero(flat).astype(np.uint32)
+    return flat[idx], idx, TensorSpec(tuple(dense.shape), dense.dtype)
+
+
+def sparse_decode(values: np.ndarray, indices: np.ndarray, spec: TensorSpec) -> np.ndarray:
+    """Inverse of :func:`sparse_encode`."""
+    if not spec.is_static:
+        raise ValueError("sparse decode requires concrete spec")
+    flat = np.zeros(spec.num_elements, dtype=spec.dtype)
+    flat[indices.astype(np.int64)] = values.astype(spec.dtype, copy=False)
+    return flat.reshape(spec.shape)

@@ -1,0 +1,123 @@
+"""Tests for time-sync policies (Collator).
+
+Behavior modeled on the reference's mux/merge sync semantics
+(``Documentation/synchronization-policies-at-mux-merge.md``,
+``nnstreamer_plugin_api_impl.c:101-533``).
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.sync import BASEPAD, NOSYNC, REFRESH, SLOWEST, Collator, SyncPolicy
+
+
+def frame(v, pts):
+    return TensorFrame([np.array([v], np.int32)], pts=pts)
+
+
+def val(f):
+    return int(f.tensors[0][0])
+
+
+class TestNoSync:
+    def test_pairs_in_arrival_order(self):
+        c = Collator(2, SyncPolicy(NOSYNC))
+        assert c.collect() is None
+        c.push(0, frame(1, 0.0))
+        assert c.collect() is None  # pad 1 empty
+        c.push(1, frame(10, 5.0))  # timestamps ignored
+        out = c.collect()
+        assert [val(f) for f in out] == [1, 10]
+
+    def test_eos_pad_repeats_last(self):
+        c = Collator(2, SyncPolicy(NOSYNC))
+        c.push(0, frame(1, 0.0))
+        c.push(1, frame(10, 0.0))
+        c.collect()
+        c.mark_eos(1)
+        c.push(0, frame(2, 1.0))
+        out = c.collect()
+        assert [val(f) for f in out] == [2, 10]
+
+
+class TestSlowest:
+    def test_fast_pad_drops_to_base(self):
+        c = Collator(2, SyncPolicy(SLOWEST))
+        # pad 0 at 30fps-ish, pad 1 slower
+        for i, t in enumerate([0.0, 0.033, 0.066]):
+            c.push(0, frame(i, t))
+        c.push(1, frame(100, 0.066))
+        out = c.collect()
+        # base = max heads = 0.066 after drops -> pad0 contributes frame at 0.066
+        assert val(out[0]) == 2
+        assert val(out[1]) == 100
+
+    def test_not_ready_until_all_pads(self):
+        c = Collator(2, SyncPolicy(SLOWEST))
+        c.push(0, frame(0, 0.0))
+        assert c.collect() is None
+
+
+class TestBasepad:
+    def test_base_drives_output(self):
+        c = Collator(2, SyncPolicy.from_string(BASEPAD, "0:1.0"))
+        c.push(1, frame(10, 0.0))
+        c.push(0, frame(1, 0.1))
+        out = c.collect()
+        assert [val(f) for f in out] == [1, 10]
+        # next base frame reuses pad1's last when nothing newer in window
+        c.push(0, frame(2, 0.2))
+        out = c.collect()
+        assert [val(f) for f in out] == [2, 10]
+
+    def test_waits_for_other_pad_first_frame(self):
+        c = Collator(2, SyncPolicy.from_string(BASEPAD, "0:1.0"))
+        c.push(0, frame(1, 0.0))
+        assert c.collect() is None  # pad 1 never seen yet
+
+
+class TestRefresh:
+    def test_any_new_frame_triggers(self):
+        c = Collator(2, SyncPolicy(REFRESH))
+        c.push(0, frame(1, 0.0))
+        assert c.collect() is None  # pad1 never seen
+        c.push(1, frame(10, 0.0))
+        assert [val(f) for f in c.collect()] == [1, 10]
+        # new frame only on pad 0 -> re-emit with pad1's last
+        c.push(0, frame(2, 1.0))
+        assert [val(f) for f in c.collect()] == [2, 10]
+        assert c.collect() is None  # nothing new
+
+
+class TestEOS:
+    def test_nosync_needs_all_pads_drained(self):
+        c = Collator(2, SyncPolicy(NOSYNC))
+        c.mark_eos(0)
+        assert not c.all_eos  # pad 1 still alive: EOS pad repeats its last
+        c.mark_eos(1)
+        assert c.all_eos
+
+    def test_slowest_ends_with_slowest_pad(self):
+        c = Collator(2, SyncPolicy(SLOWEST))
+        c.mark_eos(0)
+        assert c.all_eos  # slowest pad drained ends the stream
+
+    def test_basepad_ends_with_base_pad(self):
+        c = Collator(2, SyncPolicy.from_string(BASEPAD, "0:1.0"))
+        c.mark_eos(1)
+        assert not c.all_eos
+        c.mark_eos(0)
+        assert c.all_eos
+
+    def test_basepad_zero_window_is_strict(self):
+        assert SyncPolicy.from_string(BASEPAD, "0:0").window == 0.0  # explicit 0 = strict
+        assert SyncPolicy.from_string(BASEPAD, "0").window is None  # omitted = unlimited
+        c2 = Collator(2, SyncPolicy(BASEPAD, 0, 0.0))
+        c2.push(0, frame(1, 0.0))
+        c2.push(1, frame(10, 0.0))
+        assert [val(f) for f in c2.collect()] == [1, 10]
+        # frame far past the window must NOT be consumed for base pts 0.1
+        c2.push(0, frame(2, 0.1))
+        c2.push(1, frame(11, 99.0))
+        out = c2.collect()
+        assert [val(f) for f in out] == [2, 10]  # reuses last, not the future frame

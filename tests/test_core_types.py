@@ -1,0 +1,186 @@
+"""Unit tests for the core type system.
+
+Modeled on the reference's common unit tests
+(``tests/common/unittest_common.cc``): type<->string round trips, dim string
+parse/print, size calculation, info equality/compat, caps intersection,
+flexible header round trip, sparse encode/decode — positive and negative
+("_n") cases.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import types as T
+
+
+class TestDtypes:
+    def test_roundtrip_all_names(self):
+        for name in T.all_type_names():
+            dt = T.dtype_from_name(name)
+            assert T.dtype_to_name(dt) == name
+
+    def test_case_insensitive(self):
+        assert T.dtype_from_name(" FLOAT32 ") == np.dtype(np.float32)
+
+    def test_unknown_name_n(self):
+        with pytest.raises(ValueError):
+            T.dtype_from_name("float128")
+
+    def test_bfloat16_present(self):
+        # TPU-native extension beyond the reference's 11 types
+        assert "bfloat16" in T.all_type_names()
+
+
+class TestDims:
+    def test_parse_reference_dialect(self):
+        # "3:224:224:1" is C:W:H:N innermost-first -> numpy (1,224,224,3)
+        assert T.parse_dims_string("3:224:224:1") == (1, 224, 224, 3)
+
+    def test_roundtrip(self):
+        s = "3:224:224:1"
+        assert T.dims_to_string(T.parse_dims_string(s)) == s
+
+    def test_flexible_dim(self):
+        assert T.parse_dims_string("3:0:0:1") == (1, None, None, 3)
+
+    def test_rank_limit_n(self):
+        with pytest.raises(ValueError):
+            T.parse_dims_string(":".join(["2"] * 17))
+
+    def test_empty_n(self):
+        with pytest.raises(ValueError):
+            T.parse_dims_string("")
+
+
+class TestTensorSpec:
+    def test_size(self):
+        # reference gst_tensor_info_get_size semantics
+        s = T.TensorSpec((1, 224, 224, 3), np.uint8)
+        assert s.num_elements == 224 * 224 * 3
+        assert s.nbytes == 224 * 224 * 3
+
+    def test_flexible_size_none(self):
+        s = T.TensorSpec((None, 224, 224, 3), np.uint8)
+        assert s.nbytes is None and not s.is_static
+
+    def test_string_roundtrip(self):
+        s = T.TensorSpec.from_string("float32:10:1:1:1")
+        assert s.dtype == np.dtype(np.float32)
+        assert s.shape == (1, 1, 1, 10)
+        assert s.to_string() == "float32:10:1:1:1"
+
+    def test_compat_wildcard(self):
+        a = T.TensorSpec((None, 224, 224, 3), np.uint8)
+        b = T.TensorSpec((8, 224, 224, 3), np.uint8)
+        assert a.is_compatible(b)
+        assert a.intersect(b).shape == (8, 224, 224, 3)
+
+    def test_incompatible_dtype_n(self):
+        a = T.TensorSpec((1, 2), np.uint8)
+        b = T.TensorSpec((1, 2), np.int8)
+        assert not a.is_compatible(b)
+        assert a.intersect(b) is None
+
+    def test_matches_array(self):
+        s = T.TensorSpec((None, 3), np.float32)
+        assert s.matches(np.zeros((5, 3), np.float32))
+        assert not s.matches(np.zeros((5, 4), np.float32))
+
+    def test_bad_dim_n(self):
+        with pytest.raises(ValueError):
+            T.TensorSpec((0, 3), np.float32)
+
+
+class TestStreamSpec:
+    def make(self):
+        return T.StreamSpec(
+            (
+                T.TensorSpec((1, 224, 224, 3), np.uint8),
+                T.TensorSpec((1, 1001), np.float32),
+            ),
+            T.FORMAT_STATIC,
+        )
+
+    def test_validate(self):
+        assert self.make().validate()
+        assert not T.StreamSpec((), T.FORMAT_STATIC).validate()
+
+    def test_string_roundtrip(self):
+        s = self.make()
+        s2 = T.StreamSpec.from_string(s.to_string())
+        assert s2 == s
+
+    def test_parse_caps_like(self):
+        s = T.StreamSpec.from_string(
+            "tensors,format=static,num=1,dimensions=3:224:224:1,types=uint8,framerate=30/1"
+        )
+        assert s.num_tensors == 1
+        assert s.tensors[0].shape == (1, 224, 224, 3)
+        assert s.framerate == 30
+
+    def test_intersect(self):
+        a = T.StreamSpec((T.TensorSpec((None, 10), np.float32),))
+        b = T.StreamSpec((T.TensorSpec((4, 10), np.float32),))
+        m = a.intersect(b)
+        assert m.tensors[0].shape == (4, 10)
+
+    def test_format_mismatch_n(self):
+        a = self.make()
+        b = T.StreamSpec(a.tensors, T.FORMAT_FLEXIBLE)
+        assert not a.is_compatible(b)
+
+    def test_any_wildcard(self):
+        # ANY (zero-tensor flexible) matches and intersects with anything
+        s = self.make()
+        assert T.ANY.is_compatible(s) and s.is_compatible(T.ANY)
+        assert T.ANY.intersect(s) == s
+        assert s.intersect(T.ANY) == s
+
+    def test_numpy_int_dims_accepted(self):
+        s = T.TensorSpec((np.int64(2), np.int32(3)), np.uint8)
+        assert s.shape == (2, 3) and all(type(d) is int for d in s.shape)
+
+    def test_bool_dim_rejected_n(self):
+        with pytest.raises(ValueError):
+            T.TensorSpec((True, 3), np.uint8)
+
+    def test_pick_combination(self):
+        # input-combination subset/reorder (reference tensor_filter.c:723)
+        s = self.make()
+        p = s.pick([1, 0])
+        assert p.tensors[0].dtype == np.dtype(np.float32)
+        assert p.tensors[1].dtype == np.dtype(np.uint8)
+
+
+class TestFlexHeader:
+    def test_roundtrip(self):
+        spec = T.TensorSpec((2, 3, 4), np.float16)
+        blob = T.pack_flex_header(spec) + b"payload"
+        parsed, off = T.unpack_flex_header(blob)
+        assert parsed.shape == (2, 3, 4)
+        assert parsed.dtype == np.dtype(np.float16)
+        assert blob[off:] == b"payload"
+
+    def test_bad_magic_n(self):
+        with pytest.raises(ValueError):
+            T.unpack_flex_header(b"\x00" * 32)
+
+    def test_flexible_spec_rejected_n(self):
+        with pytest.raises(ValueError):
+            T.pack_flex_header(T.TensorSpec((None, 3), np.uint8))
+
+
+class TestSparse:
+    def test_roundtrip(self, rng):
+        dense = rng.random((8, 16)).astype(np.float32)
+        dense[dense < 0.8] = 0.0
+        vals, idx, spec = T.sparse_encode(dense)
+        assert len(vals) == np.count_nonzero(dense)
+        out = T.sparse_decode(vals, idx, spec)
+        np.testing.assert_array_equal(out, dense)
+
+    def test_all_zero(self):
+        dense = np.zeros((4, 4), np.int8)
+        vals, idx, spec = T.sparse_encode(dense)
+        assert len(vals) == 0
+        np.testing.assert_array_equal(T.sparse_decode(vals, idx, spec), dense)
