@@ -1,0 +1,15 @@
+"""Filter backends (≙ L2 filter subplugins, ext/nnstreamer/tensor_filter/).
+
+Importing registers the built-in backends; heavyweight ones (jax-xla, torch)
+register lazily so importing the package stays light.
+"""
+
+from ..core import registry
+from .base import FilterBackend, FrameworkInfo, find_backend, parse_accelerator, register_backend  # noqa: F401
+from . import fakes  # noqa: F401 — registers passthrough/scaler/average/framecounter
+from .custom_easy import CustomEasy, register_custom_easy, unregister_custom_easy  # noqa: F401
+
+registry.register_lazy(registry.KIND_FILTER, "jax-xla", "nnstreamer_tpu.backends.jax_xla:JaxXla")
+registry.register_lazy(registry.KIND_FILTER, "python3", "nnstreamer_tpu.backends.python3:Python3Backend")
+registry.register_lazy(registry.KIND_FILTER, "torch", "nnstreamer_tpu.backends.torch_cpu:TorchBackend")
+registry.register_lazy(registry.KIND_FILTER, "tflite", "nnstreamer_tpu.backends.tflite_import:TFLiteImportBackend")

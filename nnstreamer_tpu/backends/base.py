@@ -1,0 +1,196 @@
+"""Filter-backend ABI: the pluggable model-runner contract.
+
+Reference: ``GstTensorFilterFramework`` v1
+(``nnstreamer_plugin_api_filter.h:418-494``: ``open/close``, ``invoke``,
+``getFrameworkInfo``, ``getModelInfo(GET_IN_OUT_INFO | SET_INPUT_INFO)``,
+``eventHandler``) and the C++ author class
+``nnstreamer::tensor_filter_subplugin``
+(``include/nnstreamer_cppplugin_api_filter.hh:54-180``).
+
+TPU-native deltas:
+
+* ``invoke`` takes/returns a *list of arrays per frame*, and backends may
+  additionally implement ``invoke_batch`` (arrays with a leading batch dim)
+  — the micro-batching hook the filter element uses to amortize dispatch
+  into one XLA call (the reference has no batching; this is the ≥1000 fps
+  lever, SURVEY §7 stage 4).
+* device placement is advisory (``accelerator`` strings parse to a wish
+  list; XLA owns placement on TPU).
+* backends may keep outputs on device (jax.Array) — zero-copy between
+  chained filters (≙ allocate-in-invoke + GstMemory mapping).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import registry
+from ..core.types import StreamSpec
+
+# hardware wish-list names (reference accl_hw enum,
+# nnstreamer_plugin_api_filter.h:80-102); on TPU most map to "tpu"
+KNOWN_ACCELERATORS = (
+    "auto",
+    "default",
+    "cpu",
+    "cpu.simd",
+    "gpu",
+    "npu",
+    "tpu",
+    "npu.edgetpu",
+)
+
+
+def parse_accelerator(text: Optional[str]) -> Tuple[bool, List[str]]:
+    """Parse "true:tpu,cpu" / "false" accelerator strings.
+
+    Reference: regex parsing in ``tensor_filter_common.c:2719-2878``.
+    Returns (enabled, ordered wish list).
+    """
+    if not text:
+        return True, ["auto"]
+    head, _, rest = text.strip().partition(":")
+    enabled = head.strip().lower() not in ("false", "0", "no", "off")
+    wishes = [w.strip() for w in rest.split(",") if w.strip()] or ["auto"]
+    return enabled, wishes
+
+
+@dataclass
+class FrameworkInfo:
+    """≙ getFrameworkInfo."""
+
+    name: str
+    allow_in_place: bool = False
+    allocate_in_invoke: bool = True  # backends return fresh arrays
+    run_without_model: bool = False
+    verify_model_path: bool = True
+    hw_list: Tuple[str, ...] = ("tpu", "cpu")
+
+
+@dataclass
+class InvokeStats:
+    """Per-backend invoke statistics (≙ GstTensorFilterFrameworkStatistics,
+    nnstreamer_plugin_api_filter.h:170-175)."""
+
+    total_invoke_num: int = 0
+    total_invoke_latency_s: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record(self, dt: float) -> None:
+        with self._lock:
+            self.total_invoke_num += 1
+            self.total_invoke_latency_s += dt
+
+    @property
+    def avg_latency_s(self) -> float:
+        with self._lock:
+            if not self.total_invoke_num:
+                return 0.0
+            return self.total_invoke_latency_s / self.total_invoke_num
+
+
+class FilterBackend:
+    """Base class for filter backends (≙ tensor_filter_subplugin).
+
+    Lifecycle: ``open(model, props)`` once → ``invoke``/``invoke_batch`` per
+    frame/batch → ``close()``.  ``reload(model)`` hot-swaps weights without
+    dropping frames (≙ RELOAD_MODEL event / is-updatable,
+    tensor_filter_tensorflow_lite.cc:274 double-buffered reload).
+    """
+
+    NAME = "base"
+
+    def __init__(self):
+        self.stats = InvokeStats()
+        self.model_path: Optional[str] = None
+        self.custom_props: Dict[str, str] = {}
+
+    # -- framework info -----------------------------------------------------
+    def framework_info(self) -> FrameworkInfo:
+        return FrameworkInfo(name=self.NAME)
+
+    # -- lifecycle ----------------------------------------------------------
+    def open(self, model_path: Optional[str], props: Dict[str, Any]) -> None:
+        self.model_path = model_path
+        custom = props.get("custom") or ""
+        # "key1:val1,key2:val2" custom-prop dialect (reference `custom` prop)
+        for part in str(custom).split(","):
+            if ":" in part:
+                k, _, v = part.partition(":")
+                self.custom_props[k.strip()] = v.strip()
+
+    def close(self) -> None:
+        pass
+
+    def reload(self, model_path: str) -> None:
+        """Hot model reload; default = close+open."""
+        props = {"custom": ",".join(f"{k}:{v}" for k, v in self.custom_props.items())}
+        self.close()
+        self.open(model_path, props)
+
+    # -- model info ---------------------------------------------------------
+    def get_model_info(self) -> Tuple[Optional[StreamSpec], Optional[StreamSpec]]:
+        """(input schema, output schema); either may be None if the backend
+        derives it from the incoming stream (≙ GET_IN_OUT_INFO)."""
+        return None, None
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        """Given the negotiated input schema, return the output schema
+        (≙ SET_INPUT_INFO for shape-polymorphic models)."""
+        raise NotImplementedError(f"{self.NAME}: cannot derive output schema")
+
+    # -- execution ----------------------------------------------------------
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        """Run one frame: list of per-tensor arrays -> list of arrays."""
+        raise NotImplementedError
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        """Run a micro-batch: each array has a leading batch dim.  Default
+        falls back to per-frame invoke."""
+        import numpy as np
+
+        batch = inputs[0].shape[0]
+        outs: List[List[Any]] = []
+        for b in range(batch):
+            outs.append(self.invoke([a[b] for a in inputs]))
+        return [np.stack([o[i] for o in outs]) for i in range(len(outs[0]))]
+
+    @property
+    def supports_batch(self) -> bool:
+        """True if invoke_batch is native (not the per-frame fallback)."""
+        return type(self).invoke_batch is not FilterBackend.invoke_batch
+
+    # -- events -------------------------------------------------------------
+    def handle_event(self, name: str, data: Dict[str, Any]) -> None:
+        pass
+
+    # -- timed wrappers (stats) --------------------------------------------
+    def timed_invoke(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.perf_counter()
+        out = self.invoke(inputs)
+        self.stats.record(time.perf_counter() - t0)
+        return out
+
+    def timed_invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.perf_counter()
+        out = self.invoke_batch(inputs)
+        self.stats.record(time.perf_counter() - t0)
+        return out
+
+
+def register_backend(cls_or_name, cls=None) -> None:
+    """Register a FilterBackend class (≙ nnstreamer_filter_probe,
+    tensor_filter_common.c:611)."""
+    if cls is None:
+        cls, name = cls_or_name, cls_or_name.NAME
+    else:
+        name = cls_or_name
+    registry.register(registry.KIND_FILTER, name, cls)
+
+
+def find_backend(name: str) -> type:
+    """≙ nnstreamer_filter_find (tensor_filter_common.c:697)."""
+    return registry.get(registry.KIND_FILTER, name)

@@ -1,0 +1,74 @@
+"""custom-easy backend: register a plain Python callable as a model.
+
+Reference: ``tensor_filter_custom_easy.c`` /
+``include/tensor_filter_custom_easy.h`` — register an in-process C function
+under a name and run it via ``framework=custom-easy model=<name>``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.types import StreamSpec
+from .base import FilterBackend, register_backend
+
+_table_lock = threading.Lock()
+_table: Dict[str, Tuple[Callable, Optional[StreamSpec], Optional[StreamSpec]]] = {}
+
+
+def register_custom_easy(
+    name: str,
+    fn: Callable[[List[Any]], List[Any]],
+    in_spec: Optional[StreamSpec] = None,
+    out_spec: Optional[StreamSpec] = None,
+) -> None:
+    """≙ NNS_custom_easy_register."""
+    with _table_lock:
+        _table[name] = (fn, in_spec, out_spec)
+
+
+def unregister_custom_easy(name: str) -> bool:
+    """≙ NNS_custom_easy_unregister."""
+    with _table_lock:
+        return _table.pop(name, None) is not None
+
+
+class CustomEasy(FilterBackend):
+    NAME = "custom-easy"
+
+    def __init__(self):
+        super().__init__()
+        self._fn: Optional[Callable] = None
+        self._in: Optional[StreamSpec] = None
+        self._out: Optional[StreamSpec] = None
+
+    def open(self, model_path, props):
+        super().open(model_path, props)
+        with _table_lock:
+            entry = _table.get(model_path or "")
+        if entry is None:
+            raise FileNotFoundError(
+                f"custom-easy function {model_path!r} is not registered"
+            )
+        self._fn, self._in, self._out = entry
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.verify_model_path = False  # model is a registry key, not a file
+        return info
+
+    def get_model_info(self):
+        return self._in, self._out
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        if self._out is not None:
+            return self._out
+        return in_spec  # untyped callables default to same-schema
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        assert self._fn is not None
+        return self._fn(list(inputs))
+
+
+register_backend(CustomEasy)

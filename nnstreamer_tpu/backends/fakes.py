@@ -1,0 +1,111 @@
+"""Deterministic fake backends for tests.
+
+Reference analog: the custom-filter scaffolding subplugins used as fake
+backends throughout the reference test suite
+(``tests/nnstreamer_example/``: passthrough, scaler, average, framecounter)
+so element behavior is testable without any NN framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.types import StreamSpec, TensorSpec
+from .base import FilterBackend, register_backend
+
+
+class Passthrough(FilterBackend):
+    """Identity model (≙ nnstreamer_customfilter_example_passthrough)."""
+
+    NAME = "passthrough"
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.run_without_model = True
+        return info
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        return in_spec
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        return list(inputs)
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        return list(inputs)
+
+
+class Scaler(FilterBackend):
+    """Multiply by a constant from custom props ("factor:2") — the analog of
+    the reference scaler example used to check option plumbing."""
+
+    NAME = "scaler"
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.run_without_model = True
+        return info
+
+    @property
+    def factor(self) -> float:
+        return float(self.custom_props.get("factor", "2"))
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        return in_spec
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        return [np.asarray(a) * np.asarray(a).dtype.type(self.factor) for a in inputs]
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        return self.invoke(inputs)
+
+
+class Average(FilterBackend):
+    """Reduce each tensor to its scalar mean (float32, shape (1,))
+    (≙ nnstreamer_customfilter_example_average)."""
+
+    NAME = "average"
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.run_without_model = True
+        return info
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        return StreamSpec(
+            tuple(TensorSpec((1,), np.float32, t.name) for t in in_spec.tensors),
+            in_spec.fmt,
+            in_spec.framerate,
+        )
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        return [np.asarray([np.asarray(a).mean()], np.float32) for a in inputs]
+
+
+class FrameCounter(FilterBackend):
+    """Emit a running frame counter (tests ordering/liveness)."""
+
+    NAME = "framecounter"
+
+    def __init__(self):
+        super().__init__()
+        self._n = 0
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.run_without_model = True
+        return info
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        return StreamSpec(
+            (TensorSpec((1,), np.int64, "count"),), in_spec.fmt, in_spec.framerate
+        )
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        self._n += 1
+        return [np.asarray([self._n], np.int64)]
+
+
+for _cls in (Passthrough, Scaler, Average, FrameCounter):
+    register_backend(_cls)

@@ -1,0 +1,17 @@
+"""Decoder subplugins (≙ ext/nnstreamer/tensor_decoder/).
+
+Importing registers every decoder mode in the subplugin registry.
+"""
+
+from ..core import registry
+
+registry.register_lazy(registry.KIND_DECODER, "direct_video", "nnstreamer_tpu.decoders.direct_video:DirectVideo")
+registry.register_lazy(registry.KIND_DECODER, "image_labeling", "nnstreamer_tpu.decoders.image_label:ImageLabeling")
+registry.register_lazy(registry.KIND_DECODER, "bounding_boxes", "nnstreamer_tpu.decoders.bounding_box:BoundingBoxes")
+registry.register_lazy(registry.KIND_DECODER, "pose_estimation", "nnstreamer_tpu.decoders.pose:PoseEstimation")
+registry.register_lazy(registry.KIND_DECODER, "image_segment", "nnstreamer_tpu.decoders.segment:ImageSegment")
+registry.register_lazy(registry.KIND_DECODER, "tensor_region", "nnstreamer_tpu.decoders.tensor_region:TensorRegion")
+registry.register_lazy(registry.KIND_DECODER, "octet_stream", "nnstreamer_tpu.decoders.octet:OctetStream")
+registry.register_lazy(registry.KIND_DECODER, "flexbuf", "nnstreamer_tpu.decoders.serialize:FlexbufDecoder")
+registry.register_lazy(registry.KIND_DECODER, "protobuf", "nnstreamer_tpu.decoders.serialize:ProtobufDecoder")
+registry.register_lazy(registry.KIND_DECODER, "python3", "nnstreamer_tpu.decoders.python3:Python3Decoder")

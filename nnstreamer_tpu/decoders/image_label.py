@@ -1,0 +1,51 @@
+"""image_labeling decoder: classification scores -> text label.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-imagelabel.c`` — argmax
+over the score tensor, map through a label file (option1), output
+text/x-raw.  Label-file loading analog: ``tensordecutil.c``.
+
+Output frame: tensor = [argmax index] (int32); ``meta["label"]`` carries the
+text (the text/x-raw analog).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+
+
+def load_labels(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+class ImageLabeling:
+    NAME = "image_labeling"
+
+    def __init__(self):
+        self.labels: Optional[List[str]] = None
+
+    def set_options(self, options):
+        if options and options[0]:
+            self.labels = load_labels(options[0])
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        return StreamSpec(
+            (TensorSpec((1,), np.int32, "label_index"),),
+            FORMAT_STATIC,
+            in_spec.framerate if in_spec else None,
+        )
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        scores = np.asarray(frame.tensors[0]).reshape(-1)
+        idx = int(np.argmax(scores))
+        out = frame.with_tensors([np.asarray([idx], np.int32)])
+        out.meta["label_index"] = idx
+        out.meta["label_score"] = float(scores[idx])
+        if self.labels and idx < len(self.labels):
+            out.meta["label"] = self.labels[idx]
+        return out

@@ -1,0 +1,29 @@
+"""Stream elements (L3).  Importing this package registers every element
+factory (≙ plugin registration, reference
+``gst/nnstreamer/registerer/nnstreamer.c:91-122``)."""
+
+import os as _os
+from importlib import import_module as _imp
+
+from . import basic  # noqa: F401
+
+_here = _os.path.dirname(__file__)
+for _mod in (
+    "converter",
+    "filter",
+    "transform",
+    "decoder",
+    "mux",
+    "aggregator",
+    "flow",
+    "repo",
+    "sparse",
+    "datarepo",
+    "trainer",
+    "query",
+    "edge",
+    "debug",
+):
+    # only skip modules that are not built yet; real import errors propagate
+    if _os.path.exists(_os.path.join(_here, _mod + ".py")):
+        _imp(f"{__name__}.{_mod}")

@@ -1,0 +1,269 @@
+"""Basic source/sink/utility elements.
+
+Reference analogs: appsrc/videotestsrc (GStreamer core sources used by every
+nnstreamer example pipeline), ``tensor_sink`` (appsink-like terminal with
+``new-data`` signals — ``gst/nnstreamer/elements/gsttensor_sink.c``),
+``queue`` (thread boundary; here every element already has a thread so it
+only sets mailbox depth), ``tee`` (fan-out), capsfilter (schema constraint),
+``join`` (N:1 first-come forwarding — ``gst/join/gstjoin.c``).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from fractions import Fraction
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+
+from ..core.buffer import EOS, CapsEvent, Event, TensorFrame
+from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec, parse_dims_string, dtype_from_name
+from ..pipeline.element import (
+    Element,
+    ElementError,
+    Property,
+    SinkElement,
+    SourceElement,
+    TransformElement,
+    element,
+)
+
+
+@element("appsrc")
+class AppSrc(SourceElement):
+    """Push-model source: the application feeds frames via ``push()``.
+
+    ≙ GStreamer appsrc, the standard way tests/apps inject data.
+    """
+
+    PROPERTIES = {
+        "max-buffers": Property(int, 64, "internal queue depth"),
+        "framerate": Property(str, "", "n/d framerate stamped on frames without pts"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=self.PROPERTIES["max-buffers"].default)
+        self._spec: StreamSpec = ANY
+        self._count = 0
+
+    def start(self):
+        # honor max-buffers: a full queue blocks push() — backpressure
+        # reaches the producer (≙ appsrc max-buffers/block)
+        depth = int(self.props["max-buffers"])
+        if self._q.maxsize != depth and self._q.empty():
+            self._q = _queue.Queue(maxsize=depth)
+
+    def set_spec(self, spec: StreamSpec) -> None:
+        self._spec = spec
+
+    def output_spec(self) -> StreamSpec:
+        return self._spec
+
+    def push(self, frame_or_arrays: Any, pts: Optional[float] = None) -> None:
+        if isinstance(frame_or_arrays, TensorFrame):
+            frame = frame_or_arrays
+        else:
+            arrays = (
+                list(frame_or_arrays)
+                if isinstance(frame_or_arrays, (list, tuple))
+                else [frame_or_arrays]
+            )
+            frame = TensorFrame([np.asarray(a) for a in arrays], pts=pts)
+        if frame.pts is None:
+            fr = self.props["framerate"]
+            if fr:
+                n, _, d = fr.partition("/")
+                frame.pts = self._count * float(Fraction(int(d or 1), int(n)))
+        self._count += 1
+        self._q.put(frame)
+
+    def end_of_stream(self) -> None:
+        self._q.put(None)
+
+    def frames(self) -> Iterator[TensorFrame]:
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                # stay responsive to pipeline stop while idle
+                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            yield item
+
+
+@element("videotestsrc")
+class VideoTestSrc(SourceElement):
+    """Synthetic video source (≙ gst videotestsrc as used in reference SSAT
+    tests): deterministic RGB pattern frames."""
+
+    PROPERTIES = {
+        "num-buffers": Property(int, 10, "number of frames to emit (-1 = unlimited)"),
+        "width": Property(int, 224),
+        "height": Property(int, 224),
+        "framerate": Property(str, "30/1"),
+        "pattern": Property(str, "gradient", "gradient|solid|random"),
+        "seed": Property(int, 0),
+    }
+
+    def output_spec(self) -> StreamSpec:
+        h, w = self.props["height"], self.props["width"]
+        n, _, d = self.props["framerate"].partition("/")
+        return StreamSpec(
+            (TensorSpec((h, w, 3), np.uint8, "video"),),
+            FORMAT_STATIC,
+            Fraction(int(n), int(d or 1)),
+        )
+
+    def frames(self) -> Iterator[TensorFrame]:
+        h, w = self.props["height"], self.props["width"]
+        n, _, d = self.props["framerate"].partition("/")
+        dt = float(Fraction(int(d or 1), int(n)))
+        rng = np.random.default_rng(self.props["seed"])
+        count = self.props["num-buffers"]
+        i = 0
+        while count < 0 or i < count:
+            if self.props["pattern"] == "random":
+                img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            elif self.props["pattern"] == "solid":
+                img = np.full((h, w, 3), (i * 8) % 256, np.uint8)
+            else:  # gradient, phase-shifted per frame
+                row = (np.arange(w, dtype=np.uint32) * 255 // max(w - 1, 1) + i * 3) % 256
+                img = np.broadcast_to(row[None, :, None], (h, w, 3)).astype(np.uint8)
+            yield TensorFrame([img], pts=i * dt, duration=dt)
+            i += 1
+
+
+@element("tensor_sink", "appsink")
+class TensorSink(SinkElement):
+    """Terminal sink emitting new-data callbacks and retaining frames.
+
+    ≙ ``tensor_sink`` (gsttensor_sink.c): signals new-data/eos, property to
+    cap retained frames.
+    """
+
+    PROPERTIES = {
+        "max-stored": Property(int, 0, "retain at most N frames (0 = all)"),
+        "to-host": Property(bool, True, "materialize device arrays on render"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.frames: List[TensorFrame] = []
+        self._callbacks: List[Callable[[TensorFrame], None]] = []
+        self.eos_received = threading.Event()
+
+    def connect_new_data(self, cb: Callable[[TensorFrame], None]) -> None:
+        self._callbacks.append(cb)
+
+    def render(self, frame: TensorFrame) -> None:
+        if self.props["to-host"]:
+            frame = frame.to_host()
+        limit = self.props["max-stored"]
+        self.frames.append(frame)
+        if limit and len(self.frames) > limit:
+            self.frames.pop(0)
+        for cb in self._callbacks:
+            cb(frame)
+
+    def handle_eos(self, pad):
+        # the scheduler routes EOS here (not handle_event)
+        self.eos_received.set()
+        return []
+
+
+@element("queue")
+class Queue(TransformElement):
+    """Thread-boundary element.  Every element here already runs on its own
+    thread; `queue` remains for pipeline-text compatibility and to set the
+    buffering depth (`max-buffers` maps to the mailbox size)."""
+
+    PROPERTIES = {
+        "max-buffers": Property(int, 16, "bounded queue depth (backpressure)"),
+        "leaky": Property(str, "", "''|downstream — drop newest when full (unused placeholder)"),
+    }
+
+    def transform(self, frame):
+        return frame
+
+
+@element("identity")
+class Identity(TransformElement):
+    PROPERTIES = {
+        "sleep": Property(float, 0.0, "artificial per-frame delay, seconds (tests)"),
+    }
+
+    def transform(self, frame):
+        if self.props["sleep"]:
+            time.sleep(self.props["sleep"])
+        return frame
+
+
+@element("tee")
+class Tee(Element):
+    """1:N fan-out; frames are pushed to every linked src pad (payloads are
+    shared, not copied — downstream must not mutate in place)."""
+
+    NUM_SRC_PADS = None  # request pads
+
+    def derive_spec(self, pad=0):
+        return self.sink_specs.get(0, ANY)
+
+    def handle_frame(self, pad, frame):
+        return [(i, frame) for i in range(len(self.srcpads))]
+
+
+@element("capsfilter")
+class CapsFilter(TransformElement):
+    """Constrain the stream schema (≙ capsfilter with other/tensors caps).
+
+    The parser creates one for bare schema strings between ``!`` links.
+    """
+
+    PROPERTIES = {"caps": Property(str, "", "tensors schema string")}
+
+    def _target(self) -> StreamSpec:
+        text = self.props["caps"]
+        return StreamSpec.from_string(text) if text else ANY
+
+    def accept_spec(self, pad, spec):
+        merged = self._target().intersect(spec)
+        if merged is None:
+            raise ElementError(
+                f"{self.name}: schema {spec.to_string()} does not satisfy {self.props['caps']}"
+            )
+        return merged
+
+    def derive_spec(self, pad=0):
+        return self.sink_specs.get(0, self._target())
+
+    def transform(self, frame):
+        return frame
+
+
+@element("join")
+class Join(Element):
+    """N:1 first-come forwarding without synchronization.
+
+    ≙ ``gst/join/gstjoin.c``: whichever sink pad receives data first pushes
+    through; no collation.
+    """
+
+    NUM_SINK_PADS = None
+
+    def derive_spec(self, pad=0):
+        for spec in self.sink_specs.values():
+            return spec
+        return ANY
+
+    def handle_frame(self, pad, frame):
+        return [(0, frame)]
+
+    def handle_eos(self, pad):
+        return []  # scheduler emits EOS when all pads end

@@ -1,0 +1,71 @@
+"""tensor_decoder: tensor stream -> media/labels/boxes via decoder subplugins.
+
+Reference: ``gst/nnstreamer/elements/gsttensor_decoder.c`` (mode prop + 9
+option strings passed to the subplugin, ``nnstreamer_decoder_find`` :177) and
+the decoder ABI ``GstTensorDecoderDef`` {init, exit, setOption, getOutCaps,
+decode} (``nnstreamer_plugin_api_decoder.h:38-61``).
+
+Decoder subplugins register under registry kind "decoder" with the contract:
+
+    class MyDecoder:
+        NAME = "my_mode"
+        def set_options(self, options: list[str]) -> None: ...
+        def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec: ...
+        def decode(self, frame: TensorFrame, in_spec) -> TensorFrame: ...
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core import registry
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..pipeline.element import Element, ElementError, Property, TransformElement, element
+from .. import decoders as _decoders  # noqa: F401 — registers decoder modes
+
+_N_OPTIONS = 9  # reference carries option1..option9
+
+
+@element("tensor_decoder")
+class TensorDecoder(TransformElement):
+    PROPERTIES = {
+        "mode": Property(str, "", "decoder subplugin name"),
+        **{
+            f"option{i}": Property(str, "", f"mode-specific option {i}")
+            for i in range(1, _N_OPTIONS + 1)
+        },
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._dec = None
+
+    def start(self):
+        mode = self.props["mode"]
+        if not mode:
+            raise ElementError(f"{self.name}: decoder requires mode=")
+        try:
+            cls = registry.get(registry.KIND_DECODER, mode)
+        except KeyError:
+            raise ElementError(f"{self.name}: unknown decoder mode {mode!r}") from None
+        self._dec = cls() if isinstance(cls, type) else cls
+        options = [self.props[f"option{i}"] for i in range(1, _N_OPTIONS + 1)]
+        if hasattr(self._dec, "set_options"):
+            self._dec.set_options(options)
+
+    def stop(self):
+        if self._dec is not None and hasattr(self._dec, "exit"):
+            self._dec.exit()
+        self._dec = None
+
+    def derive_spec(self, pad=0):
+        in_spec = self.sink_specs.get(0, ANY)
+        if self._dec is not None and hasattr(self._dec, "get_out_spec"):
+            return self._dec.get_out_spec(in_spec)
+        return ANY
+
+    def transform(self, frame):
+        assert self._dec is not None, f"{self.name} not started"
+        return self._dec.decode(frame, self.sink_specs.get(0, ANY))

@@ -1,0 +1,15 @@
+"""Pipeline runtime (L0/L3 skeleton): elements, threaded scheduler, parser."""
+
+from .element import (  # noqa: F401
+    Element,
+    ElementError,
+    Property,
+    SinkElement,
+    SourceElement,
+    TransformElement,
+    element,
+    make_element,
+    ELEMENT_TYPES,
+)
+from .pipeline import BusMessage, Pipeline  # noqa: F401
+from .parser import ParseError, launch, parse_pipeline  # noqa: F401

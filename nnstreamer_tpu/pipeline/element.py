@@ -1,0 +1,279 @@
+"""Element model: the composable unit of a pipeline.
+
+Reference analog: GStreamer GstElement/GstPad conventions as used by the
+nnstreamer elements (``gst/nnstreamer/elements/``, registered in
+``gst/nnstreamer/registerer/nnstreamer.c:91-122``):
+
+* properties — the reference's entire user API is stringly-typed GObject
+  properties embedded in pipeline text; here each Element declares a
+  ``PROPERTIES`` table (name -> Property) and values are set/parsed the same
+  way from pipeline descriptions.
+* pads & negotiation — elements declare how many sink/src pads they expose
+  and negotiate schemas by intersection (``accept_spec`` / ``derive_spec``),
+  the analog of caps negotiation (fixed at PLAYING transition, reference
+  ``tensor_filter.c:1157-1314``).
+* processing — 1:1/1:N elements implement ``handle_frame``; N:1 elements get
+  a time-sync :class:`~nnstreamer_tpu.core.sync.Collator`; sources implement
+  ``frames()``; sinks ``render()``.
+
+TPU-first: elements never copy payloads; they pass numpy/jax arrays through
+and are encouraged to express compute as jit-able functions so chains fuse.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+from ..core.buffer import EOS, CapsEvent, CustomEvent, Event, Flush, TensorFrame
+from ..core.log import get_logger
+from ..core.types import ANY, StreamSpec
+
+
+# ---------------------------------------------------------------------------
+# Property system (≙ GObject properties)
+# ---------------------------------------------------------------------------
+@dataclass
+class Property:
+    """Declared element property: type-checked, string-parsable."""
+
+    type: type = str
+    default: Any = None
+    doc: str = ""
+    # optional validator/transformer applied after type conversion
+    convert: Optional[Callable[[Any], Any]] = None
+
+    def parse(self, value: Any) -> Any:
+        if isinstance(value, str) and self.type is not str:
+            if self.type is bool:
+                value = value.strip().lower() in ("1", "true", "yes", "on")
+            elif self.type in (int, float):
+                value = self.type(value)
+            elif self.type in (list, tuple):
+                value = self.type(
+                    s.strip() for s in value.split(",") if s.strip() != ""
+                )
+        if self.type is not None and value is not None and not isinstance(value, self.type):
+            try:
+                value = self.type(value)
+            except Exception:
+                raise ValueError(f"cannot convert {value!r} to {self.type.__name__}")
+        return self.convert(value) if self.convert else value
+
+
+class ElementError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Element registry (≙ gst element factory names)
+# ---------------------------------------------------------------------------
+ELEMENT_TYPES: Dict[str, type] = {}
+
+
+def element(name: str, *aliases: str):
+    """Class decorator registering an element factory name."""
+
+    def wrap(cls):
+        cls.FACTORY_NAME = name
+        for n in (name, *aliases):
+            ELEMENT_TYPES[n] = cls
+        return cls
+
+    return wrap
+
+
+def make_element(factory: str, name: Optional[str] = None, **props) -> "Element":
+    if factory not in ELEMENT_TYPES:
+        raise ElementError(f"no such element factory {factory!r}")
+    el = ELEMENT_TYPES[factory](name=name)
+    for k, v in props.items():
+        el.set_property(k, v)
+    return el
+
+
+# ---------------------------------------------------------------------------
+# Pads & links
+# ---------------------------------------------------------------------------
+class SrcPad:
+    """An output pad; delivers items to linked sink pads (fan-out copies ≙ tee)."""
+
+    def __init__(self, owner: "Element", index: int):
+        self.owner = owner
+        self.index = index
+        self.links: List[Tuple["Element", int]] = []
+        self.spec: Optional[StreamSpec] = None
+
+    def link(self, sink_element: "Element", sink_pad: int = 0) -> None:
+        self.links.append((sink_element, sink_pad))
+
+    def push(self, item: Union[TensorFrame, Event]) -> None:
+        for el, pad in self.links:
+            el.deliver(pad, item)
+
+    @property
+    def is_linked(self) -> bool:
+        return bool(self.links)
+
+
+# ---------------------------------------------------------------------------
+# Base element
+# ---------------------------------------------------------------------------
+class Element:
+    """Base pipeline element.
+
+    Subclass contract:
+      * class attrs ``NUM_SINK_PADS`` / ``NUM_SRC_PADS`` (``None`` = dynamic,
+        request pads created on link).
+      * ``PROPERTIES``: dict of declared properties.
+      * override ``accept_spec`` (validate/intersect incoming schema per pad),
+        ``derive_spec`` (compute output schema), ``handle_frame``,
+        ``handle_event``, ``start``/``stop`` as needed.
+    """
+
+    FACTORY_NAME = "element"
+    NUM_SINK_PADS: Optional[int] = 1
+    NUM_SRC_PADS: Optional[int] = 1
+    PROPERTIES: Dict[str, Property] = {}
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or f"{self.FACTORY_NAME}{id(self) & 0xFFFF}"
+        self.log = get_logger(self.name)
+        self.props: Dict[str, Any] = {
+            k: p.default for k, p in self.PROPERTIES.items()
+        }
+        nsrc = self.NUM_SRC_PADS if self.NUM_SRC_PADS is not None else 0
+        self.srcpads: List[SrcPad] = [SrcPad(self, i) for i in range(nsrc)]
+        self.sink_specs: Dict[int, StreamSpec] = {}
+        self._pipeline = None  # set by Pipeline.add
+        self._mailbox = None  # set by Pipeline at start for elements w/ sinks
+
+    # -- properties ---------------------------------------------------------
+    def set_property(self, key: str, value: Any) -> None:
+        key = key.replace("_", "-")
+        decl = self.PROPERTIES.get(key)
+        if decl is None:
+            raise ElementError(f"{self.name}: unknown property {key!r}")
+        self.props[key] = decl.parse(value)
+
+    def get_property(self, key: str) -> Any:
+        key = key.replace("_", "-")
+        if key not in self.props:
+            raise ElementError(f"{self.name}: unknown property {key!r}")
+        return self.props[key]
+
+    # -- pads ---------------------------------------------------------------
+    def request_src_pad(self) -> SrcPad:
+        """Create a new src pad (dynamic-src elements: demux/split/tee)."""
+        pad = SrcPad(self, len(self.srcpads))
+        self.srcpads.append(pad)
+        return pad
+
+    def srcpad(self, i: int = 0) -> SrcPad:
+        if self.NUM_SRC_PADS is None:
+            while len(self.srcpads) <= i:
+                self.request_src_pad()
+        return self.srcpads[i]
+
+    def link(self, downstream: "Element", src_pad: int = 0, sink_pad: Optional[int] = None) -> "Element":
+        """Link this element's src pad to downstream's sink pad; returns
+        downstream for chaining: ``a.link(b).link(c)``."""
+        if sink_pad is None:
+            sink_pad = downstream.next_sink_pad()
+        self.srcpad(src_pad).link(downstream, sink_pad)
+        return downstream
+
+    _next_sink = 0
+
+    def next_sink_pad(self) -> int:
+        """Allocate the next sink pad index (N:1 request pads)."""
+        if self.NUM_SINK_PADS == 1:
+            return 0
+        i = self._next_sink
+        self._next_sink += 1
+        return i
+
+    @property
+    def num_sink_pads(self) -> int:
+        if self.NUM_SINK_PADS is not None:
+            return self.NUM_SINK_PADS
+        return max(self._next_sink, 1)
+
+    # -- delivery (called from upstream worker threads) ---------------------
+    def deliver(self, pad: int, item: Union[TensorFrame, Event]) -> None:
+        assert self._mailbox is not None, f"{self.name} not scheduled"
+        self._mailbox.put((pad, item))
+
+    # -- negotiation --------------------------------------------------------
+    def accept_spec(self, pad: int, spec: StreamSpec) -> StreamSpec:
+        """Validate/refine the incoming schema on `pad`.
+
+        Raise ElementError to reject (negotiation failure)."""
+        return spec
+
+    def derive_spec(self, pad: int = 0) -> StreamSpec:
+        """Output schema for src pad `pad`, given ``self.sink_specs``."""
+        return self.sink_specs.get(0, ANY)
+
+    def set_sink_spec(self, pad: int, spec: StreamSpec) -> None:
+        self.sink_specs[pad] = self.accept_spec(pad, spec)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """Transition to running (open models, allocate state)."""
+
+    def stop(self) -> None:
+        """Release resources."""
+
+    # -- processing ---------------------------------------------------------
+    def handle_frame(
+        self, pad: int, frame: TensorFrame
+    ) -> Iterable[Tuple[int, TensorFrame]]:
+        """Process one frame from sink pad `pad`; yield (src_pad, frame)."""
+        return [(0, frame)]
+
+    def handle_event(self, pad: int, event: Event) -> Iterable[Tuple[int, Event]]:
+        """Process an in-band event; default: forward to all src pads once
+        (EOS aggregation across sink pads is handled by the scheduler)."""
+        return [(i, event) for i in range(len(self.srcpads))]
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SourceElement(Element):
+    """Element with no sink pads; produces frames from ``frames()``."""
+
+    NUM_SINK_PADS = 0
+
+    def frames(self) -> Iterator[TensorFrame]:
+        raise NotImplementedError
+
+    def output_spec(self) -> StreamSpec:
+        """Schema this source produces (sent as CapsEvent before data)."""
+        return ANY
+
+
+class SinkElement(Element):
+    """Element with no src pads; consumes frames via ``render()``."""
+
+    NUM_SRC_PADS = 0
+
+    def render(self, frame: TensorFrame) -> None:
+        raise NotImplementedError
+
+    def handle_frame(self, pad, frame):
+        self.render(frame)
+        return []
+
+
+class TransformElement(Element):
+    """1:1 element transforming each frame (≙ GstBaseTransform)."""
+
+    def transform(self, frame: TensorFrame) -> Optional[TensorFrame]:
+        raise NotImplementedError
+
+    def handle_frame(self, pad, frame):
+        out = self.transform(frame)
+        return [] if out is None else [(0, out)]

@@ -1,0 +1,134 @@
+"""Textual pipeline descriptions (gst-launch dialect).
+
+The reference's de-facto CLI is ``gst-launch-1.0`` pipeline text (SURVEY §1
+L6); keeping the same dialect lets reference examples map 1:1::
+
+    videotestsrc num-buffers=8 ! tensor_converter !
+      tensor_filter framework=jax-xla model=m.msgpack !
+      tensor_decoder mode=image_labeling option1=labels.txt ! tensor_sink name=out
+
+Supported subset:
+  * ``!`` links elements left to right.
+  * ``key=value`` tokens set properties on the preceding element
+    (``name=x`` registers the element under a pipeline-wide name).
+  * ``x.`` starts a new chain from the named element ``x`` (tee branches,
+    mux inputs): ``tee name=t  t. ! a  t. ! b`` and ``a ! m.  b ! m.``.
+  * a bare schema string (``tensors,format=...``) becomes a capsfilter.
+  * quotes protect spaces in values.
+
+Reference grammar analog: ``tools/development/parser/{parse.l,grammar.y}``.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Dict, List, Optional
+
+from ..pipeline.element import Element, ElementError, make_element, ELEMENT_TYPES
+from ..pipeline.pipeline import Pipeline
+
+# elements register themselves on import (≙ plugin registration,
+# reference gst/nnstreamer/registerer/nnstreamer.c:91-122)
+from .. import elements as _elements  # noqa: F401
+
+
+def _is_caps(token: str) -> bool:
+    head = token.split(",", 1)[0]
+    return head in ("tensors", "other/tensors") or head.startswith("other/")
+
+
+class ParseError(ValueError):
+    pass
+
+
+def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
+    """Parse a pipeline description into an (unstarted) Pipeline."""
+    try:
+        tokens = shlex.split(text.replace("\n", " "))
+    except ValueError as e:
+        raise ParseError(f"tokenize failed: {e}") from None
+    if not tokens:
+        raise ParseError("empty pipeline description")
+
+    pipe = Pipeline(name)
+    named: Dict[str, Element] = {}
+    current: Optional[Element] = None
+    pending_src: Optional[Element] = None
+    link_requested = False
+    caps_n = 0
+
+    def new_node(el: Element) -> None:
+        nonlocal current, pending_src, link_requested
+        pipe.add(el)
+        if link_requested:
+            if pending_src is None:
+                raise ParseError("dangling '!' with no upstream element")
+            pending_src.link(el)
+        pending_src = None
+        link_requested = False
+        current = el
+
+    for tok in tokens:
+        if tok == "!":
+            if current is None:
+                raise ParseError("'!' with no preceding element")
+            pending_src = current
+            link_requested = True
+            continue
+        if tok.endswith(".") and len(tok) > 1:
+            ref = tok[:-1]
+            if ref not in named:
+                raise ParseError(f"reference to unknown element {ref!r}")
+            if link_requested:
+                # "a ! m."  — link current chain INTO the named element
+                pending_src.link(named[ref])
+                pending_src = None
+                link_requested = False
+                current = None
+            else:
+                # "t. ! a" — start a new chain FROM the named element
+                current = named[ref]
+            continue
+        if _is_caps(tok):
+            caps_n += 1
+            el = make_element("capsfilter", name=f"capsfilter{caps_n}", caps=tok)
+            new_node(el)
+            continue
+        if "=" in tok and tok.split("=", 1)[0] not in ELEMENT_TYPES:
+            if current is None:
+                raise ParseError(f"property {tok!r} with no preceding element")
+            key, value = tok.split("=", 1)
+            if key == "name":
+                # re-register under the user-visible name
+                if value in named:
+                    raise ParseError(f"duplicate element name {value!r}")
+                del pipe.elements[current.name]
+                current.name = value
+                pipe.elements[value] = current
+                named[value] = current
+            else:
+                current.set_property(key, value)
+            continue
+        # element factory
+        try:
+            el = make_element(tok)
+        except ElementError as e:
+            raise ParseError(str(e)) from None
+        # ensure unique auto-name within the pipeline
+        base = el.name
+        n = 2
+        while el.name in pipe.elements:
+            el.name = f"{base}_{n}"
+            n += 1
+        new_node(el)
+
+    if link_requested:
+        raise ParseError("pipeline text ends with dangling '!'")
+    return pipe
+
+
+def launch(text: str, timeout: Optional[float] = None) -> Pipeline:
+    """Parse + run to completion (≙ gst-launch): returns the finished pipeline."""
+    pipe = parse_pipeline(text)
+    pipe.run(timeout)
+    return pipe

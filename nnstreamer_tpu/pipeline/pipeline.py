@@ -1,0 +1,353 @@
+"""Pipeline scheduler: threaded element graph with bounded queues.
+
+Reference analog: GStreamer's execution model (L0 in SURVEY.md) — each
+element runs on a streaming thread, connected by pads; ``queue`` elements add
+thread boundaries and bounded buffering creates backpressure.  Here *every*
+element gets its own worker thread and a bounded mailbox, so pipeline
+parallelism (the reference's primary parallelism: elements concurrently
+processing different frames) is the default, and a full mailbox blocks the
+upstream thread — the backpressure analog.
+
+Lifecycle ≙ NULL→PLAYING: ``start()`` negotiates schemas (CapsEvents flow
+before data), spawns workers; ``stop()`` tears down; ``wait()`` joins until
+EOS has reached every sink (≙ bus EOS message), re-raising element errors.
+
+The bus carries out-of-band messages (errors, element custom messages like
+training stats) to the application (≙ GstBus).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..core.buffer import EOS, CapsEvent, CustomEvent, Event, Flush, TensorFrame
+from ..core.log import get_logger
+from .element import Element, ElementError, SinkElement, SourceElement
+
+_STOP = object()  # out-of-band worker shutdown sentinel
+
+
+@dataclass
+class BusMessage:
+    """Out-of-band message to the application (≙ GstMessage)."""
+
+    kind: str  # "error" | "eos" | "element"
+    source: str
+    data: Any = None
+
+
+class Pipeline:
+    """A running graph of elements."""
+
+    def __init__(self, name: str = "pipeline", default_queue_size: int = 16):
+        self.name = name
+        self.log = get_logger(name)
+        self.elements: Dict[str, Element] = {}
+        self.default_queue_size = default_queue_size
+        self._threads: List[threading.Thread] = []
+        self._stop_flag = threading.Event()
+        self._started = False
+        self.errors: List[BaseException] = []
+        self._bus: "queue.Queue[BusMessage]" = queue.Queue()
+        self._bus_watchers: List[Callable[[BusMessage], None]] = []
+        self._sinks_done = threading.Event()
+        self._pending_sinks = 0
+        self._sink_lock = threading.Lock()
+
+    # -- construction -------------------------------------------------------
+    def add(self, *elements: Element) -> Element:
+        for el in elements:
+            if el.name in self.elements and self.elements[el.name] is not el:
+                raise ElementError(f"duplicate element name {el.name!r}")
+            self.elements[el.name] = el
+            el._pipeline = self
+        return elements[-1]
+
+    def chain(self, *elements: Element) -> Element:
+        """add + link a linear chain; returns the last element."""
+        self.add(*elements)
+        for a, b in zip(elements, elements[1:]):
+            a.link(b)
+        return elements[-1]
+
+    def __getitem__(self, name: str) -> Element:
+        return self.elements[name]
+
+    # -- bus ----------------------------------------------------------------
+    def post(self, msg: BusMessage) -> None:
+        self._bus.put(msg)
+        for cb in list(self._bus_watchers):
+            try:
+                cb(msg)
+            except Exception:  # watcher bugs must not kill workers
+                self.log.exception("bus watcher failed")
+
+    def add_bus_watcher(self, cb: Callable[[BusMessage], None]) -> None:
+        self._bus_watchers.append(cb)
+
+    def pop_message(self, timeout: Optional[float] = 0) -> Optional[BusMessage]:
+        try:
+            return self._bus.get(timeout=timeout) if timeout else self._bus.get_nowait()
+        except queue.Empty:
+            return None
+
+    # -- schema negotiation (static pass, ≙ initial caps negotiation) -------
+    def _negotiate(self) -> None:
+        """Propagate output schemas topologically and let each element
+        validate via accept_spec.  Dynamic/renegotiation still happens via
+        in-band CapsEvents at runtime; this pass fails fast at start()."""
+        in_degree: Dict[str, int] = {n: 0 for n in self.elements}
+        for el in self.elements.values():
+            for pad in el.srcpads:
+                for dst, _ in pad.links:
+                    in_degree[dst.name] += 1
+        ready = [self.elements[n] for n, d in in_degree.items() if d == 0]
+        seen = 0
+        while ready:
+            el = ready.pop()
+            seen += 1
+            if isinstance(el, SourceElement):
+                for pad in el.srcpads:
+                    pad.spec = el.output_spec()
+            else:
+                for i, pad in enumerate(el.srcpads):
+                    pad.spec = el.derive_spec(i)
+            for pad in el.srcpads:
+                for dst, sink_pad in pad.links:
+                    if pad.spec is not None:
+                        dst.set_sink_spec(sink_pad, pad.spec)
+                    in_degree[dst.name] -= 1
+                    if in_degree[dst.name] == 0:
+                        ready.append(dst)
+        if seen != len(self.elements):
+            # cycles are legal only through repo src/sink (out-of-band), which
+            # do not create graph edges — anything else is a bug.
+            raise ElementError("pipeline graph has a cycle through pad links")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Pipeline":
+        if self._started:
+            return self
+        started: List[Element] = []
+        try:
+            # start (open models/resources) BEFORE the static negotiation
+            # pass so elements can expose model-derived schemas (reference:
+            # caps negotiation triggers subplugin open, tensor_filter.c:1157)
+            for el in self.elements.values():
+                el.start()
+                started.append(el)
+            self._negotiate()
+        except BaseException:
+            for el in started:
+                try:
+                    el.stop()
+                except Exception:
+                    self.log.exception("stop() failed for %s", el.name)
+            raise
+        self._pending_sinks = sum(
+            1 for el in self.elements.values() if not el.srcpads
+        )
+        if self._pending_sinks == 0:
+            self._sinks_done.set()
+        # mailboxes for every element with sink pads
+        for el in self.elements.values():
+            if not isinstance(el, SourceElement):
+                size = self.default_queue_size
+                if "max-buffers" in el.props and el.props["max-buffers"]:
+                    size = int(el.props["max-buffers"])
+                el._mailbox = queue.Queue(maxsize=size)
+        self._stop_flag.clear()
+        for el in self.elements.values():
+            target = self._run_source if isinstance(el, SourceElement) else self._run_element
+            t = threading.Thread(target=target, args=(el,), name=el.name, daemon=True)
+            self._threads.append(t)
+        for t in self._threads:
+            t.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        for el in self.elements.values():
+            if el._mailbox is not None:
+                try:
+                    el._mailbox.put_nowait((0, _STOP))
+                except queue.Full:
+                    # drain one slot so the sentinel fits
+                    try:
+                        el._mailbox.get_nowait()
+                        el._mailbox.put_nowait((0, _STOP))
+                    except (queue.Empty, queue.Full):
+                        pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for el in self.elements.values():
+            try:
+                el.stop()
+            except Exception:
+                self.log.exception("stop() failed for %s", el.name)
+        self._threads.clear()
+        self._started = False
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until EOS reached every sink; re-raise the first element
+        error.  ≙ waiting for EOS/ERROR on the GstBus."""
+        finished = self._sinks_done.wait(timeout)
+        if self.errors:
+            raise self.errors[0]
+        if not finished:
+            raise TimeoutError(f"pipeline {self.name!r} did not finish in {timeout}s")
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """start + wait + stop."""
+        self.start()
+        try:
+            self.wait(timeout)
+        finally:
+            self.stop()
+
+    # -- worker loops -------------------------------------------------------
+    def _guard(self, el: Element, fn, *args):
+        try:
+            return fn(*args)
+        except BaseException as e:  # noqa: BLE001 — worker boundary
+            self.log.exception("element %s failed", el.name)
+            self.errors.append(e)
+            self.post(BusMessage("error", el.name, e))
+            self._stop_flag.set()
+            self._sinks_done.set()  # unblock wait()
+            return None
+
+    def _push(self, el: Element, src_pad: int, item) -> bool:
+        """Push downstream with backpressure; False if stopping."""
+        pad = el.srcpads[src_pad]
+        for dst, sink_pad in pad.links:
+            while not self._stop_flag.is_set():
+                try:
+                    dst._mailbox.put((sink_pad, item), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            else:
+                return False
+        return True
+
+    def _run_source(self, el: SourceElement) -> None:
+        def body():
+            for i in range(len(el.srcpads)):
+                spec = el.output_spec() if len(el.srcpads) == 1 else el.derive_spec(i)
+                self._push(el, i, CapsEvent(spec))
+            for frame in el.frames():
+                if self._stop_flag.is_set():
+                    return
+                if isinstance(frame, Event):
+                    outs = el.handle_event(0, frame) or []
+                    for sp, ev in outs:
+                        self._push(el, sp, ev)
+                    continue
+                if not self._push(el, 0, frame):
+                    return
+            for i in range(len(el.srcpads)):
+                self._push(el, i, EOS())
+
+        self._guard(el, body)
+
+    def _run_element(self, el: Element) -> None:
+        connected = {
+            pad
+            for other in self.elements.values()
+            for sp in other.srcpads
+            for dst, pad in sp.links
+            if dst is el
+        } or {0}
+        eos_pads: set = set()
+        caps_pads: set = set()
+
+        def finish_eos():
+            if el.srcpads:
+                for i in range(len(el.srcpads)):
+                    self._push(el, i, EOS())
+            else:
+                with self._sink_lock:
+                    self._pending_sinks -= 1
+                    if self._pending_sinks <= 0:
+                        self._sinks_done.set()
+                self.post(BusMessage("eos", el.name))
+
+        def body():
+            stash: Optional[Tuple[int, Any]] = None
+            while not self._stop_flag.is_set():
+                if stash is not None:
+                    pad, item = stash
+                    stash = None
+                else:
+                    try:
+                        pad, item = el._mailbox.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                if item is _STOP:
+                    return
+                if isinstance(item, TensorFrame):
+                    # micro-batching: batch-capable elements drain extra
+                    # queued frames and process them in one call (the TPU
+                    # dispatch-amortization lever; no reference analog).
+                    want = getattr(el, "preferred_batch", 1)
+                    if want > 1 and hasattr(el, "handle_frame_batch"):
+                        frames = [item]
+                        while len(frames) < want:
+                            try:
+                                p2, nxt = el._mailbox.get_nowait()
+                            except queue.Empty:
+                                break
+                            if isinstance(nxt, TensorFrame) and p2 == pad:
+                                frames.append(nxt)
+                            else:
+                                stash = (p2, nxt)  # event/other-pad: after batch
+                                break
+                        outs = el.handle_frame_batch(pad, frames) or []
+                    else:
+                        outs = el.handle_frame(pad, item) or []
+                    for sp, out in outs:
+                        if not self._push(el, sp, out):
+                            return
+                elif isinstance(item, CapsEvent):
+                    el.set_sink_spec(pad, item.spec)
+                    caps_pads.add(pad)
+                    if caps_pads >= connected:
+                        for i in range(len(el.srcpads)):
+                            if not self._push(el, i, CapsEvent(el.derive_spec(i))):
+                                return
+                elif isinstance(item, EOS):
+                    eos_pads.add(pad)
+                    outs = el.handle_eos(pad) if hasattr(el, "handle_eos") else None
+                    for sp, out in outs or []:
+                        if not self._push(el, sp, out):
+                            return
+                    if eos_pads >= connected:
+                        finish_eos()
+                        return
+                elif isinstance(item, Flush):
+                    # drop queued FRAMES only; events (EOS/caps/_STOP) behind
+                    # the flush must survive in order
+                    kept = []
+                    try:
+                        while True:
+                            p2, nxt = el._mailbox.get_nowait()
+                            if not isinstance(nxt, TensorFrame):
+                                kept.append((p2, nxt))
+                    except queue.Empty:
+                        pass
+                    for entry in kept:
+                        el._mailbox.put(entry)
+                    for sp, ev in el.handle_event(pad, item) or []:
+                        self._push(el, sp, ev)
+                else:  # custom events
+                    for sp, ev in el.handle_event(pad, item) or []:
+                        if not self._push(el, sp, ev):
+                            return
+
+        self._guard(el, body)

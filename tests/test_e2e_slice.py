@@ -1,0 +1,110 @@
+"""End-to-end slice: source -> converter -> filter -> decoder -> sink.
+
+The minimum viable pipeline from SURVEY §7 stage 4, using a deterministic
+custom-easy "classifier" instead of a real model (the reference tests element
+behavior with fake backends the same way).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends import register_custom_easy, unregister_custom_easy
+from nnstreamer_tpu.core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+@pytest.fixture
+def labels_file(tmp_path):
+    p = tmp_path / "labels.txt"
+    p.write_text("cat\ndog\nbird\n")
+    return str(p)
+
+
+@pytest.fixture
+def brightness_classifier():
+    """3-class 'model': classify mean brightness of an image batch."""
+
+    def fn(xs):
+        img = np.asarray(xs[0], np.float32)
+        mean = img.mean()
+        scores = np.stack(
+            [
+                np.exp(-abs(mean - 64.0) / 32),
+                np.exp(-abs(mean - 128.0) / 32),
+                np.exp(-abs(mean - 192.0) / 32),
+            ]
+        ).astype(np.float32)
+        return [scores]
+
+    register_custom_easy(
+        "brightness",
+        fn,
+        out_spec=StreamSpec((TensorSpec((3,), np.float32, "scores"),), FORMAT_STATIC),
+    )
+    yield
+    unregister_custom_easy("brightness")
+
+
+class TestEndToEnd:
+    def test_video_label_pipeline(self, labels_file, brightness_classifier):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=6 width=32 height=32 pattern=solid ! "
+            "tensor_converter ! "
+            "tensor_filter framework=custom-easy model=brightness ! "
+            f"tensor_decoder mode=image_labeling option1={labels_file} ! "
+            "tensor_sink name=out"
+        )
+        pipe.run(timeout=20)
+        frames = pipe["out"].frames
+        assert len(frames) == 6
+        for f in frames:
+            assert "label" in f.meta
+            assert f.meta["label"] in ("cat", "dog", "bird")
+        # solid pattern brightens per frame index (i*8): first frames darkest
+        assert frames[0].meta["label"] == "cat"
+
+    def test_converter_frames_per_tensor(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=6 width=8 height=8 ! "
+            "tensor_converter frames-per-tensor=3 ! tensor_sink name=out"
+        )
+        pipe.run(timeout=20)
+        frames = pipe["out"].frames
+        assert len(frames) == 2
+        assert frames[0].tensors[0].shape == (3, 8, 8, 3)
+
+    def test_converter_octet_mode(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_converter input-dim=4:2 input-type=uint16 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        raw = np.arange(16, dtype=np.uint8)  # 16 bytes -> (2,4) uint16
+        pipe["src"].push(raw)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        out = pipe["out"].frames[0].tensors[0]
+        assert out.dtype == np.uint16 and out.shape == (2, 4)
+        np.testing.assert_array_equal(out, raw.view(np.uint16).reshape(2, 4))
+
+    def test_direct_video_decoder(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=2 width=16 height=16 ! tensor_converter ! "
+            "tensor_filter framework=passthrough ! "
+            "tensor_decoder mode=direct_video ! tensor_sink name=out"
+        )
+        pipe.run(timeout=20)
+        f = pipe["out"].frames[0]
+        assert f.meta.get("media") == "video"
+        assert f.tensors[0].shape == (16, 16, 3) and f.tensors[0].dtype == np.uint8
+
+    def test_decoder_unknown_mode_n(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=1 ! tensor_decoder mode=nope ! tensor_sink"
+        )
+        with pytest.raises(Exception, match="unknown decoder mode"):
+            pipe.start()
+        pipe.stop()

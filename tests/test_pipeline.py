@@ -1,0 +1,164 @@
+"""Pipeline runtime tests: threading, backpressure, events, parser.
+
+Modeled on the reference's pipeline-level SSAT suites (launch a pipeline,
+collect sink output, byte-compare) but as in-process pytest.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.types import StreamSpec, TensorSpec
+from nnstreamer_tpu.pipeline import (
+    ElementError,
+    ParseError,
+    Pipeline,
+    TransformElement,
+    element,
+    make_element,
+    parse_pipeline,
+)
+from nnstreamer_tpu.elements.basic import AppSrc, TensorSink, VideoTestSrc
+
+
+class TestProgrammatic:
+    def test_linear_chain(self):
+        pipe = Pipeline("t")
+        src = make_element("videotestsrc", **{"num-buffers": 5, "width": 8, "height": 8})
+        sink = make_element("tensor_sink")
+        pipe.chain(src, make_element("identity"), sink)
+        pipe.run(timeout=10)
+        assert len(sink.frames) == 5
+        assert sink.frames[0].tensors[0].shape == (8, 8, 3)
+        # pts stamped from framerate
+        assert sink.frames[1].pts == pytest.approx(1 / 30)
+
+    def test_appsrc_push(self):
+        pipe = Pipeline("t")
+        src = AppSrc()
+        sink = TensorSink()
+        pipe.chain(src, sink)
+        pipe.start()
+        for i in range(3):
+            src.push(np.full((4,), i, np.int32))
+        src.end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        assert [int(f.tensors[0][0]) for f in sink.frames] == [0, 1, 2]
+
+    def test_tee_fanout(self):
+        pipe = Pipeline("t")
+        src = make_element("videotestsrc", **{"num-buffers": 4, "width": 4, "height": 4})
+        tee = make_element("tee")
+        s1, s2 = TensorSink("s1"), TensorSink("s2")
+        pipe.add(src, tee, s1, s2)
+        src.link(tee)
+        tee.link(s1, src_pad=0)
+        tee.link(s2, src_pad=1)
+        pipe.run(timeout=10)
+        assert len(s1.frames) == 4 and len(s2.frames) == 4
+
+    def test_error_propagates(self):
+        @element("_exploder")
+        class Exploder(TransformElement):
+            def transform(self, frame):
+                raise RuntimeError("boom")
+
+        pipe = Pipeline("t")
+        pipe.chain(
+            make_element("videotestsrc", **{"num-buffers": 2}),
+            make_element("_exploder"),
+            TensorSink(),
+        )
+        pipe.start()
+        with pytest.raises(RuntimeError, match="boom"):
+            pipe.wait(timeout=10)
+        pipe.stop()
+        msgs = []
+        while (m := pipe.pop_message()) is not None:
+            msgs.append(m)
+        assert any(m.kind == "error" for m in msgs)
+
+    def test_backpressure_bounded(self):
+        # a slow sink must throttle a fast source via bounded mailboxes
+        pipe = Pipeline("t", default_queue_size=2)
+        src = AppSrc()
+        slow = make_element("identity", sleep=0.01)
+        sink = TensorSink()
+        pipe.chain(src, slow, sink)
+        pipe.start()
+        for i in range(30):
+            src.push(np.int32([i]))
+        src.end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert len(sink.frames) == 30  # nothing dropped
+
+    def test_caps_negotiation_failure(self):
+        pipe = Pipeline("t")
+        src = make_element("videotestsrc", width=8, height=8)
+        cf = make_element("capsfilter", caps="tensors,format=static,num=1,dimensions=3:16:16,types=uint8")
+        pipe.chain(src, cf, TensorSink())
+        with pytest.raises(ElementError, match="does not satisfy"):
+            pipe.start()
+        pipe.stop()
+
+
+class TestParser:
+    def test_parse_linear(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=3 width=16 height=16 ! queue ! tensor_sink name=out"
+        )
+        pipe.run(timeout=10)
+        assert len(pipe["out"].frames) == 3
+
+    def test_parse_tee_branches(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=2 width=4 height=4 ! tee name=t "
+            "t. ! queue ! tensor_sink name=a  t. ! queue ! tensor_sink name=b"
+        )
+        pipe.run(timeout=10)
+        assert len(pipe["a"].frames) == 2
+        assert len(pipe["b"].frames) == 2
+
+    def test_parse_capsfilter(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! "
+            "tensors,format=static,num=1,dimensions=3:8:8,types=uint8 ! tensor_sink name=out"
+        )
+        pipe.run(timeout=10)
+        assert len(pipe["out"].frames) == 2
+
+    def test_parse_errors(self):
+        with pytest.raises(ParseError):
+            parse_pipeline("videotestsrc !")
+        with pytest.raises(ParseError):
+            parse_pipeline("! tensor_sink")
+        with pytest.raises(ParseError):
+            parse_pipeline("nonexistent_element_xyz")
+        with pytest.raises(ParseError):
+            parse_pipeline("")
+        with pytest.raises(ParseError):
+            parse_pipeline("videotestsrc ! nosuch. ! tensor_sink")
+
+    def test_unknown_property(self):
+        with pytest.raises(ElementError, match="unknown property"):
+            parse_pipeline("videotestsrc bogus-prop=3 ! tensor_sink")
+
+    def test_join_first_come(self):
+        pipe = parse_pipeline(
+            "appsrc name=a ! join name=j  appsrc name=b ! j.  j. ! tensor_sink name=out"
+        )
+        # "j. ! sink" after feeding INTO j: j's src chain
+        pipe.start()
+        pipe["a"].push(np.int32([1]))
+        pipe["b"].push(np.int32([2]))
+        pipe["a"].end_of_stream()
+        pipe["b"].end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        vals = sorted(int(f.tensors[0][0]) for f in pipe["out"].frames)
+        assert vals == [1, 2]
