@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Headline benchmark: MobileNet-v2 image-labeling pipeline, fps per chip.
+
+Mirrors the reference's flagship configuration (BASELINE.md: MobileNet-v2
+labeling via tensor_filter; target >= 1000 fps/chip on TPU v5e-1): a full
+streaming pipeline — source -> tensor_filter(jax-xla, MobileNet-v2 bf16,
+micro-batched) -> tensor_decoder(image_labeling) -> tensor_sink — measured
+end-to-end, not a bare model loop.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is fps / 1000 (the BASELINE.json north-star target).
+
+Runs on the real TPU by default; BENCH_PLATFORM=cpu forces CPU (debug).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from nnstreamer_tpu.backends.jax_xla import register_jax_model
+    from nnstreamer_tpu.models import build
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    n_frames = int(os.environ.get("BENCH_FRAMES", "4096"))
+    size = 224
+
+    fn, params, in_spec, out_spec = build(
+        "mobilenet_v2", {"dtype": os.environ.get("BENCH_DTYPE", "bfloat16")}
+    )
+    register_jax_model("mobilenet_v2_bench", fn, params, in_spec, out_spec)
+
+    labels_path = "/tmp/nns_bench_labels.txt"
+    with open(labels_path, "w") as f:
+        f.write("\n".join(f"class{i}" for i in range(1001)))
+
+    pipe = parse_pipeline(
+        "appsrc name=src max-buffers=512 ! "
+        "tensor_filter name=f framework=jax-xla model=mobilenet_v2_bench "
+        f"max-batch={batch} latency=1 throughput=1 ! "
+        f"tensor_decoder mode=image_labeling option1={labels_path} ! "
+        "tensor_sink name=out max-stored=1",
+        name="bench",
+    )
+    # frame pool: realistic uint8 camera frames, cycled (generation off the
+    # measured path)
+    rng = np.random.default_rng(0)
+    pool = [
+        rng.integers(0, 255, (size, size, 3), dtype=np.uint8) for _ in range(16)
+    ]
+
+    pipe.start()
+    src, sink, filt = pipe["src"], pipe["out"], pipe["f"]
+
+    # warmup: trigger compiles for the full bucket and any tail buckets
+    done = {"n": 0}
+    sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+    for i in range(batch * 2):
+        src.push(pool[i % len(pool)])
+    t_wait = time.time()
+    while done["n"] < batch * 2 and time.time() - t_wait < 300:
+        time.sleep(0.01)
+    # drain stragglers so leftover warmup completions can never leak into
+    # the measured counter: wait until the count is stable for 2 s
+    stable_since, last = time.time(), done["n"]
+    while time.time() - stable_since < 2.0:
+        time.sleep(0.1)
+        if done["n"] != last:
+            stable_since, last = time.time(), done["n"]
+
+    # measured run
+    done["n"] = 0
+    t0 = time.perf_counter()
+    for i in range(n_frames):
+        src.push(pool[i % len(pool)])
+    while done["n"] < n_frames and time.perf_counter() - t0 < 600:
+        time.sleep(0.005)
+    dt = time.perf_counter() - t0
+    fps = done["n"] / dt
+
+    src.end_of_stream()
+    pipe.wait(timeout=60)
+    pipe.stop()
+
+    result = {
+        "metric": "mobilenet_v2_image_labeling_fps_per_chip",
+        "value": round(fps, 1),
+        "unit": "fps",
+        "vs_baseline": round(fps / 1000.0, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

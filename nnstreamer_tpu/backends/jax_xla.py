@@ -1,0 +1,280 @@
+"""jax-xla: the flagship filter backend — JIT-compiles models to XLA TPU
+executables.
+
+This is the TPU-native answer to the reference's backend zoo
+(``ext/nnstreamer/tensor_filter/``, e.g. ``tensor_filter_tensorflow_lite.cc``
+TFLiteCore open/invoke, ``tensor_filter_edgetpu.cc`` device binding): one
+backend, any JAX-expressible model, compiled once per shape bucket and
+dispatched as a single XLA call per micro-batch.
+
+Model resolution (the ``model=`` property):
+
+* a name registered in-process via :func:`register_jax_model`
+  (≙ custom-easy, but jit-compiled);
+* a ``.py`` file defining ``get_model() -> (fn, params)`` where
+  ``fn(params, inputs: list[Array]) -> list[Array]``
+  (≙ the python3 subplugin, but the function is traced, not interpreted);
+* a ``.msgpack`` flax-serialized params file with custom prop
+  ``arch:<zoo-name>`` naming a model family from ``nnstreamer_tpu.models``;
+* an Orbax checkpoint directory with the same ``arch:`` prop.
+
+TPU-first design:
+
+* **shape-bucketed compilation** — XLA needs static shapes; batches are
+  padded up to the next power of two and sliced back, so a steady stream
+  compiles exactly once per bucket (the "flexible tensors vs static XLA"
+  policy from SURVEY §7 hard-part (b)).
+* **native invoke_batch** — one XLA call per micro-batch (dispatch
+  amortization; the ≥1000 fps lever).
+* **donation** — input device buffers are donated to the executable where
+  safe, letting XLA reuse HBM (≙ allocate-in-invoke).
+* **device residency** — outputs stay on device (jax.Array); chained
+  jax-xla filters never bounce through host (≙ zero-copy GstMemory).
+* optional ``dtype:bfloat16`` custom prop casts params/compute to bf16
+  (MXU-native).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .base import FilterBackend, register_backend
+
+_registry_lock = threading.Lock()
+_model_registry: Dict[str, Tuple[Callable, Any, Optional[StreamSpec], Optional[StreamSpec]]] = {}
+
+
+def register_jax_model(
+    name: str,
+    fn: Callable[[Any, List[Any]], List[Any]],
+    params: Any = None,
+    in_spec: Optional[StreamSpec] = None,
+    out_spec: Optional[StreamSpec] = None,
+) -> None:
+    """Register an in-process JAX model under `name`.
+
+    ``fn(params, inputs) -> outputs`` must be jit-traceable. Single-array
+    models may return a bare array.
+    """
+    with _registry_lock:
+        _model_registry[name] = (fn, params, in_spec, out_spec)
+
+
+def unregister_jax_model(name: str) -> bool:
+    with _registry_lock:
+        return _model_registry.pop(name, None) is not None
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class JaxXla(FilterBackend):
+    NAME = "jax-xla"
+
+    def __init__(self):
+        super().__init__()
+        self._fn: Optional[Callable] = None
+        self._params: Any = None
+        self._in_spec: Optional[StreamSpec] = None
+        self._out_spec: Optional[StreamSpec] = None
+        self._device = None
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._cache_lock = threading.Lock()
+        self._reload_lock = threading.Lock()  # double-buffered hot reload
+
+    # -- framework info -----------------------------------------------------
+    def framework_info(self):
+        info = super().framework_info()
+        info.verify_model_path = False  # may be a registry key
+        info.hw_list = ("tpu", "cpu")
+        return info
+
+    # -- model loading ------------------------------------------------------
+    def _resolve_model(self, model_path: Optional[str]):
+        if not model_path:
+            raise ValueError("jax-xla requires model= (registry key or file)")
+        with _registry_lock:
+            entry = _model_registry.get(model_path)
+        if entry is not None:
+            return entry
+        if model_path.endswith(".py") and os.path.isfile(model_path):
+            spec = importlib.util.spec_from_file_location(
+                f"_nns_jax_model_{abs(hash(model_path))}", model_path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            if not hasattr(mod, "get_model"):
+                raise ValueError(f"{model_path}: must define get_model()")
+            got = mod.get_model()
+            fn, params = got[0], got[1]
+            return (fn, params) + tuple(got[2:4]) + (None,) * (2 - len(got[2:4]))
+        arch = self.custom_props.get("arch")
+        if arch:
+            from .. import models as zoo
+
+            fn, params, in_spec, out_spec = zoo.build(arch, self.custom_props)
+            if os.path.isfile(model_path):  # msgpack flax params
+                from flax import serialization
+
+                with open(model_path, "rb") as f:
+                    params = serialization.from_bytes(params, f.read())
+            elif os.path.isdir(model_path):  # orbax checkpoint
+                import orbax.checkpoint as ocp
+
+                ckptr = ocp.StandardCheckpointer()
+                params = ckptr.restore(os.path.abspath(model_path), params)
+            return fn, params, in_spec, out_spec
+        raise FileNotFoundError(
+            f"jax-xla cannot resolve model {model_path!r} "
+            "(not registered; for files pass custom=arch:<zoo-name>)"
+        )
+
+    def open(self, model_path, props):
+        super().open(model_path, props)
+        import jax
+
+        self._fn, self._params, self._in_spec, self._out_spec = self._resolve_model(
+            model_path
+        )
+        wishes = props.get("accelerators") or ["auto"]
+        if wishes and wishes[0] == "cpu":
+            self._device = jax.devices("cpu")[0]
+        else:
+            self._device = jax.devices()[0]
+        dtype = self.custom_props.get("dtype")
+        if dtype in ("bfloat16", "float16", "float32"):
+            import jax.numpy as jnp
+
+            target = jnp.dtype(dtype)
+            self._params = jax.tree.map(
+                lambda a: a.astype(target)
+                if hasattr(a, "dtype") and np.issubdtype(a.dtype, np.floating)
+                else a,
+                self._params,
+            )
+        if self._params is not None:
+            self._params = jax.device_put(self._params, self._device)
+
+    def close(self):
+        self._jit_cache.clear()
+        self._fn = None
+        self._params = None
+
+    def reload(self, model_path):
+        """Hot reload: build the new params fully, then swap under the lock
+        (≙ double-buffered interpreter reload,
+        tensor_filter_tensorflow_lite.cc:274)."""
+        import jax
+
+        fn, params, in_spec, out_spec = self._resolve_model(model_path)
+        if params is not None:
+            params = jax.device_put(params, self._device)
+        with self._reload_lock:
+            self._fn, self._params = fn, params
+            self._in_spec = in_spec or self._in_spec
+            self._out_spec = out_spec or self._out_spec
+            self._jit_cache.clear()
+            self.model_path = model_path
+
+    # -- model info ---------------------------------------------------------
+    def get_model_info(self):
+        return self._in_spec, self._out_spec
+
+    @staticmethod
+    def _normalize_out(out) -> List[Any]:
+        if isinstance(out, (list, tuple)):
+            return list(out)
+        return [out]
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        import jax
+
+        if not in_spec.is_static:
+            raise ValueError("jax-xla needs a static input schema to trace")
+        dummies = [
+            jax.ShapeDtypeStruct(t.shape, t.dtype) for t in in_spec.tensors
+        ]
+        outs = jax.eval_shape(
+            lambda p, xs: self._normalize_out(self._fn(p, xs)), self._params, dummies
+        )
+        spec = StreamSpec(
+            tuple(TensorSpec(tuple(o.shape), np.dtype(o.dtype)) for o in outs),
+            FORMAT_STATIC,
+            in_spec.framerate,
+        )
+        self._out_spec = spec
+        return spec
+
+    # -- compilation --------------------------------------------------------
+    def _compiled(self, key: Tuple):
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        with self._cache_lock:
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                import jax
+
+                model = self._fn
+
+                def call(params, *xs):
+                    return tuple(self._normalize_out(model(params, list(xs))))
+
+                # donation (custom prop "donate:true"): XLA reuses input HBM
+                # for outputs.  Opt-in because upstream may still hold the
+                # arrays (tee fan-out shares payloads).
+                donate = ()
+                if self.custom_props.get("donate", "").lower() in ("1", "true"):
+                    donate = tuple(range(1, 1 + key[0]))
+                fn = jax.jit(call, donate_argnums=donate)
+                self._jit_cache[key] = fn
+        return fn
+
+    def _put(self, a) -> Any:
+        import jax
+
+        if isinstance(a, jax.Array):
+            return a
+        return jax.device_put(np.asarray(a), self._device)
+
+    # -- execution ----------------------------------------------------------
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        with self._reload_lock:
+            xs = [self._put(a) for a in inputs]
+            key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+            out = self._compiled(key)(self._params, *xs)
+        return list(out)
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        """One XLA call for the whole micro-batch, bucket-padded so each
+        bucket size compiles exactly once."""
+        n = int(inputs[0].shape[0])
+        bucket = _next_pow2(n)
+        with self._reload_lock:
+            xs = []
+            for a in inputs:
+                arr = self._put(a)
+                if bucket != n:
+                    import jax.numpy as jnp
+
+                    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
+                    arr = jnp.pad(arr, pad, mode="edge")
+                xs.append(arr)
+            key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
+            out = self._compiled(key)(self._params, *xs)
+        if bucket != n:
+            out = [o[:n] for o in out]
+        return list(out)
+
+
+register_backend(JaxXla)

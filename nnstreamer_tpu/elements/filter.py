@@ -300,9 +300,12 @@ class TensorFilter(TransformElement):
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, len(frames))
+        # one device->host transfer per output tensor (not per frame), then
+        # zero-copy numpy views per frame
+        out_np = [np.asarray(o) for o in out_b]
         results = []
         for b, f in enumerate(frames):
-            outs = [np.asarray(o)[b] for o in out_b]
+            outs = [o[b] for o in out_np]
             results.append(
                 (0, f.with_tensors(self._compose_outputs(f.tensors, outs)))
             )

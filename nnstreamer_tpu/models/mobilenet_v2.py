@@ -1,0 +1,155 @@
+"""MobileNet-v2 (flax) — the headline classification model.
+
+The reference runs MobileNet-v2 through TFLite
+(``tests/test_models/models/mobilenet_v2_1.0_224_quant.tflite``; BASELINE
+north star: MobileNet-v2 labeling ≥1000 fps/chip).  This is a from-scratch
+flax implementation of the architecture (Sandler et al. 2018), TPU-tuned:
+
+* uint8 frames in; normalization to [-1, 1] happens INSIDE the jitted
+  function so XLA fuses it with the first conv (no host-side preprocess).
+* compute dtype configurable (bfloat16 default on TPU — MXU native).
+* inference uses folded-constant batch stats (BatchNorm in
+  use_running_average mode), so the whole network is one fused XLA program.
+
+Output: 1001 logits (class 0 = background, TFLite-compatible labeling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+
+# (expansion t, channels c, repeats n, stride s) — standard v2 table
+_CFG: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: int = 1
+    groups: int = 1
+    act: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding="SAME",
+            feature_group_count=self.groups,
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        if self.act:
+            x = jnp.minimum(jnp.maximum(x, 0.0), 6.0)  # relu6
+        return x
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    stride: int
+    expand: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        c_in = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = ConvBN(c_in * self.expand, (1, 1), dtype=self.dtype)(h)
+        h = ConvBN(
+            c_in * self.expand if self.expand != 1 else c_in,
+            (3, 3),
+            strides=self.stride,
+            groups=c_in * self.expand if self.expand != 1 else c_in,
+            dtype=self.dtype,
+        )(h)
+        h = ConvBN(self.features, (1, 1), act=False, dtype=self.dtype)(h)
+        if self.stride == 1 and c_in == self.features:
+            h = h + x
+        return h
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1001
+    width_mult: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # fused-in preprocess: uint8 [0,255] -> [-1, 1]
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+        else:
+            x = x.astype(self.dtype)
+        c = _make_divisible(32 * self.width_mult)
+        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype)(x)
+        for t, ch, n, s in _CFG:
+            out_c = _make_divisible(ch * self.width_mult)
+            for i in range(n):
+                x = InvertedResidual(
+                    out_c, s if i == 0 else 1, t, dtype=self.dtype
+                )(x)
+        last = _make_divisible(1280 * max(self.width_mult, 1.0))
+        x = ConvBN(last, (1, 1), dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+def build(custom_props=None):
+    """Zoo entry: returns (fn, params, in_spec, out_spec).
+
+    fn(params, [images_u8 (N,224,224,3)]) -> [logits (N,1001)]
+    """
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[
+        props.get("dtype", "bfloat16")
+    ]
+    size = int(props.get("size", "224"))
+    num_classes = int(props.get("classes", "1001"))
+    width = float(props.get("width", "1.0"))
+    model = MobileNetV2(num_classes=num_classes, width_mult=width, dtype=dtype)
+    rng = jax.random.PRNGKey(int(props.get("seed", "0")))
+    variables = model.init(rng, jnp.zeros((1, size, size, 3), jnp.uint8))
+
+    def fn(params, inputs: List[Any]) -> List[Any]:
+        x = inputs[0]
+        single = x.ndim == 3  # per-frame invoke: add/strip the batch dim
+        if single:
+            x = x[None]
+        out = model.apply(params, x)
+        return [out[0] if single else out]
+
+    # stream-frame schemas (no batch dim; the filter element batches)
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((num_classes,), np.float32, "logits"),), FORMAT_STATIC
+    )
+    return fn, variables, in_spec, out_spec

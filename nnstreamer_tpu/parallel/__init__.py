@@ -1,0 +1,10 @@
+"""Parallelism layer: meshes, shardings, ring attention, collectives.
+
+SURVEY §2.3/§5.7/§5.8: the reference's distribution is among-device stream
+transport (nnstreamer-edge) with no intra-model sharding; the TPU build adds
+mesh-based dp/fsdp/tp/sp parallelism as a first-class subsystem.
+"""
+
+from .mesh import DP, EP, FSDP, PP, SP, TP, default_mesh, make_mesh, mesh_axis_size, single_device_mesh  # noqa: F401
+from .ring_attention import reference_attention, ring_attention  # noqa: F401
+from .sharding import batch_sharding, replicated, shard_params, spec_for_path, transformer_rules  # noqa: F401

@@ -1,0 +1,76 @@
+"""Device-mesh construction helpers.
+
+The reference scales *among devices* with nnstreamer-edge transports
+(SURVEY §2.3); intra-model sharding does not exist there (§2.3 "NOT
+present").  The TPU build's answer is a first-class `jax.sharding.Mesh`
+layer: every parallel subsystem (data/tensor/sequence parallel filters,
+ring attention, the trainer) takes a mesh + axis names.
+
+Axis vocabulary (the scaling-book convention):
+  * ``dp`` — data parallel (batch split; gradient psum)
+  * ``fsdp`` — fully-sharded data parallel (params sharded over dp too)
+  * ``tp`` — tensor parallel (heads / hidden split; activation collectives)
+  * ``sp`` — sequence/context parallel (ring attention over this axis)
+  * ``pp`` — pipeline stages  * ``ep`` — expert parallel
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP, FSDP, TP, SP, PP, EP = "dp", "fsdp", "tp", "sp", "pp", "ep"
+
+
+def make_mesh(
+    axes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh with named axes, e.g. ``make_mesh({"dp": 2, "tp": 4})``.
+
+    Axis sizes must multiply to the device count. ``-1`` for at most one
+    axis means "whatever is left".
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes[wild[0]] = n // fixed
+    if math.prod(sizes.values()) != n:
+        raise ValueError(
+            f"mesh axes {sizes} multiply to {math.prod(sizes.values())}, "
+            f"but {n} devices are available"
+        )
+    arr = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def single_device_mesh(axis: str = DP) -> Mesh:
+    return make_mesh({axis: 1}, devices=jax.devices()[:1])
+
+
+def default_mesh(n: Optional[int] = None) -> Mesh:
+    """A sensible mesh for n devices: prefer dp×tp close to square
+    (dp outermost → gradient psum rides the slower links, tp innermost →
+    activation collectives ride the fastest ICI neighbors)."""
+    devices = jax.devices() if n is None else jax.devices()[:n]
+    n = len(devices)
+    tp = 1
+    for cand in (8, 4, 2, 1):
+        if n % cand == 0 and cand <= n:
+            tp = cand
+            break
+    return make_mesh({DP: n // tp, TP: tp}, devices=devices)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1

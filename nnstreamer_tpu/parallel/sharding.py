@@ -1,0 +1,79 @@
+"""Parameter/activation sharding rules.
+
+The scaling-book recipe: pick a mesh, annotate shardings with
+``NamedSharding(mesh, PartitionSpec(...))``, let XLA's SPMD partitioner
+insert the collectives.  This module holds the annotation helpers: regex
+path -> PartitionSpec rules applied over a params pytree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Transformer parameter rules for (fsdp|dp)×tp meshes.  Convention: shard
+# the contracting/output-feature dim that grows with the model on tp, and
+# (optionally) the other dim on fsdp.
+def transformer_rules(tp_axis: str = "tp", fsdp_axis: Optional[str] = None):
+    f = fsdp_axis
+    return [
+        # anchored so pos_embed/embedding (positions) stays replicated
+        (r"(^|/)embed/embedding$", P(tp_axis, None)),  # vocab sharded
+        (r"(attn|attention).*(query|key|value|qkv).*kernel$", P(f, tp_axis)),
+        (r"(attn|attention).*(out|proj).*kernel$", P(tp_axis, f)),
+        (r"mlp.*(up|fc1|in).*kernel$", P(f, tp_axis)),
+        (r"mlp.*(down|fc2|out).*kernel$", P(tp_axis, f)),
+        (r"lm_head.*kernel$", P(f, tp_axis)),
+        (r".*bias$", P(None)),
+        (r".*(scale|ln|layernorm).*", P(None)),
+    ]
+
+
+def spec_for_path(path: str, rules) -> P:
+    for pat, spec in rules:
+        if re.search(pat, path):
+            return spec
+    return P()  # replicated by default
+
+
+def _keypath_str(kp) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """device_put every param leaf with its rule-derived NamedSharding."""
+    rules = rules if rules is not None else transformer_rules()
+
+    def put(kp, leaf):
+        path = _keypath_str(kp)
+        spec = spec_for_path(path, rules)
+        # drop axes the mesh doesn't have and axes that don't divide evenly
+        cleaned = []
+        for i, ax in enumerate(spec):
+            ok = (
+                ax is not None
+                and ax in mesh.shape
+                and i < leaf.ndim
+                and leaf.shape[i] % mesh.shape[ax] == 0
+            )
+            cleaned.append(ax if ok else None)
+        while cleaned and cleaned[-1] is None:
+            cleaned.pop()
+        return jax.device_put(leaf, NamedSharding(mesh, P(*cleaned)))
+
+    return jax.tree_util.tree_map_with_path(put, params)
+
+
+def batch_sharding(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
+    """NamedSharding for data: e.g. batch_sharding(mesh, 'dp', 'sp') shards
+    dim0 on dp and dim1 on sp (tokens: (batch, seq))."""
+    cleaned = [a if (a is not None and a in mesh.shape) else None for a in axes]
+    return NamedSharding(mesh, P(*cleaned))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -7,12 +7,20 @@ Must run before jax is first imported anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD override: the container pins jax to the real TPU tunnel ("axon") and
+# its sitecustomize force-updates jax.config jax_platforms="axon,cpu" at
+# interpreter start — the env var alone is overridden.  Tests must never
+# claim the chip, so set BOTH the env var and (after import) the config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
