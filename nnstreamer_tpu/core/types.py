@@ -131,6 +131,19 @@ def parse_dims_string(text: str) -> DimsT:
     return tuple(reversed(dims))
 
 
+def ref_dim_to_axis(ref_dim: int, rank: int) -> int:
+    """Convert a reference-dialect dimension index (innermost-first, as in
+    ``parse_dims_string``) to a numpy axis, validating the range.
+
+    The single owner of the ``rank - 1 - dim`` conversion used by every
+    element that takes a reference dim property (merge/split/aggregator/
+    transform)."""
+    axis = rank - 1 - int(ref_dim)
+    if not 0 <= axis < rank:
+        raise ValueError(f"dimension index {ref_dim} out of range for rank {rank}")
+    return axis
+
+
 def dims_to_string(shape: Sequence[Optional[int]]) -> str:
     """Inverse of :func:`parse_dims_string` (innermost-first, reference
     ``gst_tensor_get_dimension_string``)."""

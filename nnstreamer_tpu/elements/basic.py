@@ -71,7 +71,11 @@ class AppSrc(SourceElement):
                 if isinstance(frame_or_arrays, (list, tuple))
                 else [frame_or_arrays]
             )
-            frame = TensorFrame([np.asarray(a) for a in arrays], pts=pts)
+            # keep device arrays (jax.Array) as-is — zero-copy into the stream
+            frame = TensorFrame(
+                [a if hasattr(a, "shape") else np.asarray(a) for a in arrays],
+                pts=pts,
+            )
         if frame.pts is None:
             fr = self.props["framerate"]
             if fr:

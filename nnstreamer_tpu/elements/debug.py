@@ -1,0 +1,56 @@
+"""tensor_debug: in-pipeline inspection probe.
+
+Reference: ``gsttensor_debug.c`` — console output of schema/timestamps per
+frame, passthrough payload.  Output modes: console-info / console-warn /
+off; capability print option.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..pipeline.element import Property, TransformElement, element
+
+
+@element("tensor_debug")
+class TensorDebug(TransformElement):
+    PROPERTIES = {
+        "output-method": Property(str, "console-info", "console-info|console-warn|off"),
+        "capability": Property(bool, True, "print the negotiated schema once"),
+        "summary": Property(bool, True, "print per-tensor min/max/mean"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._caps_printed = False
+        self.seen = 0
+
+    def _emit(self, text: str) -> None:
+        method = self.props["output-method"]
+        if method == "off":
+            return
+        (self.log.warning if method == "console-warn" else self.log.info)(text)
+
+    def transform(self, frame: TensorFrame) -> TensorFrame:
+        self.seen += 1
+        if self.props["output-method"] == "off":
+            return frame  # no summary cost (device arrays stay on device)
+        if self.props["capability"] and not self._caps_printed:
+            spec = self.sink_specs.get(0)
+            self._emit(f"caps: {spec.to_string() if spec else '(unknown)'}")
+            self._caps_printed = True
+        parts = [f"frame seq={frame.seq} pts={frame.pts}"]
+        if self.props["summary"]:
+            for i, t in enumerate(frame.tensors):
+                a = np.asarray(t)
+                if a.size and np.issubdtype(a.dtype, np.number):
+                    parts.append(
+                        f"t{i} {a.dtype}{list(a.shape)} "
+                        f"min={a.min():.4g} max={a.max():.4g} mean={a.mean():.4g}"
+                    )
+                else:
+                    parts.append(f"t{i} {a.dtype}{list(a.shape)}")
+        self._emit(" | ".join(parts))
+        return frame

@@ -1,0 +1,263 @@
+"""Data-dependent flow control: tensor_if, tensor_crop, tensor_rate.
+
+Reference:
+  * ``tensor_if``  — route/modify frames by comparing a derived value
+    against supplied operands (``gsttensor_if.c``; enums
+    ``include/tensor_if.h:42-91``).  Compared-value modes A_VALUE /
+    TENSOR_TOTAL_VALUE / TENSOR_AVERAGE_VALUE / CUSTOM (callback
+    registration ≙ ``tensor_if.h:20-45``), 10 operators, then/else
+    behaviors PASSTHROUGH / SKIP / TENSORPICK.
+  * ``tensor_crop`` — crop a raw tensor stream using a second *info* tensor
+    stream (CollectPads pair, flexible output; ``gsttensor_crop.c:130``).
+  * ``tensor_rate`` — framerate control with drop/duplicate and QoS
+    throttling (``gsttensor_rate.c``).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import registry
+from ..core.buffer import TensorFrame
+from ..core.sync import Collator, SyncPolicy
+from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
+from ..pipeline.element import Element, ElementError, Property, TransformElement, element
+
+# -- tensor_if --------------------------------------------------------------
+
+_OPERATORS: Dict[str, Callable[[float, List[float]], bool]] = {
+    "eq": lambda v, s: v == s[0],
+    "ne": lambda v, s: v != s[0],
+    "gt": lambda v, s: v > s[0],
+    "ge": lambda v, s: v >= s[0],
+    "lt": lambda v, s: v < s[0],
+    "le": lambda v, s: v <= s[0],
+    "range_inclusive": lambda v, s: s[0] <= v <= s[1],
+    "range_exclusive": lambda v, s: s[0] < v < s[1],
+    "not_in_range_inclusive": lambda v, s: not (s[0] <= v <= s[1]),
+    "not_in_range_exclusive": lambda v, s: not (s[0] < v < s[1]),
+}
+
+
+def register_if_custom(name: str, fn: Callable[[TensorFrame], bool]) -> None:
+    """Register a custom tensor_if predicate (≙ nnstreamer_if_custom_register)."""
+    registry.register(registry.KIND_CUSTOM, f"if:{name}", fn)
+
+
+def unregister_if_custom(name: str) -> bool:
+    return registry.unregister(registry.KIND_CUSTOM, f"if:{name}")
+
+
+@element("tensor_if")
+class TensorIf(Element):
+    """Two src pads: 0 = 'then' branch, 1 = 'else' branch (if linked);
+    behaviors modify/route the frame per branch."""
+
+    NUM_SRC_PADS = None  # 1 or 2
+
+    PROPERTIES = {
+        "compared-value": Property(
+            str, "a_value", "a_value|tensor_total_value|tensor_average_value|custom"
+        ),
+        "compared-value-option": Property(
+            str, "", "a_value: '<refdims>,<tensor>'; total/avg: tensor idx; custom: name"
+        ),
+        "supplied-value": Property(str, "", "operand(s), comma separated"),
+        "operator": Property(str, "gt", "|".join(_OPERATORS)),
+        "then": Property(str, "passthrough", "passthrough|skip|tensorpick"),
+        "then-option": Property(str, "", "tensorpick indices"),
+        "else": Property(str, "skip", "passthrough|skip|tensorpick"),
+        "else-option": Property(str, "", "tensorpick indices"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def _compared_value(self, frame: TensorFrame) -> float:
+        mode = self.props["compared-value"].lower()
+        opt = self.props["compared-value-option"]
+        if mode == "custom":
+            fn = registry.get(registry.KIND_CUSTOM, f"if:{opt}")
+            return fn(frame)
+        if mode == "a_value":
+            # "<d0>:<d1>:...,<tensor-idx>" reference dialect (innermost-first)
+            coord_s, _, idx_s = opt.partition(",")
+            ti = int(idx_s or "0")
+            arr = np.asarray(frame.tensors[ti])
+            coords = [int(c) for c in coord_s.split(":")] if coord_s else [0]
+            np_index = tuple(reversed(coords))[-arr.ndim:] if arr.ndim else ()
+            return float(arr[np_index] if np_index else arr)
+        ti = int(opt or "0")
+        arr = np.asarray(frame.tensors[ti], dtype=np.float64)
+        if mode == "tensor_total_value":
+            return float(arr.sum())
+        if mode == "tensor_average_value":
+            return float(arr.mean())
+        raise ElementError(f"{self.name}: unknown compared-value {mode!r}")
+
+    def _decide(self, frame: TensorFrame) -> bool:
+        op = self.props["operator"].lower()
+        if op not in _OPERATORS:
+            raise ElementError(f"{self.name}: unknown operator {op!r}")
+        supplied = [
+            float(s) for s in str(self.props["supplied-value"]).split(",") if s != ""
+        ]
+        if not supplied:
+            raise ElementError(f"{self.name}: supplied-value required")
+        return _OPERATORS[op](self._compared_value(frame), supplied)
+
+    def _behave(self, frame: TensorFrame, which: str):
+        action = self.props[which].lower()
+        if action == "passthrough":
+            return frame
+        if action == "skip":
+            return None
+        if action == "tensorpick":
+            idxs = [
+                int(s) for s in self.props[f"{which}-option"].split(",") if s != ""
+            ]
+            return frame.pick(idxs)
+        raise ElementError(f"{self.name}: unknown behavior {action!r}")
+
+    def handle_frame(self, pad, frame):
+        cond = self._decide(frame)
+        which = "then" if cond else "else"
+        out = self._behave(frame, which)
+        if out is None:
+            return []
+        out.meta["tensor_if"] = which
+        src = 0 if cond else (1 if len(self.srcpads) > 1 and self.srcpads[1].is_linked else 0)
+        return [(src, out)]
+
+
+# -- tensor_crop ------------------------------------------------------------
+
+
+@element("tensor_crop")
+class TensorCrop(Element):
+    """sink 0 = raw tensors, sink 1 = crop info [[x, y, w, h], ...];
+    output: flexible stream, one cropped tensor per region."""
+
+    NUM_SINK_PADS = None  # exactly 2 used
+
+    PROPERTIES = {
+        "lateness": Property(int, -1, "reference parity (unused)"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._collator: Optional[Collator] = None
+
+    def start(self):
+        self._collator = Collator(2, SyncPolicy.from_string("nosync"))
+
+    def derive_spec(self, pad=0):
+        return StreamSpec((), FORMAT_FLEXIBLE, None)  # per-buffer shapes vary
+
+    def _crop(self, raw_f: TensorFrame, info_f: TensorFrame):
+        img = np.asarray(raw_f.tensors[0])
+        regions = np.asarray(info_f.tensors[0]).reshape(-1, 4).astype(np.int64)
+        crops = []
+        H, W = img.shape[0], img.shape[1]
+        for x, y, w, h in regions:
+            x0, y0 = max(0, int(x)), max(0, int(y))
+            x1, y1 = min(W, x0 + int(w)), min(H, y0 + int(h))
+            if x1 <= x0 or y1 <= y0:
+                continue
+            crops.append(img[y0:y1, x0:x1])
+        out = raw_f.with_tensors(crops if crops else [img[0:0, 0:0]])
+        out.meta["crop_regions"] = regions.tolist()
+        return out
+
+    def _drain(self):
+        out = []
+        while (group := self._collator.collect()) is not None:
+            out.append((0, self._crop(group[0], group[1])))
+        return out
+
+    def handle_frame(self, pad, frame):
+        self._collator.push(pad, frame)
+        return self._drain()
+
+    def handle_eos(self, pad):
+        self._collator.mark_eos(pad)
+        return self._drain()
+
+
+# -- tensor_rate ------------------------------------------------------------
+
+
+@element("tensor_rate")
+class TensorRate(TransformElement):
+    """Adjust frame rate by dropping/duplicating against pts.
+
+    Reference props (``gsttensor_rate.c:81-88``): framerate "n/d",
+    throttle (drop without duplicating), silent.
+    """
+
+    PROPERTIES = {
+        "framerate": Property(str, "", "target 'n/d'"),
+        "throttle": Property(bool, True, "drop-only (no duplication)"),
+        "silent": Property(bool, True, ""),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._next_ts: Optional[float] = None
+        self._last: Optional[TensorFrame] = None
+        self.dropped = 0
+        self.duplicated = 0
+
+    def _period(self) -> Optional[float]:
+        fr = self.props["framerate"]
+        if not fr:
+            return None
+        n, _, d = fr.partition("/")
+        return float(Fraction(int(d or 1), int(n)))
+
+    def derive_spec(self, pad=0):
+        in_spec = self.sink_specs.get(0, ANY)
+        period = self._period()
+        if period is None or not in_spec.tensors:
+            return in_spec
+        return StreamSpec(
+            in_spec.tensors, in_spec.fmt, Fraction(1) / Fraction(period).limit_denominator(10**6)
+        )
+
+    def transform(self, frame):
+        period = self._period()
+        if period is None or frame.pts is None:
+            return frame
+        if self._next_ts is None:
+            self._next_ts = frame.pts
+        outs = []
+        # duplicate to fill gaps (unless throttle)
+        if not self.props["throttle"] and self._last is not None:
+            while frame.pts - self._next_ts >= period:
+                dup = self._last.with_tensors(list(self._last.tensors))
+                dup.pts = self._next_ts
+                outs.append(dup)
+                self.duplicated += 1
+                self._next_ts += period
+        if frame.pts >= self._next_ts:
+            f = frame.with_tensors(list(frame.tensors))
+            f.pts = self._next_ts
+            self._next_ts += period
+            self._last = frame
+            outs.append(f)
+        else:
+            self.dropped += 1
+        if not outs:
+            return None
+        return outs[0] if len(outs) == 1 else outs
+
+    def handle_frame(self, pad, frame):
+        out = self.transform(frame)
+        if out is None:
+            return []
+        if isinstance(out, list):
+            return [(0, f) for f in out]
+        return [(0, out)]

@@ -1,0 +1,70 @@
+"""tensor_sparse_enc / tensor_sparse_dec: static <-> sparse stream format.
+
+Reference: ``gsttensor_sparseenc.c`` / ``gsttensor_sparsedec.c`` with the
+payload layout of ``gsttensor_sparseutil.c:27-153`` (values + linear
+indices + original spec).  Payloads here carry (values, indices) tensor
+pairs per original tensor, with the dense spec in the flexible-stream meta.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import (
+    ANY,
+    FORMAT_FLEXIBLE,
+    FORMAT_STATIC,
+    StreamSpec,
+    TensorSpec,
+    sparse_decode,
+    sparse_encode,
+)
+from ..pipeline.element import ElementError, Property, TransformElement, element
+
+
+@element("tensor_sparse_enc")
+class TensorSparseEnc(TransformElement):
+    PROPERTIES = {"max-buffers": Property(int, 0, "mailbox depth override")}
+
+    def derive_spec(self, pad=0):
+        return StreamSpec((), FORMAT_FLEXIBLE, self.sink_specs.get(0, ANY).framerate)
+
+    def transform(self, frame):
+        tensors = []
+        specs = []
+        for t in frame.tensors:
+            values, indices, spec = sparse_encode(np.asarray(t))
+            tensors.extend([values, indices])
+            specs.append(spec.to_string())
+        out = frame.with_tensors(tensors)
+        out.meta["sparse_specs"] = specs
+        return out
+
+
+@element("tensor_sparse_dec")
+class TensorSparseDec(TransformElement):
+    PROPERTIES = {"max-buffers": Property(int, 0, "mailbox depth override")}
+
+    def derive_spec(self, pad=0):
+        return ANY  # concrete shape restored per-buffer from meta
+
+    def transform(self, frame):
+        specs = frame.meta.get("sparse_specs")
+        if specs is None:
+            raise ElementError(f"{self.name}: frame lacks sparse_specs meta")
+        if len(frame.tensors) != 2 * len(specs):
+            raise ElementError(
+                f"{self.name}: expected {2 * len(specs)} payload tensors, "
+                f"got {len(frame.tensors)}"
+            )
+        tensors = []
+        for i, spec_s in enumerate(specs):
+            spec = TensorSpec.from_string(spec_s)
+            values, indices = frame.tensors[2 * i], frame.tensors[2 * i + 1]
+            tensors.append(sparse_decode(np.asarray(values), np.asarray(indices), spec))
+        out = frame.with_tensors(tensors)
+        out.meta.pop("sparse_specs", None)
+        return out

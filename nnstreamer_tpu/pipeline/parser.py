@@ -52,10 +52,23 @@ def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
 
     pipe = Pipeline(name)
     named: Dict[str, Element] = {}
+    deferred: List[tuple] = []  # (src_element, target_name) forward links
     current: Optional[Element] = None
     pending_src: Optional[Element] = None
     link_requested = False
     caps_n = 0
+
+    branch_counts: Dict[int, int] = {}  # id(element) -> src pads handed out
+
+    def link_from(src: Element, dst: Element) -> None:
+        # dynamic-src elements (tee/demux/split/if) get a fresh src pad per
+        # textual branch ("t. ! ..." twice = pads 0 and 1)
+        if src.NUM_SRC_PADS is None:
+            idx = branch_counts.get(id(src), 0)
+            branch_counts[id(src)] = idx + 1
+            src.link(dst, src_pad=idx)
+        else:
+            src.link(dst)
 
     def new_node(el: Element) -> None:
         nonlocal current, pending_src, link_requested
@@ -63,7 +76,7 @@ def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
         if link_requested:
             if pending_src is None:
                 raise ParseError("dangling '!' with no upstream element")
-            pending_src.link(el)
+            link_from(pending_src, el)
         pending_src = None
         link_requested = False
         current = el
@@ -77,16 +90,24 @@ def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
             continue
         if tok.endswith(".") and len(tok) > 1:
             ref = tok[:-1]
-            if ref not in named:
-                raise ParseError(f"reference to unknown element {ref!r}")
             if link_requested:
-                # "a ! m."  — link current chain INTO the named element
-                pending_src.link(named[ref])
+                # "a ! m." — link current chain INTO the named element; the
+                # name may be defined later in the text (forward reference,
+                # gst-launch allows it), so defer resolution.  The src pad is
+                # claimed NOW so dynamic-src branch order follows the text,
+                # not the resolution order.
+                src_pad = None
+                if pending_src.NUM_SRC_PADS is None:
+                    src_pad = branch_counts.get(id(pending_src), 0)
+                    branch_counts[id(pending_src)] = src_pad + 1
+                deferred.append((pending_src, src_pad, ref))
                 pending_src = None
                 link_requested = False
                 current = None
             else:
                 # "t. ! a" — start a new chain FROM the named element
+                if ref not in named:
+                    raise ParseError(f"reference to unknown element {ref!r}")
                 current = named[ref]
             continue
         if _is_caps(tok):
@@ -124,6 +145,13 @@ def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
 
     if link_requested:
         raise ParseError("pipeline text ends with dangling '!'")
+    for src_el, src_pad, ref in deferred:
+        if ref not in named:
+            raise ParseError(f"reference to unknown element {ref!r}")
+        if src_pad is not None:
+            src_el.link(named[ref], src_pad=src_pad)
+        else:
+            link_from(src_el, named[ref])
     return pipe
 
 

@@ -1,0 +1,386 @@
+"""Flow/composition element tests: transform, mux/demux, merge/split,
+aggregator, if, crop, rate, repo, sparse, debug.
+
+Modeled on the reference SSAT suites (tests/nnstreamer_converter, _mux,
+_demux, _if, _rate, _repo, ...) as in-process pipelines with appsrc.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.types import StreamSpec, TensorSpec, FORMAT_STATIC
+from nnstreamer_tpu.elements.flow import register_if_custom, unregister_if_custom
+from nnstreamer_tpu.elements.repo import reset_repo
+from nnstreamer_tpu.pipeline import ElementError, parse_pipeline
+
+
+def run_appsrc(text, frames, timeout=15, src="src", sink="out"):
+    pipe = parse_pipeline(text)
+    pipe.start()
+    for f in frames:
+        pipe[src].push(f)
+    pipe[src].end_of_stream()
+    pipe.wait(timeout=timeout)
+    pipe.stop()
+    return pipe
+
+
+class TestTransform:
+    def test_typecast(self):
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=typecast option=float32 ! tensor_sink name=out",
+            [np.array([1, 2], np.uint8)],
+        )
+        assert pipe["out"].frames[0].tensors[0].dtype == np.float32
+
+    def test_arithmetic_chain(self):
+        # the canonical MobileNet preprocess: cast + scale to [-1, 1]
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=arithmetic "
+            "option=typecast:float32,add:-127.5,div:127.5 ! tensor_sink name=out",
+            [np.array([0, 127.5, 255], np.float32)],
+        )
+        np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], [-1, 0, 1])
+
+    def test_arithmetic_per_channel(self):
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=arithmetic option=add:1|10|100 "
+            "! tensor_sink name=out",
+            [np.zeros((2, 3), np.float32)],
+        )
+        np.testing.assert_allclose(
+            pipe["out"].frames[0].tensors[0], [[1, 10, 100], [1, 10, 100]]
+        )
+
+    def test_transpose_reference_dialect(self):
+        # ref "1:0:2:3" swaps the two innermost dims = numpy last two axes
+        arr = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=transpose option=1:0:2:3 ! "
+            "tensor_sink name=out",
+            [arr],
+        )
+        np.testing.assert_array_equal(
+            pipe["out"].frames[0].tensors[0], arr.transpose(0, 1, 3, 2)
+        )
+
+    def test_dimchg(self):
+        # ref "0:2": move innermost dim to position 2 — NHWC -> NCHW-ish
+        arr = np.zeros((2, 4, 5, 3), np.float32)
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=dimchg option=0:2 ! tensor_sink name=out",
+            [arr],
+        )
+        assert pipe["out"].frames[0].tensors[0].shape == (2, 3, 4, 5)
+
+    def test_stand(self):
+        arr = np.array([1, 2, 3, 4], np.float32)
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=stand option=default ! tensor_sink name=out",
+            [arr],
+        )
+        out = pipe["out"].frames[0].tensors[0]
+        assert abs(out.mean()) < 1e-5 and abs(out.std() - 1) < 1e-3
+
+    def test_clamp(self):
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_transform mode=clamp option=0:1 ! tensor_sink name=out",
+            [np.array([-5, 0.5, 7], np.float32)],
+        )
+        np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], [0, 0.5, 1])
+
+    def test_bad_mode_n(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_transform mode=nope ! tensor_sink name=out"
+        )
+        with pytest.raises(ElementError, match="unknown transform mode"):
+            pipe.start()
+        pipe.stop()
+
+    def test_device_arrays_stay_on_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_transform mode=arithmetic option=mul:2 ! "
+            "tensor_sink name=out to-host=false"
+        )
+        pipe.start()
+        pipe["src"].push(jnp.ones((4,), jnp.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        assert isinstance(pipe["out"].frames[0].tensors[0], jax.Array)
+
+
+class TestMuxDemux:
+    def test_mux_combines(self):
+        pipe = parse_pipeline(
+            "appsrc name=a ! mux.  appsrc name=b ! mux.  "
+            "tensor_mux name=mux ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["a"].push(np.int32([1]), pts=0.0)
+        pipe["b"].push(np.int32([2]), pts=0.0)
+        pipe["a"].end_of_stream()
+        pipe["b"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        f = pipe["out"].frames[0]
+        assert [int(t[0]) for t in f.tensors] == [1, 2]
+
+    def test_demux_tensorpick(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_demux name=d tensorpick=1,0 "
+            "d. ! tensor_sink name=o1  d. ! tensor_sink name=o2"
+        )
+        pipe.start()
+        pipe["src"].push([np.int32([10]), np.int32([20])])
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert int(pipe["o1"].frames[0].tensors[0][0]) == 20  # pick 1 first
+        assert int(pipe["o2"].frames[0].tensors[0][0]) == 10
+
+    def test_merge_concat_dim(self):
+        pipe = parse_pipeline(
+            "appsrc name=a ! m.  appsrc name=b ! m.  "
+            "tensor_merge name=m mode=linear option=0 ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["a"].push(np.ones((2, 3), np.float32))
+        pipe["b"].push(np.zeros((2, 2), np.float32))
+        pipe["a"].end_of_stream()
+        pipe["b"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        # ref dim 0 = numpy last axis: (2,3)+(2,2) -> (2,5)
+        assert pipe["out"].frames[0].tensors[0].shape == (2, 5)
+
+    def test_split_sizes(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_split name=s tensorseg=3,2 option=0 "
+            "s. ! tensor_sink name=o1  s. ! tensor_sink name=o2"
+        )
+        pipe.start()
+        pipe["src"].push(np.arange(5, dtype=np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        np.testing.assert_array_equal(pipe["o1"].frames[0].tensors[0], [0, 1, 2])
+        np.testing.assert_array_equal(pipe["o2"].frames[0].tensors[0], [3, 4])
+
+    def test_mux_slowest_sync(self):
+        pipe = parse_pipeline(
+            "appsrc name=a ! mux.  appsrc name=b ! mux.  "
+            "tensor_mux name=mux sync-mode=slowest ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i, pts in enumerate([0.0, 0.1, 0.2]):
+            pipe["a"].push(np.int32([i]), pts=pts)
+        pipe["b"].push(np.int32([100]), pts=0.2)
+        pipe["a"].end_of_stream()
+        pipe["b"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        f = pipe["out"].frames[0]
+        assert int(f.tensors[0][0]) == 2  # fast pad dropped to base 0.2
+
+
+class TestAggregator:
+    def test_concat_frames(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_aggregator frames-out=2 frames-dim=2 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(4):
+            pipe["src"].push(np.full((1, 4, 4), i, np.float32))  # ref dim2 = np axis0
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        frames = pipe["out"].frames
+        assert len(frames) == 2
+        assert frames[0].tensors[0].shape == (2, 4, 4)
+        assert frames[0].tensors[0][0, 0, 0] == 0 and frames[0].tensors[0][1, 0, 0] == 1
+
+    def test_overlapping_window(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_aggregator frames-out=2 frames-flush=1 "
+            "frames-dim=1 ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(3):
+            pipe["src"].push(np.full((1, 2), i, np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        # windows: [0,1], [1,2] (stride 1)
+        assert len(pipe["out"].frames) == 2
+        np.testing.assert_array_equal(
+            pipe["out"].frames[1].tensors[0], [[1, 1], [2, 2]]
+        )
+
+
+class TestTensorIf:
+    def test_average_gt_routes(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_if compared-value=tensor_average_value "
+            "compared-value-option=0 supplied-value=0.5 operator=gt "
+            "then=passthrough else=skip ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.float32([0.9, 0.9]))  # avg .9 > .5 -> pass
+        pipe["src"].push(np.float32([0.1, 0.1]))  # avg .1 -> skip
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(pipe["out"].frames) == 1
+        assert pipe["out"].frames[0].meta["tensor_if"] == "then"
+
+    def test_then_else_branches(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_if name=i compared-value=a_value "
+            "compared-value-option=0,0 supplied-value=5 operator=ge "
+            "then=passthrough else=passthrough "
+            "i. ! tensor_sink name=t  i. ! tensor_sink name=e"
+        )
+        pipe.start()
+        pipe["src"].push(np.float32([7]))
+        pipe["src"].push(np.float32([1]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(pipe["t"].frames) == 1 and len(pipe["e"].frames) == 1
+        assert float(pipe["t"].frames[0].tensors[0][0]) == 7
+
+    def test_custom_predicate(self):
+        register_if_custom("always_no", lambda f: 0.0)
+        try:
+            pipe = parse_pipeline(
+                "appsrc name=src ! tensor_if compared-value=custom "
+                "compared-value-option=always_no supplied-value=0.5 operator=gt "
+                "then=passthrough else=skip ! tensor_sink name=out"
+            )
+            pipe.start()
+            pipe["src"].push(np.float32([1.0]))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=15)
+            pipe.stop()
+            assert len(pipe["out"].frames) == 0
+        finally:
+            unregister_if_custom("always_no")
+
+    def test_tensorpick_behavior(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_if compared-value=tensor_average_value "
+            "compared-value-option=0 supplied-value=0 operator=ge "
+            "then=tensorpick then-option=1 else=skip ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push([np.float32([1]), np.float32([42])])
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        f = pipe["out"].frames[0]
+        assert len(f.tensors) == 1 and float(f.tensors[0][0]) == 42
+
+
+class TestCrop:
+    def test_crop_regions(self):
+        pipe = parse_pipeline(
+            "appsrc name=raw ! c.  appsrc name=info ! c.  "
+            "tensor_crop name=c ! tensor_sink name=out"
+        )
+        pipe.start()
+        img = np.arange(64, dtype=np.uint8).reshape(8, 8)
+        pipe["raw"].push(img)
+        pipe["info"].push(np.int32([[1, 2, 3, 4], [0, 0, 2, 2]]))  # x,y,w,h
+        pipe["raw"].end_of_stream()
+        pipe["info"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        f = pipe["out"].frames[0]
+        assert len(f.tensors) == 2
+        np.testing.assert_array_equal(f.tensors[0], img[2:6, 1:4])
+        np.testing.assert_array_equal(f.tensors[1], img[0:2, 0:2])
+
+
+class TestRate:
+    def test_downsample_drops(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_rate framerate=10/1 throttle=true ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(30):  # 30 fps input, 1 second
+            pipe["src"].push(np.int32([i]), pts=i / 30)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        n = len(pipe["out"].frames)
+        assert 9 <= n <= 11  # ~10 fps out
+
+    def test_upsample_duplicates(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_rate framerate=20/1 throttle=false ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(10):  # 10 fps input, 1 second
+            pipe["src"].push(np.int32([i]), pts=i / 10)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(pipe["out"].frames) >= 18  # ~20 fps out
+
+
+class TestRepo:
+    def test_loop_roundtrip(self):
+        reset_repo()
+        # writer pipeline -> slot 7 -> reader pipeline
+        w = parse_pipeline("appsrc name=src ! tensor_reposink slot-index=7")
+        r = parse_pipeline("tensor_reposrc slot-index=7 ! tensor_sink name=out")
+        w.start()
+        r.start()
+        for i in range(3):
+            w["src"].push(np.int32([i]))
+        w["src"].end_of_stream()
+        w.wait(timeout=10)
+        r.wait(timeout=10)
+        w.stop()
+        r.stop()
+        assert [int(f.tensors[0][0]) for f in r["out"].frames] == [0, 1, 2]
+
+
+class TestSparse:
+    def test_enc_dec_roundtrip(self):
+        dense = np.zeros((4, 4), np.float32)
+        dense[1, 2] = 5.0
+        dense[3, 3] = -1.0
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_sparse_enc ! tensor_sparse_dec ! tensor_sink name=out",
+            [dense],
+        )
+        np.testing.assert_array_equal(pipe["out"].frames[0].tensors[0], dense)
+
+    def test_dec_without_meta_n(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_sparse_dec ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.float32([1]))
+        pipe["src"].end_of_stream()
+        with pytest.raises(ElementError):
+            pipe.wait(timeout=10)
+        pipe.stop()
+
+
+class TestDebug:
+    def test_passthrough_and_counts(self):
+        pipe = run_appsrc(
+            "appsrc name=src ! tensor_debug name=d output-method=off ! tensor_sink name=out",
+            [np.float32([1]), np.float32([2])],
+        )
+        assert len(pipe["out"].frames) == 2
+        assert pipe["d"].seen == 2
