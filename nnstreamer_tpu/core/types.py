@@ -427,7 +427,7 @@ def unpack_flex_header(buf: bytes) -> Tuple[TensorSpec, int]:
         off = _FLEX_FIXED.size
         dims = struct.unpack_from(f"<{rank}i", buf, off) if rank else ()
         off += 4 * rank
-        name = buf[off : off + nlen]
+        name = bytes(buf[off : off + nlen])  # bytes() so memoryviews work
         if len(name) != nlen:
             raise ValueError("truncated flexible-tensor header: dtype name")
         dtype = dtype_from_name(name.decode())

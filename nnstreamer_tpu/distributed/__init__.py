@@ -1,0 +1,18 @@
+"""Distributed layer (L4): wire framing, gRPC query/edge services.
+
+Reference analog: tensor_query_*, edgesrc/edgesink, gst/mqtt, grpc elements
+(SURVEY §2.3) over nnstreamer-edge; here one gRPC data plane.
+"""
+
+from .wire import WireError, decode_frame, encode_frame  # noqa: F401
+from .service import (  # noqa: F401
+    EdgeBroker,
+    EdgePublisher,
+    EdgeSubscriber,
+    QueryConnection,
+    QueryServerCore,
+    get_edge_broker,
+    get_query_server,
+    release_edge_broker,
+    release_query_server,
+)

@@ -1,0 +1,355 @@
+"""gRPC transport: query (request/response) and edge (pub/sub) services.
+
+Reference architecture (SURVEY §2.3, §3.5): the query elements delegate
+transport to nnstreamer-edge (TCP/MQTT/AITT) with a caps handshake before
+data and ``client_id`` routing back to the right client
+(``tensor_query_client.c:487-542``, ``tensor_query_serversink.c:237-274``);
+a process-global registry pairs serversrc/serversink by id
+(``tensor_query_server.c:24-100``).  The grpc elements
+(``ext/nnstreamer/tensor_source/tensor_src_grpc.c``) speak protobuf IDL.
+
+TPU build: one gRPC data plane for both roles, using generic method
+handlers (no codegen) over the :mod:`.wire` framing:
+
+  /nns.Query/Handshake  unary   — client caps string -> server caps string
+  /nns.Query/Invoke     unary   — frame bytes -> answer frame bytes
+  /nns.Edge/Publish     unary   — push a frame to a topic (broker mode)
+  /nns.Edge/Subscribe   stream  — topic -> stream of frame bytes
+
+The unary Invoke carries the client routing implicitly (the RPC context IS
+the return path), which collapses the reference's client_id bookkeeping;
+client_id meta is still attached for in-pipeline visibility and parity.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from concurrent import futures
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from ..core.buffer import TensorFrame
+from ..core.log import get_logger
+from ..core.types import StreamSpec
+from .wire import decode_frame, encode_frame
+
+log = get_logger("distributed")
+
+_ident = lambda b: b  # bytes-in/bytes-out (de)serializers  # noqa: E731
+
+
+# ---------------------------------------------------------------------------
+# Server-side pairing registry (≙ tensor_query_server.c global table)
+# ---------------------------------------------------------------------------
+class QueryServerCore:
+    """The in-process core pairing a serversrc (ingress) with a serversink
+    (egress) and owning the gRPC server."""
+
+    def __init__(self, port: int, host: str = "[::]"):
+        self.port = port
+        self.host = host
+        self.ingress: "queue.Queue[Tuple[int, TensorFrame]]" = queue.Queue(64)
+        self._pending: Dict[int, "queue.Queue[TensorFrame]"] = {}
+        self._pending_lock = threading.Lock()
+        self._client_seq = itertools.count(1)
+        self.caps: Optional[str] = None  # serversrc announces
+        self._server: Optional[grpc.Server] = None
+        self.refs = 0
+
+    # -- rpc handlers -------------------------------------------------------
+    def _handshake(self, request: bytes, context) -> bytes:
+        client_caps = request.decode()
+        server_caps = self.caps or ""
+        if server_caps and client_caps:
+            try:
+                a = StreamSpec.from_string(client_caps)
+                b = StreamSpec.from_string(server_caps)
+                if a.intersect(b) is None:
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        f"caps mismatch: client {client_caps} vs server {server_caps}",
+                    )
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return server_caps.encode()
+
+    def _invoke(self, request: bytes, context) -> bytes:
+        frame = decode_frame(request)
+        client_id = next(self._client_seq)
+        frame.meta["client_id"] = client_id
+        answer_q: "queue.Queue[TensorFrame]" = queue.Queue(1)
+        with self._pending_lock:
+            self._pending[client_id] = answer_q
+        try:
+            self.ingress.put((client_id, frame), timeout=10)
+            timeout = float(context.time_remaining() or 30.0)
+            try:
+                answer = answer_q.get(timeout=min(timeout, 300.0))
+            except queue.Empty:
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    "server pipeline produced no answer in time",
+                )
+            return encode_frame(answer)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(client_id, None)
+
+    def resolve(self, client_id: int, frame: TensorFrame) -> bool:
+        """serversink delivers an answer to the waiting client RPC."""
+        with self._pending_lock:
+            q = self._pending.get(client_id)
+        if q is None:
+            log.warning("no pending client %s (answer dropped)", client_id)
+            return False
+        q.put(frame)
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        handlers = {
+            "Handshake": grpc.unary_unary_rpc_method_handler(
+                self._handshake, request_deserializer=_ident, response_serializer=_ident
+            ),
+            "Invoke": grpc.unary_unary_rpc_method_handler(
+                self._invoke, request_deserializer=_ident, response_serializer=_ident
+            ),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=32),
+            options=[("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 512 * 1024 * 1024)],
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("nns.Query", handlers),)
+        )
+        bound = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if bound == 0:
+            raise RuntimeError(f"cannot bind query server on port {self.port}")
+        self.port = bound
+        self._server.start()
+        log.info("query server on :%d", self.port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+
+
+_servers_lock = threading.Lock()
+_servers: Dict[int, QueryServerCore] = {}
+
+
+def get_query_server(server_id: int, port: int = 0) -> QueryServerCore:
+    """Process-global serversrc/serversink pairing by id."""
+    with _servers_lock:
+        core = _servers.get(server_id)
+        if core is None:
+            core = QueryServerCore(port)
+            _servers[server_id] = core
+        elif port and core._server is None and core.port == 0:
+            # the paired serversink may have created the core first (element
+            # start order is textual); honor the serversrc's configured port
+            core.port = port
+        core.refs += 1
+        return core
+
+
+def release_query_server(server_id: int) -> None:
+    with _servers_lock:
+        core = _servers.get(server_id)
+        if core is None:
+            return
+        core.refs -= 1
+        if core.refs <= 0:
+            core.stop()
+            del _servers[server_id]
+
+
+# ---------------------------------------------------------------------------
+# Query client
+# ---------------------------------------------------------------------------
+class QueryConnection:
+    """Client side of /nns.Query (≙ nns_edge client handle)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.addr = f"{host}:{port}"
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            self.addr,
+            options=[("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 512 * 1024 * 1024)],
+        )
+        self._invoke = self._channel.unary_unary(
+            "/nns.Query/Invoke", request_serializer=_ident, response_deserializer=_ident
+        )
+        self._handshake = self._channel.unary_unary(
+            "/nns.Query/Handshake", request_serializer=_ident, response_deserializer=_ident
+        )
+
+    def handshake(self, caps: str) -> str:
+        return self._handshake(caps.encode(), timeout=self.timeout).decode()
+
+    def invoke(self, frame: TensorFrame, timeout: Optional[float] = None) -> TensorFrame:
+        data = self._invoke(
+            encode_frame(frame), timeout=timeout or self.timeout
+        )
+        return decode_frame(data)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+# ---------------------------------------------------------------------------
+# Edge pub/sub broker (≙ nnstreamer-edge pub/sub + MQTT broker role)
+# ---------------------------------------------------------------------------
+class EdgeBroker:
+    """In-process topic broker served over gRPC: publishers push frames,
+    subscribers hold a server-streaming RPC per topic."""
+
+    def __init__(self, port: int, host: str = "[::]"):
+        self.port = port
+        self.host = host
+        self._subs: Dict[str, List[queue.Queue]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[grpc.Server] = None
+        self.refs = 0
+
+    def publish_local(self, topic: str, data: bytes) -> int:
+        with self._lock:
+            subs = list(self._subs.get(topic, ()))
+        for q in subs:
+            try:
+                q.put_nowait(data)
+            except queue.Full:
+                pass  # slow subscriber drops (pub/sub semantics)
+        return len(subs)
+
+    def _publish(self, request: bytes, context) -> bytes:
+        topic_len = request[0]
+        topic = request[1 : 1 + topic_len].decode()
+        self.publish_local(topic, request[1 + topic_len :])
+        return b""
+
+    def _subscribe(self, request: bytes, context):
+        topic = request.decode()
+        q: "queue.Queue[bytes]" = queue.Queue(64)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(q)
+        try:
+            while context.is_active():
+                try:
+                    yield q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                if q in self._subs.get(topic, ()):
+                    self._subs[topic].remove(q)
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        handlers = {
+            "Publish": grpc.unary_unary_rpc_method_handler(
+                self._publish, request_deserializer=_ident, response_serializer=_ident
+            ),
+            "Subscribe": grpc.unary_stream_rpc_method_handler(
+                self._subscribe, request_deserializer=_ident, response_serializer=_ident
+            ),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("nns.Edge", handlers),)
+        )
+        bound = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if bound == 0:
+            raise RuntimeError(f"cannot bind edge broker on port {self.port}")
+        self.port = bound
+        # ephemeral binds (port=0) enter the registry only now, under the
+        # real port, so release-by-bound-port always finds them
+        with _brokers_lock:
+            _brokers.setdefault(self.port, self)
+        self._server.start()
+        log.info("edge broker on :%d", self.port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+
+
+_brokers_lock = threading.Lock()
+_brokers: Dict[int, EdgeBroker] = {}
+
+
+def get_edge_broker(port: int) -> EdgeBroker:
+    with _brokers_lock:
+        broker = _brokers.get(port) if port else None
+        if broker is None:
+            broker = EdgeBroker(port)
+            if port:
+                _brokers[port] = broker
+        broker.refs += 1
+        return broker
+
+
+def release_edge_broker(port: int) -> None:
+    with _brokers_lock:
+        broker = _brokers.get(port)
+        if broker is None:
+            return
+        broker.refs -= 1
+        if broker.refs <= 0:
+            broker.stop()
+            del _brokers[port]
+
+
+class EdgePublisher:
+    """Client publishing frames to a (possibly remote) broker."""
+
+    def __init__(self, host: str, port: int, topic: str):
+        self.topic = topic.encode()
+        if len(self.topic) > 255:
+            raise ValueError(
+                f"edge topic exceeds 255 bytes ({len(self.topic)}): {topic[:40]!r}…"
+            )
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._publish = self._channel.unary_unary(
+            "/nns.Edge/Publish", request_serializer=_ident, response_deserializer=_ident
+        )
+
+    def publish(self, frame: TensorFrame) -> None:
+        payload = bytes([len(self.topic)]) + self.topic + encode_frame(frame)
+        self._publish(payload, timeout=10.0)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class EdgeSubscriber:
+    """Client holding a Subscribe stream; yields TensorFrames."""
+
+    def __init__(self, host: str, port: int, topic: str):
+        self.topic = topic
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._subscribe = self._channel.unary_stream(
+            "/nns.Edge/Subscribe", request_serializer=_ident, response_deserializer=_ident
+        )
+        self._stream = None
+
+    def frames(self):
+        self._stream = self._subscribe(self.topic.encode())
+        for data in self._stream:
+            yield decode_frame(data)
+
+    def close(self) -> None:
+        if self._stream is not None:
+            self._stream.cancel()
+        self._channel.close()

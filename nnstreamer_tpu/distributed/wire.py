@@ -1,0 +1,103 @@
+"""Wire serialization for tensor frames.
+
+The reference ships ``other/tensors`` over the wire via protobuf/flatbuf
+IDLs (``ext/nnstreamer/include/nnstreamer.proto``/``.fbs``) or
+nnstreamer-edge's custom TCP framing.  This is the TPU build's framing: a
+compact self-describing binary layout reusing the flexible-tensor header
+from the core type system (one schema for in-process flexible streams AND
+the wire — the reference keeps two).
+
+Layout (little-endian):
+  u32 magic 'NNSQ' | u16 version | u64 seq | f64 pts (NaN = none) |
+  u32 meta_len | meta JSON | u16 ntensors |
+  per tensor: flex header | u64 payload_len | raw bytes
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import TensorSpec, pack_flex_header, unpack_flex_header
+
+_MAGIC = 0x4E4E5351  # 'NNSQ'
+_VERSION = 1
+_HEAD = struct.Struct("<IHQdI")
+_NT = struct.Struct("<H")
+_PLEN = struct.Struct("<Q")
+
+
+class WireError(ValueError):
+    pass
+
+
+def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in meta.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            continue  # non-serializable entries stay process-local
+    return out
+
+
+def encode_frame(frame: TensorFrame) -> bytes:
+    meta = json.dumps(_clean_meta(frame.meta)).encode()
+    pts = frame.pts if frame.pts is not None else math.nan
+    parts = [
+        _HEAD.pack(_MAGIC, _VERSION, frame.seq, pts, len(meta)),
+        meta,
+        _NT.pack(len(frame.tensors)),
+    ]
+    for t in frame.tensors:
+        arr = np.ascontiguousarray(np.asarray(t))
+        spec = TensorSpec(tuple(arr.shape), arr.dtype)
+        payload = arr.tobytes()
+        parts.append(pack_flex_header(spec))
+        parts.append(_PLEN.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def decode_frame(buf: bytes) -> TensorFrame:
+    try:
+        magic, version, seq, pts, meta_len = _HEAD.unpack_from(buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated frame header: {e}") from None
+    if magic != _MAGIC:
+        raise WireError("bad frame magic")
+    if version != _VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    off = _HEAD.size
+    mv = memoryview(buf)  # zero-copy slicing on the hot receive path
+    try:
+        meta = json.loads(bytes(mv[off : off + meta_len]).decode()) if meta_len else {}
+        off += meta_len
+        (ntensors,) = _NT.unpack_from(buf, off)
+        off += _NT.size
+        tensors = []
+        for _ in range(ntensors):
+            spec, hlen = unpack_flex_header(mv[off:])
+            off += hlen
+            (plen,) = _PLEN.unpack_from(buf, off)
+            off += _PLEN.size
+            payload = mv[off : off + plen]
+            if len(payload) != plen:
+                raise WireError("truncated tensor payload")
+            off += plen
+            tensors.append(
+                np.frombuffer(payload, dtype=spec.dtype).reshape(spec.shape)
+            )
+    except (struct.error, ValueError) as e:
+        if isinstance(e, WireError):
+            raise
+        raise WireError(f"malformed frame: {e}") from None
+    frame = TensorFrame(tensors, pts=None if math.isnan(pts) else pts, meta=meta)
+    frame.seq = seq
+    return frame

@@ -1,0 +1,140 @@
+"""edgesink / edgesrc: general tensor-stream pub/sub among devices.
+
+Reference: ``gst/edge/`` — edgesink publishes a stream (server role),
+edgesrc subscribes (client role); connect types TCP / HYBRID / MQTT / AITT
+(``edge_common.c:23-35``), topics for brokered modes, caps carried in the
+edge handshake.  The MQTT elements (``gst/mqtt/``) add broker pub/sub with
+NTP-epoch timestamp rebasing for cross-device sync
+(``Documentation/synchronization-in-mqtt-elements.md``).
+
+TPU build: one gRPC broker (distributed/service.py EdgeBroker) covers both
+the direct (edgesink hosts the broker) and brokered (both ends dial a
+third-party broker) layouts.  Timestamp rebasing: the publisher embeds
+``wall_base`` (epoch seconds at pts=0) in frame meta; subscribers rebase
+pts into their local clock domain — the NTP-sync analog.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import time
+from typing import Iterator, Optional
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..distributed.service import (
+    EdgePublisher,
+    EdgeSubscriber,
+    get_edge_broker,
+    release_edge_broker,
+)
+from ..pipeline.element import Property, SinkElement, SourceElement, element
+
+
+@element("edgesink")
+class EdgeSink(SinkElement):
+    PROPERTIES = {
+        "port": Property(int, 0, "broker port (hosted here unless connect-type=client)"),
+        "dest-host": Property(str, "localhost", "remote broker host (client mode)"),
+        "dest-port": Property(int, 0, "remote broker port (client mode)"),
+        "topic": Property(str, "nns", "pub/sub topic"),
+        "connect-type": Property(str, "server", "server (host broker) | client"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._broker = None
+        self._pub: Optional[EdgePublisher] = None
+        self._wall_base: Optional[float] = None
+
+    def start(self):
+        if self.props["connect-type"] == "client":
+            self._pub = EdgePublisher(
+                self.props["dest-host"], self.props["dest-port"], self.props["topic"]
+            )
+        else:
+            self._broker = get_edge_broker(self.props["port"])
+            self._broker.start()
+            self.props["port"] = self._broker.port
+
+    def stop(self):
+        if self._pub is not None:
+            self._pub.close()
+            self._pub = None
+        if self._broker is not None:
+            release_edge_broker(self._broker.port)
+            self._broker = None
+
+    def render(self, frame):
+        if self._wall_base is None:
+            self._wall_base = time.time() - (frame.pts or 0.0)
+        frame.meta["wall_base"] = self._wall_base  # cross-device sync anchor
+        if self._pub is not None:
+            self._pub.publish(frame)
+        else:
+            from ..distributed.wire import encode_frame
+
+            self._broker.publish_local(self.props["topic"], encode_frame(frame))
+
+
+@element("edgesrc")
+class EdgeSrc(SourceElement):
+    PROPERTIES = {
+        "dest-host": Property(str, "localhost", "broker/publisher host"),
+        "dest-port": Property(int, 0, "broker/publisher port"),
+        "topic": Property(str, "nns", "pub/sub topic"),
+        "caps": Property(str, "", "announced schema"),
+        "rebase-pts": Property(bool, True, "rebase pts into the local clock"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._sub: Optional[EdgeSubscriber] = None
+
+    def start(self):
+        self._sub = EdgeSubscriber(
+            self.props["dest-host"], self.props["dest-port"], self.props["topic"]
+        )
+
+    def stop(self):
+        if self._sub is not None:
+            self._sub.close()
+            self._sub = None
+
+    def output_spec(self) -> StreamSpec:
+        text = self.props["caps"]
+        return StreamSpec.from_string(text) if text else ANY
+
+    def frames(self) -> Iterator[TensorFrame]:
+        import threading
+
+        out: "_queue.Queue[Optional[TensorFrame]]" = _queue.Queue(64)
+
+        def pump():
+            try:
+                for frame in self._sub.frames():
+                    out.put(frame)
+            except Exception:  # stream cancelled / broker gone
+                pass
+            finally:
+                out.put(None)
+
+        t = threading.Thread(target=pump, daemon=True, name=f"{self.name}-pump")
+        t.start()
+        local_epoch = time.time()
+        while True:
+            try:
+                frame = out.get(timeout=0.1)
+            except _queue.Empty:
+                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                    return
+                continue
+            if frame is None:
+                return
+            if self.props["rebase-pts"] and frame.pts is not None:
+                wall_base = frame.meta.get("wall_base")
+                if wall_base is not None:
+                    # publisher wall-clock time of this frame, rebased local
+                    frame.pts = (wall_base + frame.pts) - local_epoch
+            yield frame

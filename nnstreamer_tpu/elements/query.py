@@ -1,0 +1,206 @@
+"""tensor_query_* elements: among-device inference offload.
+
+Reference (SURVEY §3.5): ``tensor_query_client`` sends frames to a remote
+server pipeline and awaits answers (async queue + timeout,
+``tensor_query_client.c:657-699``); ``tensor_query_serversrc`` is the server
+pipeline's entry (``tensor_query_serversrc.c:67-365``);
+``tensor_query_serversink`` returns answers to the right client via
+``client_id`` meta (``tensor_query_serversink.c:237-274``); a global
+registry pairs src/sink by id (``tensor_query_server.c``).
+
+TPU deltas: transport is gRPC (see distributed/service.py); the client adds
+**pipelined in-flight requests with ordered delivery** (``max-in-flight``)
+and **multi-host round-robin fan-out** (``hosts=h1:p1,h2:p2``) — the
+mechanism that addresses a TPU pod slice as one logical filter (BASELINE
+north star: linear 1->8 chip scaling).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Deque, Iterator, List, Optional, Tuple
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..distributed.service import (
+    QueryConnection,
+    get_query_server,
+    release_query_server,
+)
+from ..pipeline.element import (
+    Element,
+    ElementError,
+    Property,
+    SinkElement,
+    SourceElement,
+    element,
+)
+
+
+@element("tensor_query_serversrc")
+class TensorQueryServerSrc(SourceElement):
+    PROPERTIES = {
+        "port": Property(int, 0, "listen port (0 = ephemeral)"),
+        "host": Property(str, "[::]", "bind address"),
+        "id": Property(int, 0, "pairs this src with the serversink of same id"),
+        "connect-type": Property(str, "grpc", "reference parity (always grpc)"),
+        "caps": Property(str, "", "announced input schema for the handshake"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._core = None
+
+    def start(self):
+        self._core = get_query_server(self.props["id"], self.props["port"])
+        if self.props["caps"]:
+            self._core.caps = self.props["caps"]
+        self._core.start()
+        # expose the actually-bound port (ephemeral binds)
+        self.props["port"] = self._core.port
+
+    def stop(self):
+        if self._core is not None:
+            release_query_server(self.props["id"])
+            self._core = None
+
+    def output_spec(self) -> StreamSpec:
+        text = self.props["caps"]
+        return StreamSpec.from_string(text) if text else ANY
+
+    def frames(self) -> Iterator[TensorFrame]:
+        while True:
+            try:
+                client_id, frame = self._core.ingress.get(timeout=0.1)
+            except _queue.Empty:
+                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                    return
+                continue
+            # client_id meta was attached by the Invoke handler; just emit
+            yield frame
+
+
+@element("tensor_query_serversink")
+class TensorQueryServerSink(SinkElement):
+    PROPERTIES = {
+        "id": Property(int, 0, "pairs with the serversrc of the same id"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._core = None
+
+    def start(self):
+        self._core = get_query_server(self.props["id"])
+
+    def stop(self):
+        if self._core is not None:
+            release_query_server(self.props["id"])
+            self._core = None
+
+    def render(self, frame):
+        client_id = frame.meta.get("client_id")
+        if client_id is None:
+            raise ElementError(
+                f"{self.name}: frame lacks client_id meta (did it pass through "
+                "an element that drops meta?)"
+            )
+        self._core.resolve(int(client_id), frame)
+
+
+@element("tensor_query_client")
+class TensorQueryClient(Element):
+    """Looks like a local filter; actually round-trips frames through remote
+    server pipeline(s) with pipelined, order-preserving dispatch."""
+
+    PROPERTIES = {
+        "host": Property(str, "localhost", "server host"),
+        "port": Property(int, 0, "server port"),
+        "hosts": Property(str, "", "multi-server fan-out 'h1:p1,h2:p2' (round-robin)"),
+        "timeout": Property(float, 10.0, "per-request timeout, seconds"),
+        "max-in-flight": Property(int, 8, "pipelined outstanding requests"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._conns: List[QueryConnection] = []
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._inflight: Deque[Future] = deque()
+        self._rr = 0
+
+    def start(self):
+        targets: List[Tuple[str, int]] = []
+        if self.props["hosts"]:
+            for part in self.props["hosts"].split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                h, sep, p = part.rpartition(":")
+                if not sep or not h or not p.isdigit():
+                    raise ElementError(
+                        f"{self.name}: bad hosts entry {part!r} (want host:port)"
+                    )
+                targets.append((h, int(p)))
+        else:
+            targets.append((self.props["host"], self.props["port"]))
+        if not targets or any(p == 0 for _, p in targets):
+            raise ElementError(f"{self.name}: query client needs host/port")
+        self._conns = [
+            QueryConnection(h, p, self.props["timeout"]) for h, p in targets
+        ]
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.props["max-in-flight"])
+        )
+
+    def stop(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+        for c in self._conns:
+            c.close()
+        self._conns = []
+        self._inflight.clear()
+
+    # caps handshake at negotiation time (≙ edge CAPS event exchange)
+    def accept_spec(self, pad, spec):
+        if spec.tensors and self._conns:
+            for conn in self._conns:
+                try:
+                    conn.handshake(spec.to_string())
+                except Exception as e:  # noqa: BLE001 — transport boundary
+                    raise ElementError(
+                        f"{self.name}: caps handshake with {conn.addr} failed: {e}"
+                    ) from None
+        return spec
+
+    def derive_spec(self, pad=0):
+        return ANY  # the server decides the answer schema
+
+    def _drain_ready(self, block_all: bool):
+        out = []
+        while self._inflight:
+            fut = self._inflight[0]
+            if not block_all and not fut.done():
+                break
+            self._inflight.popleft()
+            out.append((0, fut.result()))  # raises on RPC error -> bus
+        return out
+
+    def handle_frame(self, pad, frame):
+        conn = self._conns[self._rr % len(self._conns)]
+        self._rr += 1
+        timeout = self.props["timeout"]
+        fut = self._pool.submit(conn.invoke, frame, timeout)
+        self._inflight.append(fut)
+        # backpressure: block on the oldest request once the in-flight window
+        # is full, then release whatever is complete (in order)
+        if len(self._inflight) >= max(1, self.props["max-in-flight"]):
+            self._inflight[0].result()
+        return self._drain_ready(block_all=False)
+
+    def handle_eos(self, pad):
+        return self._drain_ready(block_all=True)

@@ -1,0 +1,171 @@
+"""Distributed layer tests: wire round trip, query offload round trip,
+multi-server fan-out, edge pub/sub — all as in-process/localhost pipelines
+(the reference tests distribution the same way: multiple processes on
+localhost, ``tests/nnstreamer_edge/query/runTest.sh``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.distributed import WireError, decode_frame, encode_frame
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+class TestWire:
+    def test_roundtrip(self):
+        f = TensorFrame(
+            [np.arange(6, dtype=np.float32).reshape(2, 3), np.uint8([1, 2])],
+            pts=1.25,
+            meta={"client_id": 7, "label": "cat"},
+        )
+        g = decode_frame(encode_frame(f))
+        assert g.pts == 1.25
+        assert g.meta["label"] == "cat" and g.meta["client_id"] == 7
+        np.testing.assert_array_equal(g.tensors[0], f.tensors[0])
+        np.testing.assert_array_equal(g.tensors[1], f.tensors[1])
+
+    def test_no_pts(self):
+        g = decode_frame(encode_frame(TensorFrame([np.int32([1])])))
+        assert g.pts is None
+
+    def test_non_serializable_meta_skipped(self):
+        f = TensorFrame([np.int32([1])], meta={"ok": 1, "bad": object()})
+        g = decode_frame(encode_frame(f))
+        assert g.meta == {"ok": 1}
+
+    def test_garbage_n(self):
+        with pytest.raises(WireError):
+            decode_frame(b"not a frame")
+        with pytest.raises(WireError):
+            decode_frame(b"")
+
+
+class TestQueryRoundTrip:
+    def make_server(self, sid, fw="scaler", custom="factor:2"):
+        pipe = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id={sid} port=0 ! "
+            f"tensor_filter framework={fw} custom={custom} ! "
+            f"tensor_query_serversink id={sid}"
+        )
+        pipe.start()
+        return pipe, pipe["ssrc"].props["port"]
+
+    def test_offload_roundtrip(self):
+        server, port = self.make_server(101)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} ! tensor_sink name=out"
+            )
+            client.start()
+            for i in range(5):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=20)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [0.0, 2.0, 4.0, 6.0, 8.0]  # scaled by server, in order
+        finally:
+            server.stop()
+
+    def test_fanout_two_servers_ordered(self):
+        s1, p1 = self.make_server(111)
+        s2, p2 = self.make_server(112)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client hosts=localhost:{p1},localhost:{p2} "
+                "max-in-flight=4 ! tensor_sink name=out"
+            )
+            client.start()
+            n = 12
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [2.0 * i for i in range(n)]  # order preserved
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_client_unreachable_n(self):
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client port=1 timeout=1.5 ! tensor_sink name=out"
+        )
+        client.start()
+        client["src"].push(np.float32([1]))
+        client["src"].end_of_stream()
+        with pytest.raises(Exception):
+            client.wait(timeout=20)
+        client.stop()
+
+    def test_client_id_meta_on_server(self):
+        seen = []
+        server = parse_pipeline(
+            "tensor_query_serversrc name=ssrc id=120 port=0 ! "
+            "tensor_filter framework=passthrough ! tensor_query_serversink id=120"
+        )
+        server.start()
+        port = server["ssrc"].props["port"]
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} ! tensor_sink name=out"
+            )
+            client.start()
+            client["src"].push(np.float32([1]))
+            client["src"].end_of_stream()
+            client.wait(timeout=20)
+            assert client["out"].frames[0].meta.get("client_id") is not None
+            client.stop()
+        finally:
+            server.stop()
+
+
+class TestEdgePubSub:
+    def test_publish_subscribe(self):
+        sink_pipe = parse_pipeline(
+            "appsrc name=src ! edgesink name=es port=0 topic=video"
+        )
+        sink_pipe.start()
+        port = sink_pipe["es"].props["port"]
+        try:
+            src_pipe = parse_pipeline(
+                f"edgesrc dest-port={port} topic=video rebase-pts=false ! tensor_sink name=out"
+            )
+            src_pipe.start()
+            time.sleep(0.5)  # let the subscription attach
+            for i in range(3):
+                sink_pipe["src"].push(np.int32([i]), pts=i * 0.1)
+            deadline = time.time() + 10
+            while len(src_pipe["out"].frames) < 3 and time.time() < deadline:
+                time.sleep(0.05)
+            assert [int(f.tensors[0][0]) for f in src_pipe["out"].frames] == [0, 1, 2]
+            src_pipe.stop()
+        finally:
+            sink_pipe["src"].end_of_stream()
+            sink_pipe.wait(timeout=10)
+            sink_pipe.stop()
+
+    def test_topic_isolation(self):
+        sink_pipe = parse_pipeline(
+            "appsrc name=src ! edgesink name=es port=0 topic=a"
+        )
+        sink_pipe.start()
+        port = sink_pipe["es"].props["port"]
+        try:
+            other = parse_pipeline(
+                f"edgesrc dest-port={port} topic=b ! tensor_sink name=out"
+            )
+            other.start()
+            time.sleep(0.3)
+            sink_pipe["src"].push(np.int32([1]))
+            time.sleep(0.5)
+            assert len(other["out"].frames) == 0  # different topic sees nothing
+            other.stop()
+        finally:
+            sink_pipe["src"].end_of_stream()
+            sink_pipe.wait(timeout=10)
+            sink_pipe.stop()
