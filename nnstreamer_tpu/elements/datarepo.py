@@ -1,0 +1,170 @@
+"""datareposrc / datareposink: the MLOps dataset repository.
+
+Reference: ``gst/datarepo/gstdatareposrc.c`` (props :79-88 — location,
+json meta, start/stop-sample-index, epochs, is-shuffle, tensors-sequence)
+and ``gstdatareposink.c`` (render :106 writes sample files + JSON meta).
+
+Format: one flat binary file of fixed-size samples (all tensors of one
+frame concatenated) + a JSON meta file::
+
+    {"format": "static", "tensors": ["float32:1:28:28", "int64:1"],
+     "total_samples": N, "sample_size": bytes}
+
+Deterministic resume comes from sample indices + epochs (reference §5.4);
+``is-shuffle`` uses a seeded permutation per epoch so a restarted run
+replays the same order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
+from ..pipeline.element import ElementError, Property, SinkElement, SourceElement, element
+
+
+@element("datareposink")
+class DataRepoSink(SinkElement):
+    PROPERTIES = {
+        "location": Property(str, "", "data file path"),
+        "json": Property(str, "", "meta file path"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._file = None
+        self._count = 0
+        self._specs: Optional[List[TensorSpec]] = None
+        self._sample_size = 0
+
+    def start(self):
+        if not self.props["location"] or not self.props["json"]:
+            raise ElementError(f"{self.name}: datareposink needs location= and json=")
+        self._file = open(self.props["location"], "wb")
+        self._count = 0
+
+    def render(self, frame):
+        arrays = [np.ascontiguousarray(np.asarray(t)) for t in frame.tensors]
+        if self._specs is None:
+            self._specs = [TensorSpec(a.shape, a.dtype) for a in arrays]
+            self._sample_size = sum(a.nbytes for a in arrays)
+        else:
+            # the repo file is fixed-stride: every sample must match frame 0
+            if len(arrays) != len(self._specs) or any(
+                tuple(a.shape) != s.shape or a.dtype != s.dtype
+                for a, s in zip(arrays, self._specs)
+            ):
+                got = [f"{a.dtype}{list(a.shape)}" for a in arrays]
+                raise ElementError(
+                    f"{self.name}: sample {self._count} schema {got} differs "
+                    f"from first sample {[s.to_string() for s in self._specs]}"
+                )
+        for a in arrays:
+            self._file.write(a.tobytes())
+        self._count += 1
+
+    def stop(self):
+        if self._file is None:
+            return
+        self._file.close()
+        self._file = None
+        meta = {
+            "format": "static",
+            "tensors": [s.to_string() for s in (self._specs or [])],
+            "total_samples": self._count,
+            "sample_size": self._sample_size,
+        }
+        with open(self.props["json"], "w") as f:
+            json.dump(meta, f)
+
+
+@element("datareposrc")
+class DataRepoSrc(SourceElement):
+    PROPERTIES = {
+        "location": Property(str, "", "data file path"),
+        "json": Property(str, "", "meta file path"),
+        "start-sample-index": Property(int, 0, "first sample (inclusive)"),
+        "stop-sample-index": Property(int, -1, "last sample (inclusive; -1 = end)"),
+        "epochs": Property(int, 1, "repeat the range N times"),
+        "is-shuffle": Property(bool, False, "seeded shuffle per epoch"),
+        "shuffle-seed": Property(int, 0, "determinism for resume"),
+        "tensors-sequence": Property(str, "", "reorder tensors, e.g. '1,0'"),
+        "caps": Property(str, "", "override announced schema"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._specs: List[TensorSpec] = []
+        self._total = 0
+        self._sample_size = 0
+
+    def start(self):
+        if not self.props["location"] or not self.props["json"]:
+            raise ElementError(f"{self.name}: datareposrc needs location= and json=")
+        with open(self.props["json"]) as f:
+            meta = json.load(f)
+        self._specs = [TensorSpec.from_string(s) for s in meta["tensors"]]
+        self._total = int(meta["total_samples"])
+        self._sample_size = int(meta["sample_size"])
+        size = os.path.getsize(self.props["location"])
+        if size < self._total * self._sample_size:
+            raise ElementError(
+                f"{self.name}: data file smaller than meta claims "
+                f"({size} < {self._total}×{self._sample_size})"
+            )
+
+    def _sequence(self) -> Optional[List[int]]:
+        text = self.props["tensors-sequence"]
+        if not text:
+            return None
+        return [int(x) for x in text.split(",") if x.strip()]
+
+    def output_spec(self) -> StreamSpec:
+        if self.props["caps"]:
+            return StreamSpec.from_string(self.props["caps"])
+        specs = self._specs
+        seq = self._sequence()
+        if seq:
+            specs = [specs[i] for i in seq]
+        return StreamSpec(tuple(specs), FORMAT_STATIC)
+
+    def frames(self) -> Iterator[TensorFrame]:
+        start = self.props["start-sample-index"]
+        stop = self.props["stop-sample-index"]
+        stop = self._total - 1 if stop < 0 else min(stop, self._total - 1)
+        if start > stop:
+            raise ElementError(f"{self.name}: empty sample range [{start}, {stop}]")
+        indices = np.arange(start, stop + 1)
+        seq = self._sequence()
+        with open(self.props["location"], "rb") as f:
+            for epoch in range(max(1, self.props["epochs"])):
+                order = indices
+                if self.props["is-shuffle"]:
+                    rng = np.random.default_rng(self.props["shuffle-seed"] + epoch)
+                    order = rng.permutation(indices)
+                for idx in order:
+                    if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                        return
+                    f.seek(int(idx) * self._sample_size)
+                    raw = f.read(self._sample_size)
+                    tensors = []
+                    off = 0
+                    for spec in self._specs:
+                        n = spec.nbytes
+                        tensors.append(
+                            np.frombuffer(raw[off : off + n], dtype=spec.dtype)
+                            .reshape(spec.shape)
+                        )
+                        off += n
+                    if seq:
+                        tensors = [tensors[i] for i in seq]
+                    frame = TensorFrame(tensors)
+                    frame.meta["sample_index"] = int(idx)
+                    frame.meta["epoch"] = epoch
+                    yield frame

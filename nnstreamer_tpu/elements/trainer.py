@@ -1,0 +1,123 @@
+"""tensor_trainer: in-pipeline training element.
+
+Reference: ``gst/nnstreamer/elements/gsttensor_trainer.c`` (SURVEY §3.4) —
+a data pump + lifecycle/event manager around a trainer subplugin: first
+buffer triggers create+start, every buffer becomes push_data, epoch
+completion pushes a model-stats frame downstream, training completion saves
+the model and lets the pipeline EOS.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
+from ..pipeline.element import Element, ElementError, Property, element
+from ..pipeline.pipeline import BusMessage
+from ..trainer.base import (
+    EVENT_EPOCH_COMPLETION,
+    EVENT_TRAINING_COMPLETION,
+    TrainerStatus,
+    find_trainer,
+)
+
+
+@element("tensor_trainer")
+class TensorTrainer(Element):
+    PROPERTIES = {
+        "framework": Property(str, "jax", "trainer backend name"),
+        "model-config": Property(str, "", "config file path or inline JSON"),
+        "model-save-path": Property(str, "", "where to save the trained model"),
+        "model-load-path": Property(str, "", "warm-start weights"),
+        "num-inputs": Property(int, 1, "input tensors per frame"),
+        "num-labels": Property(int, 1, "label tensors per frame"),
+        "num-training-samples": Property(int, 0, "train samples per epoch"),
+        "num-validation-samples": Property(int, 0, "validation samples per epoch"),
+        "epochs": Property(int, 1, "number of epochs"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.backend = None
+        self._created = False
+        self.training_complete = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._stats_pending = []  # epoch stats awaiting downstream emission
+
+    def start(self):
+        try:
+            cls = find_trainer(self.props["framework"])
+        except KeyError:
+            raise ElementError(
+                f"{self.name}: unknown trainer framework {self.props['framework']!r}"
+            ) from None
+        self.backend = cls()
+        self.backend.add_listener(self._on_event)
+
+    def stop(self):
+        if self.backend is not None:
+            self.backend.stop()
+            self.backend = None
+        self._created = False
+
+    def _on_event(self, event: str, status: TrainerStatus) -> None:
+        # fires on the trainer's own thread: queue stats for in-band emission
+        # (≙ reference pushing model-stats buffers) and post out-of-band
+        if self._pipeline is not None:
+            self._pipeline.post(BusMessage("element", self.name, {event: status.as_dict()}))
+        if event == EVENT_EPOCH_COMPLETION:
+            s = status
+            with self._stats_lock:
+                self._stats_pending.append(
+                    np.asarray(
+                        [s.epoch_count, s.training_loss, s.training_accuracy,
+                         s.validation_loss, s.validation_accuracy],
+                        np.float64,
+                    )
+                )
+        if event == EVENT_TRAINING_COMPLETION:
+            self.training_complete.set()
+
+    def _drain_stats(self):
+        if not self.srcpads or not self.srcpads[0].is_linked:
+            return []
+        with self._stats_lock:
+            pending, self._stats_pending = self._stats_pending, []
+        return [(0, TensorFrame([stats])) for stats in pending]
+
+    def derive_spec(self, pad=0):
+        # downstream sees epoch-stats vectors
+        return StreamSpec(
+            (TensorSpec((5,), np.float64, "model-stats"),), FORMAT_STATIC
+        )
+
+    def handle_frame(self, pad, frame):
+        assert self.backend is not None
+        if not self._created:
+            # first buffer: create + start (reference :141-144)
+            self.backend.create(dict(self.props))
+            self.backend.start()
+            self._created = True
+        self.backend.push_data(frame)
+        self._check_backend_error()
+        return self._drain_stats()
+
+    def _check_backend_error(self):
+        err = getattr(self.backend, "error", None)
+        if err is not None:
+            raise ElementError(f"{self.name}: trainer failed: {err}") from err
+
+    def handle_eos(self, pad):
+        if self.backend is not None and self._created:
+            if hasattr(self.backend, "end_of_data"):
+                self.backend.end_of_data()
+            # wait for the training thread to finish + save (reference waits
+            # on TRAINING_COMPLETION before EOS)
+            self.training_complete.wait(timeout=600)
+            self._check_backend_error()
+        return self._drain_stats()

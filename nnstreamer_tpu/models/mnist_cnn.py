@@ -1,0 +1,70 @@
+"""MNIST CNN — the reference training example's model.
+
+Reference context: tensor_trainer's canonical pipeline trains an MNIST CNN
+through NNTrainer (``Documentation`` examples; trainer ABI
+``nnstreamer_plugin_api_trainer.h``).  Small LeNet-style flax CNN; bf16
+compute with f32 logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # (N, 28, 28, 1) float or uint8
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) / 255.0
+        else:
+            x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+def build(custom_props=None):
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        props.get("dtype", "bfloat16")
+    ]
+    classes = int(props.get("classes", "10"))
+    model = MnistCNN(num_classes=classes, dtype=dtype)
+    params = model.init(
+        jax.random.PRNGKey(int(props.get("seed", "0"))),
+        jnp.zeros((1, 28, 28, 1), jnp.float32),
+    )
+
+    def fn(p, inputs: List[Any]) -> List[Any]:
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        out = model.apply(p, x)
+        return [out[0] if single else out]
+
+    in_spec = StreamSpec(
+        (TensorSpec((28, 28, 1), np.float32, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((classes,), np.float32, "logits"),), FORMAT_STATIC
+    )
+    return fn, params, in_spec, out_spec

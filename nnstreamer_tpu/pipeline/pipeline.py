@@ -148,8 +148,14 @@ class Pipeline:
                 except Exception:
                     self.log.exception("stop() failed for %s", el.name)
             raise
+        # a terminal is any non-source element with no LINKED src pad (a
+        # trailing element whose output nobody consumes still ends the
+        # stream, e.g. a pipeline ending at tensor_trainer)
         self._pending_sinks = sum(
-            1 for el in self.elements.values() if not el.srcpads
+            1
+            for el in self.elements.values()
+            if not isinstance(el, SourceElement)
+            and not any(p.is_linked for p in el.srcpads)
         )
         if self._pending_sinks == 0:
             self._sinks_done.set()
@@ -268,7 +274,7 @@ class Pipeline:
         caps_pads: set = set()
 
         def finish_eos():
-            if el.srcpads:
+            if any(p.is_linked for p in el.srcpads):
                 for i in range(len(el.srcpads)):
                     self._push(el, i, EOS())
             else:

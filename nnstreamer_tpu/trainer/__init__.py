@@ -1,0 +1,9 @@
+"""Training subsystem: trainer backend ABI + the JAX/Optax delegate.
+
+Reference analog: ``GstTensorTrainerFramework`` ABI
+(``nnstreamer_plugin_api_trainer.h:95-196``) whose reference implementation
+is NNTrainer (out-of-repo); here the delegate is JAX/Optax.
+"""
+
+from .base import TrainerBackend, TrainerStatus, find_trainer, register_trainer  # noqa: F401
+from . import jax_trainer  # noqa: F401 — registers "jax"

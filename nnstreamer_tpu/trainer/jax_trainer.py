@@ -1,0 +1,260 @@
+"""JAX/Optax trainer delegate — the in-framework analog of NNTrainer.
+
+Model config (the ``model-config`` property, JSON file or inline JSON)::
+
+    {"arch": "mnist_cnn", "arch_props": {"dtype": "float32"},
+     "optimizer": "adam", "learning_rate": 1e-3, "batch_size": 32,
+     "loss": "softmax_ce"}
+
+Data protocol (≙ trainer ABI push_data, SURVEY §3.4): each incoming frame
+carries ``num-inputs`` input tensors followed by ``num-labels`` label
+tensors; every ``num-training-samples`` + ``num-validation-samples`` frames
+form one epoch (train split first, then validation) — the exact contract of
+the reference element (``gsttensor_trainer.c`` header: total expected =
+(train+valid)×epochs).
+
+The training loop runs on a dedicated thread; samples stream in through a
+bounded queue (backpressure to the pipeline).  Each optimizer step is one
+jitted donate-argnums XLA call over a micro-batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.log import get_logger
+from .base import (
+    EVENT_EPOCH_COMPLETION,
+    EVENT_TRAINING_COMPLETION,
+    TrainerBackend,
+    TrainerStatus,
+    register_trainer,
+)
+
+log = get_logger("jax-trainer")
+
+
+class JaxTrainer(TrainerBackend):
+    NAME = "jax"
+
+    def __init__(self):
+        super().__init__()
+        self._cfg: Dict[str, Any] = {}
+        self._props: Dict[str, Any] = {}
+        self._q: "queue.Queue[Optional[TensorFrame]]" = queue.Queue(256)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.params = None
+        self._fn = None
+        self.error: Optional[BaseException] = None
+
+    # -- ABI ----------------------------------------------------------------
+    def create(self, props: Dict[str, Any]) -> None:
+        self._props = dict(props)
+        cfg_text = props.get("model-config") or "{}"
+        if os.path.isfile(cfg_text):
+            with open(cfg_text) as f:
+                self._cfg = json.load(f)
+        else:
+            self._cfg = json.loads(cfg_text)
+        if "arch" not in self._cfg:
+            raise ValueError("trainer model-config must name an 'arch'")
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._train_loop, name="jax-trainer", daemon=True
+        )
+        self._thread.start()
+
+    def push_data(self, frame: TensorFrame) -> None:
+        while not self._stop.is_set():
+            if self._thread is not None and not self._thread.is_alive():
+                return  # trainer died; don't spin (its error is surfaced)
+            try:
+                self._q.put(frame, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _put_sentinel(self) -> None:
+        # never block: if the queue is full the consumer is gone — drain one
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            try:
+                self._q.get_nowait()
+                self._q.put_nowait(None)
+            except (queue.Empty, queue.Full):
+                pass
+
+    def end_of_data(self) -> None:
+        self._put_sentinel()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._put_sentinel()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- internals ----------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .. import models as zoo
+
+        arch = self._cfg["arch"]
+        arch_props = {k: str(v) for k, v in self._cfg.get("arch_props", {}).items()}
+        fn, params, _, _ = zoo.build(arch, arch_props)
+        load_path = self._props.get("model-load-path")
+        if load_path:
+            params = _load_params(load_path, params)
+        lr = float(self._cfg.get("learning_rate", 1e-3))
+        opt_name = self._cfg.get("optimizer", "adam")
+        tx = {
+            "adam": optax.adam,
+            "adamw": optax.adamw,
+            "sgd": optax.sgd,
+        }[opt_name](lr)
+        opt_state = tx.init(params)
+
+        loss_kind = self._cfg.get("loss", "softmax_ce")
+
+        def loss_fn(p, xs, ys):
+            logits = fn(p, xs)[0]
+            if loss_kind == "softmax_ce":
+                labels = ys[0]
+                # one-hot only when the trailing dim is the class dim;
+                # (B,1) integer labels must NOT be argmax'd
+                if labels.ndim == logits.ndim and labels.shape[-1] == logits.shape[-1]:
+                    labels = jnp.argmax(labels, axis=-1)
+                labels = labels.reshape(-1).astype(jnp.int32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+                acc = jnp.mean(
+                    (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+                )
+                return -jnp.mean(ll), acc
+            if loss_kind == "mse":
+                target = ys[0].astype(logits.dtype)
+                return jnp.mean((logits - target) ** 2), jnp.zeros(())
+            raise ValueError(f"unknown loss {loss_kind!r}")
+
+        @jax.jit
+        def eval_step(p, xs, ys):
+            return loss_fn(p, xs, ys)
+
+        def _step(p, opt, xs, ys):
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, xs, ys)
+            updates, opt = tx.update(grads, opt, p)
+            p = optax.apply_updates(p, updates)
+            return p, opt, loss, acc
+
+        train_step = jax.jit(_step, donate_argnums=(0, 1))
+        return fn, params, opt_state, train_step, eval_step
+
+    def _batches(self, samples: List[Tuple[List[np.ndarray], List[np.ndarray]]],
+                 batch_size: int):
+        for i in range(0, len(samples), batch_size):
+            chunk = samples[i : i + batch_size]
+            xs = [np.stack([s[0][t] for s in chunk]) for t in range(len(chunk[0][0]))]
+            ys = [np.stack([s[1][t] for s in chunk]) for t in range(len(chunk[0][1]))]
+            yield xs, ys
+
+    def _train_loop(self) -> None:
+        try:
+            self._fn, self.params, opt_state, train_step, eval_step = self._build()
+        except Exception as e:
+            log.exception("trainer build failed")
+            self.error = e  # surfaced as a pipeline error by the element
+            self.notify(EVENT_TRAINING_COMPLETION)
+            return
+        n_in = int(self._props.get("num-inputs", 1))
+        n_lab = int(self._props.get("num-labels", 1))
+        n_train = int(self._props.get("num-training-samples", 0))
+        n_valid = int(self._props.get("num-validation-samples", 0))
+        epochs = int(self._props.get("epochs", 1))
+        batch_size = int(self._cfg.get("batch_size", 32))
+        per_epoch = n_train + n_valid
+
+        epoch_samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
+        done_epochs = 0
+        while not self._stop.is_set() and (epochs <= 0 or done_epochs < epochs):
+            try:
+                frame = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if frame is None:
+                break
+            xs = [np.asarray(t) for t in frame.tensors[:n_in]]
+            ys = [np.asarray(t) for t in frame.tensors[n_in : n_in + n_lab]]
+            epoch_samples.append((xs, ys))
+            if per_epoch and len(epoch_samples) >= per_epoch:
+                train = epoch_samples[:n_train]
+                valid = epoch_samples[n_train:per_epoch]
+                losses, accs = [], []
+                for bx, by in self._batches(train, batch_size):
+                    self.params, opt_state, loss, acc = train_step(
+                        self.params, opt_state, bx, by
+                    )
+                    losses.append(float(loss))
+                    accs.append(float(acc))
+                vlosses, vaccs = [], []
+                for bx, by in self._batches(valid, batch_size) if valid else ():
+                    loss, acc = eval_step(self.params, bx, by)
+                    vlosses.append(float(loss))
+                    vaccs.append(float(acc))
+                done_epochs += 1
+                self.status = TrainerStatus(
+                    epoch_count=done_epochs,
+                    training_loss=float(np.mean(losses)) if losses else 0.0,
+                    training_accuracy=float(np.mean(accs)) if accs else 0.0,
+                    validation_loss=float(np.mean(vlosses)) if vlosses else 0.0,
+                    validation_accuracy=float(np.mean(vaccs)) if vaccs else 0.0,
+                )
+                epoch_samples = []
+                self.notify(EVENT_EPOCH_COMPLETION)
+        save_path = self._props.get("model-save-path")
+        if save_path and self.params is not None:
+            _save_params(save_path, self.params)
+            log.info("model saved to %s", save_path)
+        self.notify(EVENT_TRAINING_COMPLETION)
+
+
+def _save_params(path: str, params) -> None:
+    if path.endswith(".msgpack"):
+        from flax import serialization
+
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(params))
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), params, force=True)
+        ckptr.wait_until_finished()
+
+
+def _load_params(path: str, template):
+    if path.endswith(".msgpack"):
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            return serialization.from_bytes(template, f.read())
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), template)
+
+
+register_trainer(JaxTrainer)
