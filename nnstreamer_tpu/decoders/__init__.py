@@ -13,5 +13,6 @@ registry.register_lazy(registry.KIND_DECODER, "image_segment", "nnstreamer_tpu.d
 registry.register_lazy(registry.KIND_DECODER, "tensor_region", "nnstreamer_tpu.decoders.tensor_region:TensorRegion")
 registry.register_lazy(registry.KIND_DECODER, "octet_stream", "nnstreamer_tpu.decoders.octet:OctetStream")
 registry.register_lazy(registry.KIND_DECODER, "flexbuf", "nnstreamer_tpu.decoders.serialize:FlexbufDecoder")
+registry.register_lazy(registry.KIND_DECODER, "flatbuf", "nnstreamer_tpu.decoders.serialize:FlatbufDecoder")
 registry.register_lazy(registry.KIND_DECODER, "protobuf", "nnstreamer_tpu.decoders.serialize:ProtobufDecoder")
 registry.register_lazy(registry.KIND_DECODER, "python3", "nnstreamer_tpu.decoders.python3:Python3Decoder")

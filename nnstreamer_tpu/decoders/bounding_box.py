@@ -1,0 +1,322 @@
+"""bounding_boxes decoder: detection tensors -> RGBA box-overlay video.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c`` (2292
+LoC).  Option contract preserved (header comment :28-92 of the reference):
+
+- option1: box mode — ``mobilenet-ssd`` (alias ``tflite-ssd``),
+  ``mobilenet-ssd-postprocess`` (alias ``tf-ssd``), ``ov-person-detection``,
+  ``ov-face-detection``, ``yolov5``, ``yolov8``, ``mp-palm-detection``
+- option2: label file path
+- option3: mode-dependent (priors file / scales / thresholds — see per-mode
+  docstrings)
+- option4: video output dimension ``WIDTH:HEIGHT``
+- option5: model input dimension ``WIDTH:HEIGHT``
+- option6: tracking flag (carried in meta; no renderer-side ID persistence)
+- option7: log flag (prints detections)
+
+Output: one RGBA tensor (H, W, 4) with box outlines + label stamps, plus
+``meta["boxes"]`` = list of ``{x, y, w, h, score, class, label}`` in output
+coordinates — the machine-readable analog of the reference's video overlay.
+
+All decode math is vectorized numpy on host; detection post-processing is
+small (thousands of candidates) and latency-bound, so it stays off the TPU —
+the TPU path ends at the model head inside tensor_filter.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from . import util
+
+_MODES = (
+    "mobilenet-ssd", "tflite-ssd",
+    "mobilenet-ssd-postprocess", "tf-ssd",
+    "ov-person-detection", "ov-face-detection",
+    "yolov5", "yolov8",
+    "mp-palm-detection",
+)
+
+_DEFAULT_OUT = (640, 480)
+_DEFAULT_IN = (300, 300)
+
+
+def _floats(parts: List[str], defaults: List[float]) -> List[float]:
+    out = list(defaults)
+    for i, p in enumerate(parts[: len(defaults)]):
+        if p:
+            try:
+                out[i] = float(p)
+            except ValueError:
+                pass
+    return out
+
+
+class BoundingBoxes:
+    NAME = "bounding_boxes"
+
+    def __init__(self):
+        self.mode = "mobilenet-ssd"
+        self.labels: Optional[List[str]] = None
+        self.out_wh = _DEFAULT_OUT
+        self.in_wh = _DEFAULT_IN
+        self.option3 = ""
+        self.tracking = False
+        self.log = False
+        self._priors: Optional[np.ndarray] = None
+        self._anchors: Optional[np.ndarray] = None
+
+    # -- configuration ------------------------------------------------------
+
+    def set_options(self, options: List[str]) -> None:
+        o = list(options) + [""] * 9
+        if o[0]:
+            mode = o[0].strip()
+            if mode not in _MODES:
+                raise ValueError(f"bounding_boxes: unknown mode {mode!r}")
+            self.mode = mode
+        if o[1]:
+            self.labels = util.load_labels(o[1])
+        self.option3 = o[2]
+        self.out_wh = util.parse_wh(o[3], _DEFAULT_OUT)
+        self.in_wh = util.parse_wh(o[4], _DEFAULT_IN)
+        self.tracking = o[5].strip() in ("1", "true", "TRUE")
+        self.log = o[6].strip() in ("1", "true", "TRUE")
+        if self.mode in ("mobilenet-ssd", "tflite-ssd"):
+            self._parse_ssd_option3()
+        if self.mode == "mp-palm-detection":
+            self._parse_palm_option3()
+
+    def _parse_ssd_option3(self) -> None:
+        """option3 = priors.txt[:sigmoid_thr:y_scale:x_scale:h_scale:w_scale
+        [:iou_thr]] (reference :47-66)."""
+        parts = self.option3.split(":") if self.option3 else [""]
+        if parts[0]:
+            self._priors = _load_box_priors(parts[0])
+        (self.ssd_thr, self.ssd_ys, self.ssd_xs, self.ssd_hs, self.ssd_ws,
+         self.ssd_iou) = _floats(parts[1:], [0.5, 10.0, 10.0, 5.0, 5.0, 0.5])
+
+    def _parse_palm_option3(self) -> None:
+        """option3 = score_thr[:num_layers:min_scale:max_scale:offset_x
+        :offset_y:stride...] (reference :76-88)."""
+        parts = self.option3.split(":") if self.option3 else []
+        vals = _floats(parts, [0.5, 4, 1.0, 1.0, 0.5, 0.5])
+        self.palm_thr = vals[0]
+        self.palm_layers = int(vals[1])
+        self.palm_min_scale, self.palm_max_scale = vals[2], vals[3]
+        self.palm_offset = (vals[4], vals[5])
+        strides = [int(float(p)) for p in parts[6:] if p]
+        self.palm_strides = strides or [8, 16, 16, 16][: self.palm_layers]
+        self._anchors = None  # regenerate lazily
+
+    # -- decoder ABI ---------------------------------------------------------
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        w, h = self.out_wh
+        return StreamSpec(
+            (TensorSpec((h, w, 4), np.uint8, "video_rgba"),),
+            FORMAT_STATIC,
+            in_spec.framerate if in_spec else None,
+        )
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        tensors = [np.asarray(t) for t in frame.tensors]
+        dets = self._detect(tensors)  # [N,6] x1,y1,x2,y2,score,cls in in_wh px
+        dets = util.nms(dets, getattr(self, "ssd_iou", 0.5))
+        dets[:, :4] = util.scale_boxes(dets[:, :4], self.in_wh, self.out_wh)
+
+        w, h = self.out_wh
+        canvas = util.blank_canvas(w, h)
+        boxes_meta = []
+        for x1, y1, x2, y2, score, cls in dets:
+            color = util.class_color(int(cls))
+            util.draw_rect(canvas, x1, y1, x2, y2, color, thickness=2)
+            label = (self.labels[int(cls)]
+                     if self.labels and int(cls) < len(self.labels) else str(int(cls)))
+            util.draw_label(canvas, x1 + 2, max(0, y1 - 8), label, color)
+            boxes_meta.append({
+                "x": float(x1), "y": float(y1),
+                "w": float(x2 - x1), "h": float(y2 - y1),
+                "score": float(score), "class": int(cls), "label": label,
+            })
+        out = frame.with_tensors([canvas])
+        out.meta["boxes"] = boxes_meta
+        out.meta["box_mode"] = self.mode
+        if self.log and boxes_meta:
+            from ..core.log import get_logger
+            get_logger("decoder.bounding_boxes").info(
+                "bounding_boxes[%s]: %d detections", self.mode, len(boxes_meta))
+        return out
+
+    # -- per-mode detection -> [N,6] (x1,y1,x2,y2,score,cls) in input px -----
+
+    def _detect(self, tensors: List[np.ndarray]) -> np.ndarray:
+        if self.mode in ("mobilenet-ssd", "tflite-ssd"):
+            return self._detect_mobilenet_ssd(tensors)
+        if self.mode in ("mobilenet-ssd-postprocess", "tf-ssd"):
+            return self._detect_postprocess(tensors)
+        if self.mode.startswith("ov-"):
+            return self._detect_openvino(tensors)
+        if self.mode == "yolov5":
+            return self._detect_yolo(tensors[0], has_objectness=True)
+        if self.mode == "yolov8":
+            return self._detect_yolo(tensors[0], has_objectness=False)
+        if self.mode == "mp-palm-detection":
+            return self._detect_palm(tensors)
+        raise ValueError(self.mode)
+
+    def _detect_mobilenet_ssd(self, tensors) -> np.ndarray:
+        """tensors = [locations [P,4] (yc,xc,h,w offsets), scores [P,C]];
+        priors from option3 file; reference ``update_mobilenet_ssd``."""
+        loc = tensors[0].reshape(-1, 4).astype(np.float64)
+        scores = tensors[1].reshape(loc.shape[0], -1).astype(np.float64)
+        if self._priors is None:
+            raise ValueError("mobilenet-ssd requires box-priors file (option3)")
+        pri = self._priors  # [P,4] = yc, xc, h, w
+        yc = loc[:, 0] / self.ssd_ys * pri[:, 2] + pri[:, 0]
+        xc = loc[:, 1] / self.ssd_xs * pri[:, 3] + pri[:, 1]
+        hh = np.exp(loc[:, 2] / self.ssd_hs) * pri[:, 2]
+        ww = np.exp(loc[:, 3] / self.ssd_ws) * pri[:, 3]
+        w_in, h_in = self.in_wh
+        x1 = (xc - ww / 2) * w_in
+        y1 = (yc - hh / 2) * h_in
+        x2 = (xc + ww / 2) * w_in
+        y2 = (yc + hh / 2) * h_in
+        probs = util.sigmoid(scores)
+        cls = probs.argmax(axis=1)
+        best = probs.max(axis=1)
+        keep = best >= self.ssd_thr
+        return np.stack(
+            [x1[keep], y1[keep], x2[keep], y2[keep], best[keep],
+             cls[keep].astype(np.float64)], axis=1)
+
+    def _detect_postprocess(self, tensors) -> np.ndarray:
+        """Already-decoded SSD head: [boxes [N,4] (ymin,xmin,ymax,xmax, 0..1),
+        classes [N], scores [N], count [1]]; option3 may remap tensor order
+        as ``%i:%i:%i:%i,%i`` (reference :68-75)."""
+        order = [0, 1, 2, 3]
+        if self.option3:
+            try:
+                nums = self.option3.replace(",", ":").split(":")
+                order = [int(n) for n in nums[:4]]
+            except ValueError:
+                pass
+        boxes = tensors[order[0]].reshape(-1, 4).astype(np.float64)
+        classes = tensors[order[1]].reshape(-1).astype(np.float64)
+        scores = tensors[order[2]].reshape(-1).astype(np.float64)
+        n = boxes.shape[0]
+        if len(tensors) > max(order[3], 3):
+            n = min(n, int(np.asarray(tensors[order[3]]).reshape(-1)[0]))
+        boxes, classes, scores = boxes[:n], classes[:n], scores[:n]
+        keep = scores >= 0.5
+        w_in, h_in = self.in_wh
+        ymin, xmin, ymax, xmax = (boxes[keep, i] for i in range(4))
+        return np.stack(
+            [xmin * w_in, ymin * h_in, xmax * w_in, ymax * h_in,
+             scores[keep], classes[keep]], axis=1)
+
+    def _detect_openvino(self, tensors) -> np.ndarray:
+        """[1,1,N,7] rows = (image_id, label, conf, xmin, ymin, xmax, ymax),
+        coords normalized 0..1 (reference ov_person_detection)."""
+        rows = tensors[0].reshape(-1, 7).astype(np.float64)
+        keep = (rows[:, 0] >= 0) & (rows[:, 2] >= 0.5)
+        rows = rows[keep]
+        w_in, h_in = self.in_wh
+        return np.stack(
+            [rows[:, 3] * w_in, rows[:, 4] * h_in,
+             rows[:, 5] * w_in, rows[:, 6] * h_in,
+             rows[:, 2], rows[:, 1]], axis=1)
+
+    def _detect_yolo(self, pred: np.ndarray, has_objectness: bool) -> np.ndarray:
+        """yolov5: [N, 5+C] (cx,cy,w,h,obj,cls...); yolov8: [4+C, N] or
+        [N, 4+C] (no objectness).  option3 = scaled:conf_thr:iou_thr
+        (reference :42-66)."""
+        parts = self.option3.split(":") if self.option3 else []
+        scaled_f, conf_thr, iou_thr = _floats(parts, [0.0, 0.25, 0.45])
+        self.ssd_iou = iou_thr  # reused by the NMS stage in decode()
+        pred = np.asarray(pred, dtype=np.float64)
+        pred = pred.reshape(-1, pred.shape[-1]) if pred.ndim > 2 else pred
+        if not has_objectness:
+            # yolov8 ships [4+C, N]; detect via label count when known,
+            # else assume candidates outnumber channels
+            ch = 4 + len(self.labels) if self.labels else None
+            if (ch is not None and pred.shape[0] == ch and pred.shape[1] != ch) \
+                    or (ch is None and pred.shape[0] < pred.shape[1]):
+                pred = pred.T
+        cx, cy, w, h = pred[:, 0], pred[:, 1], pred[:, 2], pred[:, 3]
+        if has_objectness:
+            conf = pred[:, 4:5] * pred[:, 5:]
+        else:
+            conf = pred[:, 4:]
+        cls = conf.argmax(axis=1)
+        score = conf.max(axis=1) if conf.size else np.zeros(pred.shape[0])
+        if int(scaled_f) == 0:  # normalized 0..1 coords -> input px
+            w_in, h_in = self.in_wh
+            cx, w = cx * w_in, w * w_in
+            cy, h = cy * h_in, h * h_in
+        keep = score >= conf_thr
+        return np.stack(
+            [(cx - w / 2)[keep], (cy - h / 2)[keep],
+             (cx + w / 2)[keep], (cy + h / 2)[keep],
+             score[keep], cls[keep].astype(np.float64)], axis=1)
+
+    def _detect_palm(self, tensors) -> np.ndarray:
+        """MediaPipe palm detection: [boxes [N,18], scores [N]]; SSD anchors
+        generated from stride config (reference mp_palm_detection_*)."""
+        if self._anchors is None:
+            self._anchors = _generate_palm_anchors(
+                self.in_wh, self.palm_strides, self.palm_min_scale,
+                self.palm_max_scale, self.palm_offset)
+        raw = tensors[0].reshape(-1, tensors[0].shape[-1]).astype(np.float64)
+        scores = util.sigmoid(tensors[1].reshape(-1).astype(np.float64))
+        anchors = self._anchors[: raw.shape[0]]
+        w_in, h_in = self.in_wh
+        cx = raw[:, 0] / w_in + anchors[:, 0]
+        cy = raw[:, 1] / h_in + anchors[:, 1]
+        ww = raw[:, 2] / w_in * anchors[:, 2]  # anchor scale from option3
+        hh = raw[:, 3] / h_in * anchors[:, 3]
+        keep = scores >= self.palm_thr
+        return np.stack(
+            [(cx - ww / 2)[keep] * w_in, (cy - hh / 2)[keep] * h_in,
+             (cx + ww / 2)[keep] * w_in, (cy + hh / 2)[keep] * h_in,
+             scores[keep], np.zeros(int(keep.sum()))], axis=1)
+
+
+def _load_box_priors(path: str) -> np.ndarray:
+    """box-priors.txt: 4 whitespace-separated rows (yc, xc, h, w) x P columns
+    (reference ``mobilenet_ssd_load_box_priors``)."""
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            vals = [float(v) for v in line.split()]
+            if vals:
+                rows.append(vals)
+    if len(rows) < 4:
+        raise ValueError(f"box priors file {path!r} needs 4 rows, got {len(rows)}")
+    return np.asarray(rows[:4], dtype=np.float64).T  # [P,4]
+
+
+def _generate_palm_anchors(in_wh: Tuple[int, int], strides, min_scale: float,
+                           max_scale: float, offset) -> np.ndarray:
+    """SSD anchor generation (MediaPipe ssd_anchors_calculator semantics):
+    per stride layer, a grid of (W/stride x H/stride) centers, 2 anchors each
+    for the repeated-stride layers."""
+    w_in, h_in = in_wh
+    anchors = []
+    n = len(strides)
+    for i, stride in enumerate(strides):
+        scale = (min_scale + (max_scale - min_scale) * i / max(1, n - 1))
+        reps = 2 if strides.count(stride) > 1 else 1
+        gw, gh = max(1, w_in // stride), max(1, h_in // stride)
+        ys, xs = np.meshgrid(np.arange(gh), np.arange(gw), indexing="ij")
+        cx = ((xs + offset[0]) / gw).reshape(-1)
+        cy = ((ys + offset[1]) / gh).reshape(-1)
+        for _ in range(reps):
+            anchors.append(np.stack([cx, cy,
+                                     np.full_like(cx, scale),
+                                     np.full_like(cy, scale)], axis=1))
+    return np.concatenate(anchors, axis=0)

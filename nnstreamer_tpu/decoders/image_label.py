@@ -16,11 +16,7 @@ import numpy as np
 
 from ..core.buffer import TensorFrame
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
-
-
-def load_labels(path: str) -> List[str]:
-    with open(path, "r", encoding="utf-8") as f:
-        return [line.strip() for line in f if line.strip()]
+from .util import load_labels
 
 
 class ImageLabeling:

@@ -1,0 +1,31 @@
+"""octet_stream decoder: tensors -> raw application/octet-stream bytes.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-octetstream.c`` —
+concatenates every tensor's bytes into one octet buffer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import FORMAT_FLEXIBLE, StreamSpec, TensorSpec
+
+
+class OctetStream:
+    NAME = "octet_stream"
+
+    def set_options(self, options) -> None:
+        pass
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        nbytes = in_spec.nbytes() if in_spec and in_spec.is_static else None
+        tensors = ((TensorSpec((nbytes,), np.uint8, "octets"),)
+                   if nbytes else ())
+        return StreamSpec(tensors, FORMAT_FLEXIBLE,
+                          in_spec.framerate if in_spec else None)
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        payload = b"".join(np.ascontiguousarray(np.asarray(t)).tobytes()
+                           for t in frame.tensors)
+        return frame.with_tensors([np.frombuffer(payload, np.uint8)])

@@ -1,0 +1,76 @@
+"""python3 decoder: user-scripted decode loaded from a .py file.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-python3.cc`` (393 LoC) —
+loads a user script whose class implements ``decode`` (and optionally
+``getOutCaps``).  Contract here:
+
+- option1: path to the script file
+- the script defines either a class ``CustomDecoder`` (methods
+  ``decode(self, tensors, meta) -> tensors-or-frame-dict`` and optionally
+  ``get_out_spec(self, in_spec)`` / ``set_options(self, options)``) or a
+  module-level function ``decode(tensors)``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import List
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+
+
+def _load_script(path: str):
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"python3 decoder script not found: {path}")
+    name = "nns_tpu_decoder_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class Python3Decoder:
+    NAME = "python3"
+
+    def __init__(self):
+        self._impl = None
+        self._fn = None
+
+    def set_options(self, options: List[str]) -> None:
+        if not options or not options[0]:
+            raise ValueError("python3 decoder requires option1=<script.py>")
+        mod = _load_script(options[0])
+        if hasattr(mod, "CustomDecoder"):
+            self._impl = mod.CustomDecoder()
+            if hasattr(self._impl, "set_options"):
+                self._impl.set_options(options[1:])
+        elif hasattr(mod, "decode"):
+            self._fn = mod.decode
+        else:
+            raise ValueError(
+                f"{options[0]}: defines neither CustomDecoder nor decode()")
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        if self._impl is not None and hasattr(self._impl, "get_out_spec"):
+            return self._impl.get_out_spec(in_spec)
+        return ANY
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        tensors = [np.asarray(t) for t in frame.tensors]
+        if self._impl is not None:
+            res = self._impl.decode(tensors, dict(frame.meta))
+        else:
+            res = self._fn(tensors)
+        if isinstance(res, TensorFrame):
+            return res
+        if isinstance(res, dict):  # {"tensors": [...], "meta": {...}}
+            out = frame.with_tensors([np.asarray(t) for t in res["tensors"]])
+            out.meta.update(res.get("meta", {}))
+            return out
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return frame.with_tensors([np.asarray(t) for t in res])

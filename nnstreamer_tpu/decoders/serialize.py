@@ -1,0 +1,53 @@
+"""flexbuf / flatbuf / protobuf decoders: tensors -> self-describing bytes.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-{flexbuf,flatbuf,
+protobuf}.cc`` — serialize an ``other/tensors`` frame into a framework-
+neutral byte schema so non-GStreamer consumers can parse it.
+
+TPU-native shape: all three modes share this framework's canonical wire
+format (``distributed/wire.py`` — the same schema the gRPC query/edge layer
+speaks, analog of ``nnstreamer.proto`` / ``nnstreamer.fbs``), tagged with a
+mode marker so the matching converter subplugin can round-trip.  Output is a
+single uint8 tensor carrying the encoded frame.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import FORMAT_FLEXIBLE, StreamSpec
+from ..distributed import wire
+
+
+class _SerializeBase:
+    NAME = "serialize"
+    MEDIA = "other/wire"
+
+    def set_options(self, options) -> None:
+        pass
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        return StreamSpec((), FORMAT_FLEXIBLE,
+                          in_spec.framerate if in_spec else None)
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        payload = wire.encode_frame(frame)
+        out = frame.with_tensors([np.frombuffer(payload, np.uint8)])
+        out.meta["media_type"] = self.MEDIA
+        return out
+
+
+class FlexbufDecoder(_SerializeBase):
+    NAME = "flexbuf"
+    MEDIA = "other/flexbuf"
+
+
+class FlatbufDecoder(_SerializeBase):
+    NAME = "flatbuf"
+    MEDIA = "other/flatbuf"
+
+
+class ProtobufDecoder(_SerializeBase):
+    NAME = "protobuf"
+    MEDIA = "other/protobuf-tensor"

@@ -48,6 +48,8 @@ class DataRepoSink(SinkElement):
             raise ElementError(f"{self.name}: datareposink needs location= and json=")
         self._file = open(self.props["location"], "wb")
         self._count = 0
+        self._specs = None  # re-derive the schema from the new run's frame 0
+        self._sample_size = 0
 
     def render(self, frame):
         arrays = [np.ascontiguousarray(np.asarray(t)) for t in frame.tensors]

@@ -58,6 +58,10 @@ class TensorTrainer(Element):
             ) from None
         self.backend = cls()
         self.backend.add_listener(self._on_event)
+        # reset run state so a restarted pipeline waits for the new run
+        self.training_complete.clear()
+        with self._stats_lock:
+            self._stats_pending = []
 
     def stop(self):
         if self.backend is not None:
@@ -84,10 +88,10 @@ class TensorTrainer(Element):
             self.training_complete.set()
 
     def _drain_stats(self):
-        if not self.srcpads or not self.srcpads[0].is_linked:
-            return []
         with self._stats_lock:
             pending, self._stats_pending = self._stats_pending, []
+        if not self.srcpads or not self.srcpads[0].is_linked:
+            return []  # terminal trainer: drop (don't accumulate) stats
         return [(0, TensorFrame([stats])) for stats in pending]
 
     def derive_spec(self, pad=0):
@@ -118,6 +122,9 @@ class TensorTrainer(Element):
                 self.backend.end_of_data()
             # wait for the training thread to finish + save (reference waits
             # on TRAINING_COMPLETION before EOS)
-            self.training_complete.wait(timeout=600)
+            if not self.training_complete.wait(timeout=600):
+                raise ElementError(
+                    f"{self.name}: training did not complete within 600s"
+                )
             self._check_backend_error()
         return self._drain_stats()

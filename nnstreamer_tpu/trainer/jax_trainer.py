@@ -96,7 +96,17 @@ class JaxTrainer(TrainerBackend):
                 pass
 
     def end_of_data(self) -> None:
-        self._put_sentinel()
+        # block-put like push_data: the consumer is still alive here, and a
+        # lossy put would drop a real sample from the final epoch
+        while not self._stop.is_set():
+            if self._thread is None or not self._thread.is_alive():
+                self._put_sentinel()
+                return
+            try:
+                self._q.put(None, timeout=0.2)
+                return
+            except queue.Full:
+                continue
 
     def stop(self) -> None:
         self._stop.set()
@@ -179,6 +189,14 @@ class JaxTrainer(TrainerBackend):
             self.error = e  # surfaced as a pipeline error by the element
             self.notify(EVENT_TRAINING_COMPLETION)
             return
+        try:
+            self._train_body(opt_state, train_step, eval_step)
+        except Exception as e:
+            log.exception("training failed")
+            self.error = e
+        self.notify(EVENT_TRAINING_COMPLETION)
+
+    def _train_body(self, opt_state, train_step, eval_step) -> None:
         n_in = int(self._props.get("num-inputs", 1))
         n_lab = int(self._props.get("num-labels", 1))
         n_train = int(self._props.get("num-training-samples", 0))
@@ -189,6 +207,31 @@ class JaxTrainer(TrainerBackend):
 
         epoch_samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
         done_epochs = 0
+
+        def run_epoch(train, valid):
+            nonlocal opt_state, done_epochs
+            losses, accs = [], []
+            for bx, by in self._batches(train, batch_size):
+                self.params, opt_state, loss, acc = train_step(
+                    self.params, opt_state, bx, by
+                )
+                losses.append(float(loss))
+                accs.append(float(acc))
+            vlosses, vaccs = [], []
+            for bx, by in self._batches(valid, batch_size) if valid else ():
+                loss, acc = eval_step(self.params, bx, by)
+                vlosses.append(float(loss))
+                vaccs.append(float(acc))
+            done_epochs += 1
+            self.status = TrainerStatus(
+                epoch_count=done_epochs,
+                training_loss=float(np.mean(losses)) if losses else 0.0,
+                training_accuracy=float(np.mean(accs)) if accs else 0.0,
+                validation_loss=float(np.mean(vlosses)) if vlosses else 0.0,
+                validation_accuracy=float(np.mean(vaccs)) if vaccs else 0.0,
+            )
+            self.notify(EVENT_EPOCH_COMPLETION)
+
         while not self._stop.is_set() and (epochs <= 0 or done_epochs < epochs):
             try:
                 frame = self._q.get(timeout=0.2)
@@ -200,35 +243,26 @@ class JaxTrainer(TrainerBackend):
             ys = [np.asarray(t) for t in frame.tensors[n_in : n_in + n_lab]]
             epoch_samples.append((xs, ys))
             if per_epoch and len(epoch_samples) >= per_epoch:
-                train = epoch_samples[:n_train]
-                valid = epoch_samples[n_train:per_epoch]
-                losses, accs = [], []
-                for bx, by in self._batches(train, batch_size):
-                    self.params, opt_state, loss, acc = train_step(
-                        self.params, opt_state, bx, by
-                    )
-                    losses.append(float(loss))
-                    accs.append(float(acc))
-                vlosses, vaccs = [], []
-                for bx, by in self._batches(valid, batch_size) if valid else ():
-                    loss, acc = eval_step(self.params, bx, by)
-                    vlosses.append(float(loss))
-                    vaccs.append(float(acc))
-                done_epochs += 1
-                self.status = TrainerStatus(
-                    epoch_count=done_epochs,
-                    training_loss=float(np.mean(losses)) if losses else 0.0,
-                    training_accuracy=float(np.mean(accs)) if accs else 0.0,
-                    validation_loss=float(np.mean(vlosses)) if vlosses else 0.0,
-                    validation_accuracy=float(np.mean(vaccs)) if vaccs else 0.0,
-                )
+                run_epoch(epoch_samples[:n_train], epoch_samples[n_train:per_epoch])
                 epoch_samples = []
-                self.notify(EVENT_EPOCH_COMPLETION)
+        if epoch_samples and not self._stop.is_set():
+            if per_epoch:
+                log.warning(
+                    "dropping %d leftover samples (incomplete epoch of %d)",
+                    len(epoch_samples), per_epoch,
+                )
+            else:
+                # num-training-samples unset: the whole stream is the dataset;
+                # honor epochs= by re-iterating it instead of silently saving
+                # the untrained init
+                for _ in range(max(1, epochs)):
+                    if self._stop.is_set():
+                        break
+                    run_epoch(epoch_samples, [])
         save_path = self._props.get("model-save-path")
         if save_path and self.params is not None:
             _save_params(save_path, self.params)
             log.info("model saved to %s", save_path)
-        self.notify(EVENT_TRAINING_COMPLETION)
 
 
 def _save_params(path: str, params) -> None:

@@ -1,0 +1,351 @@
+"""Decoder-subplugin suite tests.
+
+Mirrors the reference test strategy (SURVEY.md §4): each decoder mode gets
+synthetic tensors with a known answer, decoded output is checked for both the
+rendered overlay and the machine-readable meta.  Reference analogs:
+``tests/nnstreamer_decoder*/runTest.sh`` + decoder gtest cases.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import registry
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.types import ANY
+import nnstreamer_tpu.decoders  # noqa: F401 — registers decoder modes
+from nnstreamer_tpu.decoders import util
+
+
+def get_decoder(name):
+    cls = registry.get(registry.KIND_DECODER, name)
+    return cls()
+
+
+def frame(*tensors, **meta):
+    f = TensorFrame(tensors=[np.asarray(t) for t in tensors], pts=0.0)
+    f.meta.update(meta)
+    return f
+
+
+# -- util ---------------------------------------------------------------------
+
+def test_nms_suppresses_same_class_overlap():
+    dets = np.array([
+        [0, 0, 10, 10, 0.9, 1],
+        [1, 1, 11, 11, 0.8, 1],   # overlaps first, same class -> dropped
+        [1, 1, 11, 11, 0.7, 2],   # same box, other class -> kept
+        [50, 50, 60, 60, 0.6, 1], # far away -> kept
+    ])
+    out = util.nms(dets, iou_threshold=0.5)
+    assert out.shape[0] == 3
+    assert out[0, 4] == pytest.approx(0.9)
+
+
+def test_nms_empty():
+    assert util.nms(np.zeros((0, 6))).shape == (0, 6)
+
+
+def test_parse_wh():
+    assert util.parse_wh("640:480", (1, 1)) == (640, 480)
+    assert util.parse_wh("", (320, 240)) == (320, 240)
+    assert util.parse_wh(":480", (320, 240)) == (320, 480)
+
+
+def test_draw_rect_bounds():
+    c = util.blank_canvas(20, 10)
+    util.draw_rect(c, -5, -5, 30, 30, (255, 0, 0, 255))
+    assert c[0, 0, 0] == 255 and c[9, 19, 0] == 255
+
+
+# -- bounding_boxes -----------------------------------------------------------
+
+def _ssd_fixture(tmp_path, priors=4):
+    """Priors file + loc/score tensors putting one box at a known spot."""
+    pri = np.zeros((4, priors))
+    pri[0] = 0.5   # yc
+    pri[1] = 0.5   # xc
+    pri[2] = 0.4   # h
+    pri[3] = 0.4   # w
+    path = tmp_path / "priors.txt"
+    path.write_text("\n".join(" ".join(str(v) for v in row) for row in pri))
+    loc = np.zeros((priors, 4), np.float32)
+    scores = np.full((priors, 3), -10.0, np.float32)
+    scores[2, 1] = 10.0  # prior 2, class 1 confident
+    return str(path), loc, scores
+
+
+def test_bbox_mobilenet_ssd(tmp_path):
+    path, loc, scores = _ssd_fixture(tmp_path)
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["mobilenet-ssd", "", path, "600:600", "300:300",
+                     "", "", "", ""])
+    out = dec.decode(frame(loc, scores), ANY)
+    assert out.tensors[0].shape == (600, 600, 4)
+    boxes = out.meta["boxes"]
+    assert len(boxes) == 1
+    b = boxes[0]
+    assert b["class"] == 1
+    # prior box centered at (.5,.5) size .4 -> scaled x2: x=180 w=240
+    assert b["x"] == pytest.approx(180, abs=2)
+    assert b["w"] == pytest.approx(240, abs=3)
+
+
+def test_bbox_requires_priors():
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["mobilenet-ssd"] + [""] * 8)
+    with pytest.raises(ValueError):
+        dec.decode(frame(np.zeros((4, 4)), np.zeros((4, 2))), ANY)
+
+
+def test_bbox_unknown_mode_rejected():
+    dec = get_decoder("bounding_boxes")
+    with pytest.raises(ValueError):
+        dec.set_options(["not-a-mode"] + [""] * 8)
+
+
+def test_bbox_postprocess_mode():
+    boxes = np.array([[0.1, 0.2, 0.5, 0.6]], np.float32)  # ymin,xmin,ymax,xmax
+    classes = np.array([3.0], np.float32)
+    scores = np.array([0.9], np.float32)
+    count = np.array([1.0], np.float32)
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["mobilenet-ssd-postprocess", "", "", "100:100",
+                     "100:100", "", "", "", ""])
+    out = dec.decode(frame(boxes, classes, scores, count), ANY)
+    b = out.meta["boxes"][0]
+    assert b["class"] == 3
+    assert b["x"] == pytest.approx(20, abs=1)
+    assert b["y"] == pytest.approx(10, abs=1)
+    assert b["w"] == pytest.approx(40, abs=2)
+
+
+def test_bbox_yolov5():
+    # one row: cx,cy,w,h (normalized), objectness, 2 class scores
+    pred = np.array([[0.5, 0.5, 0.2, 0.2, 0.99, 0.1, 0.95],
+                     [0.1, 0.1, 0.05, 0.05, 0.01, 0.5, 0.5]], np.float32)
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["yolov5", "", "0:0.5:0.5", "320:320", "320:320",
+                     "", "", "", ""])
+    out = dec.decode(frame(pred), ANY)
+    boxes = out.meta["boxes"]
+    assert len(boxes) == 1
+    assert boxes[0]["class"] == 1
+    assert boxes[0]["x"] == pytest.approx(0.4 * 320, abs=1)
+
+
+def test_bbox_yolov8_transposed():
+    # yolov8 layout [4+C, N] without objectness
+    n = 10
+    pred = np.zeros((6, n), np.float32)
+    pred[:, 1] = [0.5, 0.5, 0.3, 0.3, 0.05, 0.9]  # col 1 is a strong det
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["yolov8", "", "0:0.5:0.5", "100:100", "100:100",
+                     "", "", "", ""])
+    out = dec.decode(frame(pred), ANY)
+    assert len(out.meta["boxes"]) == 1
+    assert out.meta["boxes"][0]["class"] == 1
+
+
+def test_bbox_openvino():
+    rows = np.array([[0, 1, 0.9, 0.1, 0.1, 0.3, 0.3],
+                     [-1, 0, 0.0, 0, 0, 0, 0]], np.float32).reshape(1, 1, 2, 7)
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["ov-person-detection", "", "", "200:200", "100:100",
+                     "", "", "", ""])
+    out = dec.decode(frame(rows), ANY)
+    assert len(out.meta["boxes"]) == 1
+    assert out.meta["boxes"][0]["x"] == pytest.approx(20, abs=1)
+
+
+def test_bbox_mp_palm():
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["mp-palm-detection", "", "0.5", "192:192", "192:192",
+                     "", "", "", ""])
+    anchors = dec._anchors = None  # force regeneration on decode
+    n = 2016  # 192/8=24^2*2 + 3 layers of 12^2*2... use whatever count
+    raw = np.zeros((8, 18), np.float32)
+    raw[0, :4] = [0.0, 0.0, 38.4, 38.4]  # w,h = 0.2 of input
+    scores = np.full((8,), -10.0, np.float32)
+    scores[0] = 5.0
+    out = dec.decode(frame(raw, scores), ANY)
+    assert len(out.meta["boxes"]) == 1
+    assert out.meta["boxes"][0]["score"] > 0.9
+
+
+def test_bbox_labels(tmp_path):
+    lf = tmp_path / "labels.txt"
+    lf.write_text("zero\none\ntwo\n")
+    boxes = np.array([[0.1, 0.1, 0.5, 0.5]], np.float32)
+    dec = get_decoder("bounding_boxes")
+    dec.set_options(["mobilenet-ssd-postprocess", str(lf), "", "100:100",
+                     "100:100", "", "", "", ""])
+    out = dec.decode(frame(boxes, np.array([2.0]), np.array([0.8]),
+                           np.array([1.0])), ANY)
+    assert out.meta["boxes"][0]["label"] == "two"
+
+
+# -- pose ---------------------------------------------------------------------
+
+def test_pose_heatmap_only():
+    k = 17
+    heat = np.full((9, 9, k), -10.0, np.float32)
+    for i in range(k):
+        heat[i % 9, (i * 2) % 9, i] = 10.0
+    dec = get_decoder("pose_estimation")
+    dec.set_options(["90:90", "90:90", "", "", "", "", "", "", ""])
+    out = dec.decode(frame(heat), ANY)
+    assert out.tensors[0].shape == (90, 90, 4)
+    kps = out.meta["keypoints"]
+    assert len(kps) == k
+    # keypoint 0 at grid (0,0) -> center of cell 0 = 5px
+    assert kps[0][0] == pytest.approx(5, abs=1)
+    assert all(s > 0.9 for _, _, s in kps)
+
+
+def test_pose_heatmap_offset():
+    k = 3
+    heat = np.full((5, 5, k), -10.0, np.float32)
+    heat[2, 2, :] = 10.0
+    off = np.zeros((5, 5, 2 * k), np.float32)
+    off[2, 2, :k] = 7.0   # y offsets
+    off[2, 2, k:] = -3.0  # x offsets
+    dec = get_decoder("pose_estimation")
+    dec.set_options(["100:100", "100:100", "", "heatmap-offset",
+                     "", "", "", "", ""])
+    out = dec.decode(frame(heat, off), ANY)
+    x, y, s = out.meta["keypoints"][0]
+    assert y == pytest.approx(2 / 4 * 100 + 7.0, abs=1)
+    assert x == pytest.approx(2 / 4 * 100 - 3.0, abs=1)
+
+
+def test_pose_bad_mode():
+    dec = get_decoder("pose_estimation")
+    with pytest.raises(ValueError):
+        dec.set_options(["", "", "", "nope", "", "", "", "", ""])
+
+
+# -- segment ------------------------------------------------------------------
+
+def test_segment_deeplab_argmax():
+    grid = np.zeros((4, 4, 3), np.float32)
+    grid[:2, :, 1] = 5.0  # top half class 1
+    grid[2:, :, 2] = 5.0  # bottom half class 2
+    dec = get_decoder("image_segment")
+    dec.set_options(["tflite-deeplab", "", "", "", "", "", "", "", ""])
+    out = dec.decode(frame(grid), ANY)
+    rgba = out.tensors[0]
+    assert rgba.shape == (4, 4, 4)
+    assert set(out.meta["classes_present"]) == {1, 2}
+    assert not np.array_equal(rgba[0, 0], rgba[3, 0])
+    assert rgba[0, 0, 3] == 160  # overlay alpha
+
+
+def test_segment_snpe_depth():
+    depth = np.linspace(0, 10, 16, dtype=np.float32).reshape(4, 4)
+    dec = get_decoder("image_segment")
+    dec.set_options(["snpe-depth", "", "", "", "", "", "", "", ""])
+    out = dec.decode(frame(depth), ANY)
+    rgba = out.tensors[0]
+    assert rgba[0, 0, 0] == 0 and rgba[3, 3, 0] == 255
+    assert out.meta["depth_range"] == [0.0, 10.0]
+
+
+# -- tensor_region ------------------------------------------------------------
+
+def test_tensor_region_pairs_with_crop(tmp_path):
+    path, loc, scores = _ssd_fixture(tmp_path)
+    dec = get_decoder("tensor_region")
+    dec.set_options(["2", "", path, "", "300:300", "", "", "", ""])
+    out = dec.decode(frame(loc, scores), ANY)
+    regions = out.tensors[0]
+    assert regions.dtype == np.int32
+    assert regions.shape[1] == 4
+    x, y, w, h = regions[0]
+    assert w > 0 and h > 0
+
+    # feed it into tensor_crop's math: crop region within bounds
+    img = np.zeros((300, 300, 3), np.uint8)
+    assert 0 <= x < 300 and 0 <= y < 300
+
+
+# -- octet / serialize / python3 ----------------------------------------------
+
+def test_octet_stream_concat():
+    a = np.arange(4, dtype=np.uint8)
+    b = np.arange(2, dtype=np.int16)
+    dec = get_decoder("octet_stream")
+    out = dec.decode(frame(a, b), ANY)
+    assert out.tensors[0].dtype == np.uint8
+    assert out.tensors[0].nbytes == a.nbytes + b.nbytes
+    assert bytes(out.tensors[0][:4]) == a.tobytes()
+
+
+@pytest.mark.parametrize("mode,media", [
+    ("flexbuf", "other/flexbuf"),
+    ("flatbuf", "other/flatbuf"),
+    ("protobuf", "other/protobuf-tensor"),
+])
+def test_serialize_roundtrip(mode, media):
+    from nnstreamer_tpu.distributed import wire
+    t = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+    dec = get_decoder(mode)
+    out = dec.decode(frame(t), ANY)
+    assert out.meta["media_type"] == media
+    back = wire.decode_frame(bytes(out.tensors[0]))
+    np.testing.assert_array_equal(np.asarray(back.tensors[0]), t)
+
+
+def test_python3_decoder(tmp_path):
+    script = tmp_path / "dec.py"
+    script.write_text(
+        "import numpy as np\n"
+        "class CustomDecoder:\n"
+        "    def decode(self, tensors, meta):\n"
+        "        return [tensors[0] * 2]\n"
+    )
+    dec = get_decoder("python3")
+    dec.set_options([str(script)] + [""] * 8)
+    out = dec.decode(frame(np.ones((2, 2), np.float32)), ANY)
+    np.testing.assert_array_equal(out.tensors[0], np.full((2, 2), 2.0))
+
+
+def test_python3_decoder_function_form(tmp_path):
+    script = tmp_path / "decfn.py"
+    script.write_text("def decode(tensors):\n    return [t + 1 for t in tensors]\n")
+    dec = get_decoder("python3")
+    dec.set_options([str(script)] + [""] * 8)
+    out = dec.decode(frame(np.zeros(3, np.int32)), ANY)
+    np.testing.assert_array_equal(out.tensors[0], np.ones(3, np.int32))
+
+
+def test_python3_decoder_missing_script():
+    dec = get_decoder("python3")
+    with pytest.raises((FileNotFoundError, ValueError)):
+        dec.set_options(["/nonexistent/x.py"] + [""] * 8)
+
+
+# -- pipeline integration -----------------------------------------------------
+
+def test_decoder_element_bounding_boxes_in_pipeline(tmp_path):
+    """Full pipeline: appsrc -> tensor_decoder mode=bounding_boxes."""
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    boxes = np.array([[0.0, 0.0, 0.5, 0.5]], np.float32)
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd-postprocess "
+        "option4=64:64 option5=64:64 ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([boxes, np.array([1.0], np.float32),
+                      np.array([0.9], np.float32), np.array([1.0], np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    got = pipe["out"].frames
+    assert len(got) == 1
+    assert got[0].tensors[0].shape == (64, 64, 4)
+    assert got[0].meta["boxes"][0]["class"] == 1
