@@ -1,0 +1,82 @@
+"""tflite filter backend (gated): run .tflite models via an available
+TFLite runtime.
+
+Reference: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc``
+(1677 LoC — TFLiteInterpreter/TFLiteCore, delegates, double-buffered
+reload).  This image ships no TensorFlow/TFLite runtime, so this backend
+*gates*: it registers (so ``framework=auto`` extension priority works and
+pipelines fail with a clear message) and activates only when
+``tflite_runtime`` or ``tensorflow.lite`` is importable — mirroring the
+reference's practice of skipping gracefully when a subplugin .so is absent
+(SURVEY §4: tests skip if the .so or model is missing).
+
+For TPU execution of converted models, export to a jax callable and use
+``framework=jax-xla`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .base import FilterBackend
+
+
+def _find_interpreter():
+    try:
+        from tflite_runtime.interpreter import Interpreter  # type: ignore
+        return Interpreter
+    except ImportError:
+        pass
+    try:
+        from tensorflow.lite import Interpreter  # type: ignore
+        return Interpreter
+    except ImportError:
+        return None
+
+
+class TFLiteImportBackend(FilterBackend):
+    NAME = "tflite"
+
+    def __init__(self):
+        super().__init__()
+        self._interp = None
+
+    @staticmethod
+    def available() -> bool:
+        return _find_interpreter() is not None
+
+    def open(self, model_path: Optional[str], props: Dict[str, Any]) -> None:
+        super().open(model_path, props)
+        Interpreter = _find_interpreter()
+        if Interpreter is None:
+            raise RuntimeError(
+                "tflite backend: no TFLite runtime in this environment "
+                "(install tflite_runtime, or convert the model and use "
+                "framework=jax-xla)")
+        self._interp = Interpreter(model_path=model_path)
+        self._interp.allocate_tensors()
+
+    def close(self) -> None:
+        self._interp = None
+
+    def _specs(self, details) -> StreamSpec:
+        return StreamSpec(
+            tuple(TensorSpec(tuple(int(x) for x in d["shape"]), d["dtype"])
+                  for d in details),
+            FORMAT_STATIC,
+        )
+
+    def get_model_info(self) -> Tuple[Optional[StreamSpec], Optional[StreamSpec]]:
+        return (self._specs(self._interp.get_input_details()),
+                self._specs(self._interp.get_output_details()))
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        ins = self._interp.get_input_details()
+        for d, a in zip(ins, inputs):
+            self._interp.set_tensor(d["index"], np.asarray(a, d["dtype"]))
+        self._interp.invoke()
+        return [self._interp.get_tensor(d["index"])
+                for d in self._interp.get_output_details()]

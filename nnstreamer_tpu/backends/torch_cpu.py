@@ -1,0 +1,71 @@
+"""torch filter backend: TorchScript models on CPU.
+
+Reference: ``ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc`` (774
+LoC) — loads a TorchScript archive, maps tensors in/out, optional GPU via
+ini.  Here: CPU-only (the image ships torch-cpu; TPU compute belongs to the
+jax-xla backend — use torch for importing legacy models, not the hot path).
+
+``model=<file.pt>`` must be a ``torch.jit.save`` archive.  Output schema is
+derived by probing with zeros (≙ the reference requiring input caps and
+running shape inference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .base import FilterBackend
+
+
+class TorchBackend(FilterBackend):
+    NAME = "torch"
+
+    def __init__(self):
+        super().__init__()
+        self._module = None
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.hw_list = ("cpu",)
+        return info
+
+    def open(self, model_path: Optional[str], props: Dict[str, Any]) -> None:
+        super().open(model_path, props)
+        import torch
+
+        if not model_path:
+            raise ValueError("torch backend requires model=<file.pt>")
+        self._module = torch.jit.load(model_path, map_location="cpu")
+        self._module.eval()
+
+    def close(self) -> None:
+        self._module = None
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        zeros = [np.zeros(t.shape, t.dtype) for t in in_spec.tensors]
+        outs = self.invoke(zeros)
+        return StreamSpec(
+            tuple(TensorSpec(o.shape, o.dtype) for o in outs),
+            FORMAT_STATIC,
+            in_spec.framerate,
+        )
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        import torch
+
+        with torch.inference_mode():
+            ins = [torch.from_numpy(np.ascontiguousarray(np.asarray(a)))
+                   for a in inputs]
+            out = self._module(*ins)
+        if isinstance(out, (list, tuple)):
+            outs = list(out)
+        else:
+            outs = [out]
+        return [o.detach().cpu().numpy() for o in outs]
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        # TorchScript modules are batch-polymorphic on the leading dim
+        return self.invoke(inputs)

@@ -1,0 +1,12 @@
+"""Converter subplugins (≙ ext/nnstreamer/tensor_converter/).
+
+Importing registers every external converter in the subplugin registry
+(kind "converter"); ``tensor_converter mode=custom:<name>`` selects one.
+"""
+
+from ..core import registry
+
+registry.register_lazy(registry.KIND_CONVERTER, "flexbuf", "nnstreamer_tpu.converters.serialize:FlexbufConverter")
+registry.register_lazy(registry.KIND_CONVERTER, "flatbuf", "nnstreamer_tpu.converters.serialize:FlatbufConverter")
+registry.register_lazy(registry.KIND_CONVERTER, "protobuf", "nnstreamer_tpu.converters.serialize:ProtobufConverter")
+registry.register_lazy(registry.KIND_CONVERTER, "python3", "nnstreamer_tpu.converters.python3:Python3Converter")

@@ -1,0 +1,81 @@
+"""python3 converter: user-scripted media -> tensors conversion.
+
+Reference: ``ext/nnstreamer/tensor_converter/tensor_converter_python3.cc``
+(user script with a ``convert`` method).  Contract: the script (path given
+via the element's ``script`` custom property or set_options) defines either
+a class ``CustomConverter`` (method ``convert(self, payload, meta) ->
+tensors``) or a module-level ``convert(payload)``.
+
+Select with ``tensor_converter mode=custom-script:python3`` and configure
+the script path with ``set_script`` before start, or register your own
+converter class directly via the registry.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+
+_SCRIPT_ENV = "NNS_TPU_CONVERTER_SCRIPT"
+
+
+def _load_script(path: str):
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"python3 converter script not found: {path}")
+    name = "nns_tpu_converter_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class Python3Converter:
+    NAME = "python3"
+
+    def __init__(self, script: str = ""):
+        self._script = script
+        self._impl = None
+        self._fn = None
+
+    def set_script(self, path: str) -> None:
+        self._script = path
+
+    def open(self) -> None:
+        path = self._script or os.environ.get(_SCRIPT_ENV, "")
+        if not path:
+            raise ValueError(
+                "python3 converter needs a script (set_script or "
+                f"${_SCRIPT_ENV})")
+        mod = _load_script(path)
+        if hasattr(mod, "CustomConverter"):
+            self._impl = mod.CustomConverter()
+        elif hasattr(mod, "convert"):
+            self._fn = mod.convert
+        else:
+            raise ValueError(
+                f"{path}: defines neither CustomConverter nor convert()")
+
+    def close(self) -> None:
+        self._impl = self._fn = None
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        if self._impl is not None and hasattr(self._impl, "get_out_spec"):
+            return self._impl.get_out_spec(in_spec)
+        return ANY
+
+    def convert(self, frame: TensorFrame) -> TensorFrame:
+        payload = frame.tensors[0]
+        if self._impl is not None:
+            res = self._impl.convert(payload, dict(frame.meta))
+        else:
+            res = self._fn(payload)
+        if isinstance(res, TensorFrame):
+            return res
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return frame.with_tensors([np.asarray(t) for t in res])

@@ -1,0 +1,46 @@
+"""flexbuf / flatbuf / protobuf converters: serialized bytes -> tensors.
+
+Reference: ``ext/nnstreamer/tensor_converter/tensor_converter_{flexbuf,
+flatbuf,protobuf}.cc`` — parse a framework-neutral byte schema back into an
+``other/tensors`` frame; the exact inverse of the same-named decoder
+subplugins (decoders/serialize.py).  All three modes share this framework's
+canonical wire format (``distributed/wire.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..distributed import wire
+
+
+class _DeserializeBase:
+    NAME = "deserialize"
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        return ANY  # per-payload shapes; known only after decode
+
+    def convert(self, frame: TensorFrame) -> TensorFrame:
+        t = frame.tensors[0]
+        payload = bytes(t) if isinstance(t, (bytes, bytearray, memoryview)) \
+            else np.ascontiguousarray(np.asarray(t)).tobytes()
+        decoded = wire.decode_frame(payload)
+        out = frame.with_tensors(list(decoded.tensors))
+        for k, v in decoded.meta.items():
+            out.meta.setdefault(k, v)
+        out.meta.pop("media_type", None)  # now a plain tensor stream again
+        return out
+
+
+class FlexbufConverter(_DeserializeBase):
+    NAME = "flexbuf"
+
+
+class FlatbufConverter(_DeserializeBase):
+    NAME = "flatbuf"
+
+
+class ProtobufConverter(_DeserializeBase):
+    NAME = "protobuf"

@@ -130,5 +130,6 @@ def framework_priority(model_ext: str) -> List[str]:
         "pt": ["torch"],
         "pth": ["torch"],
         "py": ["python3"],
+        "so": ["custom"],
     }
     return defaults.get(ext, [])

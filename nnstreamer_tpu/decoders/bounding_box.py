@@ -201,8 +201,8 @@ class BoundingBoxes:
         order = [0, 1, 2, 3]
         if self.option3:
             try:
-                nums = self.option3.replace(",", ":").split(":")
-                order = [int(n) for n in nums[:4]]
+                nums = [int(n) for n in self.option3.replace(",", ":").split(":")]
+                order[: len(nums[:4])] = nums[:4]  # partial lists keep defaults
             except ValueError:
                 pass
         boxes = tensors[order[0]].reshape(-1, 4).astype(np.float64)
@@ -252,8 +252,10 @@ class BoundingBoxes:
             conf = pred[:, 4:5] * pred[:, 5:]
         else:
             conf = pred[:, 4:]
+        if conf.size == 0:  # no class columns: nothing to detect
+            return np.zeros((0, 6))
         cls = conf.argmax(axis=1)
-        score = conf.max(axis=1) if conf.size else np.zeros(pred.shape[0])
+        score = conf.max(axis=1)
         if int(scaled_f) == 0:  # normalized 0..1 coords -> input px
             w_in, h_in = self.in_wh
             cx, w = cx * w_in, w * w_in
@@ -310,7 +312,9 @@ def _generate_palm_anchors(in_wh: Tuple[int, int], strides, min_scale: float,
     n = len(strides)
     for i, stride in enumerate(strides):
         scale = (min_scale + (max_scale - min_scale) * i / max(1, n - 1))
-        reps = 2 if strides.count(stride) > 1 else 1
+        # MediaPipe emits 2 anchors per location on every layer (aspect 1.0
+        # + the interpolated-scale anchor)
+        reps = 2
         gw, gh = max(1, w_in // stride), max(1, h_in // stride)
         ys, xs = np.meshgrid(np.arange(gh), np.arange(gw), indexing="ij")
         cx = ((xs + offset[0]) / gw).reshape(-1)

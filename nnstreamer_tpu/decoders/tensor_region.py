@@ -8,7 +8,8 @@ preserved (reference header :17-33):
 - option2: label file (carried to meta)
 - option3: priors.txt[:thr:y_scale:x_scale:h_scale:w_scale:iou] — identical
   scheme to the bounding_boxes mobilenet-ssd mode
-- option4/5: output / input dimension ``WIDTH:HEIGHT``
+- option4: video *input* dimension ``WIDTH:HEIGHT`` (default 300:300;
+  reference :40 — regions are emitted in input coordinates)
 
 Output: int32 tensor [num_regions, 4] = (x, y, w, h) — exactly the crop-info
 stream ``tensor_crop`` (elements/flow.py) consumes on its second sink pad.
@@ -43,8 +44,10 @@ class TensorRegion:
                 pass
         if o[1]:
             self.labels = util.load_labels(o[1])
-        # delegate: mode=mobilenet-ssd, option3 scheme shared verbatim
-        self._bb.set_options(["mobilenet-ssd", "", o[2], o[3], o[4]])
+        # delegate: mode=mobilenet-ssd, option3 scheme shared verbatim;
+        # option4 here is the INPUT dims (reference :40) — regions stay in
+        # input coordinates for tensor_crop
+        self._bb.set_options(["mobilenet-ssd", "", o[2], "", o[3]])
 
     def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
         return StreamSpec(

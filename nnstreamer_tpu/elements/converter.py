@@ -34,6 +34,7 @@ from ..core.types import (
     unpack_flex_header,
 )
 from ..pipeline.element import Element, ElementError, Property, element
+from .. import converters as _converters  # noqa: F401 — registers subplugins
 
 
 @element("tensor_converter")
@@ -58,8 +59,19 @@ class TensorConverter(Element):
             kind, _, sub = mode.partition(":")
             if kind not in ("custom", "custom-code", "custom-script"):
                 raise ElementError(f"{self.name}: unknown converter mode {mode!r}")
-            cls = registry.get(registry.KIND_CONVERTER, sub)
-            self._sub = cls() if isinstance(cls, type) else cls
+            if sub.endswith(".py"):
+                # reference dialect: mode=custom-script:<script.py>
+                from ..converters.python3 import Python3Converter
+                self._sub = Python3Converter(script=sub)
+            else:
+                # registry name (e.g. custom-script:python3 + env script)
+                try:
+                    cls = registry.get(registry.KIND_CONVERTER, sub)
+                except KeyError:
+                    raise ElementError(
+                        f"{self.name}: unknown converter subplugin {sub!r}"
+                    ) from None
+                self._sub = cls() if isinstance(cls, type) else cls
             if hasattr(self._sub, "open"):
                 self._sub.open()
 

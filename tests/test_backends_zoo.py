@@ -1,0 +1,265 @@
+"""Backend-zoo + converter-subplugin tests.
+
+Mirrors the reference's parameterized filter-subplugin template
+(``tests/nnstreamer_filter_extensions_common/unittest_tizen_template.cc.in``:
+checkExistence, openClose_n, invoke, setDimension...) for the python3,
+torch, custom-native, and tflite(gated) backends, plus the converter
+subplugins that invert the serialize decoders.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends import find_backend
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
+from nnstreamer_tpu.pipeline import parse_pipeline
+import nnstreamer_tpu.converters  # noqa: F401
+
+
+# -- python3 backend ----------------------------------------------------------
+
+SCALER_SCRIPT = """
+import numpy as np
+
+class CustomFilter:
+    def set_options(self, custom):
+        self.mult = float(custom.get("mult", 2.0))
+    def invoke(self, inputs):
+        return [np.asarray(a, np.float32) * self.mult for a in inputs]
+"""
+
+
+@pytest.fixture
+def py_scaler(tmp_path):
+    p = tmp_path / "scaler.py"
+    p.write_text(SCALER_SCRIPT)
+    return str(p)
+
+
+def test_python3_backend_existence():
+    assert find_backend("python3") is not None
+
+
+def test_python3_backend_invoke(py_scaler):
+    be = find_backend("python3")()
+    be.open(py_scaler, {"custom": "mult:3"})
+    out = be.invoke([np.ones((2, 2), np.float32)])
+    np.testing.assert_allclose(out[0], 3.0)
+    be.close()
+
+
+def test_python3_backend_set_input_info(py_scaler):
+    be = find_backend("python3")()
+    be.open(py_scaler, {})
+    spec = StreamSpec((TensorSpec((4, 4), np.float32),), FORMAT_STATIC)
+    out_spec = be.set_input_info(spec)
+    assert out_spec.tensors[0].shape == (4, 4)
+    be.close()
+
+
+def test_python3_backend_open_missing_n():
+    be = find_backend("python3")()
+    with pytest.raises(FileNotFoundError):
+        be.open("/nonexistent/f.py", {})
+
+
+def test_python3_backend_in_pipeline(py_scaler):
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        f"tensor_filter framework=python3 model={py_scaler} custom=mult:4 ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([np.full((3,), 2.0, np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], 8.0)
+
+
+def test_python3_auto_detect(py_scaler):
+    # framework=auto + .py extension resolves to python3
+    pipe = parse_pipeline(
+        f"appsrc name=src ! tensor_filter model={py_scaler} ! tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([np.ones((2,), np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], 2.0)
+
+
+# -- torch backend ------------------------------------------------------------
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def torchscript_model(tmp_path_factory):
+    class AddOne(torch.nn.Module):
+        def forward(self, x):
+            return x + 1.0
+
+    path = tmp_path_factory.mktemp("torch") / "addone.pt"
+    torch.jit.script(AddOne()).save(str(path))
+    return str(path)
+
+
+def test_torch_backend_invoke(torchscript_model):
+    be = find_backend("torch")()
+    be.open(torchscript_model, {})
+    out = be.invoke([np.zeros((2, 3), np.float32)])
+    np.testing.assert_allclose(out[0], 1.0)
+    be.close()
+
+
+def test_torch_backend_set_input_info(torchscript_model):
+    be = find_backend("torch")()
+    be.open(torchscript_model, {})
+    out_spec = be.set_input_info(
+        StreamSpec((TensorSpec((5,), np.float32),), FORMAT_STATIC))
+    assert out_spec.tensors[0].shape == (5,)
+    assert out_spec.tensors[0].dtype == np.float32
+    be.close()
+
+
+def test_torch_backend_in_pipeline_auto(torchscript_model):
+    pipe = parse_pipeline(
+        f"appsrc name=src ! tensor_filter model={torchscript_model} ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([np.full((4,), 2.0, np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], 3.0)
+
+
+# -- tflite backend (gated) ---------------------------------------------------
+
+def test_tflite_backend_gates_cleanly():
+    from nnstreamer_tpu.backends.tflite_import import TFLiteImportBackend
+    be = TFLiteImportBackend()
+    if TFLiteImportBackend.available():
+        pytest.skip("tflite runtime present; gating path not applicable")
+    with pytest.raises(RuntimeError, match="no TFLite runtime"):
+        be.open("model.tflite", {})
+
+
+# -- custom native (.so over the C ABI) --------------------------------------
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "nnstreamer_tpu", "native", "examples")
+
+
+@pytest.fixture(scope="module")
+def scaler_so(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    build = tmp_path_factory.mktemp("native")
+    so = build / "libscaler.so"
+    inc = os.path.join(os.path.dirname(_EXAMPLES), "include")
+    subprocess.run(
+        ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", f"-I{inc}",
+         os.path.join(_EXAMPLES, "scaler_custom.cc"), "-o", str(so)],
+        check=True)
+    return str(so)
+
+
+def test_custom_native_invoke(scaler_so):
+    be = find_backend("custom")()
+    be.open(scaler_so, {"custom": "mult:2.5"})
+    spec = StreamSpec((TensorSpec((8,), np.float32),), FORMAT_STATIC)
+    out_spec = be.set_input_info(spec)
+    assert out_spec.tensors[0].shape == (8,)
+    out = be.invoke([np.full((8,), 2.0, np.float32)])
+    np.testing.assert_allclose(out[0], 5.0)
+    be.close()
+
+
+def test_custom_native_non_float_passthrough(scaler_so):
+    be = find_backend("custom")()
+    be.open(scaler_so, {"custom": "mult:3"})
+    spec = StreamSpec((TensorSpec((4,), np.int32),), FORMAT_STATIC)
+    be.set_input_info(spec)
+    data = np.arange(4, dtype=np.int32)
+    out = be.invoke([data])
+    np.testing.assert_array_equal(out[0], data)
+    be.close()
+
+
+def test_custom_native_in_pipeline_auto(scaler_so):
+    # .so extension auto-detects the custom backend
+    pipe = parse_pipeline(
+        f"appsrc name=src ! tensor_filter model={scaler_so} custom=mult:10 ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([np.ones((2, 2), np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], 10.0)
+
+
+def test_custom_native_missing_so_n():
+    be = find_backend("custom")()
+    with pytest.raises(FileNotFoundError):
+        be.open("/nonexistent/lib.so", {})
+
+
+# -- converter subplugins -----------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["flexbuf", "flatbuf", "protobuf"])
+def test_serialize_deserialize_pipeline_roundtrip(mode):
+    """decoder(serialize) ! converter(deserialize) recovers the stream."""
+    t = np.random.default_rng(1).normal(size=(2, 3)).astype(np.float32)
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        f"tensor_decoder mode={mode} ! "
+        f"tensor_converter mode=custom:{mode} ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([t])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    got = pipe["out"].frames[0].tensors[0]
+    np.testing.assert_array_equal(np.asarray(got), t)
+
+
+def test_python3_converter_script(tmp_path):
+    script = tmp_path / "conv.py"
+    script.write_text(
+        "import numpy as np\n"
+        "def convert(payload):\n"
+        "    return [np.asarray(payload, np.float32).reshape(2, -1)]\n"
+    )
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        f"tensor_converter mode=custom-script:{script} ! "
+        "tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push([np.arange(6, dtype=np.float32)])
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    assert pipe["out"].frames[0].tensors[0].shape == (2, 3)
+
+
+def test_converter_unknown_subplugin_n():
+    pipe = parse_pipeline(
+        "appsrc name=src ! tensor_converter mode=custom:nope ! tensor_sink")
+    with pytest.raises(Exception, match="unknown converter subplugin"):
+        pipe.start()
+    pipe.stop()
