@@ -133,16 +133,29 @@ class Collator:
         if not active or not all(self.queues[i] for i in active):
             return None
         base = max(_pts(self.queues[i][0]) for i in active)
-        out: List[Optional[TensorFrame]] = [None] * self.num_pads
+        # faster pads drop frames older than base; if that empties a live
+        # pad, wait for fresh data (don't pair a stale frame — reference
+        # drops and returns "need more", nnstreamer_plugin_api_impl.c:101-533)
+        for i in active:
+            q = self.queues[i]
+            while q and _pts(q[0]) < base:
+                q.popleft()
+            if not q and not self.eos[i]:
+                return None
+        # decide the full set before popping anything: a pad whose head is
+        # newer than base must fall back to its last frame, and if it has
+        # none the whole set is not ready — no partial consumption.
+        pops = []
         for i in range(self.num_pads):
             q = self.queues[i]
-            # faster pads drop frames older than base, keeping the newest <= base
-            while len(q) > 1 and _pts(q[1]) <= base:
-                q.popleft()
             if q and _pts(q[0]) <= base:
-                self.last[i] = q.popleft()
-            if self.last[i] is None:
+                pops.append(i)
+            elif self.last[i] is None:
                 return None
+        out: List[Optional[TensorFrame]] = [None] * self.num_pads
+        for i in range(self.num_pads):
+            if i in pops:
+                self.last[i] = self.queues[i].popleft()
             out[i] = self.last[i]
         return [f for f in out if f is not None]
 

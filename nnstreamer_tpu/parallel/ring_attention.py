@@ -27,6 +27,15 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 
+def vary_over(x, axes):
+    """Mark a constant as device-varying over manual mesh axes (shard_map
+    vma typing; pcast on jax >= 0.8, pvary before)."""
+    try:
+        return lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):  # pragma: no cover — older jax
+        return lax.pvary(x, axes)
+
+
 def _block_attn(q, k, v, q_pos, k_pos, causal: bool, scale: float):
     """One (q-block × kv-block) attention contribution.
 
@@ -73,16 +82,10 @@ def _ring_attn_local(q, k, v, *, axis_name: str, all_axes, causal: bool):
     q_pos = my_idx * T + jnp.arange(T)
 
     # constants entering the scan carry must be marked device-varying over
-    # the manual mesh axes (shard_map vma typing, jax >= 0.8)
-    def _vary(x):
-        try:
-            return lax.pcast(x, all_axes, to="varying")
-        except (AttributeError, TypeError):  # older jax spells it pvary
-            return lax.pvary(x, all_axes)
-
-    m0 = _vary(jnp.full((B, H, T), -jnp.inf, jnp.float32))
-    o0 = _vary(jnp.zeros(q.shape, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
+    # the manual mesh axes (shard_map vma typing)
+    m0 = vary_over(jnp.full((B, H, T), -jnp.inf, jnp.float32), all_axes)
+    o0 = vary_over(jnp.zeros(q.shape, jnp.float32), all_axes)
+    l0 = vary_over(jnp.zeros((B, H, T), jnp.float32), all_axes)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def step(carry, i):

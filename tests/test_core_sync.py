@@ -57,6 +57,19 @@ class TestSlowest:
         c.push(0, frame(0, 0.0))
         assert c.collect() is None
 
+    def test_incremental_arrival_waits_for_fresh_frame(self):
+        # regression: stale head must be dropped and the collator must WAIT,
+        # not pair the stale frame with the newer base (arrival-order race)
+        c = Collator(2, SyncPolicy(SLOWEST))
+        c.push(0, frame(0, 0.0))
+        c.push(1, frame(100, 0.2))
+        assert c.collect() is None  # 0.0 dropped, pad0 must refill
+        c.push(0, frame(1, 0.1))
+        assert c.collect() is None
+        c.push(0, frame(2, 0.2))
+        out = c.collect()
+        assert [val(f) for f in out] == [2, 100]
+
 
 class TestBasepad:
     def test_base_drives_output(self):

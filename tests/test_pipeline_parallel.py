@@ -1,0 +1,77 @@
+"""5-axis (dp/tp/sp/pp/ep) manual-SPMD transformer training step.
+
+Runs on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8).  Correctness oracle: the
+unsharded reference_loss over the same param pytree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.parallel.mesh import make_mesh
+from nnstreamer_tpu.parallel.pipeline_transformer import (
+    PipelineConfig,
+    init_params,
+    make_pipeline_train_step,
+    reference_loss,
+)
+
+
+def _tokens(cfg, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, cfg.vocab, (batch, cfg.max_seq)), jnp.int32
+    )
+
+
+def _run_and_compare(mesh_axes, cfg, batch):
+    import math
+
+    n = math.prod(mesh_axes.values())
+    mesh = make_mesh(mesh_axes, devices=jax.devices()[:n])
+    step, params, opt, data_sh = make_pipeline_train_step(mesh, cfg)
+    toks = jax.device_put(_tokens(cfg, batch), data_sh)
+    p2, opt2, loss = step(params, opt, toks)
+    ref = reference_loss(init_params(cfg), _tokens(cfg, batch), cfg)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
+    # second step must also run (exercises donated buffers + updated params)
+    _, _, loss2 = step(p2, opt2, toks)
+    assert np.isfinite(float(loss2))
+    return float(loss), float(loss2)
+
+
+class TestPipelineParallel:
+    def test_pp_sp_tp(self):
+        cfg = PipelineConfig(n_layers=2, n_experts=0, n_microbatches=2)
+        l1, l2 = _run_and_compare(
+            {"dp": 1, "pp": 2, "sp": 2, "tp": 2, "ep": 1}, cfg, batch=4
+        )
+        assert l2 < l1  # one adamw step reduces loss on the same batch
+
+    def test_dp_pp_ep_moe(self):
+        # capacity_factor high enough that no token drops => exact oracle
+        cfg = PipelineConfig(
+            n_layers=2, n_experts=4, n_microbatches=2, capacity_factor=8.0
+        )
+        _run_and_compare(
+            {"dp": 2, "pp": 2, "sp": 1, "tp": 1, "ep": 2}, cfg, batch=4
+        )
+
+    def test_all_axes_single_device(self):
+        cfg = PipelineConfig(n_layers=2, n_experts=2, n_microbatches=2,
+                             capacity_factor=8.0)
+        _run_and_compare(
+            {"dp": 1, "pp": 1, "sp": 1, "tp": 1, "ep": 1}, cfg, batch=2
+        )
+
+    def test_moe_capacity_drop_runs(self):
+        # tight capacity: tokens drop (not oracle-exact) but must stay finite
+        cfg = PipelineConfig(n_layers=2, n_experts=4, n_microbatches=1,
+                             capacity_factor=1.0)
+        mesh = make_mesh({"dp": 1, "pp": 2, "sp": 2, "tp": 1, "ep": 2})
+        step, params, opt, data_sh = make_pipeline_train_step(mesh, cfg)
+        toks = jax.device_put(_tokens(cfg, 2), data_sh)
+        _, _, loss = step(params, opt, toks)
+        assert np.isfinite(float(loss))
