@@ -13,3 +13,15 @@ from .core import (  # noqa: F401
     TensorSpec,
     TensorFrame,
 )
+
+
+def __getattr__(name):  # lazy: avoid importing jax at package import
+    if name == "SingleShot":
+        from .elements.filter import SingleShot
+
+        return SingleShot
+    if name == "parse_pipeline":
+        from .pipeline import parse_pipeline
+
+        return parse_pipeline
+    raise AttributeError(name)

@@ -1,0 +1,3 @@
+"""L6 tools (SURVEY §2.8): launch (gst-launch analog), confchk
+(nnstreamer-check analog), pbtxt converter (tools/development/parser
+analog), custom-filter codegen (nnstreamerCodeGenCustomFilter.py analog)."""

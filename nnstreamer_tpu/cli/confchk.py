@@ -1,0 +1,56 @@
+"""nns-tpu-check: dump installed elements, subplugins, and configuration.
+
+≙ the reference's ``nnstreamer-check`` / confchk CLI
+(``tools/development/confchk/confchk.c``): prints what is registered per
+subplugin kind and where the active configuration came from.
+
+CLI: ``python -m nnstreamer_tpu.cli.confchk``
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from ..core import config, registry
+
+
+def report() -> str:
+    # importing the element/subplugin packages triggers self-registration
+    from .. import backends as _b  # noqa: F401
+    from .. import converters as _c  # noqa: F401
+    from .. import decoders as _d  # noqa: F401
+    from .. import elements as _e  # noqa: F401
+    from ..pipeline.element import ELEMENT_TYPES
+
+    lines: List[str] = []
+    lines.append("nnstreamer_tpu configuration check")
+    lines.append("=" * 40)
+    import jax
+
+    lines.append(f"jax backend devices : {[str(d) for d in jax.devices()]}")
+    lines.append(f"config loaded from  : {config.loaded_from() or '(defaults)'}")
+    lines.append("")
+    factories = sorted(set(ELEMENT_TYPES))
+    lines.append(f"pipeline elements ({len(factories)}):")
+    for n in factories:
+        cls = ELEMENT_TYPES[n]
+        alias = "" if cls.FACTORY_NAME == n else f"  (alias of {cls.FACTORY_NAME})"
+        lines.append(f"  {n}{alias}")
+    for kind in registry.KINDS:
+        names = sorted(registry.get_all(kind))
+        lines.append("")
+        lines.append(f"{kind} subplugins ({len(names)}):")
+        for n in names:
+            desc = registry.get_custom_property_desc(kind, n)
+            lines.append(f"  {n}" + (f"  {desc}" if desc else ""))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    sys.stdout.write(report())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

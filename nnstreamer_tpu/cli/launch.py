@@ -1,0 +1,49 @@
+"""nns-tpu-launch: run a textual pipeline description to completion.
+
+≙ ``gst-launch-1.0`` — the reference's de-facto CLI (SURVEY §1 L6).
+
+CLI: ``python -m nnstreamer_tpu.cli.launch "<pipeline text>" [--timeout S]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nns-tpu-launch", description="run a pipeline description"
+    )
+    ap.add_argument("pipeline", nargs="+", help="pipeline text (joined by spaces)")
+    ap.add_argument("--timeout", type=float, default=None, help="max seconds")
+    ap.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress bus messages"
+    )
+    args = ap.parse_args(argv)
+
+    from ..pipeline import parse_pipeline
+
+    text = " ".join(args.pipeline)
+    pipe = parse_pipeline(text)
+    if not args.quiet:
+        pipe.add_bus_watcher(lambda msg: print(f"[bus] {msg}", file=sys.stderr))
+    t0 = time.monotonic()
+    pipe.start()
+    try:
+        pipe.wait(timeout=args.timeout)
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+    finally:
+        pipe.stop()
+    if not args.quiet:
+        print(
+            f"pipeline finished in {time.monotonic() - t0:.3f}s", file=sys.stderr
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -133,22 +133,26 @@ class Collator:
         if not active or not all(self.queues[i] for i in active):
             return None
         base = max(_pts(self.queues[i][0]) for i in active)
-        # faster pads drop frames older than base; if that empties a live
-        # pad, wait for fresh data (don't pair a stale frame — reference
-        # drops and returns "need more", nnstreamer_plugin_api_impl.c:101-533)
+        # a frame <= base is superseded once a NEWER frame <= base is queued
+        # behind it — keep only the newest candidate per pad (safe eager
+        # drop: the outcome can never change)
         for i in active:
             q = self.queues[i]
-            while q and _pts(q[0]) < base:
+            while len(q) > 1 and _pts(q[1]) <= base:
                 q.popleft()
-            if not q and not self.eos[i]:
-                return None
-        # decide the full set before popping anything: a pad whose head is
-        # newer than base must fall back to its last frame, and if it has
-        # none the whole set is not ready — no partial consumption.
+        # plan the full set before popping anything (no partial consumption).
+        # A pad whose head is STALE (< base) with no queued successor and no
+        # EOS must wait — a better frame may still arrive (the reference pops
+        # the stale head to pad->buffer and returns "need more data",
+        # nnstreamer_plugin_api_impl.c:289-327; once a newer head exists the
+        # remembered frame is the pad's contribution).  Phase-offset streams
+        # therefore emit continuously one set per slowest-pad frame.
         pops = []
         for i in range(self.num_pads):
             q = self.queues[i]
-            if q and _pts(q[0]) <= base:
+            if i in active and q and _pts(q[0]) <= base:
+                if _pts(q[0]) < base and len(q) == 1 and not self.eos[i]:
+                    return None
                 pops.append(i)
             elif self.last[i] is None:
                 return None

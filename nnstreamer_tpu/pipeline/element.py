@@ -181,6 +181,10 @@ class Element:
         downstream for chaining: ``a.link(b).link(c)``."""
         if sink_pad is None:
             sink_pad = downstream.next_sink_pad()
+        elif downstream.NUM_SINK_PADS is None:
+            # explicit pad index on a request-pad element (pbtxt links):
+            # keep the allocation counter consistent so num_sink_pads is right
+            downstream._next_sink = max(downstream._next_sink, sink_pad + 1)
         self.srcpad(src_pad).link(downstream, sink_pad)
         return downstream
 

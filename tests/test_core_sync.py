@@ -70,6 +70,21 @@ class TestSlowest:
         out = c.collect()
         assert [val(f) for f in out] == [2, 100]
 
+    def test_phase_offset_streams_emit_continuously(self):
+        # two 30fps streams with a constant phase offset must keep emitting
+        # (regression: strict drop-below-base livelocked here)
+        c = Collator(2, SyncPolicy(SLOWEST))
+        emitted = []
+        for k in range(50):
+            c.push(0, frame(k, k * 0.033))
+            c.push(1, frame(100 + k, k * 0.033 + 0.015))
+            while (out := c.collect()) is not None:
+                emitted.append([val(f) for f in out])
+        assert len(emitted) >= 45  # ~one set per frame period
+        # each set pairs temporally adjacent frames
+        for a, b in emitted:
+            assert abs(a - (b - 100)) <= 1
+
 
 class TestBasepad:
     def test_base_drives_output(self):
