@@ -1,0 +1,68 @@
+"""jax.profiler integration (SURVEY §5.1: the reference delegates tracing
+to GstShark/gst-instruments; the TPU-native equivalent is XLA's own
+profiler, surfaced through the same kind of element properties).
+
+One process-global trace session (the jax profiler is a singleton):
+elements call :func:`trace_start`/:func:`trace_stop` and refcounting keeps
+the session alive while any element wants it.  View traces with
+TensorBoard or xprof (``trace-dir`` holds the .xplane.pb files).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .log import get_logger
+
+log = get_logger("profiler")
+
+_lock = threading.Lock()
+_refs = 0
+_dir: Optional[str] = None
+
+
+def trace_start(trace_dir: str) -> bool:
+    """Begin (or join) the global profiler trace; returns True if tracing."""
+    global _refs, _dir
+    with _lock:
+        if _refs == 0:
+            import jax
+
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:  # pragma: no cover — profiler unavailable
+                log.warning("profiler trace unavailable: %s", e)
+                return False
+            _dir = trace_dir
+        elif trace_dir != _dir:
+            log.warning(
+                "profiler already tracing to %s; ignoring %s", _dir, trace_dir
+            )
+        _refs += 1
+        return True
+
+
+def trace_stop() -> None:
+    """Drop one trace reference; the session ends at zero."""
+    global _refs, _dir
+    with _lock:
+        if _refs == 0:
+            return
+        _refs -= 1
+        if _refs == 0:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                log.warning("profiler stop failed: %s", e)
+            log.info("profiler trace written to %s", _dir)
+            _dir = None
+
+
+def annotate(name: str):
+    """Context manager labeling a region in the trace (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -117,6 +117,9 @@ class TensorFilter(TransformElement):
         "shared-tensor-filter-key": Property(str, "", "share one backend instance"),
         "invoke-dynamic": Property(bool, False, "output schema varies per buffer"),
         "max-batch": Property(int, 1, "micro-batch up to N queued frames into one invoke"),
+        # ≙ GstShark/NNShark tracing (SURVEY §5.1) done the XLA-native way
+        "trace": Property(int, 0, "1 = capture a jax.profiler trace while running"),
+        "trace-dir": Property(str, "/tmp/nns_tpu_trace", "profiler output dir"),
     }
 
     def __init__(self, name=None):
@@ -143,6 +146,7 @@ class TensorFilter(TransformElement):
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        self._tracing = False
         self._in_comb = _parse_combination(self.props["input-combination"])
         self._out_comb = _parse_combination(self.props["output-combination"])
         fw = self.props["framework"]
@@ -177,8 +181,19 @@ class TensorFilter(TransformElement):
             self.backend = make()
             self._owns_backend = True
         self._model_in, self._model_out = self.backend.get_model_info()
+        # trace only after the backend opened: a start() failure must not
+        # leak a profiler reference (pipeline won't call stop() on us then)
+        if self.props["trace"]:
+            from ..core.profiler import trace_start
+
+            self._tracing = trace_start(self.props["trace-dir"])
 
     def stop(self) -> None:
+        if getattr(self, "_tracing", False):
+            from ..core.profiler import trace_stop
+
+            trace_stop()
+            self._tracing = False
         if self.backend is None:
             return
         key = self.props["shared-tensor-filter-key"]

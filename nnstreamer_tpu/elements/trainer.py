@@ -39,6 +39,12 @@ class TensorTrainer(Element):
         "num-validation-samples": Property(int, 0, "validation samples per epoch"),
         "epochs": Property(int, 1, "number of epochs"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # periodic full-state checkpointing (net-new vs reference, SURVEY §5.3:
+        # preemptible-TPU recovery needs more than final model-save-path)
+        "checkpoint-path": Property(str, "", "dir for periodic checkpoints"),
+        "checkpoint-interval": Property(int, 1, "epochs between checkpoints"),
+        "checkpoint-keep": Property(int, 3, "checkpoints retained (0 = all)"),
+        "resume": Property(bool, False, "resume from newest checkpoint"),
     }
 
     def __init__(self, name=None):

@@ -184,19 +184,60 @@ class JaxTrainer(TrainerBackend):
     def _train_loop(self) -> None:
         try:
             self._fn, self.params, opt_state, train_step, eval_step = self._build()
+            opt_state, start_epoch = self._maybe_resume(opt_state)
         except Exception as e:
             log.exception("trainer build failed")
             self.error = e  # surfaced as a pipeline error by the element
             self.notify(EVENT_TRAINING_COMPLETION)
             return
         try:
-            self._train_body(opt_state, train_step, eval_step)
+            self._train_body(opt_state, train_step, eval_step, start_epoch)
         except Exception as e:
             log.exception("training failed")
             self.error = e
         self.notify(EVENT_TRAINING_COMPLETION)
 
-    def _train_body(self, opt_state, train_step, eval_step) -> None:
+    def _maybe_resume(self, opt_state):
+        """Periodic-checkpoint resume (preemptible-TPU recovery): restore
+        params + optimizer state + epoch from the newest checkpoint under
+        ``checkpoint-path`` when ``resume=1``."""
+        from ..core import checkpoint as ckpt
+
+        path = self._props.get("checkpoint-path")
+        resume = self._props.get("resume", False)
+        if isinstance(resume, str):  # direct-API callers; element props are bool
+            resume = resume.strip().lower() in ("1", "true", "yes", "on")
+        if not (path and resume):
+            return opt_state, 0
+        step = ckpt.latest_step(path)
+        if step is None:
+            log.info("resume requested but no checkpoint under %s", path)
+            return opt_state, 0
+        state = ckpt.restore_state(
+            path, step, {"params": self.params, "opt_state": opt_state}
+        )
+        self.params = state["params"]
+        log.info("resumed from %s step %d", path, step)
+        return state["opt_state"], step
+
+    def _checkpoint(self, opt_state, epoch: int) -> None:
+        from ..core import checkpoint as ckpt
+
+        path = self._props.get("checkpoint-path")
+        if not path:
+            return
+        interval = int(self._props.get("checkpoint-interval", 1))
+        if interval <= 0 or epoch % interval:
+            return
+        ckpt.save_state(
+            path, epoch, {"params": self.params, "opt_state": opt_state}
+        )
+        keep = int(self._props.get("checkpoint-keep", 3))
+        ckpt.prune(path, keep)
+        log.info("checkpointed epoch %d to %s", epoch, path)
+
+    def _train_body(self, opt_state, train_step, eval_step,
+                    start_epoch: int = 0) -> None:
         n_in = int(self._props.get("num-inputs", 1))
         n_lab = int(self._props.get("num-labels", 1))
         n_train = int(self._props.get("num-training-samples", 0))
@@ -206,7 +247,7 @@ class JaxTrainer(TrainerBackend):
         per_epoch = n_train + n_valid
 
         epoch_samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
-        done_epochs = 0
+        done_epochs = start_epoch
 
         def run_epoch(train, valid):
             nonlocal opt_state, done_epochs
@@ -231,6 +272,7 @@ class JaxTrainer(TrainerBackend):
                 validation_accuracy=float(np.mean(vaccs)) if vaccs else 0.0,
             )
             self.notify(EVENT_EPOCH_COMPLETION)
+            self._checkpoint(opt_state, done_epochs)
 
         while not self._stop.is_set() and (epochs <= 0 or done_epochs < epochs):
             try:
@@ -254,10 +296,8 @@ class JaxTrainer(TrainerBackend):
             else:
                 # num-training-samples unset: the whole stream is the dataset;
                 # honor epochs= by re-iterating it instead of silently saving
-                # the untrained init
-                for _ in range(max(1, epochs)):
-                    if self._stop.is_set():
-                        break
+                # the untrained init (done_epochs already counts resumed ones)
+                while done_epochs < max(1, epochs) and not self._stop.is_set():
                     run_epoch(epoch_samples, [])
         save_path = self._props.get("model-save-path")
         if save_path and self.params is not None:

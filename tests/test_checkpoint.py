@@ -1,0 +1,143 @@
+"""Periodic Orbax checkpointing + resume + profiler hooks (SURVEY §5.3/5.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import checkpoint as ckpt
+
+
+class TestCheckpointStore:
+    def test_save_latest_restore_prune(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "ck")
+        state = {"params": {"w": jnp.arange(4.0)}, "opt_state": {"m": jnp.ones(4)}}
+        assert ckpt.latest_step(d) is None
+        for step in (1, 2, 3, 4):
+            st = {
+                "params": {"w": state["params"]["w"] + step},
+                "opt_state": state["opt_state"],
+            }
+            ckpt.save_state(d, step, st)
+        assert ckpt.latest_step(d) == 4
+        restored = ckpt.restore_state(d, 4, state)
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), np.arange(4.0) + 4
+        )
+        ckpt.prune(d, keep=2)
+        assert sorted(
+            n for n in os.listdir(d) if n.startswith("step_")
+        ) == ["step_3", "step_4"]
+        assert ckpt.latest_step(d) == 4
+
+    def test_prune_ignores_stray_files(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "ck")
+        for step in (1, 2):
+            ckpt.save_state(d, step, {"w": jnp.zeros(2)})
+        open(os.path.join(d, "step_9"), "w").close()  # stray regular file
+        ckpt.prune(d, keep=1)
+        assert ckpt.latest_step(d) == 2  # real newest survives
+
+
+class TestTrainerResume:
+    def _props(self, tmp_path, resume):
+        return {
+            "model-config": (
+                '{"arch": "mnist_cnn", "batch_size": 4, "learning_rate": 0.01}'
+            ),
+            "num-inputs": 1,
+            "num-labels": 1,
+            "num-training-samples": 8,
+            "num-validation-samples": 0,
+            "epochs": 2,
+            "checkpoint-path": str(tmp_path / "ck"),
+            "checkpoint-interval": 1,
+            "checkpoint-keep": 0,
+            "resume": resume,
+        }
+
+    def _feed(self, tr, rng, n):
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        for _ in range(n):
+            x = rng.random((28, 28, 1), np.float32)
+            y = np.int32([rng.integers(0, 10)])
+            tr.push_data(TensorFrame([x, y]))
+
+    def test_resume_continues_epoch_count(self, tmp_path):
+        from nnstreamer_tpu.trainer.jax_trainer import JaxTrainer
+
+        rng = np.random.default_rng(0)
+        tr = JaxTrainer()
+        tr.create(self._props(tmp_path, False))
+        tr.start()
+        self._feed(tr, rng, 16)  # 2 epochs x 8
+        tr.end_of_data()
+        tr._thread.join(timeout=120)
+        assert tr.error is None
+        assert tr.status.epoch_count == 2
+        assert ckpt.latest_step(str(tmp_path / "ck")) == 2
+
+        # restart with resume: trains epochs 3..4 (honors prior progress)
+        tr2 = JaxTrainer()
+        props = self._props(tmp_path, True)
+        props["epochs"] = 4
+        tr2.create(props)
+        tr2.start()
+        self._feed(tr2, rng, 16)
+        tr2.end_of_data()
+        tr2._thread.join(timeout=120)
+        assert tr2.error is None
+        assert tr2.status.epoch_count == 4
+        assert ckpt.latest_step(str(tmp_path / "ck")) == 4
+
+
+class TestProfilerHooks:
+    def test_refcounted_trace(self, tmp_path):
+        from nnstreamer_tpu.core import profiler
+
+        d1 = str(tmp_path / "t1")
+        assert profiler.trace_start(d1)
+        assert profiler.trace_start(d1)  # second ref joins
+        profiler.trace_stop()
+        profiler.trace_stop()  # session ends here
+        assert profiler._refs == 0
+        # a trace was actually written
+        found = any(f.endswith(".xplane.pb") for _, _, fs in os.walk(d1) for f in fs)
+        assert found
+
+    def test_filter_trace_prop(self, tmp_path):
+        from nnstreamer_tpu.pipeline import parse_pipeline
+
+        d = str(tmp_path / "t2")
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter framework=passthrough "
+            f"trace=1 trace-dir={d} ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.zeros((4,), np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert len(pipe["out"].frames) == 1
+        found = any(f.endswith(".xplane.pb") for _, _, fs in os.walk(d) for f in fs)
+        assert found
+
+    def test_failed_start_does_not_leak_trace_ref(self, tmp_path):
+        from nnstreamer_tpu.core import profiler
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.pipeline.element import ElementError
+
+        before = profiler._refs
+        f = TensorFilter("f")
+        f.set_property("framework", "passthrough")
+        f.set_property("trace", 1)
+        f.set_property("model", str(tmp_path / "missing.bin"))
+        f.set_property("trace-dir", str(tmp_path / "t3"))
+        with pytest.raises(ElementError):
+            f.start()
+        assert profiler._refs == before
