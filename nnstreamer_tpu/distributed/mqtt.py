@@ -1,0 +1,326 @@
+"""Minimal MQTT 3.1.1 transport: client + in-process broker (stdlib only).
+
+The reference's mqttsink/mqttsrc (``gst/mqtt/``) link against paho.mqtt.c;
+this image has no MQTT library, so the TPU build carries its own small
+implementation of the subset the elements need — QoS 0 publish, subscribe
+with ``+``/``#`` wildcards, keep-alive pings — plus a localhost broker so
+pipelines (and tests) run without external infrastructure.  Protocol per
+the public OASIS MQTT 3.1.1 spec.
+
+This is control-plane-grade transport (sensor streams, events); bulk
+tensor traffic between hosts should ride the gRPC query/edge elements.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.log import get_logger
+
+log = get_logger("mqtt")
+
+# packet types (MQTT 3.1.1 §2.2.1)
+CONNECT, CONNACK = 1, 2
+PUBLISH = 3
+SUBSCRIBE, SUBACK = 8, 9
+UNSUBSCRIBE, UNSUBACK = 10, 11
+PINGREQ, PINGRESP = 12, 13
+DISCONNECT = 14
+
+
+def _encode_len(n: int) -> bytes:
+    out = b""
+    while True:
+        d = n % 128
+        n //= 128
+        out += bytes([d | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("MQTT peer closed")
+        buf += chunk
+    return buf
+
+
+def _read_packet(sock: socket.socket) -> Tuple[int, int, bytes]:
+    head = _read_exact(sock, 1)[0]
+    mult, length = 1, 0
+    while True:
+        b = _read_exact(sock, 1)[0]
+        length += (b & 0x7F) * mult
+        if not (b & 0x80):
+            break
+        mult *= 128
+        if mult > 128**3:
+            raise ConnectionError("malformed MQTT length")
+    payload = _read_exact(sock, length) if length else b""
+    return head >> 4, head & 0xF, payload
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT wildcard match: ``+`` one level, ``#`` rest (spec §4.7)."""
+    pp, tp = pattern.split("/"), topic.split("/")
+    for i, p in enumerate(pp):
+        if p == "#":
+            return True
+        if i >= len(tp):
+            return False
+        if p != "+" and p != tp[i]:
+            return False
+    return len(pp) == len(tp)
+
+
+class MiniBroker:
+    """Tiny localhost MQTT broker (QoS 0, wildcards, retained messages)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()
+        self._lock = threading.Lock()
+        # sock -> list of subscription patterns; per-sock write locks so a
+        # publisher fan-out and the subscriber's own control responses
+        # (SUBACK/PINGRESP/retained) cannot interleave mid-sendall
+        self._subs: Dict[socket.socket, List[str]] = {}
+        self._wlocks: Dict[socket.socket, threading.Lock] = {}
+        self._retained: Dict[str, bytes] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="mqtt-broker", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in list(self._subs):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._subs.clear()
+
+    # -- internals ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._client_loop, args=(sock,), daemon=True
+            ).start()
+
+    def _client_loop(self, sock: socket.socket) -> None:
+        try:
+            ptype, _, _ = _read_packet(sock)
+            if ptype != CONNECT:
+                sock.close()
+                return
+            sock.sendall(bytes([CONNACK << 4, 2, 0, 0]))
+            with self._lock:
+                self._subs[sock] = []
+                self._wlocks[sock] = threading.Lock()
+            while not self._stop.is_set():
+                ptype, flags, body = _read_packet(sock)
+                if ptype == PUBLISH:
+                    self._handle_publish(flags, body)
+                elif ptype == SUBSCRIBE:
+                    self._handle_subscribe(sock, body)
+                elif ptype == UNSUBSCRIBE:
+                    self._handle_unsubscribe(sock, body)
+                elif ptype == PINGREQ:
+                    self._send(sock, bytes([PINGRESP << 4, 0]))
+                elif ptype == DISCONNECT:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                self._subs.pop(sock, None)
+                self._wlocks.pop(sock, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle_publish(self, flags: int, body: bytes) -> None:
+        tlen = struct.unpack(">H", body[:2])[0]
+        topic = body[2 : 2 + tlen].decode()
+        off = 2 + tlen
+        if (flags >> 1) & 0x3:  # QoS > 0 carries a packet id
+            off += 2
+        payload = body[off:]
+        if flags & 0x1:  # retain
+            with self._lock:
+                self._retained[topic] = payload
+        packet = self._publish_packet(topic, payload)
+        with self._lock:
+            targets = [
+                s for s, pats in self._subs.items()
+                if any(topic_matches(p, topic) for p in pats)
+            ]
+        for s in targets:
+            self._send(s, packet)
+
+    def _send(self, sock: socket.socket, data: bytes) -> None:
+        with self._lock:
+            wl = self._wlocks.get(sock)
+        if wl is None:
+            return
+        try:
+            with wl:
+                sock.sendall(data)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _publish_packet(topic: str, payload: bytes, retain: bool = False) -> bytes:
+        var = _mqtt_str(topic) + payload
+        head = (PUBLISH << 4) | (1 if retain else 0)
+        return bytes([head]) + _encode_len(len(var)) + var
+
+    def _handle_subscribe(self, sock: socket.socket, body: bytes) -> None:
+        pid = body[:2]
+        off = 2
+        pats = []
+        while off < len(body):
+            ln = struct.unpack(">H", body[off : off + 2])[0]
+            pats.append(body[off + 2 : off + 2 + ln].decode())
+            off += 2 + ln + 1  # + requested QoS byte
+        with self._lock:
+            self._subs[sock].extend(pats)
+            retained = [
+                (t, p) for t, p in self._retained.items()
+                if any(topic_matches(pat, t) for pat in pats)
+            ]
+        self._send(
+            sock,
+            bytes([SUBACK << 4]) + _encode_len(2 + len(pats)) + pid
+            + bytes([0] * len(pats)),
+        )
+        for t, p in retained:
+            self._send(sock, self._publish_packet(t, p, retain=True))
+
+    def _handle_unsubscribe(self, sock: socket.socket, body: bytes) -> None:
+        pid = body[:2]
+        off = 2
+        with self._lock:
+            pats = self._subs.get(sock, [])
+            while off < len(body):
+                ln = struct.unpack(">H", body[off : off + 2])[0]
+                pat = body[off + 2 : off + 2 + ln].decode()
+                if pat in pats:
+                    pats.remove(pat)
+                off += 2 + ln
+        self._send(sock, bytes([UNSUBACK << 4, 2]) + pid)
+
+
+class MqttClient:
+    """QoS-0 MQTT 3.1.1 client: connect, publish, subscribe(callback)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "",
+                 keepalive: int = 60, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._cb: Optional[Callable[[str, bytes], None]] = None
+        self._stop = threading.Event()
+        self._pid = 0
+        cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
+        var = (
+            _mqtt_str("MQTT") + bytes([4])  # protocol level 4 = 3.1.1
+            + bytes([0x02])                 # clean session
+            + struct.pack(">H", keepalive)
+            + _mqtt_str(cid)
+        )
+        self._send(bytes([CONNECT << 4]) + _encode_len(len(var)) + var)
+        ptype, _, body = _read_packet(self._sock)
+        if ptype != CONNACK or body[1] != 0:
+            raise ConnectionError(f"MQTT connect refused: {body!r}")
+        self._reader = threading.Thread(
+            target=self._read_loop, name="mqtt-client", daemon=True
+        )
+        self._reader.start()
+        # keepalive: a broker may drop us after 1.5x the advertised interval
+        # with no inbound packets (MQTT 3.1.1 §3.1.2.10), so ping on a timer
+        self._keepalive = max(1, keepalive)
+        self._pinger = threading.Thread(
+            target=self._ping_loop, name="mqtt-ping", daemon=True
+        )
+        self._pinger.start()
+
+    def _ping_loop(self) -> None:
+        interval = self._keepalive / 2.0
+        while not self._stop.wait(interval):
+            try:
+                self.ping()
+            except OSError:
+                return
+
+    def _send(self, data: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def publish(self, topic: str, payload: bytes, retain: bool = False) -> None:
+        var = _mqtt_str(topic) + payload
+        head = (PUBLISH << 4) | (1 if retain else 0)
+        self._send(bytes([head]) + _encode_len(len(var)) + var)
+
+    def subscribe(self, pattern: str,
+                  callback: Callable[[str, bytes], None]) -> None:
+        self._cb = callback
+        self._pid += 1
+        var = (
+            struct.pack(">H", self._pid) + _mqtt_str(pattern) + bytes([0])
+        )
+        self._send(bytes([(SUBSCRIBE << 4) | 0x2]) + _encode_len(len(var)) + var)
+
+    def ping(self) -> None:
+        self._send(bytes([PINGREQ << 4, 0]))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._send(bytes([DISCONNECT << 4, 0]))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                ptype, flags, body = _read_packet(self._sock)
+                if ptype == PUBLISH and self._cb is not None:
+                    tlen = struct.unpack(">H", body[:2])[0]
+                    topic = body[2 : 2 + tlen].decode()
+                    off = 2 + tlen
+                    if (flags >> 1) & 0x3:
+                        off += 2
+                    self._cb(topic, body[off:])
+        except (ConnectionError, OSError):
+            pass

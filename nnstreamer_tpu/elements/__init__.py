@@ -23,6 +23,8 @@ for _mod in (
     "query",
     "edge",
     "debug",
+    "src_iio",
+    "mqtt",
 ):
     # only skip modules that are not built yet; real import errors propagate
     if _os.path.exists(_os.path.join(_here, _mod + ".py")):

@@ -1,0 +1,162 @@
+"""mqttsink / mqttsrc — tensor streams over MQTT pub/sub.
+
+Reference: ``gst/mqtt/`` (mqttsink.c, mqttsrc.c, ~3100 LoC on paho MQTT)
+and ``Documentation/synchronization-in-mqtt-elements.md``: the sink embeds
+its pipeline base-time *as an epoch* in every message header; the source
+rebases incoming buffer timestamps into its own clock domain
+(``pts += sender_base_epoch - receiver_base_epoch``) so multi-device
+pipelines stay aligned without a shared GStreamer clock (the reference
+derives the epoch via NTP, ``ntputil.c``; wall clock here — same contract).
+
+Transport is the in-repo MQTT 3.1.1 client/broker
+(:mod:`nnstreamer_tpu.distributed.mqtt`) — no external broker required:
+point both elements at a :class:`MiniBroker` (or any MQTT 3.1.1 broker).
+
+Message = 48-byte header (magic, base epoch, sent epoch) + wire-encoded
+frame (:mod:`nnstreamer_tpu.distributed.wire` — the flex-header format the
+query/edge elements speak).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import struct
+import time
+from typing import Iterator, Optional
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..distributed import wire
+from ..distributed.mqtt import MqttClient
+from ..pipeline.element import (
+    ElementError,
+    Property,
+    SinkElement,
+    SourceElement,
+    element,
+)
+
+_HDR = struct.Struct(">8sdd")  # magic, base_epoch, sent_epoch
+_MAGIC = b"NNSMQTT1"
+
+
+@element("mqttsink")
+class MqttSink(SinkElement):
+    PROPERTIES = {
+        "host": Property(str, "127.0.0.1", "broker host"),
+        "port": Property(int, 1883, "broker port"),
+        "pub-topic": Property(str, "", "topic to publish to (required)"),
+        "client-id": Property(str, "", "MQTT client id (auto if empty)"),
+        "retain": Property(bool, False, "retain the last message"),
+        "num-buffers": Property(int, -1, "stop after N messages (-1 = all)"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._client: Optional[MqttClient] = None
+        self._base_epoch = 0.0
+        self._sent = 0
+
+    def start(self) -> None:
+        if not self.props["pub-topic"]:
+            raise ElementError(f"{self.name}: pub-topic is required")
+        self._client = MqttClient(
+            self.props["host"], self.props["port"],
+            client_id=self.props["client-id"],
+        )
+        # pipeline base-time as epoch (≙ ntputil-derived base in the sink's
+        # message header) — receivers rebase against their own base
+        self._base_epoch = time.time()
+        self._sent = 0
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def render(self, frame: TensorFrame) -> None:
+        limit = self.props["num-buffers"]
+        if self._client is None or (0 <= limit <= self._sent):
+            return
+        payload = _HDR.pack(_MAGIC, self._base_epoch, time.time()) + (
+            wire.encode_frame(frame)
+        )
+        self._client.publish(
+            self.props["pub-topic"], payload, retain=self.props["retain"]
+        )
+        self._sent += 1
+
+
+@element("mqttsrc")
+class MqttSrc(SourceElement):
+    PROPERTIES = {
+        "host": Property(str, "127.0.0.1", "broker host"),
+        "port": Property(int, 1883, "broker port"),
+        "sub-topic": Property(str, "", "topic filter (+/# wildcards ok)"),
+        "client-id": Property(str, "", "MQTT client id (auto if empty)"),
+        "num-buffers": Property(int, -1, "EOS after N messages (-1 = forever)"),
+        "sub-timeout": Property(int, 10000, "ms without a message before EOS"),
+        "max-msg-buf-size": Property(int, 64, "receive queue depth"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._client: Optional[MqttClient] = None
+        self._q: "_queue.Queue[bytes]" = _queue.Queue(64)
+        self._base_epoch = 0.0
+
+    def output_spec(self) -> StreamSpec:
+        return ANY
+
+    def start(self) -> None:
+        if not self.props["sub-topic"]:
+            raise ElementError(f"{self.name}: sub-topic is required")
+        self._q = _queue.Queue(self.props["max-msg-buf-size"])
+        self._client = MqttClient(
+            self.props["host"], self.props["port"],
+            client_id=self.props["client-id"],
+        )
+        self._base_epoch = time.time()
+        self._client.subscribe(self.props["sub-topic"], self._on_message)
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def _on_message(self, topic: str, payload: bytes) -> None:
+        try:
+            self._q.put(payload, timeout=1.0)
+        except _queue.Full:
+            self.log.warning("receive queue full; dropping message")
+
+    def frames(self) -> Iterator[TensorFrame]:
+        limit = self.props["num-buffers"]
+        timeout_s = self.props["sub-timeout"] / 1000.0
+        n = 0
+        while limit < 0 or n < limit:
+            try:
+                payload = self._q.get(timeout=timeout_s)
+            except _queue.Empty:
+                self.log.info("sub-timeout reached; ending stream")
+                return
+            if len(payload) < _HDR.size:
+                self.log.warning("short MQTT message dropped")
+                continue
+            magic, base_epoch, sent_epoch = _HDR.unpack_from(payload, 0)
+            if magic != _MAGIC:
+                self.log.warning("bad MQTT message magic; dropped")
+                continue
+            try:
+                frame = wire.decode_frame(payload[_HDR.size:])
+            except wire.WireError as e:
+                self.log.warning("undecodable MQTT frame: %s", e)
+                continue
+            # cross-device timestamp rebasing (reference sync doc): shift the
+            # sender's stream clock into ours via the epoch difference
+            if frame.pts is not None:
+                frame.pts += base_epoch - self._base_epoch
+            frame.meta["mqtt-sent-epoch"] = sent_epoch
+            frame.meta["mqtt-latency-s"] = max(0.0, time.time() - sent_epoch)
+            n += 1
+            yield frame
