@@ -72,6 +72,30 @@ def _mqtt_str(s: str) -> bytes:
     return struct.pack(">H", len(b)) + b
 
 
+class MqttProtocolError(ValueError):
+    pass
+
+
+def _parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
+    """PUBLISH variable header -> (topic, payload); shared by broker and
+    client so malformed-body handling stays in one place."""
+    if len(body) < 2:
+        raise MqttProtocolError("PUBLISH body too short")
+    tlen = struct.unpack(">H", body[:2])[0]
+    off = 2 + tlen
+    if off > len(body):
+        raise MqttProtocolError("PUBLISH topic length exceeds body")
+    try:
+        topic = body[2:off].decode()
+    except UnicodeDecodeError as e:
+        raise MqttProtocolError(f"PUBLISH topic not UTF-8: {e}") from None
+    if (flags >> 1) & 0x3:  # QoS > 0 carries a packet id
+        off += 2
+        if off > len(body):
+            raise MqttProtocolError("PUBLISH missing packet id")
+    return topic, body[off:]
+
+
 def topic_matches(pattern: str, topic: str) -> bool:
     """MQTT wildcard match: ``+`` one level, ``#`` rest (spec §4.7)."""
     pp, tp = pattern.split("/"), topic.split("/")
@@ -156,6 +180,9 @@ class MiniBroker:
                     break
         except (ConnectionError, OSError):
             pass
+        except (MqttProtocolError, struct.error, IndexError,
+                UnicodeDecodeError) as e:
+            log.warning("broker: dropping client on malformed packet: %s", e)
         finally:
             with self._lock:
                 self._subs.pop(sock, None)
@@ -166,12 +193,7 @@ class MiniBroker:
                 pass
 
     def _handle_publish(self, flags: int, body: bytes) -> None:
-        tlen = struct.unpack(">H", body[:2])[0]
-        topic = body[2 : 2 + tlen].decode()
-        off = 2 + tlen
-        if (flags >> 1) & 0x3:  # QoS > 0 carries a packet id
-            off += 2
-        payload = body[off:]
+        topic, payload = _parse_publish(flags, body)
         if flags & 0x1:  # retain
             with self._lock:
                 self._retained[topic] = payload
@@ -312,15 +334,19 @@ class MqttClient:
             pass
 
     def _read_loop(self) -> None:
-        try:
-            while not self._stop.is_set():
+        while not self._stop.is_set():
+            try:
                 ptype, flags, body = _read_packet(self._sock)
-                if ptype == PUBLISH and self._cb is not None:
-                    tlen = struct.unpack(">H", body[:2])[0]
-                    topic = body[2 : 2 + tlen].decode()
-                    off = 2 + tlen
-                    if (flags >> 1) & 0x3:
-                        off += 2
-                    self._cb(topic, body[off:])
-        except (ConnectionError, OSError):
-            pass
+            except (ConnectionError, OSError):
+                return
+            if ptype != PUBLISH or self._cb is None:
+                continue
+            try:
+                topic, payload = _parse_publish(flags, body)
+            except MqttProtocolError as e:
+                log.warning("client: dropping malformed PUBLISH: %s", e)
+                continue
+            try:
+                self._cb(topic, payload)
+            except Exception:  # subscriber bugs must not kill the reader
+                log.exception("mqtt subscribe callback failed")

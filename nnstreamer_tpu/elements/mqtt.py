@@ -12,7 +12,7 @@ Transport is the in-repo MQTT 3.1.1 client/broker
 (:mod:`nnstreamer_tpu.distributed.mqtt`) — no external broker required:
 point both elements at a :class:`MiniBroker` (or any MQTT 3.1.1 broker).
 
-Message = 48-byte header (magic, base epoch, sent epoch) + wire-encoded
+Message = 24-byte header (8B magic, f64 base epoch, f64 sent epoch) + wire-encoded
 frame (:mod:`nnstreamer_tpu.distributed.wire` — the flex-header format the
 query/edge elements speak).
 """

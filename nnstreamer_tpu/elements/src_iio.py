@@ -163,17 +163,20 @@ class TensorSrcIIO(SourceElement):
                 continue
             cname = fn[:-3]
             enabled = _read(os.path.join(scan, fn), "0") == "1"
-            want = (
-                explicit is not None and cname in explicit
-                or sel == "all"
-                or (sel == "auto" and enabled)
-            )
-            if explicit is not None and cname not in explicit:
-                want = False
+            if explicit is not None:
+                want = cname in explicit
+            elif sel == "all":
+                want = True
+            else:  # auto: keep the driver's current enables
+                want = enabled
             if not want:
-                # "all"/explicit may require toggling enables
-                if enabled and (sel == "all" or explicit is not None):
-                    _write(os.path.join(scan, fn), "0")
+                # a stale enabled channel would corrupt the scan layout the
+                # kernel emits vs the one we compute — failing to disable it
+                # is fatal, same as failing to enable a wanted one
+                if enabled and not _write(os.path.join(scan, fn), "0"):
+                    raise ElementError(
+                        f"{self.name}: cannot disable channel {cname}"
+                    )
                 continue
             if not enabled and not _write(os.path.join(scan, fn), "1"):
                 raise ElementError(f"{self.name}: cannot enable channel {cname}")

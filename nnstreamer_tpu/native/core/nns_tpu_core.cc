@@ -1,0 +1,188 @@
+/**
+ * nns_tpu_core — native data-plane primitives for the pipeline runtime.
+ *
+ * Reference analog: the reference's core runtime is C (GStreamer queues,
+ * streaming threads, GstAllocator — SURVEY §2.1/§L0); this library is the
+ * TPU build's native equivalent under the Python orchestration layer:
+ *
+ *  - opaque-pointer mailbox (bounded MPMC queue, condvar blocking): element
+ *    mailboxes block in native code with the GIL released (ctypes foreign
+ *    calls drop it), so handoff wakeups are immediate instead of poll-loop
+ *    latency, and producers get real backpressure.
+ *  - aligned buffer pool (≙ gst_tensor_allocator, tensor_allocator.c:128):
+ *    recycled aligned blocks for receive/scratch buffers.
+ *
+ * Pure C ABI over C++17 internals; loaded via ctypes (no pybind11 in this
+ * image).  The library never touches Python objects — the Python wrapper
+ * owns all refcounting.
+ */
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+/* ------------------------------------------------------------------ *
+ * Opaque-pointer mailbox                                             *
+ * ------------------------------------------------------------------ */
+
+struct NnsQueue {
+  std::mutex m;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<void *> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+void *nns_oq_create (size_t capacity)
+{
+  auto *q = new NnsQueue ();
+  q->capacity = capacity ? capacity : SIZE_MAX;
+  return q;
+}
+
+/* 0 = ok, -1 = timeout, -2 = closed.  timeout_s < 0 blocks forever. */
+int nns_oq_push (void *h, void *obj, double timeout_s)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  std::unique_lock<std::mutex> lk (q->m);
+  auto ready = [q] { return q->closed || q->items.size () < q->capacity; };
+  if (timeout_s < 0) {
+    q->not_full.wait (lk, ready);
+  } else if (!q->not_full.wait_for (
+                 lk, std::chrono::duration<double> (timeout_s), ready)) {
+    return -1;
+  }
+  if (q->closed)
+    return -2;
+  q->items.push_back (obj);
+  q->not_empty.notify_one ();
+  return 0;
+}
+
+/* 0 = ok (obj in *out), -1 = timeout, -2 = closed-and-drained. */
+int nns_oq_pop (void *h, double timeout_s, void **out)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  std::unique_lock<std::mutex> lk (q->m);
+  auto ready = [q] { return q->closed || !q->items.empty (); };
+  if (timeout_s < 0) {
+    q->not_empty.wait (lk, ready);
+  } else if (!q->not_empty.wait_for (
+                 lk, std::chrono::duration<double> (timeout_s), ready)) {
+    return -1;
+  }
+  if (q->items.empty ())
+    return -2; /* closed */
+  *out = q->items.front ();
+  q->items.pop_front ();
+  q->not_full.notify_one ();
+  return 0;
+}
+
+size_t nns_oq_size (void *h)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  std::lock_guard<std::mutex> lk (q->m);
+  return q->items.size ();
+}
+
+/* wake all waiters; pending items remain poppable until drained */
+void nns_oq_close (void *h)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  {
+    std::lock_guard<std::mutex> lk (q->m);
+    q->closed = true;
+  }
+  q->not_full.notify_all ();
+  q->not_empty.notify_all ();
+}
+
+/* caller must have drained (or accept leaking the queued pointers' refs —
+ * the Python wrapper drains first) */
+void nns_oq_destroy (void *h)
+{
+  delete static_cast<NnsQueue *> (h);
+}
+
+/* ------------------------------------------------------------------ *
+ * Aligned buffer pool (≙ gst_tensor_allocator)                       *
+ * ------------------------------------------------------------------ */
+
+struct NnsPool {
+  std::mutex m;
+  std::vector<void *> free_blocks;
+  size_t block_size;
+  size_t alignment;
+  size_t outstanding = 0;
+};
+
+void *nns_pool_create (size_t block_size, size_t prealloc, size_t alignment)
+{
+  if (alignment == 0 || (alignment & (alignment - 1)))
+    return nullptr; /* must be a power of two */
+  auto *p = new NnsPool ();
+  p->block_size = block_size;
+  p->alignment = alignment < sizeof (void *) ? sizeof (void *) : alignment;
+  for (size_t i = 0; i < prealloc; i++) {
+    void *b = nullptr;
+    if (posix_memalign (&b, p->alignment, block_size) == 0)
+      p->free_blocks.push_back (b);
+  }
+  return p;
+}
+
+void *nns_pool_acquire (void *h)
+{
+  auto *p = static_cast<NnsPool *> (h);
+  std::lock_guard<std::mutex> lk (p->m);
+  p->outstanding++;
+  if (!p->free_blocks.empty ()) {
+    void *b = p->free_blocks.back ();
+    p->free_blocks.pop_back ();
+    return b;
+  }
+  void *b = nullptr;
+  if (posix_memalign (&b, p->alignment, p->block_size) != 0) {
+    p->outstanding--;
+    return nullptr;
+  }
+  return b;
+}
+
+void nns_pool_release (void *h, void *block)
+{
+  auto *p = static_cast<NnsPool *> (h);
+  std::lock_guard<std::mutex> lk (p->m);
+  p->outstanding--;
+  p->free_blocks.push_back (block);
+}
+
+size_t nns_pool_block_size (void *h)
+{
+  return static_cast<NnsPool *> (h)->block_size;
+}
+
+size_t nns_pool_outstanding (void *h)
+{
+  auto *p = static_cast<NnsPool *> (h);
+  std::lock_guard<std::mutex> lk (p->m);
+  return p->outstanding;
+}
+
+void nns_pool_destroy (void *h)
+{
+  auto *p = static_cast<NnsPool *> (h);
+  for (void *b : p->free_blocks)
+    free (b);
+  delete p;
+}
+
+} /* extern "C" */

@@ -1,0 +1,244 @@
+"""ctypes bindings for the native core (libnns_tpu_core.so).
+
+Builds the library on demand with g++ (no pybind11 in this image; the C
+ABI + ctypes keeps the boundary simple).  Everything degrades gracefully:
+if the toolchain or build is unavailable, ``available()`` returns False and
+the pipeline runtime falls back to ``queue.Queue``.
+
+:class:`NativeMailbox` is API-compatible with the ``queue.Queue`` subset
+the scheduler uses (put/put_nowait/get/get_nowait raising queue.Full/Empty)
+but blocks inside the C++ condvar with the GIL released — immediate
+wakeups instead of Python poll loops.  Python object lifetime: a strong
+reference is taken (Py_IncRef) before the pointer enters the native queue
+and handed back to Python on pop; close() drains and releases leftovers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue as _pyqueue
+import subprocess
+import threading
+from typing import Any, Optional
+
+from ..core.log import get_logger
+
+log = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "core", "nns_tpu_core.cc")
+_BUILD_DIR = os.path.join(_HERE, "build")
+_SO = os.path.join(_BUILD_DIR, "libnns_tpu_core.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native core build failed to run: %s", e)
+        return None
+    if r.returncode != 0:
+        log.warning("native core build failed:\n%s", r.stderr)
+        return None
+    return _SO
+
+
+_bg_build: Optional[threading.Thread] = None
+
+
+def _so_fresh() -> bool:
+    return os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+
+
+def _load(block: bool = False) -> Optional[ctypes.CDLL]:
+    """dlopen the core library.  When the .so is not built yet, `block=False`
+    (the pipeline-start path) kicks off a background compile and returns
+    None — the FIRST pipeline falls back to queue.Queue instead of stalling
+    behind a 2-minute g++ run; later pipelines pick the library up."""
+    global _lib, _build_failed, _bg_build
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    if os.environ.get("NNS_TPU_NO_NATIVE"):
+        return None
+    if not _so_fresh() and not block:
+        with _build_lock:
+            if _bg_build is None or not _bg_build.is_alive():
+                _bg_build = threading.Thread(
+                    target=_build, name="nns-native-build", daemon=True
+                )
+                _bg_build.start()
+        return None
+    with _build_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _SO if _so_fresh() else _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.nns_oq_create.restype = ctypes.c_void_p
+        lib.nns_oq_create.argtypes = [ctypes.c_size_t]
+        lib.nns_oq_push.restype = ctypes.c_int
+        lib.nns_oq_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_double,
+        ]
+        lib.nns_oq_pop.restype = ctypes.c_int
+        lib.nns_oq_pop.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.nns_oq_size.restype = ctypes.c_size_t
+        lib.nns_oq_size.argtypes = [ctypes.c_void_p]
+        lib.nns_oq_close.argtypes = [ctypes.c_void_p]
+        lib.nns_oq_destroy.argtypes = [ctypes.c_void_p]
+        lib.nns_pool_create.restype = ctypes.c_void_p
+        lib.nns_pool_create.argtypes = [
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_size_t,
+        ]
+        lib.nns_pool_acquire.restype = ctypes.c_void_p
+        lib.nns_pool_acquire.argtypes = [ctypes.c_void_p]
+        lib.nns_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.nns_pool_block_size.restype = ctypes.c_size_t
+        lib.nns_pool_block_size.argtypes = [ctypes.c_void_p]
+        lib.nns_pool_outstanding.restype = ctypes.c_size_t
+        lib.nns_pool_outstanding.argtypes = [ctypes.c_void_p]
+        lib.nns_pool_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        log.info("native core loaded: %s", so)
+        return _lib
+
+
+def available(block: bool = False) -> bool:
+    """True when the native core is loadable now.  ``block=True`` waits for
+    (or performs) the compile — tests use it; the runtime path does not."""
+    return _load(block=block) is not None
+
+
+class NativeMailbox:
+    """queue.Queue-compatible bounded mailbox backed by the C++ condvar
+    queue.  Raises queue.Full / queue.Empty like the stdlib class."""
+
+    def __init__(self, maxsize: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._h = lib.nns_oq_create(max(0, maxsize))
+        self._closed = False
+
+    # -- stdlib-compatible subset -------------------------------------------
+    def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        ref = ctypes.py_object(item)
+        ctypes.pythonapi.Py_IncRef(ref)
+        # CPython: id(obj) IS the PyObject* address
+        rc = self._lib.nns_oq_push(
+            self._h, id(item), -1.0 if timeout is None else float(timeout)
+        )
+        if rc != 0:
+            ctypes.pythonapi.Py_DecRef(ref)
+            raise _pyqueue.Full
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, timeout=0.0)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        out = ctypes.c_void_p()
+        rc = self._lib.nns_oq_pop(
+            self._h, -1.0 if timeout is None else float(timeout),
+            ctypes.byref(out),
+        )
+        if rc != 0:
+            raise _pyqueue.Empty
+        obj = ctypes.cast(out, ctypes.py_object).value
+        ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
+        return obj
+
+    def get_nowait(self) -> Any:
+        return self.get(timeout=0.0)
+
+    def qsize(self) -> int:
+        return int(self._lib.nns_oq_size(self._h))
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    @property
+    def maxsize(self) -> int:  # parity with queue.Queue introspection
+        return 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        """Wake all waiters, drain, release refs, free the native queue."""
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.nns_oq_close(self._h)
+        while True:
+            try:
+                self.get(timeout=0.0)
+            except _pyqueue.Empty:
+                break
+        self._lib.nns_oq_destroy(self._h)
+        self._h = None
+
+    def __del__(self):  # pragma: no cover — GC order dependent
+        try:
+            if not self._closed and self._h:
+                self.close()
+        except Exception:
+            pass
+
+
+class BufferPool:
+    """Aligned recycled buffers (≙ gst_tensor_allocator): acquire() returns
+    a writable memoryview over an aligned block; release() recycles it."""
+
+    def __init__(self, block_size: int, prealloc: int = 4, alignment: int = 64):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._h = lib.nns_pool_create(block_size, prealloc, alignment)
+        if not self._h:
+            raise ValueError("bad pool parameters (alignment power of two?)")
+        self.block_size = block_size
+
+    def acquire(self):
+        ptr = self._lib.nns_pool_acquire(self._h)
+        if not ptr:
+            raise MemoryError("pool allocation failed")
+        buf = (ctypes.c_char * self.block_size).from_address(ptr)
+        mv = memoryview(buf).cast("B")
+        return ptr, mv
+
+    def release(self, ptr: int) -> None:
+        self._lib.nns_pool_release(self._h, ptr)
+
+    @property
+    def outstanding(self) -> int:
+        return int(self._lib.nns_pool_outstanding(self._h))
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.nns_pool_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.destroy()
+        except Exception:
+            pass

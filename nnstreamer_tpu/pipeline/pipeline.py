@@ -159,13 +159,15 @@ class Pipeline:
         )
         if self._pending_sinks == 0:
             self._sinks_done.set()
-        # mailboxes for every element with sink pads
+        # mailboxes for every element with sink pads — native C++ condvar
+        # queues when the core library is available (immediate wakeups, GIL
+        # released while blocked), stdlib queue.Queue otherwise
         for el in self.elements.values():
             if not isinstance(el, SourceElement):
                 size = self.default_queue_size
                 if "max-buffers" in el.props and el.props["max-buffers"]:
                     size = int(el.props["max-buffers"])
-                el._mailbox = queue.Queue(maxsize=size)
+                el._mailbox = self._make_mailbox(size)
         self._stop_flag.clear()
         for el in self.elements.values():
             target = self._run_source if isinstance(el, SourceElement) else self._run_element
@@ -175,6 +177,16 @@ class Pipeline:
             t.start()
         self._started = True
         return self
+
+    def _make_mailbox(self, size: int):
+        try:
+            from ..native.runtime import NativeMailbox, available
+
+            if available():
+                return NativeMailbox(size)
+        except Exception:  # pragma: no cover — toolchain quirks
+            self.log.exception("native mailbox unavailable; using queue.Queue")
+        return queue.Queue(maxsize=size)
 
     def stop(self) -> None:
         self._stop_flag.set()
