@@ -1,0 +1,87 @@
+"""PoseNet (flax) — keypoint heatmap model for the pose decoder.
+
+The reference's pose demo decodes PoseNet heatmaps with ``tensor_decoder
+mode=pose_estimation`` (``tensordec-pose.c``): tensor 0 = heatmaps
+(grid_h, grid_w, K), optional tensor 1 = offsets (grid_h, grid_w, 2K) for
+``option4=heatmap-offset``.  This module: MobileNet-v2 backbone truncated
+at stride 16 + 1x1 heads, emitting exactly those tensors (K = 17 COCO
+keypoints by default).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .mobilenet_v2 import _CFG, ConvBN, InvertedResidual, _make_divisible
+
+
+class PoseNet(nn.Module):
+    num_keypoints: int = 17
+    with_offsets: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+        else:
+            x = x.astype(self.dtype)
+        c = _make_divisible(32)
+        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype)(x)
+        for t, ch, n, s in _CFG:
+            if ch > 96:
+                break  # truncate at stride 16 (pose wants resolution)
+            out_c = _make_divisible(ch)
+            for i in range(n):
+                x = InvertedResidual(out_c, s if i == 0 else 1, t,
+                                     dtype=self.dtype)(x)
+        x32 = x.astype(jnp.float32)
+        heat = nn.Conv(self.num_keypoints, (1, 1), dtype=jnp.float32,
+                       name="heatmap")(x32)
+        if not self.with_offsets:
+            return (heat,)
+        off = nn.Conv(2 * self.num_keypoints, (1, 1), dtype=jnp.float32,
+                      name="offsets")(x32)
+        return heat, off
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [images_u8 (N,257,257,3)]) ->
+    [heatmap (N,gh,gw,K)[, offsets (N,gh,gw,2K)]] — feed ``tensor_decoder
+    mode=pose_estimation``."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        props.get("dtype", "bfloat16")
+    ]
+    size = int(props.get("size", "257"))
+    kpts = int(props.get("keypoints", "17"))
+    with_off = props.get("offsets", "1") not in ("0", "false")
+    model = PoseNet(num_keypoints=kpts, with_offsets=with_off, dtype=dtype)
+    params = model.init(
+        jax.random.PRNGKey(int(props.get("seed", "0"))),
+        jnp.zeros((1, size, size, 3), jnp.uint8),
+    )
+    gh = gw = (size + 15) // 16
+
+    def fn(params, inputs):
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        outs = model.apply(params, x)
+        return [o[0] for o in outs] if single else list(outs)
+
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_tensors = [TensorSpec((gh, gw, kpts), np.float32, "heatmap")]
+    if with_off:
+        out_tensors.append(TensorSpec((gh, gw, 2 * kpts), np.float32, "offsets"))
+    out_spec = StreamSpec(tuple(out_tensors), FORMAT_STATIC)
+    return fn, params, in_spec, out_spec

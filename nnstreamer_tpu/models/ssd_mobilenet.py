@@ -1,0 +1,146 @@
+"""SSD-MobileNet-v2 (flax) — detection head for the bounding-box decoder.
+
+The reference's detection demos run ssd_mobilenet_v2 through TFLite with
+``tensor_decoder mode=bounding_boxes option1=mobilenet-ssd
+option3=box-priors.txt`` (``tensordec-boundingbox.c`` update_mobilenet_ssd).
+This module is the TPU-native model for that pipeline: MobileNet-v2
+backbone (shared blocks from :mod:`.mobilenet_v2`) + SSD box/class heads
+over 6 feature scales.
+
+Outputs match the decoder contract exactly:
+  * loc    (P, 4)  raw (yc, xc, h, w) offsets (decoder divides by the
+           10/10/5/5 scale factors and applies the priors)
+  * scores (P, C)  logits (decoder applies sigmoid)
+
+:func:`anchors` generates the matching priors (yc, xc, h, w, normalized)
+and :func:`write_box_priors` emits the 4-row ``box-priors.txt`` file the
+decoder's option3 loads (``mobilenet_ssd_load_box_priors``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .mobilenet_v2 import _CFG, ConvBN, InvertedResidual, _make_divisible
+
+# one (grid, scale, aspect-ratios) row per SSD feature map, 300x300 layout
+_FEATURE_MAPS: Sequence[Tuple[int, float]] = (
+    (19, 0.2), (10, 0.35), (5, 0.5), (3, 0.65), (2, 0.8), (1, 0.95),
+)
+_ASPECTS = (1.0, 2.0, 0.5)
+
+
+def anchors() -> np.ndarray:
+    """SSD priors [P, 4] = (yc, xc, h, w), normalized to [0, 1]."""
+    out: List[Tuple[float, float, float, float]] = []
+    for i, (grid, scale) in enumerate(_FEATURE_MAPS):
+        nxt = _FEATURE_MAPS[i + 1][1] if i + 1 < len(_FEATURE_MAPS) else 1.0
+        for y, x in itertools.product(range(grid), repeat=2):
+            yc = (y + 0.5) / grid
+            xc = (x + 0.5) / grid
+            for ar in _ASPECTS:
+                out.append((yc, xc, scale / np.sqrt(ar), scale * np.sqrt(ar)))
+            out.append((yc, xc, np.sqrt(scale * nxt), np.sqrt(scale * nxt)))
+    return np.asarray(out, np.float64)
+
+
+def num_priors() -> int:
+    return sum(g * g * (len(_ASPECTS) + 1) for g, _ in _FEATURE_MAPS)
+
+
+def write_box_priors(path: str) -> str:
+    """Write the decoder's option3 file: 4 whitespace rows (yc, xc, h, w)."""
+    pri = anchors().T  # [4, P]
+    with open(path, "w", encoding="utf-8") as f:
+        for row in pri:
+            f.write(" ".join(f"{v:.8f}" for v in row) + "\n")
+    return path
+
+
+class SSDMobileNetV2(nn.Module):
+    num_classes: int = 91
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+        else:
+            x = x.astype(self.dtype)
+        feats: List[jnp.ndarray] = []
+        c = _make_divisible(32)
+        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype)(x)
+        for t, ch, n, s in _CFG:
+            out_c = _make_divisible(ch)
+            for i in range(n):
+                x = InvertedResidual(out_c, s if i == 0 else 1, t,
+                                     dtype=self.dtype)(x)
+            if ch == 96:
+                feats.append(x)   # stride 16 -> 19x19 @ 300
+        x = ConvBN(_make_divisible(1280), (1, 1), dtype=self.dtype)(x)
+        feats.append(x)           # stride 32 -> 10x10
+        # extra SSD feature layers down to 1x1
+        for ch in (512, 256, 256, 128):
+            x = ConvBN(ch // 2, (1, 1), dtype=self.dtype)(x)
+            x = ConvBN(ch, (3, 3), strides=2, dtype=self.dtype)(x)
+            feats.append(x)
+
+        locs, confs = [], []
+        per_cell = len(_ASPECTS) + 1
+        for i, f in enumerate(feats):
+            B = f.shape[0]
+            loc = nn.Conv(per_cell * 4, (3, 3), padding="SAME",
+                          dtype=jnp.float32, name=f"loc{i}")(
+                f.astype(jnp.float32))
+            conf = nn.Conv(per_cell * self.num_classes, (3, 3),
+                           padding="SAME", dtype=jnp.float32,
+                           name=f"conf{i}")(f.astype(jnp.float32))
+            locs.append(loc.reshape(B, -1, 4))
+            confs.append(conf.reshape(B, -1, self.num_classes))
+        return jnp.concatenate(locs, 1), jnp.concatenate(confs, 1)
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [images_u8 (N,300,300,3)]) ->
+    [loc (N,P,4), scores (N,P,C)]."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        props.get("dtype", "bfloat16")
+    ]
+    size = int(props.get("size", "300"))
+    classes = int(props.get("classes", "91"))
+    model = SSDMobileNetV2(num_classes=classes, dtype=dtype)
+    params = model.init(
+        jax.random.PRNGKey(int(props.get("seed", "0"))),
+        jnp.zeros((1, size, size, 3), jnp.uint8),
+    )
+
+    def fn(params, inputs):
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        loc, conf = model.apply(params, x)
+        if single:
+            return [loc[0], conf[0]]
+        return [loc, conf]
+
+    P = num_priors()
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (
+            TensorSpec((P, 4), np.float32, "loc"),
+            TensorSpec((P, classes), np.float32, "scores"),
+        ),
+        FORMAT_STATIC,
+    )
+    return fn, params, in_spec, out_spec

@@ -1,0 +1,196 @@
+"""YOLOv5s (flax) — single-stage detector for the yolov5 decoder mode.
+
+The reference decodes yolov5 exports with ``tensor_decoder
+mode=bounding_boxes option1=yolov5`` expecting one tensor ``[N, 5+C]`` of
+(cx, cy, w, h, objectness, class...) — normalized coordinates with
+``option3`` scaled=0 (``tensordec-boundingbox.c`` yolov5 path).  This is a
+from-scratch flax YOLOv5s-style network (CSP backbone, SPPF, PANet-lite
+neck, 3-scale anchored detect head) whose grid/anchor decode runs INSIDE
+the jitted program — one fused XLA executable emitting the final [N, 5+C]
+tensor, TPU-style (no host post-processing before the decoder).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+
+# (stride, anchors (w,h) in px @ 640) — standard yolov5 anchor table
+_ANCHORS: Sequence[Tuple[int, Tuple[Tuple[float, float], ...]]] = (
+    (8, ((10, 13), (16, 30), (33, 23))),
+    (16, ((30, 61), (62, 45), (59, 119))),
+    (32, ((116, 90), (156, 198), (373, 326))),
+)
+
+
+class ConvBnSiLU(nn.Module):
+    features: int
+    kernel: int = 1
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        return x * jax.nn.sigmoid(x)  # SiLU
+
+
+class Bottleneck(nn.Module):
+    features: int
+    shortcut: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = ConvBnSiLU(self.features, 1, dtype=self.dtype)(x)
+        h = ConvBnSiLU(self.features, 3, dtype=self.dtype)(h)
+        return x + h if self.shortcut and x.shape[-1] == self.features else h
+
+
+class C3(nn.Module):
+    features: int
+    n: int = 1
+    shortcut: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.features // 2
+        a = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
+        for _ in range(self.n):
+            a = Bottleneck(c, self.shortcut, dtype=self.dtype)(a)
+        b = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
+        return ConvBnSiLU(self.features, 1, dtype=self.dtype)(
+            jnp.concatenate([a, b], -1)
+        )
+
+
+class SPPF(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.features // 2
+        x = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
+        p1 = nn.max_pool(x, (5, 5), padding="SAME")
+        p2 = nn.max_pool(p1, (5, 5), padding="SAME")
+        p3 = nn.max_pool(p2, (5, 5), padding="SAME")
+        return ConvBnSiLU(self.features, 1, dtype=self.dtype)(
+            jnp.concatenate([x, p1, p2, p3], -1)
+        )
+
+
+def _upsample2(x):
+    B, H, W, C = x.shape
+    return jax.image.resize(x, (B, H * 2, W * 2, C), "nearest")
+
+
+class YOLOv5s(nn.Module):
+    num_classes: int = 80
+    size: int = 640
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) / 255.0
+        else:
+            x = x.astype(self.dtype)
+        d = self.dtype
+        # backbone (depth/width of the "s" variant)
+        x = ConvBnSiLU(32, 6, 2, dtype=d)(x)       # P1/2
+        x = ConvBnSiLU(64, 3, 2, dtype=d)(x)       # P2/4
+        x = C3(64, 1, dtype=d)(x)
+        x = ConvBnSiLU(128, 3, 2, dtype=d)(x)      # P3/8
+        p3 = C3(128, 2, dtype=d)(x)
+        x = ConvBnSiLU(256, 3, 2, dtype=d)(p3)     # P4/16
+        p4 = C3(256, 3, dtype=d)(x)
+        x = ConvBnSiLU(512, 3, 2, dtype=d)(p4)     # P5/32
+        x = C3(512, 1, dtype=d)(x)
+        p5 = SPPF(512, dtype=d)(x)
+        # neck (FPN + PAN)
+        h5 = ConvBnSiLU(256, 1, dtype=d)(p5)
+        h4 = C3(256, 1, shortcut=False, dtype=d)(
+            jnp.concatenate([_upsample2(h5), p4], -1))
+        h4r = ConvBnSiLU(128, 1, dtype=d)(h4)
+        h3 = C3(128, 1, shortcut=False, dtype=d)(
+            jnp.concatenate([_upsample2(h4r), p3], -1))      # out P3
+        h4o = C3(256, 1, shortcut=False, dtype=d)(
+            jnp.concatenate([ConvBnSiLU(128, 3, 2, dtype=d)(h3), h4r], -1))
+        h5o = C3(512, 1, shortcut=False, dtype=d)(
+            jnp.concatenate([ConvBnSiLU(256, 3, 2, dtype=d)(h4o), h5], -1))
+
+        # detect head: per scale, raw conv -> sigmoid -> grid/anchor decode
+        outs = []
+        no = 5 + self.num_classes
+        for i, (feat, (stride, anchor_list)) in enumerate(
+            zip((h3, h4o, h5o), _ANCHORS)
+        ):
+            na = len(anchor_list)
+            raw = nn.Conv(na * no, (1, 1), dtype=jnp.float32,
+                          name=f"detect{i}")(feat.astype(jnp.float32))
+            B, H, W, _ = raw.shape
+            raw = raw.reshape(B, H, W, na, no)
+            y = jax.nn.sigmoid(raw)
+            gy, gx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+            grid = jnp.stack([gx, gy], -1).astype(jnp.float32)  # (H,W,2) x,y
+            anc = jnp.asarray(anchor_list, jnp.float32)          # (na,2) w,h
+            xy = (y[..., :2] * 2.0 - 0.5 + grid[:, :, None]) * stride
+            wh = (y[..., 2:4] * 2.0) ** 2 * anc[None, None]
+            box = jnp.concatenate([xy, wh], -1) / self.size  # normalized
+            outs.append(
+                jnp.concatenate([box, y[..., 4:]], -1).reshape(B, -1, no)
+            )
+        return jnp.concatenate(outs, 1)  # (B, N, 5+C)
+
+
+def num_candidates(size: int) -> int:
+    return sum(
+        (size // s) * (size // s) * len(a) for s, a in _ANCHORS
+    )
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [images_u8 (N,size,size,3)]) ->
+    [pred (N, boxes, 5+C)] — feed ``tensor_decoder mode=bounding_boxes
+    option1=yolov5``."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        props.get("dtype", "bfloat16")
+    ]
+    size = int(props.get("size", "640"))
+    if size % 32:
+        raise ValueError("yolov5 input size must be a multiple of 32")
+    classes = int(props.get("classes", "80"))
+    model = YOLOv5s(num_classes=classes, size=size, dtype=dtype)
+    params = model.init(
+        jax.random.PRNGKey(int(props.get("seed", "0"))),
+        jnp.zeros((1, size, size, 3), jnp.uint8),
+    )
+
+    def fn(params, inputs):
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        out = model.apply(params, x)
+        return [out[0] if single else out]
+
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((num_candidates(size), 5 + classes), np.float32, "pred"),),
+        FORMAT_STATIC,
+    )
+    return fn, params, in_spec, out_spec

@@ -35,10 +35,24 @@ struct NnsQueue {
   std::mutex m;
   std::condition_variable not_full;
   std::condition_variable not_empty;
+  std::condition_variable idle; /* destroy waits for parked waiters */
   std::deque<void *> items;
   size_t capacity;
+  int waiters = 0;
   bool closed = false;
 };
+
+namespace {
+struct WaiterGuard {
+  NnsQueue *q; /* lock must be held at construction and destruction */
+  explicit WaiterGuard (NnsQueue *q_) : q (q_) { q->waiters++; }
+  ~WaiterGuard ()
+  {
+    if (--q->waiters == 0)
+      q->idle.notify_all ();
+  }
+};
+} // namespace
 
 void *nns_oq_create (size_t capacity)
 {
@@ -52,6 +66,7 @@ int nns_oq_push (void *h, void *obj, double timeout_s)
 {
   auto *q = static_cast<NnsQueue *> (h);
   std::unique_lock<std::mutex> lk (q->m);
+  WaiterGuard wg (q);
   auto ready = [q] { return q->closed || q->items.size () < q->capacity; };
   if (timeout_s < 0) {
     q->not_full.wait (lk, ready);
@@ -71,6 +86,7 @@ int nns_oq_pop (void *h, double timeout_s, void **out)
 {
   auto *q = static_cast<NnsQueue *> (h);
   std::unique_lock<std::mutex> lk (q->m);
+  WaiterGuard wg (q);
   auto ready = [q] { return q->closed || !q->items.empty (); };
   if (timeout_s < 0) {
     q->not_empty.wait (lk, ready);
@@ -106,10 +122,19 @@ void nns_oq_close (void *h)
 }
 
 /* caller must have drained (or accept leaking the queued pointers' refs —
- * the Python wrapper drains first) */
+ * the Python wrapper drains first).  Blocks until every parked waiter has
+ * left push/pop so the mutex/condvars are never freed under a waiter. */
 void nns_oq_destroy (void *h)
 {
-  delete static_cast<NnsQueue *> (h);
+  auto *q = static_cast<NnsQueue *> (h);
+  {
+    std::unique_lock<std::mutex> lk (q->m);
+    q->closed = true;
+    q->not_full.notify_all ();
+    q->not_empty.notify_all ();
+    q->idle.wait (lk, [q] { return q->waiters == 0; });
+  }
+  delete q;
 }
 
 /* ------------------------------------------------------------------ *
@@ -157,12 +182,18 @@ void *nns_pool_acquire (void *h)
   return b;
 }
 
-void nns_pool_release (void *h, void *block)
+/* 0 = ok, -1 = double release (ignored: the block stays usable once) */
+int nns_pool_release (void *h, void *block)
 {
   auto *p = static_cast<NnsPool *> (h);
   std::lock_guard<std::mutex> lk (p->m);
-  p->outstanding--;
+  for (void *b : p->free_blocks)
+    if (b == block)
+      return -1;
+  if (p->outstanding > 0)
+    p->outstanding--;
   p->free_blocks.push_back (block);
+  return 0;
 }
 
 size_t nns_pool_block_size (void *h)

@@ -38,11 +38,14 @@ _build_failed = False
 
 def _build() -> Optional[str]:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+    if _so_fresh():
         return _SO
+    # compile to a temp name and rename atomically: a concurrent loader (or
+    # a second process) must never dlopen a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-        "-o", _SO, _SRC,
+        "-o", tmp, _SRC,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
@@ -52,6 +55,7 @@ def _build() -> Optional[str]:
     if r.returncode != 0:
         log.warning("native core build failed:\n%s", r.stderr)
         return None
+    os.replace(tmp, _SO)
     return _SO
 
 
@@ -77,8 +81,13 @@ def _load(block: bool = False) -> Optional[ctypes.CDLL]:
     if not _so_fresh() and not block:
         with _build_lock:
             if _bg_build is None or not _bg_build.is_alive():
+                def _bg():
+                    global _build_failed
+                    if _build() is None:
+                        _build_failed = True  # fail once, fall back forever
+
                 _bg_build = threading.Thread(
-                    target=_build, name="nns-native-build", daemon=True
+                    target=_bg, name="nns-native-build", daemon=True
                 )
                 _bg_build.start()
         return None
@@ -111,6 +120,7 @@ def _load(block: bool = False) -> Optional[ctypes.CDLL]:
         ]
         lib.nns_pool_acquire.restype = ctypes.c_void_p
         lib.nns_pool_acquire.argtypes = [ctypes.c_void_p]
+        lib.nns_pool_release.restype = ctypes.c_int
         lib.nns_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.nns_pool_block_size.restype = ctypes.c_size_t
         lib.nns_pool_block_size.argtypes = [ctypes.c_void_p]
@@ -142,6 +152,8 @@ class NativeMailbox:
 
     # -- stdlib-compatible subset -------------------------------------------
     def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            raise _pyqueue.Full
         ref = ctypes.py_object(item)
         ctypes.pythonapi.Py_IncRef(ref)
         # CPython: id(obj) IS the PyObject* address
@@ -155,7 +167,7 @@ class NativeMailbox:
     def put_nowait(self, item: Any) -> None:
         self.put(item, timeout=0.0)
 
-    def get(self, timeout: Optional[float] = None) -> Any:
+    def _pop(self, timeout: Optional[float]) -> Any:
         out = ctypes.c_void_p()
         rc = self._lib.nns_oq_pop(
             self._h, -1.0 if timeout is None else float(timeout),
@@ -167,10 +179,17 @@ class NativeMailbox:
         ctypes.pythonapi.Py_DecRef(ctypes.py_object(obj))
         return obj
 
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise _pyqueue.Empty
+        return self._pop(timeout)
+
     def get_nowait(self) -> Any:
         return self.get(timeout=0.0)
 
     def qsize(self) -> int:
+        if self._closed:
+            return 0
         return int(self._lib.nns_oq_size(self._h))
 
     def empty(self) -> bool:
@@ -182,23 +201,28 @@ class NativeMailbox:
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:
-        """Wake all waiters, drain, release refs, free the native queue."""
+        """Wake all waiters, drain, release refs.  The native queue itself
+        is freed at GC (__del__): destroying here could free it under a
+        straggler thread entering put/get — after close they just see the
+        closed flag and raise, against still-valid memory."""
         if self._closed:
             return
         self._closed = True
         self._lib.nns_oq_close(self._h)
         while True:
             try:
-                self.get(timeout=0.0)
+                self._pop(timeout=0.0)
             except _pyqueue.Empty:
                 break
-        self._lib.nns_oq_destroy(self._h)
-        self._h = None
 
     def __del__(self):  # pragma: no cover — GC order dependent
         try:
-            if not self._closed and self._h:
+            if self._h:
                 self.close()
+                # no references left -> no concurrent callers; destroy
+                # still waits for any waiter mid-exit in C++
+                self._lib.nns_oq_destroy(self._h)
+                self._h = None
         except Exception:
             pass
 
@@ -226,7 +250,8 @@ class BufferPool:
         return ptr, mv
 
     def release(self, ptr: int) -> None:
-        self._lib.nns_pool_release(self._h, ptr)
+        if self._lib.nns_pool_release(self._h, ptr) != 0:
+            raise ValueError("double release of pool block")
 
     @property
     def outstanding(self) -> int:

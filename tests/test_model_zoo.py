@@ -1,0 +1,99 @@
+"""Detection/pose model families wired to their decoders (BASELINE rows:
+SSD-MobileNet + bounding-box decode, YOLOv5s, PoseNet + pose decode)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import available, build
+
+
+def _img(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (size, size, 3), np.uint8
+    )
+
+
+class TestZoo:
+    def test_families_registered(self):
+        names = available()
+        for want in ("mobilenet_v2", "ssd_mobilenet_v2", "yolov5s",
+                     "posenet", "mnist_cnn", "transformer"):
+            assert want in names
+
+
+class TestSSD:
+    def test_shapes_and_decode(self, tmp_path):
+        from nnstreamer_tpu.decoders.bounding_box import BoundingBoxes
+        from nnstreamer_tpu.models.ssd_mobilenet import (
+            num_priors, write_box_priors,
+        )
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        fn, params, in_spec, out_spec = build(
+            "ssd_mobilenet_v2", {"dtype": "float32", "classes": "11"}
+        )
+        loc, scores = fn(params, [_img(300)])
+        P = num_priors()
+        assert loc.shape == (P, 4)
+        assert scores.shape == (P, 11)
+        priors = write_box_priors(str(tmp_path / "box-priors.txt"))
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(11)))
+        dec = BoundingBoxes()
+        dec.set_options(
+            ["mobilenet-ssd", str(labels), priors, "300:300", "300:300"]
+        )
+        out = dec.decode(
+            TensorFrame([np.asarray(loc), np.asarray(scores)]), in_spec
+        )
+        # random weights: just require a valid RGBA video frame out
+        assert out.tensors[0].shape == (300, 300, 4)
+
+
+class TestYolo:
+    def test_shapes_and_decode(self, tmp_path):
+        from nnstreamer_tpu.decoders.bounding_box import BoundingBoxes
+        from nnstreamer_tpu.models.yolov5 import num_candidates
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        size = 320
+        fn, params, in_spec, out_spec = build(
+            "yolov5s", {"dtype": "float32", "size": str(size), "classes": "5"}
+        )
+        pred = np.asarray(fn(params, [_img(size)])[0])
+        assert pred.shape == (num_candidates(size), 10)
+        # decoded boxes are normalized * size: all finite, obj/cls in [0,1]
+        assert np.isfinite(pred).all()
+        assert (pred[:, 4:] >= 0).all() and (pred[:, 4:] <= 1).all()
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(5)))
+        dec = BoundingBoxes()
+        dec.set_options(
+            ["yolov5", str(labels), "", f"{size}:{size}", f"{size}:{size}"]
+        )
+        out = dec.decode(TensorFrame([pred]), in_spec)
+        assert out.tensors[0].shape == (size, size, 4)
+
+    def test_size_must_be_multiple_of_32(self):
+        with pytest.raises(ValueError):
+            build("yolov5s", {"size": "100"})
+
+
+class TestPoseNet:
+    def test_shapes_and_decode(self):
+        from nnstreamer_tpu.decoders.pose import PoseEstimation
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        fn, params, in_spec, out_spec = build(
+            "posenet", {"dtype": "float32", "size": "129", "keypoints": "7"}
+        )
+        heat, off = fn(params, [_img(129)])
+        gh = (129 + 15) // 16
+        assert heat.shape == (gh, gh, 7)
+        assert off.shape == (gh, gh, 14)
+        dec = PoseEstimation()
+        dec.set_options(["129:129", "129:129", "", "heatmap-offset"])
+        out = dec.decode(
+            TensorFrame([np.asarray(heat), np.asarray(off)]), in_spec
+        )
+        assert out.tensors[0].shape[-1] == 4  # RGBA overlay

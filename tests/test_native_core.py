@@ -110,6 +110,43 @@ class TestBufferPool:
         with pytest.raises(ValueError):
             runtime.BufferPool(128, alignment=48)
 
+    def test_double_release_rejected(self):
+        pool = runtime.BufferPool(64, prealloc=1)
+        ptr, mv = pool.acquire()
+        del mv
+        pool.release(ptr)
+        with pytest.raises(ValueError):
+            pool.release(ptr)
+        pool.destroy()
+
+    def test_use_after_close_raises_not_crashes(self):
+        mb = runtime.NativeMailbox(2)
+        mb.put("x")
+        mb.close()
+        with pytest.raises(queue.Full):
+            mb.put("y")
+        with pytest.raises(queue.Empty):
+            mb.get(timeout=0.0)
+        assert mb.qsize() == 0
+        mb.close()  # idempotent
+
+    def test_close_while_waiter_parked(self):
+        mb = runtime.NativeMailbox(1)
+        errs = []
+
+        def consumer():
+            try:
+                mb.get(timeout=5)
+            except queue.Empty:
+                errs.append("empty")
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.1)  # consumer parked in the native wait
+        mb.close()       # must wake it and not free memory under it
+        t.join(timeout=5)
+        assert errs == ["empty"]
+
 
 class TestPipelineUsesNative:
     def test_pipeline_runs_on_native_mailboxes(self):
