@@ -1,0 +1,39 @@
+"""Hardware capability probe.
+
+Reference: ``gst/nnstreamer/hw_accel.c`` (runtime NEON/SIMD detection via
+hwcap, 64 LoC) — used to pick accelerated code paths.  The TPU analog
+probes the XLA backend: platform, device kind/count, and whether a real
+accelerator (vs host CPU) is attached; backends use it to choose dtypes
+(bfloat16 on TPU) and batching defaults.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, List
+
+
+@lru_cache(maxsize=1)
+def probe() -> Dict[str, object]:
+    """One-time device probe: {'platform', 'device_kind', 'num_devices',
+    'accelerated', 'devices'}."""
+    import jax
+
+    devs = jax.devices()
+    platform = devs[0].platform if devs else "none"
+    return {
+        "platform": platform,
+        "device_kind": devs[0].device_kind if devs else "none",
+        "num_devices": len(devs),
+        "accelerated": platform not in ("cpu", "none"),
+        "devices": [str(d) for d in devs],
+    }
+
+
+def has_accelerator() -> bool:
+    return bool(probe()["accelerated"])
+
+
+def preferred_dtype() -> str:
+    """bfloat16 on accelerators (MXU-native), float32 on host CPU."""
+    return "bfloat16" if has_accelerator() else "float32"

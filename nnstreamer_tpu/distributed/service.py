@@ -40,6 +40,11 @@ from .wire import decode_frame, encode_frame
 log = get_logger("distributed")
 
 _ident = lambda b: b  # bytes-in/bytes-out (de)serializers  # noqa: E731
+identity_codec = _ident  # shared by every gRPC element (query/edge/stream)
+GRPC_OPTS = [
+    ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+    ("grpc.max_send_message_length", 512 * 1024 * 1024),
+]
 
 
 # ---------------------------------------------------------------------------

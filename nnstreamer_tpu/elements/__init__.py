@@ -25,6 +25,7 @@ for _mod in (
     "debug",
     "src_iio",
     "mqtt",
+    "grpc_io",
 ):
     # only skip modules that are not built yet; real import errors propagate
     if _os.path.exists(_os.path.join(_here, _mod + ".py")):

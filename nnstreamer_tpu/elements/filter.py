@@ -101,6 +101,18 @@ def _parse_combination(text: str) -> Optional[List[Tuple[str, int]]]:
     return out or None
 
 
+def _stack_tensors(arrs: List[Any]):
+    """Stack per-frame tensors into a batch WITHOUT pulling device-resident
+    arrays to host: jax inputs stack on device (one concat op), numpy stacks
+    on host (single host->device transfer happens inside the backend)."""
+    a0 = arrs[0]
+    if type(a0).__module__.split(".")[0] == "jaxlib" or hasattr(a0, "sharding"):
+        import jax.numpy as jnp
+
+        return jnp.stack(arrs)
+    return np.stack([np.asarray(a) for a in arrs])
+
+
 @element("tensor_filter")
 class TensorFilter(TransformElement):
     PROPERTIES = {
@@ -117,6 +129,9 @@ class TensorFilter(TransformElement):
         "shared-tensor-filter-key": Property(str, "", "share one backend instance"),
         "invoke-dynamic": Property(bool, False, "output schema varies per buffer"),
         "max-batch": Property(int, 1, "micro-batch up to N queued frames into one invoke"),
+        "batch-timeout": Property(
+            int, 0, "ms to wait filling a micro-batch (0 = only drain queued)"
+        ),
         # ≙ GstShark/NNShark tracing (SURVEY §5.1) done the XLA-native way
         "trace": Property(int, 0, "1 = capture a jax.profiler trace while running"),
         "trace-dir": Property(str, "/tmp/nns_tpu_trace", "profiler output dir"),
@@ -143,6 +158,10 @@ class TensorFilter(TransformElement):
         if be is not None and be.supports_batch:
             return max(1, int(self.props["max-batch"]))
         return 1
+
+    @property
+    def batch_wait_s(self) -> float:
+        return max(0, int(self.props["batch-timeout"])) / 1000.0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -308,7 +327,7 @@ class TensorFilter(TransformElement):
         ]
         ntensors = len(per_frame[0])
         batched = [
-            np.stack([np.asarray(pf[t]) for pf in per_frame]) for t in range(ntensors)
+            _stack_tensors([pf[t] for pf in per_frame]) for t in range(ntensors)
         ]
         import time
 

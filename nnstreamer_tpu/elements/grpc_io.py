@@ -1,0 +1,234 @@
+"""tensor_src_grpc / tensor_sink_grpc — raw tensor streams over gRPC.
+
+Reference: ``ext/nnstreamer/tensor_source/tensor_src_grpc.c`` (515 LoC) and
+``tensor_sink/tensor_sink_grpc.c`` (396) over
+``nnstreamer_grpc_{common,protobuf,flatbuf}.cc``: either element can run as
+the gRPC *server* or *client* (``server`` prop), with protobuf/flatbuf IDL.
+Unlike tensor_query there is no request/response pairing — this is a
+one-way tensor pipe.
+
+TPU build mapping: the wire IDL is the in-repo flex-header format
+(:mod:`nnstreamer_tpu.distributed.wire` — the same schema the query/edge
+elements speak); two RPCs cover both role combinations:
+
+  * ``nns.Stream/Send``  (unary)            sink-as-client  -> src-as-server
+  * ``nns.Stream/Pull``  (server streaming) src-as-client   <- sink-as-server
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+from ..distributed import wire
+from ..distributed.service import GRPC_OPTS as _OPTS, identity_codec as _ident
+from ..pipeline.element import (
+    ElementError,
+    Property,
+    SinkElement,
+    SourceElement,
+    element,
+)
+
+
+class _StreamServer:
+    """One gRPC server hosting Send (inbound) and Pull (outbound) for an
+    element running in server mode."""
+
+    def __init__(self, host: str, port: int, depth: int):
+        self.inbox: "_queue.Queue[bytes]" = _queue.Queue(depth)
+        self.outbox: "_queue.Queue[Optional[bytes]]" = _queue.Queue(depth)
+        self._stop = threading.Event()
+        handlers = {
+            "Send": grpc.unary_unary_rpc_method_handler(
+                self._send, request_deserializer=_ident,
+                response_serializer=_ident,
+            ),
+            "Pull": grpc.unary_stream_rpc_method_handler(
+                self._pull, request_deserializer=_ident,
+                response_serializer=_ident,
+            ),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8), options=_OPTS
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("nns.Stream", handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise ElementError(f"cannot bind gRPC stream server on {port}")
+        self._server.start()
+
+    def _send(self, request: bytes, context) -> bytes:
+        try:
+            self.inbox.put(request, timeout=10.0)
+        except _queue.Full:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "inbox full")
+        return b""
+
+    def _pull(self, request: bytes, context):
+        while not self._stop.is_set():
+            try:
+                item = self.outbox.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if item is None:  # EOS
+                return
+            yield item
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop(grace=0.5)
+
+
+@element("tensor_sink_grpc")
+class GrpcSink(SinkElement):
+    PROPERTIES = {
+        "host": Property(str, "127.0.0.1", "bind/connect host"),
+        "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
+        "server": Property(bool, False, "run as gRPC server (clients Pull)"),
+        "idl": Property(str, "flex", "wire IDL (parity prop; flex header)"),
+        "max-buffers": Property(int, 64, "stream queue depth"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._srv: Optional[_StreamServer] = None
+        self._channel = None
+        self._stub = None
+        self.bound_port: Optional[int] = None
+
+    def start(self) -> None:
+        if self.props["server"]:
+            self._srv = _StreamServer(
+                self.props["host"], self.props["port"],
+                self.props["max-buffers"],
+            )
+            self.bound_port = self._srv.port
+        else:
+            self._channel = grpc.insecure_channel(
+                f"{self.props['host']}:{self.props['port']}", options=_OPTS
+            )
+            self._stub = self._channel.unary_unary(
+                "/nns.Stream/Send",
+                request_serializer=_ident, response_deserializer=_ident,
+            )
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            try:  # signal EOS to pullers
+                self._srv.outbox.put_nowait(None)
+            except _queue.Full:
+                pass
+            self._srv.stop()
+            self._srv = None
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._stub = None
+
+    def render(self, frame: TensorFrame) -> None:
+        payload = wire.encode_frame(frame)
+        if self._srv is not None:
+            self._srv.outbox.put(payload, timeout=10.0)
+        elif self._stub is not None:
+            self._stub(payload, timeout=10.0)
+
+    def handle_eos(self, pad):
+        if self._srv is not None:
+            try:
+                self._srv.outbox.put(None, timeout=1.0)
+            except _queue.Full:
+                pass
+        return None
+
+
+@element("tensor_src_grpc")
+class GrpcSrc(SourceElement):
+    PROPERTIES = {
+        "host": Property(str, "127.0.0.1", "bind/connect host"),
+        "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
+        "server": Property(bool, True, "run as gRPC server (peers Send)"),
+        "idl": Property(str, "flex", "wire IDL (parity prop; flex header)"),
+        "num-buffers": Property(int, -1, "EOS after N frames (-1 = forever)"),
+        "timeout": Property(int, 10000, "ms without a frame before EOS"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._srv: Optional[_StreamServer] = None
+        self._channel = None
+        self.bound_port: Optional[int] = None
+
+    def output_spec(self) -> StreamSpec:
+        return ANY
+
+    def start(self) -> None:
+        if self.props["server"]:
+            self._srv = _StreamServer(
+                self.props["host"], self.props["port"], 64
+            )
+            self.bound_port = self._srv.port
+        else:
+            self._channel = grpc.insecure_channel(
+                f"{self.props['host']}:{self.props['port']}", options=_OPTS
+            )
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.stop()
+            self._srv = None
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def frames(self) -> Iterator[TensorFrame]:
+        limit = self.props["num-buffers"]
+        timeout_s = self.props["timeout"] / 1000.0
+        n = 0
+        if self._srv is not None:
+            inbox = self._srv.inbox
+        else:
+            # client mode: a reader thread feeds an inbox so the 'timeout'
+            # prop gives a real inter-frame deadline (a bare stream iterator
+            # would block forever on a stalled peer)
+            inbox = _queue.Queue(64)
+            pull = self._channel.unary_stream(
+                "/nns.Stream/Pull",
+                request_serializer=_ident, response_deserializer=_ident,
+            )
+
+            def _reader():
+                try:
+                    for payload in pull(b"", timeout=None):
+                        inbox.put(payload)
+                except grpc.RpcError as e:
+                    self.log.info("grpc pull ended: %s", e)
+
+            threading.Thread(
+                target=_reader, name=f"{self.name}-pull", daemon=True
+            ).start()
+        while limit < 0 or n < limit:
+            try:
+                payload = inbox.get(timeout=timeout_s)
+            except _queue.Empty:
+                self.log.info("grpc src timeout; ending stream")
+                return
+            frame = self._decode(payload)
+            if frame is not None:
+                n += 1
+                yield frame
+
+    def _decode(self, payload: bytes) -> Optional[TensorFrame]:
+        try:
+            return wire.decode_frame(payload)
+        except wire.WireError as e:
+            self.log.warning("undecodable grpc frame dropped: %s", e)
+            return None

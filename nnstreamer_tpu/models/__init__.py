@@ -39,4 +39,11 @@ def build(name: str, custom_props: Optional[Dict[str, str]] = None):
         mod = import_module(_ZOO[name])
     except ModuleNotFoundError as e:
         raise KeyError(f"model family {name!r} is not built yet: {e}") from None
-    return mod.build(custom_props or {})
+    props = dict(custom_props or {})
+    if "dtype" not in props:
+        # hw-probed default: bfloat16 on accelerators (MXU-native),
+        # float32 on host CPU (core/hw.py, ≙ reference hw_accel.c probe)
+        from ..core import hw
+
+        props["dtype"] = hw.preferred_dtype()
+    return mod.build(props)

@@ -115,6 +115,10 @@ def build(custom_props=None):
         props.get("dtype", "bfloat16")
     ]
     size = int(props.get("size", "300"))
+    if size != 300:
+        # anchors()/num_priors() encode the 300x300 feature-map layout;
+        # other sizes would desync priors from the head outputs
+        raise ValueError("ssd_mobilenet_v2 supports size=300 only")
     classes = int(props.get("classes", "91"))
     model = SSDMobileNetV2(num_classes=classes, dtype=dtype)
     params = model.init(

@@ -167,6 +167,9 @@ class Pipeline:
                 size = self.default_queue_size
                 if "max-buffers" in el.props and el.props["max-buffers"]:
                     size = int(el.props["max-buffers"])
+                # a micro-batching element needs its full batch to fit in the
+                # mailbox or batches can never form at max-batch size
+                size = max(size, getattr(el, "preferred_batch", 1))
                 el._mailbox = self._make_mailbox(size)
         self._stop_flag.clear()
         for el in self.elements.values():
@@ -315,10 +318,20 @@ class Pipeline:
                     # dispatch-amortization lever; no reference analog).
                     want = getattr(el, "preferred_batch", 1)
                     if want > 1 and hasattr(el, "handle_frame_batch"):
+                        # optional bounded wait to FILL the batch (amortizes
+                        # dispatch/transfer latency; batch-timeout prop) —
+                        # 0 keeps the lossless drain-what's-queued behavior
+                        deadline = time.monotonic() + getattr(
+                            el, "batch_wait_s", 0.0
+                        )
                         frames = [item]
                         while len(frames) < want:
                             try:
-                                p2, nxt = el._mailbox.get_nowait()
+                                wait = deadline - time.monotonic()
+                                if wait > 0:
+                                    p2, nxt = el._mailbox.get(timeout=wait)
+                                else:
+                                    p2, nxt = el._mailbox.get_nowait()
                             except queue.Empty:
                                 break
                             if isinstance(nxt, TensorFrame) and p2 == pad:

@@ -1,0 +1,75 @@
+"""tensor_src_grpc / tensor_sink_grpc — one-way tensor pipes over gRPC."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+class TestSendDirection:
+    def test_sink_client_to_src_server(self):
+        rx = parse_pipeline(
+            "tensor_src_grpc name=src server=true port=0 num-buffers=3 "
+            "timeout=15000 ! tensor_sink name=out"
+        )
+        rx.start()
+        port = rx["src"].bound_port
+        assert port
+
+        tx = parse_pipeline(
+            f"appsrc name=a ! tensor_sink_grpc server=false port={port}"
+        )
+        tx.start()
+        for i in range(3):
+            tx["a"].push(np.full((2, 2), i, np.int32), pts=i * 0.5)
+        tx["a"].end_of_stream()
+        tx.wait(timeout=15)
+        tx.stop()
+
+        rx.wait(timeout=30)
+        rx.stop()
+        frames = rx["out"].frames
+        assert len(frames) == 3
+        np.testing.assert_array_equal(
+            frames[2].tensors[0], np.full((2, 2), 2, np.int32)
+        )
+        assert frames[1].pts == pytest.approx(0.5)
+
+
+class TestPullDirection:
+    def test_src_client_pulls_from_sink_server(self):
+        tx = parse_pipeline(
+            "appsrc name=a ! tensor_sink_grpc name=s server=true port=0"
+        )
+        tx.start()
+        port = tx["s"].bound_port
+        assert port
+
+        rx = parse_pipeline(
+            f"tensor_src_grpc server=false port={port} num-buffers=2 ! "
+            "tensor_sink name=out"
+        )
+        rx.start()
+        time.sleep(0.2)  # let the Pull stream attach
+        for i in range(2):
+            tx["a"].push(np.float32([i, i + 1]))
+        rx.wait(timeout=30)
+        rx.stop()
+        tx["a"].end_of_stream()
+        tx.wait(timeout=15)
+        tx.stop()
+        frames = rx["out"].frames
+        assert len(frames) == 2
+        np.testing.assert_allclose(frames[1].tensors[0], [1.0, 2.0])
+
+    def test_src_server_timeout_eos(self):
+        rx = parse_pipeline(
+            "tensor_src_grpc server=true port=0 timeout=300 ! "
+            "tensor_sink name=out"
+        )
+        rx.start()
+        rx.wait(timeout=15)
+        rx.stop()
+        assert rx["out"].frames == []
