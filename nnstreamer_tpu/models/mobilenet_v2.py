@@ -98,12 +98,19 @@ class MobileNetV2(nn.Module):
     num_classes: int = 1001
     width_mult: float = 1.0
     dtype: Any = jnp.bfloat16
+    pallas_preprocess: bool = False
 
     @nn.compact
     def __call__(self, x):
-        # fused-in preprocess: uint8 [0,255] -> [-1, 1]
+        # fused-in preprocess: uint8 [0,255] -> [-1, 1]; custom prop
+        # pallas:1 swaps in the ops/ Pallas kernel (VMEM-tiled) on TPU
         if x.dtype == jnp.uint8:
-            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+            if self.pallas_preprocess:
+                from ..ops import normalize_u8
+
+                x = normalize_u8(x, dtype=self.dtype)
+            else:
+                x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
         else:
             x = x.astype(self.dtype)
         c = _make_divisible(32 * self.width_mult)
@@ -133,7 +140,12 @@ def build(custom_props=None):
     size = int(props.get("size", "224"))
     num_classes = int(props.get("classes", "1001"))
     width = float(props.get("width", "1.0"))
-    model = MobileNetV2(num_classes=num_classes, width_mult=width, dtype=dtype)
+    model = MobileNetV2(
+        num_classes=num_classes,
+        width_mult=width,
+        dtype=dtype,
+        pallas_preprocess=props.get("pallas", "0") in ("1", "true"),
+    )
     rng = jax.random.PRNGKey(int(props.get("seed", "0")))
     variables = model.init(rng, jnp.zeros((1, size, size, 3), jnp.uint8))
 

@@ -172,6 +172,9 @@ def build(custom_props=None):
     if size % 32:
         raise ValueError("yolov5 input size must be a multiple of 32")
     classes = int(props.get("classes", "80"))
+    with_nms = props.get("nms", "0") in ("1", "true")
+    iou_thr = float(props.get("iou", "0.45"))
+    nms_topk = int(props.get("nms_topk", "300"))
     model = YOLOv5s(num_classes=classes, size=size, dtype=dtype)
     params = model.init(
         jax.random.PRNGKey(int(props.get("seed", "0"))),
@@ -184,6 +187,29 @@ def build(custom_props=None):
         if single:
             x = x[None]
         out = model.apply(params, x)
+        if with_nms:
+            # in-graph batched NMS (custom prop nms:1): suppressed
+            # candidates get objectness 0, so the decoder's threshold
+            # drops them — whole micro-batch in one device call.
+            # Top-k pre-filter keeps the IoU matrix K x K (not N x N), and
+            # class-offset boxes make suppression per-class (standard
+            # yolov5 postprocess: different classes never overlap).
+            from ..ops import batched_nms
+
+            B, N = out.shape[0], out.shape[1]
+            K = min(nms_topk, N)
+            cxcy, wh = out[..., :2], out[..., 2:4]
+            boxes = jnp.concatenate([cxcy - wh / 2, cxcy + wh / 2], -1)
+            cls = jnp.argmax(out[..., 5:], -1)
+            boxes = boxes + (cls.astype(boxes.dtype) * 2.0)[..., None]
+            score = out[..., 4] * jnp.max(out[..., 5:], -1)
+            topv, topi = jax.lax.top_k(score, K)
+            boxes_k = jnp.take_along_axis(boxes, topi[..., None], 1)
+            keep_k = batched_nms(boxes_k, topv, iou_thr=iou_thr)
+            mask = jnp.zeros((B, N), bool).at[
+                jnp.arange(B)[:, None], topi
+            ].set(keep_k)
+            out = out.at[..., 4].multiply(mask.astype(out.dtype))
         return [out[0] if single else out]
 
     in_spec = StreamSpec(

@@ -1,0 +1,13 @@
+"""TPU compute kernels (the "ops" layer of the round-1 package plan).
+
+The reference accelerates hot elementwise paths with ORC SIMD
+(``gsttensor_transform.c`` orc_typecast macros :463-533) and leaves NMS /
+argmax post-processing to C loops in the decoders.  The TPU equivalents
+live here: Pallas kernels for the fused elementwise hot paths (VMEM-tiled,
+VPU-friendly) and jit/lax implementations for control-flow-heavy ops
+(batched NMS) — everything falls back to a pure jax.numpy path off-TPU.
+"""
+
+from .labeling import top1  # noqa: F401
+from .nms import batched_nms  # noqa: F401
+from .preprocess import normalize_u8  # noqa: F401

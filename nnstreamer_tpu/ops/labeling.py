@@ -1,0 +1,65 @@
+"""Fused top-1 (argmax + max score) over class logits.
+
+≙ the image-labeling decoder's C argmax loop
+(``tensordec-imagelabel.c``), done once per micro-batch on device: a
+Pallas row-reduction on TPU, identical jnp expression elsewhere.
+Returning (idx, score) together saves a second pass over HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128
+
+
+def _kernel(x_ref, idx_ref, val_ref):
+    x = x_ref[:].astype(jnp.float32)  # (RB, C)
+    idx_ref[:, 0] = jnp.argmax(x, axis=1).astype(jnp.int32)
+    val_ref[:, 0] = jnp.max(x, axis=1)
+
+
+@jax.jit
+def _pallas_top1(x):
+    from jax.experimental import pallas as pl
+
+    B, C = x.shape
+    idx, val = pl.pallas_call(
+        _kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec((B, C), lambda: (0, 0))],
+        out_specs=(
+            pl.BlockSpec((B, 1), lambda: (0, 0)),
+            pl.BlockSpec((B, 1), lambda: (0, 0)),
+        ),
+    )(x)
+    return idx[:, 0], val[:, 0]
+
+
+def top1(logits, use_pallas: bool = True):
+    """logits (B, C) or (C,) -> (argmax int32, max float32) per row."""
+    x = jnp.asarray(logits)
+    single = x.ndim == 1
+    if single:
+        x = x[None]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if use_pallas and on_tpu:
+        # pad classes to a lane multiple with -inf (argmax unaffected)
+        C = x.shape[1]
+        Cp = (C + _LANES - 1) // _LANES * _LANES
+        if Cp != C:
+            x = jnp.pad(x, ((0, 0), (0, Cp - C)),
+                        constant_values=-jnp.inf)
+        idx, val = _pallas_top1(x.astype(jnp.float32))
+    else:
+        idx = jnp.argmax(x, axis=1).astype(jnp.int32)
+        val = jnp.max(x.astype(jnp.float32), axis=1)
+    if single:
+        return idx[0], val[0]
+    return idx, val

@@ -1,0 +1,67 @@
+"""Batched non-maximum suppression on device (lax control flow).
+
+≙ the C NMS loops in ``tensordec-boundingbox.c`` (``nms`` per frame on
+host).  Control-flow heavy, so this is a jit/lax implementation (static
+shapes, fori_loop) rather than Pallas: XLA schedules it fine, and the win
+is running NMS for a whole micro-batch in one device call instead of N
+Python loops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _iou_matrix(boxes):
+    """boxes (N,4) x1,y1,x2,y2 -> pairwise IoU (N,N)."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1], 0
+    )
+    x1 = jnp.maximum(boxes[:, None, 0], boxes[None, :, 0])
+    y1 = jnp.maximum(boxes[:, None, 1], boxes[None, :, 1])
+    x2 = jnp.minimum(boxes[:, None, 2], boxes[None, :, 2])
+    y2 = jnp.minimum(boxes[:, None, 3], boxes[None, :, 3])
+    inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("iou_thr",))
+def _nms_one(boxes, scores, iou_thr: float):
+    """Greedy NMS, static shapes: returns keep mask (N,) bool."""
+    N = boxes.shape[0]
+    iou = _iou_matrix(boxes)
+    order = jnp.argsort(-scores)
+
+    def body(i, state):
+        keep, suppressed = state
+        cand = order[i]
+        ok = ~suppressed[cand]
+        keep = keep.at[cand].set(ok)
+        # suppress everything the candidate overlaps (only if kept)
+        sup = ok & (iou[cand] > iou_thr)
+        suppressed = suppressed | (sup & (jnp.arange(N) != cand))
+        return keep, suppressed
+
+    keep, _ = jax.lax.fori_loop(
+        0, N, body,
+        (jnp.zeros(N, bool), jnp.zeros(N, bool)),
+    )
+    return keep
+
+
+def batched_nms(boxes, scores, iou_thr: float = 0.45):
+    """boxes (B,N,4) or (N,4), scores (B,N) or (N,) -> bool keep mask of the
+    same leading shape.  Scores <= 0 are never kept (use as a validity
+    mask for padded candidates)."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    single = boxes.ndim == 2
+    if single:
+        boxes, scores = boxes[None], scores[None]
+    keep = jax.vmap(lambda b, s: _nms_one(b, s, iou_thr))(boxes, scores)
+    keep = keep & (scores > 0)
+    return keep[0] if single else keep

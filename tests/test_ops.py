@@ -1,0 +1,112 @@
+"""ops layer: fused preprocess / top1 / batched NMS (CPU fallback paths;
+the Pallas variants compile on TPU and share the same numerics)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.ops import batched_nms, normalize_u8, top1
+
+
+class TestNormalize:
+    def test_default_mobilenet_transform(self):
+        x = np.array([[0, 128, 255]], np.uint8)
+        y = np.asarray(normalize_u8(x, dtype=np.float32))
+        np.testing.assert_allclose(y, [[-1.0, 128 * 2 / 255 - 1, 1.0]], atol=1e-6)
+
+    def test_arbitrary_shape_and_scale(self):
+        x = np.arange(2 * 3 * 5, dtype=np.uint8).reshape(2, 3, 5)
+        y = np.asarray(normalize_u8(x, scale=0.5, bias=1.0, dtype=np.float32))
+        np.testing.assert_allclose(y, x.astype(np.float32) * 0.5 + 1.0)
+
+
+class TestTop1:
+    def test_batch(self):
+        logits = np.array([[0.1, 2.0, -1.0], [5.0, 0.0, 4.9]], np.float32)
+        idx, val = top1(logits)
+        np.testing.assert_array_equal(np.asarray(idx), [1, 0])
+        np.testing.assert_allclose(np.asarray(val), [2.0, 5.0])
+
+    def test_single_row(self):
+        idx, val = top1(np.float32([0.0, 1.0]))
+        assert int(idx) == 1 and float(val) == 1.0
+
+
+class TestBatchedNMS:
+    def test_suppresses_overlaps(self):
+        boxes = np.float32([
+            [0, 0, 10, 10],
+            [1, 1, 11, 11],   # heavy overlap with 0, lower score
+            [50, 50, 60, 60],  # disjoint
+        ])
+        scores = np.float32([0.9, 0.8, 0.7])
+        keep = np.asarray(batched_nms(boxes, scores, iou_thr=0.5))
+        np.testing.assert_array_equal(keep, [True, False, True])
+
+    def test_batched_and_padding_mask(self):
+        boxes = np.zeros((2, 4, 4), np.float32)
+        boxes[0, 0] = [0, 0, 10, 10]
+        boxes[0, 1] = [20, 0, 30, 10]
+        scores = np.zeros((2, 4), np.float32)
+        scores[0, :2] = [0.9, 0.8]
+        keep = np.asarray(batched_nms(boxes, scores))
+        assert keep[0, 0] and keep[0, 1]
+        assert not keep[0, 2:].any() and not keep[1].any()  # padded rows
+
+    def test_yolov5_in_graph_nms(self):
+        from nnstreamer_tpu.models import build
+
+        fn, params, _, _ = build(
+            "yolov5s",
+            {"dtype": "float32", "size": "64", "classes": "3", "nms": "1"},
+        )
+        img = np.random.default_rng(0).integers(0, 255, (64, 64, 3), np.uint8)
+        pred = np.asarray(fn(params, [img])[0])
+        assert np.isfinite(pred).all()
+        # NMS zeroes suppressed objectness: strictly fewer positives than
+        # candidates (random weights produce heavy overlap)
+        assert (pred[:, 4] > 0).sum() < pred.shape[0]
+
+    def test_mobilenet_pallas_preprocess_numerics(self):
+        from nnstreamer_tpu.models import build
+
+        img = np.random.default_rng(1).integers(0, 255, (32, 32, 3), np.uint8)
+        fn1, p1, _, _ = build(
+            "mobilenet_v2",
+            {"dtype": "float32", "size": "32", "classes": "5", "pallas": "0"},
+        )
+        fn2, p2, _, _ = build(
+            "mobilenet_v2",
+            {"dtype": "float32", "size": "32", "classes": "5", "pallas": "1"},
+        )
+        np.testing.assert_allclose(
+            np.asarray(fn1(p1, [img])[0]),
+            np.asarray(fn2(p2, [img])[0]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_matches_host_reference(self):
+        rng = np.random.default_rng(0)
+        xy = rng.random((32, 2)) * 100
+        wh = rng.random((32, 2)) * 30 + 1
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rng.random(32).astype(np.float32) + 0.01
+        keep = np.asarray(batched_nms(boxes, scores, iou_thr=0.45))
+
+        # host greedy NMS oracle
+        def iou(a, b):
+            x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+            x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+            return inter / ua if ua > 0 else 0.0
+
+        ref = np.zeros(32, bool)
+        sup = np.zeros(32, bool)
+        for i in np.argsort(-scores):
+            if sup[i]:
+                continue
+            ref[i] = True
+            for j in range(32):
+                if j != i and iou(boxes[i], boxes[j]) > 0.45:
+                    sup[j] = True
+        np.testing.assert_array_equal(keep, ref)
