@@ -1,0 +1,79 @@
+"""Model-URI resolution for the ``model=`` property.
+
+Reference: ``gst/nnstreamer/ml_agent.c:106`` (``mlagent_parse_uri_string``
+resolves ``mlagent://model/<name>/<version>`` against the Tizen model
+repository).  The TPU analog resolves:
+
+* plain paths — returned as-is;
+* ``file://<path>`` — stripped;
+* ``model://<name>[/<version>]`` — looked up in the local model repo dir
+  (config ``[model-repo] path`` or env ``NNS_TPU_MODEL_REPO``, default
+  ``~/.nnstreamer_tpu/models``): ``<repo>/<name>/<version>/`` with
+  ``latest`` = highest numeric version.  A repo entry is whatever the
+  backend accepts (msgpack file, orbax dir, .py, .so, ...) — single file
+  in the version dir, or the dir itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import config
+from .log import get_logger
+
+log = get_logger("model-uri")
+
+
+def repo_dir() -> str:
+    env = os.environ.get("NNS_TPU_MODEL_REPO")
+    if env:
+        return env
+    return config.get_value(
+        "model-repo", "path", os.path.expanduser("~/.nnstreamer_tpu/models")
+    )
+
+
+def _resolve_version(name_dir: str, version: str) -> Optional[str]:
+    if version != "latest":
+        d = os.path.join(name_dir, version)
+        return d if os.path.exists(d) else None
+    versions = []
+    try:
+        entries = os.listdir(name_dir)
+    except OSError:
+        return None
+    for entry in entries:
+        try:
+            key = [int(p) for p in entry.split(".")]
+        except ValueError:  # non-numeric or malformed ('1.', 'v2', ...)
+            continue
+        versions.append((key, entry))
+    if not versions:
+        return None
+    return os.path.join(name_dir, max(versions)[1])
+
+
+def resolve_model_uri(uri: str) -> str:
+    """Resolve a model= value to a concrete path (or return it unchanged
+    when it is not a URI).  Raises FileNotFoundError for a model:// URI
+    that does not resolve."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    if not uri.startswith("model://"):
+        return uri
+    rest = uri[len("model://"):].strip("/")
+    if not rest:
+        raise FileNotFoundError("model:// URI needs a model name")
+    name, _, version = rest.partition("/")
+    vdir = _resolve_version(os.path.join(repo_dir(), name), version or "latest")
+    if vdir is None:
+        raise FileNotFoundError(
+            f"{uri}: not found under model repo {repo_dir()!r}"
+        )
+    if os.path.isdir(vdir):
+        entries = sorted(os.listdir(vdir))
+        files = [e for e in entries if not e.startswith(".")]
+        if len(files) == 1:
+            return os.path.join(vdir, files[0])
+    return vdir

@@ -31,6 +31,7 @@ from ..backends.base import FilterBackend, find_backend, parse_accelerator
 from ..core import config as nns_config
 from ..core import registry
 from ..core.buffer import CustomEvent, TensorFrame
+from ..core.model_uri import resolve_model_uri
 from ..core.types import ANY, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, TransformElement, element
 
@@ -170,6 +171,10 @@ class TensorFilter(TransformElement):
         self._out_comb = _parse_combination(self.props["output-combination"])
         fw = self.props["framework"]
         model = self.props["model"] or None
+        if model:
+            # mlagent-URI analog: model://name[/version] + file:// schemes
+            # (plain paths pass through unchanged)
+            model = resolve_model_uri(model)
         if fw == "auto":
             if not model:
                 raise ElementError(f"{self.name}: framework=auto requires a model")
@@ -368,6 +373,8 @@ class SingleShot:
     """
 
     def __init__(self, framework: str = "auto", model: str = "", **props):
+        if model:
+            model = resolve_model_uri(model)
         fw = detect_framework(model) if framework == "auto" else framework
         self.backend: FilterBackend = find_backend(fw)()
         merged = {"custom": "", **props}

@@ -123,6 +123,9 @@ class TensorQueryClient(Element):
         "timeout": Property(float, 10.0, "per-request timeout, seconds"),
         "max-in-flight": Property(int, 8, "pipelined outstanding requests"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # elastic recovery (SURVEY §5.3: preemptible workers need client-side
+        # retry/requeue — net-new vs the reference's single timeout)
+        "retries": Property(int, 1, "re-send attempts per request (0 = none)"),
     }
 
     def __init__(self, name=None):
@@ -131,6 +134,9 @@ class TensorQueryClient(Element):
         self._pool: Optional[ThreadPoolExecutor] = None
         self._inflight: Deque[Future] = deque()
         self._rr = 0
+        # health tracking: conn index -> monotonic time until which it is
+        # considered down (skipped by round-robin; retried after cooldown)
+        self._down_until: dict = {}
 
     def start(self):
         targets: List[Tuple[str, int]] = []
@@ -168,13 +174,22 @@ class TensorQueryClient(Element):
     # caps handshake at negotiation time (≙ edge CAPS event exchange)
     def accept_spec(self, pad, spec):
         if spec.tensors and self._conns:
+            failures = []
             for conn in self._conns:
                 try:
                     conn.handshake(spec.to_string())
                 except Exception as e:  # noqa: BLE001 — transport boundary
-                    raise ElementError(
-                        f"{self.name}: caps handshake with {conn.addr} failed: {e}"
-                    ) from None
+                    failures.append((conn.addr, e))
+            can_failover = self.props["retries"] > 0 and len(self._conns) > 1
+            if failures and (len(failures) == len(self._conns) or not can_failover):
+                addr, e = failures[0]
+                raise ElementError(
+                    f"{self.name}: caps handshake with {addr} failed: {e}"
+                ) from None
+            for addr, e in failures:
+                # a down server is tolerable when others answered AND requests
+                # can fail over (elastic recovery); it may also come back later
+                self.log.warning("caps handshake with %s failed: %s", addr, e)
         return spec
 
     def derive_spec(self, pad=0):
@@ -190,11 +205,46 @@ class TensorQueryClient(Element):
             out.append((0, fut.result()))  # raises on RPC error -> bus
         return out
 
-    def handle_frame(self, pad, frame):
-        conn = self._conns[self._rr % len(self._conns)]
-        self._rr += 1
+    def _healthy_order(self, first: int) -> List[int]:
+        """Conn indices starting at `first`, known-down ones (cooldown not
+        expired) pushed to the back so a hung server doesn't eat a full
+        timeout per frame."""
+        import time
+
+        now = time.monotonic()
+        order = [(first + k) % len(self._conns) for k in range(len(self._conns))]
+        healthy = [i for i in order if self._down_until.get(i, 0) <= now]
+        return healthy + [i for i in order if i not in healthy]
+
+    def _invoke_failover(self, frame, first: int):
+        """One request: try the assigned (healthy-first) server, fail over
+        round-robin to the others, `retries` extra attempts total."""
+        import time
+
+        attempts = 1 + max(0, self.props["retries"])
         timeout = self.props["timeout"]
-        fut = self._pool.submit(conn.invoke, frame, timeout)
+        order = self._healthy_order(first)
+        err: Optional[BaseException] = None
+        for k in range(attempts):
+            i = order[k % len(order)]
+            conn = self._conns[i]
+            try:
+                result = conn.invoke(frame, timeout)
+                self._down_until.pop(i, None)
+                return result
+            except Exception as e:  # noqa: BLE001 — transport boundary
+                err = e
+                self._down_until[i] = time.monotonic() + timeout
+                self.log.warning(
+                    "query to %s failed (attempt %d/%d): %s",
+                    conn.addr, k + 1, attempts, e,
+                )
+        raise err  # all attempts failed -> surfaced on the bus
+
+    def handle_frame(self, pad, frame):
+        first = self._rr % len(self._conns)
+        self._rr += 1
+        fut = self._pool.submit(self._invoke_failover, frame, first)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window
         # is full, then release whatever is complete (in order)

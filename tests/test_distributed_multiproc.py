@@ -1,0 +1,103 @@
+"""Among-device offload across OS processes (SURVEY §4: the reference tests
+multi-"node" as multiple processes on localhost — gstTestBackground server +
+foreground client) + client-side retry/failover (SURVEY §5.3)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+_SERVER_SCRIPT = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+pipe = parse_pipeline(
+    "tensor_query_serversrc name=src port=0 ! "
+    "tensor_transform mode=arithmetic option=add:100 ! "
+    "tensor_query_serversink"
+)
+pipe.start()
+print("PORT", pipe["src"].props["port"], flush=True)
+time.sleep(60)
+"""
+
+
+class TestMultiProcessQuery:
+    def test_client_offloads_to_server_process(self, tmp_path):
+        script = tmp_path / "server.py"
+        script.write_text(_SERVER_SCRIPT.format(
+            repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "NNS_TPU_NO_NATIVE": "1"}
+        srv = subprocess.Popen(
+            [sys.executable, str(script)],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        try:
+            line = srv.stdout.readline()
+            assert line.startswith("PORT "), line
+            port = int(line.split()[1])
+
+            pipe = parse_pipeline(
+                f"appsrc name=a ! tensor_query_client port={port} "
+                "timeout=30 ! tensor_sink name=out"
+            )
+            pipe.start()
+            for i in range(4):
+                pipe["a"].push(np.int32([i]))
+            pipe["a"].end_of_stream()
+            pipe.wait(timeout=60)
+            pipe.stop()
+            vals = [int(f.tensors[0][0]) for f in pipe["out"].frames]
+            assert vals == [100, 101, 102, 103]  # +100 done in the other process
+        finally:
+            srv.kill()
+            srv.wait(timeout=10)
+
+
+class TestClientFailover:
+    def test_dead_server_fails_over_to_live_one(self):
+        # server pipeline in-process (separate pipeline object)
+        server = parse_pipeline(
+            "tensor_query_serversrc name=src port=0 id=7 ! "
+            "tensor_transform mode=arithmetic option=mul:2 ! "
+            "tensor_query_serversink id=7"
+        )
+        server.start()
+        port = server["src"].props["port"]
+
+        # first target is a dead port: every request must fail over
+        dead = 1  # port 1: nothing listens there
+        client = parse_pipeline(
+            f"appsrc name=a ! tensor_query_client hosts=127.0.0.1:{dead},"
+            f"127.0.0.1:{port} retries=2 timeout=3 ! tensor_sink name=out"
+        )
+        client.start()
+        for i in range(4):
+            client["a"].push(np.int32([i]))
+        client["a"].end_of_stream()
+        client.wait(timeout=60)
+        client.stop()
+        server.stop()
+        vals = sorted(int(f.tensors[0][0]) for f in client["out"].frames)
+        assert vals == [0, 2, 4, 6]
+
+    def test_no_retries_surfaces_error(self):
+        client = parse_pipeline(
+            "appsrc name=a ! tensor_query_client host=127.0.0.1 port=1 "
+            "retries=0 timeout=2 ! tensor_sink name=out"
+        )
+        client.start()
+        client["a"].push(np.int32([1]))
+        client["a"].end_of_stream()
+        with pytest.raises(Exception):
+            client.wait(timeout=30)
+        client.stop()

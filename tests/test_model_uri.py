@@ -1,0 +1,66 @@
+"""model:// URI resolution (≙ mlagent_parse_uri_string, ml_agent.c)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.model_uri import resolve_model_uri
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    monkeypatch.setenv("NNS_TPU_MODEL_REPO", str(tmp_path))
+    return tmp_path
+
+
+class TestResolve:
+    def test_plain_and_file_scheme(self):
+        assert resolve_model_uri("/a/b.msgpack") == "/a/b.msgpack"
+        assert resolve_model_uri("file:///a/b.py") == "/a/b.py"
+
+    def test_named_version(self, repo):
+        d = repo / "scaler" / "2"
+        d.mkdir(parents=True)
+        (d / "scaler.py").write_text("# model")
+        assert resolve_model_uri("model://scaler/2") == str(d / "scaler.py")
+
+    def test_latest_picks_highest(self, repo):
+        for v in ("1", "3", "2"):
+            d = repo / "m" / v
+            d.mkdir(parents=True)
+            (d / f"m{v}.bin").write_text(v)
+        assert resolve_model_uri("model://m").endswith("3/m3.bin")
+        assert resolve_model_uri("model://m/latest").endswith("3/m3.bin")
+
+    def test_multi_file_version_returns_dir(self, repo):
+        d = repo / "ck" / "1"
+        d.mkdir(parents=True)
+        (d / "a").write_text("x")
+        (d / "b").write_text("y")
+        assert resolve_model_uri("model://ck/1") == str(d)
+
+    def test_missing_raises(self, repo):
+        with pytest.raises(FileNotFoundError):
+            resolve_model_uri("model://nope")
+
+    def test_filter_resolves_uri(self, repo):
+        # a python3-backend model via model:// in a pipeline
+        d = repo / "pysq" / "1"
+        d.mkdir(parents=True)
+        (d / "sq.py").write_text(
+            "def invoke(inputs):\n"
+            "    return [inputs[0] * inputs[0]]\n"
+        )
+        from nnstreamer_tpu.pipeline import parse_pipeline
+
+        pipe = parse_pipeline(
+            "appsrc name=a ! tensor_filter framework=python3 "
+            "model=model://pysq ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["a"].push(np.float32([3.0]))
+        pipe["a"].end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert float(pipe["out"].frames[0].tensors[0][0]) == 9.0
