@@ -91,6 +91,7 @@ class JaxXla(FilterBackend):
         self._jit_cache: Dict[Tuple, Any] = {}
         self._cache_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # double-buffered hot reload
+        self._posts: List[Callable[[List[Any]], List[Any]]] = []
 
     # -- framework info -----------------------------------------------------
     def framework_info(self):
@@ -196,6 +197,26 @@ class JaxXla(FilterBackend):
             return list(out)
         return [out]
 
+    # -- device-fused postprocess -------------------------------------------
+    def append_postprocess(self, fn: Callable[[List[Any]], List[Any]]) -> None:
+        """Fold a jit-traceable postprocess (e.g. a decoder's device half)
+        into the compiled program: outputs = fn(model outputs).
+
+        The TPU-native replacement for the reference's host-side decoder
+        hop (tensordec-*.c operate on mapped CPU memory after invoke): XLA
+        fuses the postprocess into the same program, so only its (usually
+        tiny) result ever crosses PCIe.  Used by the pipeline's device-
+        fusion pass; survives hot reload (applied outside the model fn).
+        """
+        self._posts.append(fn)
+        with self._cache_lock:
+            self._jit_cache.clear()
+
+    def _apply_posts(self, outs: List[Any]) -> List[Any]:
+        for post in self._posts:
+            outs = self._normalize_out(post(outs))
+        return outs
+
     def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
         import jax
 
@@ -205,7 +226,8 @@ class JaxXla(FilterBackend):
             jax.ShapeDtypeStruct(t.shape, t.dtype) for t in in_spec.tensors
         ]
         outs = jax.eval_shape(
-            lambda p, xs: self._normalize_out(self._fn(p, xs)), self._params, dummies
+            lambda p, xs: self._apply_posts(self._normalize_out(self._fn(p, xs))),
+            self._params, dummies,
         )
         spec = StreamSpec(
             tuple(TensorSpec(tuple(o.shape), np.dtype(o.dtype)) for o in outs),
@@ -228,7 +250,8 @@ class JaxXla(FilterBackend):
                 model = self._fn
 
                 def call(params, *xs):
-                    return tuple(self._normalize_out(model(params, list(xs))))
+                    outs = self._normalize_out(model(params, list(xs)))
+                    return tuple(self._apply_posts(outs))
 
                 # donation (custom prop "donate:true"): XLA reuses input HBM
                 # for outputs.  Opt-in because upstream may still hold the

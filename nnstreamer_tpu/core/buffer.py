@@ -71,6 +71,53 @@ class TensorFrame:
         return self.with_tensors([np.asarray(t) for t in self.tensors])
 
 
+@dataclass
+class BatchFrame(TensorFrame):
+    """A micro-batch travelling as ONE stream item: every tensor has a
+    leading batch axis; ``frames_info`` keeps the per-logical-frame
+    (pts, duration, meta) so the batch can be split back losslessly.
+
+    TPU-first rationale (no reference analog): per-frame Python dispatch
+    caps throughput long before the MXU does, so batch-capable element
+    chains (filter -> fused decoder -> sink) move whole micro-batches —
+    usually still device-resident — and split only at a host boundary.
+    Produced by tensor_filter in batch-through mode; any element built on
+    ``with_tensors``/``pick`` preserves the batch (dataclasses.replace
+    keeps the subclass), and sinks/decoders split via :meth:`split`.
+    """
+
+    frames_info: List[Tuple[Optional[float], Optional[float], Dict[str, Any]]] = field(
+        default_factory=list
+    )
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.frames_info)
+
+    @classmethod
+    def from_frames(
+        cls, tensors: Sequence[Any], frames: Sequence[TensorFrame]
+    ) -> "BatchFrame":
+        first = frames[0]
+        return cls(
+            tensors=list(tensors),
+            pts=first.pts,
+            duration=first.duration,
+            meta=dict(first.meta),
+            frames_info=[(f.pts, f.duration, f.meta) for f in frames],
+        )
+
+    def split(self) -> List[TensorFrame]:
+        """Materialize on host and fan back out into per-frame views."""
+        mats = [np.asarray(t) for t in self.tensors]
+        return [
+            TensorFrame(
+                [m[b] for m in mats], pts=p, duration=d, meta=dict(fm)
+            )
+            for b, (p, d, fm) in enumerate(self.frames_info)
+        ]
+
+
 # ---------------------------------------------------------------------------
 # In-band events (flow through the same queues as frames, in order)
 # ---------------------------------------------------------------------------

@@ -39,9 +39,29 @@ class ImageLabeling:
     def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
         scores = np.asarray(frame.tensors[0]).reshape(-1)
         idx = int(np.argmax(scores))
+        return self._emit(frame, idx, float(scores[idx]))
+
+    def _emit(self, frame: TensorFrame, idx: int, score: float) -> TensorFrame:
         out = frame.with_tensors([np.asarray([idx], np.int32)])
         out.meta["label_index"] = idx
-        out.meta["label_score"] = float(scores[idx])
+        out.meta["label_score"] = score
         if self.labels and idx < len(self.labels):
             out.meta["label"] = self.labels[idx]
         return out
+
+    # -- device-fused half (pipeline fusion pass) ---------------------------
+    def device_fn(self, outs):
+        """jit-traceable half, folded into the upstream filter's XLA
+        program: fused argmax+max (Pallas row-reduction on TPU,
+        ``ops/labeling.py``) so only (index, score) — 8 bytes/frame —
+        ever crosses PCIe instead of the full score tensor."""
+        from ..ops.labeling import top1
+
+        idx, score = top1(outs[0])
+        return [idx[..., None], score[..., None]]  # (B,1)/(1,) each
+
+    def decode_fused(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        """Host finishing after device_fn: tensors are [idx, score]."""
+        idx = int(np.asarray(frame.tensors[0]).reshape(-1)[0])
+        score = float(np.asarray(frame.tensors[1]).reshape(-1)[0])
+        return self._emit(frame, idx, score)

@@ -18,7 +18,7 @@ from typing import Any, Callable, Iterator, List, Optional
 
 import numpy as np
 
-from ..core.buffer import EOS, CapsEvent, Event, TensorFrame
+from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, TensorFrame
 from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec, parse_dims_string, dtype_from_name
 from ..pipeline.element import (
     Element,
@@ -167,6 +167,12 @@ class TensorSink(SinkElement):
         self._callbacks.append(cb)
 
     def render(self, frame: TensorFrame) -> None:
+        if isinstance(frame, BatchFrame):
+            # batch-through chains end here: fan the micro-batch back out
+            # so callbacks/stored frames see per-frame granularity
+            for f in frame.split():
+                self.render(f)
+            return
         if self.props["to-host"]:
             frame = frame.to_host()
         limit = self.props["max-stored"]

@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..core import registry
-from ..core.buffer import TensorFrame
+from ..core.buffer import BatchFrame, TensorFrame
 from ..core.types import ANY, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, TransformElement, element
 from .. import decoders as _decoders  # noqa: F401 — registers decoder modes
@@ -36,13 +36,34 @@ class TensorDecoder(TransformElement):
             for i in range(1, _N_OPTIONS + 1)
         },
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        "device-fused": Property(
+            str, "auto",
+            "auto = let the pipeline fold this decoder's device half "
+            "(subplugin device_fn) into the upstream jax-xla filter's XLA "
+            "program; never = always decode on host",
+        ),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
         self._dec = None
+        self._fused = False  # set by the pipeline's device-fusion pass
+
+    # -- device fusion (pipeline pass) --------------------------------------
+    @property
+    def can_fuse_device(self) -> bool:
+        return (
+            self._dec is not None
+            and hasattr(self._dec, "device_fn")
+            and hasattr(self._dec, "decode_fused")
+            and self.props["device-fused"] != "never"
+        )
+
+    def enable_fused(self) -> None:
+        self._fused = True
 
     def start(self):
+        self._fused = False  # re-fused (or not) by the pass on every start
         mode = self.props["mode"]
         if not mode:
             raise ElementError(f"{self.name}: decoder requires mode=")
@@ -68,4 +89,17 @@ class TensorDecoder(TransformElement):
 
     def transform(self, frame):
         assert self._dec is not None, f"{self.name} not started"
+        if self._fused:
+            return self._dec.decode_fused(frame, self.sink_specs.get(0, ANY))
         return self._dec.decode(frame, self.sink_specs.get(0, ANY))
+
+    def handle_frame(self, pad, frame):
+        # batch-through fast path: the upstream filter hands the whole
+        # micro-batch as ONE device-resident BatchFrame; split() does the
+        # single (tiny, post-device_fn) device->host transfer, then the
+        # host finisher runs per logical frame.
+        if isinstance(frame, BatchFrame):
+            spec = self.sink_specs.get(0, ANY)
+            dec = self._dec.decode_fused if self._fused else self._dec.decode
+            return [(0, dec(f, spec)) for f in frame.split()]
+        return super().handle_frame(pad, frame)

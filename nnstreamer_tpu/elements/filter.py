@@ -30,7 +30,7 @@ import numpy as np
 from ..backends.base import FilterBackend, find_backend, parse_accelerator
 from ..core import config as nns_config
 from ..core import registry
-from ..core.buffer import CustomEvent, TensorFrame
+from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
 from ..core.model_uri import resolve_model_uri
 from ..core.types import ANY, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, TransformElement, element
@@ -136,6 +136,12 @@ class TensorFilter(TransformElement):
         # ≙ GstShark/NNShark tracing (SURVEY §5.1) done the XLA-native way
         "trace": Property(int, 0, "1 = capture a jax.profiler trace while running"),
         "trace-dir": Property(str, "/tmp/nns_tpu_trace", "profiler output dir"),
+        "batch-through": Property(
+            bool, False,
+            "emit micro-batches as ONE BatchFrame (device-resident) instead "
+            "of per-frame outputs; downstream must be batch-aware (set "
+            "automatically by the pipeline's device-fusion pass)",
+        ),
     }
 
     def __init__(self, name=None):
@@ -151,6 +157,29 @@ class TensorFilter(TransformElement):
         # combination props parsed once at start (hot path stays parse-free)
         self._in_comb: Optional[List[Tuple[str, int]]] = None
         self._out_comb: Optional[List[Tuple[str, int]]] = None
+
+    # -- device fusion (pipeline pass) --------------------------------------
+    @property
+    def can_fuse_postprocess(self) -> bool:
+        """True when a downstream device half can be folded into this
+        filter's compiled program (no combination/dynamic-shape features
+        that would change what the postprocess sees, and a private,
+        postprocess-capable backend)."""
+        return (
+            self.backend is not None
+            and hasattr(self.backend, "append_postprocess")
+            and self._owns_backend
+            and not self.props["invoke-dynamic"]
+            and not self._out_comb
+        )
+
+    def fuse_device_postprocess(self, fn) -> None:
+        """Fold ``fn`` (jit-traceable, operates on the model's output list)
+        into the backend program and invalidate cached output schemas so
+        negotiation re-derives the fused shape."""
+        assert self.can_fuse_postprocess
+        self.backend.append_postprocess(fn)
+        self._model_out = None
 
     # -- batching hook for the scheduler ------------------------------------
     @property
@@ -169,6 +198,13 @@ class TensorFilter(TransformElement):
         self._tracing = False
         self._in_comb = _parse_combination(self.props["input-combination"])
         self._out_comb = _parse_combination(self.props["output-combination"])
+        if self.props["batch-through"] and self._out_comb:
+            # the BatchFrame fast path bypasses _compose_outputs; refusing
+            # beats emitting a layout that depends on queue depth
+            raise ElementError(
+                f"{self.name}: batch-through=true is incompatible with "
+                "output-combination"
+            )
         fw = self.props["framework"]
         model = self.props["model"] or None
         if model:
@@ -316,7 +352,8 @@ class TensorFilter(TransformElement):
 
         t0 = time.perf_counter()
         outputs = self.backend.timed_invoke(inputs)
-        self._record_stats(time.perf_counter() - t0, 1)
+        nlogical = frame.batch_size if isinstance(frame, BatchFrame) else 1
+        self._record_stats(time.perf_counter() - t0, nlogical)
         return frame.with_tensors(self._compose_outputs(frame.tensors, outputs))
 
     def handle_frame_batch(
@@ -339,6 +376,13 @@ class TensorFilter(TransformElement):
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, len(frames))
+        if self.props["batch-through"]:
+            # device residency: the whole micro-batch leaves as ONE frame,
+            # outputs still on device (jax.Array) — no host sync here, so
+            # the next batch's stack/dispatch overlaps this one's compute.
+            # Downstream (fused decoder / chained filter / sink) splits or
+            # materializes at the real host boundary.
+            return [(0, BatchFrame.from_frames(out_b, frames))]
         # one device->host transfer per output tensor (not per frame), then
         # zero-copy numpy views per frame
         out_np = [np.asarray(o) for o in out_b]

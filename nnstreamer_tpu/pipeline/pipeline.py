@@ -128,6 +128,45 @@ class Pipeline:
             # do not create graph edges — anything else is a bug.
             raise ElementError("pipeline graph has a cycle through pad links")
 
+    # -- device fusion pass (no reference analog; SURVEY §7 design stance:
+    # "compile element graphs down to as few XLA programs as possible") ----
+    def _fuse_device_chains(self) -> None:
+        """Fold fusable decoder device halves into their upstream jax-xla
+        filter's compiled program and switch the pair to device-resident
+        batch-through flow.
+
+        Conditions (all checked, else the chain runs unfused):
+        the filter owns its backend and has no output-combination/dynamic
+        output; its single src pad feeds exactly one tensor_decoder whose
+        subplugin exposes a device half (``device_fn``/``decode_fused``)
+        and whose only input is this filter.  Runs after element start()
+        (subplugins exist) and before negotiation (fused schemas
+        propagate).
+        """
+        incoming: Dict[str, int] = {n: 0 for n in self.elements}
+        for el in self.elements.values():
+            for pad in el.srcpads:
+                for dst, _ in pad.links:
+                    incoming[dst.name] += 1
+        for el in self.elements.values():
+            if not getattr(el, "can_fuse_postprocess", False):
+                continue
+            if len(el.srcpads) != 1 or len(el.srcpads[0].links) != 1:
+                continue
+            dst, _ = el.srcpads[0].links[0]
+            if not getattr(dst, "can_fuse_device", False):
+                continue
+            if incoming[dst.name] != 1:
+                continue
+            el.fuse_device_postprocess(dst._dec.device_fn)
+            dst.enable_fused()
+            if el.preferred_batch > 1:
+                el.props["batch-through"] = True
+            self.log.info(
+                "device-fused %s -> %s (decoder half compiled into the "
+                "filter's XLA program)", el.name, dst.name,
+            )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Pipeline":
         if self._started:
@@ -140,6 +179,7 @@ class Pipeline:
             for el in self.elements.values():
                 el.start()
                 started.append(el)
+            self._fuse_device_chains()
             self._negotiate()
         except BaseException:
             for el in started:

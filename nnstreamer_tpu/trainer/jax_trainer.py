@@ -331,4 +331,81 @@ def _load_params(path: str, template):
     return ckptr.restore(os.path.abspath(path), template)
 
 
+def mnist_epoch_benchmark(
+    dtype: str = "bfloat16",
+    n_train: int = 2048,
+    n_valid: int = 256,
+    epochs: int = 3,
+    tmp_dir: str = "/tmp/nns_mnist_bench",
+) -> Tuple[float, float]:
+    """BASELINE.md tracked row: tensor_trainer MNIST CNN epoch time.
+
+    Runs the reference's canonical in-pipeline training config
+    (datareposrc -> tensor_trainer, SURVEY §3.4) on a synthetic
+    MNIST-shaped dataset and returns (steady-state seconds/epoch, final
+    training accuracy).  Epoch 1 includes the XLA compile, so timing uses
+    the epochs after it (stats-frame arrival deltas at the sink).
+    """
+    import json as _json
+    import shutil
+    import time
+
+    from ..pipeline import parse_pipeline
+
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir, exist_ok=True)
+    data_path = os.path.join(tmp_dir, "data.bin")
+    json_path = os.path.join(tmp_dir, "data.json")
+
+    # synthetic learnable task: class = brightest of 10 row-bands
+    rng = np.random.default_rng(0)
+    wpipe = parse_pipeline(
+        f"appsrc name=src ! datareposink location={data_path} json={json_path}"
+    )
+    wpipe.start()
+    n = n_train + n_valid
+    for i in range(n):
+        label = i % 10
+        img = rng.normal(0.2, 0.05, (28, 28, 1)).astype(np.float32)
+        img[label * 2 : label * 2 + 3, :, :] += 0.8
+        wpipe["src"].push([img, np.int64([label])])
+    wpipe["src"].end_of_stream()
+    wpipe.wait(timeout=60)
+    wpipe.stop()
+
+    cfg = {
+        "arch": "mnist_cnn",
+        "arch_props": {"dtype": dtype, "classes": "10"},
+        "optimizer": "adam",
+        "learning_rate": 3e-3,
+        "batch_size": 256,
+    }
+    cfg_path = os.path.join(tmp_dir, "cfg.json")
+    with open(cfg_path, "w") as f:
+        _json.dump(cfg, f)
+
+    pipe = parse_pipeline(
+        f"datareposrc location={data_path} json={json_path} epochs={epochs} ! "
+        f"tensor_trainer name=t framework=jax model-config={cfg_path} "
+        f"num-inputs=1 num-labels=1 num-training-samples={n_train} "
+        f"num-validation-samples={n_valid} epochs={epochs} ! "
+        "tensor_sink name=out"
+    )
+    arrivals = []
+    pipe.start()
+    pipe["out"].connect_new_data(lambda f: arrivals.append(time.perf_counter()))
+    pipe.wait(timeout=900)
+    stats = [f.tensors[0] for f in pipe["out"].frames]
+    pipe.stop()
+
+    if len(arrivals) < 2:
+        raise RuntimeError(
+            f"expected >=2 epoch stats frames, got {len(arrivals)}"
+        )
+    deltas = [b - a for a, b in zip(arrivals[1:], arrivals[2:])] or [
+        arrivals[1] - arrivals[0]
+    ]
+    return float(np.mean(deltas)), float(stats[-1][2])
+
+
 register_trainer(JaxTrainer)

@@ -1,0 +1,171 @@
+"""Device-fusion pass + batch-through flow.
+
+The TPU-first replacement for the reference's host-side decode hop
+(tensor_filter invoke -> mapped CPU memory -> tensordec-imagelabel.c
+argmax): the pipeline folds the decoder's device half into the filter's
+XLA program (`Pipeline._fuse_device_chains`), and micro-batches travel as
+single device-resident BatchFrames until the first host boundary.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.jax_xla import register_jax_model, unregister_jax_model
+from nnstreamer_tpu.core.buffer import BatchFrame, TensorFrame
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+@pytest.fixture
+def labels(tmp_path):
+    p = tmp_path / "labels.txt"
+    p.write_text("\n".join(f"cls{i}" for i in range(8)))
+    return str(p)
+
+
+@pytest.fixture
+def scale_model():
+    import jax.numpy as jnp
+
+    # logits = x * w: argmax is wherever the input is largest
+    def fn(params, xs):
+        return [xs[0].astype(jnp.float32) * params["w"]]
+
+    register_jax_model("fusion_scale", fn, {"w": np.float32(2.0)})
+    yield "fusion_scale"
+    unregister_jax_model("fusion_scale")
+
+
+def push_frames(pipe, n=8, classes=8):
+    rng = np.random.default_rng(7)
+    expected = []
+    for i in range(n):
+        x = rng.normal(0, 1, (classes,)).astype(np.float32)
+        x[i % classes] += 10.0  # deterministic argmax
+        expected.append(i % classes)
+        pipe["src"].push(TensorFrame([x], pts=float(i)))
+    pipe["src"].end_of_stream()
+    return expected
+
+
+class TestDeviceFusion:
+    def pipeline(self, model, labels, extra=""):
+        return parse_pipeline(
+            "appsrc name=src ! "
+            f"tensor_filter name=f framework=jax-xla model={model} "
+            "max-batch=4 batch-timeout=30 ! "
+            f"tensor_decoder name=d mode=image_labeling option1={labels} "
+            f"{extra} ! tensor_sink name=out"
+        )
+
+    def test_fused_results_match_host_decode(self, scale_model, labels):
+        results = {}
+        for fused, extra in (("yes", ""), ("no", "device-fused=never")):
+            pipe = self.pipeline(scale_model, labels, extra)
+            pipe.start()
+            expected = push_frames(pipe)
+            pipe.wait(timeout=30)
+            assert pipe["d"]._fused is (fused == "yes")
+            if fused == "yes":
+                # the pass must also have switched the filter to
+                # device-resident batch-through emission
+                assert pipe["f"].props["batch-through"] is True
+            frames = list(pipe["out"].frames)
+            pipe.stop()
+            assert [f.meta["label_index"] for f in frames] == expected
+            assert [f.meta["label"] for f in frames] == [
+                f"cls{i}" for i in expected
+            ]
+            results[fused] = [
+                (f.meta["label_index"], round(f.meta["label_score"], 4))
+                for f in frames
+            ]
+        assert results["yes"] == results["no"]
+
+    def test_fused_preserves_order_and_pts(self, scale_model, labels):
+        pipe = self.pipeline(scale_model, labels)
+        pipe.start()
+        push_frames(pipe, n=11)  # odd count: exercises partial batches
+        pipe.wait(timeout=30)
+        frames = list(pipe["out"].frames)
+        pipe.stop()
+        assert [f.pts for f in frames] == [float(i) for i in range(11)]
+
+    def test_no_fusion_across_tee(self, scale_model, labels):
+        # two consumers on the filter's src pad: fusing would corrupt the
+        # second branch's schema, so the pass must leave the chain alone
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            f"tensor_filter name=f framework=jax-xla model={scale_model} ! "
+            "tee name=t "
+            f"t. ! tensor_decoder name=d mode=image_labeling option1={labels} "
+            "! tensor_sink name=out "
+            "t. ! tensor_sink name=raw"
+        )
+        pipe.start()
+        expected = push_frames(pipe, n=4)
+        pipe.wait(timeout=30)
+        assert pipe["d"]._fused is False
+        idxs = [f.meta["label_index"] for f in pipe["out"].frames]
+        raw = [f.tensors[0].shape for f in pipe["raw"].frames]
+        pipe.stop()
+        assert idxs == expected
+        assert raw == [(8,)] * 4  # untouched full score tensors
+
+
+class TestBatchFrame:
+    def test_split_roundtrip(self):
+        frames = [
+            TensorFrame([np.full((3,), i, np.float32)], pts=float(i),
+                        meta={"k": i})
+            for i in range(5)
+        ]
+        stacked = np.stack([f.tensors[0] for f in frames])
+        bf = BatchFrame.from_frames([stacked], frames)
+        assert bf.batch_size == 5
+        back = bf.split()
+        assert [f.pts for f in back] == [float(i) for i in range(5)]
+        assert [f.meta["k"] for f in back] == list(range(5))
+        for i, f in enumerate(back):
+            np.testing.assert_array_equal(f.tensors[0], frames[i].tensors[0])
+
+    def test_with_tensors_preserves_batch(self):
+        frames = [TensorFrame([np.zeros((2,))], pts=float(i)) for i in range(3)]
+        bf = BatchFrame.from_frames([np.zeros((3, 2))], frames)
+        out = bf.with_tensors([np.ones((3, 4))])
+        assert isinstance(out, BatchFrame)
+        assert out.batch_size == 3
+
+    def test_chained_filter_passes_batch_through(self, scale_model, labels):
+        # filter1 (batch-through) -> filter2 -> sink: the BatchFrame flows
+        # through the second jax filter as one batched invoke and splits
+        # only at the sink
+        import jax.numpy as jnp
+
+        def plus_one(params, xs):
+            return [xs[0] + jnp.float32(1.0)]
+
+        register_jax_model("fusion_plus1", plus_one, {})
+        try:
+            pipe = parse_pipeline(
+                "appsrc name=src ! "
+                f"tensor_filter name=f1 framework=jax-xla model={scale_model} "
+                "max-batch=4 batch-timeout=30 batch-through=true ! "
+                "tensor_filter name=f2 framework=jax-xla model=fusion_plus1 ! "
+                "tensor_sink name=out"
+            )
+            pipe.start()
+            rng = np.random.default_rng(3)
+            xs = [rng.normal(0, 1, (8,)).astype(np.float32) for _ in range(6)]
+            for i, x in enumerate(xs):
+                pipe["src"].push(TensorFrame([x], pts=float(i)))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            frames = list(pipe["out"].frames)
+            pipe.stop()
+            assert [f.pts for f in frames] == [float(i) for i in range(6)]
+            for x, f in zip(xs, frames):
+                np.testing.assert_allclose(
+                    f.tensors[0], x * 2.0 + 1.0, rtol=1e-5
+                )
+        finally:
+            unregister_jax_model("fusion_plus1")
