@@ -81,8 +81,17 @@ def probe_backend(tries: int, timeout_s: float) -> str:
     return last_err
 
 
+METRICS = {
+    "mobilenet": ("mobilenet_v2_image_labeling_fps_per_chip", "fps"),
+    "ssd": ("ssd_mobilenet_v2_bbox_fps_per_chip", "fps"),
+    "yolov5": ("yolov5s_bbox_fps_per_chip", "fps"),
+    "posenet": ("posenet_pose_fps_per_chip", "fps"),
+    "mnist_trainer": ("mnist_cnn_trainer_epoch_seconds", "s"),
+}
+
+
 def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
-                 host_frames: bool) -> dict:
+                 host_frames: bool, budget_s: float) -> dict:
     import numpy as np
 
     from nnstreamer_tpu.backends.jax_xla import register_jax_model
@@ -98,7 +107,6 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     if which == "mobilenet":
         size, family, props = 224, "mobilenet_v2", {"dtype": dtype}
         decoder = f"tensor_decoder mode=image_labeling option1={labels_path} ! "
-        metric = "mobilenet_v2_image_labeling_fps_per_chip"
     elif which == "ssd":
         from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
 
@@ -109,7 +117,6 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
             f"option2={labels_path} option3={priors} option4=300:300 "
             "option5=300:300 ! "
         )
-        metric = "ssd_mobilenet_v2_bbox_fps_per_chip"
     elif which == "yolov5":
         size = int(os.environ.get("BENCH_SIZE", "640"))
         family, props = "yolov5s", {"dtype": dtype, "size": str(size)}
@@ -118,17 +125,16 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
             f"option2={labels_path} option4={size}:{size} "
             f"option5={size}:{size} ! "
         )
-        metric = "yolov5s_bbox_fps_per_chip"
     elif which == "posenet":
         size, family, props = 257, "posenet", {"dtype": dtype}
         decoder = (
             "tensor_decoder mode=pose_estimation option1=257:257 "
             "option2=257:257 option4=heatmap-offset ! "
         )
-        metric = "posenet_pose_fps_per_chip"
     else:
         raise SystemExit(f"unknown BENCH_MODEL {which!r}")
 
+    metric = METRICS[which][0]
     fn, params, in_spec, out_spec = build(family, props)
     register_jax_model("bench_model", fn, params, in_spec, out_spec)
 
@@ -158,14 +164,26 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     pipe.start()
     src, sink = pipe["src"], pipe["out"]
 
+    # the child must self-report before the parent's kill deadline, so the
+    # warmup/measure windows are carved out of the budget (compile time
+    # dominates warmup; whatever remains is the measure cap)
+    t_start = time.time()
+    warmup_cap = budget_s * 0.7
+
     # warmup: trigger compiles for the full bucket and any tail buckets
     done = {"n": 0}
     sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
     for i in range(batch * 2):
         src.push(pool[i % len(pool)])
     t_wait = time.time()
-    while done["n"] < batch * 2 and time.time() - t_wait < 300:
+    while done["n"] < batch * 2 and time.time() - t_wait < warmup_cap:
         time.sleep(0.01)
+    if done["n"] < batch * 2:
+        pipe.stop()
+        raise RuntimeError(
+            f"warmup incomplete: {done['n']}/{batch * 2} frames in "
+            f"{warmup_cap:.0f}s"
+        )
     # drain stragglers so leftover warmup completions can never leak into
     # the measured counter: wait until the count is stable for 2 s
     stable_since, last = time.time(), done["n"]
@@ -174,12 +192,13 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         if done["n"] != last:
             stable_since, last = time.time(), done["n"]
 
-    # measured run
+    # measured run (cap: whatever remains of the budget, minus margin)
+    measure_cap = max(30.0, budget_s - (time.time() - t_start) - 15.0)
     done["n"] = 0
     t0 = time.perf_counter()
     for i in range(n_frames):
         src.push(pool[i % len(pool)])
-    while done["n"] < n_frames and time.perf_counter() - t0 < 600:
+    while done["n"] < n_frames and time.perf_counter() - t0 < measure_cap:
         time.sleep(0.005)
     dt = time.perf_counter() - t0
     fps = done["n"] / dt
@@ -201,13 +220,15 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     }
 
 
-def trainer_row(dtype: str) -> dict:
+def trainer_row(dtype: str, budget_s: float) -> dict:
     """BASELINE.md row: tensor_trainer MNIST CNN epoch time (tracked)."""
     from nnstreamer_tpu.trainer.jax_trainer import mnist_epoch_benchmark
 
-    secs, acc = mnist_epoch_benchmark(dtype=dtype)
+    secs, acc = mnist_epoch_benchmark(
+        dtype=dtype, timeout_s=max(60.0, budget_s - 30.0)
+    )
     return {
-        "metric": "mnist_cnn_trainer_epoch_seconds",
+        "metric": METRICS["mnist_trainer"][0],
         "value": round(secs, 2),
         "unit": "s",
         "vs_baseline": None,
@@ -215,13 +236,65 @@ def trainer_row(dtype: str) -> dict:
     }
 
 
-METRICS = {
-    "mobilenet": ("mobilenet_v2_image_labeling_fps_per_chip", "fps"),
-    "ssd": ("ssd_mobilenet_v2_bbox_fps_per_chip", "fps"),
-    "yolov5": ("yolov5s_bbox_fps_per_chip", "fps"),
-    "posenet": ("posenet_pose_fps_per_chip", "fps"),
-    "mnist_trainer": ("mnist_cnn_trainer_epoch_seconds", "s"),
-}
+def child_main() -> None:
+    """Run the actual measurement; print the result row on the last line.
+
+    Runs inside a killable subprocess (see main): accelerator ops dispatch
+    into C calls that no in-process alarm can interrupt when the device
+    tunnel wedges mid-run, so the deadline lives in the parent.
+    """
+    which = os.environ.get("BENCH_MODEL", "mobilenet")
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    n_frames = int(os.environ.get("BENCH_FRAMES", "4096"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    host_frames = os.environ.get("BENCH_HOST", "0").lower() in (
+        "1", "true", "yes",
+    )
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    budget = float(os.environ.get("BENCH_DEADLINE", "420"))
+    if which == "mnist_trainer":
+        row = trainer_row(dtype, budget)
+    else:
+        row = pipeline_row(which, batch, n_frames, dtype, host_frames, budget)
+    print("BENCHROW " + json.dumps(row), flush=True)
+
+
+def run_child(deadline_s: float) -> tuple:
+    """(row|None, error_string).
+
+    Child stderr is inherited (diagnostics stream through live); stdout is
+    captured for the BENCHROW line.  The kill deadline gets a grace margin
+    over the child's own budget so a self-reporting child always wins the
+    race — the kill only fires when the child is truly wedged (tunnel hang
+    inside a C call).
+    """
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+t") as out:
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=out, timeout=deadline_s + 60.0,
+            )
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            out.seek(0)
+            tail = out.read().strip().splitlines()
+            return None, (
+                f"bench run exceeded {deadline_s + 60:.0f}s deadline; "
+                f"last output: {tail[-1] if tail else 'none'}"
+            )
+        out.seek(0)
+        lines = out.read().splitlines()
+    for line in reversed(lines):
+        if line.startswith("BENCHROW "):
+            return json.loads(line[len("BENCHROW "):]), ""
+    return None, (
+        f"bench child rc={rc}: {lines[-1] if lines else 'no stdout'}"
+    )
 
 
 def main() -> None:
@@ -235,30 +308,21 @@ def main() -> None:
         })
         return
     metric, unit = METRICS[which]
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    n_frames = int(os.environ.get("BENCH_FRAMES", "4096"))
-    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     host_frames = os.environ.get("BENCH_HOST", "0").lower() in (
         "1", "true", "yes",
     )
     force_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
-
     meta = {
         "model": which,
-        "batch": batch,
-        "dtype": dtype,
+        "batch": int(os.environ.get("BENCH_BATCH", "128")),
+        "dtype": os.environ.get("BENCH_DTYPE", "bfloat16"),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"
         ),
     }
 
-    if force_cpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    else:
+    if not force_cpu:
         err = probe_backend(
             tries=int(os.environ.get("BENCH_PROBE_TRIES", "3")),
             timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "180")),
@@ -271,22 +335,25 @@ def main() -> None:
             })
             return
 
-    try:
-        if which == "mnist_trainer":
-            row = trainer_row(dtype)
-        else:
-            row = pipeline_row(which, batch, n_frames, dtype, host_frames)
-        emit({**row, **meta})
-    except Exception as e:  # fail-soft: one diagnosable JSON line
-        import traceback
-
-        traceback.print_exc()
-        emit({
-            "metric": metric, "value": None, "unit": unit,
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}", **meta,
-        })
+    deadline = float(os.environ.get("BENCH_DEADLINE", "420"))
+    tries = int(os.environ.get("BENCH_TRIES", "2"))
+    err = "no attempts"
+    for attempt in range(1, tries + 1):
+        row, err = run_child(deadline)
+        if row is not None:
+            emit({**row, **meta})
+            return
+        sys.stderr.write(
+            f"[bench] attempt {attempt}/{tries} failed: {err}\n"
+        )
+    emit({
+        "metric": metric, "value": None, "unit": unit,
+        "vs_baseline": None, "error": err, **meta,
+    })
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main()
+    else:
+        main()

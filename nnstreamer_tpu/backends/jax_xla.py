@@ -207,8 +207,24 @@ class JaxXla(FilterBackend):
         fuses the postprocess into the same program, so only its (usually
         tiny) result ever crosses PCIe.  Used by the pipeline's device-
         fusion pass; survives hot reload (applied outside the model fn).
+
+        Postprocess fns that take a ``platform`` keyword get the platform
+        of THIS backend's device (not the process default) so they can
+        pick device-specific kernels (e.g. Pallas top-1 on tpu only).
         """
-        self._posts.append(fn)
+        import inspect
+
+        try:
+            takes_platform = "platform" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            takes_platform = False
+        if takes_platform:
+            wrapped = lambda outs, _fn=fn: _fn(  # noqa: E731
+                outs, platform=self._device.platform
+            )
+        else:
+            wrapped = fn
+        self._posts.append(wrapped)
         with self._cache_lock:
             self._jit_cache.clear()
 

@@ -26,9 +26,13 @@ def report() -> str:
     lines: List[str] = []
     lines.append("nnstreamer_tpu configuration check")
     lines.append("=" * 40)
-    import jax
+    from ..core import hw
 
-    lines.append(f"jax backend devices : {[str(d) for d in jax.devices()]}")
+    # time-bounded probe: device enumeration through a wedged accelerator
+    # tunnel must not hang a conf-check tool
+    hw_info = hw.probe()
+    dev_desc = hw_info["devices"] or [hw_info.get("error", "none found")]
+    lines.append(f"jax backend devices : {dev_desc}")
     lines.append(f"config loaded from  : {config.loaded_from() or '(defaults)'}")
     lines.append("")
     factories = sorted(set(ELEMENT_TYPES))

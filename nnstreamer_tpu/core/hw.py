@@ -5,29 +5,86 @@ hwcap, 64 LoC) — used to pick accelerated code paths.  The TPU analog
 probes the XLA backend: platform, device kind/count, and whether a real
 accelerator (vs host CPU) is attached; backends use it to choose dtypes
 (bfloat16 on TPU) and batching defaults.
+
+The probe is time-bounded: remote/tunneled accelerator backends can hang
+indefinitely inside device enumeration (an uninterruptible C call), and a
+capability *probe* must never wedge the caller — tools like confchk run it
+on hosts whose accelerator may be unreachable.  On timeout the probe
+reports an unaccelerated host so callers degrade to CPU defaults.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache
-from typing import Dict, List
+import os
+import queue
+import threading
+from typing import Dict
+
+_cache: Dict[str, object] = {}
+_cache_lock = threading.Lock()
 
 
-@lru_cache(maxsize=1)
-def probe() -> Dict[str, object]:
+def _query_devices(out: "queue.Queue") -> None:
+    try:
+        import jax
+
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "none"
+        out.put({
+            "platform": platform,
+            "device_kind": devs[0].device_kind if devs else "none",
+            "num_devices": len(devs),
+            "accelerated": platform not in ("cpu", "none"),
+            "devices": [str(d) for d in devs],
+        })
+    except Exception as e:  # backend init failure = no accelerator
+        out.put({
+            "platform": "none",
+            "device_kind": "none",
+            "num_devices": 0,
+            "accelerated": False,
+            "devices": [],
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+
+def probe(timeout_s: float = None) -> Dict[str, object]:
     """One-time device probe: {'platform', 'device_kind', 'num_devices',
-    'accelerated', 'devices'}."""
-    import jax
+    'accelerated', 'devices'[, 'error']}.
 
-    devs = jax.devices()
-    platform = devs[0].platform if devs else "none"
-    return {
-        "platform": platform,
-        "device_kind": devs[0].device_kind if devs else "none",
-        "num_devices": len(devs),
-        "accelerated": platform not in ("cpu", "none"),
-        "devices": [str(d) for d in devs],
-    }
+    Successful results are cached for the process; timeouts are NOT, so a
+    backend that comes up later is still discovered.
+    """
+    with _cache_lock:
+        if _cache:
+            return dict(_cache)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("NNS_TPU_HW_PROBE_TIMEOUT", "30"))
+    out: "queue.Queue" = queue.Queue()
+    t = threading.Thread(target=_query_devices, args=(out,), daemon=True)
+    t.start()
+    try:
+        result = out.get(timeout=timeout_s)
+    except queue.Empty:
+        # leave the stuck enumeration thread parked (daemon); report an
+        # unaccelerated host but do not cache — the tunnel may recover
+        return {
+            "platform": "none",
+            "device_kind": "none",
+            "num_devices": 0,
+            "accelerated": False,
+            "devices": [],
+            "error": f"device probe timed out after {timeout_s:.0f}s",
+        }
+    with _cache_lock:
+        _cache.update(result)
+    return dict(result)
+
+
+def reset() -> None:
+    """Drop the cached probe (tests / after backend reconfiguration)."""
+    with _cache_lock:
+        _cache.clear()
 
 
 def has_accelerator() -> bool:

@@ -50,14 +50,16 @@ class ImageLabeling:
         return out
 
     # -- device-fused half (pipeline fusion pass) ---------------------------
-    def device_fn(self, outs):
+    def device_fn(self, outs, platform=None):
         """jit-traceable half, folded into the upstream filter's XLA
         program: fused argmax+max (Pallas row-reduction on TPU,
         ``ops/labeling.py``) so only (index, score) — 8 bytes/frame —
-        ever crosses PCIe instead of the full score tensor."""
+        ever crosses PCIe instead of the full score tensor.  ``platform``
+        comes from the backend that compiles this (its actual device, not
+        the process default)."""
         from ..ops.labeling import top1
 
-        idx, score = top1(outs[0])
+        idx, score = top1(outs[0], platform=platform)
         return [idx[..., None], score[..., None]]  # (B,1)/(1,) each
 
     def decode_fused(self, frame: TensorFrame, in_spec) -> TensorFrame:

@@ -157,6 +157,15 @@ class TensorFilter(TransformElement):
         # combination props parsed once at start (hot path stays parse-free)
         self._in_comb: Optional[List[Tuple[str, int]]] = None
         self._out_comb: Optional[List[Tuple[str, int]]] = None
+        # set by the pipeline's device-fusion pass (NOT the user prop, so a
+        # restart without the pass re-fusing leaves the chain unfused)
+        self._auto_batch_through = False
+
+    @property
+    def batch_through_active(self) -> bool:
+        """Effective batch-through: the user prop, or the device-fusion
+        pass's per-run flag (reset on every start)."""
+        return bool(self.props["batch-through"]) or self._auto_batch_through
 
     # -- device fusion (pipeline pass) --------------------------------------
     @property
@@ -196,6 +205,7 @@ class TensorFilter(TransformElement):
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._tracing = False
+        self._auto_batch_through = False  # re-set by the fusion pass, or not
         self._in_comb = _parse_combination(self.props["input-combination"])
         self._out_comb = _parse_combination(self.props["output-combination"])
         if self.props["batch-through"] and self._out_comb:
@@ -376,7 +386,7 @@ class TensorFilter(TransformElement):
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, len(frames))
-        if self.props["batch-through"]:
+        if self.batch_through_active:
             # device residency: the whole micro-batch leaves as ONE frame,
             # outputs still on device (jax.Array) — no host sync here, so
             # the next batch's stack/dispatch overlaps this one's compute.

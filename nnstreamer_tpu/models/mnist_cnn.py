@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 
 
 class MnistCNN(nn.Module):
@@ -48,9 +49,10 @@ def build(custom_props=None):
     ]
     classes = int(props.get("classes", "10"))
     model = MnistCNN(num_classes=classes, dtype=dtype)
-    params = model.init(
-        jax.random.PRNGKey(int(props.get("seed", "0"))),
-        jnp.zeros((1, 28, 28, 1), jnp.float32),
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, 28, 28, 1), np.float32),
     )
 
     def fn(p, inputs: List[Any]) -> List[Any]:

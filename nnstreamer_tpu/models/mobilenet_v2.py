@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 
 # (expansion t, channels c, repeats n, stride s) — standard v2 table
 _CFG: Sequence[Tuple[int, int, int, int]] = (
@@ -146,8 +147,11 @@ def build(custom_props=None):
         dtype=dtype,
         pallas_preprocess=props.get("pallas", "0") in ("1", "true"),
     )
-    rng = jax.random.PRNGKey(int(props.get("seed", "0")))
-    variables = model.init(rng, jnp.zeros((1, size, size, 3), jnp.uint8))
+    variables = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
+    )
 
     def fn(params, inputs: List[Any]) -> List[Any]:
         x = inputs[0]

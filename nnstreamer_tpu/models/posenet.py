@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 from .mobilenet_v2 import _CFG, ConvBN, InvertedResidual, _make_divisible
 
 
@@ -63,9 +64,10 @@ def build(custom_props=None):
     kpts = int(props.get("keypoints", "17"))
     with_off = props.get("offsets", "1") not in ("0", "false")
     model = PoseNet(num_keypoints=kpts, with_offsets=with_off, dtype=dtype)
-    params = model.init(
-        jax.random.PRNGKey(int(props.get("seed", "0"))),
-        jnp.zeros((1, size, size, 3), jnp.uint8),
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
     )
     gh = gw = (size + 15) // 16
 

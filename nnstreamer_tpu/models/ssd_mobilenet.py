@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 from .mobilenet_v2 import _CFG, ConvBN, InvertedResidual, _make_divisible
 
 # one (grid, scale, aspect-ratios) row per SSD feature map, 300x300 layout
@@ -121,9 +122,10 @@ def build(custom_props=None):
         raise ValueError("ssd_mobilenet_v2 supports size=300 only")
     classes = int(props.get("classes", "91"))
     model = SSDMobileNetV2(num_classes=classes, dtype=dtype)
-    params = model.init(
-        jax.random.PRNGKey(int(props.get("seed", "0"))),
-        jnp.zeros((1, size, size, 3), jnp.uint8),
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
     )
 
     def fn(params, inputs):

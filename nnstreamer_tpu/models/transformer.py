@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 from ..parallel.ring_attention import reference_attention, ring_attention
 
 
@@ -112,9 +113,10 @@ def build(custom_props=None):
     props = custom_props or {}
     cfg = _cfg_from_props(props)
     model = TransformerLM(cfg)
-    params = model.init(
-        jax.random.PRNGKey(int(props.get("seed", "0"))),
-        jnp.zeros((1, min(8, cfg.max_seq)), jnp.int32),
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, min(8, cfg.max_seq)), np.int32),
     )
 
     def fn(p, inputs):
@@ -155,8 +157,8 @@ def make_train_step(
     cfg = cfg or TransformerConfig()
     # init with an unsharded twin (same param structure; ring attention needs
     # shard-divisible shapes the tiny init batch doesn't have)
-    params = TransformerLM(cfg).init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    params = host_init(
+        TransformerLM(cfg).init, 0, np.zeros((1, 8), np.int32)
     )
     model = TransformerLM(cfg, mesh=mesh, seq_axis=seq_axis)
     tx = optax.adamw(learning_rate)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
 
 # (stride, anchors (w,h) in px @ 640) — standard yolov5 anchor table
 _ANCHORS: Sequence[Tuple[int, Tuple[Tuple[float, float], ...]]] = (
@@ -176,9 +177,10 @@ def build(custom_props=None):
     iou_thr = float(props.get("iou", "0.45"))
     nms_topk = int(props.get("nms_topk", "300"))
     model = YOLOv5s(num_classes=classes, size=size, dtype=dtype)
-    params = model.init(
-        jax.random.PRNGKey(int(props.get("seed", "0"))),
-        jnp.zeros((1, size, size, 3), jnp.uint8),
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
     )
 
     def fn(params, inputs):

@@ -42,14 +42,22 @@ def _pallas_top1(x):
     return idx[:, 0], val[:, 0]
 
 
-def top1(logits, use_pallas: bool = True):
-    """logits (B, C) or (C,) -> (argmax int32, max float32) per row."""
+def top1(logits, use_pallas: bool = True, platform: str = None):
+    """logits (B, C) or (C,) -> (argmax int32, max float32) per row.
+
+    ``platform`` is the platform of the device this computation actually
+    runs on; callers compiling for a non-default device (e.g. a filter
+    with accelerator=cpu on a TPU host) must pass it — the default-backend
+    guess is wrong exactly there, and a Pallas TPU kernel traced into a
+    CPU program fails to lower.
+    """
     x = jnp.asarray(logits)
     single = x.ndim == 1
     if single:
         x = x[None]
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if use_pallas and on_tpu:
+    if platform is None:
+        platform = jax.default_backend()
+    if use_pallas and platform == "tpu":
         # pad classes to a lane multiple with -inf (argmax unaffected)
         C = x.shape[1]
         Cp = (C + _LANES - 1) // _LANES * _LANES

@@ -161,7 +161,7 @@ class Pipeline:
             el.fuse_device_postprocess(dst._dec.device_fn)
             dst.enable_fused()
             if el.preferred_batch > 1:
-                el.props["batch-through"] = True
+                el._auto_batch_through = True
             self.log.info(
                 "device-fused %s -> %s (decoder half compiled into the "
                 "filter's XLA program)", el.name, dst.name,

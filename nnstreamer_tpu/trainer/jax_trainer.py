@@ -129,6 +129,11 @@ class JaxTrainer(TrainerBackend):
         load_path = self._props.get("model-load-path")
         if load_path:
             params = _load_params(load_path, params)
+        # zoo params come back committed to host CPU (models/_init_util.py);
+        # re-commit to the accelerator so training compiles there, and init
+        # the optimizer as one compiled call (eager tree_map would dispatch
+        # a tiny op per leaf through the device tunnel)
+        params = jax.device_put(params, jax.devices()[0])
         lr = float(self._cfg.get("learning_rate", 1e-3))
         opt_name = self._cfg.get("optimizer", "adam")
         tx = {
@@ -136,7 +141,7 @@ class JaxTrainer(TrainerBackend):
             "adamw": optax.adamw,
             "sgd": optax.sgd,
         }[opt_name](lr)
-        opt_state = tx.init(params)
+        opt_state = jax.jit(tx.init)(params)
 
         loss_kind = self._cfg.get("loss", "softmax_ce")
 
@@ -337,6 +342,7 @@ def mnist_epoch_benchmark(
     n_valid: int = 256,
     epochs: int = 3,
     tmp_dir: str = "/tmp/nns_mnist_bench",
+    timeout_s: float = 900.0,
 ) -> Tuple[float, float]:
     """BASELINE.md tracked row: tensor_trainer MNIST CNN epoch time.
 
@@ -394,7 +400,7 @@ def mnist_epoch_benchmark(
     arrivals = []
     pipe.start()
     pipe["out"].connect_new_data(lambda f: arrivals.append(time.perf_counter()))
-    pipe.wait(timeout=900)
+    pipe.wait(timeout=timeout_s)
     stats = [f.tensors[0] for f in pipe["out"].frames]
     pipe.stop()
 

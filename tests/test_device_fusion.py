@@ -68,7 +68,10 @@ class TestDeviceFusion:
             if fused == "yes":
                 # the pass must also have switched the filter to
                 # device-resident batch-through emission
-                assert pipe["f"].props["batch-through"] is True
+                assert pipe["f"].batch_through_active is True
+                # the user-visible prop must stay untouched (restart without
+                # re-fusing must not inherit batch-through)
+                assert pipe["f"].props["batch-through"] is False
             frames = list(pipe["out"].frames)
             pipe.stop()
             assert [f.meta["label_index"] for f in frames] == expected
