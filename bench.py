@@ -30,6 +30,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+_T0 = time.time()  # child-process start; deadline windows anchor here
 NORTH_STAR_FPS = 1000.0  # BASELINE.json north star, MobileNet headline row
 
 
@@ -91,7 +92,12 @@ METRICS = {
 
 
 def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
-                 host_frames: bool, budget_s: float) -> dict:
+                 host_frames: bool, deadline_ts: float) -> dict:
+    """``deadline_ts`` is the absolute time.time() by which this function
+    must have returned (the parent kills 60s after it): every internal
+    wait is carved from time-remaining, so imports/model-build/compile
+    time spent before any given phase shrinks that phase's window instead
+    of pushing the whole run past the kill."""
     import numpy as np
 
     from nnstreamer_tpu.backends.jax_xla import register_jax_model
@@ -164,11 +170,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     pipe.start()
     src, sink = pipe["src"], pipe["out"]
 
-    # the child must self-report before the parent's kill deadline, so the
-    # warmup/measure windows are carved out of the budget (compile time
-    # dominates warmup; whatever remains is the measure cap)
-    t_start = time.time()
-    warmup_cap = budget_s * 0.7
+    # compile time dominates warmup; whatever remains is the measure cap
+    warmup_cap = max(30.0, (deadline_ts - time.time()) * 0.7)
 
     # warmup: trigger compiles for the full bucket and any tail buckets
     done = {"n": 0}
@@ -192,8 +195,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         if done["n"] != last:
             stable_since, last = time.time(), done["n"]
 
-    # measured run (cap: whatever remains of the budget, minus margin)
-    measure_cap = max(30.0, budget_s - (time.time() - t_start) - 15.0)
+    # measured run (cap: whatever remains of the budget, minus EOS margin)
+    measure_cap = max(30.0, deadline_ts - time.time() - 15.0)
     done["n"] = 0
     t0 = time.perf_counter()
     for i in range(n_frames):
@@ -220,12 +223,12 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     }
 
 
-def trainer_row(dtype: str, budget_s: float) -> dict:
+def trainer_row(dtype: str, deadline_ts: float) -> dict:
     """BASELINE.md row: tensor_trainer MNIST CNN epoch time (tracked)."""
     from nnstreamer_tpu.trainer.jax_trainer import mnist_epoch_benchmark
 
     secs, acc = mnist_epoch_benchmark(
-        dtype=dtype, timeout_s=max(60.0, budget_s - 30.0)
+        dtype=dtype, timeout_s=max(60.0, deadline_ts - time.time() - 30.0)
     )
     return {
         "metric": METRICS["mnist_trainer"][0],
@@ -254,11 +257,16 @@ def child_main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    budget = float(os.environ.get("BENCH_DEADLINE", "420"))
+    # absolute deadline anchored at process start (_T0, module import),
+    # so import/build/compile time shrinks later windows instead of
+    # racing the parent's kill
+    deadline_ts = _T0 + float(os.environ.get("BENCH_DEADLINE", "420"))
     if which == "mnist_trainer":
-        row = trainer_row(dtype, budget)
+        row = trainer_row(dtype, deadline_ts)
     else:
-        row = pipeline_row(which, batch, n_frames, dtype, host_frames, budget)
+        row = pipeline_row(
+            which, batch, n_frames, dtype, host_frames, deadline_ts
+        )
     print("BENCHROW " + json.dumps(row), flush=True)
 
 

@@ -47,7 +47,11 @@ def report() -> str:
         lines.append(f"{kind} subplugins ({len(names)}):")
         for n in names:
             desc = registry.get_custom_property_desc(kind, n)
-            lines.append(f"  {n}" + (f"  {desc}" if desc else ""))
+            if desc:  # Dict[str, str] -> readable "key: help" list
+                desc_text = ", ".join(f"{k}: {v}" for k, v in desc.items())
+                lines.append(f"  {n}  [{desc_text}]")
+            else:
+                lines.append(f"  {n}")
     return "\n".join(lines) + "\n"
 
 

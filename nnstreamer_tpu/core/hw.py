@@ -16,7 +16,6 @@ reports an unaccelerated host so callers degrade to CPU defaults.
 from __future__ import annotations
 
 import os
-import queue
 import threading
 from typing import Dict
 
@@ -24,28 +23,54 @@ _cache: Dict[str, object] = {}
 _cache_lock = threading.Lock()
 
 
-def _query_devices(out: "queue.Queue") -> None:
-    try:
-        import jax
+_PROBE_SRC = (
+    "import json, jax; d = jax.devices(); p = d[0].platform if d else 'none';"
+    "print('HWPROBE ' + json.dumps({'platform': p,"
+    "'device_kind': d[0].device_kind if d else 'none',"
+    "'num_devices': len(d), 'accelerated': p not in ('cpu', 'none'),"
+    "'devices': [str(x) for x in d]}))"
+)
 
-        devs = jax.devices()
-        platform = devs[0].platform if devs else "none"
-        out.put({
-            "platform": platform,
-            "device_kind": devs[0].device_kind if devs else "none",
-            "num_devices": len(devs),
-            "accelerated": platform not in ("cpu", "none"),
-            "devices": [str(d) for d in devs],
-        })
-    except Exception as e:  # backend init failure = no accelerator
-        out.put({
-            "platform": "none",
-            "device_kind": "none",
-            "num_devices": 0,
-            "accelerated": False,
-            "devices": [],
-            "error": f"{type(e).__name__}: {e}",
-        })
+
+def _fail(err: str) -> Dict[str, object]:
+    return {
+        "platform": "none",
+        "device_kind": "none",
+        "num_devices": 0,
+        "accelerated": False,
+        "devices": [],
+        "error": err,
+    }
+
+
+def _query_devices(timeout_s: float) -> Dict[str, object]:
+    """Enumerate devices from a THROWAWAY subprocess.
+
+    Never in-process: a wedged ``jax.devices()`` holds jax's global
+    backend lock, so a parked probe thread would block every later jax
+    call in the process — the exact hang the probe exists to prevent.  A
+    subprocess is killable and leaves this process's jax state untouched.
+    """
+    import json
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return _fail(f"device probe timed out after {timeout_s:.0f}s")
+    except OSError as e:
+        return _fail(f"device probe failed to launch: {e}")
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("HWPROBE "):
+            return json.loads(line[len("HWPROBE "):])
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return _fail(
+        f"device probe rc={r.returncode}: {tail[-1] if tail else 'no output'}"
+    )
 
 
 def probe(timeout_s: float = None) -> Dict[str, object]:
@@ -60,22 +85,10 @@ def probe(timeout_s: float = None) -> Dict[str, object]:
             return dict(_cache)
     if timeout_s is None:
         timeout_s = float(os.environ.get("NNS_TPU_HW_PROBE_TIMEOUT", "30"))
-    out: "queue.Queue" = queue.Queue()
-    t = threading.Thread(target=_query_devices, args=(out,), daemon=True)
-    t.start()
-    try:
-        result = out.get(timeout=timeout_s)
-    except queue.Empty:
-        # leave the stuck enumeration thread parked (daemon); report an
-        # unaccelerated host but do not cache — the tunnel may recover
-        return {
-            "platform": "none",
-            "device_kind": "none",
-            "num_devices": 0,
-            "accelerated": False,
-            "devices": [],
-            "error": f"device probe timed out after {timeout_s:.0f}s",
-        }
+    result = _query_devices(timeout_s)
+    if "error" in result:
+        # do not cache failures — the tunnel may recover
+        return result
     with _cache_lock:
         _cache.update(result)
     return dict(result)

@@ -267,7 +267,9 @@ class MqttClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
-        self._cb: Optional[Callable[[str, bytes], None]] = None
+        # per-pattern callbacks: a second subscribe() must not reroute
+        # earlier patterns' messages to the newest callback
+        self._subs: Dict[str, Callable[[str, bytes], None]] = {}
         self._stop = threading.Event()
         self._pid = 0
         cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
@@ -312,7 +314,7 @@ class MqttClient:
 
     def subscribe(self, pattern: str,
                   callback: Callable[[str, bytes], None]) -> None:
-        self._cb = callback
+        self._subs[pattern] = callback
         self._pid += 1
         var = (
             struct.pack(">H", self._pid) + _mqtt_str(pattern) + bytes([0])
@@ -339,14 +341,17 @@ class MqttClient:
                 ptype, flags, body = _read_packet(self._sock)
             except (ConnectionError, OSError):
                 return
-            if ptype != PUBLISH or self._cb is None:
+            if ptype != PUBLISH or not self._subs:
                 continue
             try:
                 topic, payload = _parse_publish(flags, body)
             except MqttProtocolError as e:
                 log.warning("client: dropping malformed PUBLISH: %s", e)
                 continue
-            try:
-                self._cb(topic, payload)
-            except Exception:  # subscriber bugs must not kill the reader
-                log.exception("mqtt subscribe callback failed")
+            for pattern, cb in list(self._subs.items()):
+                if not topic_matches(pattern, topic):
+                    continue
+                try:
+                    cb(topic, payload)
+                except Exception:  # subscriber bugs must not kill the reader
+                    log.exception("mqtt subscribe callback failed")

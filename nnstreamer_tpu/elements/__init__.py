@@ -9,6 +9,7 @@ from . import basic  # noqa: F401
 
 _here = _os.path.dirname(__file__)
 for _mod in (
+    "media_src",
     "converter",
     "filter",
     "transform",

@@ -8,11 +8,16 @@ audio/x-raw (frames-per-buffer), text (fixed bytes/frame), octet-stream
 (reshape per input-dim/input-type), flexible tensors (parse per-memory
 header), anything else via converter subplugins.
 
-Here upstream sources already carry arrays; the converter's job is framing
-and typing: batch ``frames-per-tensor`` media frames into one tensor
-(reference: 3:W:H:1 -> 3:W:H:N, numpy (N,H,W,C)), reinterpret octet/byte
-payloads per ``input-dim``/``input-type``, decode flexible-header bytes, and
-delegate unknown media to converter subplugins (registry kind "converter").
+Raw media payloads arrive from the media sources (``elements/media_src.py``)
+as byte buffers with a ``meta["media"]`` :class:`MediaInfo`; this element
+does the reference's actual framing work: video stride removal (rows padded
+to 4 bytes -> packed (H, W, C)), audio sample framing ((N, channels) per
+the sample format), text fixed-size framing (pad/truncate to ``input-dim``
+bytes), octet reshaping per ``input-dim``/``input-type``.  Array payloads
+(appsrc/videotestsrc) pass through with ``frames-per-tensor`` batching
+(reference: 3:W:H:1 -> 3:W:H:N, numpy (N,H,W,C)); flexible-header bytes are
+decoded; unknown media goes to converter subplugins (registry kind
+"converter").
 """
 
 from __future__ import annotations
@@ -87,10 +92,55 @@ class TensorConverter(Element):
         dtype = dtype_from_name(self.props["input-type"] or "uint8")
         return TensorSpec(parse_dims_string(self.props["input-dim"]), dtype)
 
+    def _media_tensor_spec(self, media) -> Optional[TensorSpec]:
+        """Static tensor schema for a negotiated media payload (≙ the
+        reference deriving other/tensors caps from video/audio/text caps,
+        gsttensor_converter.c parse_caps :168)."""
+        if media.mtype == "video":
+            return TensorSpec(
+                (media.height, media.width, media.pixel_channels),
+                np.uint8, "video",
+            )
+        if media.mtype == "audio":
+            if media.samples_per_buffer:
+                return TensorSpec(
+                    (media.samples_per_buffer, media.channels),
+                    media.sample_dtype, "audio",
+                )
+            return None  # per-buffer framing resolved at runtime
+        if media.mtype == "text":
+            octet = self._octet_spec()
+            if octet is None:
+                raise ElementError(
+                    f"{self.name}: text/x-raw needs input-dim= (fixed "
+                    "bytes per frame, reference converter contract)"
+                )
+            if octet.dtype != np.uint8:
+                # the reference pins text frames to uint8 bytes
+                raise ElementError(
+                    f"{self.name}: text/x-raw is uint8 only "
+                    f"(got input-type={self.props['input-type']!r})"
+                )
+            return octet
+        return self._octet_spec()  # octet: None until input-dim is set
+
     def derive_spec(self, pad=0):
+        from ..media.caps import MediaSpec
+
         in_spec = self.sink_specs.get(0, ANY)
         if self._sub is not None and hasattr(self._sub, "get_out_spec"):
             return self._sub.get_out_spec(in_spec)
+        if isinstance(in_spec, MediaSpec) and in_spec.media is not None:
+            t = self._media_tensor_spec(in_spec.media)
+            if t is None:
+                return ANY
+            fpt = self.props["frames-per-tensor"]
+            fr = in_spec.media.framerate
+            if fpt > 1:
+                t = t.with_batch(fpt)
+                if fr is not None:
+                    fr = fr / fpt
+            return StreamSpec((t,), FORMAT_STATIC, fr)
         octet = self._octet_spec()
         if octet is not None:
             return StreamSpec((octet,), FORMAT_STATIC, in_spec.framerate)
@@ -106,10 +156,69 @@ class TensorConverter(Element):
         return ANY
 
     # -- processing ---------------------------------------------------------
+    def _convert_media(self, frame: TensorFrame, media) -> TensorFrame:
+        """Frame a raw media payload into its tensor (reference per-type
+        chains, gsttensor_converter.c:750-1005)."""
+        buf = np.asarray(frame.tensors[0]).reshape(-1).view(np.uint8)
+        if media.mtype == "video":
+            h, stride, rb = media.height, media.stride, media.row_bytes
+            if len(buf) != h * stride:
+                raise ElementError(
+                    f"{self.name}: video payload {len(buf)}B != "
+                    f"height {h} x stride {stride}"
+                )
+            # stride removal (≙ the converter's per-row memcpy when
+            # width%4 != 0) then pack to (H, W, C)
+            img = buf.reshape(h, stride)[:, :rb].reshape(
+                h, media.width, media.pixel_channels
+            )
+            return frame.with_tensors([img])
+        if media.mtype == "audio":
+            bpf = media.bytes_per_frame
+            if len(buf) % bpf:
+                raise ElementError(
+                    f"{self.name}: audio payload {len(buf)}B not a "
+                    f"multiple of frame size {bpf}B"
+                )
+            arr = buf.view(media.sample_dtype).reshape(-1, media.channels)
+            return frame.with_tensors([arr])
+        if media.mtype == "text":
+            octet = self._octet_spec()
+            if octet is None or octet.dtype != np.uint8:
+                raise ElementError(
+                    f"{self.name}: text/x-raw needs input-dim= "
+                    "(uint8 only)"
+                )
+            size = octet.nbytes
+            out = np.zeros(size, np.uint8)  # pad with NUL / truncate
+            n = min(size, len(buf))
+            out[:n] = buf[:n]
+            return frame.with_tensors([out.reshape(octet.shape)])
+        # octet: reshape per input-dim/input-type (reference :940-1005)
+        octet = self._octet_spec()
+        if octet is None:
+            raise ElementError(
+                f"{self.name}: octet payload needs input-dim=/input-type="
+            )
+        if len(buf) != octet.nbytes:
+            raise ElementError(
+                f"{self.name}: octet payload {len(buf)}B != schema "
+                f"{octet.nbytes}B (set filesrc blocksize accordingly)"
+            )
+        return frame.with_tensors(
+            [buf.view(octet.dtype).reshape(octet.shape)]
+        )
+
     def _convert_one(self, frame: TensorFrame) -> TensorFrame:
         if self._sub is not None:
             out = self._sub.convert(frame)
             return out if isinstance(out, TensorFrame) else frame.with_tensors(out)
+        media = frame.meta.get("media")
+        if media is not None:
+            out = self._convert_media(frame, media)
+            out.meta = dict(out.meta)
+            out.meta.pop("media", None)  # tensors now, not raw media
+            return out
         octet = self._octet_spec()
         if octet is not None:
             raw = np.asarray(frame.tensors[0]).reshape(-1).view(np.uint8)

@@ -166,11 +166,13 @@ class GrpcSrc(SourceElement):
         self._srv: Optional[_StreamServer] = None
         self._channel = None
         self.bound_port: Optional[int] = None
+        self._reader_stop = threading.Event()
 
     def output_spec(self) -> StreamSpec:
         return ANY
 
     def start(self) -> None:
+        self._reader_stop.clear()
         if self.props["server"]:
             self._srv = _StreamServer(
                 self.props["host"], self.props["port"], 64
@@ -182,6 +184,7 @@ class GrpcSrc(SourceElement):
             )
 
     def stop(self) -> None:
+        self._reader_stop.set()
         if self._srv is not None:
             self._srv.stop()
             self._srv = None
@@ -205,10 +208,23 @@ class GrpcSrc(SourceElement):
                 request_serializer=_ident, response_deserializer=_ident,
             )
 
+            stop = self._reader_stop
+
             def _reader():
                 try:
                     for payload in pull(b"", timeout=None):
-                        inbox.put(payload)
+                        # bounded put with a stop check: once frames() exits
+                        # (num-buffers/timeout EOS) nobody drains the inbox,
+                        # and an unconditional put() would park this thread
+                        # forever holding the payload and the channel
+                        while not stop.is_set():
+                            try:
+                                inbox.put(payload, timeout=0.25)
+                                break
+                            except _queue.Full:
+                                continue
+                        if stop.is_set():
+                            return
                 except grpc.RpcError as e:
                     self.log.info("grpc pull ended: %s", e)
 

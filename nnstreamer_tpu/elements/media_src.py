@@ -1,0 +1,223 @@
+"""File media sources: raw bytes, .y4m video, .wav audio, text.
+
+The reference gets media into pipelines through stock GStreamer sources
+(``filesrc``, ``v4l2src``, ``multifilesrc``) plus parsers/converters; its
+tensor_converter then ingests negotiated ``video/x-raw``/``audio/x-raw``/
+``text/x-raw``/octet buffers (``gsttensor_converter.c:750-1005``).  These
+elements are the framework's own front door for the same pipelines:
+
+- ``filesrc``: raw byte chunks (``blocksize`` per buffer), octet media —
+  pairs with ``tensor_converter input-dim=/input-type=``;
+- ``videofilesrc`` (alias ``y4msrc``): .y4m file -> ``video/x-raw``
+  payloads in RGB/BGRx/GRAY8 with rows padded to 4 bytes, exactly the
+  layout the converter's stride removal expects;
+- ``audiofilesrc`` (alias ``wavsrc``): .wav -> ``audio/x-raw`` payloads of
+  ``samples-per-buffer`` frames;
+- ``textfilesrc``: one line per buffer as ``text/x-raw``.
+
+Payload convention: ``tensors[0]`` is a 1-D uint8 array of the raw media
+bytes; ``meta["media"]`` carries the :class:`MediaInfo`; the advertised
+schema is a :class:`MediaSpec` so ``tensor_converter`` derives the exact
+tensor schema during static negotiation.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..media.caps import MediaInfo, MediaSpec, round_up_4
+from ..pipeline.element import ElementError, Property, SourceElement, element
+
+
+def _pad_rows(img: np.ndarray, stride: int) -> np.ndarray:
+    """(h, w, c) -> flat bytes with each row padded to `stride` bytes."""
+    h = img.shape[0]
+    flat = img.reshape(h, -1)
+    if flat.shape[1] == stride:
+        return flat.reshape(-1)
+    out = np.zeros((h, stride), np.uint8)
+    out[:, : flat.shape[1]] = flat
+    return out.reshape(-1)
+
+
+class _MediaSource(SourceElement):
+    def _media_frame(
+        self, payload: np.ndarray, media: MediaInfo,
+        pts: Optional[float] = None, duration: Optional[float] = None,
+    ) -> TensorFrame:
+        f = TensorFrame([payload], pts=pts, duration=duration)
+        f.meta["media"] = media
+        return f
+
+
+@element("filesrc")
+class FileSrc(_MediaSource):
+    """Raw file bytes in ``blocksize`` chunks (≙ GStreamer filesrc)."""
+
+    PROPERTIES = {
+        "location": Property(str, "", "file path"),
+        "blocksize": Property(int, 4096, "bytes per buffer"),
+        "num-buffers": Property(int, -1, "stop after N buffers (-1 = all)"),
+    }
+
+    def output_spec(self):
+        return MediaSpec(media=MediaInfo("octet"))
+
+    def frames(self) -> Iterator[TensorFrame]:
+        path = self.props["location"]
+        if not path:
+            raise ElementError(f"{self.name}: location= is required")
+        media = MediaInfo("octet")
+        limit = self.props["num-buffers"]
+        n = 0
+        with open(path, "rb") as f:
+            while limit < 0 or n < limit:
+                chunk = f.read(self.props["blocksize"])
+                if not chunk:
+                    return
+                yield self._media_frame(np.frombuffer(chunk, np.uint8), media)
+                n += 1
+
+
+@element("videofilesrc", "y4msrc")
+class VideoFileSrc(_MediaSource):
+    """.y4m file -> video/x-raw payloads (RGB/BGRx/GRAY8, 4-byte row
+    stride, BT.601 conversion in ``media/y4m.py``)."""
+
+    PROPERTIES = {
+        "location": Property(str, "", ".y4m file path"),
+        "format": Property(str, "RGB", "RGB|BGRx|GRAY8 output pixel format"),
+        "num-buffers": Property(int, -1, "stop after N frames (-1 = all)"),
+        "loop": Property(bool, False, "restart at EOF (stream soak tests)"),
+    }
+
+    def _media(self) -> MediaInfo:
+        from ..media.y4m import Y4MReader
+
+        with Y4MReader(self.props["location"]) as r:
+            return MediaInfo(
+                "video", self.props["format"],
+                width=r.width, height=r.height, framerate=r.framerate,
+            )
+
+    def output_spec(self):
+        if not self.props["location"]:
+            raise ElementError(f"{self.name}: location= is required")
+        return MediaSpec(media=self._media())
+
+    def frames(self) -> Iterator[TensorFrame]:
+        from ..media.y4m import Y4MReader
+
+        media = self._media()
+        fmt = self.props["format"]
+        dt = (
+            float(1 / media.framerate) if media.framerate else None
+        )
+        limit = self.props["num-buffers"]
+        n = 0
+        while True:
+            with Y4MReader(self.props["location"]) as r:
+                for rgb in r.frames_rgb():
+                    if limit >= 0 and n >= limit:
+                        return
+                    if fmt == "RGB":
+                        img = rgb
+                    elif fmt == "BGRx":
+                        img = np.concatenate(
+                            [rgb[..., ::-1],
+                             np.full(rgb.shape[:2] + (1,), 255, np.uint8)],
+                            axis=-1,
+                        )
+                    elif fmt == "GRAY8":
+                        # BT.601 luma of the already-converted RGB
+                        img = np.clip(
+                            0.299 * rgb[..., 0] + 0.587 * rgb[..., 1]
+                            + 0.114 * rgb[..., 2], 0, 255,
+                        ).astype(np.uint8)[..., None]
+                    else:
+                        raise ElementError(
+                            f"{self.name}: unsupported format {fmt!r}"
+                        )
+                    payload = _pad_rows(img, media.stride)
+                    yield self._media_frame(
+                        payload, media,
+                        pts=n * dt if dt is not None else None, duration=dt,
+                    )
+                    n += 1
+            if not self.props["loop"]:
+                return
+
+
+@element("audiofilesrc", "wavsrc")
+class AudioFileSrc(_MediaSource):
+    """.wav file -> audio/x-raw payloads of ``samples-per-buffer`` frames."""
+
+    PROPERTIES = {
+        "location": Property(str, "", ".wav file path"),
+        "samples-per-buffer": Property(int, 1024, "audio frames per buffer"),
+        "num-buffers": Property(int, -1, "stop after N buffers (-1 = all)"),
+    }
+
+    def _read(self):
+        from ..media.wav import read_wav
+
+        return read_wav(self.props["location"])
+
+    def _media_of(self, rate: int, channels: int, fmt: str) -> MediaInfo:
+        return MediaInfo(
+            "audio", fmt, rate=rate, channels=channels,
+            samples_per_buffer=max(1, self.props["samples-per-buffer"]),
+        )
+
+    def output_spec(self):
+        if not self.props["location"]:
+            raise ElementError(f"{self.name}: location= is required")
+        rate, channels, fmt, _ = self._read()
+        return MediaSpec(media=self._media_of(rate, channels, fmt))
+
+    def frames(self) -> Iterator[TensorFrame]:
+        rate, channels, fmt, data = self._read()
+        media = self._media_of(rate, channels, fmt)
+        spb = max(1, self.props["samples-per-buffer"])
+        limit = self.props["num-buffers"]
+        n = 0
+        for off in range(0, len(data) - spb + 1, spb):
+            if limit >= 0 and n >= limit:
+                return
+            chunk = data[off : off + spb]
+            yield self._media_frame(
+                np.frombuffer(chunk.tobytes(), np.uint8), media,
+                pts=off / rate, duration=spb / rate,
+            )
+            n += 1
+
+
+@element("textfilesrc")
+class TextFileSrc(_MediaSource):
+    """Text file -> one line per buffer as text/x-raw (utf-8 bytes)."""
+
+    PROPERTIES = {
+        "location": Property(str, "", "text file path"),
+        "num-buffers": Property(int, -1, "stop after N lines (-1 = all)"),
+    }
+
+    def output_spec(self):
+        return MediaSpec(media=MediaInfo("text"))
+
+    def frames(self) -> Iterator[TensorFrame]:
+        path = self.props["location"]
+        if not path:
+            raise ElementError(f"{self.name}: location= is required")
+        media = MediaInfo("text")
+        limit = self.props["num-buffers"]
+        with open(path, "rb") as f:
+            for n, line in enumerate(f):
+                if limit >= 0 and n >= limit:
+                    return
+                yield self._media_frame(
+                    np.frombuffer(line.rstrip(b"\r\n"), np.uint8), media
+                )

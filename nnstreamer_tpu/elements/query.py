@@ -125,7 +125,11 @@ class TensorQueryClient(Element):
         "max-buffers": Property(int, 0, "mailbox depth override"),
         # elastic recovery (SURVEY §5.3: preemptible workers need client-side
         # retry/requeue — net-new vs the reference's single timeout)
-        "retries": Property(int, 1, "re-send attempts per request (0 = none)"),
+        # default 0: retries>0 makes delivery at-least-once (a request that
+        # timed out client-side but succeeded server-side is re-sent,
+        # possibly to another server) — opt in only for idempotent server
+        # pipelines; 0 matches the reference's single-timeout semantics
+        "retries": Property(int, 0, "re-send attempts per request (0 = none; >0 = at-least-once delivery)"),
     }
 
     def __init__(self, name=None):

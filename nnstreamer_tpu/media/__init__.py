@@ -1,0 +1,18 @@
+"""Media layer: raw media payloads (video/audio/text/octet) entering the
+tensor world.
+
+The reference sits on GStreamer, so media arrives as negotiated
+``video/x-raw``/``audio/x-raw``/``text/x-raw`` GstBuffers and
+``tensor_converter`` only has to strip strides and batch frames
+(``gst/nnstreamer/elements/gsttensor_converter.c:750-1005``).  This
+framework has no GStreamer underneath, so the media layer provides:
+
+- :class:`MediaInfo` / :class:`MediaSpec` — the ``video/x-raw,...`` caps
+  analog, carried through schema negotiation so ``tensor_converter`` can
+  derive the exact tensor schema statically;
+- container readers/writers (`y4m`, `wav`) used by the file sources —
+  the minimal in-process stand-in for ``filesrc ! decodebin !
+  videoconvert``.
+"""
+
+from .caps import MediaInfo, MediaSpec, parse_media_caps  # noqa: F401

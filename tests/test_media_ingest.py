@@ -1,0 +1,258 @@
+"""Real media ingest: y4m/wav/text/octet file sources -> tensor_converter.
+
+SSAT-style golden tests (≙ reference runTest.sh pipelines that push real
+media files through tensor_converter and byte-compare the output against
+directly-computed goldens; converter framing semantics:
+gst/nnstreamer/elements/gsttensor_converter.c:750-1005).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.media.caps import MediaInfo, MediaSpec, parse_media_caps, round_up_4
+from nnstreamer_tpu.media.wav import read_wav, write_wav
+from nnstreamer_tpu.media.y4m import Y4MReader, i420_to_rgb, rgb_to_i420, write_y4m
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+def _run(pipeline_text, timeout=60):
+    pipe = parse_pipeline(pipeline_text)
+    pipe.start()
+    pipe.wait(timeout=timeout)
+    frames = list(pipe["out"].frames)
+    pipe.stop()
+    return frames, pipe
+
+
+def _blocky_rgb(h, w, seed=0, n=3):
+    """2x2-aligned random blocks: survives I420 chroma subsampling with
+    small, bounded error (sharp sub-2px detail would not)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        small = rng.integers(0, 256, (h // 2, w // 2, 3), dtype=np.uint8)
+        out.append(np.repeat(np.repeat(small, 2, axis=0), 2, axis=1))
+    return out
+
+
+class TestContainers:
+    def test_y4m_roundtrip_close(self, tmp_path):
+        frames = _blocky_rgb(16, 12)
+        path = str(tmp_path / "t.y4m")
+        write_y4m(path, frames, framerate=__import__("fractions").Fraction(25, 1))
+        with Y4MReader(path) as r:
+            assert (r.width, r.height) == (12, 16)
+            assert r.framerate == __import__("fractions").Fraction(25, 1)
+            got = list(r.frames_rgb())
+        assert len(got) == 3
+        for a, b in zip(frames, got):
+            # limited-range quantization + rounding: small bounded error
+            assert np.max(np.abs(a.astype(int) - b.astype(int))) <= 12
+            assert np.mean(np.abs(a.astype(int) - b.astype(int))) <= 3
+
+    def test_yuv_rgb_inverse_on_primaries(self):
+        # black, white, mid-gray: luma-only, chroma-neutral -> near-exact
+        for val in (0, 128, 255):
+            img = np.full((4, 4, 3), val, np.uint8)
+            y, u, v = rgb_to_i420(img)
+            back = i420_to_rgb(y, u, v)
+            assert np.max(np.abs(back.astype(int) - val)) <= 3
+
+    def test_wav_roundtrip_exact(self, tmp_path):
+        t = np.arange(2000, dtype=np.float32)
+        stereo = np.stack(
+            [np.sin(t / 10) * 20000, np.cos(t / 7) * 15000], axis=1
+        ).astype(np.int16)
+        path = str(tmp_path / "t.wav")
+        write_wav(path, stereo, rate=16000)
+        rate, channels, fmt, data = read_wav(path)
+        assert (rate, channels, fmt) == (16000, 2, "S16LE")
+        np.testing.assert_array_equal(data, stereo)
+
+    def test_media_caps_parse(self):
+        m = parse_media_caps("video/x-raw,format=RGB,width=6,height=4,framerate=30/1")
+        assert (m.mtype, m.format, m.width, m.height) == ("video", "RGB", 6, 4)
+        assert m.stride == round_up_4(18) == 20  # rows padded to 4 bytes
+        a = parse_media_caps("audio/x-raw,format=S16LE,rate=16000,channels=2")
+        assert (a.mtype, a.rate, a.channels) == ("audio", 16000, 2)
+        assert MediaSpec(media=m).intersect(MediaSpec(media=m)).media == m
+        assert MediaSpec(media=m).intersect(MediaSpec(media=a)) is None
+
+
+class TestVideoIngest:
+    def test_stride_removal_golden(self, tmp_path):
+        # width 6 -> row bytes 18, stride 20: the exact misalignment case
+        # the reference strips per-row (gsttensor_converter.c video chain)
+        frames = _blocky_rgb(4, 6, seed=1)
+        path = str(tmp_path / "s.y4m")
+        write_y4m(path, frames)
+        with Y4MReader(path) as r:
+            golden = list(r.frames_rgb())  # oracle: reader output, unpadded
+        got, pipe = _run(
+            f"videofilesrc location={path} ! tensor_converter ! "
+            "tensor_sink name=out"
+        )
+        assert len(got) == 3
+        for f, g in zip(got, golden):
+            assert f.tensors[0].shape == (4, 6, 3)
+            np.testing.assert_array_equal(f.tensors[0], g)
+            assert "media" not in f.meta  # converted: no longer raw media
+
+    def test_static_negotiation_from_media_caps(self, tmp_path):
+        path = str(tmp_path / "n.y4m")
+        write_y4m(path, _blocky_rgb(8, 6))
+        pipe = parse_pipeline(
+            f"videofilesrc location={path} name=src ! "
+            "tensor_converter name=c ! tensor_sink name=out"
+        )
+        pipe.start()
+        # converter derived the exact static schema BEFORE any data flowed
+        spec = pipe["c"].srcpads[0].spec
+        assert spec.is_static
+        assert spec.tensors[0].shape == (8, 6, 3)
+        assert str(spec.tensors[0].dtype) == "uint8"
+        pipe.wait(timeout=60)
+        pipe.stop()
+
+    @pytest.mark.parametrize("fmt,channels", [("BGRx", 4), ("GRAY8", 1)])
+    def test_formats(self, tmp_path, fmt, channels):
+        frames = _blocky_rgb(4, 6, seed=2)
+        path = str(tmp_path / "f.y4m")
+        write_y4m(path, frames)
+        got, _ = _run(
+            f"videofilesrc location={path} format={fmt} ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        assert got and got[0].tensors[0].shape == (4, 6, channels)
+        if fmt == "BGRx":
+            with Y4MReader(path) as r:
+                rgb = next(r.frames_rgb())
+            np.testing.assert_array_equal(got[0].tensors[0][..., :3], rgb[..., ::-1])
+            assert (got[0].tensors[0][..., 3] == 255).all()
+
+    def test_frames_per_tensor_batching(self, tmp_path):
+        path = str(tmp_path / "b.y4m")
+        write_y4m(path, _blocky_rgb(4, 4, n=4))
+        got, _ = _run(
+            f"videofilesrc location={path} ! "
+            "tensor_converter frames-per-tensor=2 ! tensor_sink name=out"
+        )
+        # 4 media frames -> 2 batched tensors (N,H,W,C)
+        assert len(got) == 2
+        assert got[0].tensors[0].shape == (2, 4, 4, 3)
+
+    def test_num_buffers_limit(self, tmp_path):
+        path = str(tmp_path / "l.y4m")
+        write_y4m(path, _blocky_rgb(4, 4, n=5))
+        got, _ = _run(
+            f"videofilesrc location={path} num-buffers=2 ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        assert len(got) == 2
+
+
+class TestAudioIngest:
+    def test_wav_to_tensors_golden(self, tmp_path):
+        t = np.arange(4096, dtype=np.float32)
+        stereo = np.stack(
+            [np.sin(t / 9) * 12000, np.sin(t / 5) * 9000], axis=1
+        ).astype(np.int16)
+        path = str(tmp_path / "a.wav")
+        write_wav(path, stereo, rate=8000)
+        got, _ = _run(
+            f"audiofilesrc location={path} samples-per-buffer=512 ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        assert len(got) == 8  # 4096 / 512
+        for i, f in enumerate(got):
+            assert f.tensors[0].shape == (512, 2)
+            assert f.tensors[0].dtype == np.int16
+            np.testing.assert_array_equal(
+                f.tensors[0], stereo[i * 512 : (i + 1) * 512]
+            )
+
+    def test_audio_static_negotiation(self, tmp_path):
+        path = str(tmp_path / "a8.wav")
+        write_wav(path, np.zeros(1024, np.uint8), rate=8000)
+        pipe = parse_pipeline(
+            f"audiofilesrc location={path} samples-per-buffer=256 ! "
+            "tensor_converter name=c ! tensor_sink name=out"
+        )
+        pipe.start()
+        spec = pipe["c"].srcpads[0].spec
+        assert spec.is_static and spec.tensors[0].shape == (256, 1)
+        pipe.wait(timeout=30)
+        pipe.stop()
+
+
+class TestTextOctetIngest:
+    def test_text_fixed_framing(self, tmp_path):
+        path = str(tmp_path / "t.txt")
+        path_obj = tmp_path / "t.txt"
+        path_obj.write_bytes(b"hello\nworld-is-long\nx\n")
+        got, _ = _run(
+            f"textfilesrc location={path} ! "
+            "tensor_converter input-dim=8 input-type=uint8 ! "
+            "tensor_sink name=out"
+        )
+        assert len(got) == 3
+        # pad with NUL / truncate to input-dim bytes (reference text chain)
+        assert bytes(got[0].tensors[0]) == b"hello\x00\x00\x00"
+        assert bytes(got[1].tensors[0]) == b"world-is"
+        assert bytes(got[2].tensors[0]) == b"x" + b"\x00" * 7
+
+    def test_octet_typed_reshape(self, tmp_path):
+        data = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        path = tmp_path / "o.bin"
+        path.write_bytes(data.tobytes())
+        # reference dialect is innermost-first: 4:3 -> numpy (3, 4)
+        got, _ = _run(
+            f"filesrc location={path} blocksize={4 * 12} ! "
+            "tensor_converter input-dim=4:3 input-type=float32 ! "
+            "tensor_sink name=out"
+        )
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[0].tensors[0], data[0])
+        np.testing.assert_array_equal(got[1].tensors[0], data[1])
+
+    def test_octet_size_mismatch_errors(self, tmp_path):
+        path = tmp_path / "o.bin"
+        path.write_bytes(b"\x00" * 10)
+        pipe = parse_pipeline(
+            f"filesrc location={path} blocksize=10 ! "
+            "tensor_converter input-dim=3:4 input-type=float32 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        with pytest.raises(Exception, match="octet payload"):
+            pipe.wait(timeout=30)
+        pipe.stop()
+
+
+class TestMediaToModel:
+    def test_video_file_through_filter(self, tmp_path):
+        """Reference example-pipeline shape: media file -> converter ->
+        transform -> filter -> sink, end to end with a real file."""
+        from nnstreamer_tpu.backends import (
+            register_custom_easy,
+            unregister_custom_easy,
+        )
+
+        path = str(tmp_path / "m.y4m")
+        write_y4m(path, _blocky_rgb(8, 8, n=2))
+        register_custom_easy(
+            "brightsum",
+            lambda xs: [np.asarray([np.asarray(xs[0]).sum()], np.int64)],
+        )
+        try:
+            got, _ = _run(
+                f"videofilesrc location={path} ! tensor_converter ! "
+                "tensor_transform mode=typecast option=int64 ! "
+                "tensor_filter framework=custom-easy model=brightsum ! "
+                "tensor_sink name=out"
+            )
+        finally:
+            unregister_custom_easy("brightsum")
+        with Y4MReader(path) as r:
+            golden = [int(f.astype(np.int64).sum()) for f in r.frames_rgb()]
+        assert [int(f.tensors[0][0]) for f in got] == golden
