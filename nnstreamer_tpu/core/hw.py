@@ -21,6 +21,9 @@ from typing import Dict
 
 _cache: Dict[str, object] = {}
 _cache_lock = threading.Lock()
+_neg_cache: Dict[str, object] = {}  # last failed probe result
+_neg_cache_ts = 0.0
+_NEG_TTL_S = 60.0  # re-probe failures after this (the tunnel may recover)
 
 
 _PROBE_SRC = (
@@ -80,24 +83,37 @@ def probe(timeout_s: float = None) -> Dict[str, object]:
     Successful results are cached for the process; timeouts are NOT, so a
     backend that comes up later is still discovered.
     """
+    global _neg_cache_ts
+    import time
+
     with _cache_lock:
         if _cache:
             return dict(_cache)
+        # failures are cached with a TTL: a host whose backend is broken
+        # must not pay a multi-second subprocess probe on EVERY model
+        # build, but a recovering tunnel is still re-discovered
+        if _neg_cache and time.monotonic() - _neg_cache_ts < _NEG_TTL_S:
+            return dict(_neg_cache)
     if timeout_s is None:
         timeout_s = float(os.environ.get("NNS_TPU_HW_PROBE_TIMEOUT", "30"))
     result = _query_devices(timeout_s)
-    if "error" in result:
-        # do not cache failures — the tunnel may recover
-        return result
     with _cache_lock:
-        _cache.update(result)
+        if "error" in result:
+            _neg_cache.clear()
+            _neg_cache.update(result)
+            _neg_cache_ts = time.monotonic()
+        else:
+            _cache.update(result)
     return dict(result)
 
 
 def reset() -> None:
     """Drop the cached probe (tests / after backend reconfiguration)."""
+    global _neg_cache_ts
     with _cache_lock:
         _cache.clear()
+        _neg_cache.clear()
+        _neg_cache_ts = 0.0
 
 
 def has_accelerator() -> bool:

@@ -2,10 +2,17 @@
 
 The reference's mqttsink/mqttsrc (``gst/mqtt/``) link against paho.mqtt.c;
 this image has no MQTT library, so the TPU build carries its own small
-implementation of the subset the elements need — QoS 0 publish, subscribe
-with ``+``/``#`` wildcards, keep-alive pings — plus a localhost broker so
+implementation of the subset the elements need — QoS 0/1 publish (PUBACK +
+DUP redelivery), subscribe with ``+``/``#`` wildcards, keep-alive pings,
+automatic reconnect with re-subscribe — plus a localhost broker so
 pipelines (and tests) run without external infrastructure.  Protocol per
-the public OASIS MQTT 3.1.1 spec.
+the public OASIS MQTT 3.1.1 spec; reconnect semantics match the
+reference's paho ``MQTTAsync`` usage (``gst/mqtt/mqttsrc.c`` reconnects
+and resumes its subscription; ``mqttsink.h`` ``mqtt_qos``).
+
+QoS 1 is at-least-once: a publish unacknowledged when the connection
+drops is re-sent (DUP flag) after reconnect — receivers may see
+duplicates, never corruption or silent loss.
 
 This is control-plane-grade transport (sensor streams, events); bulk
 tensor traffic between hosts should ride the gRPC query/edge elements.
@@ -25,7 +32,7 @@ log = get_logger("mqtt")
 
 # packet types (MQTT 3.1.1 §2.2.1)
 CONNECT, CONNACK = 1, 2
-PUBLISH = 3
+PUBLISH, PUBACK = 3, 4
 SUBSCRIBE, SUBACK = 8, 9
 UNSUBSCRIBE, UNSUBACK = 10, 11
 PINGREQ, PINGRESP = 12, 13
@@ -76,9 +83,9 @@ class MqttProtocolError(ValueError):
     pass
 
 
-def _parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
-    """PUBLISH variable header -> (topic, payload); shared by broker and
-    client so malformed-body handling stays in one place."""
+def _parse_publish(flags: int, body: bytes) -> Tuple[str, bytes, Optional[int]]:
+    """PUBLISH variable header -> (topic, payload, packet_id|None); shared
+    by broker and client so malformed-body handling stays in one place."""
     if len(body) < 2:
         raise MqttProtocolError("PUBLISH body too short")
     tlen = struct.unpack(">H", body[:2])[0]
@@ -89,11 +96,25 @@ def _parse_publish(flags: int, body: bytes) -> Tuple[str, bytes]:
         topic = body[2:off].decode()
     except UnicodeDecodeError as e:
         raise MqttProtocolError(f"PUBLISH topic not UTF-8: {e}") from None
+    pid = None
     if (flags >> 1) & 0x3:  # QoS > 0 carries a packet id
-        off += 2
-        if off > len(body):
+        if off + 2 > len(body):
             raise MqttProtocolError("PUBLISH missing packet id")
-    return topic, body[off:]
+        pid = struct.unpack(">H", body[off : off + 2])[0]
+        off += 2
+    return topic, body[off:], pid
+
+
+def _publish_packet(topic: str, payload: bytes, retain: bool = False,
+                    qos: int = 0, pid: int = 0, dup: bool = False) -> bytes:
+    var = _mqtt_str(topic)
+    if qos:
+        var += struct.pack(">H", pid)
+    var += payload
+    head = (PUBLISH << 4) | (1 if retain else 0) | ((qos & 0x3) << 1)
+    if dup:
+        head |= 0x8
+    return bytes([head]) + _encode_len(len(var)) + var
 
 
 def topic_matches(pattern: str, topic: str) -> bool:
@@ -114,6 +135,10 @@ class MiniBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # REUSEADDR (not REUSEPORT: two live brokers on one port would
+        # silently load-balance clients between them) — restart rebinding
+        # works because close() shuts every client sock down first, so the
+        # old listener and its connections are gone before the new bind
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
         self._srv.listen(16)
@@ -139,6 +164,14 @@ class MiniBroker:
             pass
         with self._lock:
             for s in list(self._subs):
+                try:
+                    # shutdown BEFORE close: close() alone neither wakes a
+                    # thread blocked in recv on this fd nor guarantees a
+                    # prompt FIN to the peer; shutdown does both, so
+                    # clients detect broker death immediately
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 try:
                     s.close()
                 except OSError:
@@ -169,7 +202,9 @@ class MiniBroker:
             while not self._stop.is_set():
                 ptype, flags, body = _read_packet(sock)
                 if ptype == PUBLISH:
-                    self._handle_publish(flags, body)
+                    self._handle_publish(sock, flags, body)
+                elif ptype == PUBACK:
+                    pass  # subscribers are served at QoS 0 (downgrade)
                 elif ptype == SUBSCRIBE:
                     self._handle_subscribe(sock, body)
                 elif ptype == UNSUBSCRIBE:
@@ -192,12 +227,17 @@ class MiniBroker:
             except OSError:
                 pass
 
-    def _handle_publish(self, flags: int, body: bytes) -> None:
-        topic, payload = _parse_publish(flags, body)
+    def _handle_publish(self, sock: socket.socket, flags: int,
+                        body: bytes) -> None:
+        topic, payload, pid = _parse_publish(flags, body)
+        if pid is not None:  # QoS 1 in: acknowledge to the publisher
+            self._send(sock, bytes([PUBACK << 4, 2]) + struct.pack(">H", pid))
         if flags & 0x1:  # retain
             with self._lock:
                 self._retained[topic] = payload
-        packet = self._publish_packet(topic, payload)
+        # fan out at QoS 0 (broker-side downgrade; publisher-side QoS 1
+        # still guarantees the message reached the broker at least once)
+        packet = _publish_packet(topic, payload)
         with self._lock:
             targets = [
                 s for s, pats in self._subs.items()
@@ -216,12 +256,6 @@ class MiniBroker:
                 sock.sendall(data)
         except OSError:
             pass
-
-    @staticmethod
-    def _publish_packet(topic: str, payload: bytes, retain: bool = False) -> bytes:
-        var = _mqtt_str(topic) + payload
-        head = (PUBLISH << 4) | (1 if retain else 0)
-        return bytes([head]) + _encode_len(len(var)) + var
 
     def _handle_subscribe(self, sock: socket.socket, body: bytes) -> None:
         pid = body[:2]
@@ -243,7 +277,7 @@ class MiniBroker:
             + bytes([0] * len(pats)),
         )
         for t, p in retained:
-            self._send(sock, self._publish_packet(t, p, retain=True))
+            self._send(sock, _publish_packet(t, p, retain=True))
 
     def _handle_unsubscribe(self, sock: socket.socket, body: bytes) -> None:
         pid = body[:2]
@@ -260,66 +294,189 @@ class MiniBroker:
 
 
 class MqttClient:
-    """QoS-0 MQTT 3.1.1 client: connect, publish, subscribe(callback)."""
+    """MQTT 3.1.1 client: QoS 0/1 publish, subscribe(callback), automatic
+    reconnect with re-subscribe and QoS-1 redelivery.
+
+    ≙ the reference's paho ``MQTTAsync`` usage: ``mqtt_qos``
+    (``gst/mqtt/mqttsink.h:77``) and mqttsrc's reconnect-and-resume."""
 
     def __init__(self, host: str, port: int, client_id: str = "",
-                 keepalive: int = 60, timeout: float = 10.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(None)
+                 keepalive: int = 60, timeout: float = 10.0,
+                 reconnect: bool = True, retransmit_s: float = 2.0,
+                 reconnect_delay_s: float = 0.1):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
+        self._keepalive = max(1, keepalive)
+        self._reconnect_enabled = reconnect
+        self._retransmit_s = retransmit_s
+        # initial reconnect backoff (≙ paho MQTTAsync_setReconnectDelay):
+        # publishers should use a LARGER delay than subscribers so that
+        # after a broker restart the subscriptions are back before QoS-1
+        # redelivery lands (a broker with no session persistence acks a
+        # publish even when nobody is subscribed yet)
+        self._reconnect_delay_s = max(0.05, reconnect_delay_s)
         self._wlock = threading.Lock()
         # per-pattern callbacks: a second subscribe() must not reroute
         # earlier patterns' messages to the newest callback
         self._subs: Dict[str, Callable[[str, bytes], None]] = {}
         self._stop = threading.Event()
+        self._pid_lock = threading.Lock()
         self._pid = 0
-        cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
-        var = (
-            _mqtt_str("MQTT") + bytes([4])  # protocol level 4 = 3.1.1
-            + bytes([0x02])                 # clean session
-            + struct.pack(">H", keepalive)
-            + _mqtt_str(cid)
-        )
-        self._send(bytes([CONNECT << 4]) + _encode_len(len(var)) + var)
-        ptype, _, body = _read_packet(self._sock)
-        if ptype != CONNACK or body[1] != 0:
-            raise ConnectionError(f"MQTT connect refused: {body!r}")
+        # QoS-1 in flight: pid -> [topic, payload, retain, last_sent_ts]
+        self._pending: Dict[int, list] = {}
+        self._pending_lock = threading.Lock()
+        self.connected = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._connect()  # first connect failure raises to the caller
         self._reader = threading.Thread(
             target=self._read_loop, name="mqtt-client", daemon=True
         )
         self._reader.start()
         # keepalive: a broker may drop us after 1.5x the advertised interval
-        # with no inbound packets (MQTT 3.1.1 §3.1.2.10), so ping on a timer
-        self._keepalive = max(1, keepalive)
+        # with no inbound packets (MQTT 3.1.1 §3.1.2.10), so ping on a
+        # timer; the same timer drives QoS-1 retransmission
         self._pinger = threading.Thread(
             target=self._ping_loop, name="mqtt-ping", daemon=True
         )
         self._pinger.start()
 
-    def _ping_loop(self) -> None:
-        interval = self._keepalive / 2.0
-        while not self._stop.wait(interval):
+    # -- connection ---------------------------------------------------------
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        var = (
+            _mqtt_str("MQTT") + bytes([4])  # protocol level 4 = 3.1.1
+            + bytes([0x02])                 # clean session
+            + struct.pack(">H", self._keepalive)
+            + _mqtt_str(self._cid)
+        )
+        sock.sendall(bytes([CONNECT << 4]) + _encode_len(len(var)) + var)
+        ptype, _, body = _read_packet(sock)
+        if ptype != CONNACK or body[1] != 0:
+            sock.close()
+            raise ConnectionError(f"MQTT connect refused: {body!r}")
+        sock.settimeout(None)
+        with self._wlock:
+            self._sock = sock
+        self.connected.set()
+
+    def _resume_session(self) -> None:
+        """After reconnect: re-subscribe every pattern (clean-session
+        broker forgot them) and re-send unacked QoS-1 publishes (DUP)."""
+        for pattern in list(self._subs):
             try:
-                self.ping()
+                self._send_subscribe(pattern)
+            except OSError:
+                return
+        with self._pending_lock:
+            pending = sorted(self._pending.items())
+        for pid, entry in pending:
+            topic, payload, retain, _ = entry
+            try:
+                self._send(_publish_packet(
+                    topic, payload, retain, qos=1, pid=pid, dup=True
+                ))
+                entry[3] = time.monotonic()
             except OSError:
                 return
 
+    def _reconnect_loop(self) -> None:
+        backoff = self._reconnect_delay_s
+        self._stop.wait(self._reconnect_delay_s)
+        while not self._stop.is_set():
+            try:
+                self._connect()
+                log.info("mqtt client reconnected to %s:%d",
+                         self._host, self._port)
+                self._resume_session()
+                return
+            except OSError:
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    # -- io -----------------------------------------------------------------
     def _send(self, data: bytes) -> None:
         with self._wlock:
+            if self._sock is None:
+                raise OSError("mqtt client not connected")
             self._sock.sendall(data)
 
-    def publish(self, topic: str, payload: bytes, retain: bool = False) -> None:
-        var = _mqtt_str(topic) + payload
-        head = (PUBLISH << 4) | (1 if retain else 0)
-        self._send(bytes([head]) + _encode_len(len(var)) + var)
+    def _ping_loop(self) -> None:
+        interval = min(self._keepalive / 2.0, max(self._retransmit_s, 0.2))
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._pending_lock:
+                stale = [
+                    (pid, e) for pid, e in sorted(self._pending.items())
+                    if now - e[3] >= self._retransmit_s
+                ]
+            for pid, entry in stale:  # QoS-1 redelivery
+                try:
+                    self._send(_publish_packet(
+                        entry[0], entry[1], entry[2], qos=1, pid=pid, dup=True
+                    ))
+                    entry[3] = now
+                except OSError:
+                    break
+            try:
+                self.ping()
+            except OSError:
+                continue  # reader notices and reconnects
+
+    def _next_pid(self) -> int:
+        with self._pid_lock:
+            self._pid = (self._pid % 0xFFFF) + 1
+            return self._pid
+
+    # -- API ----------------------------------------------------------------
+    def publish(self, topic: str, payload: bytes, retain: bool = False,
+                qos: int = 0) -> None:
+        if qos not in (0, 1):
+            raise ValueError("only QoS 0/1 supported")
+        if qos == 1:
+            pid = self._next_pid()
+            with self._pending_lock:
+                self._pending[pid] = [topic, payload, retain, time.monotonic()]
+            try:
+                self._send(_publish_packet(topic, payload, retain, 1, pid))
+            except OSError:
+                if not self._reconnect_enabled:
+                    with self._pending_lock:
+                        self._pending.pop(pid, None)
+                    raise
+                # stays pending; redelivered after reconnect
+            return
+        try:
+            self._send(_publish_packet(topic, payload, retain))
+        except OSError:
+            if not self._reconnect_enabled:
+                raise
+            # fire-and-forget during the reconnect window: QoS 0 has no
+            # delivery guarantee — dropping beats killing the pipeline
+            log.debug("QoS-0 publish dropped while reconnecting")
+
+    def unacked(self) -> int:
+        """Outstanding QoS-1 publishes (0 = everything acknowledged)."""
+        with self._pending_lock:
+            return len(self._pending)
+
+    def _send_subscribe(self, pattern: str) -> None:
+        var = (
+            struct.pack(">H", self._next_pid()) + _mqtt_str(pattern)
+            + bytes([0])
+        )
+        self._send(bytes([(SUBSCRIBE << 4) | 0x2]) + _encode_len(len(var)) + var)
 
     def subscribe(self, pattern: str,
                   callback: Callable[[str, bytes], None]) -> None:
         self._subs[pattern] = callback
-        self._pid += 1
-        var = (
-            struct.pack(">H", self._pid) + _mqtt_str(pattern) + bytes([0])
-        )
-        self._send(bytes([(SUBSCRIBE << 4) | 0x2]) + _encode_len(len(var)) + var)
+        try:
+            self._send_subscribe(pattern)
+        except OSError:
+            if not self._reconnect_enabled:
+                raise
+            # recorded; _resume_session re-sends it after reconnect
 
     def ping(self) -> None:
         self._send(bytes([PINGREQ << 4, 0]))
@@ -330,24 +487,55 @@ class MqttClient:
             self._send(bytes([DISCONNECT << 4, 0]))
         except OSError:
             pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._wlock:
+            if self._sock is not None:
+                try:  # wake the reader blocked in recv (see MiniBroker.close)
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
 
+    # -- reader -------------------------------------------------------------
     def _read_loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                ptype, flags, body = _read_packet(self._sock)
-            except (ConnectionError, OSError):
+            sock = self._sock
+            if sock is None:
                 return
+            try:
+                ptype, flags, body = _read_packet(sock)
+            except (ConnectionError, OSError):
+                self.connected.clear()
+                try:  # release the dead fd (one leak per reconnect otherwise)
+                    sock.close()
+                except OSError:
+                    pass
+                if self._stop.is_set() or not self._reconnect_enabled:
+                    return
+                self._reconnect_loop()
+                continue
+            if ptype == PUBACK and len(body) >= 2:
+                (pid,) = struct.unpack(">H", body[:2])
+                with self._pending_lock:
+                    self._pending.pop(pid, None)
+                continue
             if ptype != PUBLISH or not self._subs:
                 continue
             try:
-                topic, payload = _parse_publish(flags, body)
+                topic, payload, pid = _parse_publish(flags, body)
             except MqttProtocolError as e:
                 log.warning("client: dropping malformed PUBLISH: %s", e)
                 continue
+            if pid is not None:  # QoS-1 inbound: acknowledge
+                try:
+                    self._send(
+                        bytes([PUBACK << 4, 2]) + struct.pack(">H", pid)
+                    )
+                except OSError:
+                    pass
             for pattern, cb in list(self._subs.items()):
                 if not topic_matches(pattern, topic):
                     continue

@@ -1,0 +1,10 @@
+"""Wire IDLs for non-framework peers.
+
+``nns_tensors.proto`` is the tensors schema external systems speak
+(≙ reference ``ext/nnstreamer/include/nnstreamer.proto``); the checked-in
+``nns_tensors_pb2.py`` is its protoc output.  Regenerate after editing the
+schema::
+
+    protoc --python_out=nnstreamer_tpu/distributed/proto \
+           --proto_path=nnstreamer_tpu/distributed/proto nns_tensors.proto
+"""

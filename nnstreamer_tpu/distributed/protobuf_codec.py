@@ -1,0 +1,97 @@
+"""Protobuf wire codec for tensor frames.
+
+The interop IDL: anything that can speak protobuf can exchange frames with
+the framework without linking it — the role of the reference's
+``nnstreamer.proto`` + ``nnstreamer_grpc_protobuf.cc``
+(``ext/nnstreamer/extra/``).  Selected per element via ``idl=protobuf``
+(grpc src/sink, mqtt elements); the default ``idl=flex`` NNSQ framing
+(``distributed/wire.py``) stays the compact intra-framework format.
+
+Schema: ``proto/nns_tensors.proto`` (checked-in protoc output
+``nns_tensors_pb2.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from .wire import WireError, _clean_meta
+
+_TO_PB = {
+    "int32": 0, "uint32": 1, "int16": 2, "uint16": 3, "int8": 4,
+    "uint8": 5, "float64": 6, "float32": 7, "int64": 8, "uint64": 9,
+    "float16": 10, "bfloat16": 11,
+}
+_FROM_PB = {v: k for k, v in _TO_PB.items()}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _dtype_name(dt) -> str:
+    return str(np.dtype(dt))
+
+
+def _pb2():
+    from .proto import nns_tensors_pb2
+
+    return nns_tensors_pb2
+
+
+def encode_frame(frame: TensorFrame) -> bytes:
+    pb = _pb2()
+    msg = pb.TensorFrame(
+        num_tensors=len(frame.tensors),
+        pts=frame.pts if frame.pts is not None else math.nan,
+        seq=frame.seq,
+        meta_json=json.dumps(_clean_meta(frame.meta)),
+    )
+    for t in frame.tensors:
+        arr = np.ascontiguousarray(np.asarray(t))
+        name = _dtype_name(arr.dtype)
+        if name not in _TO_PB:
+            raise WireError(f"dtype {name} not representable in nns_tensors.proto")
+        msg.tensor.append(
+            pb.Tensor(
+                type=_TO_PB[name],
+                dimension=list(arr.shape),
+                data=arr.tobytes(),
+            )
+        )
+    return msg.SerializeToString()
+
+
+def decode_frame(buf: bytes) -> TensorFrame:
+    pb = _pb2()
+    msg = pb.TensorFrame()
+    try:
+        msg.ParseFromString(bytes(buf))
+    except Exception as e:
+        raise WireError(f"malformed protobuf frame: {e}") from None
+    tensors = []
+    for t in msg.tensor:
+        if t.type not in _FROM_PB:
+            raise WireError(f"unknown tensor type id {t.type}")
+        dtype = _np_dtype(_FROM_PB[t.type])
+        shape = tuple(int(d) for d in t.dimension)
+        expect = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        if len(t.data) != expect:
+            raise WireError(
+                f"tensor payload {len(t.data)}B != shape {shape} x {dtype}"
+            )
+        tensors.append(np.frombuffer(t.data, dtype=dtype).reshape(shape))
+    meta = json.loads(msg.meta_json) if msg.meta_json else {}
+    frame = TensorFrame(
+        tensors, pts=None if math.isnan(msg.pts) else msg.pts, meta=meta
+    )
+    frame.seq = int(msg.seq)  # sender's seq, even 0 (proto3 default)
+    return frame

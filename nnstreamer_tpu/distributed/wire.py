@@ -36,6 +36,22 @@ class WireError(ValueError):
     pass
 
 
+def get_codec(name: str):
+    """(encode, decode) for a wire IDL name.
+
+    ``flex``/``nnsq`` = this module's compact framing (default);
+    ``protobuf`` = the interop IDL (``protobuf_codec.py``,
+    ≙ reference nnstreamer.proto + nnstreamer_grpc_protobuf.cc).
+    """
+    if name in ("", "flex", "nnsq"):
+        return encode_frame, decode_frame
+    if name == "protobuf":
+        from . import protobuf_codec
+
+        return protobuf_codec.encode_frame, protobuf_codec.decode_frame
+    raise WireError(f"unknown wire idl {name!r} (flex|protobuf)")
+
+
 def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
     out = {}
     for k, v in meta.items():

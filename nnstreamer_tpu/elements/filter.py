@@ -32,7 +32,7 @@ from ..core import config as nns_config
 from ..core import registry
 from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
 from ..core.model_uri import resolve_model_uri
-from ..core.types import ANY, StreamSpec
+from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, TransformElement, element
 
 # ---------------------------------------------------------------------------
@@ -215,6 +215,14 @@ class TensorFilter(TransformElement):
                 f"{self.name}: batch-through=true is incompatible with "
                 "output-combination"
             )
+        if self.props["invoke-dynamic"] and int(self.props["max-batch"]) > 1:
+            # per-buffer-varying output shapes cannot be stacked into one
+            # batched XLA call (reference invoke_dynamic is per-frame too,
+            # tensor_filter.c:856-930)
+            raise ElementError(
+                f"{self.name}: invoke-dynamic is per-frame "
+                "(incompatible with max-batch>1)"
+            )
         fw = self.props["framework"]
         model = self.props["model"] or None
         if model:
@@ -295,7 +303,10 @@ class TensorFilter(TransformElement):
     def derive_spec(self, pad=0):
         in_spec = self.sink_specs.get(0, ANY)
         if self.props["invoke-dynamic"]:
-            return ANY
+            # per-buffer output schemas: advertise format=flexible so
+            # downstream negotiates late, per frame (reference wraps
+            # invoke_dynamic outputs as flexible, tensor_filter.c:856-930)
+            return StreamSpec((), FORMAT_FLEXIBLE, in_spec.framerate)
         if self._model_out is not None:
             out = self._model_out
         elif self.backend is not None and in_spec.tensors:

@@ -51,28 +51,66 @@ def unregister_if_custom(name: str) -> bool:
     return registry.unregister(registry.KIND_CUSTOM, f"if:{name}")
 
 
+_BEHAVIORS = (
+    "passthrough", "skip", "fill_zero", "fill_values", "fill_with_file",
+    "fill_with_file_rpt", "repeat_previous_frame", "tensorpick",
+)
+
+
 @element("tensor_if")
 class TensorIf(Element):
     """Two src pads: 0 = 'then' branch, 1 = 'else' branch (if linked);
-    behaviors modify/route the frame per branch."""
+    behaviors modify/route the frame per branch.
+
+    Full reference matrix (``gsttensor_if.h:42-91``): 6 compared-value
+    modes x 10 operators x 8 then/else behaviors.
+    """
 
     NUM_SRC_PADS = None  # 1 or 2
 
     PROPERTIES = {
         "compared-value": Property(
-            str, "a_value", "a_value|tensor_total_value|tensor_average_value|custom"
+            str, "a_value",
+            "a_value|tensor_total_value|all_tensors_total_value|"
+            "tensor_average_value|all_tensors_average_value|custom",
         ),
         "compared-value-option": Property(
-            str, "", "a_value: '<refdims>,<tensor>'; total/avg: tensor idx; custom: name"
+            str, "", "a_value: '<refdims>,<tensor>'; total/avg: tensor "
+            "idx (all_*: comma list, empty = all); custom: name"
         ),
         "supplied-value": Property(str, "", "operand(s), comma separated"),
         "operator": Property(str, "gt", "|".join(_OPERATORS)),
-        "then": Property(str, "passthrough", "passthrough|skip|tensorpick"),
-        "then-option": Property(str, "", "tensorpick indices"),
-        "else": Property(str, "skip", "passthrough|skip|tensorpick"),
-        "else-option": Property(str, "", "tensorpick indices"),
+        "then": Property(str, "passthrough", "|".join(_BEHAVIORS)),
+        "then-option": Property(
+            str, "", "tensorpick indices | fill value(s) | fill file path"
+        ),
+        "else": Property(str, "skip", "|".join(_BEHAVIORS)),
+        "else-option": Property(
+            str, "", "tensorpick indices | fill value(s) | fill file path"
+        ),
         "max-buffers": Property(int, 0, "mailbox depth override"),
     }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        # REPEAT_PREVIOUS_FRAME cache, per branch (reference caches the
+        # previous output frame; first occurrence sends zeros)
+        self._prev: Dict[str, Optional[TensorFrame]] = {"then": None, "else": None}
+        self._file_cache: Dict[str, bytes] = {}
+
+    def start(self):
+        self._prev = {"then": None, "else": None}
+        self._file_cache.clear()
+        for which in ("then", "else"):
+            if self.props[which].lower() not in _BEHAVIORS:
+                raise ElementError(
+                    f"{self.name}: unknown behavior {self.props[which]!r}"
+                )
+
+    def _tensor_indices(self, opt: str, frame: TensorFrame) -> List[int]:
+        if not opt:
+            return list(range(len(frame.tensors)))
+        return [int(s) for s in opt.split(",") if s != ""]
 
     def _compared_value(self, frame: TensorFrame) -> float:
         mode = self.props["compared-value"].lower()
@@ -85,9 +123,21 @@ class TensorIf(Element):
             coord_s, _, idx_s = opt.partition(",")
             ti = int(idx_s or "0")
             arr = np.asarray(frame.tensors[ti])
-            coords = [int(c) for c in coord_s.split(":")] if coord_s else [0]
+            coords = [int(c) for c in coord_s.split(":")] if coord_s else []
+            # innermost-first -> numpy order; unspecified outer dims = 0
             np_index = tuple(reversed(coords))[-arr.ndim:] if arr.ndim else ()
+            np_index = (0,) * (arr.ndim - len(np_index)) + np_index
             return float(arr[np_index] if np_index else arr)
+        if mode in ("all_tensors_total_value", "all_tensors_average_value"):
+            idxs = self._tensor_indices(opt, frame)
+            vals = [
+                np.asarray(frame.tensors[i], dtype=np.float64) for i in idxs
+            ]
+            if mode.endswith("total_value"):
+                return float(sum(v.sum() for v in vals))
+            total = sum(v.sum() for v in vals)
+            count = sum(v.size for v in vals)
+            return float(total / count) if count else 0.0
         ti = int(opt or "0")
         arr = np.asarray(frame.tensors[ti], dtype=np.float64)
         if mode == "tensor_total_value":
@@ -107,18 +157,79 @@ class TensorIf(Element):
             raise ElementError(f"{self.name}: supplied-value required")
         return _OPERATORS[op](self._compared_value(frame), supplied)
 
+    def _file_bytes(self, path: str) -> bytes:
+        data = self._file_cache.get(path)
+        if data is None:
+            with open(path, "rb") as f:
+                data = f.read()
+            self._file_cache[path] = data
+        return data
+
+    def _fill_from_bytes(self, frame: TensorFrame, raw: bytes,
+                         repeat: bool) -> TensorFrame:
+        """FILL_WITH_FILE(_RPT): tensors refilled from a flat byte blob —
+        short files pad with zeros (plain) or cycle (rpt)."""
+        outs, off = [], 0
+        for t in frame.tensors:
+            arr = np.asarray(t)
+            n = arr.nbytes
+            if repeat and raw:
+                reps = -(-(off + n) // len(raw))  # ceil
+                chunk = (raw * reps)[off : off + n]
+            else:
+                chunk = raw[off : off + n]
+            buf = np.zeros(n, np.uint8)
+            buf[: len(chunk)] = np.frombuffer(chunk, np.uint8)
+            outs.append(buf.view(arr.dtype)[: arr.size].reshape(arr.shape))
+            off += n
+        return frame.with_tensors(outs)
+
     def _behave(self, frame: TensorFrame, which: str):
         action = self.props[which].lower()
+        option = self.props[f"{which}-option"]
         if action == "passthrough":
-            return frame
-        if action == "skip":
+            out = frame
+        elif action == "skip":
             return None
-        if action == "tensorpick":
-            idxs = [
-                int(s) for s in self.props[f"{which}-option"].split(",") if s != ""
-            ]
-            return frame.pick(idxs)
-        raise ElementError(f"{self.name}: unknown behavior {action!r}")
+        elif action == "tensorpick":
+            idxs = [int(s) for s in option.split(",") if s != ""]
+            out = frame.pick(idxs)
+        elif action == "fill_zero":
+            out = frame.with_tensors(
+                [np.zeros_like(np.asarray(t)) for t in frame.tensors]
+            )
+        elif action == "fill_values":
+            vals = [float(s) for s in option.split(",") if s != ""]
+            if not vals:
+                raise ElementError(
+                    f"{self.name}: fill_values needs {which}-option"
+                )
+            out = frame.with_tensors([
+                np.full_like(
+                    np.asarray(t), vals[i] if i < len(vals) else vals[-1]
+                )
+                for i, t in enumerate(frame.tensors)
+            ])
+        elif action in ("fill_with_file", "fill_with_file_rpt"):
+            if not option:
+                raise ElementError(
+                    f"{self.name}: {action} needs {which}-option (file path)"
+                )
+            out = self._fill_from_bytes(
+                frame, self._file_bytes(option), action.endswith("rpt")
+            )
+        elif action == "repeat_previous_frame":
+            prev = self._prev[which]
+            if prev is None:  # first: zeros (reference contract)
+                out = frame.with_tensors(
+                    [np.zeros_like(np.asarray(t)) for t in frame.tensors]
+                )
+            else:
+                out = frame.with_tensors(list(prev.tensors))
+        else:
+            raise ElementError(f"{self.name}: unknown behavior {action!r}")
+        self._prev[which] = out
+        return out
 
     def handle_frame(self, pad, frame):
         cond = self._decide(frame)
@@ -200,7 +311,7 @@ class TensorRate(TransformElement):
     PROPERTIES = {
         "framerate": Property(str, "", "target 'n/d'"),
         "throttle": Property(bool, True, "drop-only (no duplication)"),
-        "silent": Property(bool, True, ""),
+        "silent": Property(bool, True, "suppress per-frame counter logs"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
     }
 
@@ -208,8 +319,18 @@ class TensorRate(TransformElement):
         super().__init__(name)
         self._next_ts: Optional[float] = None
         self._last: Optional[TensorFrame] = None
+        # readable QoS counters ≙ reference props in/out/dup/drop
+        # (gsttensor_rate.c:81-88)
+        self.in_frames = 0
+        self.out_frames = 0
         self.dropped = 0
         self.duplicated = 0
+
+    def start(self):
+        self._next_ts = None
+        self._last = None
+        self.in_frames = self.out_frames = 0
+        self.dropped = self.duplicated = 0
 
     def _period(self) -> Optional[float]:
         fr = self.props["framerate"]
@@ -228,8 +349,10 @@ class TensorRate(TransformElement):
         )
 
     def transform(self, frame):
+        self.in_frames += 1
         period = self._period()
         if period is None or frame.pts is None:
+            self.out_frames += 1
             return frame
         if self._next_ts is None:
             self._next_ts = frame.pts
@@ -250,6 +373,13 @@ class TensorRate(TransformElement):
             outs.append(f)
         else:
             self.dropped += 1
+            if not self.props["silent"]:
+                self.log.info(
+                    "rate: in=%d out=%d dup=%d drop=%d",
+                    self.in_frames, self.out_frames,
+                    self.duplicated, self.dropped,
+                )
+        self.out_frames += len(outs)
         if not outs:
             return None
         return outs[0] if len(outs) == 1 else outs

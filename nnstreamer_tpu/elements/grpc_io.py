@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from concurrent import futures
 from typing import Iterator, Optional
 
@@ -94,8 +95,13 @@ class GrpcSink(SinkElement):
         "host": Property(str, "127.0.0.1", "bind/connect host"),
         "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
         "server": Property(bool, False, "run as gRPC server (clients Pull)"),
-        "idl": Property(str, "flex", "wire IDL (parity prop; flex header)"),
+        "idl": Property(str, "flex", "wire IDL: flex | protobuf (interop)"),
         "max-buffers": Property(int, 64, "stream queue depth"),
+        "retry-timeout": Property(
+            float, 10.0,
+            "client mode: keep retrying a failed Send for up to this many "
+            "seconds (peer restart window); 0 = fail fast",
+        ),
     }
 
     def __init__(self, name=None):
@@ -104,8 +110,10 @@ class GrpcSink(SinkElement):
         self._channel = None
         self._stub = None
         self.bound_port: Optional[int] = None
+        self._encode = wire.encode_frame
 
     def start(self) -> None:
+        self._encode, _ = wire.get_codec(self.props["idl"])
         if self.props["server"]:
             self._srv = _StreamServer(
                 self.props["host"], self.props["port"],
@@ -134,12 +142,46 @@ class GrpcSink(SinkElement):
             self._channel = None
             self._stub = None
 
+    # transient codes worth retrying through a peer restart; anything else
+    # (INVALID_ARGUMENT, UNIMPLEMENTED, ...) fails fast
+    _RETRYABLE = frozenset({
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+    })
+
+    def _stopping(self) -> bool:
+        p = self._pipeline
+        return p is not None and p._stop_flag.is_set()
+
     def render(self, frame: TensorFrame) -> None:
-        payload = wire.encode_frame(frame)
+        payload = self._encode(frame)
         if self._srv is not None:
             self._srv.outbox.put(payload, timeout=10.0)
         elif self._stub is not None:
-            self._stub(payload, timeout=10.0)
+            # survive a server restart mid-stream: the channel reconnects
+            # on its own, so retry the Send with backoff inside the window
+            deadline = time.monotonic() + max(0.0, self.props["retry-timeout"])
+            backoff = 0.1
+            while True:
+                try:
+                    self._stub(payload, timeout=10.0)
+                    return
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code not in self._RETRYABLE:
+                        raise ElementError(
+                            f"{self.name}: Send failed ({code}): {e}"
+                        ) from None
+                    if time.monotonic() >= deadline or self._stopping():
+                        if self._stopping():
+                            return  # pipeline is tearing down; drop quietly
+                        raise ElementError(
+                            f"{self.name}: Send failed after retries: {e}"
+                        ) from None
+                    self.log.info("grpc send failed; retrying in %.1fs", backoff)
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 1.0)
 
     def handle_eos(self, pad):
         if self._srv is not None:
@@ -156,7 +198,7 @@ class GrpcSrc(SourceElement):
         "host": Property(str, "127.0.0.1", "bind/connect host"),
         "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
         "server": Property(bool, True, "run as gRPC server (peers Send)"),
-        "idl": Property(str, "flex", "wire IDL (parity prop; flex header)"),
+        "idl": Property(str, "flex", "wire IDL: flex | protobuf (interop)"),
         "num-buffers": Property(int, -1, "EOS after N frames (-1 = forever)"),
         "timeout": Property(int, 10000, "ms without a frame before EOS"),
     }
@@ -167,12 +209,14 @@ class GrpcSrc(SourceElement):
         self._channel = None
         self.bound_port: Optional[int] = None
         self._reader_stop = threading.Event()
+        self._decode_payload = wire.decode_frame
 
     def output_spec(self) -> StreamSpec:
         return ANY
 
     def start(self) -> None:
         self._reader_stop.clear()
+        _, self._decode_payload = wire.get_codec(self.props["idl"])
         if self.props["server"]:
             self._srv = _StreamServer(
                 self.props["host"], self.props["port"], 64
@@ -211,22 +255,36 @@ class GrpcSrc(SourceElement):
             stop = self._reader_stop
 
             def _reader():
-                try:
-                    for payload in pull(b"", timeout=None):
-                        # bounded put with a stop check: once frames() exits
-                        # (num-buffers/timeout EOS) nobody drains the inbox,
-                        # and an unconditional put() would park this thread
-                        # forever holding the payload and the channel
-                        while not stop.is_set():
-                            try:
-                                inbox.put(payload, timeout=0.25)
-                                break
-                            except _queue.Full:
-                                continue
-                        if stop.is_set():
-                            return
-                except grpc.RpcError as e:
-                    self.log.info("grpc pull ended: %s", e)
+                # reconnect-on-server-restart: the Pull stream breaking is
+                # NOT end-of-stream for the element — re-open it with
+                # backoff until stop; the frames() inter-frame timeout
+                # remains the only EOS authority (matching the failover
+                # quality of the query elements, VERDICT item 10)
+                backoff = 0.1
+                while not stop.is_set():
+                    try:
+                        for payload in pull(b"", timeout=None):
+                            backoff = 0.1  # healthy stream resets backoff
+                            # bounded put with a stop check: once frames()
+                            # exits nobody drains the inbox, and an
+                            # unconditional put() would park this thread
+                            # forever holding payload + channel
+                            while not stop.is_set():
+                                try:
+                                    inbox.put(payload, timeout=0.25)
+                                    break
+                                except _queue.Full:
+                                    continue
+                            if stop.is_set():
+                                return
+                    except grpc.RpcError as e:
+                        self.log.info(
+                            "grpc pull broke (%s); retrying in %.1fs",
+                            getattr(e, "code", lambda: e)(), backoff,
+                        )
+                    if stop.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 2.0)
 
             threading.Thread(
                 target=_reader, name=f"{self.name}-pull", daemon=True
@@ -244,7 +302,7 @@ class GrpcSrc(SourceElement):
 
     def _decode(self, payload: bytes) -> Optional[TensorFrame]:
         try:
-            return wire.decode_frame(payload)
+            return self._decode_payload(payload)
         except wire.WireError as e:
             self.log.warning("undecodable grpc frame dropped: %s", e)
             return None

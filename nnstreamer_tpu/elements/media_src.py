@@ -23,13 +23,12 @@ tensor schema during static negotiation.
 
 from __future__ import annotations
 
-from fractions import Fraction
 from typing import Iterator, Optional
 
 import numpy as np
 
 from ..core.buffer import TensorFrame
-from ..media.caps import MediaInfo, MediaSpec, round_up_4
+from ..media.caps import MediaInfo, MediaSpec
 from ..pipeline.element import ElementError, Property, SourceElement, element
 
 

@@ -49,6 +49,14 @@ class MqttSink(SinkElement):
         "client-id": Property(str, "", "MQTT client id (auto if empty)"),
         "retain": Property(bool, False, "retain the last message"),
         "num-buffers": Property(int, -1, "stop after N messages (-1 = all)"),
+        "idl": Property(str, "flex", "payload IDL: flex | protobuf (interop)"),
+        # ≙ reference mqtt_qos (gst/mqtt/mqttsink.h:77); 1 = at-least-once
+        # with PUBACK + DUP redelivery across broker restarts
+        "qos": Property(int, 0, "MQTT QoS: 0 (fire-forget) | 1 (at-least-once)"),
+        # publishers reconnect slower than subscribers by default so that
+        # after a broker restart subscriptions are re-established before
+        # QoS-1 redelivery lands (see distributed/mqtt.py)
+        "reconnect-delay": Property(float, 1.0, "initial reconnect backoff, s"),
     }
 
     def __init__(self, name=None):
@@ -56,21 +64,36 @@ class MqttSink(SinkElement):
         self._client: Optional[MqttClient] = None
         self._base_epoch = 0.0
         self._sent = 0
+        self._encode = wire.encode_frame
 
     def start(self) -> None:
         if not self.props["pub-topic"]:
             raise ElementError(f"{self.name}: pub-topic is required")
+        self._encode, _ = wire.get_codec(self.props["idl"])
         self._client = MqttClient(
             self.props["host"], self.props["port"],
             client_id=self.props["client-id"],
+            reconnect_delay_s=self.props["reconnect-delay"],
         )
         # pipeline base-time as epoch (≙ ntputil-derived base in the sink's
         # message header) — receivers rebase against their own base
         self._base_epoch = time.time()
         self._sent = 0
 
+    _DRAIN_S = 5.0  # bounded unacked-drain window at stop
+
     def stop(self) -> None:
         if self._client is not None:
+            # at-least-once: give parked QoS-1 publishes a bounded window
+            # to reach the broker before tearing the client down
+            deadline = time.monotonic() + self._DRAIN_S
+            while self._client.unacked() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            left = self._client.unacked()
+            if left:
+                self.log.warning(
+                    "stopping with %d unacknowledged QoS-1 publish(es)", left
+                )
             self._client.close()
             self._client = None
 
@@ -79,10 +102,11 @@ class MqttSink(SinkElement):
         if self._client is None or (0 <= limit <= self._sent):
             return
         payload = _HDR.pack(_MAGIC, self._base_epoch, time.time()) + (
-            wire.encode_frame(frame)
+            self._encode(frame)
         )
         self._client.publish(
-            self.props["pub-topic"], payload, retain=self.props["retain"]
+            self.props["pub-topic"], payload,
+            retain=self.props["retain"], qos=self.props["qos"],
         )
         self._sent += 1
 
@@ -97,10 +121,13 @@ class MqttSrc(SourceElement):
         "num-buffers": Property(int, -1, "EOS after N messages (-1 = forever)"),
         "sub-timeout": Property(int, 10000, "ms without a message before EOS"),
         "max-msg-buf-size": Property(int, 64, "receive queue depth"),
+        "idl": Property(str, "flex", "payload IDL: flex | protobuf (interop)"),
+        "reconnect-delay": Property(float, 0.1, "initial reconnect backoff, s"),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
+        self._decode_payload = wire.decode_frame
         self._client: Optional[MqttClient] = None
         self._q: "_queue.Queue[bytes]" = _queue.Queue(64)
         self._base_epoch = 0.0
@@ -111,10 +138,12 @@ class MqttSrc(SourceElement):
     def start(self) -> None:
         if not self.props["sub-topic"]:
             raise ElementError(f"{self.name}: sub-topic is required")
+        _, self._decode_payload = wire.get_codec(self.props["idl"])
         self._q = _queue.Queue(self.props["max-msg-buf-size"])
         self._client = MqttClient(
             self.props["host"], self.props["port"],
             client_id=self.props["client-id"],
+            reconnect_delay_s=self.props["reconnect-delay"],
         )
         self._base_epoch = time.time()
         self._client.subscribe(self.props["sub-topic"], self._on_message)
@@ -148,7 +177,7 @@ class MqttSrc(SourceElement):
                 self.log.warning("bad MQTT message magic; dropped")
                 continue
             try:
-                frame = wire.decode_frame(payload[_HDR.size:])
+                frame = self._decode_payload(payload[_HDR.size:])
             except wire.WireError as e:
                 self.log.warning("undecodable MQTT frame: %s", e)
                 continue

@@ -12,7 +12,6 @@ frame ``FRAME\\n`` + packed I420 planes (Y w*h, U and V w/2*h/2).
 
 from __future__ import annotations
 
-import io
 from fractions import Fraction
 from typing import BinaryIO, Iterator, Tuple
 

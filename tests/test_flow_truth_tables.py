@@ -1,0 +1,251 @@
+"""tensor_if / tensor_rate truth tables — the full reference option matrix
+(``gsttensor_if.h:42-91`` enums: 6 compared-value modes x 10 operators x 8
+then/else behaviors; ``gsttensor_rate.c:81-88`` in/out/dup/drop counters).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.elements.flow import TensorIf, TensorRate
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+def make_if(**props):
+    el = TensorIf("tif")
+    for k, v in props.items():
+        el.props[k.replace("_", "-")] = v
+    el.srcpad(0)
+    el.start()
+    return el
+
+
+def run_if(el, frame):
+    out = el.handle_frame(0, frame)
+    return out[0][1] if out else None
+
+
+class TestOperators:
+    # (operator, supplied, value, expected) — truth table for all 10
+    TABLE = [
+        ("eq", "5", 5.0, True), ("eq", "5", 4.0, False),
+        ("ne", "5", 4.0, True), ("ne", "5", 5.0, False),
+        ("gt", "5", 6.0, True), ("gt", "5", 5.0, False),
+        ("ge", "5", 5.0, True), ("ge", "5", 4.9, False),
+        ("lt", "5", 4.0, True), ("lt", "5", 5.0, False),
+        ("le", "5", 5.0, True), ("le", "5", 5.1, False),
+        ("range_inclusive", "2,5", 2.0, True),
+        ("range_inclusive", "2,5", 5.0, True),
+        ("range_inclusive", "2,5", 5.5, False),
+        ("range_exclusive", "2,5", 2.0, False),
+        ("range_exclusive", "2,5", 3.0, True),
+        ("range_exclusive", "2,5", 5.0, False),
+        ("not_in_range_inclusive", "2,5", 2.0, False),
+        ("not_in_range_inclusive", "2,5", 1.0, True),
+        ("not_in_range_exclusive", "2,5", 2.0, True),
+        ("not_in_range_exclusive", "2,5", 3.0, False),
+    ]
+
+    @pytest.mark.parametrize("op,supplied,value,expect", TABLE)
+    def test_operator_truth_table(self, op, supplied, value, expect):
+        el = make_if(operator=op, supplied_value=supplied,
+                     then="passthrough", **{"else": "skip"})
+        out = run_if(el, TensorFrame([np.float64([value])]))
+        if expect:
+            assert out is not None and out.meta["tensor_if"] == "then"
+        else:
+            assert out is None
+
+
+class TestComparedValues:
+    def test_a_value_coordinate(self):
+        # innermost-first dims "1:0" -> numpy [0, 1] of tensor 1
+        arr0 = np.zeros((2, 2), np.float32)
+        arr1 = np.float32([[0, 9], [0, 0]])
+        el = make_if(compared_value="a_value", compared_value_option="1:0,1",
+                     operator="eq", supplied_value="9")
+        assert run_if(el, TensorFrame([arr0, arr1])) is not None
+
+    def test_tensor_total_and_average(self):
+        frame = TensorFrame([np.float32([1, 2, 3]), np.float32([10, 20])])
+        el = make_if(compared_value="tensor_total_value",
+                     compared_value_option="1", operator="eq",
+                     supplied_value="30")
+        assert run_if(el, frame) is not None
+        el = make_if(compared_value="tensor_average_value",
+                     compared_value_option="0", operator="eq",
+                     supplied_value="2")
+        assert run_if(el, frame) is not None
+
+    def test_all_tensors_total_and_average(self):
+        frame = TensorFrame([np.float32([1, 2, 3]), np.float32([10, 20])])
+        el = make_if(compared_value="all_tensors_total_value",
+                     operator="eq", supplied_value="36")
+        assert run_if(el, frame) is not None
+        # subset list: tensors 0 only
+        el = make_if(compared_value="all_tensors_total_value",
+                     compared_value_option="0", operator="eq",
+                     supplied_value="6")
+        assert run_if(el, frame) is not None
+        el = make_if(compared_value="all_tensors_average_value",
+                     operator="eq", supplied_value="7.2")  # 36/5
+        assert run_if(el, frame) is not None
+
+    def test_custom_callback(self):
+        from nnstreamer_tpu.elements.flow import (
+            register_if_custom,
+            unregister_if_custom,
+        )
+
+        register_if_custom("odd_sum", lambda f: int(np.asarray(f.tensors[0]).sum()) % 2 == 1)
+        try:
+            el = make_if(compared_value="custom", compared_value_option="odd_sum",
+                         operator="eq", supplied_value="1")
+            assert run_if(el, TensorFrame([np.int32([1, 2])])) is not None
+            assert run_if(el, TensorFrame([np.int32([1, 3])])) is None
+        finally:
+            unregister_if_custom("odd_sum")
+
+
+class TestBehaviors:
+    def _frame(self, fill=7):
+        return TensorFrame(
+            [np.full((2, 2), fill, np.int32), np.full((3,), fill, np.uint8)]
+        )
+
+    def test_fill_zero(self):
+        el = make_if(operator="gt", supplied_value="0", then="fill_zero")
+        out = run_if(el, self._frame())
+        assert (out.tensors[0] == 0).all() and (out.tensors[1] == 0).all()
+        assert out.tensors[0].dtype == np.int32
+
+    def test_fill_values_per_tensor_and_broadcast(self):
+        el = make_if(operator="gt", supplied_value="0", then="fill_values",
+                     then_option="3,250")
+        out = run_if(el, self._frame())
+        assert (out.tensors[0] == 3).all()
+        assert (out.tensors[1] == 250).all()
+        # single value broadcasts to every tensor
+        el = make_if(operator="gt", supplied_value="0", then="fill_values",
+                     then_option="9")
+        out = run_if(el, self._frame())
+        assert (out.tensors[0] == 9).all() and (out.tensors[1] == 9).all()
+
+    def test_fill_with_file_pads_zero(self, tmp_path):
+        path = tmp_path / "fill.raw"
+        path.write_bytes(np.int32([11, 22]).tobytes())  # 8 bytes < 16+3
+        el = make_if(operator="gt", supplied_value="0", then="fill_with_file",
+                     then_option=str(path))
+        out = run_if(el, self._frame())
+        np.testing.assert_array_equal(
+            out.tensors[0].reshape(-1), np.int32([11, 22, 0, 0])
+        )
+        assert (out.tensors[1] == 0).all()  # file exhausted -> zeros
+
+    def test_fill_with_file_rpt_cycles(self, tmp_path):
+        path = tmp_path / "fill.raw"
+        path.write_bytes(bytes([1, 2]))
+        el = make_if(operator="gt", supplied_value="0",
+                     then="fill_with_file_rpt", then_option=str(path))
+        out = run_if(el, self._frame())
+        flat0 = out.tensors[0].view(np.uint8).reshape(-1)
+        np.testing.assert_array_equal(flat0, np.tile([1, 2], 8))
+        # the second tensor continues the cycle from byte offset 16
+        np.testing.assert_array_equal(out.tensors[1], [1, 2, 1])
+
+    def test_repeat_previous_frame(self):
+        el = make_if(operator="gt", supplied_value="0",
+                     then="repeat_previous_frame")
+        first = run_if(el, self._frame(5))
+        assert (first.tensors[0] == 0).all()  # first: zeros (reference)
+        second = run_if(el, self._frame(6))
+        assert (second.tensors[0] == 0).all()  # resends previous output
+
+    def test_repeat_previous_after_passthrough_branch_isolation(self):
+        # then=passthrough else=repeat: the else cache is per-branch
+        el = make_if(operator="gt", supplied_value="10", then="passthrough",
+                     **{"else": "repeat_previous_frame"})
+        out1 = run_if(el, self._frame(20))  # then: passthrough 20s
+        assert (out1.tensors[0] == 20).all()
+        out2 = run_if(el, self._frame(1))  # else first: zeros, NOT 20s
+        assert (out2.tensors[0] == 0).all()
+
+    def test_tensorpick_subset(self):
+        el = make_if(operator="gt", supplied_value="0", then="tensorpick",
+                     then_option="1")
+        out = run_if(el, self._frame())
+        assert len(out.tensors) == 1 and out.tensors[0].shape == (3,)
+
+    def test_unknown_behavior_rejected_at_start(self):
+        el = TensorIf("bad")
+        el.props["then"] = "explode"
+        el.srcpad(0)
+        with pytest.raises(Exception, match="unknown behavior"):
+            el.start()
+
+    def test_caches_reset_on_restart(self):
+        el = make_if(operator="gt", supplied_value="0",
+                     then="repeat_previous_frame")
+        run_if(el, self._frame(5))
+        run_if(el, self._frame(6))
+        el.start()  # restart
+        again = run_if(el, self._frame(7))
+        assert (again.tensors[0] == 0).all()  # cache cleared -> zeros
+
+
+class TestRateCounters:
+    def _push(self, el, pts, val=1.0):
+        return el.handle_frame(0, TensorFrame([np.float32([val])], pts=pts))
+
+    def test_drop_counters(self):
+        el = TensorRate("r")
+        el.props["framerate"] = "1/1"
+        el.props["throttle"] = True
+        el.start()
+        # 4 frames at 2 fps -> 2 out, 2 dropped
+        for i in range(4):
+            self._push(el, i * 0.5)
+        assert (el.in_frames, el.out_frames) == (4, 2)
+        assert (el.dropped, el.duplicated) == (2, 0)
+
+    def test_duplicate_counters(self):
+        el = TensorRate("r")
+        el.props["framerate"] = "2/1"
+        el.props["throttle"] = False
+        el.start()
+        # 1 fps in -> 2 fps out: each gap filled with one duplicate
+        outs = []
+        for i in range(3):
+            outs.extend(self._push(el, float(i)))
+        assert el.in_frames == 3
+        assert el.duplicated == 2
+        assert el.out_frames == len(outs) == 5
+        assert el.dropped == 0
+
+    def test_counters_reset_on_restart(self):
+        el = TensorRate("r")
+        el.props["framerate"] = "1/1"
+        el.start()
+        for i in range(3):
+            self._push(el, i * 0.5)
+        el.start()
+        assert (el.in_frames, el.out_frames, el.dropped, el.duplicated) == (0, 0, 0, 0)
+
+
+class TestPipelineIntegration:
+    def test_if_fill_values_in_pipeline(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_if compared-value=tensor_average_value "
+            "compared-value-option=0 operator=ge supplied-value=100 "
+            "then=fill_values then-option=255 else=passthrough ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.full((2, 2), 200, np.uint8))  # bright -> filled
+        pipe["src"].push(np.full((2, 2), 3, np.uint8))  # dark -> passthrough
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert (np.asarray(frames[0].tensors[0]) == 255).all()
+        assert (np.asarray(frames[1].tensors[0]) == 3).all()

@@ -1,0 +1,99 @@
+"""invoke-dynamic end-to-end: per-buffer-varying output schemas flow as
+format=flexible frames through decoder and sink.
+
+Reference: ``tensor_filter.c:856-930`` — a subplugin with invoke_dynamic
+produces outputs whose dimensions differ per buffer; the element wraps
+them as flexible tensors so downstream caps stay valid.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends import register_custom_easy, unregister_custom_easy
+from nnstreamer_tpu.core.types import FORMAT_FLEXIBLE
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+@pytest.fixture
+def nonzero_model():
+    # output shape = number of nonzero elements -> varies per buffer
+    register_custom_easy(
+        "nonzeros", lambda xs: [np.asarray(xs[0])[np.asarray(xs[0]) != 0]]
+    )
+    yield "nonzeros"
+    unregister_custom_easy("nonzeros")
+
+
+class TestInvokeDynamic:
+    def test_two_shapes_one_run(self, nonzero_model):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=custom-easy "
+            f"model={nonzero_model} invoke-dynamic=true ! tensor_sink name=out"
+        )
+        pipe.start()
+        # flexible advertised downstream before data flows
+        assert pipe["f"].srcpads[0].spec.fmt == FORMAT_FLEXIBLE
+        pipe["src"].push(np.float32([1, 0, 2, 0, 3]))  # -> shape (3,)
+        pipe["src"].push(np.float32([0, 7, 0, 0, 0]))  # -> shape (1,)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert [f.tensors[0].shape for f in frames] == [(3,), (1,)]
+        np.testing.assert_array_equal(frames[0].tensors[0], [1, 2, 3])
+        np.testing.assert_array_equal(frames[1].tensors[0], [7])
+
+    def test_through_decoder(self, nonzero_model):
+        # flexible frames decode per-buffer (octet decoder concatenates
+        # whatever bytes arrive — size varies run to run)
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter framework=custom-easy "
+            f"model={nonzero_model} invoke-dynamic=true ! "
+            "tensor_decoder mode=octet_stream ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.uint8([5, 0, 6]))
+        pipe["src"].push(np.uint8([0, 0, 9]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert bytes(frames[0].tensors[0]) == bytes([5, 6])
+        assert bytes(frames[1].tensors[0]) == bytes([9])
+
+    def test_batching_rejected(self, nonzero_model):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter framework=custom-easy "
+            f"model={nonzero_model} invoke-dynamic=true max-batch=8 ! "
+            "tensor_sink name=out"
+        )
+        with pytest.raises(Exception, match="invoke-dynamic is per-frame"):
+            pipe.start()
+        pipe.stop()
+
+    def test_jax_backend_dynamic_via_shape_buckets(self):
+        """jax-xla handles per-buffer-varying INPUT shapes through its
+        shape-bucketed jit cache; with invoke-dynamic the varying output
+        schema flows as flexible frames."""
+        from nnstreamer_tpu.backends.jax_xla import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        register_jax_model("idy", lambda p, xs: [xs[0] * 2])
+        try:
+            pipe = parse_pipeline(
+                "appsrc name=src ! tensor_filter framework=jax-xla "
+                "model=idy invoke-dynamic=true ! tensor_sink name=out"
+            )
+            pipe.start()
+            pipe["src"].push(np.float32([1, 2]))
+            pipe["src"].push(np.float32([1, 2, 3, 4]))  # different shape
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=60)
+            frames = pipe["out"].frames
+            pipe.stop()
+            assert [f.tensors[0].shape for f in frames] == [(2,), (4,)]
+            np.testing.assert_array_equal(frames[1].tensors[0], [2, 4, 6, 8])
+        finally:
+            unregister_jax_model("idy")

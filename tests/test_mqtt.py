@@ -103,3 +103,117 @@ class TestMqttElements:
         rx.wait(timeout=15)  # EOS via sub-timeout
         rx.stop()
         assert rx["out"].frames == []
+
+
+def _restart_broker(port, timeout=8.0):
+    """Rebind the broker port, retrying while old sockets drain."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return MiniBroker(port=port)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+class TestQos1:
+    def test_puback_drains_pending(self, broker):
+        sub_got = []
+        rx = MqttClient(broker.host, broker.port)
+        rx.subscribe("q/1", lambda t, p: sub_got.append(p))
+        tx = MqttClient(broker.host, broker.port)
+        time.sleep(0.1)
+        tx.publish("q/1", b"hello", qos=1)
+        deadline = time.time() + 5
+        while (tx.unacked() or len(sub_got) < 1) and time.time() < deadline:
+            time.sleep(0.02)
+        assert tx.unacked() == 0  # PUBACK received
+        assert sub_got == [b"hello"]
+        tx.close(); rx.close()
+
+    def test_qos0_unaffected(self, broker):
+        tx = MqttClient(broker.host, broker.port)
+        tx.publish("q/0", b"x", qos=0)
+        assert tx.unacked() == 0
+        tx.close()
+
+
+class TestBrokerRestart:
+    def test_reconnect_resubscribe_and_redeliver(self):
+        """Kill the broker mid-stream; the client reconnects, re-subscribes,
+        and unacked QoS-1 publishes are redelivered (at-least-once, no
+        corruption) — the reference mqttsrc.c reconnect contract."""
+        b1 = MiniBroker()
+        port = b1.port
+        got = []
+        rx = MqttClient(b1.host, port, client_id="rx")
+        rx.subscribe("s/#", lambda t, p: got.append(p))
+        tx = MqttClient(b1.host, port, client_id="tx", retransmit_s=0.3,
+                        reconnect_delay_s=1.0)  # publisher lags subscriber
+        time.sleep(0.1)
+        tx.publish("s/a", b"before", qos=1)
+        deadline = time.time() + 5
+        while len(got) < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [b"before"]
+
+        b1.close()  # broker dies mid-stream
+        time.sleep(0.2)
+        # published while down: parked as unacked QoS-1
+        tx.publish("s/a", b"during", qos=1)
+        assert tx.unacked() >= 1
+
+        b2 = _restart_broker(port)  # broker comes back on the same port
+        try:
+            deadline = time.time() + 10
+            while (tx.unacked() or b"during" not in got) and time.time() < deadline:
+                time.sleep(0.05)
+            assert tx.unacked() == 0
+            assert b"during" in got  # redelivered through the new broker
+            # stream resumes normally (rx auto-resubscribed)
+            tx.publish("s/a", b"after", qos=1)
+            deadline = time.time() + 5
+            while b"after" not in got and time.time() < deadline:
+                time.sleep(0.02)
+            assert b"after" in got
+        finally:
+            tx.close(); rx.close(); b2.close()
+
+    def test_element_stream_survives_restart(self):
+        """mqttsink qos=1 -> broker restart -> mqttsrc: frames resume,
+        every delivered frame decodes (no corruption)."""
+        b1 = MiniBroker()
+        port = b1.port
+        rx = parse_pipeline(
+            f"mqttsrc host=127.0.0.1 port={port} sub-topic=el/t "
+            "sub-timeout=15000 num-buffers=3 ! tensor_sink name=out"
+        )
+        rx.start()
+        tx = parse_pipeline(
+            f"appsrc name=src ! mqttsink host=127.0.0.1 port={port} "
+            "pub-topic=el/t qos=1"
+        )
+        tx.start()
+        time.sleep(0.2)
+        tx["src"].push(np.int32([1]))
+        time.sleep(0.3)
+        b1.close()  # mid-stream broker death
+        time.sleep(0.2)
+        tx["src"].push(np.int32([2]))  # parked unacked
+        b2 = _restart_broker(port)
+        try:
+            time.sleep(0.5)
+            tx["src"].push(np.int32([3]))
+            rx.wait(timeout=20)
+            frames = rx["out"].frames
+            rx.stop()
+            tx["src"].end_of_stream()
+            tx.wait(timeout=10)
+            tx.stop()
+            vals = [int(np.asarray(f.tensors[0])[0]) for f in frames]
+            # at-least-once: 2 and 3 must arrive post-restart; every frame
+            # decoded cleanly (wire errors would have dropped them)
+            assert 2 in vals and 3 in vals
+        finally:
+            b2.close()

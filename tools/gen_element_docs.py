@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Generate Documentation/element-reference.md from the element registry.
+
+≙ the reference's hand-written ``Documentation/component-description.md``,
+but derived from the live Property tables so it cannot drift (CI re-runs
+this and fails on diff).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # never dial an accelerator
+
+
+def main(out_path: str) -> None:
+    from nnstreamer_tpu import elements  # noqa: F401 — registers factories
+    from nnstreamer_tpu.pipeline.element import ELEMENT_TYPES
+
+    lines = [
+        "# Element reference",
+        "",
+        "Every pipeline element and its properties, generated from the",
+        "registry (`tools/gen_element_docs.py`; do not edit by hand).",
+        "Reference analog: `Documentation/component-description.md`.",
+        "",
+    ]
+    by_factory = {}
+    aliases = {}
+    for name, cls in sorted(ELEMENT_TYPES.items()):
+        if cls.FACTORY_NAME == name:
+            by_factory[name] = cls
+        else:
+            aliases.setdefault(cls.FACTORY_NAME, []).append(name)
+    for name, cls in sorted(by_factory.items()):
+        header = f"## `{name}`"
+        if name in aliases:
+            header += "  (aliases: " + ", ".join(
+                f"`{a}`" for a in sorted(aliases[name])
+            ) + ")"
+        lines.append(header)
+        lines.append("")
+        doc = (cls.__doc__ or "").strip().splitlines()
+        if doc:
+            lines.append(doc[0].strip())
+            lines.append("")
+        props = getattr(cls, "PROPERTIES", {})
+        if props:
+            lines.append("| property | type | default | description |")
+            lines.append("|---|---|---|---|")
+            for pname, prop in props.items():
+                desc = (prop.doc or "").replace("|", "\\|")
+                default = repr(prop.default)
+                lines.append(
+                    f"| `{pname}` | {prop.type.__name__} | {default} | {desc} |"
+                )
+            lines.append("")
+        else:
+            lines.append("(no properties)")
+            lines.append("")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out_path}: {len(by_factory)} elements")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "Documentation/element-reference.md")
