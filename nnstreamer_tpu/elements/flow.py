@@ -93,13 +93,16 @@ class TensorIf(Element):
 
     def __init__(self, name=None):
         super().__init__(name)
-        # REPEAT_PREVIOUS_FRAME cache, per branch (reference caches the
-        # previous output frame; first occurrence sends zeros)
-        self._prev: Dict[str, Optional[TensorFrame]] = {"then": None, "else": None}
+        # REPEAT_PREVIOUS_FRAME cache, per OUTPUT PAD: "resend the previous
+        # output frame" (tensor_if.h header contract) means the last frame
+        # that left that pad — whichever branch produced it — so
+        # else=repeat_previous_frame re-sends the last passed-through frame
+        # when both branches share a pad.  First frame on a pad: zeros.
+        self._prev: Dict[int, TensorFrame] = {}
         self._file_cache: Dict[str, bytes] = {}
 
     def start(self):
-        self._prev = {"then": None, "else": None}
+        self._prev = {}
         self._file_cache.clear()
         for which in ("then", "else"):
             if self.props[which].lower() not in _BEHAVIORS:
@@ -184,7 +187,7 @@ class TensorIf(Element):
             off += n
         return frame.with_tensors(outs)
 
-    def _behave(self, frame: TensorFrame, which: str):
+    def _behave(self, frame: TensorFrame, which: str, src_pad: int = 0):
         action = self.props[which].lower()
         option = self.props[f"{which}-option"]
         if action == "passthrough":
@@ -219,8 +222,8 @@ class TensorIf(Element):
                 frame, self._file_bytes(option), action.endswith("rpt")
             )
         elif action == "repeat_previous_frame":
-            prev = self._prev[which]
-            if prev is None:  # first: zeros (reference contract)
+            prev = self._prev.get(src_pad)
+            if prev is None:  # first on this pad: zeros (header contract)
                 out = frame.with_tensors(
                     [np.zeros_like(np.asarray(t)) for t in frame.tensors]
                 )
@@ -228,17 +231,17 @@ class TensorIf(Element):
                 out = frame.with_tensors(list(prev.tensors))
         else:
             raise ElementError(f"{self.name}: unknown behavior {action!r}")
-        self._prev[which] = out
         return out
 
     def handle_frame(self, pad, frame):
         cond = self._decide(frame)
         which = "then" if cond else "else"
-        out = self._behave(frame, which)
+        src = 0 if cond else (1 if len(self.srcpads) > 1 and self.srcpads[1].is_linked else 0)
+        out = self._behave(frame, which, src)
         if out is None:
             return []
         out.meta["tensor_if"] = which
-        src = 0 if cond else (1 if len(self.srcpads) > 1 and self.srcpads[1].is_linked else 0)
+        self._prev[src] = out
         return [(src, out)]
 
 

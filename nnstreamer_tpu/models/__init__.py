@@ -19,6 +19,8 @@ _ZOO = {
     "posenet": "nnstreamer_tpu.models.posenet",
     "mnist_cnn": "nnstreamer_tpu.models.mnist_cnn",
     "transformer": "nnstreamer_tpu.models.transformer",
+    "deeplab": "nnstreamer_tpu.models.deeplab",
+    "kws_cnn": "nnstreamer_tpu.models.kws_cnn",
 }
 
 

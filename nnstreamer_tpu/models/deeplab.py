@@ -1,0 +1,121 @@
+"""DeepLab-style semantic segmentation (flax) — pairs with the
+``image_segment`` decoder.
+
+The reference runs segmentation through TFLite DeepLab models decoded by
+``tensordec-imagesegment.c`` (mode ``tflite-deeplab``: a (H, W, classes)
+class-score grid).  This is a from-scratch TPU-friendly implementation:
+MobileNet-v2 backbone at output-stride 16, an ASPP-lite head (1x1 + two
+atrous 3x3 branches + image pooling), bilinear upsample back to the input
+grid — all static shapes, one fused XLA program.
+
+fn(params, [img_u8 (H,W,3) or (N,H,W,3)]) -> [(H,W,classes) scores]
+(per-frame; the filter element batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
+from .mobilenet_v2 import _CFG, ConvBN, InvertedResidual, _make_divisible
+
+
+class _Backbone(nn.Module):
+    """MobileNet-v2 trunk, stride capped at 16 (dilate the last stage)."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = ConvBN(32, (3, 3), strides=2, dtype=self.dtype)(x)
+        stride_seen = 2
+        for t, ch, n, s in _CFG:
+            out_c = _make_divisible(ch)
+            for i in range(n):
+                s_i = s if i == 0 else 1
+                if stride_seen >= 16 and s_i == 2:
+                    s_i = 1  # keep output-stride 16 (dilation-free approx)
+                stride_seen *= s_i
+                x = InvertedResidual(out_c, s_i, t, dtype=self.dtype)(x)
+        return x
+
+
+class _ASPPLite(nn.Module):
+    features: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h, w = x.shape[-3], x.shape[-2]
+        b1 = ConvBN(self.features, (1, 1), dtype=self.dtype)(x)
+        b2 = nn.Conv(self.features, (3, 3), kernel_dilation=(2, 2),
+                     padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        b3 = nn.Conv(self.features, (3, 3), kernel_dilation=(4, 4),
+                     padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        # image-level pooling branch, broadcast back to the grid
+        gp = jnp.mean(x, axis=(-3, -2), keepdims=True)
+        gp = ConvBN(self.features, (1, 1), dtype=self.dtype)(gp)
+        gp = jnp.broadcast_to(gp, gp.shape[:-3] + (h, w, self.features))
+        y = jnp.concatenate([b1, b2, b3, gp], axis=-1)
+        return ConvBN(self.features, (1, 1), dtype=self.dtype)(y)
+
+
+class DeepLabLite(nn.Module):
+    num_classes: int = 21  # Pascal VOC + background (tflite-deeplab layout)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        size = (x.shape[-3], x.shape[-2])
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+        else:
+            x = x.astype(self.dtype)
+        x = _Backbone(dtype=self.dtype)(x)
+        x = _ASPPLite(dtype=self.dtype)(x)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        # bilinear upsample to the input grid (XLA lowers resize to gathers
+        # + matmuls; static scale so it compiles once)
+        return jax.image.resize(
+            x, x.shape[:-3] + size + (self.num_classes,), method="bilinear"
+        )
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [img (H,W,3) u8]) -> [(H,W,classes) f32]."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[props.get("dtype", "bfloat16")]
+    size = int(props.get("size", "257"))
+    classes = int(props.get("classes", "21"))
+    model = DeepLabLite(num_classes=classes, dtype=dtype)
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
+    )
+
+    def fn(p, inputs):
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        out = model.apply(p, x)
+        return [out[0] if single else out]
+
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((size, size, classes), np.float32, "class_scores"),),
+        FORMAT_STATIC,
+    )
+    return fn, params, in_spec, out_spec

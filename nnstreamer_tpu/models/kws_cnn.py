@@ -1,0 +1,124 @@
+"""Keyword-spotting CNN on log-mel features — the audio model family.
+
+Pairs with the audio ingest path (``audiofilesrc -> tensor_converter``):
+raw PCM frames in, keyword class logits out.  The reference exercises
+audio through generic tensor pipelines (audio/x-raw converter framing,
+``gsttensor_converter.c`` audio chain); this family gives the framework a
+native speech workload, TPU-first:
+
+* the WHOLE front-end (pre-emphasis, framing, Hann window, |STFT| via
+  matmul against DFT bases, mel filterbank, log) runs INSIDE the jitted
+  program — matmuls on the MXU, zero host preprocams;
+* conv stack over the (frames, mels) "spectrogram image".
+
+fn(params, [pcm_i16 (samples, channels)]) -> [logits (classes,)]
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
+
+
+def _mel_matrix(n_mels: int, n_fft: int, rate: int) -> np.ndarray:
+    """Triangular mel filterbank (HTK mel scale), (n_fft//2+1, n_mels)."""
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, rate / 2, n_bins)
+    mel_pts = mel_to_hz(np.linspace(
+        hz_to_mel(20.0), hz_to_mel(rate / 2), n_mels + 2
+    ))
+    weights = np.zeros((n_bins, n_mels), np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-6)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-6)
+        weights[:, i] = np.maximum(0.0, np.minimum(up, down))
+    return weights
+
+
+class KwsCNN(nn.Module):
+    num_classes: int = 12  # Speech-Commands style: 10 words + silence/unknown
+    rate: int = 16000
+    n_fft: int = 400       # 25 ms @ 16 kHz
+    hop: int = 160         # 10 ms
+    n_mels: int = 40
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, pcm):
+        # pcm (N, samples) float in [-1, 1]
+        n = pcm.shape[-1]
+        frames = 1 + (n - self.n_fft) // self.hop
+        idx = (
+            np.arange(self.n_fft)[None, :]
+            + self.hop * np.arange(frames)[:, None]
+        )
+        x = pcm[..., idx]  # (N, frames, n_fft) — one gather, static shapes
+        window = jnp.asarray(np.hanning(self.n_fft).astype(np.float32))
+        x = x.astype(jnp.float32) * window
+        # |DFT| as two matmuls against fixed cos/sin bases: MXU-native STFT
+        k = np.arange(self.n_fft // 2 + 1)[:, None] * np.arange(self.n_fft)[None, :]
+        ang = 2.0 * np.pi * k / self.n_fft
+        cos_b = jnp.asarray(np.cos(ang).T.astype(np.float32))
+        sin_b = jnp.asarray(np.sin(ang).T.astype(np.float32))
+        re, im = x @ cos_b, x @ sin_b
+        power = re * re + im * im  # (N, frames, bins)
+        mel = power @ jnp.asarray(_mel_matrix(self.n_mels, self.n_fft, self.rate))
+        feats = jnp.log1p(mel).astype(self.dtype)[..., None]  # (N, F, M, 1)
+        h = nn.Conv(32, (3, 3), strides=2, dtype=self.dtype)(feats)
+        h = nn.relu(h)
+        h = nn.Conv(64, (3, 3), strides=2, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = nn.Conv(64, (3, 3), strides=2, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = jnp.mean(h, axis=(-3, -2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            h.astype(jnp.float32)
+        )
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [pcm (samples, ch) i16|f32]) -> [logits]."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+             "float16": jnp.float16}[props.get("dtype", "bfloat16")]
+    rate = int(props.get("rate", "16000"))
+    samples = int(props.get("samples", "16000"))  # 1 s clip
+    channels = int(props.get("channels", "1"))
+    classes = int(props.get("classes", "12"))
+    model = KwsCNN(num_classes=classes, rate=rate, dtype=dtype)
+    params = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, samples), np.float32),
+    )
+
+    def fn(p, inputs):
+        x = inputs[0]
+        single = x.ndim == 2  # (samples, channels) per-frame
+        if single:
+            x = x[None]
+        # mono mixdown + int16 normalize inside the program
+        x = jnp.mean(x.astype(jnp.float32), axis=-1) / 32768.0
+        out = model.apply(p, x)
+        return [out[0] if single else out]
+
+    in_spec = StreamSpec(
+        (TensorSpec((samples, channels), np.int16, "pcm"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((classes,), np.float32, "logits"),), FORMAT_STATIC
+    )
+    return fn, params, in_spec, out_spec

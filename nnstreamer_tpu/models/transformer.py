@@ -37,6 +37,9 @@ class TransformerConfig:
     d_ff: int = 512
     max_seq: int = 256
     dtype: Any = jnp.bfloat16
+    # sequence-parallel attention strategy when the mesh has sp > 1:
+    # auto (ulysses when heads divide sp, else ring) | ring | ulysses
+    sp_strategy: str = "auto"
 
 
 class Block(nn.Module):
@@ -56,8 +59,11 @@ class Block(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1:
-            attn = ring_attention(
-                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True
+            from ..parallel.ulysses import sequence_attention
+
+            attn = sequence_attention(
+                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
+                strategy=cfg.sp_strategy,
             )
         else:
             attn = reference_attention(q, k, v, causal=True)
@@ -105,6 +111,7 @@ def _cfg_from_props(props: Dict[str, str]) -> TransformerConfig:
         d_ff=int(props.get("d_ff", "512")),
         max_seq=int(props.get("seq", "256")),
         dtype=dt,
+        sp_strategy=props.get("sp_strategy", "auto"),
     )
 
 

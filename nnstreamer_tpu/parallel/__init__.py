@@ -7,4 +7,5 @@ mesh-based dp/fsdp/tp/sp parallelism as a first-class subsystem.
 
 from .mesh import DP, EP, FSDP, PP, SP, TP, default_mesh, make_mesh, mesh_axis_size, single_device_mesh  # noqa: F401
 from .ring_attention import reference_attention, ring_attention  # noqa: F401
+from .ulysses import sequence_attention, ulysses_attention  # noqa: F401
 from .sharding import batch_sharding, replicated, shard_params, spec_for_path, transformer_rules  # noqa: F401

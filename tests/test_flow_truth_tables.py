@@ -153,22 +153,27 @@ class TestBehaviors:
         # the second tensor continues the cycle from byte offset 16
         np.testing.assert_array_equal(out.tensors[1], [1, 2, 1])
 
-    def test_repeat_previous_frame(self):
+    def test_repeat_previous_frame_first_is_zero(self):
         el = make_if(operator="gt", supplied_value="0",
                      then="repeat_previous_frame")
         first = run_if(el, self._frame(5))
-        assert (first.tensors[0] == 0).all()  # first: zeros (reference)
+        assert (first.tensors[0] == 0).all()  # first on the pad: zeros
         second = run_if(el, self._frame(6))
         assert (second.tensors[0] == 0).all()  # resends previous output
 
-    def test_repeat_previous_after_passthrough_branch_isolation(self):
-        # then=passthrough else=repeat: the else cache is per-branch
+    def test_repeat_resends_last_passthrough_on_shared_pad(self):
+        # then=passthrough else=repeat, single pad: 'previous output
+        # frame' = the last frame that left this pad (the passthrough) —
+        # the hold-last-good-frame use case
         el = make_if(operator="gt", supplied_value="10", then="passthrough",
                      **{"else": "repeat_previous_frame"})
         out1 = run_if(el, self._frame(20))  # then: passthrough 20s
         assert (out1.tensors[0] == 20).all()
-        out2 = run_if(el, self._frame(1))  # else first: zeros, NOT 20s
-        assert (out2.tensors[0] == 0).all()
+        out2 = run_if(el, self._frame(1))  # else: re-sends the 20s frame
+        assert (out2.tensors[0] == 20).all()
+        out3 = run_if(el, self._frame(30))  # passthrough updates the cache
+        out4 = run_if(el, self._frame(2))
+        assert (out4.tensors[0] == 30).all()
 
     def test_tensorpick_subset(self):
         el = make_if(operator="gt", supplied_value="0", then="tensorpick",
@@ -190,7 +195,7 @@ class TestBehaviors:
         run_if(el, self._frame(6))
         el.start()  # restart
         again = run_if(el, self._frame(7))
-        assert (again.tensors[0] == 0).all()  # cache cleared -> zeros
+        assert (again.tensors[0] == 0).all()  # pad cache cleared -> zeros
 
 
 class TestRateCounters:

@@ -1,0 +1,102 @@
+"""New zoo families: DeepLab-lite segmentation and keyword-spotting CNN.
+
+Each runs as a full pipeline with its natural decoder — segmentation pairs
+with image_segment (``tensordec-imagesegment.c`` contract), KWS consumes
+real .wav audio through the media ingest path.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.jax_xla import register_jax_model, unregister_jax_model
+from nnstreamer_tpu.media.wav import write_wav
+from nnstreamer_tpu.models import build
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+class TestDeepLab:
+    def test_build_shapes(self):
+        fn, params, in_spec, out_spec = build(
+            "deeplab", {"dtype": "float32", "size": "65", "classes": "5"}
+        )
+        img = np.random.default_rng(0).integers(0, 255, (65, 65, 3), np.uint8)
+        out = fn(params, [img])[0]
+        assert out.shape == (65, 65, 5)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_pipeline_with_segment_decoder(self):
+        fn, params, in_spec, out_spec = build(
+            "deeplab", {"dtype": "float32", "size": "33", "classes": "5"}
+        )
+        register_jax_model("seg_t", fn, params, in_spec, out_spec)
+        try:
+            pipe = parse_pipeline(
+                "appsrc name=src ! tensor_filter framework=jax-xla "
+                "model=seg_t ! tensor_decoder mode=image_segment "
+                "option1=tflite-deeplab option2=5 ! tensor_sink name=out"
+            )
+            pipe.start()
+            img = np.random.default_rng(1).integers(0, 255, (33, 33, 3), np.uint8)
+            pipe["src"].push(img)
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=120)
+            frames = pipe["out"].frames
+            pipe.stop()
+            assert frames[0].tensors[0].shape == (33, 33, 4)  # RGBA overlay
+            assert frames[0].tensors[0].dtype == np.uint8
+        finally:
+            unregister_jax_model("seg_t")
+
+
+class TestKwsCNN:
+    def test_build_and_logits(self):
+        fn, params, in_spec, out_spec = build(
+            "kws_cnn", {"dtype": "float32", "samples": "4000", "classes": "4"}
+        )
+        pcm = (np.sin(np.arange(4000) / 5.0) * 10000).astype(np.int16)[:, None]
+        out = np.asarray(fn(params, [pcm])[0])
+        assert out.shape == (4,)
+        assert np.isfinite(out).all()
+
+    def test_wav_to_keyword_pipeline(self, tmp_path):
+        """audiofilesrc -> converter -> KWS filter: real file, end to end."""
+        rate, samples = 16000, 4000
+        t = np.arange(rate, dtype=np.float32)
+        pcm = (np.sin(t / 8.0) * 9000).astype(np.int16)
+        path = str(tmp_path / "kw.wav")
+        write_wav(path, pcm, rate=rate)
+
+        fn, params, in_spec, out_spec = build(
+            "kws_cnn",
+            {"dtype": "float32", "samples": str(samples), "classes": "4",
+             "rate": str(rate)},
+        )
+        register_jax_model("kws_t", fn, params, in_spec, out_spec)
+        try:
+            pipe = parse_pipeline(
+                f"audiofilesrc location={path} samples-per-buffer={samples} ! "
+                "tensor_converter ! tensor_filter framework=jax-xla "
+                "model=kws_t ! tensor_sink name=out"
+            )
+            pipe.start()
+            pipe.wait(timeout=120)
+            frames = pipe["out"].frames
+            pipe.stop()
+            assert len(frames) == rate // samples  # 4 clips
+            for f in frames:
+                logits = np.asarray(f.tensors[0])
+                assert logits.shape == (4,) and np.isfinite(logits).all()
+        finally:
+            unregister_jax_model("kws_t")
+
+    def test_frontend_is_traced_not_host(self):
+        """The mel front-end must live inside the jitted program (no host
+        numpy on the data path) — jit with tracers would fail otherwise."""
+        import jax
+
+        fn, params, _, _ = build(
+            "kws_cnn", {"dtype": "float32", "samples": "2000", "classes": "3"}
+        )
+        jf = jax.jit(lambda p, x: fn(p, [x])[0])
+        out = jf(params, np.zeros((2000, 1), np.int16))
+        assert out.shape == (3,)
