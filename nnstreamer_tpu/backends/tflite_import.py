@@ -31,9 +31,13 @@ def _find_interpreter():
     except ImportError:
         pass
     try:
-        from tensorflow.lite import Interpreter  # type: ignore
-        return Interpreter
-    except ImportError:
+        # attribute access, not `from tensorflow.lite import ...`: tf
+        # exposes the lite namespace through a lazy loader that defeats
+        # direct from-imports
+        import tensorflow as tf  # type: ignore
+
+        return tf.lite.Interpreter
+    except (ImportError, AttributeError):
         return None
 
 

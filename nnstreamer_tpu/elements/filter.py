@@ -67,15 +67,32 @@ def _shared_release(key: str) -> bool:
         return False
 
 
-def detect_framework(model_path: str) -> str:
+def detect_framework(model_path: str, custom: str = "") -> str:
     """framework=auto resolution from the model extension.
 
     Reference: ``_detect_framework_from_config`` tensor_filter_common.c:1171.
+    jax-xla wins a foreign extension (e.g. .tflite) only when the pipeline
+    supplies ``custom=arch:<zoo-family>`` — without it jax-xla cannot load
+    the file, so auto falls through to the native runtime for that format.
     """
     ext = os.path.splitext(model_path)[1]
+    # parse the "k1:v1,k2:v2" custom dialect properly — a substring test
+    # would false-positive on keys/values merely containing "arch:"
+    has_arch = any(
+        part.partition(":")[0].strip() == "arch"
+        for part in str(custom or "").split(",")
+        if ":" in part
+    )
     for cand in nns_config.framework_priority(ext):
-        if registry.exists(registry.KIND_FILTER, cand):
-            return cand
+        if not registry.exists(registry.KIND_FILTER, cand):
+            continue
+        if (
+            cand == "jax-xla"
+            and ext not in ("", ".py", ".msgpack")
+            and not has_arch
+        ):
+            continue
+        return cand
     raise ElementError(
         f"cannot auto-detect a backend for model {model_path!r} (ext {ext!r})"
     )
@@ -232,7 +249,7 @@ class TensorFilter(TransformElement):
         if fw == "auto":
             if not model:
                 raise ElementError(f"{self.name}: framework=auto requires a model")
-            fw = detect_framework(model)
+            fw = detect_framework(model, self.props["custom"])
         try:
             backend_cls = find_backend(fw)
         except KeyError:
@@ -440,9 +457,12 @@ class SingleShot:
     def __init__(self, framework: str = "auto", model: str = "", **props):
         if model:
             model = resolve_model_uri(model)
-        fw = detect_framework(model) if framework == "auto" else framework
-        self.backend: FilterBackend = find_backend(fw)()
         merged = {"custom": "", **props}
+        fw = (
+            detect_framework(model, merged["custom"])
+            if framework == "auto" else framework
+        )
+        self.backend: FilterBackend = find_backend(fw)()
         self.backend.open(model or None, merged)
         self.in_spec, self.out_spec = self.backend.get_model_info()
 

@@ -99,6 +99,10 @@ def build(custom_props=None):
     channels = int(props.get("channels", "1"))
     classes = int(props.get("classes", "12"))
     model = KwsCNN(num_classes=classes, rate=rate, dtype=dtype)
+    if samples < model.n_fft:
+        raise ValueError(
+            f"kws_cnn needs samples >= n_fft ({model.n_fft}); got {samples}"
+        )
     params = host_init(
         model.init,
         int(props.get("seed", "0")),
@@ -110,8 +114,11 @@ def build(custom_props=None):
         single = x.ndim == 2  # (samples, channels) per-frame
         if single:
             x = x[None]
-        # mono mixdown + int16 normalize inside the program
-        x = jnp.mean(x.astype(jnp.float32), axis=-1) / 32768.0
+        # mono mixdown; int PCM normalizes to [-1, 1], float passes as-is
+        is_int = np.issubdtype(np.dtype(x.dtype), np.integer)
+        x = jnp.mean(x.astype(jnp.float32), axis=-1)
+        if is_int:
+            x = x / 32768.0
         out = model.apply(p, x)
         return [out[0] if single else out]
 

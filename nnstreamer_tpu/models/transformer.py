@@ -40,6 +40,10 @@ class TransformerConfig:
     # sequence-parallel attention strategy when the mesh has sp > 1:
     # auto (ulysses when heads divide sp, else ring) | ring | ulysses
     sp_strategy: str = "auto"
+    # single-device attention kernel: xla (fused reference) | flash
+    # (Pallas online-softmax kernel, ops/flash_attention.py; needs
+    # T % 128 == 0 on TPU)
+    attn_impl: str = "xla"
 
 
 class Block(nn.Module):
@@ -65,6 +69,10 @@ class Block(nn.Module):
                 q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
                 strategy=cfg.sp_strategy,
             )
+        elif cfg.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
         else:
             attn = reference_attention(q, k, v, causal=True)
         attn = attn.reshape(B, T, D)
@@ -112,6 +120,7 @@ def _cfg_from_props(props: Dict[str, str]) -> TransformerConfig:
         max_seq=int(props.get("seq", "256")),
         dtype=dt,
         sp_strategy=props.get("sp_strategy", "auto"),
+        attn_impl=props.get("attn", "xla"),
     )
 
 

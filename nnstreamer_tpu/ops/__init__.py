@@ -8,6 +8,7 @@ VPU-friendly) and jit/lax implementations for control-flow-heavy ops
 (batched NMS) — everything falls back to a pure jax.numpy path off-TPU.
 """
 
+from .flash_attention import flash_attention  # noqa: F401
 from .labeling import top1  # noqa: F401
 from .nms import batched_nms  # noqa: F401
 from .preprocess import normalize_u8  # noqa: F401

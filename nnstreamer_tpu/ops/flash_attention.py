@@ -1,0 +1,144 @@
+"""Flash attention as a Pallas TPU kernel (single-device block).
+
+The MXU-native attention inner loop for the transformer family: Q blocks
+stream over K/V blocks with an online softmax, so the (Tq x Tk) score
+matrix never materializes in HBM — scores live in VMEM one block at a
+time, accumulation in f32.  Pattern references: Dao et al. FlashAttention;
+the public jax pallas attention examples (PAPERS.md / SNIPPETS.md).
+
+This is the intra-device complement of the sequence-parallel layers:
+``parallel/ring_attention.py`` shards T across chips and rotates K/V;
+each device's local block product is exactly what this kernel computes.
+
+``flash_attention(q, k, v)`` takes (B, T, H, D) like the rest of the
+stack; on non-TPU platforms it runs the kernel in interpret mode (tests)
+or falls back to the fused-XLA reference implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN in exp-diff
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, seq_len: int, block_q: int):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    q_ref (block_q, D); k_ref/v_ref (T, D) — the whole K/V for this head
+    (T*D*2 bytes must fit VMEM; the wrapper asserts); o_ref (block_q, D).
+    """
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    D = q.shape[-1]
+    n_kv = seq_len // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = lax.dynamic_slice_in_dim(
+            k_ref[:], j * block_k, block_k, axis=0
+        ).astype(jnp.float32)
+        v = lax.dynamic_slice_in_dim(
+            v_ref[:], j * block_k, block_k, axis=0
+        ).astype(jnp.float32)
+        s = q @ k.T  # (block_q, block_k) on the MXU
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; bound the
+        # loop at the q-block's last row (traced upper bound via while)
+        n_kv_eff = lax.min(
+            n_kv, (qi * block_q + block_q + block_k - 1) // block_k
+        )
+    else:
+        n_kv_eff = n_kv
+    m, l, acc = lax.fori_loop(0, n_kv_eff, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+try:  # imported lazily below for environments without pallas
+    from jax.experimental import pallas as pl
+except ImportError:  # pragma: no cover
+    pl = None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _flash_bh(qf, kf, vf, causal: bool, block_q: int, block_k: int,
+              interpret: bool):
+    """(BH, T, D) inputs -> (BH, T, D); grid over (BH, T/block_q)."""
+    BH, T, D = qf.shape
+    scale = 1.0 / (D**0.5)
+    kern = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+        seq_len=T, block_q=block_q,
+    )
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), qf.dtype),
+        grid=(BH, T // block_q),
+        in_specs=[
+            # None squeezes the batch*head dim out of the kernel refs
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Exact attention, (B, T, H, D) -> (B, T, H, D).
+
+    TPU: real Pallas kernel.  Elsewhere: interpret mode when requested
+    (tests), else the fused-XLA reference path (same numerics contract).
+    """
+    B, T, H, D = q.shape
+    platform = jax.default_backend()
+    if interpret is None:
+        interpret = platform != "tpu"
+    if pl is None or (interpret and T > 4096):
+        from ..parallel.ring_attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal).astype(q.dtype)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"flash_attention needs T ({T}) divisible by block_q/block_k "
+            f"({block_q}/{block_k})"
+        )
+    # (B, T, H, D) -> (B*H, T, D): each (batch, head) is one independent
+    # attention problem; kernel VMEM holds one head's K/V
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    out = _flash_bh(qf, kf, vf, causal, block_q, block_k, bool(interpret))
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,71 @@
+"""tflite backend: real .tflite models through the interpreter runtime.
+
+≙ reference ``tests/nnstreamer_filter_tensorflow2_lite/runTest.sh`` —
+skips gracefully when no TFLite runtime is present (SURVEY §4 practice),
+runs a real converted model otherwise.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.tflite_import import TFLiteImportBackend
+from nnstreamer_tpu.elements.filter import SingleShot, detect_framework
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+pytestmark = pytest.mark.skipif(
+    not TFLiteImportBackend.available(), reason="no TFLite runtime in image"
+)
+
+
+@pytest.fixture(scope="module")
+def tflite_model(tmp_path_factory):
+    """A tiny y = 2x + 1 model converted to .tflite."""
+    import tensorflow as tf
+
+    class M(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec((1, 4), tf.float32)])
+        def f(self, x):
+            return {"y": x * 2.0 + 1.0}
+
+    m = M()
+    conv = tf.lite.TFLiteConverter.from_concrete_functions(
+        [m.f.get_concrete_function()], m
+    )
+    path = tmp_path_factory.mktemp("tfl") / "affine.tflite"
+    path.write_bytes(conv.convert())
+    return str(path)
+
+
+class TestTFLiteBackend:
+    def test_pipeline_explicit_framework(self, tflite_model):
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_filter framework=tflite "
+            f"model={tflite_model} ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.full((1, 4), 3.0, np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=60)
+        frames = pipe["out"].frames
+        pipe.stop()
+        np.testing.assert_allclose(
+            np.asarray(frames[0].tensors[0]), np.full((1, 4), 7.0)
+        )
+
+    def test_framework_auto_detects_tflite(self, tflite_model):
+        # no arch: custom prop -> jax-xla cannot load a raw .tflite, so
+        # extension priority falls through to the tflite runtime
+        assert detect_framework(tflite_model) == "tflite"
+
+    def test_single_shot(self, tflite_model):
+        with SingleShot("tflite", tflite_model) as m:
+            (out,) = m.invoke([np.zeros((1, 4), np.float32)])
+            np.testing.assert_allclose(np.asarray(out), np.ones((1, 4)))
+
+    def test_model_info(self, tflite_model):
+        be = TFLiteImportBackend()
+        be.open(tflite_model, {})
+        in_spec, out_spec = be.get_model_info()
+        assert in_spec.tensors[0].shape == (1, 4)
+        assert out_spec.tensors[0].shape == (1, 4)
+        be.close()
