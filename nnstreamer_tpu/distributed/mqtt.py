@@ -232,9 +232,12 @@ class MiniBroker:
         topic, payload, pid = _parse_publish(flags, body)
         if pid is not None:  # QoS 1 in: acknowledge to the publisher
             self._send(sock, bytes([PUBACK << 4, 2]) + struct.pack(">H", pid))
-        if flags & 0x1:  # retain
+        if flags & 0x1:  # retain; empty payload DELETES (MQTT 3.1.1 §3.3.1.3)
             with self._lock:
-                self._retained[topic] = payload
+                if payload:
+                    self._retained[topic] = payload
+                else:
+                    self._retained.pop(topic, None)
         # fan out at QoS 0 (broker-side downgrade; publisher-side QoS 1
         # still guarantees the message reached the broker at least once)
         packet = _publish_packet(topic, payload)

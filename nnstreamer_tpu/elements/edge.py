@@ -9,9 +9,17 @@ NTP-epoch timestamp rebasing for cross-device sync
 
 TPU build: one gRPC broker (distributed/service.py EdgeBroker) covers both
 the direct (edgesink hosts the broker) and brokered (both ends dial a
-third-party broker) layouts.  Timestamp rebasing: the publisher embeds
-``wall_base`` (epoch seconds at pts=0) in frame meta; subscribers rebase
-pts into their local clock domain — the NTP-sync analog.
+third-party broker) layouts.  ``connect-type=hybrid`` reproduces the
+reference's MQTT-hybrid split (control over MQTT + data over TCP for
+throughput, ``CHANGES:8-13``): the sink hosts its data broker and
+announces ``{host, port}`` as a RETAINED MQTT message on
+``nns/edge/<topic>``; sources discover the endpoint from the MQTT broker
+and attach to the gRPC data plane directly — bulk tensors never transit
+MQTT.  AITT (Samsung-internal transport) is out of scope.
+
+Timestamp rebasing: the publisher embeds ``wall_base`` (epoch seconds at
+pts=0) in frame meta; subscribers rebase pts into their local clock
+domain — the NTP-sync analog.
 """
 
 from __future__ import annotations
@@ -31,14 +39,22 @@ from ..distributed.service import (
 from ..pipeline.element import Property, SinkElement, SourceElement, element
 
 
+def _control_topic(topic: str) -> str:
+    return f"nns/edge/{topic}"
+
+
 @element("edgesink")
 class EdgeSink(SinkElement):
     PROPERTIES = {
         "port": Property(int, 0, "broker port (hosted here unless connect-type=client)"),
-        "dest-host": Property(str, "localhost", "remote broker host (client mode)"),
-        "dest-port": Property(int, 0, "remote broker port (client mode)"),
+        "dest-host": Property(str, "localhost", "remote broker host (client/hybrid)"),
+        "dest-port": Property(int, 0, "remote broker port (client: data; hybrid: MQTT)"),
         "topic": Property(str, "nns", "pub/sub topic"),
-        "connect-type": Property(str, "server", "server (host broker) | client"),
+        "connect-type": Property(
+            str, "server", "server (host broker) | client | hybrid "
+            "(announce over MQTT, data over gRPC)"
+        ),
+        "host": Property(str, "127.0.0.1", "hybrid: address announced to subscribers"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
     }
 
@@ -47,21 +63,52 @@ class EdgeSink(SinkElement):
         self._broker = None
         self._pub: Optional[EdgePublisher] = None
         self._wall_base: Optional[float] = None
+        self._mqtt = None
 
     def start(self):
-        if self.props["connect-type"] == "client":
+        mode = self.props["connect-type"]
+        if mode == "client":
             self._pub = EdgePublisher(
                 self.props["dest-host"], self.props["dest-port"], self.props["topic"]
             )
-        else:
-            self._broker = get_edge_broker(self.props["port"])
-            self._broker.start()
-            self.props["port"] = self._broker.port
+            return
+        self._broker = get_edge_broker(self.props["port"])
+        self._broker.start()
+        self.props["port"] = self._broker.port
+        if mode == "hybrid":
+            # control plane: retained announce on the MQTT broker at
+            # dest-host:dest-port; data stays on the local gRPC broker
+            import json
+
+            from ..distributed.mqtt import MqttClient
+
+            self._mqtt = MqttClient(
+                self.props["dest-host"], self.props["dest-port"]
+            )
+            self._mqtt.publish(
+                _control_topic(self.props["topic"]),
+                json.dumps(
+                    {"host": self.props["host"], "port": self._broker.port}
+                ).encode(),
+                retain=True, qos=1,
+            )
 
     def stop(self):
         if self._pub is not None:
             self._pub.close()
             self._pub = None
+        if self._mqtt is not None:
+            try:
+                # clear the retained announce (empty retained payload =
+                # delete, MQTT §3.3.1.3) so later subscribers don't dial
+                # the released data port
+                self._mqtt.publish(
+                    _control_topic(self.props["topic"]), b"", retain=True,
+                )
+            except OSError:
+                pass
+            self._mqtt.close()
+            self._mqtt = None
         if self._broker is not None:
             release_edge_broker(self._broker.port)
             self._broker = None
@@ -81,10 +128,15 @@ class EdgeSink(SinkElement):
 @element("edgesrc")
 class EdgeSrc(SourceElement):
     PROPERTIES = {
-        "dest-host": Property(str, "localhost", "broker/publisher host"),
-        "dest-port": Property(int, 0, "broker/publisher port"),
+        "dest-host": Property(str, "localhost", "broker host (hybrid: MQTT broker)"),
+        "dest-port": Property(int, 0, "broker port (hybrid: MQTT broker)"),
         "topic": Property(str, "nns", "pub/sub topic"),
         "caps": Property(str, "", "announced schema"),
+        "connect-type": Property(
+            str, "direct", "direct (dial the data broker) | hybrid "
+            "(discover the data endpoint over MQTT)"
+        ),
+        "discovery-timeout": Property(float, 10.0, "hybrid: seconds to wait for the announce"),
         "rebase-pts": Property(bool, True, "rebase pts into the local clock"),
     }
 
@@ -92,10 +144,41 @@ class EdgeSrc(SourceElement):
         super().__init__(name)
         self._sub: Optional[EdgeSubscriber] = None
 
+    def _discover(self) -> tuple:
+        """Hybrid control plane: read the retained announce from MQTT."""
+        import json
+        import queue as q
+
+        from ..distributed.mqtt import MqttClient
+        from ..pipeline.element import ElementError
+
+        got: "q.Queue[bytes]" = q.Queue(1)
+        client = MqttClient(self.props["dest-host"], self.props["dest-port"])
+        try:
+            client.subscribe(
+                _control_topic(self.props["topic"]),
+                # empty payload = retained-announce deletion, not an offer
+                lambda t, p: got.put_nowait(p) if p else None,
+            )
+            try:
+                payload = got.get(timeout=self.props["discovery-timeout"])
+            except q.Empty:
+                raise ElementError(
+                    f"{self.name}: no edge announce for topic "
+                    f"{self.props['topic']!r} within "
+                    f"{self.props['discovery-timeout']}s"
+                ) from None
+        finally:
+            client.close()
+        info = json.loads(payload)
+        return info["host"], int(info["port"])
+
     def start(self):
-        self._sub = EdgeSubscriber(
-            self.props["dest-host"], self.props["dest-port"], self.props["topic"]
-        )
+        if self.props["connect-type"] == "hybrid":
+            host, port = self._discover()
+        else:
+            host, port = self.props["dest-host"], self.props["dest-port"]
+        self._sub = EdgeSubscriber(host, port, self.props["topic"])
 
     def stop(self):
         if self._sub is not None:

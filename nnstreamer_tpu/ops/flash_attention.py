@@ -32,7 +32,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     q_ref (block_q, D); k_ref/v_ref (T, D) — the whole K/V for this head
-    (T*D*2 bytes must fit VMEM; the wrapper asserts); o_ref (block_q, D).
+    (the wrapper budget-checks VMEM and falls back to the XLA reference
+    path when a head's K/V would not fit); o_ref (block_q, D).
     """
     qi = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
@@ -122,9 +123,23 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     """
     B, T, H, D = q.shape
     platform = jax.default_backend()
+    # interpret mode is for TESTS only (explicitly requested): it executes
+    # the kernel block-by-block in the interpreter, orders of magnitude
+    # slower than XLA.  Off-TPU without an explicit request -> reference.
     if interpret is None:
-        interpret = platform != "tpu"
-    if pl is None or (interpret and T > 4096):
+        interpret = False
+    # VMEM budget: the kernel holds one head's full K/V plus the q block
+    # and f32 accumulators; past ~3/4 of the ~16 MB VMEM, fall back to the
+    # reference path instead of an opaque Mosaic overflow
+    itemsize = jnp.dtype(q.dtype).itemsize
+    vmem_est = (2 * T * D) * itemsize + block_q * D * (itemsize + 4) \
+        + block_q * block_k * 4
+    if (
+        pl is None
+        or (platform != "tpu" and not interpret)
+        or vmem_est > 12 * 1024 * 1024
+        or (interpret and T > 4096)
+    ):
         from ..parallel.ring_attention import reference_attention
 
         return reference_attention(q, k, v, causal=causal).astype(q.dtype)

@@ -232,7 +232,18 @@ def make_pipeline_train_step(
         for k, v in params.items()
     }
     tx = optax.adamw(learning_rate)
-    opt_state = tx.init(params)
+    # optimizer moments propagate the param shardings; leaves with NO
+    # param dependence (adam's step count) come out single-device, so pin
+    # every non-mesh leaf replicated over the mesh — a mixed placement
+    # breaks later jitted steps and checkpoint-restore templates
+    opt_state = jax.jit(tx.init)(params)
+    _rep = jax.sharding.NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda a: a if isinstance(
+            getattr(a, "sharding", None), jax.sharding.NamedSharding
+        ) else jax.device_put(a, _rep),
+        opt_state,
+    )
     data_sh = NamedSharding(mesh, P("dp", "sp"))
     stage_fn = _make_stage_fn(cfg, mesh)
     M = cfg.n_microbatches
@@ -379,3 +390,43 @@ def reference_loss(params, tokens, cfg: PipelineConfig) -> jnp.ndarray:
     ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
     msk = jnp.ones_like(ll).at[:, -1].set(0.0)
     return -(ll * msk).sum() / msk.sum()
+
+
+# ---------------------------------------------------------------------------
+# Elastic checkpointing: preemptible-TPU recovery for the 5-axis train step
+# (SURVEY §5.3/§5.4 — the reference checkpoints only the trainer element;
+# sharded multi-chip training state is net-new).  Orbax persists each
+# jax.Array with its sharding; restoring against a sharded template puts
+# every shard back on its mesh position, so a resumed run is bit-identical
+# to an uninterrupted one (tests/test_pipeline_parallel.py asserts this).
+# ---------------------------------------------------------------------------
+def save_train_state(path: str, step: int, params, opt_state) -> str:
+    """Persist (params, opt_state) as checkpoint `step` under `path`."""
+    from ..core.checkpoint import save_state
+
+    return save_state(path, step, {"params": params, "opt_state": opt_state})
+
+
+def restore_train_state(path: str, step: int, params_template, opt_template):
+    """-> (params, opt_state) restored onto the templates' shardings."""
+    from ..core.checkpoint import restore_state
+
+    state = restore_state(
+        path, step, {"params": params_template, "opt_state": opt_template}
+    )
+
+    def _resharded(tmpl_tree, got_tree):
+        # orbax can restore scalar/replicated leaves onto a single device;
+        # re-commit every leaf to its template's mesh sharding so the next
+        # jitted step sees a consistent placement
+        def one(got, tmpl):
+            if hasattr(tmpl, "sharding") and hasattr(got, "shape"):
+                return jax.device_put(got, tmpl.sharding)
+            return got
+
+        return jax.tree.map(one, got_tree, tmpl_tree)
+
+    return (
+        _resharded(params_template, state["params"]),
+        _resharded(opt_template, state["opt_state"]),
+    )

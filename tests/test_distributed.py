@@ -169,3 +169,121 @@ class TestEdgePubSub:
             sink_pipe["src"].end_of_stream()
             sink_pipe.wait(timeout=10)
             sink_pipe.stop()
+
+
+class TestEdgeHybrid:
+    """MQTT-hybrid connect type: discovery over MQTT, data over gRPC
+    (reference CHANGES:8-13 — control/data channel split for throughput)."""
+
+    def test_hybrid_discovery_and_stream(self):
+        import numpy as np
+
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        mqtt = MiniBroker()
+        tx = parse_pipeline(
+            f"appsrc name=src ! edgesink topic=hy connect-type=hybrid "
+            f"dest-host=127.0.0.1 dest-port={mqtt.port} port=0"
+        )
+        tx.start()
+        rx = parse_pipeline(
+            f"edgesrc topic=hy connect-type=hybrid dest-host=127.0.0.1 "
+            f"dest-port={mqtt.port} ! tensor_sink name=out"
+        )
+        rx.start()
+        try:
+            import time as _t
+
+            _t.sleep(0.3)  # let the subscription attach to the data broker
+            for i in range(3):
+                tx["src"].push(np.int32([i]), pts=float(i))
+            deadline = _t.time() + 10
+            while len(rx["out"].frames) < 3 and _t.time() < deadline:
+                _t.sleep(0.05)
+            vals = [int(np.asarray(f.tensors[0])[0]) for f in rx["out"].frames]
+            assert vals == [0, 1, 2]
+        finally:
+            rx.stop()
+            tx["src"].end_of_stream()
+            tx.wait(timeout=10)
+            tx.stop()
+            mqtt.close()
+
+    def test_hybrid_discovery_timeout(self):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        mqtt = MiniBroker()  # nobody announces on this broker
+        rx = parse_pipeline(
+            f"edgesrc topic=ghost connect-type=hybrid dest-host=127.0.0.1 "
+            f"dest-port={mqtt.port} discovery-timeout=0.5 ! tensor_sink name=out"
+        )
+        try:
+            with pytest.raises(Exception, match="no edge announce"):
+                rx.start()
+        finally:
+            rx.stop()
+            mqtt.close()
+
+    def test_late_subscriber_gets_retained_announce(self):
+        """The announce is retained: a source starting AFTER the sink still
+        discovers the endpoint."""
+        import numpy as np
+
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        mqtt = MiniBroker()
+        tx = parse_pipeline(
+            f"appsrc name=src ! edgesink topic=late connect-type=hybrid "
+            f"dest-host=127.0.0.1 dest-port={mqtt.port}"
+        )
+        tx.start()
+        import time as _t
+
+        _t.sleep(0.5)  # announce long since published and retained
+        rx = parse_pipeline(
+            f"edgesrc topic=late connect-type=hybrid dest-host=127.0.0.1 "
+            f"dest-port={mqtt.port} ! tensor_sink name=out"
+        )
+        rx.start()
+        try:
+            _t.sleep(0.3)
+            tx["src"].push(np.float32([7.0]))
+            deadline = _t.time() + 10
+            while not rx["out"].frames and _t.time() < deadline:
+                _t.sleep(0.05)
+            assert rx["out"].frames
+        finally:
+            rx.stop()
+            tx["src"].end_of_stream()
+            tx.wait(timeout=10)
+            tx.stop()
+            mqtt.close()
+
+    def test_stopped_sink_clears_retained_announce(self):
+        """A stopped hybrid sink deletes its retained announce, so later
+        sources time out cleanly instead of dialing the dead port."""
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        mqtt = MiniBroker()
+        tx = parse_pipeline(
+            f"appsrc name=src ! edgesink topic=gone connect-type=hybrid "
+            f"dest-host=127.0.0.1 dest-port={mqtt.port}"
+        )
+        tx.start()
+        import time as _t
+
+        _t.sleep(0.3)
+        tx["src"].end_of_stream()
+        tx.wait(timeout=10)
+        tx.stop()
+        _t.sleep(0.3)
+        rx = parse_pipeline(
+            f"edgesrc topic=gone connect-type=hybrid dest-host=127.0.0.1 "
+            f"dest-port={mqtt.port} discovery-timeout=0.6 ! tensor_sink name=out"
+        )
+        try:
+            with pytest.raises(Exception, match="no edge announce"):
+                rx.start()
+        finally:
+            rx.stop()
+            mqtt.close()

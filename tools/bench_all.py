@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Run every BASELINE.md bench row (plus the host-sourced headline variant)
+and collect the JSON lines into one artifact.
+
+Usage: python tools/bench_all.py [out.json]
+Honors the same env knobs as bench.py (BENCH_DEADLINE etc.).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (BENCH_MODEL, extra env) — mobilenet runs device- AND host-sourced so the
+# headline number is published alongside its transfer-inclusive variant
+ROWS = [
+    ("mobilenet", {}),
+    ("mobilenet", {"BENCH_HOST": "1"}),
+    ("ssd", {}),
+    ("yolov5", {}),
+    ("posenet", {}),
+    ("mnist_trainer", {}),
+]
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_ROWS.json"
+    results = []
+    for model, extra in ROWS:
+        env = {**os.environ, "BENCH_MODEL": model, **extra}
+        print(f"[bench_all] {model} {extra or ''}...", flush=True)
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            capture_output=True, text=True, env=env,
+        )
+        row = None
+        for line in reversed(r.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    row = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if row is None:
+            row = {
+                "metric": model, "value": None, "unit": None,
+                "vs_baseline": None,
+                "error": f"no JSON line (rc={r.returncode})",
+            }
+        print(f"[bench_all]   -> {json.dumps(row)}", flush=True)
+        results.append(row)
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"[bench_all] wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
