@@ -101,10 +101,15 @@ class EdgeSink(SinkElement):
             try:
                 # clear the retained announce (empty retained payload =
                 # delete, MQTT §3.3.1.3) so later subscribers don't dial
-                # the released data port
+                # the released data port; QoS 1 + bounded drain so the
+                # delete actually reaches the broker before we hang up
                 self._mqtt.publish(
-                    _control_topic(self.props["topic"]), b"", retain=True,
+                    _control_topic(self.props["topic"]), b"",
+                    retain=True, qos=1,
                 )
+                deadline = time.monotonic() + 3.0
+                while self._mqtt.unacked() and time.monotonic() < deadline:
+                    time.sleep(0.02)
             except OSError:
                 pass
             self._mqtt.close()
