@@ -464,6 +464,14 @@ class MqttClient:
         with self._pending_lock:
             return len(self._pending)
 
+    def drain(self, timeout_s: float = 5.0) -> int:
+        """Wait up to `timeout_s` for all QoS-1 publishes to be PUBACKed;
+        returns how many remain unacknowledged (0 = clean)."""
+        deadline = time.monotonic() + timeout_s
+        while self.unacked() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        return self.unacked()
+
     def _send_subscribe(self, pattern: str) -> None:
         var = (
             struct.pack(">H", self._next_pid()) + _mqtt_str(pattern)

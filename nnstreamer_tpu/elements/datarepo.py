@@ -136,6 +136,26 @@ class DataRepoSrc(SourceElement):
             specs = [specs[i] for i in seq]
         return StreamSpec(tuple(specs), FORMAT_STATIC)
 
+    def _open_reader(self):
+        """Native mmap reader when the core is built (one memcpy per
+        sample, GIL released, next-sample prefetch — ≙ the reference's C
+        reader in gstdatareposrc.c); Python seek/read fallback otherwise.
+
+        Returns (read(idx) -> uint8 view, prefetch(idx), close())."""
+        try:
+            from ..native.runtime import SampleReader
+
+            r = SampleReader(self.props["location"], self._sample_size)
+            return r.read, r.prefetch, r.close
+        except (RuntimeError, OSError):
+            f = open(self.props["location"], "rb")
+
+            def read(idx: int):
+                f.seek(int(idx) * self._sample_size)
+                return np.frombuffer(f.read(self._sample_size), np.uint8)
+
+            return read, lambda idx: None, f.close
+
     def frames(self) -> Iterator[TensorFrame]:
         start = self.props["start-sample-index"]
         stop = self.props["stop-sample-index"]
@@ -144,24 +164,25 @@ class DataRepoSrc(SourceElement):
             raise ElementError(f"{self.name}: empty sample range [{start}, {stop}]")
         indices = np.arange(start, stop + 1)
         seq = self._sequence()
-        with open(self.props["location"], "rb") as f:
+        read, prefetch, close = self._open_reader()
+        try:
             for epoch in range(max(1, self.props["epochs"])):
                 order = indices
                 if self.props["is-shuffle"]:
                     rng = np.random.default_rng(self.props["shuffle-seed"] + epoch)
                     order = rng.permutation(indices)
-                for idx in order:
+                for i, idx in enumerate(order):
                     if self._pipeline is not None and self._pipeline._stop_flag.is_set():
                         return
-                    f.seek(int(idx) * self._sample_size)
-                    raw = f.read(self._sample_size)
+                    raw = read(int(idx))
+                    if i + 1 < len(order):
+                        prefetch(int(order[i + 1]))
                     tensors = []
                     off = 0
                     for spec in self._specs:
                         n = spec.nbytes
                         tensors.append(
-                            np.frombuffer(raw[off : off + n], dtype=spec.dtype)
-                            .reshape(spec.shape)
+                            raw[off : off + n].view(spec.dtype).reshape(spec.shape)
                         )
                         off += n
                     if seq:
@@ -170,3 +191,5 @@ class DataRepoSrc(SourceElement):
                     frame.meta["sample_index"] = int(idx)
                     frame.meta["epoch"] = epoch
                     yield frame
+        finally:
+            close()

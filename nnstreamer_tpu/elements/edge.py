@@ -107,9 +107,12 @@ class EdgeSink(SinkElement):
                     _control_topic(self.props["topic"]), b"",
                     retain=True, qos=1,
                 )
-                deadline = time.monotonic() + 3.0
-                while self._mqtt.unacked() and time.monotonic() < deadline:
-                    time.sleep(0.02)
+                left = self._mqtt.drain(5.0)
+                if left:
+                    self.log.warning(
+                        "retained-announce delete unacknowledged; a stale "
+                        "endpoint may remain on the MQTT broker"
+                    )
             except OSError:
                 pass
             self._mqtt.close()

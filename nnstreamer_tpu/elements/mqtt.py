@@ -80,16 +80,11 @@ class MqttSink(SinkElement):
         self._base_epoch = time.time()
         self._sent = 0
 
-    _DRAIN_S = 5.0  # bounded unacked-drain window at stop
-
     def stop(self) -> None:
         if self._client is not None:
             # at-least-once: give parked QoS-1 publishes a bounded window
             # to reach the broker before tearing the client down
-            deadline = time.monotonic() + self._DRAIN_S
-            while self._client.unacked() and time.monotonic() < deadline:
-                time.sleep(0.05)
-            left = self._client.unacked()
+            left = self._client.drain(5.0)
             if left:
                 self.log.warning(
                     "stopping with %d unacknowledged QoS-1 publish(es)", left

@@ -25,6 +25,11 @@
 #include <mutex>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 extern "C" {
 
 /* ------------------------------------------------------------------ *
@@ -214,6 +219,86 @@ void nns_pool_destroy (void *h)
   for (void *b : p->free_blocks)
     free (b);
   delete p;
+}
+
+/* ------------------------------------------------------------------ *
+ * mmap sample reader — the datarepo data loader                       *
+ *                                                                     *
+ * Reference analog: gstdatareposrc.c reads training samples in C      *
+ * (read()/seek per sample).  Here: the whole repo file is mapped      *
+ * once; a sample read is one memcpy out of the page cache with the    *
+ * GIL released (ctypes call), and nns_reader_prefetch() madvises the  *
+ * next sample so shuffled epochs stream at page-cache speed.          *
+ * ------------------------------------------------------------------ */
+
+struct NnsReader {
+  uint8_t *base = nullptr;
+  uint64_t file_size = 0;
+  uint64_t sample_size = 0;
+  int fd = -1;
+};
+
+void *nns_reader_open (const char *path, uint64_t sample_size)
+{
+  if (sample_size == 0)
+    return nullptr;
+  int fd = ::open (path, O_RDONLY);
+  if (fd < 0)
+    return nullptr;
+  struct stat st;
+  if (fstat (fd, &st) != 0 || st.st_size <= 0) {
+    ::close (fd);
+    return nullptr;
+  }
+  void *base = mmap (nullptr, (size_t) st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close (fd);
+    return nullptr;
+  }
+  madvise (base, (size_t) st.st_size, MADV_WILLNEED);
+  auto *r = new NnsReader ();
+  r->base = static_cast<uint8_t *> (base);
+  r->file_size = (uint64_t) st.st_size;
+  r->sample_size = sample_size;
+  r->fd = fd;
+  return r;
+}
+
+uint64_t nns_reader_total (void *h)
+{
+  auto *r = static_cast<NnsReader *> (h);
+  return r->file_size / r->sample_size;
+}
+
+/* copy sample `index` into out (caller allocates sample_size bytes);
+ * 0 = ok, -1 = out of range.  Bounds-check BEFORE the multiply: a huge
+ * index (e.g. (uint64_t)-1 from a negative Python int) would overflow
+ * `index * sample_size` and wrap past the `off + size > file_size` test. */
+int nns_reader_read (void *h, uint64_t index, uint8_t *out)
+{
+  auto *r = static_cast<NnsReader *> (h);
+  if (index >= r->file_size / r->sample_size)
+    return -1;
+  memcpy (out, r->base + index * r->sample_size, r->sample_size);
+  return 0;
+}
+
+void nns_reader_prefetch (void *h, uint64_t index)
+{
+  auto *r = static_cast<NnsReader *> (h);
+  if (index >= r->file_size / r->sample_size)
+    return;
+  madvise (r->base + index * r->sample_size, r->sample_size, MADV_WILLNEED);
+}
+
+void nns_reader_close (void *h)
+{
+  auto *r = static_cast<NnsReader *> (h);
+  if (r->base)
+    munmap (r->base, r->file_size);
+  if (r->fd >= 0)
+    ::close (r->fd);
+  delete r;
 }
 
 } /* extern "C" */

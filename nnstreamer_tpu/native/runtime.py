@@ -127,6 +127,16 @@ def _load(block: bool = False) -> Optional[ctypes.CDLL]:
         lib.nns_pool_outstanding.restype = ctypes.c_size_t
         lib.nns_pool_outstanding.argtypes = [ctypes.c_void_p]
         lib.nns_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.nns_reader_open.restype = ctypes.c_void_p
+        lib.nns_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.nns_reader_total.restype = ctypes.c_uint64
+        lib.nns_reader_total.argtypes = [ctypes.c_void_p]
+        lib.nns_reader_read.restype = ctypes.c_int
+        lib.nns_reader_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+        ]
+        lib.nns_reader_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.nns_reader_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         log.info("native core loaded: %s", so)
         return _lib
@@ -265,5 +275,58 @@ class BufferPool:
     def __del__(self):  # pragma: no cover
         try:
             self.destroy()
+        except Exception:
+            pass
+
+
+class SampleReader:
+    """mmap-backed fixed-size sample reader — the native datarepo loader.
+
+    ≙ the reference's C data reader (gstdatareposrc.c): the repo file is
+    mapped once; ``read(i)`` is a single memcpy out of the page cache with
+    the GIL released, and ``prefetch(i)`` madvises the next sample so
+    shuffled epochs stream without per-sample seek/read syscalls.
+    """
+
+    def __init__(self, path: str, sample_size: int):
+        import numpy as np
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._np = np
+        self._h = lib.nns_reader_open(path.encode(), sample_size)
+        if not self._h:
+            raise OSError(f"cannot map {path!r} (empty or unreadable)")
+        self.sample_size = sample_size
+        self.total = int(lib.nns_reader_total(self._h))
+
+    def read(self, index: int):
+        """-> uint8 numpy array holding sample `index`."""
+        # validate here too (a negative int becomes 2^64-1 through ctypes;
+        # the C side also rejects, but never hand it a bad index)
+        if not 0 <= int(index) < self.total:
+            raise IndexError(f"sample {index} out of range (total {self.total})")
+        out = self._np.empty(self.sample_size, self._np.uint8)
+        rc = self._lib.nns_reader_read(
+            self._h, int(index), out.ctypes.data_as(ctypes.c_void_p)
+        )
+        if rc != 0:
+            raise IndexError(f"sample {index} out of range (total {self.total})")
+        return out
+
+    def prefetch(self, index: int) -> None:
+        if self._h and 0 <= index < self.total:
+            self._lib.nns_reader_prefetch(self._h, int(index))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nns_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover — GC order dependent
+        try:
+            self.close()
         except Exception:
             pass

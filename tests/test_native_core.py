@@ -167,3 +167,36 @@ class TestPipelineUsesNative:
         frames = pipe["out"].frames
         assert len(frames) == 16
         assert float(frames[5].tensors[0][0]) == 10.0
+
+
+class TestSampleReader:
+    def test_reads_match_python_path(self, tmp_path):
+        import numpy as np
+
+        from nnstreamer_tpu.native.runtime import SampleReader, available
+
+        if not available(block=True):
+            pytest.skip("native core not buildable")
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 255, (10, 64), np.uint8)
+        path = tmp_path / "samples.bin"
+        path.write_bytes(data.tobytes())
+        r = SampleReader(str(path), 64)
+        assert r.total == 10
+        for i in (0, 3, 9):
+            np.testing.assert_array_equal(r.read(i), data[i])
+        r.prefetch(5)  # advisory; must not fail
+        with pytest.raises(IndexError):
+            r.read(10)
+        with pytest.raises(IndexError):
+            r.read(-1)  # would wrap to 2^64-1 through ctypes (SIGSEGV bug)
+        r.prefetch(-1)  # clamped, must not crash
+        r.close()
+
+    def test_open_missing_file(self):
+        from nnstreamer_tpu.native.runtime import SampleReader, available
+
+        if not available(block=True):
+            pytest.skip("native core not buildable")
+        with pytest.raises(OSError):
+            SampleReader("/nonexistent/x.bin", 8)
