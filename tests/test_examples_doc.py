@@ -1,0 +1,46 @@
+"""Documentation/examples.md stays honest: every nnstreamer_tpu pipeline
+in it must parse (reference gst-launch blocks are skipped)."""
+
+import os
+import re
+
+import pytest
+
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "Documentation", "examples.md",
+)
+
+
+def _our_pipelines():
+    text = open(DOC).read()
+    out = []
+    for block in re.findall(r"```\n(.*?)```", text, re.S):
+        if "gst-launch-1.0" in block:
+            continue  # reference side of the comparison
+        # strip comments, join backslash continuations
+        block = re.sub(r"^#.*$", "", block, flags=re.M)
+        block = block.replace("\\\n", " ")
+        for line in block.splitlines():
+            line = line.strip()
+            # "..." marks elided fragments in the prose, not runnable text
+            if line and "!" in line and "..." not in line:
+                out.append(line)
+    return out
+
+
+PIPELINES = _our_pipelines()
+
+
+def test_doc_has_pipelines():
+    assert len(PIPELINES) >= 8
+
+
+@pytest.mark.parametrize("text", PIPELINES)
+def test_pipeline_parses(text):
+    # parse only (files referenced by the docs don't exist here); parser
+    # errors = the doc drifted from the element/property registry
+    pipe = parse_pipeline(text)
+    assert pipe.elements
