@@ -331,9 +331,12 @@ def main() -> None:
     }
 
     if not force_cpu:
+        # worst case ~4.5 min (2 x 120s + backoff): the fail-soft JSON row
+        # must land well inside the driver's own kill window — a healthy
+        # tunnel probes in 10-30s, so 120s also covers "slow but alive"
         err = probe_backend(
-            tries=int(os.environ.get("BENCH_PROBE_TRIES", "3")),
-            timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "180")),
+            tries=int(os.environ.get("BENCH_PROBE_TRIES", "2")),
+            timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
         )
         if err:
             emit({
