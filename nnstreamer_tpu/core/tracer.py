@@ -1,0 +1,166 @@
+"""Pipeline-wide tracing: the GstShark-analog observability layer.
+
+The reference delegates pipeline profiling to GStreamer ecosystem tracers —
+GstShark's proctime / interlatency / framerate / queuelevel / bitrate
+hooks (SURVEY §5.1, ``tools/tracing/README.md`` in the reference) — plus
+per-filter latency/throughput props.  Here the same five measurements are
+a built-in: the pipeline calls ``frame_in``/``frame_out`` around every
+element's processing when a tracer is attached (one ``is not None`` test
+per frame when disabled).
+
+Measurements per element:
+  * **proctime** — wall time inside the element's handler (µs; avg/p50/p99
+    over a bounded ring).
+  * **framerate** — logical frames/sec out of the element (micro-batches
+    count as their batch size).
+  * **interlatency** — source-to-here latency: elements see the wall-clock
+    stamp the tracer put on the frame when it left its source.
+  * **queuelevel** — mailbox depth sampled at dequeue (backpressure view).
+  * **bitrate** — payload bytes/sec through the element.
+
+``report()`` returns plain dicts; ``summary_lines()`` renders the
+gst-shark-style table.  For device-level detail this composes with the
+XLA profiler (``core/profiler.py`` — tensor_filter ``trace`` prop).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+META_SRC_TS = "_nns_trace_src_ts"  # wall stamp set when a frame leaves a source
+
+
+class _ElementStats:
+    __slots__ = (
+        "frames", "calls", "proc_ring", "t_first", "t_last",
+        "inter_sum", "inter_max", "inter_n", "bytes", "q_sum", "q_max",
+        "q_n", "q_cap",
+    )
+
+    def __init__(self) -> None:
+        self.frames = 0
+        self.calls = 0
+        self.proc_ring: deque = deque(maxlen=1024)  # seconds per call
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.inter_sum = 0.0
+        self.inter_max = 0.0
+        self.inter_n = 0
+        self.bytes = 0
+        self.q_sum = 0
+        self.q_max = 0
+        self.q_n = 0
+        self.q_cap = 0
+
+
+class PipelineTracer:
+    """Attach via ``Pipeline(..., tracer=PipelineTracer())`` or
+    ``pipeline.enable_tracing()``; read ``report()`` any time (thread-safe,
+    including while the pipeline runs)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, _ElementStats] = {}
+        self._lock = threading.Lock()
+        self.t_started = time.perf_counter()
+
+    # -- hot-path hooks (called from element worker threads) ---------------
+    def stamp_source(self, frame) -> None:
+        """Stamp a frame leaving a source element (interlatency origin)."""
+        frame.meta.setdefault(META_SRC_TS, time.perf_counter())
+
+    def queue_level(self, name: str, depth: int, cap: int) -> None:
+        st = self._get(name)
+        st.q_sum += depth
+        st.q_n += 1
+        st.q_cap = cap
+        if depth > st.q_max:
+            st.q_max = depth
+
+    def frame_out(
+        self, name: str, t_in: float, t_out: float,
+        nframes: int, nbytes: int, src_ts: Optional[float],
+    ) -> None:
+        st = self._get(name)
+        st.calls += 1
+        st.frames += nframes
+        st.proc_ring.append(t_out - t_in)
+        if st.t_first is None:
+            st.t_first = t_out
+        st.t_last = t_out
+        st.bytes += nbytes
+        if src_ts is not None:
+            lat = t_out - src_ts
+            st.inter_sum += lat
+            st.inter_n += 1
+            if lat > st.inter_max:
+                st.inter_max = lat
+
+    def _get(self, name: str) -> _ElementStats:
+        st = self._stats.get(name)
+        if st is None:
+            with self._lock:
+                st = self._stats.setdefault(name, _ElementStats())
+        return st
+
+    # -- reporting ----------------------------------------------------------
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, st in list(self._stats.items()):
+            ring = list(st.proc_ring)
+            span = (
+                (st.t_last - st.t_first)
+                if st.t_first is not None and st.t_last != st.t_first
+                else 0.0
+            )
+            proc = np.asarray(ring) if ring else np.zeros(1)
+            out[name] = {
+                "frames": st.frames,
+                "calls": st.calls,
+                "proctime_us_avg": float(proc.mean()) * 1e6,
+                "proctime_us_p50": float(np.percentile(proc, 50)) * 1e6,
+                "proctime_us_p99": float(np.percentile(proc, 99)) * 1e6,
+                "framerate_fps": (st.frames / span) if span else 0.0,
+                "interlatency_ms_avg": (
+                    st.inter_sum / st.inter_n * 1e3 if st.inter_n else None
+                ),
+                "interlatency_ms_max": (
+                    st.inter_max * 1e3 if st.inter_n else None
+                ),
+                "bitrate_mbps": (st.bytes * 8 / 1e6 / span) if span else 0.0,
+                "queuelevel_avg": (st.q_sum / st.q_n) if st.q_n else 0.0,
+                "queuelevel_max": st.q_max,
+                "queue_capacity": st.q_cap,
+            }
+        return out
+
+    def summary_lines(self) -> List[str]:
+        rows = self.report()
+        lines = [
+            f"{'element':<20} {'frames':>8} {'fps':>9} {'proc µs':>9} "
+            f"{'p99 µs':>9} {'inter ms':>9} {'Mb/s':>8} {'queue':>7}"
+        ]
+        for name, r in rows.items():
+            inter = (
+                f"{r['interlatency_ms_avg']:.2f}"
+                if r["interlatency_ms_avg"] is not None else "-"
+            )
+            lines.append(
+                f"{name:<20} {r['frames']:>8} {r['framerate_fps']:>9.1f} "
+                f"{r['proctime_us_avg']:>9.1f} {r['proctime_us_p99']:>9.1f} "
+                f"{inter:>9} {r['bitrate_mbps']:>8.2f} "
+                f"{r['queuelevel_avg']:>4.1f}/{r['queue_capacity']}"
+            )
+        return lines
+
+
+def frame_nbytes(item) -> int:
+    """Payload size of a frame (host or device tensors)."""
+    try:
+        return sum(int(getattr(t, "nbytes", 0)) for t in item.tensors)
+    except Exception:
+        return 0

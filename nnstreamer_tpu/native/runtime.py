@@ -158,6 +158,7 @@ class NativeMailbox:
             raise RuntimeError("native core unavailable")
         self._lib = lib
         self._h = lib.nns_oq_create(max(0, maxsize))
+        self._maxsize = max(0, maxsize)
         self._closed = False
 
     # -- stdlib-compatible subset -------------------------------------------
@@ -207,7 +208,7 @@ class NativeMailbox:
 
     @property
     def maxsize(self) -> int:  # parity with queue.Queue introspection
-        return 0
+        return self._maxsize
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:

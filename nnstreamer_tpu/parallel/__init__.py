@@ -9,3 +9,4 @@ from .mesh import DP, EP, FSDP, PP, SP, TP, default_mesh, make_mesh, mesh_axis_s
 from .ring_attention import reference_attention, ring_attention  # noqa: F401
 from .ulysses import sequence_attention, ulysses_attention  # noqa: F401
 from .sharding import batch_sharding, replicated, shard_params, spec_for_path, transformer_rules  # noqa: F401
+from . import multihost  # noqa: F401

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ..core.buffer import EOS, CapsEvent, CustomEvent, Event, Flush, TensorFrame
 from ..core.log import get_logger
+from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
 from .element import Element, ElementError, SinkElement, SourceElement
 
 _STOP = object()  # out-of-band worker shutdown sentinel
@@ -43,7 +44,12 @@ class BusMessage:
 class Pipeline:
     """A running graph of elements."""
 
-    def __init__(self, name: str = "pipeline", default_queue_size: int = 16):
+    def __init__(
+        self,
+        name: str = "pipeline",
+        default_queue_size: int = 16,
+        tracer=None,
+    ):
         self.name = name
         self.log = get_logger(name)
         self.elements: Dict[str, Element] = {}
@@ -57,6 +63,13 @@ class Pipeline:
         self._sinks_done = threading.Event()
         self._pending_sinks = 0
         self._sink_lock = threading.Lock()
+        # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
+        self.tracer = tracer
+
+    def enable_tracing(self) -> PipelineTracer:
+        """Attach a fresh PipelineTracer (before start()); returns it."""
+        self.tracer = PipelineTracer()
+        return self.tracer
 
     # -- construction -------------------------------------------------------
     def add(self, *elements: Element) -> Element:
@@ -310,6 +323,8 @@ class Pipeline:
                     for sp, ev in outs:
                         self._push(el, sp, ev)
                     continue
+                if self.tracer is not None:
+                    self.tracer.stamp_source(frame)
                 if not self._push(el, 0, frame):
                     return
             for i in range(len(el.srcpads)):
@@ -352,6 +367,15 @@ class Pipeline:
                         continue
                 if item is _STOP:
                     return
+                tracer = self.tracer
+                if tracer is not None and hasattr(el._mailbox, "qsize"):
+                    try:
+                        tracer.queue_level(
+                            el.name, el._mailbox.qsize(),
+                            getattr(el._mailbox, "maxsize", 0),
+                        )
+                    except Exception:
+                        pass
                 if isinstance(item, TensorFrame):
                     # micro-batching: batch-capable elements drain extra
                     # queued frames and process them in one call (the TPU
@@ -379,9 +403,32 @@ class Pipeline:
                             else:
                                 stash = (p2, nxt)  # event/other-pad: after batch
                                 break
+                        t_in = (
+                            time.perf_counter() if tracer is not None else 0.0
+                        )
                         outs = el.handle_frame_batch(pad, frames) or []
+                        if tracer is not None:
+                            tracer.frame_out(
+                                el.name, t_in, time.perf_counter(),
+                                sum(
+                                    getattr(f, "batch_size", 1)
+                                    for f in frames
+                                ),
+                                sum(frame_nbytes(f) for f in frames),
+                                frames[0].meta.get(META_SRC_TS),
+                            )
                     else:
+                        t_in = (
+                            time.perf_counter() if tracer is not None else 0.0
+                        )
                         outs = el.handle_frame(pad, item) or []
+                        if tracer is not None:
+                            tracer.frame_out(
+                                el.name, t_in, time.perf_counter(),
+                                getattr(item, "batch_size", 1),
+                                frame_nbytes(item),
+                                item.meta.get(META_SRC_TS),
+                            )
                     for sp, out in outs:
                         if not self._push(el, sp, out):
                             return

@@ -1,0 +1,66 @@
+"""Pipeline tracer: the GstShark-analog proctime/interlatency/framerate/
+queuelevel/bitrate measurements (SURVEY §5.1; reference delegates these to
+GstShark tracer hooks, ``tools/tracing/README.md``)."""
+
+import numpy as np
+
+from nnstreamer_tpu.core.tracer import PipelineTracer
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+def _run_traced(n_frames=32):
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        "tensor_transform mode=arithmetic option=add:1.0 ! "
+        "tensor_sink name=out max-stored=64",
+        name="traced",
+    )
+    tracer = pipe.enable_tracing()
+    pipe.start()
+    src = pipe["src"]
+    for i in range(n_frames):
+        src.push(np.full((4, 4), float(i), np.float32))
+    src.end_of_stream()
+    pipe.wait(timeout=30)
+    pipe.stop()
+    return tracer, n_frames
+
+
+def test_tracer_counts_and_latency():
+    tracer, n = _run_traced()
+    rep = tracer.report()
+    # the transform and the sink both processed every frame
+    els = {name: r for name, r in rep.items()}
+    transform = next(r for name, r in els.items() if "transform" in name)
+    sink = els["out"]
+    assert transform["frames"] == n
+    assert sink["frames"] == n
+    # proctime measured and sane (>0, < 1s)
+    assert 0 < transform["proctime_us_avg"] < 1e6
+    assert transform["proctime_us_p99"] >= transform["proctime_us_p50"]
+    # interlatency: frames carried a source stamp through the chain
+    assert transform["interlatency_ms_avg"] is not None
+    assert sink["interlatency_ms_avg"] >= 0
+    # bitrate: 4x4 float32 = 64 bytes per frame flowed
+    assert transform["bitrate_mbps"] >= 0
+    # queue levels sampled with a real capacity
+    assert sink["queue_capacity"] > 0
+
+
+def test_tracer_summary_renders():
+    tracer, _ = _run_traced(8)
+    lines = tracer.summary_lines()
+    assert len(lines) >= 3  # header + 2 elements
+    assert "fps" in lines[0] and "inter ms" in lines[0]
+
+
+def test_no_tracer_by_default():
+    pipe = parse_pipeline(
+        "appsrc name=src ! tensor_sink name=out", name="untraced"
+    )
+    assert pipe.tracer is None
+    pipe.start()
+    pipe["src"].push(np.zeros((2,), np.float32))
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
