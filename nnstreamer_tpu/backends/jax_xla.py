@@ -32,6 +32,13 @@ TPU-first design:
   jax-xla filters never bounce through host (≙ zero-copy GstMemory).
 * optional ``dtype:bfloat16`` custom prop casts params/compute to bf16
   (MXU-native).
+* **sharded serving** — custom props ``mesh_dp:2,mesh_tp:4`` run ONE
+  logical filter across a device mesh: params sharded by the parallel
+  layer's rules (``parallel/sharding.py``), micro-batches scattered over
+  ``dp``, XLA SPMD inserts the collectives.  The reference's only
+  multi-device story is stream fan-out over nnstreamer-edge transports
+  (SURVEY §2.3); intra-model sharding of a *serving* pipeline is
+  TPU-native net-new.
 """
 
 from __future__ import annotations
@@ -92,6 +99,11 @@ class JaxXla(FilterBackend):
         self._cache_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # double-buffered hot reload
         self._posts: List[Callable[[List[Any]], List[Any]]] = []
+        # sharded serving (mesh_* custom props)
+        self._mesh = None
+        self._dp = 1
+        self._batch_sharding = None
+        self._replicated = None
 
     # -- framework info -----------------------------------------------------
     def framework_info(self):
@@ -140,6 +152,15 @@ class JaxXla(FilterBackend):
             "(not registered; for files pass custom=arch:<zoo-name>)"
         )
 
+    def _mesh_axes_from_props(self) -> Dict[str, int]:
+        """``mesh_<axis>:<size>`` custom props (e.g. ``mesh_dp:2,mesh_tp:4``;
+        ``-1`` = remaining devices).  Empty dict = unsharded."""
+        axes = {}
+        for k, v in self.custom_props.items():
+            if k.startswith("mesh_"):
+                axes[k[len("mesh_"):]] = int(v)
+        return axes
+
     def open(self, model_path, props):
         super().open(model_path, props)
         import jax
@@ -163,7 +184,34 @@ class JaxXla(FilterBackend):
                 else a,
                 self._params,
             )
-        if self._params is not None:
+        mesh_axes = self._mesh_axes_from_props()
+        if mesh_axes:
+            import math
+
+            from ..parallel.mesh import make_mesh
+            from ..parallel.sharding import (
+                batch_sharding,
+                replicated,
+                shard_params,
+                transformer_rules,
+            )
+
+            # explicit sizes claim a sub-mesh of the first N devices; a -1
+            # wildcard claims them all
+            if any(v == -1 for v in mesh_axes.values()):
+                devices = jax.devices()
+            else:
+                devices = jax.devices()[: math.prod(mesh_axes.values())]
+            self._mesh = make_mesh(mesh_axes, devices=devices)
+            self._dp = self._mesh.shape.get("dp", 1)
+            if self._params is not None:
+                # rule misses fall back to replicated — safe for any family
+                self._params = shard_params(
+                    self._params, self._mesh, transformer_rules(tp_axis="tp")
+                )
+            self._batch_sharding = batch_sharding(self._mesh, "dp")
+            self._replicated = replicated(self._mesh)
+        elif self._params is not None:
             self._params = jax.device_put(self._params, self._device)
 
     def close(self):
@@ -179,7 +227,14 @@ class JaxXla(FilterBackend):
 
         fn, params, in_spec, out_spec = self._resolve_model(model_path)
         if params is not None:
-            params = jax.device_put(params, self._device)
+            if self._mesh is not None:
+                from ..parallel.sharding import shard_params, transformer_rules
+
+                params = shard_params(
+                    params, self._mesh, transformer_rules(tp_axis="tp")
+                )
+            else:
+                params = jax.device_put(params, self._device)
         with self._reload_lock:
             self._fn, self._params = fn, params
             self._in_spec = in_spec or self._in_spec
@@ -279,9 +334,13 @@ class JaxXla(FilterBackend):
                 self._jit_cache[key] = fn
         return fn
 
-    def _put(self, a) -> Any:
+    def _put(self, a, sharding=None) -> Any:
         import jax
 
+        if sharding is not None:
+            # mesh placement: resharding an already-placed array is a
+            # device-side scatter/collective, not a host bounce
+            return jax.device_put(a, sharding)
         if isinstance(a, jax.Array):
             return a
         return jax.device_put(np.asarray(a), self._device)
@@ -289,25 +348,46 @@ class JaxXla(FilterBackend):
     # -- execution ----------------------------------------------------------
     def invoke(self, inputs: List[Any]) -> List[Any]:
         with self._reload_lock:
-            xs = [self._put(a) for a in inputs]
+            # single frame has no batch dim to scatter: replicate on a mesh
+            xs = [self._put(a, self._replicated) for a in inputs]
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
             out = self._compiled(key)(self._params, *xs)
         return list(out)
 
     def invoke_batch(self, inputs: List[Any]) -> List[Any]:
         """One XLA call for the whole micro-batch, bucket-padded so each
-        bucket size compiles exactly once."""
+        bucket size compiles exactly once (and, on a mesh, stays divisible
+        by the dp axis so the scatter is even)."""
         n = int(inputs[0].shape[0])
         bucket = _next_pow2(n)
+        if bucket % self._dp:
+            bucket = ((bucket + self._dp - 1) // self._dp) * self._dp
         with self._reload_lock:
+            import jax
+
             xs = []
             for a in inputs:
+                if self._batch_sharding is not None and not isinstance(
+                    a, jax.Array
+                ):
+                    # host batch onto a mesh: pad host-side, then scatter
+                    # each dp shard straight to its owning device (no
+                    # whole-batch bounce through device 0)
+                    arr = np.asarray(a)
+                    if bucket != n:
+                        pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
+                        arr = np.pad(arr, pad, mode="edge")
+                    arr = self._put(arr, self._batch_sharding)
+                    xs.append(arr)
+                    continue
                 arr = self._put(a)
                 if bucket != n:
                     import jax.numpy as jnp
 
                     pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
                     arr = jnp.pad(arr, pad, mode="edge")
+                if self._batch_sharding is not None:
+                    arr = self._put(arr, self._batch_sharding)
                 xs.append(arr)
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
             out = self._compiled(key)(self._params, *xs)

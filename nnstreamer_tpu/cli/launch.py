@@ -22,6 +22,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "-q", "--quiet", action="store_true", help="suppress bus messages"
     )
+    ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="print the per-element tracer table on exit "
+        "(proctime/framerate/interlatency/queue/bitrate; ≙ GstShark)",
+    )
     args = ap.parse_args(argv)
 
     from ..pipeline import parse_pipeline
@@ -30,6 +36,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     pipe = parse_pipeline(text)
     if not args.quiet:
         pipe.add_bus_watcher(lambda msg: print(f"[bus] {msg}", file=sys.stderr))
+    tracer = pipe.enable_tracing() if args.trace else None
     t0 = time.monotonic()
     pipe.start()
     try:
@@ -38,6 +45,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("interrupted", file=sys.stderr)
     finally:
         pipe.stop()
+    if tracer is not None:
+        print("\n".join(tracer.summary_lines()), file=sys.stderr)
     if not args.quiet:
         print(
             f"pipeline finished in {time.monotonic() - t0:.3f}s", file=sys.stderr

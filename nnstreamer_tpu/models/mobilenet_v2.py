@@ -45,6 +45,43 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
+class QuantConv(nn.Module):
+    """Drop-in for ``nn.Conv`` running int8×int8→int32 on the MXU
+    (ops/quantize.py): weights quantized per-channel in-graph (params stay
+    a plain float tree), activations dynamically.  ≙ the reference's
+    quantized-tflite execution, the MXU way.  Given ``name="Conv_0"`` its
+    param path — and therefore flax's per-param RNG fold — matches
+    ``nn.Conv``, so quantized and float builds share identical weights
+    for the same seed."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: int = 1
+    feature_group_count: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.quantize import int8_conv
+
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (
+                *self.kernel_size,
+                x.shape[-1] // self.feature_group_count,
+                self.features,
+            ),
+        )
+        return int8_conv(
+            x,
+            w,
+            strides=(self.strides, self.strides),
+            feature_group_count=self.feature_group_count,
+            out_dtype=self.dtype,
+        )
+
+
 class ConvBN(nn.Module):
     features: int
     kernel: Tuple[int, int] = (3, 3)
@@ -52,18 +89,29 @@ class ConvBN(nn.Module):
     groups: int = 1
     act: bool = True
     dtype: Any = jnp.bfloat16
+    quant: bool = False  # int8 MXU path (ops/quantize.py)
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(
-            self.features,
-            self.kernel,
-            strides=self.strides,
-            padding="SAME",
-            feature_group_count=self.groups,
-            use_bias=False,
-            dtype=self.dtype,
-        )(x)
+        if self.quant:
+            x = QuantConv(
+                self.features,
+                self.kernel,
+                strides=self.strides,
+                feature_group_count=self.groups,
+                dtype=self.dtype,
+                name="Conv_0",
+            )(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                self.kernel,
+                strides=self.strides,
+                padding="SAME",
+                feature_group_count=self.groups,
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
         x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
         if self.act:
             x = jnp.minimum(jnp.maximum(x, 0.0), 6.0)  # relu6
@@ -75,21 +123,28 @@ class InvertedResidual(nn.Module):
     stride: int
     expand: int
     dtype: Any = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
         c_in = x.shape[-1]
         h = x
         if self.expand != 1:
-            h = ConvBN(c_in * self.expand, (1, 1), dtype=self.dtype)(h)
+            h = ConvBN(
+                c_in * self.expand, (1, 1), dtype=self.dtype, quant=self.quant
+            )(h)
         h = ConvBN(
             c_in * self.expand if self.expand != 1 else c_in,
             (3, 3),
             strides=self.stride,
             groups=c_in * self.expand if self.expand != 1 else c_in,
             dtype=self.dtype,
+            quant=self.quant,
         )(h)
-        h = ConvBN(self.features, (1, 1), act=False, dtype=self.dtype)(h)
+        h = ConvBN(
+            self.features, (1, 1), act=False, dtype=self.dtype,
+            quant=self.quant,
+        )(h)
         if self.stride == 1 and c_in == self.features:
             h = h + x
         return h
@@ -100,6 +155,7 @@ class MobileNetV2(nn.Module):
     width_mult: float = 1.0
     dtype: Any = jnp.bfloat16
     pallas_preprocess: bool = False
+    quant: bool = False  # int8 conv stack (≙ reference's quant tflite)
 
     @nn.compact
     def __call__(self, x):
@@ -115,15 +171,16 @@ class MobileNetV2(nn.Module):
         else:
             x = x.astype(self.dtype)
         c = _make_divisible(32 * self.width_mult)
-        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype)(x)
+        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype, quant=self.quant)(x)
         for t, ch, n, s in _CFG:
             out_c = _make_divisible(ch * self.width_mult)
             for i in range(n):
                 x = InvertedResidual(
-                    out_c, s if i == 0 else 1, t, dtype=self.dtype
+                    out_c, s if i == 0 else 1, t, dtype=self.dtype,
+                    quant=self.quant,
                 )(x)
         last = _make_divisible(1280 * max(self.width_mult, 1.0))
-        x = ConvBN(last, (1, 1), dtype=self.dtype)(x)
+        x = ConvBN(last, (1, 1), dtype=self.dtype, quant=self.quant)(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
         return x
@@ -146,6 +203,7 @@ def build(custom_props=None):
         width_mult=width,
         dtype=dtype,
         pallas_preprocess=props.get("pallas", "0") in ("1", "true"),
+        quant=props.get("quantize", "") == "int8",
     )
     variables = host_init(
         model.init,

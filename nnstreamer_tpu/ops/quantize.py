@@ -1,0 +1,91 @@
+"""Int8 quantized inference primitives (post-training, calibration-free).
+
+The reference's flagship pipeline runs a *quantized* model
+(``tests/test_models/models/mobilenet_v2_1.0_224_quant.tflite`` — uint8
+TFLite quantization executed by the tflite subplugin's integer kernels).
+The TPU-native analog is int8 matmul/conv on the MXU: TPU systolic arrays
+execute int8×int8→int32 at twice the bf16 rate and quantized weights halve
+HBM traffic — the same lever TFLite quantization pulls on edge NPUs.
+
+Scheme (AQT-style, all in-graph so XLA fuses everything):
+
+* **weights** — symmetric per-output-channel int8, quantized from the
+  float params inside the jitted program (negligible next to the conv
+  itself; params stay a plain float tree, so checkpoints/reload/zoo
+  plumbing are unchanged).
+* **activations** — symmetric per-tensor *dynamic* quantization: abs-max
+  computed on the fly.  No calibration pass, no observer state; accuracy
+  follows TFLite dynamic-range quantization.
+
+Usage: models opt in via ``custom=quantize:int8`` (zoo prop); see
+``models/mobilenet_v2.py`` ConvBN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+_EPS = 1e-8
+
+
+def quantize_symmetric(
+    x: jnp.ndarray, axes: Optional[Tuple[int, ...]] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(int8 values, float32 scale) with ``x ≈ values * scale``.
+
+    ``axes=None`` → one per-tensor scale; otherwise the scale is computed
+    by reducing over ``axes`` (e.g. ``(0,1,2)`` for HWIO conv kernels =
+    per-output-channel).
+    """
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x)) if axes is None else jnp.max(
+        jnp.abs(x), axis=axes, keepdims=True
+    )
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_conv(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    strides: Tuple[int, int] = (1, 1),
+    padding: str = "SAME",
+    feature_group_count: int = 1,
+    out_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """NHWC×HWIO conv computed int8×int8→int32 on the MXU, rescaled to
+    ``out_dtype``.  ``w`` is the float kernel straight from params."""
+    xq, s_x = quantize_symmetric(x)
+    wq, s_w = quantize_symmetric(w, axes=(0, 1, 2))
+    y = lax.conv_general_dilated(
+        xq,
+        wq,
+        strides,
+        padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.int32,
+    )
+    rescale = (s_x * s_w.reshape(1, 1, 1, -1)).astype(jnp.float32)
+    return (y.astype(jnp.float32) * rescale).astype(out_dtype)
+
+
+def int8_dense(
+    x: jnp.ndarray, w: jnp.ndarray, out_dtype=jnp.float32
+) -> jnp.ndarray:
+    """x @ w with int8 MXU accumulation; ``w`` is (in, out) float."""
+    xq, s_x = quantize_symmetric(x)
+    wq, s_w = quantize_symmetric(w, axes=(0,))
+    y = lax.dot_general(
+        xq,
+        wq,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (y.astype(jnp.float32) * (s_x * s_w.reshape(1, -1))).astype(
+        out_dtype
+    )

@@ -48,17 +48,19 @@ def test_two_process_hybrid_mesh():
             )
         )
     results = {}
-    for pid, p in enumerate(procs):
-        try:
+    try:
+        for pid, p in enumerate(procs):
             out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, f"worker {pid} failed:\n{err[-2000:]}"
+            line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+            assert line, f"worker {pid} printed no RESULT:\n{out[-500:]}"
+            results[pid] = json.loads(line[-1][len("RESULT "):])
+    finally:
+        # a failed/hung worker must not orphan its peers (they block in
+        # gloo collectives against the dead coordinator, holding the port)
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"worker {pid} failed:\n{err[-2000:]}"
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
-        assert line, f"worker {pid} printed no RESULT:\n{out[-500:]}"
-        results[pid] = json.loads(line[-1][len("RESULT "):])
 
     assert results[0]["primary"] and not results[1]["primary"]
     for pid, r in results.items():
