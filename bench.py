@@ -82,6 +82,15 @@ def probe_backend(tries: int, timeout_s: float) -> str:
     return last_err
 
 
+def quant_applied(which: str) -> bool:
+    """True when BENCH_QUANT actually changes the model that runs — only
+    the mobilenet row has an int8 path; one definition keeps the executed
+    pipeline and the emitted row label in agreement."""
+    return which == "mobilenet" and os.environ.get("BENCH_QUANT", "") in (
+        "1", "int8",
+    )
+
+
 METRICS = {
     "mobilenet": ("mobilenet_v2_image_labeling_fps_per_chip", "fps"),
     "ssd": ("ssd_mobilenet_v2_bbox_fps_per_chip", "fps"),
@@ -108,12 +117,11 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     with open(labels_path, "w") as f:
         f.write("\n".join(f"class{i}" for i in range(1001)))
 
-    quant = os.environ.get("BENCH_QUANT", "") in ("1", "int8")
     # BASELINE.md tracked rows: mobilenet (headline), ssd+bbox decode,
     # yolov5, posenet+pose decode — all measured as full pipelines
     if which == "mobilenet":
         size, family, props = 224, "mobilenet_v2", {"dtype": dtype}
-        if quant:
+        if quant_applied(which):
             # int8 MXU path ≙ the reference's quantized-tflite flagship
             # (mobilenet_v2_1.0_224_quant.tflite)
             props["quantize"] = "int8"
@@ -329,14 +337,7 @@ def main() -> None:
         "model": which,
         "batch": int(os.environ.get("BENCH_BATCH", "128")),
         "dtype": os.environ.get("BENCH_DTYPE", "bfloat16"),
-        # only the mobilenet row has an int8 path; other models run float
-        # even under BENCH_QUANT, so their rows must not claim int8
-        "quantize": (
-            "int8"
-            if which == "mobilenet"
-            and os.environ.get("BENCH_QUANT", "") in ("1", "int8")
-            else None
-        ),
+        "quantize": "int8" if quant_applied(which) else None,
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"

@@ -45,8 +45,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("interrupted", file=sys.stderr)
     finally:
         pipe.stop()
-    if tracer is not None:
-        print("\n".join(tracer.summary_lines()), file=sys.stderr)
+        # a failing run is exactly when the timing table matters most
+        if tracer is not None:
+            print("\n".join(tracer.summary_lines()), file=sys.stderr)
     if not args.quiet:
         print(
             f"pipeline finished in {time.monotonic() - t0:.3f}s", file=sys.stderr

@@ -50,6 +50,7 @@ class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
+    decode: bool = False  # KV-cache single-token step (generation serving)
 
     @nn.compact
     def __call__(self, x):
@@ -62,7 +63,45 @@ class Block(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        if self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1:
+        if self.decode:
+            # autoregressive step: T == 1; K/V append into a static-shape
+            # ring of max_seq slots (lax.dynamic_update_slice keeps the
+            # whole generate loop one compiled program — no growing shapes)
+            assert T == 1, "decode mode processes one token per call"
+            ck = self.variable(
+                "cache", "key",
+                lambda: jnp.zeros((B, cfg.max_seq, H, D // H), cfg.dtype),
+            )
+            cv = self.variable(
+                "cache", "value",
+                lambda: jnp.zeros((B, cfg.max_seq, H, D // H), cfg.dtype),
+            )
+            idx = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32)
+            )
+            pos = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, pos, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, pos, 0, 0)
+            )
+            idx.value = pos + 1
+            # attend over the filled prefix only
+            mask = (jnp.arange(cfg.max_seq) <= pos)[None, None, :, None]
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q.astype(jnp.float32),
+                ck.value.astype(jnp.float32),
+            ) / np.sqrt(D // H)
+            scores = jnp.where(
+                mask.transpose(0, 3, 1, 2), scores, -1e30
+            )
+            attn = jnp.einsum(
+                "bhts,bshd->bthd",
+                jax.nn.softmax(scores, axis=-1),
+                cv.value.astype(jnp.float32),
+            ).astype(cfg.dtype)
+        elif self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1:
             from ..parallel.ulysses import sequence_attention
 
             attn = sequence_attention(
@@ -88,18 +127,30 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens):  # (B, T) int32
         cfg = self.cfg
         x = nn.Embed(cfg.vocab, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
         T = tokens.shape[1]
+        if self.decode:
+            step = self.variable(
+                "cache", "step", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = step.value + jnp.arange(T)[None, :]
+            step.value = step.value + T
+        else:
+            positions = jnp.arange(T)[None, :]
         pos = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype, name="pos_embed")(
-            jnp.arange(T)[None, :]
+            positions
         )
         x = x + pos
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.mesh, self.seq_axis, name=f"block{i}")(x)
+            x = Block(
+                cfg, self.mesh, self.seq_axis, decode=self.decode,
+                name=f"block{i}",
+            )(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab, use_bias=False, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
@@ -124,8 +175,74 @@ def _cfg_from_props(props: Dict[str, str]) -> TransformerConfig:
     )
 
 
+def make_generate(cfg: TransformerConfig, max_new: int):
+    """Greedy KV-cache generation: ``gen(params, prompt (B,Tp)) ->
+    (B, Tp+max_new)``.
+
+    The whole prefill+decode loop is ONE ``lax.scan`` over static-shape
+    cache rings (``Block`` decode mode), so the backend jit-compiles a
+    single XLA program per (B, Tp) bucket — no per-token Python dispatch,
+    no growing shapes.  The serving analog of the reference's recurrence
+    emulation (``tests/nnstreamer_repo_lstm`` loops frames through
+    tensor_repo); here the loop lives inside the compiled program.
+    """
+    model_dec = TransformerLM(cfg, decode=True)
+
+    def gen(params, prompt):  # (B, Tp) int32
+        B, Tp = prompt.shape
+        total = Tp + max_new
+        if total > cfg.max_seq:
+            raise ValueError(
+                f"prompt {Tp} + generate {max_new} exceeds max_seq "
+                f"{cfg.max_seq}"
+            )
+        # init RUNS one decode step on a dummy token, so the returned
+        # cache already holds index=1 and a stale K/V row — zero the whole
+        # tree to get the true empty-cache state
+        cache0 = jax.tree.map(
+            jnp.zeros_like,
+            model_dec.init(
+                jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32)
+            )["cache"],
+        )
+        prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new)))
+
+        def step(carry, t):
+            cache, last = carry
+            tok = jnp.where(
+                t < Tp,
+                jax.lax.dynamic_index_in_dim(
+                    prompt_pad, t, axis=1, keepdims=False
+                ),
+                last,
+            )
+            logits, upd = model_dec.apply(
+                {"params": params["params"], "cache": cache},
+                tok[:, None],
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (upd["cache"], nxt), nxt
+
+        (_, _), nxt_all = jax.lax.scan(
+            step,
+            (cache0, jnp.zeros((B,), jnp.int32)),
+            jnp.arange(total - 1),
+        )
+        # nxt_all[t] is the greedy next-token after consuming input t:
+        # generated tokens are the predictions from step Tp-1 onward
+        generated = jnp.moveaxis(nxt_all, 0, 1)[:, Tp - 1 :]
+        return jnp.concatenate([prompt, generated], axis=1)
+
+    return gen
+
+
 def build(custom_props=None):
-    """Zoo entry (inference LM): fn(params, [tokens (B,T) or (T,)]) -> [logits]."""
+    """Zoo entry: fn(params, [tokens (B,T) or (T,)]) -> [logits].
+
+    With custom prop ``generate:<N>`` the entry serves greedy KV-cache
+    generation instead: tokens in -> prompt+N completion tokens out.
+    """
     props = custom_props or {}
     cfg = _cfg_from_props(props)
     model = TransformerLM(cfg)
@@ -134,6 +251,24 @@ def build(custom_props=None):
         int(props.get("seed", "0")),
         np.zeros((1, min(8, cfg.max_seq)), np.int32),
     )
+    max_new = int(props.get("generate", "0"))
+    in_spec = StreamSpec((TensorSpec((None,), np.int32, "tokens"),), FORMAT_STATIC)
+
+    if max_new > 0:
+        gen = make_generate(cfg, max_new)
+
+        def fn(p, inputs):
+            toks = inputs[0]
+            single = toks.ndim == 1
+            if single:
+                toks = toks[None]
+            out = gen(p, toks)
+            return [out[0] if single else out]
+
+        out_spec = StreamSpec(
+            (TensorSpec((None,), np.int32, "tokens"),), FORMAT_STATIC
+        )
+        return fn, params, in_spec, out_spec
 
     def fn(p, inputs):
         toks = inputs[0]
@@ -143,7 +278,6 @@ def build(custom_props=None):
         out = model.apply(p, toks)
         return [out[0] if single else out]
 
-    in_spec = StreamSpec((TensorSpec((None,), np.int32, "tokens"),), FORMAT_STATIC)
     out_spec = StreamSpec(
         (TensorSpec((None, cfg.vocab), np.float32, "logits"),), FORMAT_STATIC
     )

@@ -58,8 +58,13 @@ def int8_conv(
     out_dtype=jnp.bfloat16,
 ) -> jnp.ndarray:
     """NHWC×HWIO conv computed int8×int8→int32 on the MXU, rescaled to
-    ``out_dtype``.  ``w`` is the float kernel straight from params."""
-    xq, s_x = quantize_symmetric(x)
+    ``out_dtype``.  ``w`` is the float kernel straight from params.
+
+    Activation scales are per-SAMPLE (reduce over H/W/C only): a frame's
+    quantization must not depend on which other frames the scheduler
+    happened to micro-batch it with — same input, same output, regardless
+    of arrival timing."""
+    xq, s_x = quantize_symmetric(x, axes=tuple(range(1, x.ndim)))
     wq, s_w = quantize_symmetric(w, axes=(0, 1, 2))
     y = lax.conv_general_dilated(
         xq,
@@ -77,8 +82,9 @@ def int8_conv(
 def int8_dense(
     x: jnp.ndarray, w: jnp.ndarray, out_dtype=jnp.float32
 ) -> jnp.ndarray:
-    """x @ w with int8 MXU accumulation; ``w`` is (in, out) float."""
-    xq, s_x = quantize_symmetric(x)
+    """x @ w with int8 MXU accumulation; ``w`` is (in, out) float.
+    Activation scale is per-row (last dim only) — batching-invariant."""
+    xq, s_x = quantize_symmetric(x, axes=(x.ndim - 1,))
     wq, s_w = quantize_symmetric(w, axes=(0,))
     y = lax.dot_general(
         xq,

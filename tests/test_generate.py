@@ -1,0 +1,100 @@
+"""KV-cache autoregressive generation (transformer zoo ``generate:<N>``).
+
+Oracle: greedy decoding with the full (no-cache) forward re-run per token
+must produce the same tokens as the single-scan KV-cache program — the
+cache path is a pure optimization, never a semantic change.
+
+Reference analog: recurrence is emulated by looping frames through
+tensor_repo (``tests/nnstreamer_repo_lstm``); here the loop is one
+compiled XLA scan.
+"""
+
+import jax
+import numpy as np
+
+from nnstreamer_tpu.elements.filter import SingleShot
+from nnstreamer_tpu.models import build
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+PROPS = {
+    "dtype": "float32", "vocab": 61, "d_model": 32, "heads": 2,
+    "layers": 2, "d_ff": 64, "seq": 32, "seed": 11,
+}
+CUSTOM = "arch:transformer," + ",".join(
+    f"{k}:{v}" for k, v in PROPS.items()
+)
+
+
+def _greedy_oracle(fn_full, params, prompt, n):
+    seq = prompt.copy()
+    for _ in range(n):
+        logits = np.asarray(fn_full(params, [seq])[0])
+        nxt = np.argmax(logits[:, -1, :], axis=-1).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    return seq
+
+
+def test_generate_matches_full_forward_oracle(rng):
+    n_new = 5
+    fn_gen, params, _, _ = build(
+        "transformer", {**PROPS, "generate": str(n_new)}
+    )
+    fn_full, params_full, _, _ = build("transformer", PROPS)
+    # same seed/arch -> identical params
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params_full)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    prompt = rng.integers(0, PROPS["vocab"], (2, 7)).astype(np.int32)
+    got = np.asarray(jax.jit(lambda p, x: fn_gen(p, [x])[0])(params, prompt))
+    want = _greedy_oracle(fn_full, params_full, prompt, n_new)
+    assert got.shape == (2, 7 + n_new)
+    np.testing.assert_array_equal(got[:, :7], prompt)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_singleshot_and_pipeline(rng):
+    """Generation served through tensor_filter: one prompt frame in, one
+    completed-sequence frame out (micro-batched across prompts)."""
+    prompts = [
+        rng.integers(0, PROPS["vocab"], (6,)).astype(np.int32)
+        for _ in range(5)
+    ]
+    with SingleShot(
+        framework="jax-xla", model="zoo", custom=CUSTOM + ",generate:4"
+    ) as s:
+        single = np.asarray(s.invoke([prompts[0]])[0])
+    assert single.shape == (10,)
+
+    pipe = parse_pipeline(
+        "appsrc name=src ! "
+        f"tensor_filter framework=jax-xla model=zoo "
+        f"custom={CUSTOM},generate:4 max-batch=4 batch-timeout=50 ! "
+        "tensor_sink name=out",
+        name="llm-serve",
+    )
+    pipe.start()
+    for p in prompts:
+        pipe["src"].push(p)
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=120)
+    outs = [np.asarray(f.tensors[0]) for f in pipe["out"].frames]
+    pipe.stop()
+    assert len(outs) == 5
+    for p, o in zip(prompts, outs):
+        assert o.shape == (10,)
+        np.testing.assert_array_equal(o[:6], p)
+    # pipeline path agrees with the pipeline-less SingleShot path
+    np.testing.assert_array_equal(outs[0], single)
+
+
+def test_generate_rejects_overflow(rng):
+    fn_gen, params, _, _ = build(
+        "transformer", {**PROPS, "generate": "30"}
+    )
+    prompt = rng.integers(0, PROPS["vocab"], (1, 8)).astype(np.int32)
+    try:
+        fn_gen(params, [prompt])
+    except ValueError as e:
+        assert "max_seq" in str(e)
+    else:
+        raise AssertionError("expected ValueError for seq overflow")
