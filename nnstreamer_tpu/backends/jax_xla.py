@@ -165,6 +165,9 @@ class JaxXla(FilterBackend):
         super().open(model_path, props)
         import jax
 
+        from ..core.compile_cache import enable as enable_compile_cache
+
+        enable_compile_cache()
         self._fn, self._params, self._in_spec, self._out_spec = self._resolve_model(
             model_path
         )

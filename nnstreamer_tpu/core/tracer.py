@@ -63,10 +63,14 @@ class PipelineTracer:
     ``pipeline.enable_tracing()``; read ``report()`` any time (thread-safe,
     including while the pipeline runs)."""
 
-    def __init__(self) -> None:
+    def __init__(self, detail: bool = False) -> None:
         self._stats: Dict[str, _ElementStats] = {}
         self._lock = threading.Lock()
         self.t_started = time.perf_counter()
+        # detail mode additionally keeps per-call spans (bounded ring) so
+        # export_chrome_trace renders a real timeline, not just aggregates
+        self._detail = detail
+        self._spans: deque = deque(maxlen=200_000)
 
     # -- hot-path hooks (called from element worker threads) ---------------
     def stamp_source(self, frame) -> None:
@@ -85,6 +89,8 @@ class PipelineTracer:
         self, name: str, t_in: float, t_out: float,
         nframes: int, nbytes: int, src_ts: Optional[float],
     ) -> None:
+        if self._detail:
+            self._spans.append((name, t_in, t_out, nframes))
         st = self._get(name)
         st.calls += 1
         st.frames += nframes
@@ -156,6 +162,54 @@ class PipelineTracer:
                 f"{r['queuelevel_avg']:>4.1f}/{r['queue_capacity']}"
             )
         return lines
+
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write a Chrome-trace JSON (``chrome://tracing`` / Perfetto) so
+        pipeline timing sits next to ``jax.profiler`` device traces — the
+        GstShark→tracing-UI hop the reference gets from HawkTracer
+        (SURVEY §5.1).  With ``detail=True`` every element call becomes a
+        real timeline span (one lane per element); otherwise one summary
+        span per element plus fps counters."""
+        import json
+
+        t0 = self.t_started
+        lanes = {name: i for i, name in enumerate(list(self._stats))}
+        events = [
+            {
+                "name": "process_name", "ph": "M", "pid": 0,
+                "args": {"name": "nnstreamer_tpu pipeline"},
+            }
+        ] + [
+            {
+                "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                "args": {"name": name},
+            }
+            for name, tid in lanes.items()
+        ]
+        if self._detail and self._spans:
+            for name, t_in, t_out, nframes in list(self._spans):
+                events.append({
+                    "name": name, "ph": "X", "pid": 0,
+                    "tid": lanes.get(name, 0),
+                    "ts": (t_in - t0) * 1e6,
+                    "dur": max(0.1, (t_out - t_in) * 1e6),
+                    "args": {"frames": nframes},
+                })
+        for name, r in self.report().items():
+            if not (self._detail and self._spans):
+                events.append({
+                    "name": name, "ph": "X", "pid": 0,
+                    "tid": lanes.get(name, 0), "ts": 0,
+                    "dur": max(1, int(r["proctime_us_avg"] * r["calls"])),
+                    "args": {k: v for k, v in r.items() if v is not None},
+                })
+            events.append({
+                "name": f"{name}/fps", "ph": "C", "pid": 0,
+                "ts": 0, "args": {"fps": round(r["framerate_fps"], 1)},
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
 
 
 def frame_nbytes(item) -> int:

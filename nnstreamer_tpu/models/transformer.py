@@ -64,10 +64,12 @@ class Block(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if self.decode:
-            # autoregressive step: T == 1; K/V append into a static-shape
-            # ring of max_seq slots (lax.dynamic_update_slice keeps the
-            # whole generate loop one compiled program — no growing shapes)
-            assert T == 1, "decode mode processes one token per call"
+            # KV-cache attention over a static-shape ring of max_seq slots
+            # (dynamic_update_slice keeps the generate loop one compiled
+            # program — no growing shapes).  T == 1 is the per-token decode
+            # step; T > 1 is chunked PREFILL: the whole prompt attends
+            # causally in one pass while filling the cache, so prefill
+            # costs one forward instead of T sequential steps.
             ck = self.variable(
                 "cache", "key",
                 lambda: jnp.zeros((B, cfg.max_seq, H, D // H), cfg.dtype),
@@ -86,16 +88,17 @@ class Block(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, pos, 0, 0)
             )
-            idx.value = pos + 1
-            # attend over the filled prefix only
-            mask = (jnp.arange(cfg.max_seq) <= pos)[None, None, :, None]
+            idx.value = pos + T
+            # query i (global position pos+i) sees cache slots <= pos+i
+            mask = (
+                jnp.arange(cfg.max_seq)[None, :]
+                <= (pos + jnp.arange(T))[:, None]
+            )  # (T, S)
             scores = jnp.einsum(
                 "bthd,bshd->bhts", q.astype(jnp.float32),
                 ck.value.astype(jnp.float32),
             ) / np.sqrt(D // H)
-            scores = jnp.where(
-                mask.transpose(0, 3, 1, 2), scores, -1e30
-            )
+            scores = jnp.where(mask[None, None], scores, -1e30)
             attn = jnp.einsum(
                 "bhts,bshd->bthd",
                 jax.nn.softmax(scores, axis=-1),
@@ -179,12 +182,15 @@ def make_generate(cfg: TransformerConfig, max_new: int):
     """Greedy KV-cache generation: ``gen(params, prompt (B,Tp)) ->
     (B, Tp+max_new)``.
 
-    The whole prefill+decode loop is ONE ``lax.scan`` over static-shape
-    cache rings (``Block`` decode mode), so the backend jit-compiles a
-    single XLA program per (B, Tp) bucket — no per-token Python dispatch,
-    no growing shapes.  The serving analog of the reference's recurrence
-    emulation (``tests/nnstreamer_repo_lstm`` loops frames through
-    tensor_repo); here the loop lives inside the compiled program.
+    Two phases inside one traced function: chunked PREFILL — a single
+    full-attention forward over the whole prompt that fills the K/V cache
+    (long prompts cost one pass, not Tp sequential steps) — then a
+    ``lax.scan`` decoding one token per step over static-shape cache
+    rings.  The backend jit-compiles one XLA program per (B, Tp) bucket;
+    no per-token Python dispatch, no growing shapes.  The serving analog
+    of the reference's recurrence emulation (``tests/nnstreamer_repo_lstm``
+    loops frames through tensor_repo); here the loop lives inside the
+    compiled program.
     """
     model_dec = TransformerLM(cfg, decode=True)
 
@@ -196,42 +202,42 @@ def make_generate(cfg: TransformerConfig, max_new: int):
                 f"prompt {Tp} + generate {max_new} exceeds max_seq "
                 f"{cfg.max_seq}"
             )
-        # init RUNS one decode step on a dummy token, so the returned
-        # cache already holds index=1 and a stale K/V row — zero the whole
-        # tree to get the true empty-cache state
-        cache0 = jax.tree.map(
-            jnp.zeros_like,
-            model_dec.init(
+        # empty-cache state: eval_shape gives the cache tree's structure
+        # without tracing the whole init (whose random params would be
+        # dead code), and zeros ARE the empty state (index=0)
+        cache_shapes = jax.eval_shape(
+            lambda: model_dec.init(
                 jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32)
-            )["cache"],
+            )["cache"]
         )
-        prompt_pad = jnp.pad(prompt, ((0, 0), (0, max_new)))
+        cache0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+        variables = {"params": params["params"]}
 
-        def step(carry, t):
-            cache, last = carry
-            tok = jnp.where(
-                t < Tp,
-                jax.lax.dynamic_index_in_dim(
-                    prompt_pad, t, axis=1, keepdims=False
-                ),
-                last,
-            )
-            logits, upd = model_dec.apply(
-                {"params": params["params"], "cache": cache},
+        # phase 1: prefill the cache with ONE causal pass over the prompt
+        logits_p, upd = model_dec.apply(
+            {**variables, "cache": cache0}, prompt, mutable=["cache"]
+        )
+        first = jnp.argmax(logits_p[:, -1, :], axis=-1).astype(jnp.int32)
+
+        # phase 2: decode max_new - 1 more tokens, one per scan step
+        def step(carry, _):
+            cache, tok = carry
+            logits, upd2 = model_dec.apply(
+                {**variables, "cache": cache},
                 tok[:, None],
                 mutable=["cache"],
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            return (upd["cache"], nxt), nxt
+            return (upd2["cache"], nxt), nxt
 
-        (_, _), nxt_all = jax.lax.scan(
-            step,
-            (cache0, jnp.zeros((B,), jnp.int32)),
-            jnp.arange(total - 1),
+        (_, _), rest = jax.lax.scan(
+            step, (upd["cache"], first), None, length=max_new - 1
         )
-        # nxt_all[t] is the greedy next-token after consuming input t:
-        # generated tokens are the predictions from step Tp-1 onward
-        generated = jnp.moveaxis(nxt_all, 0, 1)[:, Tp - 1 :]
+        generated = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+        )
         return jnp.concatenate([prompt, generated], axis=1)
 
     return gen

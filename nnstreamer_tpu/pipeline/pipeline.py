@@ -66,9 +66,11 @@ class Pipeline:
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
 
-    def enable_tracing(self) -> PipelineTracer:
-        """Attach a fresh PipelineTracer (before start()); returns it."""
-        self.tracer = PipelineTracer()
+    def enable_tracing(self, detail: bool = False) -> PipelineTracer:
+        """Attach a fresh PipelineTracer (before start()); returns it.
+        ``detail=True`` also records per-call spans for
+        ``export_chrome_trace``."""
+        self.tracer = PipelineTracer(detail=detail)
         return self.tracer
 
     # -- construction -------------------------------------------------------

@@ -1,0 +1,76 @@
+"""Persistent XLA compilation cache (core/compile_cache.py).
+
+Reference analog: engine/result caching in backends (TensorRT serialized
+engine cache); here compiled XLA executables persist across processes.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_enable_creates_dir_and_sets_config(tmp_path, monkeypatch):
+    from nnstreamer_tpu.core import compile_cache
+
+    compile_cache.reset_for_tests()
+    target = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("NNS_TPU_XLA_CACHE_DIR", target)
+    from nnstreamer_tpu.core import config as nns_config
+
+    nns_config.reset()
+    try:
+        got = compile_cache.enable()
+        assert got == target
+        assert os.path.isdir(target)
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == target
+        # idempotent: second call returns the same dir, no re-init
+        assert compile_cache.enable() == target
+    finally:
+        compile_cache.reset_for_tests()
+        monkeypatch.delenv("NNS_TPU_XLA_CACHE_DIR")
+        nns_config.reset()
+
+
+def test_disable_via_empty_dir(monkeypatch):
+    from nnstreamer_tpu.core import compile_cache
+
+    compile_cache.reset_for_tests()
+    try:
+        assert compile_cache.enable("") is None
+    finally:
+        compile_cache.reset_for_tests()
+
+
+def test_cache_populates_across_processes(tmp_path):
+    """A fresh process compiling through the jax-xla backend writes cache
+    entries; a second fresh process starts with a warm cache dir."""
+    cache = str(tmp_path / "xc")
+    src = (
+        "import os, sys, numpy as np;"
+        f"sys.path.insert(0, {ROOT!r});"
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "from nnstreamer_tpu.elements.filter import SingleShot;"
+        "s = SingleShot(framework='jax-xla', model='zoo',"
+        " custom='arch:mnist_cnn,dtype:float32');"
+        "out = s.invoke_batch([np.zeros((4, 28, 28, 1), np.float32)]);"
+        "s.close(); print('OK', out[0].shape)"
+    )
+    env = dict(
+        os.environ, NNS_TPU_XLA_CACHE_DIR=cache, JAX_PLATFORMS="cpu"
+    )
+    r1 = subprocess.run(
+        [sys.executable, "-c", src], env=env, capture_output=True,
+        text=True, timeout=240,
+    )
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    entries = os.listdir(cache)
+    assert entries, "first run wrote no cache entries"
+    r2 = subprocess.run(
+        [sys.executable, "-c", src], env=env, capture_output=True,
+        text=True, timeout=240,
+    )
+    assert r2.returncode == 0, r2.stderr[-2000:]

@@ -87,6 +87,38 @@ def test_generate_singleshot_and_pipeline(rng):
     np.testing.assert_array_equal(outs[0], single)
 
 
+def test_chunked_prefill_logits_match_full_forward(rng):
+    """Decode-mode prefill (one causal pass filling the K/V cache) must
+    produce the same logits at every position as the ordinary forward."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models._init_util import host_init
+    from nnstreamer_tpu.models.transformer import (
+        TransformerLM,
+        _cfg_from_props,
+    )
+
+    cfg = _cfg_from_props({k: str(v) for k, v in PROPS.items()})
+    full = TransformerLM(cfg)
+    params = host_init(full.init, 11, np.zeros((1, 8), np.int32))
+    dec = TransformerLM(cfg, decode=True)
+    prompt = rng.integers(0, PROPS["vocab"], (2, 9)).astype(np.int32)
+
+    want = np.asarray(full.apply(params, jnp.asarray(prompt)))
+    cache0 = jax.tree.map(
+        jnp.zeros_like,
+        dec.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))[
+            "cache"
+        ],
+    )
+    got, _ = dec.apply(
+        {"params": params["params"], "cache": cache0},
+        jnp.asarray(prompt),
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
 def test_generate_rejects_overflow(rng):
     fn_gen, params, _, _ = build(
         "transformer", {**PROPS, "generate": "30"}

@@ -98,6 +98,24 @@ def test_sharded_pipeline_end_to_end(rng):
         np.testing.assert_allclose(b, a, rtol=2e-4, atol=2e-4)
 
 
+def test_sharded_generation_matches_unsharded(rng):
+    """mesh_* props compose with generate:<N>: the KV-cache decode loop
+    runs under GSPMD with tp-sharded params; tokens must be identical."""
+    toks = _tokens(rng, 4, t=8)
+    with SingleShot(
+        framework="jax-xla", model="zoo", custom=TRANSFORMER + ",generate:3"
+    ) as plain:
+        want = np.asarray(plain.invoke_batch([toks])[0])
+    with SingleShot(
+        framework="jax-xla",
+        model="zoo",
+        custom=TRANSFORMER + ",generate:3,mesh_dp:2,mesh_tp:2",
+    ) as sharded:
+        got = np.asarray(sharded.invoke_batch([toks])[0])
+    assert want.shape == (4, 11)
+    np.testing.assert_array_equal(got, want)
+
+
 def _setup_module_guard():
     # fail fast if the zoo alias used above ever changes
     assert find_backend("jax-xla") is not None

@@ -8,14 +8,14 @@ from nnstreamer_tpu.core.tracer import PipelineTracer
 from nnstreamer_tpu.pipeline import parse_pipeline
 
 
-def _run_traced(n_frames=32):
+def _run_traced(n_frames=32, detail=False):
     pipe = parse_pipeline(
         "appsrc name=src ! "
         "tensor_transform mode=arithmetic option=add:1.0 ! "
         "tensor_sink name=out max-stored=64",
         name="traced",
     )
-    tracer = pipe.enable_tracing()
+    tracer = pipe.enable_tracing(detail=detail)
     pipe.start()
     src = pipe["src"]
     for i in range(n_frames):
@@ -52,6 +52,24 @@ def test_tracer_summary_renders():
     lines = tracer.summary_lines()
     assert len(lines) >= 3  # header + 2 elements
     assert "fps" in lines[0] and "inter ms" in lines[0]
+
+
+def test_chrome_trace_export(tmp_path):
+    import json
+
+    tracer, n = _run_traced(16, detail=True)
+    path = str(tmp_path / "trace.json")
+    tracer.export_chrome_trace(path)
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    # detail mode: one real span per element call, with timestamps
+    assert len(spans) >= 2 * n
+    assert all(e["dur"] > 0 for e in spans)
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert any("out" == nm for nm in names)
+    assert any(e["ph"] == "C" for e in events)  # fps counters
 
 
 def test_no_tracer_by_default():
