@@ -39,7 +39,9 @@ def enable(cache_dir: Optional[str] = None) -> Optional[str]:
     in use, or None when disabled by config/error."""
     global _enabled
     with _lock:
-        if _enabled is not None:
+        if _enabled is not None and not (cache_dir and not _enabled):
+            # sticky result — except that an explicit cache_dir may retry
+            # after an earlier failure/disable
             return _enabled or None
         raw = (
             cache_dir
@@ -51,18 +53,19 @@ def enable(cache_dir: Optional[str] = None) -> Optional[str]:
             return None
         path = os.path.expanduser(raw)
         try:
-            os.makedirs(path, exist_ok=True)
-            import jax
-
-            jax.config.update("jax_compilation_cache_dir", path)
-            # cache even fast compiles (min 0): streaming pipelines
-            # recompile per shape bucket, and those sub-second compiles
-            # are exactly the ones worth persisting
+            # parse every knob BEFORE mutating jax.config so a bad ini
+            # value cannot leave the cache half-enabled.  min 0: streaming
+            # pipelines recompile per shape bucket, and those sub-second
+            # compiles are exactly the ones worth persisting.
             min_secs = float(
                 nns_config.get_value(
                     "xla", "cache_min_compile_secs", "0.0"
                 )
             )
+            os.makedirs(path, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", path)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", min_secs
             )

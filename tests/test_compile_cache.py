@@ -20,16 +20,20 @@ def test_enable_creates_dir_and_sets_config(tmp_path, monkeypatch):
     from nnstreamer_tpu.core import config as nns_config
 
     nns_config.reset()
+    import jax
+
+    prior = jax.config.jax_compilation_cache_dir
     try:
         got = compile_cache.enable()
         assert got == target
         assert os.path.isdir(target)
-        import jax
-
         assert jax.config.jax_compilation_cache_dir == target
         # idempotent: second call returns the same dir, no re-init
         assert compile_cache.enable() == target
     finally:
+        # restore the process-global flag: later tests must not write
+        # cache entries into this test's doomed tmp_path
+        jax.config.update("jax_compilation_cache_dir", prior)
         compile_cache.reset_for_tests()
         monkeypatch.delenv("NNS_TPU_XLA_CACHE_DIR")
         nns_config.reset()

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Among-device fan-out scaling: one client round-robining a model over N
+server pipelines (BASELINE.md row 2: "multi-stream via tensor_query
+fan-out, linear 1->8 chips").
+
+Real multi-chip hardware is not reachable from this harness, so this
+measures the SCALING SHAPE on localhost: N OS processes each run a
+serversrc -> tensor_filter -> serversink pipeline (≙ one chip's worth of
+serving), and the client fans frames across them with pipelined in-flight
+requests.  On a pod, each server process sits on its own chip and the
+same client code fans over hosts=chip0:p,chip1:p,... — the transport,
+round-robin, and in-flight machinery exercised here is exactly what runs
+there.
+
+Prints one JSON line per N with throughput and efficiency vs N=1.
+
+Env knobs:
+  FANOUT_NS        comma list of server counts (default "1,2,4")
+  FANOUT_FRAMES    frames per measurement (default 256)
+  FANOUT_WORK_MS   per-frame model cost to emulate, in ms (default 20)
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_SERVER = """
+import sys, time
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu.backends.custom_easy import register_custom_easy
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+# deterministic service time: on real hardware each server's chip spends
+# WORK_MS of device time per frame; on this shared-core host a CPU spin
+# would make every "chip" fight for the same cores and measure nothing,
+# so the device time is emulated with a sleep (GIL released, cores idle)
+# — what remains under test is exactly the part that exists at pod scale:
+# transport, round-robin fan-out, pipelined in-flight, ordered delivery.
+def serve(inputs):
+    time.sleep({work_ms} / 1000.0)
+    return [np.asarray(inputs[0])]
+
+register_custom_easy("sleepy", serve)
+pipe = parse_pipeline(
+    "tensor_query_serversrc name=src port=0 ! "
+    "tensor_filter framework=custom-easy model=sleepy ! "
+    "tensor_query_serversink"
+)
+pipe.start()
+print("PORT", pipe["src"].props["port"], flush=True)
+time.sleep(600)
+"""
+
+
+def run_scale(n_servers: int, frames: int, work_ms: float) -> float:
+    import numpy as np
+
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    procs, ports = [], []
+    script = _SERVER.format(root=ROOT, work_ms=work_ms)
+    try:
+        for _ in range(n_servers):
+            p = subprocess.Popen(
+                [sys.executable, "-c", script],
+                stdout=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.startswith("PORT "), line
+            ports.append(int(line.split()[1]))
+
+        hosts = ",".join(f"127.0.0.1:{pt}" for pt in ports)
+        pipe = parse_pipeline(
+            f"appsrc name=a max-buffers={frames + 8} ! "
+            f"tensor_query_client hosts={hosts} timeout=60 "
+            f"max-in-flight={4 * n_servers} ! tensor_sink name=out",
+            name=f"fanout{n_servers}",
+        )
+        pipe.start()
+        frame = np.zeros((8,), np.float32)
+        # warmup (server-side jit compile on every server)
+        for _ in range(2 * n_servers):
+            pipe["a"].push(frame)
+        deadline = time.time() + 120
+        while len(pipe["out"].frames) < 2 * n_servers and time.time() < deadline:
+            time.sleep(0.02)
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            pipe["a"].push(frame)
+        pipe["a"].end_of_stream()
+        pipe.wait(timeout=300)
+        done = len(pipe["out"].frames) - 2 * n_servers
+        dt = time.perf_counter() - t0
+        pipe.stop()
+        return done / dt
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ns = [int(x) for x in os.environ.get("FANOUT_NS", "1,2,4").split(",")]
+    frames = int(os.environ.get("FANOUT_FRAMES", "256"))
+    work_ms = float(os.environ.get("FANOUT_WORK_MS", "20"))
+    base = None
+    for ns_i in ns:
+        fps = run_scale(ns_i, frames, work_ms)
+        if base is None:
+            base = fps
+        print(json.dumps({
+            "metric": "query_fanout_scaling_fps",
+            "n_servers": ns_i,
+            "value": round(fps, 1),
+            "unit": "fps",
+            "efficiency_vs_1": round(fps / (base * ns_i), 3),
+            "work_ms_per_frame": work_ms,
+            "platform": "cpu-proxy",
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
