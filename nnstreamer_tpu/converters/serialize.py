@@ -3,8 +3,10 @@
 Reference: ``ext/nnstreamer/tensor_converter/tensor_converter_{flexbuf,
 flatbuf,protobuf}.cc`` — parse a framework-neutral byte schema back into an
 ``other/tensors`` frame; the exact inverse of the same-named decoder
-subplugins (decoders/serialize.py).  All three modes share this framework's
-canonical wire format (``distributed/wire.py``).
+subplugins (decoders/serialize.py).  flexbuf/flatbuf speak the canonical
+wire format (``distributed/wire.py``); protobuf parses the PUBLIC
+``nns_tensors.proto`` schema, so non-framework producers with only a
+protobuf runtime interop here.
 """
 
 from __future__ import annotations
@@ -18,16 +20,21 @@ from ..distributed import wire
 
 class _DeserializeBase:
     NAME = "deserialize"
+    IDL = "flex"  # wire.get_codec name
 
     def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
         return ANY  # per-payload shapes; known only after decode
 
     def convert(self, frame: TensorFrame) -> TensorFrame:
+        _, decode = wire.get_codec(self.IDL)
         t = frame.tensors[0]
         payload = bytes(t) if isinstance(t, (bytes, bytearray, memoryview)) \
             else np.ascontiguousarray(np.asarray(t)).tobytes()
-        decoded = wire.decode_frame(payload)
+        decoded = decode(payload)
         out = frame.with_tensors(list(decoded.tensors))
+        # with_tensors aliases the input frame's meta; copy before editing
+        # so tee siblings sharing the frame keep their own metadata
+        out.meta = dict(out.meta)
         for k, v in decoded.meta.items():
             out.meta.setdefault(k, v)
         out.meta.pop("media_type", None)  # now a plain tensor stream again
@@ -44,3 +51,4 @@ class FlatbufConverter(_DeserializeBase):
 
 class ProtobufConverter(_DeserializeBase):
     NAME = "protobuf"
+    IDL = "protobuf"

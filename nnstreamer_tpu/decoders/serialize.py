@@ -4,11 +4,14 @@ Reference: ``ext/nnstreamer/tensor_decoder/tensordec-{flexbuf,flatbuf,
 protobuf}.cc`` — serialize an ``other/tensors`` frame into a framework-
 neutral byte schema so non-GStreamer consumers can parse it.
 
-TPU-native shape: all three modes share this framework's canonical wire
-format (``distributed/wire.py`` — the same schema the gRPC query/edge layer
-speaks, analog of ``nnstreamer.proto`` / ``nnstreamer.fbs``), tagged with a
-mode marker so the matching converter subplugin can round-trip.  Output is a
-single uint8 tensor carrying the encoded frame.
+TPU-native shape: the flexbuf/flatbuf modes share this framework's
+canonical wire format (``distributed/wire.py``, analog of
+``nnstreamer.fbs``); the protobuf mode emits the PUBLIC
+``nns_tensors.proto`` schema (``distributed/protobuf_codec.py``) so a
+peer with only a protobuf runtime can parse the stream — the reference's
+``tensordec-protobuf.cc`` interop contract.  Output is a single uint8
+tensor carrying the encoded frame; the matching converter subplugin
+(converters/serialize.py) is the exact inverse.
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ from ..distributed import wire
 class _SerializeBase:
     NAME = "serialize"
     MEDIA = "other/wire"
+    IDL = "flex"  # wire.get_codec name
 
     def set_options(self, options) -> None:
         pass
@@ -32,8 +36,12 @@ class _SerializeBase:
                           in_spec.framerate if in_spec else None)
 
     def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
-        payload = wire.encode_frame(frame)
+        encode, _ = wire.get_codec(self.IDL)
+        payload = encode(frame)
         out = frame.with_tensors([np.frombuffer(payload, np.uint8)])
+        # with_tensors aliases the input frame's meta dict; copy before
+        # stamping so tee siblings sharing the frame never see our keys
+        out.meta = dict(out.meta)
         out.meta["media_type"] = self.MEDIA
         return out
 
@@ -51,3 +59,4 @@ class FlatbufDecoder(_SerializeBase):
 class ProtobufDecoder(_SerializeBase):
     NAME = "protobuf"
     MEDIA = "other/protobuf-tensor"
+    IDL = "protobuf"

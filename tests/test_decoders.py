@@ -288,12 +288,17 @@ def test_octet_stream_concat():
     ("protobuf", "other/protobuf-tensor"),
 ])
 def test_serialize_roundtrip(mode, media):
-    from nnstreamer_tpu.distributed import wire
+    # round-trip through the matching converter subplugin (protobuf mode
+    # speaks the public nns_tensors.proto; flexbuf/flatbuf the canonical
+    # NNSQ framing — either way decoder+converter must be exact inverses)
+    import nnstreamer_tpu.converters  # noqa: F401 — registers subplugins
+    from nnstreamer_tpu.core.registry import KIND_CONVERTER, get
     t = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
     dec = get_decoder(mode)
     out = dec.decode(frame(t), ANY)
     assert out.meta["media_type"] == media
-    back = wire.decode_frame(bytes(out.tensors[0]))
+    conv = get(KIND_CONVERTER, mode)()
+    back = conv.convert(out)
     np.testing.assert_array_equal(np.asarray(back.tensors[0]), t)
 
 
