@@ -32,9 +32,6 @@ class _DeserializeBase:
             else np.ascontiguousarray(np.asarray(t)).tobytes()
         decoded = decode(payload)
         out = frame.with_tensors(list(decoded.tensors))
-        # with_tensors aliases the input frame's meta; copy before editing
-        # so tee siblings sharing the frame keep their own metadata
-        out.meta = dict(out.meta)
         for k, v in decoded.meta.items():
             out.meta.setdefault(k, v)
         out.meta.pop("media_type", None)  # now a plain tensor stream again

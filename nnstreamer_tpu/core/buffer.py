@@ -50,11 +50,20 @@ class TensorFrame:
 
     def pick(self, indices: Sequence[int]) -> "TensorFrame":
         """input-combination / tensorpick subset-reorder."""
-        return replace(self, tensors=[self.tensors[i] for i in indices])
+        return replace(
+            self,
+            tensors=[self.tensors[i] for i in indices],
+            meta=dict(self.meta),
+        )
 
     def with_tensors(self, tensors: Sequence[Any]) -> "TensorFrame":
-        """New frame with same timestamps/meta, different payload."""
-        return replace(self, tensors=list(tensors))
+        """New frame with same timestamps, COPIED meta, different payload.
+
+        Meta is copied, not aliased: derived frames get stamped with new
+        keys by decoders/elements, and a tee sibling sharing the source
+        frame must never see those (the payload-sharing contract covers
+        tensors only)."""
+        return replace(self, tensors=list(tensors), meta=dict(self.meta))
 
     def spec(self) -> StreamSpec:
         """Derive the concrete schema of this frame."""

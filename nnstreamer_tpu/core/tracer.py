@@ -39,7 +39,7 @@ class _ElementStats:
     __slots__ = (
         "frames", "calls", "proc_ring", "t_first", "t_last",
         "inter_sum", "inter_max", "inter_n", "bytes", "q_sum", "q_max",
-        "q_n", "q_cap",
+        "q_n", "q_cap", "sched_ring", "t_prev_in",
     )
 
     def __init__(self) -> None:
@@ -56,6 +56,10 @@ class _ElementStats:
         self.q_max = 0
         self.q_n = 0
         self.q_cap = 0
+        # scheduletime: gap between consecutive call starts (GstShark's
+        # scheduling-jitter view)
+        self.sched_ring: deque = deque(maxlen=1024)
+        self.t_prev_in: Optional[float] = None
 
 
 class PipelineTracer:
@@ -67,6 +71,8 @@ class PipelineTracer:
         self._stats: Dict[str, _ElementStats] = {}
         self._lock = threading.Lock()
         self.t_started = time.perf_counter()
+        # cpuusage: process CPU time vs wall time over the traced window
+        self._cpu_started = time.process_time()
         # detail mode additionally keeps per-call spans (bounded ring) so
         # export_chrome_trace renders a real timeline, not just aggregates
         self._detail = detail
@@ -95,6 +101,9 @@ class PipelineTracer:
         st.calls += 1
         st.frames += nframes
         st.proc_ring.append(t_out - t_in)
+        if st.t_prev_in is not None:
+            st.sched_ring.append(t_in - st.t_prev_in)
+        st.t_prev_in = t_in
         if st.t_first is None:
             st.t_first = t_out
         st.t_last = t_out
@@ -114,22 +123,46 @@ class PipelineTracer:
         return st
 
     # -- reporting ----------------------------------------------------------
+    def cpu_usage(self) -> float:
+        """Process CPU seconds per wall second since tracing began
+        (GstShark cpuusage analog; >1.0 = more than one busy core)."""
+        wall = time.perf_counter() - self.t_started
+        if wall <= 0:
+            return 0.0
+        return (time.process_time() - self._cpu_started) / wall
+
+    @staticmethod
+    def _snap(dq: deque) -> list:
+        """Copy a ring that worker threads append to without locks: a
+        full ring's append also evicts, which makes a concurrent
+        list(deque) raise — retry, then settle for empty."""
+        for _ in range(4):
+            try:
+                return list(dq)
+            except RuntimeError:
+                continue
+        return []
+
     def report(self) -> Dict[str, Dict[str, Any]]:
         out: Dict[str, Dict[str, Any]] = {}
         for name, st in list(self._stats.items()):
-            ring = list(st.proc_ring)
+            ring = self._snap(st.proc_ring)
             span = (
                 (st.t_last - st.t_first)
                 if st.t_first is not None and st.t_last != st.t_first
                 else 0.0
             )
             proc = np.asarray(ring) if ring else np.zeros(1)
+            sched = self._snap(st.sched_ring)
             out[name] = {
                 "frames": st.frames,
                 "calls": st.calls,
                 "proctime_us_avg": float(proc.mean()) * 1e6,
                 "proctime_us_p50": float(np.percentile(proc, 50)) * 1e6,
                 "proctime_us_p99": float(np.percentile(proc, 99)) * 1e6,
+                "scheduletime_us_avg": (
+                    float(np.mean(sched)) * 1e6 if sched else None
+                ),
                 "framerate_fps": (st.frames / span) if span else 0.0,
                 "interlatency_ms_avg": (
                     st.inter_sum / st.inter_n * 1e3 if st.inter_n else None
@@ -161,6 +194,7 @@ class PipelineTracer:
                 f"{inter:>9} {r['bitrate_mbps']:>8.2f} "
                 f"{r['queuelevel_avg']:>4.1f}/{r['queue_capacity']}"
             )
+        lines.append(f"cpu usage: {self.cpu_usage():.2f} cores")
         return lines
 
 

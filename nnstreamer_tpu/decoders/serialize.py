@@ -39,9 +39,6 @@ class _SerializeBase:
         encode, _ = wire.get_codec(self.IDL)
         payload = encode(frame)
         out = frame.with_tensors([np.frombuffer(payload, np.uint8)])
-        # with_tensors aliases the input frame's meta dict; copy before
-        # stamping so tee siblings sharing the frame never see our keys
-        out.meta = dict(out.meta)
         out.meta["media_type"] = self.MEDIA
         return out
 

@@ -300,6 +300,13 @@ def test_serialize_roundtrip(mode, media):
     conv = get(KIND_CONVERTER, mode)()
     back = conv.convert(out)
     np.testing.assert_array_equal(np.asarray(back.tensors[0]), t)
+    if mode == "protobuf":
+        # the payload must ALSO parse with the public proto codec alone —
+        # the decoder/converter pair agreeing is not the interop contract
+        from nnstreamer_tpu.distributed import protobuf_codec
+
+        ext = protobuf_codec.decode_frame(bytes(out.tensors[0]))
+        np.testing.assert_array_equal(np.asarray(ext.tensors[0]), t)
 
 
 def test_python3_decoder(tmp_path):

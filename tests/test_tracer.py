@@ -45,6 +45,10 @@ def test_tracer_counts_and_latency():
     assert transform["bitrate_mbps"] >= 0
     # queue levels sampled with a real capacity
     assert sink["queue_capacity"] > 0
+    # scheduletime: inter-dequeue gap measured after the first call
+    assert transform["scheduletime_us_avg"] is not None
+    assert transform["scheduletime_us_avg"] > 0
+    assert tracer.cpu_usage() >= 0.0
 
 
 def test_tracer_summary_renders():
