@@ -1,0 +1,83 @@
+"""Capstone cascade: the reference's flagship demo shape in one pipeline —
+
+  camera -> tee -> [detector -> tensor_region]  (crop-info branch)
+              \\-> tensor_crop (raw branch)
+  tensor_crop -> python3 resize -> classifier -> image_label -> sink
+
+Exercises tee fan-out, two jax-xla filters (SSD detector + MobileNet
+classifier), the tensor_region/tensor_crop pairing, a python3 scriptable
+filter in the middle, and decoder labeling — the multi-model composition
+story (SURVEY §2.3 "model parallelism (composition)").
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+RESIZE_SCRIPT = """
+import numpy as np
+
+SIZE = 64
+
+class CustomFilter:
+    def invoke(self, tensors):
+        # nearest-neighbor resize of a (H, W[, C]) crop to SIZE x SIZE
+        img = np.asarray(tensors[0])
+        if img.ndim == 2:
+            img = img[:, :, None].repeat(3, axis=2)
+        H, W = img.shape[:2]
+        ys = (np.arange(SIZE) * H // SIZE).clip(0, H - 1)
+        xs = (np.arange(SIZE) * W // SIZE).clip(0, W - 1)
+        return [img[ys][:, xs].astype(np.uint8)]
+"""
+
+
+def test_detect_crop_classify_cascade(tmp_path):
+    from nnstreamer_tpu.backends.jax_xla import register_jax_model
+    from nnstreamer_tpu.models import build
+    from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+
+    det_fn, det_p, det_i, det_o = build(
+        "ssd_mobilenet_v2", {"dtype": "float32"}
+    )
+    register_jax_model("cascade_det", det_fn, det_p, det_i, det_o)
+    cls_fn, cls_p, cls_i, cls_o = build(
+        "mobilenet_v2", {"dtype": "float32", "size": "64"}
+    )
+    register_jax_model("cascade_cls", cls_fn, cls_p, cls_i, cls_o)
+
+    priors = write_box_priors(str(tmp_path / "priors.txt"))
+    labels = tmp_path / "labels.txt"
+    labels.write_text("\n".join(f"class{i}" for i in range(1001)))
+    resize = tmp_path / "resize.py"
+    resize.write_text(RESIZE_SCRIPT)
+
+    n_frames = 3
+    pipe = parse_pipeline(
+        "appsrc name=cam ! tee name=t "
+        "t. ! queue ! c. "
+        "t. ! queue ! tensor_filter framework=jax-xla model=cascade_det ! "
+        f"tensor_decoder mode=tensor_region option1=2 option3={priors} "
+        "option4=300:300 ! c. "
+        "tensor_crop name=c ! "
+        f"tensor_filter framework=python3 model={resize} ! "
+        "tensor_filter framework=jax-xla model=cascade_cls ! "
+        f"tensor_decoder mode=image_labeling option1={labels} ! "
+        "tensor_sink name=out",
+        name="cascade",
+    )
+    pipe.start()
+    rng = np.random.default_rng(7)
+    for _ in range(n_frames):
+        pipe["cam"].push(rng.integers(0, 255, (300, 300, 3), np.uint8))
+    pipe["cam"].end_of_stream()
+    pipe.wait(timeout=180)
+    outs = pipe["out"].frames
+    pipe.stop()
+
+    # one labeled frame per camera frame (top crop region classified)
+    assert len(outs) == n_frames
+    for f in outs:
+        assert f.meta.get("label", "").startswith("class")
+        idx = int(np.asarray(f.tensors[0])[0])
+        assert 0 <= idx < 1001
