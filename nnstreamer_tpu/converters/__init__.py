@@ -10,3 +10,4 @@ registry.register_lazy(registry.KIND_CONVERTER, "flexbuf", "nnstreamer_tpu.conve
 registry.register_lazy(registry.KIND_CONVERTER, "flatbuf", "nnstreamer_tpu.converters.serialize:FlatbufConverter")
 registry.register_lazy(registry.KIND_CONVERTER, "protobuf", "nnstreamer_tpu.converters.serialize:ProtobufConverter")
 registry.register_lazy(registry.KIND_CONVERTER, "python3", "nnstreamer_tpu.converters.python3:Python3Converter")
+registry.register_lazy(registry.KIND_CONVERTER, "tokenizer", "nnstreamer_tpu.converters.tokenizer:TokenizerConverter")

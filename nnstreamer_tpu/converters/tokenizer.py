@@ -1,0 +1,39 @@
+"""tokenizer converter: text bytes -> int32 token ids (net-new).
+
+The reference frames text as fixed-size uint8 tensors
+(``gsttensor_converter.c`` text chain) and stops there — it has no LLM
+serving story.  This subplugin completes the textual pipeline for the
+transformer family: byte-level tokenization (ids 0-255, the zoo
+transformer's default vocab) so
+
+    appsrc ! tensor_converter mode=custom:tokenizer
+        ! tensor_filter custom=arch:transformer,generate:N
+        ! tensor_decoder mode=detokenizer ! tensor_sink
+
+round-trips prompt text to completion text (the tokenizer consumes raw
+text bytes directly — no fixed-size text framing stage, whose NUL
+padding would append id-0 tokens to every prompt).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import ANY, StreamSpec
+
+
+class TokenizerConverter:
+    NAME = "tokenizer"
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        return ANY  # token count = byte count, known per frame
+
+    def convert(self, frame: TensorFrame) -> TensorFrame:
+        t = frame.tensors[0]
+        raw = bytes(t) if isinstance(t, (bytes, bytearray, memoryview)) \
+            else np.ascontiguousarray(np.asarray(t)).tobytes()
+        toks = np.frombuffer(raw, np.uint8).astype(np.int32)
+        out = frame.with_tensors([toks])
+        out.meta.pop("media_type", None)
+        return out

@@ -16,3 +16,4 @@ registry.register_lazy(registry.KIND_DECODER, "flexbuf", "nnstreamer_tpu.decoder
 registry.register_lazy(registry.KIND_DECODER, "flatbuf", "nnstreamer_tpu.decoders.serialize:FlatbufDecoder")
 registry.register_lazy(registry.KIND_DECODER, "protobuf", "nnstreamer_tpu.decoders.serialize:ProtobufDecoder")
 registry.register_lazy(registry.KIND_DECODER, "python3", "nnstreamer_tpu.decoders.python3:Python3Decoder")
+registry.register_lazy(registry.KIND_DECODER, "detokenizer", "nnstreamer_tpu.decoders.detokenizer:Detokenizer")

@@ -1,0 +1,36 @@
+"""detokenizer decoder: int32 token ids -> text bytes (net-new).
+
+Inverse of the tokenizer converter (converters/tokenizer.py): byte-level
+ids (0-255) become utf-8-ish bytes; out-of-range ids clamp to '?'.  The
+decoded text also lands in ``meta["text"]`` (mirroring image_labeling's
+``meta["label"]`` contract) so sinks can read it without byte-wrangling.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import FORMAT_FLEXIBLE, StreamSpec
+
+
+class Detokenizer:
+    NAME = "detokenizer"
+
+    def set_options(self, options: List[str]) -> None:
+        pass
+
+    def get_out_spec(self, in_spec: StreamSpec) -> StreamSpec:
+        return StreamSpec((), FORMAT_FLEXIBLE,
+                          in_spec.framerate if in_spec else None)
+
+    def decode(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        toks = np.asarray(frame.tensors[0]).ravel()
+        ok = (toks >= 0) & (toks < 256)
+        data = np.where(ok, toks, ord("?")).astype(np.uint8)
+        out = frame.with_tensors([data])
+        out.meta["media_type"] = "text"
+        out.meta["text"] = data.tobytes().decode("utf-8", errors="replace")
+        return out

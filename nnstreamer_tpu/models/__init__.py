@@ -21,6 +21,7 @@ _ZOO = {
     "transformer": "nnstreamer_tpu.models.transformer",
     "deeplab": "nnstreamer_tpu.models.deeplab",
     "kws_cnn": "nnstreamer_tpu.models.kws_cnn",
+    "vit": "nnstreamer_tpu.models.vit",
 }
 
 

@@ -1,0 +1,157 @@
+"""ViT image classifier (flax) — the transformer-era vision family.
+
+The reference's model zoo is convnet-centric (per-vendor tflite/onnx
+classifiers); a Vision Transformer is the TPU-native complement: patch
+embedding + attention blocks are large dense matmuls that map straight
+onto the MXU, and the encoder reuses this framework's transformer Block
+machinery (``models/transformer.py``) including the flash-attention
+Pallas kernel via ``attn:flash``.
+
+Zoo entry ``vit``: fn(params, [images_u8 (N,S,S,3)]) -> [logits (N,classes)].
+Props: size (default 224), patch (16), d_model (192), heads (3),
+layers (6), d_ff (768), classes (1001), dtype, attn (xla|flash).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from ._init_util import host_init
+
+
+class EncoderBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):  # (B, T, D), pre-norm ViT block
+        B, T, D = x.shape
+        H = self.n_heads
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(
+            3 * D, use_bias=False, dtype=self.dtype, name="attn_qkv"
+        )(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, T, H, D // H)
+        v = v.reshape(B, T, H, D // H)
+        if self.attn_impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            a = flash_attention(q, k, v, causal=False)
+        else:
+            from ..parallel.ring_attention import reference_attention
+
+            a = reference_attention(q, k, v, causal=False)
+        x = x + nn.Dense(
+            D, use_bias=False, dtype=self.dtype, name="attn_out"
+        )(a.reshape(B, T, D))
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = jax.nn.gelu(h)
+        return x + nn.Dense(
+            D, use_bias=False, dtype=self.dtype, name="mlp_down"
+        )(h)
+
+
+class ViT(nn.Module):
+    size: int = 224
+    patch: int = 16
+    d_model: int = 192
+    n_heads: int = 3
+    n_layers: int = 6
+    d_ff: int = 768
+    num_classes: int = 1001
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):  # (B, S, S, 3) uint8 or float
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) * (2.0 / 255.0) - 1.0
+        else:
+            x = x.astype(self.dtype)
+        # patchify as one conv: the embedding matmul the MXU loves
+        x = nn.Conv(
+            self.d_model, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        B = x.shape[0]
+        x = x.reshape(B, -1, self.d_model)  # (B, T, D)
+        T = x.shape[1]
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.d_model)
+        ).astype(self.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.d_model)), x], 1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, T + 1, self.d_model),
+        ).astype(self.dtype)
+        x = x + pos
+        for i in range(self.n_layers):
+            x = EncoderBlock(
+                self.d_model, self.n_heads, self.d_ff,
+                dtype=self.dtype, attn_impl=self.attn_impl,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="head"
+        )(x[:, 0].astype(jnp.float32))
+
+
+def build(custom_props=None):
+    """Zoo entry: fn(params, [images_u8 (N,S,S,3)]) -> [logits]."""
+    props = custom_props or {}
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[
+        props.get("dtype", "bfloat16")
+    ]
+    size = int(props.get("size", "224"))
+    patch = int(props.get("patch", "16"))
+    if size % patch:
+        raise ValueError(f"size {size} not divisible by patch {patch}")
+    model = ViT(
+        size=size,
+        patch=patch,
+        d_model=int(props.get("d_model", "192")),
+        n_heads=int(props.get("heads", "3")),
+        n_layers=int(props.get("layers", "6")),
+        d_ff=int(props.get("d_ff", "768")),
+        num_classes=int(props.get("classes", "1001")),
+        dtype=dtype,
+        attn_impl=props.get("attn", "xla"),
+    )
+    variables = host_init(
+        model.init,
+        int(props.get("seed", "0")),
+        np.zeros((1, size, size, 3), np.uint8),
+    )
+
+    def fn(params, inputs: List[Any]) -> List[Any]:
+        x = inputs[0]
+        single = x.ndim == 3
+        if single:
+            x = x[None]
+        out = model.apply(params, x)
+        return [out[0] if single else out]
+
+    in_spec = StreamSpec(
+        (TensorSpec((size, size, 3), np.uint8, "image"),), FORMAT_STATIC
+    )
+    out_spec = StreamSpec(
+        (TensorSpec((model.num_classes,), np.float32, "logits"),),
+        FORMAT_STATIC,
+    )
+    return fn, variables, in_spec, out_spec

@@ -29,17 +29,20 @@ _NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN in exp-diff
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, seq_len: int, block_q: int):
+                  scale: float, seq_len: int, block_q: int, valid_len: int):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     q_ref (block_q, D); k_ref/v_ref (T, D) — the whole K/V for this head
     (the wrapper budget-checks VMEM and falls back to the XLA reference
     path when a head's K/V would not fit); o_ref (block_q, D).
+    ``valid_len`` < seq_len marks wrapper padding: K columns at or past it
+    are masked out (static python int — the mask compiles to constants).
     """
     qi = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
     D = q.shape[-1]
     n_kv = seq_len // block_k
+    padded = valid_len < seq_len
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
@@ -50,14 +53,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             v_ref[:], j * block_k, block_k, axis=0
         ).astype(jnp.float32)
         s = q @ k.T  # (block_q, block_k) on the MXU
+        if causal or padded:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if padded:
+            s = jnp.where(k_pos < valid_len, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -89,16 +95,17 @@ except ImportError:  # pragma: no cover
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "block_q", "block_k", "interpret",
+                     "valid_len"),
 )
 def _flash_bh(qf, kf, vf, causal: bool, block_q: int, block_k: int,
-              interpret: bool):
+              interpret: bool, valid_len: int):
     """(BH, T, D) inputs -> (BH, T, D); grid over (BH, T/block_q)."""
     BH, T, D = qf.shape
     scale = 1.0 / (D**0.5)
     kern = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-        seq_len=T, block_q=block_q,
+        seq_len=T, block_q=block_q, valid_len=valid_len,
     )
     return pl.pallas_call(
         kern,
@@ -129,12 +136,21 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     # slower than XLA.  Off-TPU without an explicit request -> reference.
     if interpret is None:
         interpret = False
+    # non-divisible T (e.g. ViT's (S/p)^2 + 1 tokens): pad K/V/Q up to a
+    # block multiple; padded K columns are masked inside the kernel via
+    # the static valid_len, padded Q rows are sliced off below
+    bq, bk = min(block_q, T), min(block_k, T)
+    T_pad = T
+    if T % bq or T % bk:
+        blk = max(bq, bk)
+        T_pad = -(-T // blk) * blk
+        bq, bk = min(block_q, T_pad), min(block_k, T_pad)
     # VMEM budget: the kernel holds one head's full K/V plus the q block
     # and f32 accumulators; past ~3/4 of the ~16 MB VMEM, fall back to the
     # reference path instead of an opaque Mosaic overflow
     itemsize = jnp.dtype(q.dtype).itemsize
-    vmem_est = (2 * T * D) * itemsize + block_q * D * (itemsize + 4) \
-        + block_q * block_k * 4
+    vmem_est = (2 * T_pad * D) * itemsize + bq * D * (itemsize + 4) \
+        + bq * bk * 4
     if (
         pl is None
         or (platform != "tpu" and not interpret)
@@ -147,17 +163,19 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
         from ..parallel.ring_attention import reference_attention
 
         return reference_attention(q, k, v, causal=causal).astype(q.dtype)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"flash_attention needs T ({T}) divisible by block_q/block_k "
-            f"({block_q}/{block_k})"
-        )
     # (B, T, H, D) -> (B*H, T, D): each (batch, head) is one independent
     # attention problem; kernel VMEM holds one head's K/V
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    out = _flash_bh(qf, kf, vf, causal, block_q, block_k, bool(interpret))
+    if T_pad != T:
+        pad = ((0, 0), (0, T_pad - T), (0, 0))
+        qf = jnp.pad(qf, pad)
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    out = _flash_bh(
+        qf, kf, vf, causal, bq, bk, bool(interpret), valid_len=T
+    )
+    if T_pad != T:
+        out = out[:, :T]
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -55,10 +55,21 @@ class TestFlashAttention:
             np.asarray(out, np.float32), np.asarray(ref), atol=0.08
         )
 
-    def test_indivisible_seq_rejected(self):
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_indivisible_seq_pads_and_masks(self, causal):
+        """T not divisible by the block: the wrapper pads K/V/Q and the
+        kernel masks the padded columns via static valid_len — results
+        must equal the reference exactly (padding must not leak into the
+        softmax)."""
         q, k, v = _qkv(T=100)
-        with pytest.raises(ValueError, match="divisible"):
-            flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+        )
+        ref = reference_attention(q, k, v, causal=causal)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
 
     def test_transformer_attn_prop(self):
         from nnstreamer_tpu.models import build

@@ -18,6 +18,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROWS = [
     ("mobilenet", {}),
     ("mobilenet", {"BENCH_HOST": "1"}),
+    ("mobilenet", {"BENCH_QUANT": "1"}),  # int8 MXU path
     ("ssd", {}),
     ("yolov5", {}),
     ("posenet", {}),
