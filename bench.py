@@ -83,12 +83,13 @@ def probe_backend(tries: int, timeout_s: float) -> str:
 
 
 def quant_applied(which: str) -> bool:
-    """True when BENCH_QUANT actually changes the model that runs — only
-    the mobilenet row has an int8 path; one definition keeps the executed
-    pipeline and the emitted row label in agreement."""
-    return which == "mobilenet" and os.environ.get("BENCH_QUANT", "") in (
-        "1", "int8",
-    )
+    """True when BENCH_QUANT actually changes the model that runs —
+    mobilenet (int8 convs) and vit (int8 dense) have int8 paths; one
+    definition keeps the executed pipeline and the emitted row label in
+    agreement."""
+    return which in ("mobilenet", "vit") and os.environ.get(
+        "BENCH_QUANT", ""
+    ) in ("1", "int8")
 
 
 METRICS = {
@@ -96,6 +97,7 @@ METRICS = {
     "ssd": ("ssd_mobilenet_v2_bbox_fps_per_chip", "fps"),
     "yolov5": ("yolov5s_bbox_fps_per_chip", "fps"),
     "posenet": ("posenet_pose_fps_per_chip", "fps"),
+    "vit": ("vit_image_labeling_fps_per_chip", "fps"),
     "mnist_trainer": ("mnist_cnn_trainer_epoch_seconds", "s"),
 }
 
@@ -150,6 +152,13 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
             "tensor_decoder mode=pose_estimation option1=257:257 "
             "option2=257:257 option4=heatmap-offset ! "
         )
+    elif which == "vit":
+        # transformer-era vision row (net-new vs BASELINE.md): flash
+        # attention on TPU, same labeling pipeline as the headline
+        size, family, props = 224, "vit", {"dtype": dtype, "attn": "flash"}
+        if quant_applied(which):
+            props["quantize"] = "int8"
+        decoder = f"tensor_decoder mode=image_labeling option1={labels_path} ! "
     else:
         raise SystemExit(f"unknown BENCH_MODEL {which!r}")
 

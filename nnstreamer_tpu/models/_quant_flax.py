@@ -1,0 +1,76 @@
+"""Flax drop-in modules for int8 MXU inference (ops/quantize.py).
+
+``QuantConv`` / ``QuantDense`` mirror ``nn.Conv`` / ``nn.Dense`` (bias-free
+forms) but run int8×int8→int32 with per-channel weight scales and
+per-sample dynamic activation scales.  Given the SAME submodule ``name``
+as the float module they replace, their param path — and therefore
+flax's per-param RNG fold — is identical, so quantized and float builds
+share identical weights for the same seed (pinned by tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class QuantConv(nn.Module):
+    """Drop-in for bias-free ``nn.Conv`` on the int8 MXU path."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: int = 1
+    feature_group_count: int = 1
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.quantize import int8_conv
+
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (
+                *self.kernel_size,
+                x.shape[-1] // self.feature_group_count,
+                self.features,
+            ),
+        )
+        return int8_conv(
+            x,
+            w,
+            strides=(self.strides, self.strides),
+            padding=self.padding,
+            feature_group_count=self.feature_group_count,
+            out_dtype=self.dtype,
+        )
+
+
+def dense_or_quant(quant: bool, features: int, dtype, name: str):
+    """The bias-free Dense layer factory shared by the transformer and
+    ViT blocks: ``nn.Dense`` normally, ``QuantDense`` under int8 — one
+    switch, so the quant path cannot drift between model families."""
+    if quant:
+        return QuantDense(features, dtype=dtype, name=name)
+    return nn.Dense(features, use_bias=False, dtype=dtype, name=name)
+
+
+class QuantDense(nn.Module):
+    """Drop-in for bias-free ``nn.Dense`` on the int8 MXU path."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.quantize import int8_dense
+
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+        )
+        return int8_dense(x, w, out_dtype=self.dtype)

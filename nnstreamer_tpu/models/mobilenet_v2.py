@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from ._init_util import host_init
+from ._quant_flax import QuantConv
 
 # (expansion t, channels c, repeats n, stride s) — standard v2 table
 _CFG: Sequence[Tuple[int, int, int, int]] = (
@@ -45,43 +46,6 @@ def _make_divisible(v: float, divisor: int = 8) -> int:
     return new_v
 
 
-class QuantConv(nn.Module):
-    """Drop-in for ``nn.Conv`` running int8×int8→int32 on the MXU
-    (ops/quantize.py): weights quantized per-channel in-graph (params stay
-    a plain float tree), activations dynamically.  ≙ the reference's
-    quantized-tflite execution, the MXU way.  Given ``name="Conv_0"`` its
-    param path — and therefore flax's per-param RNG fold — matches
-    ``nn.Conv``, so quantized and float builds share identical weights
-    for the same seed."""
-
-    features: int
-    kernel_size: Tuple[int, int]
-    strides: int = 1
-    feature_group_count: int = 1
-    dtype: Any = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x):
-        from ..ops.quantize import int8_conv
-
-        w = self.param(
-            "kernel",
-            nn.initializers.lecun_normal(),
-            (
-                *self.kernel_size,
-                x.shape[-1] // self.feature_group_count,
-                self.features,
-            ),
-        )
-        return int8_conv(
-            x,
-            w,
-            strides=(self.strides, self.strides),
-            feature_group_count=self.feature_group_count,
-            out_dtype=self.dtype,
-        )
-
-
 class ConvBN(nn.Module):
     features: int
     kernel: Tuple[int, int] = (3, 3)
@@ -94,6 +58,8 @@ class ConvBN(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.quant:
+            # name="Conv_0" keeps the param path (and RNG fold) identical
+            # to nn.Conv: quantized and float builds share weights
             x = QuantConv(
                 self.features,
                 self.kernel,

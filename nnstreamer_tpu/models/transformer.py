@@ -41,9 +41,10 @@ class TransformerConfig:
     # auto (ulysses when heads divide sp, else ring) | ring | ulysses
     sp_strategy: str = "auto"
     # single-device attention kernel: xla (fused reference) | flash
-    # (Pallas online-softmax kernel, ops/flash_attention.py; needs
-    # T % 128 == 0 on TPU)
+    # (Pallas online-softmax kernel, ops/flash_attention.py)
     attn_impl: str = "xla"
+    # int8 MXU dense layers (_quant_flax.QuantDense; quantize:int8 prop)
+    quant: bool = False
 
 
 class Block(nn.Module):
@@ -52,13 +53,19 @@ class Block(nn.Module):
     seq_axis: str = "sp"
     decode: bool = False  # KV-cache single-token step (generation serving)
 
+    def _dense(self, features, name):
+        from ._quant_flax import dense_or_quant
+
+        # same explicit name -> same param path/RNG fold either way
+        return dense_or_quant(self.cfg.quant, features, self.cfg.dtype, name)
+
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
         B, T, D = x.shape
         H = cfg.n_heads
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * D, use_bias=False, dtype=cfg.dtype, name="attn_qkv")(h)
+        qkv = self._dense(3 * D, "attn_qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
@@ -118,11 +125,11 @@ class Block(nn.Module):
         else:
             attn = reference_attention(q, k, v, causal=True)
         attn = attn.reshape(B, T, D)
-        x = x + nn.Dense(D, use_bias=False, dtype=cfg.dtype, name="attn_out")(attn)
+        x = x + self._dense(D, "attn_out")(attn)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype, name="mlp_up")(h)
+        h = self._dense(cfg.d_ff, "mlp_up")(h)
         h = jax.nn.gelu(h)
-        x = x + nn.Dense(D, use_bias=False, dtype=cfg.dtype, name="mlp_down")(h)
+        x = x + self._dense(D, "mlp_down")(h)
         return x
 
 
@@ -175,6 +182,7 @@ def _cfg_from_props(props: Dict[str, str]) -> TransformerConfig:
         dtype=dt,
         sp_strategy=props.get("sp_strategy", "auto"),
         attn_impl=props.get("attn", "xla"),
+        quant=props.get("quantize", "") == "int8",
     )
 
 

@@ -31,15 +31,20 @@ class EncoderBlock(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
+    quant: bool = False  # int8 MXU dense layers (_quant_flax.QuantDense)
+
+    def _dense(self, features, name):
+        from ._quant_flax import dense_or_quant
+
+        # same explicit name -> same param path/RNG fold either way
+        return dense_or_quant(self.quant, features, self.dtype, name)
 
     @nn.compact
     def __call__(self, x):  # (B, T, D), pre-norm ViT block
         B, T, D = x.shape
         H = self.n_heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        qkv = nn.Dense(
-            3 * D, use_bias=False, dtype=self.dtype, name="attn_qkv"
-        )(h)
+        qkv = self._dense(3 * D, "attn_qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
@@ -52,16 +57,11 @@ class EncoderBlock(nn.Module):
             from ..parallel.ring_attention import reference_attention
 
             a = reference_attention(q, k, v, causal=False)
-        x = x + nn.Dense(
-            D, use_bias=False, dtype=self.dtype, name="attn_out"
-        )(a.reshape(B, T, D))
+        x = x + self._dense(D, "attn_out")(a.reshape(B, T, D))
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                     name="mlp_up")(h)
+        h = self._dense(self.d_ff, "mlp_up")(h)
         h = jax.nn.gelu(h)
-        return x + nn.Dense(
-            D, use_bias=False, dtype=self.dtype, name="mlp_down"
-        )(h)
+        return x + self._dense(D, "mlp_down")(h)
 
 
 class ViT(nn.Module):
@@ -74,6 +74,7 @@ class ViT(nn.Module):
     num_classes: int = 1001
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):  # (B, S, S, 3) uint8 or float
@@ -104,7 +105,7 @@ class ViT(nn.Module):
             x = EncoderBlock(
                 self.d_model, self.n_heads, self.d_ff,
                 dtype=self.dtype, attn_impl=self.attn_impl,
-                name=f"block{i}",
+                quant=self.quant, name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         return nn.Dense(
@@ -132,6 +133,7 @@ def build(custom_props=None):
         num_classes=int(props.get("classes", "1001")),
         dtype=dtype,
         attn_impl=props.get("attn", "xla"),
+        quant=props.get("quantize", "") == "int8",
     )
     variables = host_init(
         model.init,

@@ -137,13 +137,18 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     if interpret is None:
         interpret = False
     # non-divisible T (e.g. ViT's (S/p)^2 + 1 tokens): pad K/V/Q up to a
-    # block multiple; padded K columns are masked inside the kernel via
-    # the static valid_len, padded Q rows are sliced off below
+    # multiple of BOTH block sizes; padded K columns are masked inside the
+    # kernel via the static valid_len, padded Q rows are sliced off below
     bq, bk = min(block_q, T), min(block_k, T)
     T_pad = T
     if T % bq or T % bk:
-        blk = max(bq, bk)
+        import math
+
+        blk = max(block_q, block_k)
+        if blk % min(block_q, block_k):
+            blk = math.lcm(block_q, block_k)
         T_pad = -(-T // blk) * blk
+        # T_pad >= blk >= both requested blocks, and divides both
         bq, bk = min(block_q, T_pad), min(block_k, T_pad)
     # VMEM budget: the kernel holds one head's full K/V plus the q block
     # and f32 accumulators; past ~3/4 of the ~16 MB VMEM, fall back to the

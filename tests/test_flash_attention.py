@@ -56,14 +56,19 @@ class TestFlashAttention:
         )
 
     @pytest.mark.parametrize("causal", [False, True])
-    def test_indivisible_seq_pads_and_masks(self, causal):
-        """T not divisible by the block: the wrapper pads K/V/Q and the
-        kernel masks the padded columns via static valid_len — results
-        must equal the reference exactly (padding must not leak into the
-        softmax)."""
+    @pytest.mark.parametrize(
+        "bq,bk", [(64, 64), (128, 64), (64, 128), (96, 64)]
+    )
+    def test_indivisible_seq_pads_and_masks(self, causal, bq, bk):
+        """T not divisible by the block (incl. MIXED block sizes with T
+        below the larger one): the wrapper pads K/V/Q to a common block
+        multiple and the kernel masks padded columns via static
+        valid_len — results must equal the reference exactly (padding
+        must never leak into the softmax, and no K columns / Q rows may
+        be silently dropped)."""
         q, k, v = _qkv(T=100)
         out = flash_attention(
-            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+            q, k, v, causal=causal, block_q=bq, block_k=bk, interpret=True
         )
         ref = reference_attention(q, k, v, causal=causal)
         assert out.shape == q.shape

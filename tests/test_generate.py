@@ -119,6 +119,22 @@ def test_chunked_prefill_logits_match_full_forward(rng):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
 
 
+def test_quantized_generation_runs(rng):
+    """quantize:int8 composes with generate:<N> (int8 dense layers inside
+    the KV-cache scan): same weights as float, valid token stream out."""
+    fn_q, p_q, _, _ = build(
+        "transformer", {**PROPS, "generate": "4", "quantize": "int8"}
+    )
+    fn_f, p_f, _, _ = build("transformer", PROPS)
+    for a, b in zip(jax.tree.leaves(p_q), jax.tree.leaves(p_f)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    prompt = rng.integers(0, PROPS["vocab"], (2, 6)).astype(np.int32)
+    out = np.asarray(jax.jit(lambda p, x: fn_q(p, [x])[0])(p_q, prompt))
+    assert out.shape == (2, 10)
+    np.testing.assert_array_equal(out[:, :6], prompt)
+    assert ((out >= 0) & (out < PROPS["vocab"])).all()
+
+
 def test_generate_rejects_overflow(rng):
     fn_gen, params, _, _ = build(
         "transformer", {**PROPS, "generate": "30"}

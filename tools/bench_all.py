@@ -22,6 +22,7 @@ ROWS = [
     ("ssd", {}),
     ("yolov5", {}),
     ("posenet", {}),
+    ("vit", {}),
     ("mnist_trainer", {}),
 ]
 
