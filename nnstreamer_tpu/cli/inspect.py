@@ -1,0 +1,93 @@
+"""nns-tpu-inspect: introspect elements and subplugins.
+
+≙ ``gst-inspect-1.0`` — list every registered element, or print one
+element's properties/pads (the reference CLI the launch/debug workflow
+leans on; SURVEY §1 L6 tooling).
+
+CLI:
+  nns-tpu-inspect                 # list all elements (+ subplugin kinds)
+  nns-tpu-inspect tensor_filter   # one element's properties and pads
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _ensure_registered() -> None:
+    """Import side-effect registration of every element/subplugin."""
+    import nnstreamer_tpu.converters  # noqa: F401
+    import nnstreamer_tpu.decoders  # noqa: F401
+    import nnstreamer_tpu.elements  # noqa: F401
+
+
+def _list_all(out) -> None:
+    from ..core import registry
+    from ..pipeline.element import ELEMENT_TYPES
+
+    out.write(f"{len(ELEMENT_TYPES)} elements:\n")
+    for name in sorted(ELEMENT_TYPES):
+        cls = ELEMENT_TYPES[name]
+        doc = (cls.__doc__ or "").strip().splitlines()
+        out.write(f"  {name:<24} {doc[0] if doc else ''}\n")
+    for kind in registry.KINDS:
+        names = sorted(registry.get_all(kind))
+        if names:
+            out.write(f"{len(names)} {kind} subplugins: {', '.join(names)}\n")
+
+
+def _inspect_one(name: str, out) -> int:
+    from ..pipeline.element import ELEMENT_TYPES, SinkElement, SourceElement
+
+    cls = ELEMENT_TYPES.get(name)
+    if cls is None:
+        close = [n for n in sorted(ELEMENT_TYPES) if name in n]
+        out.write(f"no element {name!r}")
+        out.write(f" (did you mean: {', '.join(close)})\n" if close else "\n")
+        return 1
+    out.write(f"Element: {name}\n")
+    if cls.__doc__:
+        for line in cls.__doc__.strip().splitlines():
+            out.write(f"  {line.strip()}\n")
+    kind = (
+        "source" if issubclass(cls, SourceElement)
+        else "sink" if issubclass(cls, SinkElement)
+        else "transform/filter"
+    )
+    out.write(f"Kind: {kind}\n")
+
+    def pads(n):  # None = request pads, created on link (≙ Sometimes/Request)
+        return "dynamic (on request)" if n is None else str(n)
+
+    out.write(
+        f"Pads: sink={pads(cls.NUM_SINK_PADS)} "
+        f"src={pads(cls.NUM_SRC_PADS)}\n"
+    )
+    props = getattr(cls, "PROPERTIES", {})
+    out.write(f"Properties ({len(props)}):\n")
+    for pname, p in props.items():
+        out.write(
+            f"  {pname:<24} {p.type.__name__:<7} "
+            f"default={p.default!r:<12} {p.doc}\n"
+        )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nns-tpu-inspect",
+        description="list elements or show one element's properties",
+    )
+    ap.add_argument("element", nargs="?", help="element name (omit to list)")
+    args = ap.parse_args(argv)
+    _ensure_registered()
+    if args.element:
+        return _inspect_one(args.element, sys.stdout)
+    _list_all(sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -161,3 +161,27 @@ class TestLaunchCli:
             timeout=120,
         )
         assert r.returncode == 0, r.stderr
+
+
+class TestInspectCli:
+    def test_list_all_elements(self, capsys):
+        from nnstreamer_tpu.cli.inspect import main
+
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "tensor_filter" in out and "appsrc" in out
+        assert "decoder subplugins" in out
+
+    def test_inspect_element_properties(self, capsys):
+        from nnstreamer_tpu.cli.inspect import main
+
+        assert main(["tensor_filter"]) == 0
+        out = capsys.readouterr().out
+        assert "framework" in out and "max-batch" in out
+
+    def test_unknown_element_suggests(self, capsys):
+        from nnstreamer_tpu.cli.inspect import main
+
+        assert main(["tensor_filt"]) == 1
+        out = capsys.readouterr().out
+        assert "did you mean" in out and "tensor_filter" in out
