@@ -4,11 +4,20 @@ Reference: ``gst/datarepo/gstdatareposrc.c`` (props :79-88 — location,
 json meta, start/stop-sample-index, epochs, is-shuffle, tensors-sequence)
 and ``gstdatareposink.c`` (render :106 writes sample files + JSON meta).
 
-Format: one flat binary file of fixed-size samples (all tensors of one
-frame concatenated) + a JSON meta file::
+Formats:
 
-    {"format": "static", "tensors": ["float32:1:28:28", "int64:1"],
-     "total_samples": N, "sample_size": bytes}
+* flat binary — one file of fixed-size samples (all tensors of one frame
+  concatenated) + JSON meta::
+
+      {"format": "static", "tensors": ["float32:1:28:28", "int64:1"],
+       "total_samples": N, "sample_size": bytes}
+
+* image — one decoded file per sample with a printf-style ``location``
+  pattern (``img_%04d.png``), meta ``{"format": "image", "total_samples":
+  N}`` — ≙ the reference's image media type (samples read via
+  pngdec/jpegdec; here ``media/image.py``/Pillow).  The sink picks this
+  mode automatically when ``location`` contains a ``%`` pattern and the
+  sample is a single uint8 H×W×C tensor.
 
 Deterministic resume comes from sample indices + epochs (reference §5.4);
 ``is-shuffle`` uses a seeded permutation per epoch so a restarted run
@@ -19,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -26,6 +36,14 @@ import numpy as np
 from ..core.buffer import TensorFrame
 from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
 from ..pipeline.element import ElementError, Property, SinkElement, SourceElement, element
+
+_IMG_PATTERN = re.compile(r"%0?\d*d")
+
+
+def _is_image_pattern(location: str) -> bool:
+    """Image mode iff the location holds a printf-style integer pattern
+    (``img_%04d.png``); a literal ``%`` elsewhere stays flat-binary."""
+    return bool(_IMG_PATTERN.search(location))
 
 
 @element("datareposink")
@@ -42,36 +60,76 @@ class DataRepoSink(SinkElement):
         self._count = 0
         self._specs: Optional[List[TensorSpec]] = None
         self._sample_size = 0
+        self._image_mode = False
 
     def start(self):
         if not self.props["location"] or not self.props["json"]:
             raise ElementError(f"{self.name}: datareposink needs location= and json=")
-        self._file = open(self.props["location"], "wb")
+        self._image_mode = _is_image_pattern(self.props["location"])
+        self._file = (
+            None if self._image_mode else open(self.props["location"], "wb")
+        )
         self._count = 0
         self._specs = None  # re-derive the schema from the new run's frame 0
         self._sample_size = 0
 
-    def render(self, frame):
-        arrays = [np.ascontiguousarray(np.asarray(t)) for t in frame.tensors]
+    def _check_schema(self, arrays) -> None:
+        """Every sample must match frame 0 (fixed-stride repo / one image
+        schema), in BOTH modes — a mismatched write must fail at write
+        time, not at read time mid-training."""
         if self._specs is None:
             self._specs = [TensorSpec(a.shape, a.dtype) for a in arrays]
             self._sample_size = sum(a.nbytes for a in arrays)
-        else:
-            # the repo file is fixed-stride: every sample must match frame 0
-            if len(arrays) != len(self._specs) or any(
-                tuple(a.shape) != s.shape or a.dtype != s.dtype
-                for a, s in zip(arrays, self._specs)
-            ):
-                got = [f"{a.dtype}{list(a.shape)}" for a in arrays]
+            return
+        if len(arrays) != len(self._specs) or any(
+            tuple(a.shape) != s.shape or a.dtype != s.dtype
+            for a, s in zip(arrays, self._specs)
+        ):
+            got = [f"{a.dtype}{list(a.shape)}" for a in arrays]
+            raise ElementError(
+                f"{self.name}: sample {self._count} schema {got} differs "
+                f"from first sample {[s.to_string() for s in self._specs]}"
+            )
+
+    def render(self, frame):
+        arrays = [np.ascontiguousarray(np.asarray(t)) for t in frame.tensors]
+        if self._image_mode:
+            ok = (
+                len(arrays) == 1
+                and arrays[0].dtype == np.uint8
+                and arrays[0].ndim == 3
+                and arrays[0].shape[-1] in (1, 3)
+            )
+            if not ok:
+                # only shapes the src can decode BACK may be written
                 raise ElementError(
-                    f"{self.name}: sample {self._count} schema {got} differs "
-                    f"from first sample {[s.to_string() for s in self._specs]}"
+                    f"{self.name}: image mode writes ONE uint8 (H, W, C) "
+                    f"tensor per sample with C in (1, 3), got "
+                    f"{[f'{a.dtype}{list(a.shape)}' for a in arrays]}"
                 )
+            self._check_schema(arrays)
+            from ..media.image import write_image
+
+            write_image(self.props["location"] % self._count, arrays[0])
+            self._count += 1
+            return
+        self._check_schema(arrays)
         for a in arrays:
             self._file.write(a.tobytes())
         self._count += 1
 
     def stop(self):
+        if self._image_mode:
+            if not self.props["json"]:
+                return
+            meta = {
+                "format": "image",
+                "tensors": [s.to_string() for s in (self._specs or [])],
+                "total_samples": self._count,
+            }
+            with open(self.props["json"], "w") as f:
+                json.dump(meta, f)
+            return
         if self._file is None:
             return
         self._file.close()
@@ -105,6 +163,7 @@ class DataRepoSrc(SourceElement):
         self._specs: List[TensorSpec] = []
         self._total = 0
         self._sample_size = 0
+        self._image_mode = False
 
     def start(self):
         if not self.props["location"] or not self.props["json"]:
@@ -113,6 +172,15 @@ class DataRepoSrc(SourceElement):
             meta = json.load(f)
         self._specs = [TensorSpec.from_string(s) for s in meta["tensors"]]
         self._total = int(meta["total_samples"])
+        self._image_mode = meta.get("format") == "image"
+        if self._image_mode:
+            if not _is_image_pattern(self.props["location"]):
+                raise ElementError(
+                    f"{self.name}: image repo needs a printf-style "
+                    "location pattern (e.g. img_%04d.png)"
+                )
+            self._sample_size = 0
+            return
         self._sample_size = int(meta["sample_size"])
         size = os.path.getsize(self.props["location"])
         if size < self._total * self._sample_size:
@@ -140,8 +208,25 @@ class DataRepoSrc(SourceElement):
         """Native mmap reader when the core is built (one memcpy per
         sample, GIL released, next-sample prefetch — ≙ the reference's C
         reader in gstdatareposrc.c); Python seek/read fallback otherwise.
+        Image repos decode one file per sample via media/image.py.
 
         Returns (read(idx) -> uint8 view, prefetch(idx), close())."""
+        if self._image_mode:
+            from ..media.image import read_image
+
+            spec = self._specs[0]
+            fmt = "GRAY8" if spec.shape[-1] == 1 else "RGB"
+
+            def read_img(idx: int):
+                arr = read_image(self.props["location"] % int(idx), fmt)
+                if tuple(arr.shape) != tuple(spec.shape):
+                    raise ElementError(
+                        f"{self.name}: sample {idx} is {list(arr.shape)}, "
+                        f"meta says {list(spec.shape)}"
+                    )
+                return arr.reshape(-1).view(np.uint8)
+
+            return read_img, lambda idx: None, lambda: None
         try:
             from ..native.runtime import SampleReader
 

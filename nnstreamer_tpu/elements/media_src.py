@@ -151,6 +151,108 @@ class VideoFileSrc(_MediaSource):
                 return
 
 
+@element("imagefilesrc", "multifilesrc")
+class ImageFileSrc(_MediaSource):
+    """Still images (PNG/JPEG/BMP via Pillow) -> video/x-raw payloads
+    (≙ ``multifilesrc ! pngdec/jpegdec ! videoconvert``).
+
+    ``location`` is one path, a comma list, or a glob pattern; all images
+    must share one size (the stream schema is static, like the
+    reference's caps).  ``framerate`` spaces pts for downstream
+    rate/sync elements."""
+
+    PROPERTIES = {
+        "location": Property(str, "", "path, comma list, or glob pattern"),
+        "format": Property(str, "RGB", "RGB|GRAY8 output pixel format"),
+        "framerate": Property(str, "30/1", "pts spacing, N/D"),
+        "num-buffers": Property(int, -1, "stop after N frames (-1 = all)"),
+        "loop": Property(bool, False, "cycle the file list forever"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._cached: Optional[tuple] = None  # (paths, MediaInfo)
+
+    def _fps(self):
+        from fractions import Fraction
+
+        n, _, d = self.props["framerate"].partition("/")
+        den = int(d or "1")
+        if den == 0:
+            raise ElementError(
+                f"{self.name}: bad framerate {self.props['framerate']!r}"
+            )
+        # exact Fraction (24000/1001 stays canonical in the caps);
+        # 0/1 = GStreamer's still-image rate -> no pts spacing
+        return Fraction(int(n), den)
+
+    def _scan(self):
+        """(paths, MediaInfo) — globbed and probed ONCE per start."""
+        if self._cached is not None:
+            return self._cached
+        import glob as _glob
+
+        from ..media.image import read_image
+
+        loc = self.props["location"]
+        if not loc:
+            raise ElementError(f"{self.name}: location= is required")
+        if "," in loc:
+            paths = [p.strip() for p in loc.split(",") if p.strip()]
+        elif any(ch in loc for ch in "*?["):
+            paths = sorted(_glob.glob(loc))
+        else:
+            paths = [loc]
+        if not paths:
+            raise ElementError(f"{self.name}: no files match {loc!r}")
+        first = read_image(paths[0], self.props["format"])
+        media = MediaInfo(
+            "video", self.props["format"],
+            width=first.shape[1], height=first.shape[0],
+            framerate=self._fps(),
+        )
+        self._cached = (paths, media)
+        return self._cached
+
+    def start(self):
+        self._cached = None  # re-scan on every run (files may change)
+        self._scan()
+
+    def output_spec(self):
+        return MediaSpec(media=self._scan()[1])
+
+    def frames(self) -> Iterator[TensorFrame]:
+        from ..media.image import read_image
+
+        paths, media = self._scan()
+        fmt = self.props["format"]
+        fps = self._fps()
+        dt = float(1 / fps) if fps else None
+        limit = self.props["num-buffers"]
+        n = 0
+        while True:
+            for p in paths:
+                if limit >= 0 and n >= limit:
+                    return
+                img = read_image(p, fmt)
+                if (img.shape[0], img.shape[1]) != (media.height, media.width):
+                    raise ElementError(
+                        f"{self.name}: {p} is {img.shape[1]}x{img.shape[0]}"
+                        f", stream is {media.width}x{media.height} (static "
+                        "schema; resize your images or split the pipeline)"
+                    )
+                payload = _pad_rows(img, media.stride)
+                f = self._media_frame(
+                    payload, media,
+                    pts=n * dt if dt is not None else None, duration=dt,
+                )
+                f.meta["filename"] = p
+                yield f
+                n += 1
+            if not self.props["loop"]:
+                return
+
+
 @element("audiofilesrc", "wavsrc")
 class AudioFileSrc(_MediaSource):
     """.wav file -> audio/x-raw payloads of ``samples-per-buffer`` frames."""

@@ -1,0 +1,42 @@
+"""Still-image codecs (PNG/JPEG/BMP/...) via Pillow.
+
+The reference ingests images through stock GStreamer decoders
+(``multifilesrc ! pngdec/jpegdec ! videoconvert`` in its example
+pipelines and datarepo "image" samples); Pillow is this framework's
+equivalent codec layer.  Import is gated so environments without it
+still load everything except the image paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover — PIL is in the base image
+        raise RuntimeError(
+            "image support requires Pillow (PIL) — not installed"
+        ) from e
+    return Image
+
+
+def read_image(path: str, fmt: str = "RGB") -> np.ndarray:
+    """Decode to uint8 (H, W, C): fmt RGB -> C=3, GRAY8 -> C=1."""
+    img = _pil().open(path)
+    if fmt == "RGB":
+        arr = np.asarray(img.convert("RGB"), np.uint8)
+    elif fmt == "GRAY8":
+        arr = np.asarray(img.convert("L"), np.uint8)[..., None]
+    else:
+        raise ValueError(f"unsupported image format {fmt!r} (RGB|GRAY8)")
+    return arr
+
+
+def write_image(path: str, arr: np.ndarray) -> None:
+    """Encode uint8 (H, W, C) or (H, W); container chosen by extension."""
+    a = np.asarray(arr, np.uint8)
+    if a.ndim == 3 and a.shape[-1] == 1:
+        a = a[..., 0]
+    _pil().fromarray(a).save(path)

@@ -256,3 +256,115 @@ class TestMediaToModel:
         with Y4MReader(path) as r:
             golden = [int(f.astype(np.int64).sum()) for f in r.frames_rgb()]
         assert [int(f.tensors[0][0]) for f in got] == golden
+
+
+class TestImageIngest:
+    def _write_pngs(self, tmp_path, n=4, size=(6, 8)):
+        from nnstreamer_tpu.media.image import write_image
+
+        rng = np.random.default_rng(3)
+        paths, imgs = [], []
+        for i in range(n):
+            img = rng.integers(0, 255, (*size, 3), np.uint8)
+            p = str(tmp_path / f"img_{i:02d}.png")
+            write_image(p, img)
+            paths.append(p)
+            imgs.append(img)
+        return paths, imgs
+
+    def test_image_codec_roundtrip(self, tmp_path):
+        from nnstreamer_tpu.media.image import read_image, write_image
+
+        img = np.random.default_rng(0).integers(0, 255, (5, 7, 3), np.uint8)
+        p = str(tmp_path / "x.png")
+        write_image(p, img)
+        np.testing.assert_array_equal(read_image(p), img)  # png = lossless
+        gray = read_image(p, "GRAY8")
+        assert gray.shape == (5, 7, 1)
+
+    def test_imagefilesrc_glob_through_converter(self, tmp_path):
+        _, imgs = self._write_pngs(tmp_path)
+        pipe = parse_pipeline(
+            f"imagefilesrc location={tmp_path}/img_*.png ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        pipe.run(timeout=30)
+        outs = [np.asarray(f.tensors[0]) for f in pipe["out"].frames]
+        assert len(outs) == len(imgs)
+        for got, want in zip(outs, imgs):
+            np.testing.assert_array_equal(got, want)
+
+    def test_imagefilesrc_rejects_mixed_sizes(self, tmp_path):
+        from nnstreamer_tpu.media.image import write_image
+
+        write_image(str(tmp_path / "a.png"), np.zeros((4, 4, 3), np.uint8))
+        write_image(str(tmp_path / "b.png"), np.zeros((5, 4, 3), np.uint8))
+        pipe = parse_pipeline(
+            f"imagefilesrc location={tmp_path}/*.png ! tensor_sink name=out"
+        )
+        pipe.start()
+        with pytest.raises(Exception):
+            pipe.wait(timeout=20)
+        pipe.stop()
+
+    def test_datarepo_image_roundtrip(self, tmp_path):
+        from nnstreamer_tpu.pipeline import parse_pipeline as pp
+
+        # write: appsrc -> datareposink (image mode via % pattern)
+        sink_pipe = pp(
+            f"appsrc name=src ! datareposink "
+            f"location={tmp_path}/s_%03d.png json={tmp_path}/meta.json"
+        )
+        sink_pipe.start()
+        rng = np.random.default_rng(9)
+        imgs = [rng.integers(0, 255, (6, 6, 3), np.uint8) for _ in range(5)]
+        for img in imgs:
+            sink_pipe["src"].push(img)
+        sink_pipe["src"].end_of_stream()
+        sink_pipe.wait(timeout=20)
+        sink_pipe.stop()
+
+        # read back a sub-range, shuffled deterministically
+        src_pipe = pp(
+            f"datareposrc location={tmp_path}/s_%03d.png "
+            f"json={tmp_path}/meta.json start-sample-index=1 "
+            "stop-sample-index=3 is-shuffle=true shuffle-seed=4 ! "
+            "tensor_sink name=out"
+        )
+        src_pipe.run(timeout=30)
+        got = {
+            f.meta["sample_index"]: np.asarray(f.tensors[0])
+            for f in src_pipe["out"].frames
+        }
+        assert sorted(got) == [1, 2, 3]
+        for idx, arr in got.items():
+            np.testing.assert_array_equal(arr, imgs[idx])
+
+    def test_datarepo_image_sink_rejects_drifting_schema(self, tmp_path):
+        from nnstreamer_tpu.elements.datarepo import DataRepoSink
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.pipeline.element import ElementError
+
+        sink = DataRepoSink()
+        sink.props["location"] = str(tmp_path / "s_%03d.png")
+        sink.props["json"] = str(tmp_path / "m.json")
+        sink.start()
+        sink.render(TensorFrame([np.zeros((6, 6, 3), np.uint8)]))
+        with pytest.raises(ElementError, match="differs"):
+            sink.render(TensorFrame([np.zeros((8, 8, 3), np.uint8)]))
+        with pytest.raises(ElementError, match="uint8"):
+            sink.render(TensorFrame([np.zeros((6, 6), np.uint8)]))  # 2-D
+
+    def test_datarepo_literal_percent_stays_flat(self, tmp_path):
+        from nnstreamer_tpu.elements.datarepo import DataRepoSink
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        sink = DataRepoSink()
+        sink.props["location"] = str(tmp_path / "data_50%.bin")
+        sink.props["json"] = str(tmp_path / "m.json")
+        sink.start()
+        sink.render(TensorFrame([np.ones((4,), np.float32)]))
+        sink.stop()
+        import json as _json
+        meta = _json.load(open(tmp_path / "m.json"))
+        assert meta["format"] == "static" and meta["total_samples"] == 1
