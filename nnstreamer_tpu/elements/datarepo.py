@@ -42,8 +42,20 @@ _IMG_PATTERN = re.compile(r"%0?\d*d")
 
 def _is_image_pattern(location: str) -> bool:
     """Image mode iff the location holds a printf-style integer pattern
-    (``img_%04d.png``); a literal ``%`` elsewhere stays flat-binary."""
+    (``img_%04d.png``); a ``%`` with no ``%d`` pattern stays flat-binary."""
     return bool(_IMG_PATTERN.search(location))
+
+
+def _fmt_sample_path(location: str, idx: int) -> str:
+    """``location % idx`` with stray-% errors surfaced as ElementError
+    (a second bare ``%`` in the path makes %-formatting throw)."""
+    try:
+        return location % idx
+    except (ValueError, TypeError) as e:
+        raise ElementError(
+            f"bad sample-path pattern {location!r}: {e} (exactly one "
+            "%d-style field is supported; escape other percents as %%)"
+        ) from None
 
 
 @element("datareposink")
@@ -110,7 +122,10 @@ class DataRepoSink(SinkElement):
             self._check_schema(arrays)
             from ..media.image import write_image
 
-            write_image(self.props["location"] % self._count, arrays[0])
+            write_image(
+                _fmt_sample_path(self.props["location"], self._count),
+                arrays[0],
+            )
             self._count += 1
             return
         self._check_schema(arrays)
@@ -179,6 +194,21 @@ class DataRepoSrc(SourceElement):
                     f"{self.name}: image repo needs a printf-style "
                     "location pattern (e.g. img_%04d.png)"
                 )
+            # completeness check at START (flat mode verifies file size
+            # here): a deleted/missing sample must not surface hours into
+            # a shuffled training run
+            missing = [
+                i for i in range(self._total)
+                if not os.path.exists(
+                    _fmt_sample_path(self.props["location"], i)
+                )
+            ]
+            if missing:
+                raise ElementError(
+                    f"{self.name}: image repo is missing "
+                    f"{len(missing)}/{self._total} samples "
+                    f"(first: {_fmt_sample_path(self.props['location'], missing[0])})"
+                )
             self._sample_size = 0
             return
         self._sample_size = int(meta["sample_size"])
@@ -218,7 +248,9 @@ class DataRepoSrc(SourceElement):
             fmt = "GRAY8" if spec.shape[-1] == 1 else "RGB"
 
             def read_img(idx: int):
-                arr = read_image(self.props["location"] % int(idx), fmt)
+                arr = read_image(
+                    _fmt_sample_path(self.props["location"], int(idx)), fmt
+                )
                 if tuple(arr.shape) != tuple(spec.shape):
                     raise ElementError(
                         f"{self.name}: sample {idx} is {list(arr.shape)}, "

@@ -202,7 +202,20 @@ class ImageFileSrc(_MediaSource):
         elif any(ch in loc for ch in "*?["):
             paths = sorted(_glob.glob(loc))
         else:
-            paths = [loc]
+            from .datarepo import _fmt_sample_path, _is_image_pattern
+
+            if _is_image_pattern(loc):
+                # canonical multifilesrc form: img_%04d.png, indexed from
+                # 0 until the first gap
+                import os as _os
+
+                paths = []
+                i = 0
+                while _os.path.exists(_fmt_sample_path(loc, i)):
+                    paths.append(_fmt_sample_path(loc, i))
+                    i += 1
+            else:
+                paths = [loc]
         if not paths:
             raise ElementError(f"{self.name}: no files match {loc!r}")
         first = read_image(paths[0], self.props["format"])

@@ -186,9 +186,21 @@ def _cfg_from_props(props: Dict[str, str]) -> TransformerConfig:
     )
 
 
-def make_generate(cfg: TransformerConfig, max_new: int):
-    """Greedy KV-cache generation: ``gen(params, prompt (B,Tp)) ->
+def make_generate(
+    cfg: TransformerConfig,
+    max_new: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+):
+    """KV-cache generation: ``gen(params, prompt (B,Tp)) ->
     (B, Tp+max_new)``.
+
+    ``temperature=0`` (default) is greedy argmax decoding;
+    ``temperature>0`` samples from softmax(logits/temperature),
+    optionally truncated to the ``top_k`` highest-probability tokens —
+    deterministic for a given ``seed`` (the key is folded per step and
+    per batch row).
 
     Two phases inside one traced function: chunked PREFILL — a single
     full-attention forward over the whole prompt that fills the K/V cache
@@ -201,6 +213,17 @@ def make_generate(cfg: TransformerConfig, max_new: int):
     compiled program.
     """
     model_dec = TransformerLM(cfg, decode=True)
+
+    def pick(logits, key):  # (B, V) -> (B,) next token
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][
+                :, -1:
+            ]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     def gen(params, prompt):  # (B, Tp) int32
         B, Tp = prompt.shape
@@ -223,25 +246,27 @@ def make_generate(cfg: TransformerConfig, max_new: int):
         )
         variables = {"params": params["params"]}
 
+        key0 = jax.random.PRNGKey(seed)
+
         # phase 1: prefill the cache with ONE causal pass over the prompt
         logits_p, upd = model_dec.apply(
             {**variables, "cache": cache0}, prompt, mutable=["cache"]
         )
-        first = jnp.argmax(logits_p[:, -1, :], axis=-1).astype(jnp.int32)
+        first = pick(logits_p[:, -1, :], key0)
 
         # phase 2: decode max_new - 1 more tokens, one per scan step
-        def step(carry, _):
+        def step(carry, t):
             cache, tok = carry
             logits, upd2 = model_dec.apply(
                 {**variables, "cache": cache},
                 tok[:, None],
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            nxt = pick(logits[:, -1, :], jax.random.fold_in(key0, t + 1))
             return (upd2["cache"], nxt), nxt
 
         (_, _), rest = jax.lax.scan(
-            step, (upd["cache"], first), None, length=max_new - 1
+            step, (upd["cache"], first), jnp.arange(max_new - 1)
         )
         generated = jnp.concatenate(
             [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
@@ -269,7 +294,13 @@ def build(custom_props=None):
     in_spec = StreamSpec((TensorSpec((None,), np.int32, "tokens"),), FORMAT_STATIC)
 
     if max_new > 0:
-        gen = make_generate(cfg, max_new)
+        gen = make_generate(
+            cfg,
+            max_new,
+            temperature=float(props.get("temperature", "0")),
+            top_k=int(props.get("top_k", "0")),
+            seed=int(props.get("gen_seed", "0")),
+        )
 
         def fn(p, inputs):
             toks = inputs[0]

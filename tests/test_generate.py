@@ -135,6 +135,33 @@ def test_quantized_generation_runs(rng):
     assert ((out >= 0) & (out < PROPS["vocab"])).all()
 
 
+def test_sampled_generation_deterministic_and_topk_bounded(rng):
+    """temperature/top_k sampling: deterministic per gen_seed, different
+    seeds diverge, and top_k=1 degenerates to greedy."""
+    base = {**PROPS, "generate": "6", "temperature": "1.0", "top_k": "5"}
+    prompt = rng.integers(0, PROPS["vocab"], (2, 5)).astype(np.int32)
+
+    f1, p1, _, _ = build("transformer", {**base, "gen_seed": "1"})
+    f1b, p1b, _, _ = build("transformer", {**base, "gen_seed": "1"})
+    f2, p2, _, _ = build("transformer", {**base, "gen_seed": "2"})
+    a = np.asarray(f1(p1, [prompt])[0])
+    b = np.asarray(f1b(p1b, [prompt])[0])
+    c = np.asarray(f2(p2, [prompt])[0])
+    np.testing.assert_array_equal(a, b)  # same seed -> same stream
+    assert not np.array_equal(a, c)  # different seed -> diverges
+    assert ((a >= 0) & (a < PROPS["vocab"])).all()
+
+    # top_k=1 at any temperature IS greedy
+    fk, pk, _, _ = build(
+        "transformer",
+        {**PROPS, "generate": "6", "temperature": "0.7", "top_k": "1"},
+    )
+    fg, pg, _, _ = build("transformer", {**PROPS, "generate": "6"})
+    np.testing.assert_array_equal(
+        np.asarray(fk(pk, [prompt])[0]), np.asarray(fg(pg, [prompt])[0])
+    )
+
+
 def test_generate_rejects_overflow(rng):
     fn_gen, params, _, _ = build(
         "transformer", {**PROPS, "generate": "30"}

@@ -368,3 +368,30 @@ class TestImageIngest:
         import json as _json
         meta = _json.load(open(tmp_path / "m.json"))
         assert meta["format"] == "static" and meta["total_samples"] == 1
+
+    def test_imagefilesrc_printf_pattern(self, tmp_path):
+        _, imgs = self._write_pngs(tmp_path)  # writes img_00..img_03
+        pipe = parse_pipeline(
+            f"imagefilesrc location={tmp_path}/img_%02d.png ! "
+            "tensor_converter ! tensor_sink name=out"
+        )
+        pipe.run(timeout=30)
+        assert len(pipe["out"].frames) == len(imgs)
+
+    def test_datarepo_image_start_detects_missing_sample(self, tmp_path):
+        import os as _os
+        from nnstreamer_tpu.elements.datarepo import DataRepoSrc
+        from nnstreamer_tpu.pipeline.element import ElementError
+
+        self._write_pngs(tmp_path, n=3)
+        import json as _json
+        (tmp_path / "m.json").write_text(_json.dumps({
+            "format": "image", "tensors": ["uint8:6:8:3"],
+            "total_samples": 3,
+        }))
+        _os.remove(tmp_path / "img_01.png")
+        src = DataRepoSrc()
+        src.props["location"] = str(tmp_path / "img_%02d.png")
+        src.props["json"] = str(tmp_path / "m.json")
+        with pytest.raises(ElementError, match="missing"):
+            src.start()
