@@ -28,6 +28,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print the per-element tracer table on exit "
         "(proctime/framerate/interlatency/queue/bitrate; ≙ GstShark)",
     )
+    ap.add_argument(
+        "--dot",
+        metavar="FILE",
+        default="",
+        help="write the pipeline graph as Graphviz DOT after negotiation "
+        "(≙ GST_DEBUG_DUMP_DOT_DIR)",
+    )
     args = ap.parse_args(argv)
 
     from ..pipeline import parse_pipeline
@@ -40,6 +47,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     t0 = time.monotonic()
     pipe.start()
     try:
+        # inside the try: a bad --dot path must still stop the pipeline
+        if args.dot:
+            with open(args.dot, "w") as f:
+                f.write(pipe.to_dot())
         pipe.wait(timeout=args.timeout)
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)

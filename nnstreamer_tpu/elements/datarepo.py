@@ -196,9 +196,14 @@ class DataRepoSrc(SourceElement):
                 )
             # completeness check at START (flat mode verifies file size
             # here): a deleted/missing sample must not surface hours into
-            # a shuffled training run
+            # a shuffled training run.  Only the configured index range is
+            # checked — pruned repos read with start/stop-sample-index
+            # stay valid, and the scan cost is bounded by the range.
+            lo = max(0, self.props["start-sample-index"])
+            hi = self.props["stop-sample-index"]
+            hi = self._total - 1 if hi < 0 else min(hi, self._total - 1)
             missing = [
-                i for i in range(self._total)
+                i for i in range(lo, hi + 1)
                 if not os.path.exists(
                     _fmt_sample_path(self.props["location"], i)
                 )
@@ -206,7 +211,7 @@ class DataRepoSrc(SourceElement):
             if missing:
                 raise ElementError(
                     f"{self.name}: image repo is missing "
-                    f"{len(missing)}/{self._total} samples "
+                    f"{len(missing)} of samples [{lo}, {hi}] "
                     f"(first: {_fmt_sample_path(self.props['location'], missing[0])})"
                 )
             self._sample_size = 0

@@ -162,7 +162,10 @@ class ImageFileSrc(_MediaSource):
     rate/sync elements."""
 
     PROPERTIES = {
-        "location": Property(str, "", "path, comma list, or glob pattern"),
+        "location": Property(
+            str, "",
+            "path, comma list, glob, or printf pattern (img_%04d.png)",
+        ),
         "format": Property(str, "RGB", "RGB|GRAY8 output pixel format"),
         "framerate": Property(str, "30/1", "pts spacing, N/D"),
         "num-buffers": Property(int, -1, "stop after N frames (-1 = all)"),

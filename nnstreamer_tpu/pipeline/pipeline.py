@@ -66,6 +66,47 @@ class Pipeline:
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
 
+    def to_dot(self) -> str:
+        """Graphviz DOT of the element graph (≙ GStreamer's
+        GST_DEBUG_DUMP_DOT_DIR pipeline dumps): one node per element
+        (shape by role), one edge per pad link, negotiated schemas as
+        edge labels when known."""
+        lines = [
+            "digraph pipeline {",
+            "  rankdir=LR;",
+            "  node [fontsize=10 shape=box style=rounded];",
+        ]
+        for el in self.elements.values():
+            kind = type(el).__name__
+            shape = (
+                "invhouse" if isinstance(el, SourceElement)
+                else "house" if isinstance(el, SinkElement)
+                else "box"
+            )
+            lines.append(
+                f'  "{el.name}" [label="{el.name}\\n({kind})" shape={shape}];'
+            )
+        for el in self.elements.values():
+            for sp_i, sp in enumerate(el.srcpads):
+                for dst, sink_pad in sp.links:
+                    spec = None
+                    try:
+                        spec = dst.sink_specs.get(sink_pad)
+                    except AttributeError:
+                        pass
+                    label = (
+                        spec.to_string().replace('"', "'")
+                        if spec is not None and getattr(spec, "tensors", None)
+                        else ""
+                    )
+                    lines.append(
+                        f'  "{el.name}" -> "{dst.name}" '
+                        f'[taillabel="{sp_i}" headlabel="{sink_pad}" '
+                        f'label="{label}" fontsize=8];'
+                    )
+        lines.append("}")
+        return "\n".join(lines)
+
     def enable_tracing(self, detail: bool = False) -> PipelineTracer:
         """Attach a fresh PipelineTracer (before start()); returns it.
         ``detail=True`` also records per-call spans for

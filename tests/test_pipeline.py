@@ -162,3 +162,32 @@ class TestParser:
         pipe.stop()
         vals = sorted(int(f.tensors[0][0]) for f in pipe["out"].frames)
         assert vals == [1, 2]
+
+
+class TestDotExport:
+    def test_to_dot_structure(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=1 width=4 height=4 ! tee name=t "
+            "t. ! queue ! tensor_sink name=a  t. ! queue ! tensor_sink name=b"
+        )
+        dot = pipe.to_dot()
+        assert dot.startswith("digraph pipeline {")
+        for name in ("t", "a", "b"):
+            assert f'"{name}"' in dot
+        # tee fans to two queues: two edges out of t
+        assert dot.count('"t" ->') == 2
+        # sinks render as house shapes, sources inverted
+        assert "shape=house" in dot and "shape=invhouse" in dot
+
+    def test_launch_dot_flag(self, tmp_path):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from nnstreamer_tpu.cli.launch import main
+
+        out = tmp_path / "g.dot"
+        assert main([
+            "videotestsrc num-buffers=1 width=4 height=4 ! tensor_sink",
+            "--dot", str(out), "--timeout", "20", "-q",
+        ]) == 0
+        assert out.read_text().startswith("digraph pipeline {")
