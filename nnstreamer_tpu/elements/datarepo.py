@@ -183,6 +183,13 @@ class DataRepoSrc(SourceElement):
     def start(self):
         if not self.props["location"] or not self.props["json"]:
             raise ElementError(f"{self.name}: datareposrc needs location= and json=")
+        if self.props["start-sample-index"] < 0:
+            # a negative start would read negative indices (mid-epoch with
+            # shuffle on) — fail here, not hours into a run
+            raise ElementError(
+                f"{self.name}: start-sample-index must be >= 0, got "
+                f"{self.props['start-sample-index']}"
+            )
         with open(self.props["json"]) as f:
             meta = json.load(f)
         self._specs = [TensorSpec.from_string(s) for s in meta["tensors"]]
@@ -199,7 +206,7 @@ class DataRepoSrc(SourceElement):
             # a shuffled training run.  Only the configured index range is
             # checked — pruned repos read with start/stop-sample-index
             # stay valid, and the scan cost is bounded by the range.
-            lo = max(0, self.props["start-sample-index"])
+            lo = self.props["start-sample-index"]
             hi = self.props["stop-sample-index"]
             hi = self._total - 1 if hi < 0 else min(hi, self._total - 1)
             missing = [

@@ -71,6 +71,9 @@ class Pipeline:
         GST_DEBUG_DUMP_DOT_DIR pipeline dumps): one node per element
         (shape by role), one edge per pad link, negotiated schemas as
         edge labels when known."""
+        def esc(s: str) -> str:  # DOT quoted strings: no raw double quotes
+            return str(s).replace('"', "'")
+
         lines = [
             "digraph pipeline {",
             "  rankdir=LR;",
@@ -84,23 +87,20 @@ class Pipeline:
                 else "box"
             )
             lines.append(
-                f'  "{el.name}" [label="{el.name}\\n({kind})" shape={shape}];'
+                f'  "{esc(el.name)}" '
+                f'[label="{esc(el.name)}\\n({kind})" shape={shape}];'
             )
         for el in self.elements.values():
             for sp_i, sp in enumerate(el.srcpads):
                 for dst, sink_pad in sp.links:
-                    spec = None
-                    try:
-                        spec = dst.sink_specs.get(sink_pad)
-                    except AttributeError:
-                        pass
+                    spec = dst.sink_specs.get(sink_pad)
                     label = (
-                        spec.to_string().replace('"', "'")
+                        esc(spec.to_string())
                         if spec is not None and getattr(spec, "tensors", None)
                         else ""
                     )
                     lines.append(
-                        f'  "{el.name}" -> "{dst.name}" '
+                        f'  "{esc(el.name)}" -> "{esc(dst.name)}" '
                         f'[taillabel="{sp_i}" headlabel="{sink_pad}" '
                         f'label="{label}" fontsize=8];'
                     )

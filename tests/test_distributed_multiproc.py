@@ -5,47 +5,56 @@ foreground client) + client-side retry/failover (SURVEY §5.3)."""
 import os
 import subprocess
 import sys
-import time
 
 import numpy as np
 import pytest
 
 from nnstreamer_tpu.pipeline import parse_pipeline
 
-_SERVER_SCRIPT = """
+_SERVER_TEMPLATE = """
 import sys, time
 sys.path.insert(0, {repo!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
 from nnstreamer_tpu.pipeline import parse_pipeline
 
-pipe = parse_pipeline(
-    "tensor_query_serversrc name=src port=0 ! "
-    "tensor_transform mode=arithmetic option=add:100 ! "
-    "tensor_query_serversink"
-)
+pipe = parse_pipeline({pipeline!r})
 pipe.start()
 print("PORT", pipe["src"].props["port"], flush=True)
-time.sleep(60)
+time.sleep({lifetime})
 """
 
 
+def spawn_server(pipeline_text: str, lifetime: float = 240.0,
+                 extra_env=None):
+    """Background server-pipeline process (≙ the reference's
+    gstTestBackground); returns (proc, port).  Caller kills in finally.
+    ``lifetime`` must exceed the client's total wait budget or a slow but
+    healthy run loses its server mid-test."""
+    src = _SERVER_TEMPLATE.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        pipeline=pipeline_text,
+        lifetime=lifetime,
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", src],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    line = proc.stdout.readline()
+    assert line.startswith("PORT "), line
+    return proc, int(line.split()[1])
+
+
 class TestMultiProcessQuery:
-    def test_client_offloads_to_server_process(self, tmp_path):
-        script = tmp_path / "server.py"
-        script.write_text(_SERVER_SCRIPT.format(
-            repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        ))
-        env = {**os.environ, "JAX_PLATFORMS": "cpu", "NNS_TPU_NO_NATIVE": "1"}
-        srv = subprocess.Popen(
-            [sys.executable, str(script)],
-            stdout=subprocess.PIPE, text=True, env=env,
+    def test_client_offloads_to_server_process(self):
+        srv, port = spawn_server(
+            "tensor_query_serversrc name=src port=0 ! "
+            "tensor_transform mode=arithmetic option=add:100 ! "
+            "tensor_query_serversink",
+            extra_env={"NNS_TPU_NO_NATIVE": "1"},
         )
         try:
-            line = srv.stdout.readline()
-            assert line.startswith("PORT "), line
-            port = int(line.split()[1])
-
             pipe = parse_pipeline(
                 f"appsrc name=a ! tensor_query_client port={port} "
                 "timeout=30 ! tensor_sink name=out"
@@ -101,3 +110,43 @@ class TestClientFailover:
         with pytest.raises(Exception):
             client.wait(timeout=30)
         client.stop()
+
+
+class TestGenerationOffload:
+    """LLM generation served across OS processes: the query client
+    offloads prompts to a server pipeline running KV-cache generation
+    (distributed serving = the reference's among-device story composed
+    with the net-new generation path)."""
+
+    def test_prompts_offloaded_and_completed(self):
+        srv, port = spawn_server(
+            "tensor_query_serversrc name=src port=0 ! "
+            "tensor_filter framework=jax-xla model=zoo "
+            "custom=arch:transformer,dtype:float32,vocab:61,d_model:32,"
+            "heads:2,layers:2,d_ff:64,seq:32,seed:11,generate:4 ! "
+            "tensor_query_serversink",
+            lifetime=300,  # > client 180s wait + 90s per-request budget
+        )
+        try:
+            client = parse_pipeline(
+                f"appsrc name=a ! tensor_query_client port={port} "
+                "timeout=90 ! tensor_sink name=out"
+            )
+            client.start()
+            rng = np.random.default_rng(4)
+            prompts = [
+                rng.integers(0, 61, (6,)).astype(np.int32) for _ in range(3)
+            ]
+            for p in prompts:
+                client["a"].push(p)
+            client["a"].end_of_stream()
+            client.wait(timeout=180)
+            client.stop()
+            outs = [np.asarray(f.tensors[0]) for f in client["out"].frames]
+            assert len(outs) == 3
+            for p, o in zip(prompts, outs):
+                assert o.shape == (10,)  # 6 prompt + 4 generated
+                np.testing.assert_array_equal(o[:6], p)
+        finally:
+            srv.kill()
+            srv.wait(timeout=10)
