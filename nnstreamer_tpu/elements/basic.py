@@ -84,6 +84,11 @@ class AppSrc(SourceElement):
         self._count += 1
         self._q.put(frame)
 
+    def push_event(self, event) -> None:
+        """Queue an out-of-band event into the stream in arrival order
+        (e.g. ``CustomEvent("reload-model", {...})`` ≙ RELOAD_MODEL)."""
+        self._q.put(event)
+
     def end_of_stream(self) -> None:
         self._q.put(None)
 

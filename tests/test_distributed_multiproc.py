@@ -41,9 +41,16 @@ def spawn_server(pipeline_text: str, lifetime: float = 240.0,
         [sys.executable, "-c", src],
         stdout=subprocess.PIPE, text=True, env=env,
     )
-    line = proc.stdout.readline()
-    assert line.startswith("PORT "), line
-    return proc, int(line.split()[1])
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        return proc, int(line.split()[1])
+    except BaseException:
+        # a failed handshake must not orphan the server for its full
+        # lifetime (callers' finally only covers the post-return window)
+        proc.kill()
+        proc.wait(timeout=10)
+        raise
 
 
 class TestMultiProcessQuery:

@@ -187,8 +187,8 @@ class TestFilterElement:
             )
             pipe.start()
             pipe["src"].push(np.float32([1]))
-            pipe["src"]._q.put(CustomEvent("reload-model", {"model": "m2"}))
-            # appsrc frames() only yields TensorFrames; push event via deliver path
+            pipe["src"].push_event(CustomEvent("reload-model", {"model": "m2"}))
+            # the event rides the same source queue as frames, in order
             pipe["src"].end_of_stream()
             pipe.wait(timeout=15)
             pipe.stop()

@@ -1,0 +1,83 @@
+"""Model-file resolution in the jax-xla backend: .msgpack flax params and
+Orbax checkpoint directories with ``custom=arch:<family>`` (the
+reference's model= file contract, ≙ tensor_filter model=m.tflite).
+
+Also pins hot reload between two weight files (≙ RELOAD_MODEL /
+is-updatable, double-buffered reload in the reference's tflite
+subplugin)."""
+
+import numpy as np
+
+from nnstreamer_tpu.core.buffer import CustomEvent
+from nnstreamer_tpu.elements.filter import SingleShot
+from nnstreamer_tpu.models import build
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+ARCH = "arch:mnist_cnn,dtype:float32"
+PROPS = {"dtype": "float32"}
+
+
+def _save_msgpack(path, seed):
+    from flax import serialization
+
+    fn, params, _, _ = build("mnist_cnn", {**PROPS, "seed": str(seed)})
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(params))
+    return fn, params
+
+
+def test_msgpack_file_load(tmp_path, rng):
+    path = str(tmp_path / "w.msgpack")
+    fn, params = _save_msgpack(path, seed=5)
+    x = rng.normal(size=(2, 28, 28, 1)).astype(np.float32)
+    want = np.asarray(fn(params, [x])[0])
+    with SingleShot(framework="jax-xla", model=path, custom=ARCH) as s:
+        got = np.asarray(s.invoke_batch([x])[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_orbax_dir_load(tmp_path, rng):
+    import jax
+    import orbax.checkpoint as ocp
+
+    fn, params, _, _ = build("mnist_cnn", {**PROPS, "seed": "8"})
+    ckpt = str(tmp_path / "ckpt")
+    ocp.StandardCheckpointer().save(
+        ckpt, jax.tree.map(np.asarray, params)
+    )
+    x = rng.normal(size=(2, 28, 28, 1)).astype(np.float32)
+    want = np.asarray(fn(params, [x])[0])
+    with SingleShot(framework="jax-xla", model=ckpt, custom=ARCH) as s:
+        got = np.asarray(s.invoke_batch([x])[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_hot_reload_swaps_weights(tmp_path, rng):
+    """is-updatable reload mid-stream: outputs flip to the new weights'
+    results without restarting the pipeline."""
+    p1, p2 = str(tmp_path / "a.msgpack"), str(tmp_path / "b.msgpack")
+    fn, params1 = _save_msgpack(p1, seed=1)
+    _, params2 = _save_msgpack(p2, seed=2)
+    x = rng.normal(size=(28, 28, 1)).astype(np.float32)
+    want1 = np.asarray(fn(params1, [x[None]])[0])[0]
+    want2 = np.asarray(fn(params2, [x[None]])[0])[0]
+
+    pipe = parse_pipeline(
+        f"appsrc name=src ! tensor_filter name=f framework=jax-xla "
+        f"model={p1} custom={ARCH} is-updatable=true ! "
+        "tensor_sink name=out",
+        name="reload",
+    )
+    pipe.start()
+    pipe["src"].push(x)
+    # reload event travels the stream like the reference's RELOAD_MODEL
+    pipe["src"].push_event(CustomEvent("reload-model", {"model": p2}))
+    pipe["src"].push(x)
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=60)
+    outs = [np.asarray(f.tensors[0]) for f in pipe["out"].frames]
+    pipe.stop()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0], want1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[1], want2, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(outs[0], outs[1])
