@@ -196,13 +196,36 @@ class TensorSink(SinkElement):
 @element("queue")
 class Queue(TransformElement):
     """Thread-boundary element.  Every element here already runs on its own
-    thread; `queue` remains for pipeline-text compatibility and to set the
-    buffering depth (`max-buffers` maps to the mailbox size)."""
+    thread; `queue` remains for pipeline-text compatibility, to set the
+    buffering depth (`max-buffers` maps to the mailbox size), and for the
+    live-pipeline ``leaky`` modes (≙ GstQueue leaky): a full queue then
+    DROPS frames instead of blocking the producer —
+    ``leaky=upstream`` drops the incoming frame, ``leaky=downstream``
+    drops the oldest queued frame.  Events are never dropped."""
 
     PROPERTIES = {
         "max-buffers": Property(int, 16, "bounded queue depth (backpressure)"),
-        "leaky": Property(str, "", "''|downstream — drop newest when full (unused placeholder)"),
+        "leaky": Property(
+            str, "",
+            "''|no|upstream|downstream — full queue drops frames instead "
+            "of blocking (upstream: incoming; downstream: oldest)",
+        ),
     }
+
+    def start(self):
+        mode = (self.props["leaky"] or "no").lower()
+        if mode not in ("", "no", "upstream", "downstream"):
+            from ..pipeline.element import ElementError
+
+            raise ElementError(
+                f"{self.name}: leaky must be ''|no|upstream|downstream, "
+                f"got {self.props['leaky']!r}"
+            )
+
+    @property
+    def leaky_policy(self) -> str:
+        mode = (self.props["leaky"] or "no").lower()
+        return "" if mode in ("", "no") else mode
 
     def transform(self, frame):
         return frame

@@ -32,6 +32,79 @@ from .element import Element, ElementError, SinkElement, SourceElement
 _STOP = object()  # out-of-band worker shutdown sentinel
 
 
+class _LeakyMailbox:
+    """Bounded mailbox with GstQueue leaky semantics, all decisions taken
+    atomically under one lock: a frame arriving at a full box either
+    replaces the oldest queued FRAME (``downstream`` — events keep their
+    exact position) or is itself discarded (``upstream``).  Events use the
+    blocking ``put`` and are never dropped or reordered."""
+
+    def __init__(self, maxsize: int, policy: str):
+        import collections
+
+        self._dq = collections.deque()
+        self._max = max(1, maxsize)
+        self.policy = policy  # "upstream" | "downstream"
+        self._mtx = threading.Lock()
+        self._not_empty = threading.Condition(self._mtx)
+        self._not_full = threading.Condition(self._mtx)
+
+    def put_frame(self, item) -> None:
+        """Non-blocking frame delivery with the leaky policy."""
+        with self._mtx:
+            if len(self._dq) >= self._max:
+                if self.policy == "upstream":
+                    return  # live semantics: lose the newest frame
+                # downstream: drop the oldest FRAME in place; if only
+                # events are queued, the incoming frame is the loss
+                for i, old in enumerate(self._dq):
+                    if isinstance(old[1], TensorFrame):
+                        del self._dq[i]
+                        break
+                else:
+                    return
+            self._dq.append(item)
+            self._not_empty.notify()
+
+    # -- queue.Queue-compatible subset (events, sentinel, worker get) ----
+    def put(self, item, timeout: Optional[float] = None) -> None:
+        with self._mtx:
+            if len(self._dq) >= self._max:
+                self._not_full.wait_for(
+                    lambda: len(self._dq) < self._max, timeout=timeout
+                )
+                if len(self._dq) >= self._max:
+                    raise queue.Full
+            self._dq.append(item)
+            self._not_empty.notify()
+
+    def put_nowait(self, item) -> None:
+        self.put(item, timeout=0.0)
+
+    def get(self, timeout: Optional[float] = None):
+        with self._mtx:
+            if not self._dq:
+                self._not_empty.wait_for(
+                    lambda: bool(self._dq), timeout=timeout
+                )
+                if not self._dq:
+                    raise queue.Empty
+            item = self._dq.popleft()
+            self._not_full.notify()
+            return item
+
+    def get_nowait(self):
+        return self.get(timeout=0.0)
+
+    def qsize(self) -> int:
+        with self._mtx:
+            return len(self._dq)
+
+    @property
+    def maxsize(self) -> int:
+        return self._max
+
+
 @dataclass
 class BusMessage:
     """Out-of-band message to the application (≙ GstMessage)."""
@@ -266,7 +339,9 @@ class Pipeline:
                 # a micro-batching element needs its full batch to fit in the
                 # mailbox or batches can never form at max-batch size
                 size = max(size, getattr(el, "preferred_batch", 1))
-                el._mailbox = self._make_mailbox(size)
+                el._mailbox = self._make_mailbox(
+                    size, getattr(el, "leaky_policy", "")
+                )
         self._stop_flag.clear()
         for el in self.elements.values():
             target = self._run_source if isinstance(el, SourceElement) else self._run_element
@@ -277,7 +352,9 @@ class Pipeline:
         self._started = True
         return self
 
-    def _make_mailbox(self, size: int):
+    def _make_mailbox(self, size: int, leaky: str = ""):
+        if leaky:
+            return _LeakyMailbox(size, leaky)
         try:
             from ..native.runtime import NativeMailbox, available
 
@@ -340,12 +417,22 @@ class Pipeline:
             return None
 
     def _push(self, el: Element, src_pad: int, item) -> bool:
-        """Push downstream with backpressure; False if stopping."""
+        """Push downstream with backpressure; False if stopping.
+
+        Frames bound for a leaky queue are dropped instead of blocking
+        (``upstream``: the incoming frame; ``downstream``: the oldest
+        queued frame).  Events always use the blocking path — caps/EOS
+        must never be lost."""
         pad = el.srcpads[src_pad]
+        is_frame = isinstance(item, TensorFrame)
         for dst, sink_pad in pad.links:
+            box = dst._mailbox
+            if is_frame and isinstance(box, _LeakyMailbox):
+                box.put_frame((sink_pad, item))
+                continue
             while not self._stop_flag.is_set():
                 try:
-                    dst._mailbox.put((sink_pad, item), timeout=0.1)
+                    box.put((sink_pad, item), timeout=0.1)
                     break
                 except queue.Full:
                     continue

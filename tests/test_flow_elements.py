@@ -384,3 +384,47 @@ class TestDebug:
         )
         assert len(pipe["out"].frames) == 2
         assert pipe["d"].seen == 2
+
+
+class TestLeakyQueue:
+    """GstQueue leaky modes: a full queue drops frames instead of
+    blocking the producer (live pipelines must not stall on a slow
+    consumer); events are never dropped."""
+
+    def _run(self, leaky, n=40):
+        pipe = parse_pipeline(
+            "appsrc name=src max-buffers=64 ! "
+            f"queue max-buffers=2 leaky={leaky} ! "
+            "identity sleep=0.02 ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(n):
+            pipe["src"].push(np.int32([i]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=60)
+        pipe.stop()
+        return [int(f.tensors[0][0]) for f in pipe["out"].frames]
+
+    def test_upstream_drops_newest(self):
+        got = self._run("upstream")
+        assert 0 < len(got) < 40  # dropped under pressure
+        assert got[0] == 0  # earliest frames survive
+        assert got == sorted(got)
+
+    def test_downstream_drops_oldest(self):
+        got = self._run("downstream")
+        assert 0 < len(got) < 40
+        assert got[-1] == 39  # newest frame survives (oldest were dropped)
+        assert got == sorted(got)
+
+    def test_no_leak_keeps_everything(self):
+        got = self._run("no")
+        assert got == list(range(40))
+
+    def test_bad_mode_rejected(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! queue leaky=sideways ! tensor_sink"
+        )
+        with pytest.raises(Exception, match="leaky"):
+            pipe.start()
+            pipe.wait(timeout=10)
