@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 import math
 import struct
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict
 
 import numpy as np
 

@@ -18,8 +18,8 @@ from typing import Any, Callable, Iterator, List, Optional
 
 import numpy as np
 
-from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, TensorFrame
-from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec, parse_dims_string, dtype_from_name
+from ..core.buffer import BatchFrame, TensorFrame
+from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
 from ..pipeline.element import (
     Element,
     ElementError,

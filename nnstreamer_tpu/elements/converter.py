@@ -22,7 +22,7 @@ decoded; unknown media goes to converter subplugins (registry kind
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
@@ -30,7 +30,6 @@ from ..core import registry
 from ..core.buffer import TensorFrame
 from ..core.types import (
     ANY,
-    FORMAT_FLEXIBLE,
     FORMAT_STATIC,
     StreamSpec,
     TensorSpec,

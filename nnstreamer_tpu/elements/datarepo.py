@@ -34,7 +34,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from ..core.buffer import TensorFrame
-from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from ..pipeline.element import ElementError, Property, SinkElement, SourceElement, element
 
 _IMG_PATTERN = re.compile(r"%0?\d*d")

@@ -16,12 +16,11 @@ Decoder subplugins register under registry kind "decoder" with the contract:
 
 from __future__ import annotations
 
-from typing import List, Optional
 
 from ..core import registry
-from ..core.buffer import BatchFrame, TensorFrame
-from ..core.types import ANY, StreamSpec
-from ..pipeline.element import Element, ElementError, Property, TransformElement, element
+from ..core.buffer import BatchFrame
+from ..core.types import ANY
+from ..pipeline.element import ElementError, Property, TransformElement, element
 from .. import decoders as _decoders  # noqa: F401 — registers decoder modes
 
 _N_OPTIONS = 9  # reference carries option1..option9

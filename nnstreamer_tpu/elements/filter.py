@@ -33,7 +33,7 @@ from ..core import registry
 from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
 from ..core.model_uri import resolve_model_uri
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
-from ..pipeline.element import Element, ElementError, Property, TransformElement, element
+from ..pipeline.element import ElementError, Property, TransformElement, element
 
 # ---------------------------------------------------------------------------
 # Shared model table (reference tensor_filter_common.c:2879-3084):

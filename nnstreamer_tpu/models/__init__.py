@@ -10,7 +10,7 @@ jax-xla backend consumes (``custom=arch:<name>``).
 from __future__ import annotations
 
 from importlib import import_module
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 _ZOO = {
     "mobilenet_v2": "nnstreamer_tpu.models.mobilenet_v2",

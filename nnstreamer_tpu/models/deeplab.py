@@ -14,7 +14,7 @@ fn(params, [img_u8 (H,W,3) or (N,H,W,3)]) -> [(H,W,classes) scores]
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax

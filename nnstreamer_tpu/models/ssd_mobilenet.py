@@ -23,7 +23,6 @@ import itertools
 from typing import Any, List, Sequence, Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -15,17 +15,17 @@ trainer element).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from ._init_util import host_init
-from ..parallel.ring_attention import reference_attention, ring_attention
+from ..parallel.ring_attention import reference_attention
 
 
 @dataclasses.dataclass(frozen=True)

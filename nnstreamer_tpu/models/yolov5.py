@@ -12,7 +12,7 @@ tensor, TPU-style (no host post-processing before the decoder).
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax

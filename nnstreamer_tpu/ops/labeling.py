@@ -8,7 +8,6 @@ Returning (idx, score) together saves a second pass over HBM.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

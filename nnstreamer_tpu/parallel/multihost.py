@@ -33,7 +33,7 @@ job); elastic behavior is restart-from-checkpoint — see
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional
 
 import numpy as np
 

@@ -28,8 +28,7 @@ Megatron-LM (1909.08053), Switch Transformer (2101.03961), Ring Attention
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring_attention import reference_attention, ring_attention, shard_map
+from .ring_attention import ring_attention, shard_map
 
 
 def _local_attention(q, k, v, causal: bool):

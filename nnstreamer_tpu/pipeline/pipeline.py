@@ -21,10 +21,10 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..core.buffer import EOS, CapsEvent, CustomEvent, Event, Flush, TensorFrame
+from ..core.buffer import EOS, CapsEvent, Event, Flush, TensorFrame
 from ..core.log import get_logger
 from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
 from .element import Element, ElementError, SinkElement, SourceElement

@@ -6,7 +6,6 @@ rendered overlay and the machine-readable meta.  Reference analogs:
 ``tests/nnstreamer_decoder*/runTest.sh`` + decoder gtest cases.
 """
 
-import os
 
 import numpy as np
 import pytest

@@ -3,7 +3,6 @@ multi-server fan-out, edge pub/sub — all as in-process/localhost pipelines
 (the reference tests distribution the same way: multiple processes on
 localhost, ``tests/nnstreamer_edge/query/runTest.sh``)."""
 
-import threading
 import time
 
 import numpy as np

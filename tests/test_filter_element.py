@@ -13,10 +13,10 @@ import pytest
 from nnstreamer_tpu.backends import find_backend, register_custom_easy, unregister_custom_easy
 from nnstreamer_tpu.backends.base import parse_accelerator
 from nnstreamer_tpu.core.types import FORMAT_STATIC, StreamSpec, TensorSpec
-from nnstreamer_tpu.core.buffer import CustomEvent, TensorFrame
+from nnstreamer_tpu.core.buffer import CustomEvent
 from nnstreamer_tpu.elements.basic import AppSrc, TensorSink
-from nnstreamer_tpu.elements.filter import SingleShot, TensorFilter, detect_framework
-from nnstreamer_tpu.pipeline import ElementError, Pipeline, make_element, parse_pipeline
+from nnstreamer_tpu.elements.filter import SingleShot
+from nnstreamer_tpu.pipeline import Pipeline, make_element, parse_pipeline
 
 
 def spec1(shape=(4,), dtype=np.float32):

@@ -8,7 +8,6 @@ _demux, _if, _rate, _repo, ...) as in-process pipelines with appsrc.
 import numpy as np
 import pytest
 
-from nnstreamer_tpu.core.types import StreamSpec, TensorSpec, FORMAT_STATIC
 from nnstreamer_tpu.elements.flow import register_if_custom, unregister_if_custom
 from nnstreamer_tpu.elements.repo import reset_repo
 from nnstreamer_tpu.pipeline import ElementError, parse_pipeline

@@ -9,7 +9,7 @@ gst/nnstreamer/elements/gsttensor_converter.c:750-1005).
 import numpy as np
 import pytest
 
-from nnstreamer_tpu.media.caps import MediaInfo, MediaSpec, parse_media_caps, round_up_4
+from nnstreamer_tpu.media.caps import MediaSpec, parse_media_caps, round_up_4
 from nnstreamer_tpu.media.wav import read_wav, write_wav
 from nnstreamer_tpu.media.y4m import Y4MReader, i420_to_rgb, rgb_to_i420, write_y4m
 from nnstreamer_tpu.pipeline import parse_pipeline

@@ -6,7 +6,6 @@ real .wav audio through the media ingest path.
 """
 
 import numpy as np
-import pytest
 
 from nnstreamer_tpu.backends.jax_xla import register_jax_model, unregister_jax_model
 from nnstreamer_tpu.media.wav import write_wav

@@ -4,14 +4,10 @@ Modeled on the reference's pipeline-level SSAT suites (launch a pipeline,
 collect sink output, byte-compare) but as in-process pytest.
 """
 
-import threading
-import time
 
 import numpy as np
 import pytest
 
-from nnstreamer_tpu.core.buffer import TensorFrame
-from nnstreamer_tpu.core.types import StreamSpec, TensorSpec
 from nnstreamer_tpu.pipeline import (
     ElementError,
     ParseError,
@@ -21,7 +17,7 @@ from nnstreamer_tpu.pipeline import (
     make_element,
     parse_pipeline,
 )
-from nnstreamer_tpu.elements.basic import AppSrc, TensorSink, VideoTestSrc
+from nnstreamer_tpu.elements.basic import AppSrc, TensorSink
 
 
 class TestProgrammatic:

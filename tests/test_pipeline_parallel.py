@@ -8,7 +8,6 @@ unsharded reference_loss over the same param pytree.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from nnstreamer_tpu.parallel.mesh import make_mesh
 from nnstreamer_tpu.parallel.pipeline_transformer import (

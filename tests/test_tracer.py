@@ -4,7 +4,6 @@ GstShark tracer hooks, ``tools/tracing/README.md``)."""
 
 import numpy as np
 
-from nnstreamer_tpu.core.tracer import PipelineTracer
 from nnstreamer_tpu.pipeline import parse_pipeline
 
 

@@ -8,7 +8,6 @@ the framework codec on the other.
 """
 
 import math
-import time
 
 import numpy as np
 import pytest

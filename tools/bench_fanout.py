@@ -22,7 +22,6 @@ Env knobs:
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
