@@ -34,6 +34,33 @@ _lock = threading.Lock()
 _enabled: Optional[str] = None
 
 
+def host_fingerprint() -> str:
+    """Short tag identifying this host's compilation compatibility class.
+
+    XLA's CPU backend AOT-compiles for the host's exact CPU features; an
+    entry produced on another machine can load but SIGILL at run time
+    (cpu_aot_loader machine-feature-mismatch warnings).  Keying the cache
+    directory by platform + CPU-feature hash keeps each compatibility
+    class in its own subtree, so cross-host cache reuse can't happen.
+    """
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        feats = platform.processor()
+    tag = hashlib.sha1(
+        f"{platform.system()}-{platform.machine()}-{feats}".encode()
+    ).hexdigest()[:12]
+    return f"{platform.machine()}-{tag}"
+
+
 def enable(cache_dir: Optional[str] = None) -> Optional[str]:
     """Turn on the persistent cache (idempotent); returns the directory
     in use, or None when disabled by config/error."""
@@ -42,6 +69,16 @@ def enable(cache_dir: Optional[str] = None) -> Optional[str]:
         if _enabled is not None and not (cache_dir and not _enabled):
             # sticky result — except that an explicit cache_dir may retry
             # after an earlier failure/disable
+            if cache_dir and _enabled:
+                want = os.path.expanduser(cache_dir)
+                # _enabled is <dir>/<host-fingerprint>; same request iff
+                # want is that dir (or the full fingerprinted path)
+                if want not in (_enabled, os.path.dirname(_enabled)):
+                    log.warning(
+                        "compile cache already enabled at %s; ignoring "
+                        "request for %s (call reset_for_tests() first to "
+                        "re-point)", _enabled, want,
+                    )
             return _enabled or None
         raw = (
             cache_dir
@@ -51,7 +88,9 @@ def enable(cache_dir: Optional[str] = None) -> Optional[str]:
         if not raw:
             _enabled = ""
             return None
-        path = os.path.expanduser(raw)
+        # per-host subtree: AOT entries are only valid on hosts with the
+        # same CPU feature set (see host_fingerprint)
+        path = os.path.join(os.path.expanduser(raw), host_fingerprint())
         try:
             # parse every knob BEFORE mutating jax.config so a bad ini
             # value cannot leave the cache half-enabled.  min 0: streaming

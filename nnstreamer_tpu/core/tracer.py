@@ -145,7 +145,9 @@ class PipelineTracer:
 
     def report(self) -> Dict[str, Dict[str, Any]]:
         out: Dict[str, Dict[str, Any]] = {}
-        for name, st in list(self._stats.items()):
+        with self._lock:  # _get() inserts concurrently from worker threads
+            items = list(self._stats.items())
+        for name, st in items:
             ring = self._snap(st.proc_ring)
             span = (
                 (st.t_last - st.t_first)
@@ -208,7 +210,9 @@ class PipelineTracer:
         import json
 
         t0 = self.t_started
-        lanes = {name: i for i, name in enumerate(list(self._stats))}
+        with self._lock:
+            names = list(self._stats)
+        lanes = {name: i for i, name in enumerate(names)}
         events = [
             {
                 "name": "process_name", "ph": "M", "pid": 0,

@@ -37,7 +37,10 @@ from ..core.buffer import TensorFrame
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from ..pipeline.element import ElementError, Property, SinkElement, SourceElement, element
 
-_IMG_PATTERN = re.compile(r"%0?\d*d")
+# accepts printf length modifiers (%04ld, %04lld — gstdatareposrc.c
+# documents them); Python rejects ll and only ignores single l, so
+# _fmt_sample_path strips them before %-formatting
+_IMG_PATTERN = re.compile(r"%0?\d*(?:ll?)?d")
 
 
 def _is_image_pattern(location: str) -> bool:
@@ -50,7 +53,9 @@ def _fmt_sample_path(location: str, idx: int) -> str:
     """``location % idx`` with stray-% errors surfaced as ElementError
     (a second bare ``%`` in the path makes %-formatting throw)."""
     try:
-        return location % idx
+        return _IMG_PATTERN.sub(
+            lambda m: m.group(0).replace("l", ""), location, count=1
+        ) % idx
     except (ValueError, TypeError) as e:
         raise ElementError(
             f"bad sample-path pattern {location!r}: {e} (exactly one "

@@ -207,7 +207,21 @@ class Element:
     # -- delivery (called from upstream worker threads) ---------------------
     def deliver(self, pad: int, item: Union[TensorFrame, Event]) -> None:
         assert self._mailbox is not None, f"{self.name} not scheduled"
-        self._mailbox.put((pad, item))
+        put_frame = getattr(self._mailbox, "put_frame", None)
+        if put_frame is not None and isinstance(item, TensorFrame):
+            put_frame((pad, item))  # leaky mailbox: drop, never block
+            return
+        # blocking backpressure semantics, expressed as a bounded-wait
+        # retry loop so a leaky mailbox (which forbids timeout=None)
+        # behaves the same as queue.Queue here; never raises queue.Full
+        import queue as _queue
+
+        while True:
+            try:
+                self._mailbox.put((pad, item), timeout=0.5)
+                return
+            except _queue.Full:
+                continue
 
     # -- negotiation --------------------------------------------------------
     def accept_spec(self, pad: int, spec: StreamSpec) -> StreamSpec:

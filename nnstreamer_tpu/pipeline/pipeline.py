@@ -36,8 +36,10 @@ class _LeakyMailbox:
     """Bounded mailbox with GstQueue leaky semantics, all decisions taken
     atomically under one lock: a frame arriving at a full box either
     replaces the oldest queued FRAME (``downstream`` — events keep their
-    exact position) or is itself discarded (``upstream``).  Events use the
-    blocking ``put`` and are never dropped or reordered."""
+    exact position) or is itself discarded (``upstream``).  Events go
+    through ``put``, which requires a bounded timeout (there is no
+    stop-flag escape here); callers retry in a loop so events are never
+    dropped or reordered."""
 
     def __init__(self, maxsize: int, policy: str):
         import collections
@@ -68,6 +70,10 @@ class _LeakyMailbox:
 
     # -- queue.Queue-compatible subset (events, sentinel, worker get) ----
     def put(self, item, timeout: Optional[float] = None) -> None:
+        # no stop-flag escape exists here, so an unbounded block on a full
+        # box could hang shutdown; Pipeline._push loops with bounded waits
+        if timeout is None:
+            raise ValueError("_LeakyMailbox.put requires a bounded timeout")
         with self._mtx:
             if len(self._dq) >= self._max:
                 self._not_full.wait_for(
@@ -590,7 +596,14 @@ class Pipeline:
                     except queue.Empty:
                         pass
                     for entry in kept:
-                        el._mailbox.put(entry)
+                        # upstream producers may refill the box; retry with
+                        # bounded waits (events must survive, same as _push)
+                        while not self._stop_flag.is_set():
+                            try:
+                                el._mailbox.put(entry, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
                     for sp, ev in el.handle_event(pad, item) or []:
                         self._push(el, sp, ev)
                 else:  # custom events

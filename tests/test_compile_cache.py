@@ -25,11 +25,14 @@ def test_enable_creates_dir_and_sets_config(tmp_path, monkeypatch):
     prior = jax.config.jax_compilation_cache_dir
     try:
         got = compile_cache.enable()
-        assert got == target
-        assert os.path.isdir(target)
-        assert jax.config.jax_compilation_cache_dir == target
+        # cache lives in a per-host subtree so AOT entries compiled on a
+        # host with different CPU features can never be loaded here
+        fp = compile_cache.host_fingerprint()
+        assert got == os.path.join(target, fp)
+        assert os.path.isdir(got)
+        assert jax.config.jax_compilation_cache_dir == got
         # idempotent: second call returns the same dir, no re-init
-        assert compile_cache.enable() == target
+        assert compile_cache.enable() == got
     finally:
         # restore the process-global flag: later tests must not write
         # cache entries into this test's doomed tmp_path
@@ -37,6 +40,35 @@ def test_enable_creates_dir_and_sets_config(tmp_path, monkeypatch):
         compile_cache.reset_for_tests()
         monkeypatch.delenv("NNS_TPU_XLA_CACHE_DIR")
         nns_config.reset()
+
+
+def test_host_fingerprint_stable_and_filesystem_safe():
+    from nnstreamer_tpu.core import compile_cache
+
+    fp = compile_cache.host_fingerprint()
+    assert fp == compile_cache.host_fingerprint()  # deterministic
+    assert fp and "/" not in fp and not fp.startswith(".")
+
+
+def test_enable_warns_on_conflicting_explicit_dir(tmp_path, caplog):
+    from nnstreamer_tpu.core import compile_cache
+
+    compile_cache.reset_for_tests()
+    import jax
+
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        first = compile_cache.enable(str(tmp_path / "a"))
+        assert first
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            again = compile_cache.enable(str(tmp_path / "b"))
+        assert again == first  # sticky — but no longer silent
+        assert any("already enabled" in r.message for r in caplog.records)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+        compile_cache.reset_for_tests()
 
 
 def test_disable_via_empty_dir(monkeypatch):

@@ -369,6 +369,21 @@ class TestImageIngest:
         meta = _json.load(open(tmp_path / "m.json"))
         assert meta["format"] == "static" and meta["total_samples"] == 1
 
+    def test_printf_length_modifiers_accepted(self, tmp_path):
+        # gstdatareposrc.c documents 'image_%02ld.png' / '%04lld'; these
+        # must route to image mode and format like plain %d
+        from nnstreamer_tpu.elements.datarepo import (
+            _fmt_sample_path, _is_image_pattern,
+        )
+
+        for pat, idx, want in [
+            ("img_%02ld.png", 3, "img_03.png"),
+            ("img_%04lld.png", 7, "img_0007.png"),
+            ("img_%lld.png", 12, "img_12.png"),
+        ]:
+            assert _is_image_pattern(pat)
+            assert _fmt_sample_path(pat, idx) == want
+
     def test_imagefilesrc_printf_pattern(self, tmp_path):
         _, imgs = self._write_pngs(tmp_path)  # writes img_00..img_03
         pipe = parse_pipeline(
