@@ -3,10 +3,11 @@
 Reference: ``ext/nnstreamer/tensor_converter/tensor_converter_{flexbuf,
 flatbuf,protobuf}.cc`` — parse a framework-neutral byte schema back into an
 ``other/tensors`` frame; the exact inverse of the same-named decoder
-subplugins (decoders/serialize.py).  flexbuf/flatbuf speak the canonical
-wire format (``distributed/wire.py``); protobuf parses the PUBLIC
-``nns_tensors.proto`` schema, so non-framework producers with only a
-protobuf runtime interop here.
+subplugins (decoders/serialize.py).  flexbuf speaks the canonical wire
+format (``distributed/wire.py``); protobuf parses the PUBLIC
+``nns_tensors.proto`` schema and flatbuf parses the reference's ACTUAL
+``nnstreamer.fbs`` binary schema, so non-framework producers with only a
+protobuf/flatbuffers runtime interop here.
 """
 
 from __future__ import annotations
@@ -44,6 +45,7 @@ class FlexbufConverter(_DeserializeBase):
 
 class FlatbufConverter(_DeserializeBase):
     NAME = "flatbuf"
+    IDL = "flatbuf"
 
 
 class ProtobufConverter(_DeserializeBase):

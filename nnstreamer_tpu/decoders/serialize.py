@@ -4,14 +4,15 @@ Reference: ``ext/nnstreamer/tensor_decoder/tensordec-{flexbuf,flatbuf,
 protobuf}.cc`` — serialize an ``other/tensors`` frame into a framework-
 neutral byte schema so non-GStreamer consumers can parse it.
 
-TPU-native shape: the flexbuf/flatbuf modes share this framework's
-canonical wire format (``distributed/wire.py``, analog of
-``nnstreamer.fbs``); the protobuf mode emits the PUBLIC
-``nns_tensors.proto`` schema (``distributed/protobuf_codec.py``) so a
-peer with only a protobuf runtime can parse the stream — the reference's
-``tensordec-protobuf.cc`` interop contract.  Output is a single uint8
-tensor carrying the encoded frame; the matching converter subplugin
-(converters/serialize.py) is the exact inverse.
+TPU-native shape: the flexbuf mode uses this framework's canonical wire
+format (``distributed/wire.py``); the protobuf mode emits the PUBLIC
+``nns_tensors.proto`` schema (``distributed/protobuf_codec.py``) and the
+flatbuf mode emits the reference's ACTUAL ``nnstreamer.fbs`` binary
+schema (``distributed/flatbuf_codec.py``) so peers with only a
+protobuf/flatbuffers runtime can parse the stream — the reference's
+``tensordec-{protobuf,flatbuf}.cc`` interop contracts.  Output is a
+single uint8 tensor carrying the encoded frame; the matching converter
+subplugin (converters/serialize.py) is the exact inverse.
 """
 
 from __future__ import annotations
@@ -51,6 +52,7 @@ class FlexbufDecoder(_SerializeBase):
 class FlatbufDecoder(_SerializeBase):
     NAME = "flatbuf"
     MEDIA = "other/flatbuf"
+    IDL = "flatbuf"  # real nnstreamer.fbs schema, not the NNSQ framing
 
 
 class ProtobufDecoder(_SerializeBase):

@@ -1,0 +1,195 @@
+"""FlatBuffers wire codec speaking the reference's public schema.
+
+Interop IDL #2: emits/parses the exact binary schema of the reference's
+``ext/nnstreamer/include/nnstreamer.fbs`` (root table ``Tensors`` with
+``num_tensor``, inline ``frame_rate`` struct, a vector of ``Tensor``
+tables — name / type enum / uint32[16] dimension / ubyte data — and a
+``format`` enum), built with the stock ``flatbuffers`` Python runtime.
+A peer that ran ``flatc`` over the reference schema parses these buffers
+unmodified, and vice versa — the contract of the reference's
+``tensordec-flatbuf.cc`` / ``tensor_converter/converter-flatbuf.cc``.
+
+Field slots below mirror the schema's declaration order (what flatc
+assigns); the decode side uses the runtime's generic ``Table`` accessors
+— the same machinery flatc-generated readers are sugar over.
+
+Schema limits (vs the richer NNSQ/protobuf codecs): no pts/seq/meta on
+the wire — senders' frame meta is dropped, exactly as the reference's
+flatbuf path drops GstBuffer metadata.  Dimensions ride innermost-first
+(the reference dialect), padded to rank 16 with zeros.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import TensorFrame
+from ..core.types import RANK_LIMIT as _REPO_RANK_LIMIT
+from .wire import WireError
+
+_RANK_LIMIT = 16  # NNS_TENSOR_RANK_LIMIT (tensor_typedef.h:34)
+
+# Tensor_type enum (nnstreamer.fbs) — indices are the wire contract
+_TO_FB = {
+    "int32": 0, "uint32": 1, "int16": 2, "uint16": 3, "int8": 4,
+    "uint8": 5, "float64": 6, "float32": 7, "int64": 8, "uint64": 9,
+}
+_FROM_FB = {v: k for k, v in _TO_FB.items()}
+
+# vtable slots in schema declaration order (flatc assignment)
+_TENSOR_NAME, _TENSOR_TYPE, _TENSOR_DIM, _TENSOR_DATA = 0, 1, 2, 3
+_TENSORS_NUM, _TENSORS_FR, _TENSORS_VEC, _TENSORS_FORMAT = 0, 1, 2, 3
+_NNS_END = 10  # Tensor.type schema default
+
+_FORMAT_STATIC = 0  # Tensor_format enum
+
+
+def _slot(i: int) -> int:
+    """Slot index -> vtable byte offset (flatbuffers layout: 4 + 2*i)."""
+    return 4 + 2 * i
+
+
+def encode_frame(frame: TensorFrame) -> bytes:
+    import flatbuffers
+
+    b = flatbuffers.Builder(1024)
+    tensor_offs = []
+    for t in frame.tensors:
+        arr = np.ascontiguousarray(np.asarray(t))
+        name = str(np.dtype(arr.dtype))
+        if name not in _TO_FB:
+            raise WireError(
+                f"dtype {name} not representable in nnstreamer.fbs"
+            )
+        if arr.ndim > _RANK_LIMIT:
+            raise WireError(f"rank {arr.ndim} exceeds fbs limit {_RANK_LIMIT}")
+        if 0 in arr.shape:
+            # 0 is the dimension terminator on this wire — a zero-size
+            # tensor cannot be represented (the peer would misparse it)
+            raise WireError(
+                f"zero-size tensor shape {arr.shape} not representable "
+                "in nnstreamer.fbs"
+            )
+        # reference dialect: innermost-first, zero-padded to rank 16
+        dims = np.zeros(_RANK_LIMIT, np.uint32)
+        dims[: arr.ndim] = arr.shape[::-1]
+        name_off = b.CreateString(frame.meta.get("tensor_name", "") or "")
+        dim_off = b.CreateNumpyVector(dims)
+        data_off = b.CreateByteVector(arr.tobytes())
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(_TENSOR_NAME, name_off, 0)
+        b.PrependInt32Slot(_TENSOR_TYPE, _TO_FB[name], _NNS_END)
+        b.PrependUOffsetTRelativeSlot(_TENSOR_DIM, dim_off, 0)
+        b.PrependUOffsetTRelativeSlot(_TENSOR_DATA, data_off, 0)
+        tensor_offs.append(b.EndObject())
+
+    b.StartVector(4, len(tensor_offs), 4)
+    for off in reversed(tensor_offs):
+        b.PrependUOffsetTRelative(off)
+    vec_off = b.EndVector()
+
+    rate_n, rate_d = _framerate_of(frame)
+    b.StartObject(4)
+    b.PrependInt32Slot(_TENSORS_NUM, len(frame.tensors), 0)
+    # frame_rate is a struct: built inline while its parent table is open
+    b.Prep(4, 8)
+    b.PrependInt32(rate_d)
+    b.PrependInt32(rate_n)
+    b.PrependStructSlot(_TENSORS_FR, b.Offset(), 0)
+    b.PrependUOffsetTRelativeSlot(_TENSORS_VEC, vec_off, 0)
+    b.PrependInt32Slot(_TENSORS_FORMAT, _FORMAT_STATIC, 0)
+    b.Finish(b.EndObject())
+    return bytes(b.Output())
+
+
+def _framerate_of(frame: TensorFrame):
+    fr = frame.meta.get("framerate")
+    if isinstance(fr, (list, tuple)) and len(fr) == 2:
+        try:
+            return int(fr[0]), int(fr[1])
+        except (TypeError, ValueError):
+            pass
+    return 0, 1
+
+
+def decode_frame(buf: bytes) -> TensorFrame:
+    import flatbuffers
+    from flatbuffers import number_types as NT
+
+    # no copy: the runtime's Table reads any buffer-protocol object, and
+    # decoded arrays alias the payload (same ownership convention as the
+    # NNSQ codec's memoryview slicing)
+    data = buf if isinstance(buf, (bytes, bytearray)) else bytes(buf)
+    try:
+        root = flatbuffers.encode.Get(NT.UOffsetTFlags.packer_type, data, 0)
+        tab = flatbuffers.table.Table(data, root)
+        tensors = []
+        names = []
+        o = tab.Offset(_slot(_TENSORS_VEC))
+        n_declared = 0
+        num_o = tab.Offset(_slot(_TENSORS_NUM))
+        if num_o:
+            n_declared = tab.Get(NT.Int32Flags, num_o + tab.Pos)
+        if o:
+            vec = tab.Vector(o)
+            n = tab.VectorLen(o)
+            for i in range(n):
+                elem = tab.Indirect(vec + i * 4)
+                tt = flatbuffers.table.Table(data, elem)
+                to = tt.Offset(_slot(_TENSOR_TYPE))
+                type_id = (
+                    tt.Get(NT.Int32Flags, to + tt.Pos) if to else _NNS_END
+                )
+                if type_id not in _FROM_FB:
+                    raise WireError(f"unknown Tensor_type {type_id}")
+                dtype = np.dtype(_FROM_FB[type_id])
+                do = tt.Offset(_slot(_TENSOR_DIM))
+                dims = (
+                    tt.GetVectorAsNumpy(NT.Uint32Flags, do)
+                    if do else np.zeros(0, np.uint32)
+                )
+                # innermost-first, zero-terminated -> numpy shape
+                keep = []
+                for d in dims:
+                    if d == 0:
+                        break
+                    keep.append(int(d))
+                shape = tuple(reversed(keep))
+                po = tt.Offset(_slot(_TENSOR_DATA))
+                payload = (
+                    tt.GetVectorAsNumpy(NT.Uint8Flags, po)
+                    if po else np.zeros(0, np.uint8)
+                )
+                expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                if payload.nbytes != expect:
+                    raise WireError(
+                        f"tensor payload {payload.nbytes}B != "
+                        f"shape {shape} x {dtype}"
+                    )
+                if len(shape) > _REPO_RANK_LIMIT:
+                    raise WireError(f"rank {len(shape)} over limit")
+                tensors.append(payload.view(dtype).reshape(shape))
+                no = tt.Offset(_slot(_TENSOR_NAME))
+                names.append(
+                    tt.String(no + tt.Pos).decode() if no else ""
+                )
+        if n_declared and n_declared != len(tensors):
+            raise WireError(
+                f"num_tensor={n_declared} != {len(tensors)} tensors present"
+            )
+        fo = tab.Offset(_slot(_TENSORS_FR))
+        meta = {}
+        if fo:
+            pos = fo + tab.Pos
+            rate_n = tab.Get(NT.Int32Flags, pos)
+            rate_d = tab.Get(NT.Int32Flags, pos + 4)
+            if rate_d:
+                meta["framerate"] = [int(rate_n), int(rate_d)]
+        name = next((n for n in names if n), "")
+        if name:
+            meta["tensor_name"] = name
+    except WireError:
+        raise
+    except Exception as e:  # runtime raises assorted struct/index errors
+        raise WireError(f"malformed flatbuffers frame: {e}") from None
+    return TensorFrame(tensors, meta=meta)

@@ -40,8 +40,10 @@ def get_codec(name: str):
     """(encode, decode) for a wire IDL name.
 
     ``flex``/``nnsq`` = this module's compact framing (default);
-    ``protobuf`` = the interop IDL (``protobuf_codec.py``,
-    ≙ reference nnstreamer.proto + nnstreamer_grpc_protobuf.cc).
+    ``protobuf`` = interop IDL #1 (``protobuf_codec.py``,
+    ≙ reference nnstreamer.proto + nnstreamer_grpc_protobuf.cc);
+    ``flatbuf`` = interop IDL #2 (``flatbuf_codec.py``, the reference's
+    actual nnstreamer.fbs binary schema).
     """
     if name in ("", "flex", "nnsq"):
         return encode_frame, decode_frame
@@ -49,7 +51,11 @@ def get_codec(name: str):
         from . import protobuf_codec
 
         return protobuf_codec.encode_frame, protobuf_codec.decode_frame
-    raise WireError(f"unknown wire idl {name!r} (flex|protobuf)")
+    if name == "flatbuf":
+        from . import flatbuf_codec
+
+        return flatbuf_codec.encode_frame, flatbuf_codec.decode_frame
+    raise WireError(f"unknown wire idl {name!r} (flex|protobuf|flatbuf)")
 
 
 def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:

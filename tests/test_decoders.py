@@ -288,8 +288,9 @@ def test_octet_stream_concat():
 ])
 def test_serialize_roundtrip(mode, media):
     # round-trip through the matching converter subplugin (protobuf mode
-    # speaks the public nns_tensors.proto; flexbuf/flatbuf the canonical
-    # NNSQ framing — either way decoder+converter must be exact inverses)
+    # speaks the public nns_tensors.proto; flatbuf the reference's actual
+    # nnstreamer.fbs; flexbuf the canonical NNSQ framing — either way
+    # decoder+converter must be exact inverses)
     import nnstreamer_tpu.converters  # noqa: F401 — registers subplugins
     from nnstreamer_tpu.core.registry import KIND_CONVERTER, get
     t = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
@@ -305,6 +306,13 @@ def test_serialize_roundtrip(mode, media):
         from nnstreamer_tpu.distributed import protobuf_codec
 
         ext = protobuf_codec.decode_frame(bytes(out.tensors[0]))
+        np.testing.assert_array_equal(np.asarray(ext.tensors[0]), t)
+    if mode == "flatbuf":
+        # same interop bar for flatbuf: the payload is a real
+        # nnstreamer.fbs buffer, parseable by the schema codec alone
+        from nnstreamer_tpu.distributed import flatbuf_codec
+
+        ext = flatbuf_codec.decode_frame(bytes(out.tensors[0]))
         np.testing.assert_array_equal(np.asarray(ext.tensors[0]), t)
 
 

@@ -115,6 +115,161 @@ class TestDeviceFusion:
         assert raw == [(8,)] * 4  # untouched full score tensors
 
 
+class TestBoundingBoxFusion:
+    """Device-fused bounding-box decode (≙ tensordec-boundingbox.c, but the
+    box decode + NMS run inside the filter's XLA program; only top-K
+    surviving boxes cross the device->host boundary)."""
+
+    C = 3  # classes
+
+    def _yolo_pred(self, boxes_px):
+        """Build a (N, 5+C) yolov5 head: a few confident boxes + noise rows.
+
+        ``boxes_px``: list of (cx, cy, w, h, obj, cls) with coords in 0..1.
+        """
+        rng = np.random.default_rng(11)
+        n = 16
+        pred = np.zeros((n, 5 + self.C), np.float32)
+        pred[:, :4] = rng.uniform(0.3, 0.7, (n, 4)).astype(np.float32)
+        pred[:, 4] = 0.01  # low objectness: below conf threshold
+        pred[:, 5:] = rng.uniform(0.1, 0.9, (n, self.C)).astype(np.float32)
+        for i, (cx, cy, w, h, obj, cls) in enumerate(boxes_px):
+            pred[i, :5] = (cx, cy, w, h, obj)
+            pred[i, 5:] = 0.05
+            pred[i, 5 + int(cls)] = 0.99
+        return pred
+
+    def _frames(self):
+        # frame 0: two separated boxes (cls 0, cls 1)
+        # frame 1: same-class overlap (NMS keeps the higher score) plus a
+        #          different-class box at the same spot (per-class NMS
+        #          keeps it)
+        return [
+            self._yolo_pred([
+                (0.25, 0.25, 0.2, 0.2, 0.9, 0),
+                (0.75, 0.75, 0.2, 0.3, 0.8, 1),
+            ]),
+            self._yolo_pred([
+                (0.5, 0.5, 0.3, 0.3, 0.9, 2),
+                (0.52, 0.5, 0.3, 0.3, 0.7, 2),   # suppressed (IoU ~0.8)
+                (0.5, 0.5, 0.3, 0.3, 0.85, 1),   # other class: survives
+            ]),
+        ]
+
+    def _run(self, mode_opts, preds, n_inputs=1, extra=""):
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=jax-xla model=fusion_passthru "
+            "max-batch=2 batch-timeout=50 ! "
+            f"tensor_decoder name=d mode=bounding_boxes {mode_opts} "
+            f"{extra} ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i, p in enumerate(preds):
+            ts = [np.asarray(t) for t in (p if n_inputs > 1 else [p])]
+            pipe["src"].push(TensorFrame(ts, pts=float(i)))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=60)
+        fused = pipe["d"]._fused
+        frames = list(pipe["out"].frames)
+        pipe.stop()
+        return fused, frames
+
+    @staticmethod
+    def _boxes(frames):
+        return [f.meta["boxes"] for f in frames]
+
+    @staticmethod
+    def _assert_same_boxes(got, want):
+        assert len(got) == len(want)
+        for g_frame, w_frame in zip(got, want):
+            assert len(g_frame) == len(w_frame)
+            for g, w in zip(g_frame, w_frame):
+                assert g["class"] == w["class"] and g["label"] == w["label"]
+                for k in ("x", "y", "w", "h"):
+                    assert g[k] == pytest.approx(w[k], abs=0.1)
+                assert g["score"] == pytest.approx(w["score"], rel=1e-4)
+
+    def test_yolov5_fused_matches_host(self, labels):
+        import jax  # noqa: F401
+
+        def passthru(params, xs):
+            return list(xs)
+
+        register_jax_model("fusion_passthru", passthru, {})
+        try:
+            preds = self._frames()
+            opts = f"option1=yolov5 option2={labels}"
+            fused, f_frames = self._run(opts, preds)
+            assert fused is True
+            unfused, h_frames = self._run(opts, preds, extra="device-fused=never")
+            assert unfused is False
+        finally:
+            unregister_jax_model("fusion_passthru")
+        host = self._boxes(h_frames)
+        # sanity: the scenario exercises NMS (frame 1 lost its overlap)
+        assert [len(b) for b in host] == [2, 2]
+        assert sorted(b["class"] for b in host[1]) == [1, 2]
+        self._assert_same_boxes(self._boxes(f_frames), host)
+
+    def test_mobilenet_ssd_fused_matches_host(self, tmp_path, labels):
+        P = 8
+        rng = np.random.default_rng(5)
+        yc = rng.uniform(0.25, 0.75, P)
+        xc = rng.uniform(0.25, 0.75, P)
+        yc[1], xc[1] = yc[0] + 0.01, xc[0] + 0.01  # overlapping prior pair
+        hw = np.full(P, 0.22)
+        priors = tmp_path / "priors.txt"
+        priors.write_text("\n".join(
+            " ".join(f"{v:.6f}" for v in row) for row in (yc, xc, hw, hw)
+        ))
+        # logits: priors 0,1 confident class 1 (NMS pair), prior 2 class 2,
+        # rest below threshold
+        frames = []
+        for _ in range(2):
+            loc = rng.normal(0, 0.5, (P, 4)).astype(np.float32)
+            sc = np.full((P, self.C), -4.0, np.float32)
+            sc[0, 1], sc[1, 1], sc[2, 2] = 3.0, 2.0, 2.5
+            frames.append((loc, sc))
+
+        def passthru(params, xs):
+            return list(xs)
+
+        register_jax_model("fusion_passthru", passthru, {})
+        try:
+            opts = f"option1=mobilenet-ssd option2={labels} option3={priors}"
+            fused, f_frames = self._run(opts, frames, n_inputs=2)
+            assert fused is True
+            unfused, h_frames = self._run(
+                opts, frames, n_inputs=2, extra="device-fused=never")
+            assert unfused is False
+        finally:
+            unregister_jax_model("fusion_passthru")
+        host = self._boxes(h_frames)
+        assert all(len(b) >= 2 for b in host)  # NMS dropped the weaker twin
+        self._assert_same_boxes(self._boxes(f_frames), host)
+
+    def test_untraceable_mode_stays_on_host(self, labels):
+        # tf-ssd postprocess mode has a dynamic valid-count: must not fuse
+        def passthru(params, xs):
+            return list(xs)
+
+        register_jax_model("fusion_passthru", passthru, {})
+        try:
+            boxes = np.asarray(
+                [[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]], np.float32)
+            classes = np.asarray([0.0, 1.0], np.float32)
+            scores = np.asarray([0.9, 0.8], np.float32)
+            count = np.asarray([2.0], np.float32)
+            fused, frames = self._run(
+                f"option1=tf-ssd option2={labels}",
+                [(boxes, classes, scores, count)], n_inputs=4)
+            assert fused is False
+            assert len(frames[0].meta["boxes"]) == 2
+        finally:
+            unregister_jax_model("fusion_passthru")
+
+
 class TestBatchFrame:
     def test_split_roundtrip(self):
         frames = [
