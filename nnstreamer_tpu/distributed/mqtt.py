@@ -130,10 +130,50 @@ def topic_matches(pattern: str, topic: str) -> bool:
     return len(pp) == len(tp)
 
 
-class MiniBroker:
-    """Tiny localhost MQTT broker (QoS 0, wildcards, retained messages)."""
+class _BrokerSession:
+    """Per-client-id broker state: subscriptions (pattern -> granted QoS),
+    the live socket (None while offline), QoS-1 messages in flight to the
+    subscriber, and — for persistent (clean_session=0) sessions — messages
+    queued while offline."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    __slots__ = ("cid", "clean", "subs", "sock", "inflight", "queue",
+                 "next_pid", "dropped")
+
+    QUEUE_LIMIT = 1024     # offline/overflow backlog bound per session
+    INFLIGHT_LIMIT = 512   # unacked deliveries per connected subscriber
+
+    def __init__(self, cid: str, clean: bool):
+        self.cid = cid
+        self.clean = clean
+        self.subs: Dict[str, int] = {}
+        self.sock: Optional[socket.socket] = None
+        # pid -> [topic, payload, last_sent_ts, retain]
+        self.inflight: Dict[int, list] = {}
+        self.queue: List[Tuple[str, bytes, bool]] = []
+        self.next_pid = 0
+        self.dropped = 0
+
+    def alloc_pid(self) -> int:
+        # never reuse a pid that is still awaiting its PUBACK (wraparound
+        # would silently overwrite an undelivered message); INFLIGHT_LIMIT
+        # << 65535 keeps this loop trivially bounded
+        while True:
+            self.next_pid = (self.next_pid % 0xFFFF) + 1
+            if self.next_pid not in self.inflight:
+                return self.next_pid
+
+
+class MiniBroker:
+    """Tiny localhost MQTT broker: wildcards, retained messages, QoS 0/1
+    end-to-end.  Subscriber-side QoS 1 honors the spec: the requested QoS
+    is granted in SUBACK, deliveries carry packet ids and are retransmitted
+    (DUP) until PUBACKed, and persistent sessions (CONNECT clean=0) keep
+    subscriptions + undelivered QoS-1 messages across subscriber death so
+    a reconnecting subscriber loses nothing (≙ paho/mosquitto behavior the
+    reference relies on, gst/mqtt/mqttsink.h:77 ``mqtt_qos``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 retransmit_s: float = 1.0):
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         # REUSEADDR (not REUSEPORT: two live brokers on one port would
         # silently load-balance clients between them) — restart rebinding
@@ -144,17 +184,23 @@ class MiniBroker:
         self._srv.listen(16)
         self.host, self.port = self._srv.getsockname()
         self._lock = threading.Lock()
-        # sock -> list of subscription patterns; per-sock write locks so a
-        # publisher fan-out and the subscriber's own control responses
-        # (SUBACK/PINGRESP/retained) cannot interleave mid-sendall
-        self._subs: Dict[socket.socket, List[str]] = {}
+        self._sessions: Dict[str, _BrokerSession] = {}
+        self._by_sock: Dict[socket.socket, _BrokerSession] = {}
+        # per-sock write locks so a publisher fan-out and the subscriber's
+        # own control responses (SUBACK/PINGRESP/retained) cannot
+        # interleave mid-sendall
         self._wlocks: Dict[socket.socket, threading.Lock] = {}
         self._retained: Dict[str, bytes] = {}
+        self._retransmit_s = retransmit_s
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._accept_loop, name="mqtt-broker", daemon=True
         )
         self._thread.start()
+        self._redeliver = threading.Thread(
+            target=self._redeliver_loop, name="mqtt-broker-qos1", daemon=True
+        )
+        self._redeliver.start()
 
     def close(self) -> None:
         self._stop.set()
@@ -163,20 +209,24 @@ class MiniBroker:
         except OSError:
             pass
         with self._lock:
-            for s in list(self._subs):
-                try:
-                    # shutdown BEFORE close: close() alone neither wakes a
-                    # thread blocked in recv on this fd nor guarantees a
-                    # prompt FIN to the peer; shutdown does both, so
-                    # clients detect broker death immediately
-                    s.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._subs.clear()
+            socks = list(self._by_sock)
+        for s in socks:
+            try:
+                # shutdown BEFORE close: close() alone neither wakes a
+                # thread blocked in recv on this fd nor guarantees a
+                # prompt FIN to the peer; shutdown does both, so
+                # clients detect broker death immediately
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._lock:
+            self._by_sock.clear()
+            self._wlocks.clear()
+            self._sessions.clear()
 
     # -- internals ----------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -189,26 +239,74 @@ class MiniBroker:
                 target=self._client_loop, args=(sock,), daemon=True
             ).start()
 
+    @staticmethod
+    def _parse_connect(body: bytes) -> Tuple[str, bool]:
+        """CONNECT variable header + payload -> (client_id, clean_session).
+        MQTT 3.1.1 §3.1: proto name str, level byte, flags byte,
+        keepalive u16, then the client id string."""
+        off = 2 + struct.unpack(">H", body[:2])[0]  # skip protocol name
+        flags = body[off + 1]
+        off += 4  # level + flags + keepalive
+        cid_len = struct.unpack(">H", body[off : off + 2])[0]
+        cid = body[off + 2 : off + 2 + cid_len].decode()
+        return cid, bool(flags & 0x02)
+
+    def _open_session(self, sock: socket.socket,
+                      body: bytes) -> Tuple[_BrokerSession, bool]:
+        cid, clean = self._parse_connect(body)
+        with self._lock:
+            existing = self._sessions.get(cid) if cid else None
+            # a still-live connection under this client id is displaced
+            # whatever the clean flag (MQTT 3.1.1 §3.1.4: new wins)
+            old = existing.sock if existing is not None else None
+            sess = existing if (existing is not None and not clean) else None
+            present = sess is not None
+            if sess is None:
+                sess = _BrokerSession(cid or f"anon-{id(sock):x}", clean)
+            sess.clean = clean
+            sess.sock = sock
+            self._sessions[sess.cid] = sess
+            self._by_sock[sock] = sess
+            self._wlocks[sock] = threading.Lock()
+        if old is not None and old is not sock:
+            try:
+                old.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return sess, present
+
     def _client_loop(self, sock: socket.socket) -> None:
+        sess = None
         try:
-            ptype, _, _ = _read_packet(sock)
+            # bound SENDS only (SO_SNDTIMEO, not settimeout: recv must
+            # stay blocking): a wedged subscriber whose TCP window filled
+            # would otherwise stall the shared redelivery/fan-out threads
+            # in sendall forever
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", 5, 0),
+            )
+            ptype, _, body = _read_packet(sock)
             if ptype != CONNECT:
                 sock.close()
                 return
-            sock.sendall(bytes([CONNACK << 4, 2, 0, 0]))
-            with self._lock:
-                self._subs[sock] = []
-                self._wlocks[sock] = threading.Lock()
+            sess, present = self._open_session(sock, body)
+            sock.sendall(bytes([CONNACK << 4, 2, 1 if present else 0, 0]))
+            if present:
+                self._resume_delivery(sess)
             while not self._stop.is_set():
                 ptype, flags, body = _read_packet(sock)
                 if ptype == PUBLISH:
                     self._handle_publish(sock, flags, body)
                 elif ptype == PUBACK:
-                    pass  # subscribers are served at QoS 0 (downgrade)
+                    if len(body) >= 2:
+                        (pid,) = struct.unpack(">H", body[:2])
+                        with self._lock:
+                            sess.inflight.pop(pid, None)
                 elif ptype == SUBSCRIBE:
-                    self._handle_subscribe(sock, body)
+                    self._handle_subscribe(sock, sess, body)
                 elif ptype == UNSUBSCRIBE:
-                    self._handle_unsubscribe(sock, body)
+                    self._handle_unsubscribe(sock, sess, body)
                 elif ptype == PINGREQ:
                     self._send(sock, bytes([PINGRESP << 4, 0]))
                 elif ptype == DISCONNECT:
@@ -220,16 +318,86 @@ class MiniBroker:
             log.warning("broker: dropping client on malformed packet: %s", e)
         finally:
             with self._lock:
-                self._subs.pop(sock, None)
+                self._by_sock.pop(sock, None)
                 self._wlocks.pop(sock, None)
+                if sess is not None and sess.sock is sock:
+                    sess.sock = None
+                    # drop only OUR session entry: a reconnect may already
+                    # have replaced this cid with a fresh session object
+                    if sess.clean and self._sessions.get(sess.cid) is sess:
+                        self._sessions.pop(sess.cid, None)
             try:
                 sock.close()
             except OSError:
                 pass
 
+    def _resume_delivery(self, sess: _BrokerSession) -> None:
+        """Persistent-session reconnect: retransmit unacked inflight
+        (DUP) and flush the offline queue as fresh QoS-1 deliveries."""
+        with self._lock:
+            sock = sess.sock
+            inflight = sorted(sess.inflight.items())
+            queued, sess.queue = sess.queue, []
+        if sock is None:
+            return
+        for pid, entry in inflight:
+            self._send(sock, _publish_packet(
+                entry[0], entry[1], entry[3], qos=1, pid=pid, dup=True))
+            entry[2] = time.monotonic()
+        for topic, payload, retain in queued:
+            self._deliver_qos1(sess, topic, payload, retain)
+
+    def _deliver_qos1(self, sess: _BrokerSession, topic: str,
+                      payload: bytes, retain: bool = False) -> None:
+        with self._lock:
+            sock = sess.sock
+            # offline subscriber — or a connected one that stopped acking
+            # (inflight full): park in the bounded queue; the redelivery
+            # loop promotes queued entries as PUBACKs free inflight room
+            if sock is None or len(sess.inflight) >= sess.INFLIGHT_LIMIT:
+                if len(sess.queue) < sess.QUEUE_LIMIT:
+                    sess.queue.append((topic, payload, retain))
+                else:
+                    sess.dropped += 1
+                return
+            pid = sess.alloc_pid()
+            sess.inflight[pid] = [topic, payload, time.monotonic(), retain]
+        self._send(sock, _publish_packet(topic, payload, retain, 1, pid))
+
+    def _redeliver_loop(self) -> None:
+        """QoS-1 redelivery to subscribers: resend inflight entries older
+        than the retransmit interval with DUP until PUBACKed, and promote
+        queued messages into freed inflight slots."""
+        while not self._stop.wait(max(0.05, self._retransmit_s / 2)):
+            now = time.monotonic()
+            with self._lock:
+                stale = [
+                    (sess.sock, pid, e)
+                    for sess in self._sessions.values() if sess.sock
+                    for pid, e in sess.inflight.items()
+                    if now - e[2] >= self._retransmit_s
+                ]
+                promotable = [
+                    sess for sess in self._sessions.values()
+                    if sess.sock and sess.queue
+                    and len(sess.inflight) < sess.INFLIGHT_LIMIT
+                ]
+            for sock, pid, entry in stale:
+                entry[2] = now
+                self._send(sock, _publish_packet(
+                    entry[0], entry[1], entry[3], qos=1, pid=pid, dup=True))
+            for sess in promotable:
+                with self._lock:
+                    room = sess.INFLIGHT_LIMIT - len(sess.inflight)
+                    batch, sess.queue = (
+                        sess.queue[:room], sess.queue[room:])
+                for topic, payload, retain in batch:
+                    self._deliver_qos1(sess, topic, payload, retain)
+
     def _handle_publish(self, sock: socket.socket, flags: int,
                         body: bytes) -> None:
         topic, payload, pid = _parse_publish(flags, body)
+        pub_qos = (flags >> 1) & 0x3
         if pid is not None:  # QoS 1 in: acknowledge to the publisher
             self._send(sock, bytes([PUBACK << 4, 2]) + struct.pack(">H", pid))
         if flags & 0x1:  # retain; empty payload DELETES (MQTT 3.1.1 §3.3.1.3)
@@ -238,16 +406,26 @@ class MiniBroker:
                     self._retained[topic] = payload
                 else:
                     self._retained.pop(topic, None)
-        # fan out at QoS 0 (broker-side downgrade; publisher-side QoS 1
-        # still guarantees the message reached the broker at least once)
-        packet = _publish_packet(topic, payload)
+        # fan out at min(publish QoS, granted subscription QoS) per
+        # subscriber (MQTT 3.1.1 §3.8.4)
         with self._lock:
             targets = [
-                s for s, pats in self._subs.items()
-                if any(topic_matches(p, topic) for p in pats)
+                (sess, max(
+                    (q for p, q in sess.subs.items()
+                     if topic_matches(p, topic)), default=-1,
+                ))
+                for sess in self._sessions.values()
             ]
-        for s in targets:
-            self._send(s, packet)
+        qos0_packet = None
+        for sess, sub_qos in targets:
+            if sub_qos < 0:
+                continue
+            if min(pub_qos, sub_qos) >= 1:
+                self._deliver_qos1(sess, topic, payload)
+            elif sess.sock is not None:
+                if qos0_packet is None:
+                    qos0_packet = _publish_packet(topic, payload)
+                self._send(sess.sock, qos0_packet)
 
     def _send(self, sock: socket.socket, data: bytes) -> None:
         with self._lock:
@@ -257,41 +435,64 @@ class MiniBroker:
         try:
             with wl:
                 sock.sendall(data)
+        except socket.timeout:
+            # send window stayed full for the whole SNDTIMEO: the peer is
+            # wedged — tear it down so its session goes offline (messages
+            # queue) instead of letting it stall shared delivery threads
+            log.warning("broker: peer stopped reading; disconnecting it")
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         except OSError:
             pass
 
-    def _handle_subscribe(self, sock: socket.socket, body: bytes) -> None:
+    def _handle_subscribe(self, sock: socket.socket, sess: _BrokerSession,
+                          body: bytes) -> None:
         pid = body[:2]
         off = 2
-        pats = []
-        while off < len(body):
-            ln = struct.unpack(">H", body[off : off + 2])[0]
-            pats.append(body[off + 2 : off + 2 + ln].decode())
-            off += 2 + ln + 1  # + requested QoS byte
+        grants = []
+        new_pats = []
         with self._lock:
-            self._subs[sock].extend(pats)
-            retained = [
-                (t, p) for t, p in self._retained.items()
-                if any(topic_matches(pat, t) for pat in pats)
-            ]
-        self._send(
-            sock,
-            bytes([SUBACK << 4]) + _encode_len(2 + len(pats)) + pid
-            + bytes([0] * len(pats)),
-        )
-        for t, p in retained:
-            self._send(sock, _publish_packet(t, p, retain=True))
-
-    def _handle_unsubscribe(self, sock: socket.socket, body: bytes) -> None:
-        pid = body[:2]
-        off = 2
-        with self._lock:
-            pats = self._subs.get(sock, [])
             while off < len(body):
                 ln = struct.unpack(">H", body[off : off + 2])[0]
                 pat = body[off + 2 : off + 2 + ln].decode()
-                if pat in pats:
-                    pats.remove(pat)
+                req_qos = body[off + 2 + ln] & 0x3
+                granted = min(req_qos, 1)  # QoS 2 not implemented
+                sess.subs[pat] = granted  # re-subscribe replaces
+                grants.append(granted)
+                new_pats.append(pat)
+                off += 2 + ln + 1
+            retained = [
+                (t, p, max(
+                    (sess.subs[pat] for pat in new_pats
+                     if topic_matches(pat, t)), default=0,
+                ))
+                for t, p in self._retained.items()
+                if any(topic_matches(pat, t) for pat in new_pats)
+            ]
+        self._send(
+            sock,
+            bytes([SUBACK << 4]) + _encode_len(2 + len(grants)) + pid
+            + bytes(grants),
+        )
+        # retained state rides at the granted QoS (§3.3.1.3): a qos-1
+        # subscription gets tracked, retransmitted retained delivery
+        for t, p, q in retained:
+            if q >= 1:
+                self._deliver_qos1(sess, t, p, retain=True)
+            else:
+                self._send(sock, _publish_packet(t, p, retain=True))
+
+    def _handle_unsubscribe(self, sock: socket.socket, sess: _BrokerSession,
+                            body: bytes) -> None:
+        pid = body[:2]
+        off = 2
+        with self._lock:
+            while off < len(body):
+                ln = struct.unpack(">H", body[off : off + 2])[0]
+                pat = body[off + 2 : off + 2 + ln].decode()
+                sess.subs.pop(pat, None)
                 off += 2 + ln
         self._send(sock, bytes([UNSUBACK << 4, 2]) + pid)
 
@@ -306,9 +507,14 @@ class MqttClient:
     def __init__(self, host: str, port: int, client_id: str = "",
                  keepalive: int = 60, timeout: float = 10.0,
                  reconnect: bool = True, retransmit_s: float = 2.0,
-                 reconnect_delay_s: float = 0.1):
+                 reconnect_delay_s: float = 0.1,
+                 clean_session: bool = True):
         self._host, self._port, self._timeout = host, port, timeout
         self._cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
+        # clean_session=False + a stable client_id = persistent session:
+        # the broker keeps subscriptions and queues/retransmits QoS-1
+        # deliveries across this client's death (at-least-once end-to-end)
+        self._clean_session = clean_session
         self._keepalive = max(1, keepalive)
         self._reconnect_enabled = reconnect
         self._retransmit_s = retransmit_s
@@ -322,6 +528,7 @@ class MqttClient:
         # per-pattern callbacks: a second subscribe() must not reroute
         # earlier patterns' messages to the newest callback
         self._subs: Dict[str, Callable[[str, bytes], None]] = {}
+        self._sub_qos: Dict[str, int] = {}
         self._stop = threading.Event()
         self._pid_lock = threading.Lock()
         self._pid = 0
@@ -350,7 +557,7 @@ class MqttClient:
         )
         var = (
             _mqtt_str("MQTT") + bytes([4])  # protocol level 4 = 3.1.1
-            + bytes([0x02])                 # clean session
+            + bytes([0x02 if self._clean_session else 0x00])
             + struct.pack(">H", self._keepalive)
             + _mqtt_str(self._cid)
         )
@@ -475,13 +682,17 @@ class MqttClient:
     def _send_subscribe(self, pattern: str) -> None:
         var = (
             struct.pack(">H", self._next_pid()) + _mqtt_str(pattern)
-            + bytes([0])
+            + bytes([self._sub_qos.get(pattern, 0)])
         )
         self._send(bytes([(SUBSCRIBE << 4) | 0x2]) + _encode_len(len(var)) + var)
 
     def subscribe(self, pattern: str,
-                  callback: Callable[[str, bytes], None]) -> None:
+                  callback: Callable[[str, bytes], None],
+                  qos: int = 0) -> None:
+        if qos not in (0, 1):
+            raise ValueError("only QoS 0/1 supported")
         self._subs[pattern] = callback
+        self._sub_qos[pattern] = qos
         try:
             self._send_subscribe(pattern)
         except OSError:

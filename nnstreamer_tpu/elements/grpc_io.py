@@ -95,7 +95,7 @@ class GrpcSink(SinkElement):
         "host": Property(str, "127.0.0.1", "bind/connect host"),
         "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
         "server": Property(bool, False, "run as gRPC server (clients Pull)"),
-        "idl": Property(str, "flex", "wire IDL: flex | protobuf (interop)"),
+        "idl": Property(str, "flex", "wire IDL: flex | protobuf | flatbuf (interop)"),
         "max-buffers": Property(int, 64, "stream queue depth"),
         "retry-timeout": Property(
             float, 10.0,
@@ -198,7 +198,7 @@ class GrpcSrc(SourceElement):
         "host": Property(str, "127.0.0.1", "bind/connect host"),
         "port": Property(int, 55115, "bind/connect port (0 = auto in server mode)"),
         "server": Property(bool, True, "run as gRPC server (peers Send)"),
-        "idl": Property(str, "flex", "wire IDL: flex | protobuf (interop)"),
+        "idl": Property(str, "flex", "wire IDL: flex | protobuf | flatbuf (interop)"),
         "num-buffers": Property(int, -1, "EOS after N frames (-1 = forever)"),
         "timeout": Property(int, 10000, "ms without a frame before EOS"),
     }

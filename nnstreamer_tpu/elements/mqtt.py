@@ -49,7 +49,7 @@ class MqttSink(SinkElement):
         "client-id": Property(str, "", "MQTT client id (auto if empty)"),
         "retain": Property(bool, False, "retain the last message"),
         "num-buffers": Property(int, -1, "stop after N messages (-1 = all)"),
-        "idl": Property(str, "flex", "payload IDL: flex | protobuf (interop)"),
+        "idl": Property(str, "flex", "payload IDL: flex | protobuf | flatbuf (interop)"),
         # ≙ reference mqtt_qos (gst/mqtt/mqttsink.h:77); 1 = at-least-once
         # with PUBACK + DUP redelivery across broker restarts
         "qos": Property(int, 0, "MQTT QoS: 0 (fire-forget) | 1 (at-least-once)"),
@@ -116,7 +116,7 @@ class MqttSrc(SourceElement):
         "num-buffers": Property(int, -1, "EOS after N messages (-1 = forever)"),
         "sub-timeout": Property(int, 10000, "ms without a message before EOS"),
         "max-msg-buf-size": Property(int, 64, "receive queue depth"),
-        "idl": Property(str, "flex", "payload IDL: flex | protobuf (interop)"),
+        "idl": Property(str, "flex", "payload IDL: flex | protobuf | flatbuf (interop)"),
         "reconnect-delay": Property(float, 0.1, "initial reconnect backoff, s"),
     }
 

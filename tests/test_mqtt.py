@@ -139,6 +139,192 @@ class TestQos1:
         tx.close()
 
 
+class TestSubscriberQos1:
+    """Subscriber-side QoS 1 (MQTT 3.1.1 §3.8.4/§4.3.2): granted in
+    SUBACK, deliveries carry packet ids and retransmit until PUBACK, and
+    persistent sessions survive subscriber death with no message loss."""
+
+    @staticmethod
+    def _raw_connect(broker, cid, clean=True):
+        import socket as _socket
+        import struct as _struct
+
+        from nnstreamer_tpu.distributed import mqtt as m
+
+        s = _socket.create_connection((broker.host, broker.port), timeout=5)
+        var = (
+            m._mqtt_str("MQTT") + bytes([4])
+            + bytes([0x02 if clean else 0x00])
+            + _struct.pack(">H", 60) + m._mqtt_str(cid)
+        )
+        s.sendall(bytes([m.CONNECT << 4]) + m._encode_len(len(var)) + var)
+        ptype, _, body = m._read_packet(s)
+        assert ptype == m.CONNACK
+        return s, body
+
+    @staticmethod
+    def _raw_subscribe(s, pattern, qos):
+        import struct as _struct
+
+        from nnstreamer_tpu.distributed import mqtt as m
+
+        var = _struct.pack(">H", 7) + m._mqtt_str(pattern) + bytes([qos])
+        s.sendall(
+            bytes([(m.SUBSCRIBE << 4) | 0x2]) + m._encode_len(len(var)) + var
+        )
+        ptype, _, body = m._read_packet(s)
+        assert ptype == m.SUBACK
+        return body[2:]  # granted QoS list
+
+    def test_suback_grants_requested_qos(self, broker):
+        s, _ = self._raw_connect(broker, "raw-grant")
+        try:
+            assert self._raw_subscribe(s, "g/1", 1) == bytes([1])
+            assert self._raw_subscribe(s, "g/0", 0) == bytes([0])
+            assert self._raw_subscribe(s, "g/2", 2) == bytes([1])  # capped
+        finally:
+            s.close()
+
+    def test_delivery_has_packet_id_and_dup_retransmit(self):
+        from nnstreamer_tpu.distributed import mqtt as m
+
+        broker = MiniBroker(retransmit_s=0.3)
+        try:
+            s, _ = self._raw_connect(broker, "raw-sub")
+            self._raw_subscribe(s, "d/t", 1)
+            tx = MqttClient(broker.host, broker.port)
+            tx.publish("d/t", b"payload", qos=1)
+            # first delivery: QoS 1, packet id, no DUP
+            ptype, flags, body = m._read_packet(s)
+            assert ptype == m.PUBLISH and (flags >> 1) & 0x3 == 1
+            topic, payload, pid = m._parse_publish(flags, body)
+            assert (topic, payload) == ("d/t", b"payload")
+            assert pid is not None and not (flags & 0x8)
+            # no PUBACK sent -> broker must retransmit with DUP, same pid
+            ptype, flags, body = m._read_packet(s)
+            assert ptype == m.PUBLISH and flags & 0x8
+            _, _, pid2 = m._parse_publish(flags, body)
+            assert pid2 == pid
+            # ack it; the redelivery loop must go quiet
+            import struct as _struct
+
+            s.sendall(bytes([m.PUBACK << 4, 2]) + _struct.pack(">H", pid))
+            s.settimeout(1.0)
+            with pytest.raises(OSError):
+                m._read_packet(s)  # nothing further arrives
+            tx.close()
+            s.close()
+        finally:
+            broker.close()
+
+    def test_qos0_subscription_downgrades_delivery(self, broker):
+        from nnstreamer_tpu.distributed import mqtt as m
+
+        s, _ = self._raw_connect(broker, "raw-q0")
+        try:
+            self._raw_subscribe(s, "q0/t", 0)
+            tx = MqttClient(broker.host, broker.port)
+            tx.publish("q0/t", b"x", qos=1)  # min(1, 0) = QoS 0 out
+            ptype, flags, body = m._read_packet(s)
+            assert ptype == m.PUBLISH and (flags >> 1) & 0x3 == 0
+            _, _, pid = m._parse_publish(flags, body)
+            assert pid is None
+            tx.close()
+        finally:
+            s.close()
+
+    def test_slow_acker_overflow_queues_then_promotes(self, monkeypatch):
+        """A connected subscriber that stops PUBACKing must not grow the
+        inflight map unboundedly: overflow parks in the session queue and
+        is promoted (delivered) once acks free inflight slots."""
+        import struct as _struct
+
+        from nnstreamer_tpu.distributed import mqtt as m
+        from nnstreamer_tpu.distributed.mqtt import _BrokerSession
+
+        monkeypatch.setattr(_BrokerSession, "INFLIGHT_LIMIT", 3)
+        broker = MiniBroker(retransmit_s=0.2)
+        try:
+            s, _ = self._raw_connect(broker, "slow-acker")
+            self._raw_subscribe(s, "o/t", 1)
+            tx = MqttClient(broker.host, broker.port)
+            n = 10
+            for i in range(n):
+                tx.publish("o/t", f"p{i}".encode(), qos=1)
+            assert tx.drain(5) == 0
+            with broker._lock:
+                sess = broker._sessions["slow-acker"]
+                assert len(sess.inflight) <= 3  # capped
+            # now ack everything we receive; promotions must drain the lot
+            got = set()
+            s.settimeout(5.0)
+            deadline = time.time() + 10
+            while len(got) < n and time.time() < deadline:
+                ptype, flags, body = m._read_packet(s)
+                if ptype != m.PUBLISH:
+                    continue
+                _, payload, pid = m._parse_publish(flags, body)
+                got.add(payload)
+                if pid is not None:
+                    s.sendall(
+                        bytes([m.PUBACK << 4, 2]) + _struct.pack(">H", pid))
+            assert got == {f"p{i}".encode() for i in range(n)}
+            tx.close()
+            s.close()
+        finally:
+            broker.close()
+
+    def test_killed_subscriber_reconnects_without_loss(self):
+        """The end-to-end at-least-once contract across a flaky
+        subscriber link: kill the subscriber (no DISCONNECT) mid-stream,
+        keep publishing, reconnect with the same client id — every
+        message arrives (duplicates allowed, loss not)."""
+        broker = MiniBroker(retransmit_s=0.2)
+        try:
+            got = []
+            sub = MqttClient(
+                broker.host, broker.port, client_id="persist-sub",
+                clean_session=False, reconnect=False,
+            )
+            sub.subscribe("k/t", lambda t, p: got.append(p), qos=1)
+            tx = MqttClient(broker.host, broker.port, client_id="pub")
+            time.sleep(0.1)
+            tx.publish("k/t", b"m0", qos=1)
+            deadline = time.time() + 5
+            while len(got) < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert got == [b"m0"]
+
+            # hard-kill the subscriber: socket torn down, no DISCONNECT
+            sub._sock.shutdown(__import__("socket").SHUT_RDWR)
+            time.sleep(0.3)
+            # published into the void: session queues them
+            for i in range(1, 6):
+                tx.publish("k/t", f"m{i}".encode(), qos=1)
+            assert tx.drain(5) == 0  # broker acked the publisher
+
+            # same client id, persistent session -> queued messages land
+            sub2 = MqttClient(
+                broker.host, broker.port, client_id="persist-sub",
+                clean_session=False,
+            )
+            sub2.subscribe("k/t", lambda t, p: got.append(p), qos=1)
+            want = {f"m{i}".encode() for i in range(6)}
+            deadline = time.time() + 10
+            while not want.issubset(set(got)) and time.time() < deadline:
+                time.sleep(0.05)
+            assert want.issubset(set(got)), f"lost: {want - set(got)}"
+            # post-reconnect stream continues
+            tx.publish("k/t", b"m6", qos=1)
+            deadline = time.time() + 5
+            while b"m6" not in got and time.time() < deadline:
+                time.sleep(0.02)
+            assert b"m6" in got
+            tx.close(); sub.close(); sub2.close()
+        finally:
+            broker.close()
+
+
 class TestBrokerRestart:
     def test_reconnect_resubscribe_and_redeliver(self):
         """Kill the broker mid-stream; the client reconnects, re-subscribes,
