@@ -205,6 +205,12 @@ class MiniBroker:
     def close(self) -> None:
         self._stop.set()
         try:
+            # shutdown wakes a thread blocked in accept() (plain close of
+            # a listening fd can leave it blocked forever on Linux)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
         except OSError:
             pass

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue as _queue
 import struct
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -118,6 +119,11 @@ class MqttSrc(SourceElement):
         "max-msg-buf-size": Property(int, 64, "receive queue depth"),
         "idl": Property(str, "flex", "payload IDL: flex | protobuf | flatbuf (interop)"),
         "reconnect-delay": Property(float, 0.1, "initial reconnect backoff, s"),
+        # subscriber-side QoS (broker grants in SUBACK, deliveries carry
+        # packet ids + DUP retransmit); pair qos=1 with clean-session=false
+        # and a stable client-id for no-loss across subscriber restarts
+        "qos": Property(int, 0, "subscription QoS: 0 | 1 (at-least-once)"),
+        "clean-session": Property(bool, True, "false = persistent session"),
     }
 
     def __init__(self, name=None):
@@ -126,6 +132,7 @@ class MqttSrc(SourceElement):
         self._client: Optional[MqttClient] = None
         self._q: "_queue.Queue[bytes]" = _queue.Queue(64)
         self._base_epoch = 0.0
+        self._stopping = threading.Event()
 
     def output_spec(self) -> StreamSpec:
         return ANY
@@ -133,17 +140,23 @@ class MqttSrc(SourceElement):
     def start(self) -> None:
         if not self.props["sub-topic"]:
             raise ElementError(f"{self.name}: sub-topic is required")
+        self._stopping = threading.Event()  # fresh per run (restartable)
         _, self._decode_payload = wire.get_codec(self.props["idl"])
         self._q = _queue.Queue(self.props["max-msg-buf-size"])
         self._client = MqttClient(
             self.props["host"], self.props["port"],
             client_id=self.props["client-id"],
             reconnect_delay_s=self.props["reconnect-delay"],
+            clean_session=self.props["clean-session"],
         )
         self._base_epoch = time.time()
-        self._client.subscribe(self.props["sub-topic"], self._on_message)
+        self._client.subscribe(
+            self.props["sub-topic"], self._on_message,
+            qos=min(1, max(0, self.props["qos"])),
+        )
 
     def stop(self) -> None:
+        self._stopping.set()  # wakes frames() out of its queue wait
         if self._client is not None:
             self._client.close()
             self._client = None
@@ -159,11 +172,21 @@ class MqttSrc(SourceElement):
         timeout_s = self.props["sub-timeout"] / 1000.0
         n = 0
         while limit < 0 or n < limit:
-            try:
-                payload = self._q.get(timeout=timeout_s)
-            except _queue.Empty:
-                self.log.info("sub-timeout reached; ending stream")
-                return
+            # bounded wait slices so stop() ends the stream immediately
+            # instead of holding the worker for the full sub-timeout
+            deadline = time.monotonic() + timeout_s
+            payload = None
+            while payload is None:
+                if self._stopping.is_set():
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.log.info("sub-timeout reached; ending stream")
+                    return
+                try:
+                    payload = self._q.get(timeout=min(0.25, remaining))
+                except _queue.Empty:
+                    continue
             if len(payload) < _HDR.size:
                 self.log.warning("short MQTT message dropped")
                 continue

@@ -1,0 +1,261 @@
+"""Chaos/soak: sustained streaming through broker death, gRPC peer death,
+and live model hot-reload.
+
+The reference inherits this robustness from GStreamer's maturity (its
+elements survive peer restarts because paho/gRPC reconnect underneath);
+here the framework must prove the same end-to-end: frames keep flowing
+across every injected failure, outputs switch cleanly on reload, both
+pipelines reach EOS, the publisher ends with zero unacked QoS-1 messages,
+and no worker threads or native buffers leak.
+
+Failure injections (one continuous run each):
+  * MQTT broker kill + rebind on the same port mid-stream
+    (≙ gst/mqtt reconnect contract)
+  * model hot-reload while frames are in flight
+    (≙ tensor_filter RELOAD_MODEL, tests/nnstreamer_filter_reload)
+  * gRPC server pipeline kill + restart mid-stream
+    (≙ grpc element reconnect, nnstreamer_grpc_common.cc)
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.jax_xla import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.distributed.mqtt import MiniBroker
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+def _alive_threads():
+    return {t.ident for t in threading.enumerate() if t.is_alive()}
+
+
+def _restart_broker(port, timeout=8.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return MiniBroker(port=port, retransmit_s=0.2)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+@pytest.fixture
+def chaos_models():
+    def scale(w):
+        def fn(params, xs):
+            return [xs[0] * params["w"]]
+
+        return fn
+
+    register_jax_model("chaos_m1", scale(2.0), {"w": np.float32(2.0)})
+    register_jax_model("chaos_m2", scale(3.0), {"w": np.float32(3.0)})
+    yield
+    unregister_jax_model("chaos_m1")
+    unregister_jax_model("chaos_m2")
+
+
+class TestChaosSoak:
+    def test_stream_survives_broker_death_and_model_reload(
+        self, chaos_models
+    ):
+        """One continuous load: push frames at a steady rate while the
+        broker is killed+rebound and the model is hot-reloaded; assert
+        per-frame continuity (every pushed index arrives, correct value
+        for whichever model weight was live) and clean shutdown."""
+        from nnstreamer_tpu.core.buffer import CustomEvent
+
+        baseline_threads = _alive_threads()
+        b1 = MiniBroker(retransmit_s=0.2)
+        port = b1.port
+
+        rx = parse_pipeline(
+            f"mqttsrc host=127.0.0.1 port={port} sub-topic=chaos/t "
+            "client-id=chaos-rx clean-session=false qos=1 "
+            "sub-timeout=20000 ! tensor_sink name=out"
+        )
+        rx.start()
+        tx = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=jax-xla model=chaos_m1 "
+            "is-updatable=true ! "
+            f"mqttsink name=snk host=127.0.0.1 port={port} "
+            "pub-topic=chaos/t qos=1 client-id=chaos-tx"
+        )
+        tx.start()
+        time.sleep(0.3)  # subscription lands
+
+        n_total = 60
+        reload_at = 40  # model switch point (weight 2.0 -> 3.0)
+        broker = b1
+        try:
+            for i in range(n_total):
+                if i == 20:
+                    broker.close()  # chaos: broker dies under load
+                if i == 28:
+                    broker = _restart_broker(port)
+                if i == reload_at:
+                    # chaos: live weight swap while frames are in flight;
+                    # barrier first so in-flight frames finish under m1
+                    # and the value contract below stays exact
+                    deadline = time.time() + 10
+                    while (len(rx["out"].frames) < reload_at
+                           and time.time() < deadline):
+                        time.sleep(0.05)
+                    tx["src"].push_event(
+                        CustomEvent("reload-model", {"model": "chaos_m2"})
+                    )
+                    time.sleep(0.2)
+                tx["src"].push(np.full((4,), float(i), np.float32),
+                               pts=float(i))
+                time.sleep(0.02)  # ~50 fps sustained
+
+            tx["src"].end_of_stream()
+            tx.wait(timeout=30)
+            # publisher must end clean: all QoS-1 publishes acknowledged
+            assert tx["snk"]._client is None or tx["snk"]._client.unacked() == 0
+            tx.stop()
+
+            deadline = time.time() + 20
+            while (len(rx["out"].frames) < n_total
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            frames = list(rx["out"].frames)
+            rx.stop()
+        finally:
+            broker.close()
+
+        # continuity: every frame index arrived at least once (QoS 1 =
+        # at-least-once; duplicates legal, loss not), each with the value
+        # of the model that was live when it was pushed
+        by_idx = {}
+        for f in frames:
+            arr = np.asarray(f.tensors[0])
+            idx = int(round(f.pts)) if f.pts is not None else -1
+            by_idx.setdefault(idx, arr)
+        missing = [i for i in range(n_total) if i not in by_idx]
+        assert not missing, f"lost frames: {missing}"
+        for i, arr in by_idx.items():
+            w = 2.0 if i < reload_at else 3.0
+            np.testing.assert_allclose(arr, np.full((4,), i * w), rtol=1e-5)
+
+        # no leaked workers: thread population returns to baseline
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            leaked = [
+                t for t in threading.enumerate()
+                if t.is_alive() and t.ident not in baseline_threads
+            ]
+            if not leaked:
+                break
+            time.sleep(0.2)
+        assert not leaked, f"leaked: {[(t.name, t.daemon) for t in leaked]}"
+
+    def test_stream_survives_grpc_server_restart_under_load(self):
+        """gRPC leg: client-side sink streams into a server-side src
+        pipeline; the server pipeline is killed and a fresh one bound on
+        the same port mid-stream.  The client reconnects and the stream
+        completes; both servers' frames decode cleanly."""
+        rx1 = parse_pipeline(
+            "tensor_src_grpc name=src server=true port=0 num-buffers=-1 "
+            "timeout=4000 ! tensor_sink name=out"
+        )
+        rx1.start()
+        port = rx1["src"].bound_port
+
+        tx = parse_pipeline(
+            f"appsrc name=a ! tensor_sink_grpc server=false port={port} "
+            "retry-timeout=15"
+        )
+        tx.start()
+        got = []
+        n_total, kill_at = 40, 15
+        rx2 = None
+        try:
+            for i in range(n_total):
+                if i == kill_at:
+                    # wait for phase-1 delivery, then kill the server
+                    deadline = time.time() + 10
+                    while (len(rx1["out"].frames) < kill_at
+                           and time.time() < deadline):
+                        time.sleep(0.05)
+                    got.extend(rx1["out"].frames)
+                    rx1.stop()
+                    deadline = time.time() + 8
+                    while time.time() < deadline:
+                        try:
+                            rx2 = parse_pipeline(
+                                f"tensor_src_grpc name=src server=true "
+                                f"port={port} num-buffers=-1 timeout=4000 "
+                                "! tensor_sink name=out"
+                            )
+                            rx2.start()
+                            break
+                        except Exception:
+                            time.sleep(0.2)
+                    assert rx2 is not None
+                    time.sleep(0.3)  # client notices + reconnects
+                tx["a"].push(np.full((3,), float(i), np.float32))
+                time.sleep(0.02)
+            deadline = time.time() + 15
+            while (len(rx2["out"].frames) < 5  # post-restart flow resumed
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            tx["a"].end_of_stream()
+            tx.wait(timeout=15)
+            tx.stop()
+            rx2.wait(timeout=10)  # idle timeout EOS
+            got.extend(rx2["out"].frames)
+            rx2.stop()
+        finally:
+            for p in (rx1, rx2):
+                try:
+                    if p is not None:
+                        p.stop()
+                except Exception:
+                    pass
+
+        # frames from before the kill and after the restart all decoded;
+        # the mid-kill window may drop (gRPC has no at-least-once layer —
+        # that's the MQTT leg's job) but the stream must RESUME
+        vals = sorted({int(np.asarray(f.tensors[0])[0]) for f in got})
+        assert vals[:kill_at] == list(range(kill_at)), "pre-kill loss"
+        assert any(v >= kill_at + 5 for v in vals), "stream never resumed"
+
+    def test_native_pool_balance_under_churn(self):
+        """The native allocator stays balanced through a realistic
+        acquire/release storm with concurrent churn (the leak probe the
+        soak story needs: outstanding() must return to zero)."""
+        rt = pytest.importorskip("nnstreamer_tpu.native.runtime")
+        if not rt.available():
+            pytest.skip("native core not built")
+        pool = rt.BufferPool(block_size=4096, prealloc=8)
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            try:
+                while not stop.is_set():
+                    grabbed = [pool.acquire() for _ in range(16)]
+                    for ptr, mv in grabbed:
+                        mv[:8] = b"chaosrun"
+                        pool.release(ptr)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+        assert pool.outstanding == 0  # every block returned
+        pool.destroy()
