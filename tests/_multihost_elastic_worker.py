@@ -1,0 +1,129 @@
+"""Worker for test_multihost_elastic.py: a 4-process gang on a 2-D DCN
+hybrid mesh (dcn dp×sp across processes, ici tp within a host).
+
+Phase A trains 2 steps, checkpoints, prints its local-shard fingerprint,
+then the designated victim process dies WITHOUT cleanup (os._exit) while
+the others walk into the next collective — the gang-scheduled failure
+mode (multihost.py: "a lost process fails the job").
+
+Phase B is the rejoined gang: fresh processes, same checkpoint dir —
+restore, verify bit-identical shards, and continue training.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.parallel import multihost  # noqa: E402
+
+
+def shard_fingerprint(tree) -> str:
+    """sha1 over this process's addressable shards (device-ordered) of
+    every leaf — bit-identity probe for checkpoint restore."""
+    import jax
+
+    h = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            h.update(np.asarray(leaf).tobytes())
+            continue
+        for shard in sorted(leaf.addressable_shards,
+                            key=lambda s: s.device.id):
+            h.update(np.ascontiguousarray(np.asarray(shard.data)).tobytes())
+    return h.hexdigest()
+
+
+def main() -> None:
+    phase = os.environ["NNS_ELASTIC_PHASE"]
+    ckpt = os.environ["NNS_ELASTIC_CKPT"]
+    kill_pid = int(os.environ.get("NNS_ELASTIC_KILL_PID", "-1"))
+
+    multihost.initialize(platform="cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.core.checkpoint import restore_state, save_state
+    from nnstreamer_tpu.models.transformer import (
+        TransformerConfig,
+        make_train_step,
+    )
+
+    pid = multihost.process_index()
+    # 2-D DCN: dp AND sp cross processes (4 procs), tp rides "ICI"
+    # (the 2 local devices) — the hybrid shape VERDICT item 9 asks for
+    mesh = multihost.hybrid_mesh({"tp": -1}, {"dp": 2, "sp": 2})
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq=16, dtype=jnp.float32,
+    )
+    step, params, opt_state, data_sh = make_train_step(mesh, cfg)
+
+    batch = 8  # dp=2 × sp=2 × tp local — divisible everywhere
+    rng = np.random.default_rng(7)  # same stream on every process
+    batches = [
+        rng.integers(0, cfg.vocab, (batch, cfg.max_seq)).astype(np.int32)
+        for _ in range(3)
+    ]
+
+    def put(arr):
+        return jax.device_put(arr, data_sh)
+
+    if phase == "A":
+        losses = []
+        for t in batches[:2]:
+            params, opt_state, loss = step(params, opt_state, put(t))
+            losses.append(float(loss))
+        save_state(ckpt, 2, {"params": params, "opt_state": opt_state})
+        print("RESULT " + json.dumps({
+            "pid": pid,
+            "phase": "A",
+            "losses": losses,
+            "fingerprint": shard_fingerprint(params),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        }), flush=True)
+        multihost.barrier("phase_a_checkpointed")
+        if pid == kill_pid:
+            os._exit(1)  # hard death: no shutdown, no goodbye
+        # survivors walk into the next collective against a dead peer;
+        # the gang is now failed (hang or error — parent cleans up)
+        step(params, opt_state, put(batches[2]))
+        print("UNREACHABLE post-kill step completed", flush=True)
+    else:
+        # one throwaway step first: jit outputs carry fully-committed mesh
+        # shardings (tx.init leaves are uncommitted, and a restore onto an
+        # uncommitted scalar pins it to one device — incompatible with the
+        # mesh-wide params in the next jitted call)
+        t_params, t_opt, _ = step(params, opt_state, put(batches[0]))
+        templates = {"params": t_params, "opt_state": t_opt}
+        restored = restore_state(ckpt, 2, templates)
+        # re-commit every leaf onto the template's mesh sharding (orbax
+        # may restore replicated/single-device; the jitted step expects
+        # the original placement)
+        restored = jax.tree.map(
+            lambda got, tmpl: (
+                jax.device_put(got, tmpl.sharding)
+                if hasattr(tmpl, "sharding") else got
+            ),
+            restored, templates,
+        )
+        params, opt_state = restored["params"], restored["opt_state"]
+        fp = shard_fingerprint(params)
+        params, opt_state, loss3 = step(params, opt_state, put(batches[2]))
+        multihost.barrier("phase_b_resumed")
+        print("RESULT " + json.dumps({
+            "pid": pid,
+            "phase": "B",
+            "fingerprint": fp,
+            "loss3": float(loss3),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
