@@ -167,7 +167,6 @@ class JaxXla(FilterBackend):
 
         from ..core.compile_cache import enable as enable_compile_cache
 
-        enable_compile_cache()
         self._fn, self._params, self._in_spec, self._out_spec = self._resolve_model(
             model_path
         )
@@ -176,6 +175,9 @@ class JaxXla(FilterBackend):
             self._device = jax.devices("cpu")[0]
         else:
             self._device = jax.devices()[0]
+        # cache keyed off the device we will actually compile for (on CPU
+        # the auto-enabled cache only emits AOT feature-mismatch noise)
+        enable_compile_cache(platform=self._device.platform)
         dtype = self.custom_props.get("dtype")
         if dtype in ("bfloat16", "float16", "float32"):
             import jax.numpy as jnp

@@ -61,9 +61,20 @@ def host_fingerprint() -> str:
     return f"{platform.machine()}-{tag}"
 
 
-def enable(cache_dir: Optional[str] = None) -> Optional[str]:
+def enable(cache_dir: Optional[str] = None,
+           platform: Optional[str] = None) -> Optional[str]:
     """Turn on the persistent cache (idempotent); returns the directory
-    in use, or None when disabled by config/error."""
+    in use, or None when disabled by config/error.
+
+    ``platform`` is the caller's actual device platform when known.  With
+    no explicit directory (arg/env/ini), the cache auto-enables only for
+    accelerator platforms: TPU compiles are the 20-40 s ones worth
+    persisting, while XLA:CPU persists AOT machine code whose embedded
+    compile "features" include tuning prefs (+prefer-no-gather, ...) the
+    host feature probe never reports — so every warm-start load logs a
+    spurious cpu_aot_loader feature-mismatch error.  An explicit
+    directory overrides (tests, CPU farms that accept the noise).
+    """
     global _enabled
     with _lock:
         if _enabled is not None and not (cache_dir and not _enabled):
@@ -80,11 +91,17 @@ def enable(cache_dir: Optional[str] = None) -> Optional[str]:
                         "re-point)", _enabled, want,
                     )
             return _enabled or None
-        raw = (
+        explicit = (
             cache_dir
             if cache_dir is not None
-            else nns_config.get_value("xla", "cache_dir", _DEFAULT_DIR)
+            else nns_config.get_value("xla", "cache_dir", None)
         )
+        if explicit is None and platform == "cpu":
+            # auto mode on CPU: skip (see docstring); stays retryable so a
+            # later accelerator-backend open() can still enable it
+            log.debug("persistent cache auto-disabled on cpu platform")
+            return None
+        raw = _DEFAULT_DIR if explicit is None else explicit
         if not raw:
             _enabled = ""
             return None

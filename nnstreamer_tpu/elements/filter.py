@@ -447,9 +447,11 @@ class TensorFilter(TransformElement):
             # Downstream (fused decoder / chained filter / sink) splits or
             # materializes at the real host boundary.
             return [(0, BatchFrame.from_frames(out_b, frames))]
-        # one device->host transfer per output tensor (not per frame), then
-        # zero-copy numpy views per frame
-        out_np = [np.asarray(o) for o in out_b]
+        # one overlapped device->host transfer pass for all output tensors
+        # (not per frame), then zero-copy numpy views per frame
+        from ..core.buffer import materialize
+
+        out_np = materialize(out_b)
         results = []
         for b, f in enumerate(frames):
             outs = [o[b] for o in out_np]

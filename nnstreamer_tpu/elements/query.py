@@ -22,7 +22,7 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Deque, Iterator, List, Optional, Tuple
 
-from ..core.buffer import TensorFrame
+from ..core.buffer import CustomEvent, TensorFrame
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
     QueryConnection,
@@ -245,10 +245,33 @@ class TensorQueryClient(Element):
                 )
         raise err  # all attempts failed -> surfaced on the bus
 
+    _DRAIN_EVENT = "_nns_query_drain"
+
+    def _notify_done(self, _fut) -> None:
+        """Future-completion callback (pool thread): wake the worker so a
+        LIVE stream emits answers as they land — without this, responses
+        to the last frames of a burst sit in the in-flight window until
+        the next frame or EOS arrives (latency bug for sparse streams).
+        Best-effort: a full mailbox means the worker is busy and will
+        drain on its next frame anyway."""
+        box = self._mailbox
+        if box is None:
+            return  # stopping
+        try:
+            box.put_nowait((0, CustomEvent(self._DRAIN_EVENT, {})))
+        except _queue.Full:
+            pass
+
+    def handle_event(self, pad, ev):
+        if isinstance(ev, CustomEvent) and ev.name == self._DRAIN_EVENT:
+            return self._drain_ready(block_all=False)  # swallow the tick
+        return super().handle_event(pad, ev)
+
     def handle_frame(self, pad, frame):
         first = self._rr % len(self._conns)
         self._rr += 1
         fut = self._pool.submit(self._invoke_failover, frame, first)
+        fut.add_done_callback(self._notify_done)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window
         # is full, then release whatever is complete (in order)

@@ -71,6 +71,39 @@ def test_enable_warns_on_conflicting_explicit_dir(tmp_path, caplog):
         compile_cache.reset_for_tests()
 
 
+def test_cpu_platform_auto_skips_but_stays_retryable(tmp_path, monkeypatch):
+    # no explicit dir + cpu platform -> no cache (XLA:CPU AOT entries log
+    # feature-mismatch noise on every warm load); a later accelerator
+    # open() in the same process must still be able to enable it
+    from nnstreamer_tpu.core import compile_cache
+    from nnstreamer_tpu.core import config as nns_config
+
+    monkeypatch.delenv("NNS_TPU_XLA_CACHE_DIR", raising=False)
+    # the auto default expands under HOME: point it at tmp_path so the
+    # test neither pollutes ~/.cache nor depends on HOME being writable
+    monkeypatch.setattr(
+        compile_cache, "_DEFAULT_DIR", str(tmp_path / "auto_cache")
+    )
+    nns_config.reset()
+    compile_cache.reset_for_tests()
+    import jax
+
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        assert compile_cache.enable(platform="cpu") is None
+        got = compile_cache.enable(platform="tpu")  # retry succeeds
+        assert got and compile_cache.host_fingerprint() in got
+        assert got.startswith(str(tmp_path))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prior_min
+        )
+        compile_cache.reset_for_tests()
+        nns_config.reset()
+
+
 def test_disable_via_empty_dir(monkeypatch):
     from nnstreamer_tpu.core import compile_cache
 

@@ -69,6 +69,32 @@ class TestQueryRoundTrip:
         finally:
             server.stop()
 
+    def test_live_stream_emits_without_eos(self):
+        """Answers must reach the sink as soon as they land, not when the
+        NEXT frame (or EOS) happens to trigger a drain — a sparse live
+        stream would otherwise stall with responses parked in the
+        in-flight window (regression: burst < max-in-flight)."""
+        import time
+
+        server, port = self.make_server(131)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "max-in-flight=8 ! tensor_sink name=out"
+            )
+            client.start()
+            for i in range(2):  # burst smaller than the in-flight window
+                client["src"].push(np.float32([i]))
+            deadline = time.time() + 10
+            while len(client["out"].frames) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(client["out"].frames) == 2, "live drain never fired"
+            client["src"].end_of_stream()
+            client.wait(timeout=10)
+            client.stop()
+        finally:
+            server.stop()
+
     def test_fanout_two_servers_ordered(self):
         s1, p1 = self.make_server(111)
         s2, p2 = self.make_server(112)

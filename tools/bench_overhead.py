@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Framework-overhead harness: full pipeline fps vs raw jitted-model fps
+on the SAME model/batch (VERDICT r2 item 2: pipeline must be >= 0.9x raw).
+
+Runs on CPU by default with a deliberately tiny model so per-frame
+framework cost dominates — the dispatch-bound regime where the 772-vs-
+1090 fps gap on the chip lives.  BENCH_OVERHEAD_MODEL=mobilenet measures
+the compute-bound regime instead.
+
+Prints per-stage tracer rows plus one JSON line:
+  {"pipeline_fps", "raw_fps", "ratio", ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("BENCH_OVERHEAD_PLATFORM", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from nnstreamer_tpu.backends.jax_xla import register_jax_model
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    which = os.environ.get("BENCH_OVERHEAD_MODEL", "tiny")
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    n_frames = int(os.environ.get("BENCH_FRAMES", "8192"))
+
+    if which == "tiny":
+        classes = 1001
+
+        def fn(params, xs):
+            # one small matmul: enough to be a real XLA program, cheap
+            # enough that dispatch/framework cost dominates
+            import jax.numpy as jnp
+
+            return [xs[0].astype(jnp.float32) @ params["w"]]
+
+        params = {
+            "w": np.random.default_rng(0)
+            .normal(0, 0.02, (64, classes))
+            .astype(np.float32)
+        }
+        register_jax_model("ovh_model", fn, params)
+        frame_shape, frame_dtype = (64,), np.float32
+    else:
+        from nnstreamer_tpu.models import build
+
+        fn, params, in_spec, out_spec = build(
+            "mobilenet_v2", {"dtype": "float32"}
+        )
+        register_jax_model("ovh_model", fn, params, in_spec, out_spec)
+        frame_shape, frame_dtype = (224, 224, 3), np.float32
+
+    labels = "/tmp/ovh_labels.txt"
+    with open(labels, "w") as f:
+        f.write("\n".join(f"c{i}" for i in range(1001)))
+
+    rng = np.random.default_rng(1)
+    pool = [
+        rng.normal(0, 1, frame_shape).astype(frame_dtype) for _ in range(16)
+    ]
+    pool_dev = [jax.device_put(p) for p in pool]
+    jax.block_until_ready(pool_dev)
+
+    # -- raw ceiling: same batched invoke the filter makes, no pipeline
+    # (same helper bench.py BENCH_RAW uses, so the two ratios agree) --
+    from bench import measure_raw_fps
+
+    raw_fps = measure_raw_fps(fn, params, pool, batch, n_frames)
+
+    # -- full pipeline on the same model -------------------------------
+    pipe = parse_pipeline(
+        "appsrc name=src max-buffers=512 ! "
+        "tensor_filter name=f framework=jax-xla model=ovh_model "
+        f"max-batch={batch} batch-timeout=20 ! "
+        f"tensor_decoder mode=image_labeling option1={labels} ! "
+        "tensor_sink name=out max-stored=1",
+        name="overhead",
+    )
+    if os.environ.get("BENCH_TRACE", "1") == "1":
+        pipe.enable_tracing()
+    pipe.start()
+    src, sink = pipe["src"], pipe["out"]
+    done = {"n": 0}
+    sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+    for i in range(batch * 2):  # warmup compiles
+        src.push(pool_dev[i % len(pool)])
+    t_wait = time.time()
+    while done["n"] < batch * 2 and time.time() - t_wait < 120:
+        time.sleep(0.01)
+    assert done["n"] >= batch * 2, "warmup incomplete"
+    time.sleep(0.3)
+
+    done["n"] = 0
+    t0 = time.perf_counter()
+    for i in range(n_frames):
+        src.push(pool_dev[i % len(pool)])
+    while done["n"] < n_frames and time.perf_counter() - t0 < 300:
+        time.sleep(0.005)
+    pipe_fps = done["n"] / (time.perf_counter() - t0)
+
+    if pipe.tracer is not None:
+        for line in pipe.tracer.summary_lines():
+            print(line, file=sys.stderr)
+    src.end_of_stream()
+    pipe.wait(timeout=30)
+    pipe.stop()
+
+    print(json.dumps({
+        "metric": "pipeline_vs_raw_ratio",
+        "model": which,
+        "batch": batch,
+        "pipeline_fps": round(pipe_fps, 1),
+        "raw_fps": round(raw_fps, 1),
+        "ratio": round(pipe_fps / raw_fps, 3),
+        "platform": "cpu" if os.environ.get(
+            "BENCH_OVERHEAD_PLATFORM", "cpu") == "cpu" else "accel",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
