@@ -1,0 +1,185 @@
+"""Raw-TCP edge transport: the dependency-free data channel.
+
+Reference: nnstreamer-edge's plain-TCP connect type
+(``gst/edge/edge_common.c:23-35`` lists TCP / HYBRID / MQTT / AITT; the
+TCP transport itself lives in nnstreamer-edge's socket layer).  The gRPC
+edge broker (``distributed/service.py``) is the feature-rich default;
+this module is the minimal-footprint alternative for peers that speak
+only sockets — embedded subscribers, containers without grpc.
+
+Protocol (all little-endian, layered on the NNSQ wire framing):
+  subscribe:  client -> server   u32 topic_len | topic utf8
+  stream:     server -> client   per frame: u32 payload_len | payload
+payload = ``distributed/wire.py`` NNSQ bytes (or any codec the caller
+pairs); topic matching is exact (no wildcards — parity with edge topics,
+which are opaque strings, not MQTT filters).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Iterator, List, Optional
+
+from ..core.log import get_logger
+
+log = get_logger("tcp_edge")
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 30  # 1 GiB sanity bound on a length prefix
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tcp edge peer closed")
+        buf += chunk
+    return buf
+
+
+class TcpEdgeServer:
+    """Publisher-side endpoint: subscribers dial in, name a topic, and
+    receive every frame published to it until they hang up."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._lock = threading.Lock()
+        # topic -> list of (sock, per-sock write lock)
+        self._subs: Dict[str, List[tuple]] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="tcp-edge-server", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._register, args=(sock,), daemon=True
+            ).start()
+
+    def _register(self, sock: socket.socket) -> None:
+        try:
+            (tlen,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+            if tlen > 4096:
+                raise ConnectionError("absurd topic length")
+            topic = _read_exact(sock, tlen).decode()
+            # bound sends so one wedged subscriber cannot stall publish
+            # fan-out for the healthy ones (see MiniBroker._send)
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", 5, 0),
+            )
+        except (ConnectionError, OSError, UnicodeDecodeError) as e:
+            log.warning("tcp edge: dropping bad subscriber: %s", e)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self._subs.setdefault(topic, []).append(
+                (sock, threading.Lock())
+            )
+        log.info("tcp edge: subscriber attached to topic %r", topic)
+
+    def publish(self, topic: str, payload: bytes) -> int:
+        """Send to every live subscriber of `topic`; returns how many
+        received it (dead/wedged ones are dropped on the way)."""
+        header = _LEN.pack(len(payload))
+        with self._lock:
+            targets = list(self._subs.get(topic, ()))
+        delivered, dead = 0, []
+        for sock, wlock in targets:
+            try:
+                with wlock:
+                    sock.sendall(header + payload)
+                delivered += 1
+            except (socket.timeout, OSError):
+                dead.append((sock, wlock))
+        if dead:
+            with self._lock:
+                subs = self._subs.get(topic, [])
+                for entry in dead:
+                    if entry in subs:
+                        subs.remove(entry)
+                    try:
+                        entry[0].close()
+                    except OSError:
+                        pass
+        return delivered
+
+    def subscriber_count(self, topic: str) -> int:
+        with self._lock:
+            return len(self._subs.get(topic, ()))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)  # wake accept()
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = [s for subs in self._subs.values() for s, _ in subs]
+            self._subs.clear()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TcpEdgeSubscriber:
+    """Subscriber-side endpoint: dial, name the topic, iterate payloads."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        t = topic.encode()
+        self._sock.sendall(_LEN.pack(len(t)) + t)
+        self._sock.settimeout(None)
+        self._closed = False
+
+    def payloads(self, idle_timeout: Optional[float] = None
+                 ) -> Iterator[bytes]:
+        """Yield raw frame payloads until the publisher hangs up (or
+        `idle_timeout` seconds pass without one)."""
+        self._sock.settimeout(idle_timeout)
+        while not self._closed:
+            try:
+                (plen,) = _LEN.unpack(_read_exact(self._sock, _LEN.size))
+                if plen > _MAX_FRAME:
+                    raise ConnectionError("absurd frame length")
+                yield _read_exact(self._sock, plen)
+            except (ConnectionError, OSError):
+                return
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -15,7 +15,10 @@ throughput, ``CHANGES:8-13``): the sink hosts its data broker and
 announces ``{host, port}`` as a RETAINED MQTT message on
 ``nns/edge/<topic>``; sources discover the endpoint from the MQTT broker
 and attach to the gRPC data plane directly — bulk tensors never transit
-MQTT.  AITT (Samsung-internal transport) is out of scope.
+MQTT.  ``connect-type=tcp`` is the raw-socket data channel
+(``distributed/tcp_edge.py`` — length-prefixed NNSQ frames, no gRPC
+dependency), matching the reference's plain-TCP connect type.  AITT
+(Samsung-internal transport) is out of scope.
 
 Timestamp rebasing: the publisher embeds ``wall_base`` (epoch seconds at
 pts=0) in frame meta; subscribers rebase pts into their local clock
@@ -29,6 +32,7 @@ import time
 from typing import Iterator, Optional
 
 from ..core.buffer import TensorFrame
+from ..core.log import get_logger
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
     EdgePublisher,
@@ -51,8 +55,9 @@ class EdgeSink(SinkElement):
         "dest-port": Property(int, 0, "remote broker port (client: data; hybrid: MQTT)"),
         "topic": Property(str, "nns", "pub/sub topic"),
         "connect-type": Property(
-            str, "server", "server (host broker) | client | hybrid "
-            "(announce over MQTT, data over gRPC)"
+            str, "server", "server (host gRPC broker) | client | hybrid "
+            "(announce over MQTT, data over gRPC) | tcp (host a raw-TCP "
+            "data channel — no gRPC dependency, ≙ reference edge TCP)"
         ),
         "host": Property(str, "127.0.0.1", "hybrid: address announced to subscribers"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
@@ -64,6 +69,7 @@ class EdgeSink(SinkElement):
         self._pub: Optional[EdgePublisher] = None
         self._wall_base: Optional[float] = None
         self._mqtt = None
+        self._tcp = None
 
     def start(self):
         mode = self.props["connect-type"]
@@ -71,6 +77,12 @@ class EdgeSink(SinkElement):
             self._pub = EdgePublisher(
                 self.props["dest-host"], self.props["dest-port"], self.props["topic"]
             )
+            return
+        if mode == "tcp":
+            from ..distributed.tcp_edge import TcpEdgeServer
+
+            self._tcp = TcpEdgeServer(port=self.props["port"])
+            self.props["port"] = self._tcp.port
             return
         self._broker = get_edge_broker(self.props["port"])
         self._broker.start()
@@ -117,6 +129,9 @@ class EdgeSink(SinkElement):
                 pass
             self._mqtt.close()
             self._mqtt = None
+        if self._tcp is not None:
+            self._tcp.close()
+            self._tcp = None
         if self._broker is not None:
             release_edge_broker(self._broker.port)
             self._broker = None
@@ -127,10 +142,34 @@ class EdgeSink(SinkElement):
         frame.meta["wall_base"] = self._wall_base  # cross-device sync anchor
         if self._pub is not None:
             self._pub.publish(frame)
-        else:
-            from ..distributed.wire import encode_frame
+            return
+        from ..distributed.wire import encode_frame
 
+        if self._tcp is not None:
+            self._tcp.publish(self.props["topic"], encode_frame(frame))
+        else:
             self._broker.publish_local(self.props["topic"], encode_frame(frame))
+
+
+class _TcpFrameSubscriber:
+    """Adapts TcpEdgeSubscriber (raw payloads) to the EdgeSubscriber
+    surface edgesrc consumes (frames() iterator + close())."""
+
+    def __init__(self, sub):
+        self._sub = sub
+
+    def frames(self):
+        from ..distributed.wire import WireError, decode_frame
+
+        for payload in self._sub.payloads():
+            try:
+                yield decode_frame(payload)
+            except WireError as e:
+                log = get_logger("edgesrc")
+                log.warning("undecodable tcp edge frame dropped: %s", e)
+
+    def close(self):
+        self._sub.close()
 
 
 @element("edgesrc")
@@ -141,8 +180,9 @@ class EdgeSrc(SourceElement):
         "topic": Property(str, "nns", "pub/sub topic"),
         "caps": Property(str, "", "announced schema"),
         "connect-type": Property(
-            str, "direct", "direct (dial the data broker) | hybrid "
-            "(discover the data endpoint over MQTT)"
+            str, "direct", "direct (dial the gRPC data broker) | hybrid "
+            "(discover the data endpoint over MQTT) | tcp (dial a raw-TCP "
+            "edgesink)"
         ),
         "discovery-timeout": Property(float, 10.0, "hybrid: seconds to wait for the announce"),
         "rebase-pts": Property(bool, True, "rebase pts into the local clock"),
@@ -182,6 +222,14 @@ class EdgeSrc(SourceElement):
         return info["host"], int(info["port"])
 
     def start(self):
+        if self.props["connect-type"] == "tcp":
+            from ..distributed.tcp_edge import TcpEdgeSubscriber
+
+            self._sub = _TcpFrameSubscriber(TcpEdgeSubscriber(
+                self.props["dest-host"], self.props["dest-port"],
+                self.props["topic"],
+            ))
+            return
         if self.props["connect-type"] == "hybrid":
             host, port = self._discover()
         else:

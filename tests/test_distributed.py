@@ -196,6 +196,115 @@ class TestEdgePubSub:
             sink_pipe.stop()
 
 
+class TestEdgeTcp:
+    """Raw-TCP connect type (≙ reference edge_common.c TCP): a plain
+    socket data channel with no gRPC dependency."""
+
+    def test_tcp_publish_subscribe(self):
+        tx = parse_pipeline(
+            "appsrc name=src ! edgesink name=es connect-type=tcp port=0 "
+            "topic=tv"
+        )
+        tx.start()
+        port = tx["es"].props["port"]
+        try:
+            rx = parse_pipeline(
+                f"edgesrc connect-type=tcp dest-port={port} topic=tv "
+                "rebase-pts=false ! tensor_sink name=out"
+            )
+            rx.start()
+            deadline = time.time() + 5
+            while (tx["es"]._tcp.subscriber_count("tv") < 1
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            for i in range(3):
+                tx["src"].push(np.int32([i]), pts=i * 0.1)
+            deadline = time.time() + 10
+            while len(rx["out"].frames) < 3 and time.time() < deadline:
+                time.sleep(0.05)
+            vals = [int(f.tensors[0][0]) for f in rx["out"].frames]
+            assert vals == [0, 1, 2]
+            rx.stop()
+        finally:
+            tx["src"].end_of_stream()
+            tx.wait(timeout=10)
+            tx.stop()
+
+    def test_sockets_only_external_subscriber(self):
+        """A peer with ONLY the socket module + the public framing (u32
+        topic prefix in, u32 length-prefixed NNSQ frames out) reads the
+        stream — the no-dependency interop contract of the TCP type."""
+        import socket
+        import struct
+
+        from nnstreamer_tpu.distributed.wire import decode_frame
+
+        tx = parse_pipeline(
+            "appsrc name=src ! edgesink name=es connect-type=tcp port=0 "
+            "topic=raw"
+        )
+        tx.start()
+        port = tx["es"].props["port"]
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.sendall(struct.pack("<I", 3) + b"raw")
+            deadline = time.time() + 5
+            while (tx["es"]._tcp.subscriber_count("raw") < 1
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            tx["src"].push(np.float32([1.5, 2.5]))
+
+            def read_exact(n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = s.recv(n - len(buf))
+                    assert chunk, "publisher hung up"
+                    buf += chunk
+                return buf
+
+            s.settimeout(10)
+            (plen,) = struct.unpack("<I", read_exact(4))
+            frame = decode_frame(read_exact(plen))
+            np.testing.assert_allclose(
+                np.asarray(frame.tensors[0]), [1.5, 2.5])
+            s.close()
+        finally:
+            tx["src"].end_of_stream()
+            tx.wait(timeout=10)
+            tx.stop()
+
+    def test_dead_subscriber_dropped_not_fatal(self):
+        from nnstreamer_tpu.distributed.tcp_edge import (
+            TcpEdgeServer,
+            TcpEdgeSubscriber,
+        )
+
+        srv = TcpEdgeServer()
+        try:
+            sub = TcpEdgeSubscriber("127.0.0.1", srv.port, "t")
+            deadline = time.time() + 5
+            while srv.subscriber_count("t") < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert srv.publish("t", b"x" * 64) == 1
+            sub.close()
+            time.sleep(0.1)
+            # dead peer: delivery count drops to 0, server stays up
+            for _ in range(3):
+                srv.publish("t", b"y" * 64)
+            assert srv.subscriber_count("t") == 0
+            # and a new subscriber still works
+            sub2 = TcpEdgeSubscriber("127.0.0.1", srv.port, "t")
+            deadline = time.time() + 5
+            while srv.subscriber_count("t") < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert srv.publish("t", b"z") == 1
+            it = sub2.payloads(idle_timeout=5)
+            assert next(it) == b"z"
+            sub2.close()
+        finally:
+            srv.close()
+
+
 class TestEdgeHybrid:
     """MQTT-hybrid connect type: discovery over MQTT, data over gRPC
     (reference CHANGES:8-13 — control/data channel split for throughput)."""
