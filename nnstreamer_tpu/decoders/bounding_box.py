@@ -352,7 +352,10 @@ class BoundingBoxes:
         import jax
         import jax.numpy as jnp
 
-        loc = jnp.reshape(outs[0], (outs[0].shape[0], -1, 4)).astype(jnp.float32)
+        loc = outs[0]
+        if loc.ndim == 2:  # single-frame invoke path: (P, 4), no batch
+            loc = loc[None]
+        loc = jnp.reshape(loc, (loc.shape[0], -1, 4)).astype(jnp.float32)
         pri = jnp.asarray(self._priors, jnp.float32)  # [P,4] = yc, xc, h, w
         scores = jnp.reshape(
             outs[1], (loc.shape[0], loc.shape[1], -1)

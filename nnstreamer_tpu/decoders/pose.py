@@ -86,6 +86,11 @@ class PoseEstimation:
             y_in = gy / max(1, gh - 1) * self.in_wh[1] + off[gy, gx, np.arange(k)]
             x_in = gx / max(1, gw - 1) * self.in_wh[0] + off[gy, gx, np.arange(k) + k]
 
+        return self._render(frame, x_in, y_in, score)
+
+    def _render(self, frame: TensorFrame, x_in, y_in, score) -> TensorFrame:
+        """Keypoints in model-input px -> RGBA overlay + keypoints meta."""
+        k = len(score)
         sx = self.out_wh[0] / max(1, self.in_wh[0])
         sy = self.out_wh[1] / max(1, self.in_wh[1])
         x_out, y_out = x_in * sx, y_in * sy
@@ -109,3 +114,52 @@ class PoseEstimation:
         if self.labels:
             out.meta["keypoint_labels"] = self.labels[:k]
         return out
+
+    # -- device-fused half (pipeline fusion pass) ---------------------------
+    def supports_device_fn(self) -> bool:
+        return True  # both heatmap modes are static-shape traceable
+
+    def device_fn(self, outs, platform=None):
+        """jit-traceable half, folded into the upstream filter's XLA
+        program: per-keypoint argmax + offset gather on device, so one
+        (B, K, 3) [x_in, y_in, score] tensor — ~200 bytes/frame — crosses
+        the link instead of the full heatmap/offset stack (PoseNet 257:
+        ~4.5 MB/frame).  Mirrors ``decode`` (tensordec-pose.c math)."""
+        import jax
+        import jax.numpy as jnp
+
+        heat = outs[0].astype(jnp.float32)
+        if heat.ndim == 3:  # single-frame invoke path: no batch axis
+            heat = heat[None]
+        heat = jnp.reshape(heat, (heat.shape[0],) + tuple(heat.shape[-3:]))
+        B, gh, gw, k = heat.shape
+        flat = jnp.reshape(heat, (B, gh * gw, k))
+        best = jnp.argmax(flat, axis=1)                      # (B, K)
+        score = jax.nn.sigmoid(jnp.max(flat, axis=1))        # (B, K)
+        gy, gx = best // gw, best % gw
+        x_in = (gx + 0.5) / gw * self.in_wh[0]
+        y_in = (gy + 0.5) / gh * self.in_wh[1]
+        if self.mode == "heatmap-offset" and len(outs) > 1:
+            off = outs[1].astype(jnp.float32)
+            if off.ndim == 3:
+                off = off[None]
+            off = jnp.reshape(off, (B, gh * gw, 2 * k))
+            # per keypoint i: off[b, best[b,i], i] (y) / [.., i+k] (x)
+            at_best = jnp.take_along_axis(
+                off, best[:, :, None], axis=1)               # (B, K, 2K)
+            ks = jnp.arange(k)[None, :, None]
+            off_y = jnp.take_along_axis(at_best, ks, axis=2)[..., 0]
+            off_x = jnp.take_along_axis(at_best, ks + k, axis=2)[..., 0]
+            y_in = gy / max(1, gh - 1) * self.in_wh[1] + off_y
+            x_in = gx / max(1, gw - 1) * self.in_wh[0] + off_x
+        return [
+            jnp.stack(
+                [x_in.astype(jnp.float32), y_in.astype(jnp.float32), score],
+                axis=-1,
+            )
+        ]  # (B, K, 3)
+
+    def decode_fused(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        """Host finishing after device_fn: tensor is (K, 3) x/y/score."""
+        arr = np.asarray(frame.tensors[0], np.float64).reshape(-1, 3)
+        return self._render(frame, arr[:, 0], arr[:, 1], arr[:, 2])

@@ -144,6 +144,8 @@ class TestBoundingBoxFusion:
         # frame 1: same-class overlap (NMS keeps the higher score) plus a
         #          different-class box at the same spot (per-class NMS
         #          keeps it)
+        # frame 2: odd count -> the last micro-batch is a SINGLE frame,
+        #          exercising the unbatched invoke path through device_fn
         return [
             self._yolo_pred([
                 (0.25, 0.25, 0.2, 0.2, 0.9, 0),
@@ -153,6 +155,9 @@ class TestBoundingBoxFusion:
                 (0.5, 0.5, 0.3, 0.3, 0.9, 2),
                 (0.52, 0.5, 0.3, 0.3, 0.7, 2),   # suppressed (IoU ~0.8)
                 (0.5, 0.5, 0.3, 0.3, 0.85, 1),   # other class: survives
+            ]),
+            self._yolo_pred([
+                (0.4, 0.6, 0.25, 0.2, 0.95, 1),
             ]),
         ]
 
@@ -208,7 +213,7 @@ class TestBoundingBoxFusion:
             unregister_jax_model("fusion_passthru")
         host = self._boxes(h_frames)
         # sanity: the scenario exercises NMS (frame 1 lost its overlap)
-        assert [len(b) for b in host] == [2, 2]
+        assert [len(b) for b in host] == [2, 2, 1]
         assert sorted(b["class"] for b in host[1]) == [1, 2]
         self._assert_same_boxes(self._boxes(f_frames), host)
 
@@ -268,6 +273,71 @@ class TestBoundingBoxFusion:
             assert len(frames[0].meta["boxes"]) == 2
         finally:
             unregister_jax_model("fusion_passthru")
+
+
+class TestPoseFusion:
+    """Device-fused pose decode (≙ tensordec-pose.c): keypoint argmax +
+    offset gather run in the filter's XLA program; only (K,3) keypoints
+    cross the device->host boundary instead of the full heatmaps."""
+
+    K, GH, GW = 14, 9, 9
+
+    def _frames(self, n=3, offsets=False):
+        rng = np.random.default_rng(13)
+        frames = []
+        for _ in range(n):
+            heat = rng.normal(-4, 0.5, (self.GH, self.GW, self.K))
+            peaks = rng.integers(0, self.GH * self.GW, self.K)
+            for i, p in enumerate(peaks):
+                heat[p // self.GW, p % self.GW, i] = 4.0 + rng.uniform(0, 1)
+            ts = [heat.astype(np.float32)]
+            if offsets:
+                ts.append(rng.normal(0, 3, (self.GH, self.GW, 2 * self.K))
+                          .astype(np.float32))
+            frames.append(tuple(ts))
+        return frames
+
+    def _run(self, preds, mode_opt="", extra=""):
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=jax-xla model=fusion_passthru "
+            "max-batch=2 batch-timeout=50 ! "
+            f"tensor_decoder name=d mode=pose_estimation option1=257:257 "
+            f"option2=257:257 {mode_opt} {extra} ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i, ts in enumerate(preds):
+            pipe["src"].push(TensorFrame([np.asarray(t) for t in ts],
+                                         pts=float(i)))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=60)
+        fused = pipe["d"]._fused
+        kps = [f.meta["keypoints"] for f in pipe["out"].frames]
+        pipe.stop()
+        return fused, kps
+
+    @pytest.mark.parametrize("offsets", [False, True])
+    def test_fused_matches_host(self, offsets):
+        def passthru(params, xs):
+            return list(xs)
+
+        register_jax_model("fusion_passthru", passthru, {})
+        try:
+            preds = self._frames(offsets=offsets)
+            opt = "option4=heatmap-offset" if offsets else ""
+            fused, f_kps = self._run(preds, opt)
+            assert fused is True
+            unfused, h_kps = self._run(preds, opt, extra="device-fused=never")
+            assert unfused is False
+        finally:
+            unregister_jax_model("fusion_passthru")
+        assert len(f_kps) == len(h_kps) == len(preds)
+        for fk, hk in zip(f_kps, h_kps):
+            assert len(fk) == len(hk) == self.K
+            for (fx, fy, fs), (hx, hy, hs) in zip(fk, hk):
+                assert fx == pytest.approx(hx, abs=0.1)
+                assert fy == pytest.approx(hy, abs=0.1)
+                assert fs == pytest.approx(hs, rel=1e-4)
 
 
 class TestBatchFrame:
