@@ -35,7 +35,13 @@ import grpc
 from ..core.buffer import TensorFrame
 from ..core.log import get_logger
 from ..core.types import StreamSpec
-from .wire import decode_frame, encode_frame
+from .wire import (
+    decode_frame,
+    decode_frames,
+    encode_frame,
+    encode_frames,
+    is_batch_payload,
+)
 
 log = get_logger("distributed")
 
@@ -83,23 +89,37 @@ class QueryServerCore:
         return server_caps.encode()
 
     def _invoke(self, request: bytes, context) -> bytes:
-        frame = decode_frame(request)
+        # wire micro-batch envelope: N frames ride one RPC (amortizes the
+        # per-RPC transport cost); the server pipeline still sees N
+        # ordinary frames, answers are collected back in stream order
+        batched = is_batch_payload(request)
+        frames = decode_frames(request) if batched else [decode_frame(request)]
         client_id = next(self._client_seq)
-        frame.meta["client_id"] = client_id
-        answer_q: "queue.Queue[TensorFrame]" = queue.Queue(1)
+        answer_q: "queue.Queue[TensorFrame]" = queue.Queue(len(frames))
         with self._pending_lock:
             self._pending[client_id] = answer_q
         try:
-            self.ingress.put((client_id, frame), timeout=10)
+            for frame in frames:
+                frame.meta["client_id"] = client_id
+                self.ingress.put((client_id, frame), timeout=10)
             timeout = float(context.time_remaining() or 30.0)
-            try:
-                answer = answer_q.get(timeout=min(timeout, 300.0))
-            except queue.Empty:
-                context.abort(
-                    grpc.StatusCode.DEADLINE_EXCEEDED,
-                    "server pipeline produced no answer in time",
-                )
-            return encode_frame(answer)
+            answers = []
+            deadline = time.monotonic() + min(timeout, 300.0)
+            for _ in frames:
+                try:
+                    answers.append(
+                        answer_q.get(
+                            timeout=max(0.0, deadline - time.monotonic())
+                        )
+                    )
+                except queue.Empty:
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "server pipeline produced no answer in time",
+                    )
+            if batched:
+                return encode_frames(answers)
+            return encode_frame(answers[0])
         finally:
             with self._pending_lock:
                 self._pending.pop(client_id, None)
@@ -206,6 +226,14 @@ class QueryConnection:
             encode_frame(frame), timeout=timeout or self.timeout
         )
         return decode_frame(data)
+
+    def invoke_batch(self, frames: List[TensorFrame],
+                     timeout: Optional[float] = None) -> List[TensorFrame]:
+        """N frames in one RPC (wire micro-batch); answers in order."""
+        data = self._invoke(
+            encode_frames(frames), timeout=timeout or self.timeout
+        )
+        return decode_frames(data)
 
     def close(self) -> None:
         self._channel.close()

@@ -87,6 +87,58 @@ def encode_frame(frame: TensorFrame) -> bytes:
     return b"".join(parts)
 
 
+# -- multi-frame envelope (wire micro-batching) -----------------------------
+_BMAGIC = 0x4E4E5342  # 'NNSB'
+_BHEAD = struct.Struct("<IH")
+_BLEN = struct.Struct("<Q")
+
+
+def encode_frames(frames) -> bytes:
+    """Pack several frames into ONE envelope (u32 'NNSB' | u16 count |
+    per frame u64 len + NNSQ bytes).  The query path uses this to
+    amortize per-RPC transport overhead over a micro-batch — the wire
+    analog of the filter's batched XLA invoke."""
+    parts = [_BHEAD.pack(_BMAGIC, len(frames))]
+    for f in frames:
+        blob = encode_frame(f)
+        parts.append(_BLEN.pack(len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def decode_frames(buf: bytes):
+    """Inverse of :func:`encode_frames`; returns a list of frames."""
+    try:
+        magic, count = _BHEAD.unpack_from(buf, 0)
+    except struct.error as e:
+        raise WireError(f"truncated batch header: {e}") from None
+    if magic != _BMAGIC:
+        raise WireError("bad batch magic")
+    off = _BHEAD.size
+    mv = memoryview(buf)
+    frames = []
+    for _ in range(count):
+        try:
+            (blen,) = _BLEN.unpack_from(buf, off)
+        except struct.error as e:
+            raise WireError(f"truncated batch entry: {e}") from None
+        off += _BLEN.size
+        blob = mv[off : off + blen]
+        if len(blob) != blen:
+            raise WireError("truncated batch frame")
+        # no copy: decode_frame works on any buffer (memoryview slicing)
+        frames.append(decode_frame(blob))
+        off += blen
+    return frames
+
+
+def is_batch_payload(buf) -> bool:
+    return (
+        len(buf) >= _BHEAD.size
+        and _BHEAD.unpack_from(buf, 0)[0] == _BMAGIC
+    )
+
+
 def decode_frame(buf: bytes) -> TensorFrame:
     try:
         magic, version, seq, pts, meta_len = _HEAD.unpack_from(buf, 0)

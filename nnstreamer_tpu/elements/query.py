@@ -130,6 +130,12 @@ class TensorQueryClient(Element):
         # possibly to another server) — opt in only for idempotent server
         # pipelines; 0 matches the reference's single-timeout semantics
         "retries": Property(int, 0, "re-send attempts per request (0 = none; >0 = at-least-once delivery)"),
+        # wire micro-batching (TPU-first, no reference analog): drain
+        # whatever frames are ALREADY queued (no added latency) and ship
+        # up to N of them in ONE RPC — amortizes the per-RPC transport
+        # cost exactly like the filter's batched XLA invoke amortizes
+        # dispatch.  1 = per-frame RPCs (reference parity).
+        "wire-batch": Property(int, 1, "max frames per RPC (1 = no batching)"),
     }
 
     def __init__(self, name=None):
@@ -206,7 +212,11 @@ class TensorQueryClient(Element):
             if not block_all and not fut.done():
                 break
             self._inflight.popleft()
-            out.append((0, fut.result()))  # raises on RPC error -> bus
+            got = fut.result()  # raises on RPC error -> bus
+            if isinstance(got, list):  # wire-batched request
+                out.extend((0, f) for f in got)
+            else:
+                out.append((0, got))
         return out
 
     def _healthy_order(self, first: int) -> List[int]:
@@ -222,7 +232,8 @@ class TensorQueryClient(Element):
 
     def _invoke_failover(self, frame, first: int):
         """One request: try the assigned (healthy-first) server, fail over
-        round-robin to the others, `retries` extra attempts total."""
+        round-robin to the others, `retries` extra attempts total.
+        ``frame`` may be a list (wire micro-batch) -> list comes back."""
         import time
 
         attempts = 1 + max(0, self.props["retries"])
@@ -233,7 +244,10 @@ class TensorQueryClient(Element):
             i = order[k % len(order)]
             conn = self._conns[i]
             try:
-                result = conn.invoke(frame, timeout)
+                if isinstance(frame, list):
+                    result = conn.invoke_batch(frame, timeout)
+                else:
+                    result = conn.invoke(frame, timeout)
                 self._down_until.pop(i, None)
                 return result
             except Exception as e:  # noqa: BLE001 — transport boundary
@@ -268,9 +282,26 @@ class TensorQueryClient(Element):
         return super().handle_event(pad, ev)
 
     def handle_frame(self, pad, frame):
+        return self._dispatch(frame)
+
+    # scheduler micro-batch hooks: with wire-batch > 1 the pipeline drains
+    # already-queued frames into handle_frame_batch (batch_wait_s = 0 so
+    # batching never ADDS latency — a lone frame still ships immediately)
+    @property
+    def preferred_batch(self) -> int:
+        return max(1, int(self.props["wire-batch"]))
+
+    batch_wait_s = 0.0
+
+    def handle_frame_batch(self, pad, frames):
+        if len(frames) == 1:
+            return self._dispatch(frames[0])
+        return self._dispatch(list(frames))
+
+    def _dispatch(self, frame_or_batch):
         first = self._rr % len(self._conns)
         self._rr += 1
-        fut = self._pool.submit(self._invoke_failover, frame, first)
+        fut = self._pool.submit(self._invoke_failover, frame_or_batch, first)
         fut.add_done_callback(self._notify_done)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window

@@ -95,6 +95,53 @@ class TestQueryRoundTrip:
         finally:
             server.stop()
 
+    def test_wire_batch_ordered_roundtrip(self):
+        """wire-batch > 1: already-queued frames ride one RPC; results
+        come back per-frame, in order, correctly transformed."""
+        server, port = self.make_server(141)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "wire-batch=4 max-in-flight=4 ! tensor_sink name=out"
+            )
+            client.start()
+            n = 11  # odd: forces 1-frame and partial batches too
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=20)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [i * 2.0 for i in range(n)]
+        finally:
+            server.stop()
+
+    def test_wire_batch_envelope_roundtrip(self):
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.distributed.wire import (
+            decode_frames,
+            encode_frames,
+            is_batch_payload,
+        )
+
+        frames = [
+            TensorFrame([np.full((3,), i, np.int32)], pts=float(i),
+                        meta={"i": i})
+            for i in range(5)
+        ]
+        buf = encode_frames(frames)
+        assert is_batch_payload(buf)
+        back = decode_frames(buf)
+        assert len(back) == 5
+        for i, f in enumerate(back):
+            np.testing.assert_array_equal(
+                f.tensors[0], np.full((3,), i, np.int32))
+            assert f.pts == float(i) and f.meta["i"] == i
+        # a single-frame NNSQ payload is NOT mistaken for an envelope
+        from nnstreamer_tpu.distributed.wire import encode_frame
+
+        assert not is_batch_payload(encode_frame(frames[0]))
+
     def test_fanout_two_servers_ordered(self):
         s1, p1 = self.make_server(111)
         s2, p2 = self.make_server(112)

@@ -106,7 +106,7 @@ _SCRIPTS = {"sleepy": _SERVER_SLEEPY, "real": _SERVER_REAL,
 
 
 def run_scale(mode: str, n_servers: int, frames: int,
-              work_ms: float, payload) -> float:
+              work_ms: float, payload, wire_batch: int = 1) -> float:
     from nnstreamer_tpu.pipeline import parse_pipeline
 
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
@@ -126,10 +126,14 @@ def run_scale(mode: str, n_servers: int, frames: int,
             ports.append(int(line.split()[1]))
 
         hosts = ",".join(f"127.0.0.1:{pt}" for pt in ports)
+        # the ceiling measurement wants a deep pipelined window; the
+        # scaling measurements keep the serving-shaped 4/server window
+        inflight = 16 if mode == "echo" else 4 * n_servers
         pipe = parse_pipeline(
             f"appsrc name=a max-buffers={frames + 8} ! "
             f"tensor_query_client hosts={hosts} timeout=120 "
-            f"max-in-flight={4 * n_servers} ! tensor_sink name=out",
+            f"max-in-flight={inflight} wire-batch={wire_batch} ! "
+            "tensor_sink name=out",
             name=f"fanout{n_servers}",
         )
         pipe.start()
@@ -181,54 +185,60 @@ def main() -> int:
         0, 255, (224, 224, 3), dtype=np.uint8
     )
     rows = []
+
+    def emit(row):
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        # incremental write: a timeout/crash in a later (slower) mode
+        # must not discard completed measurements
+        with open(out_path, "w") as f:
+            json.dump(rows, f, indent=2)
+
     for mode in modes:
+        if mode == "echo":
+            # client-ceiling matrix: payload size × wire batching — the
+            # two levers deciding whether ONE client can pump chip rate.
+            # 2 echo servers keep the server side off the critical path.
+            for payload, wb in (
+                (mobilenet_frame, 1), (mobilenet_frame, 8),
+                (np.zeros((8,), np.float32), 8),
+            ):
+                fps = run_scale("echo", 2, frames, work_ms, payload,
+                                wire_batch=wb)
+                emit({
+                    "metric": "query_client_ceiling_fps",
+                    "mode": "echo", "n_servers": 2,
+                    "value": round(fps, 1), "unit": "fps",
+                    "platform": "cpu-loopback",
+                    "payload_bytes": int(payload.nbytes),
+                    "wire_batch": wb,
+                })
+            continue
         payload = (
-            np.zeros((8,), np.float32)
-            if (mode == "echo"
-                and os.environ.get("FANOUT_ECHO_PAYLOAD") == "small")
-            else mobilenet_frame
+            mobilenet_frame if mode == "real"
+            else np.zeros((8,), np.float32)  # payload not under test
         )
-        if mode == "sleepy" and payload is mobilenet_frame:
-            payload = np.zeros((8,), np.float32)  # payload not under test
         base = None
-        # echo measures the ONE client's ceiling; fanning echo servers
-        # out only divides the same client-side work.  real mode shares
-        # one machine's cores between "chips", so scaling beyond 2 only
-        # measures contention — and at CPU-mobilenet rates fewer frames
-        # still give seconds of steady state.
-        mode_ns = [1] if mode == "echo" else (
-            [n for n in ns if n <= 2] if mode == "real" else ns
-        )
+        # real mode shares one machine's cores between "chips", so
+        # scaling beyond 2 only measures contention — and at
+        # CPU-mobilenet rates fewer frames still give steady state.
+        mode_ns = [n for n in ns if n <= 2] if mode == "real" else ns
         mode_frames = min(frames, 48) if mode == "real" else frames
         for n in mode_ns:
             fps = run_scale(mode, n, mode_frames, work_ms, payload)
             if base is None:
                 base = fps
-            row = {
-                "metric": (
-                    "query_client_ceiling_fps" if mode == "echo"
-                    else "query_fanout_scaling_fps"
-                ),
+            emit({
+                "metric": "query_fanout_scaling_fps",
                 "mode": mode,
                 "n_servers": n,
                 "value": round(fps, 1),
                 "unit": "fps",
                 "efficiency_vs_1": round(fps / (base * n), 3),
-                "platform": {
-                    "sleepy": "cpu-proxy", "real": "cpu-real",
-                    "echo": "cpu-loopback",
-                }[mode],
+                "platform": "cpu-proxy" if mode == "sleepy" else "cpu-real",
                 **({"work_ms_per_frame": work_ms}
                    if mode == "sleepy" else {}),
-                **({"payload_bytes": int(payload.nbytes)}
-                   if mode == "echo" else {}),
-            }
-            print(json.dumps(row), flush=True)
-            rows.append(row)
-            # incremental write: a timeout/crash in a later (slower) mode
-            # must not discard completed measurements
-            with open(out_path, "w") as f:
-                json.dump(rows, f, indent=2)
+            })
     print(f"[bench_fanout] wrote {out_path}", file=sys.stderr)
     return 0
 
