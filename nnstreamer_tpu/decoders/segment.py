@@ -79,7 +79,10 @@ class ImageSegment:
             classes = t.reshape(t.shape[0], t.shape[1]).astype(np.int64)
         classes = np.clip(classes, 0, self.max_labels)
 
-        h, w = classes.shape
+        return self._render_classes(frame, classes)
+
+    def _render_classes(self, frame: TensorFrame,
+                        classes: np.ndarray) -> TensorFrame:
         palette = np.zeros((self.max_labels + 1, 4), np.uint8)
         palette[1:] = [util.class_color(i) for i in range(self.max_labels)]
         palette[1:, 3] = 160  # semi-transparent overlay; class 0 transparent
@@ -88,3 +91,32 @@ class ImageSegment:
         present = np.unique(classes)
         out.meta["classes_present"] = [int(c) for c in present if c > 0]
         return out
+
+    # -- device-fused half (pipeline fusion pass) ---------------------------
+    def supports_device_fn(self) -> bool:
+        # per-pixel argmax is the transfer-heavy mode worth fusing; the
+        # other modes already ship index/depth grids.  uint8 wire grid
+        # caps the class space at 255 (Pascal VOC default is 20).
+        return self.mode == "tflite-deeplab" and self.max_labels <= 255
+
+    def device_fn(self, outs, platform=None):
+        """jit-traceable half: per-pixel argmax + clip on device, so a
+        (H, W) uint8 class grid (~66 KB at deeplab 257) crosses the link
+        instead of the (H, W, C) float score volume (~5.5 MB at C=21).
+        Mirrors ``decode``'s tflite-deeplab branch
+        (tensordec-imagesegment.c)."""
+        import jax.numpy as jnp
+
+        t = outs[0]
+        if t.ndim == 3:  # single-frame invoke path: no batch axis
+            t = t[None]
+        t = jnp.reshape(t, (t.shape[0],) + tuple(t.shape[-3:]))
+        classes = jnp.argmax(t, axis=-1)
+        classes = jnp.clip(classes, 0, self.max_labels)
+        return [classes.astype(jnp.uint8)]  # (B, H, W)
+
+    def decode_fused(self, frame: TensorFrame, in_spec) -> TensorFrame:
+        """Host finishing after device_fn: tensor is the class grid."""
+        classes = np.asarray(frame.tensors[0], np.int64)
+        classes = classes.reshape(classes.shape[-2], classes.shape[-1])
+        return self._render_classes(frame, classes)

@@ -340,6 +340,53 @@ class TestPoseFusion:
                 assert fs == pytest.approx(hs, rel=1e-4)
 
 
+class TestSegmentFusion:
+    """Device-fused segmentation (≙ tensordec-imagesegment.c): per-pixel
+    argmax runs in the filter's XLA program; a uint8 class grid crosses
+    the boundary instead of the float score volume."""
+
+    def test_fused_matches_host(self):
+        def passthru(params, xs):
+            return list(xs)
+
+        register_jax_model("fusion_passthru", passthru, {})
+        try:
+            rng = np.random.default_rng(21)
+            preds = [
+                rng.normal(0, 1, (16, 16, 21)).astype(np.float32)
+                for _ in range(3)
+            ]
+            results = {}
+            for key, extra in (("fused", ""), ("host", "device-fused=never")):
+                pipe = parse_pipeline(
+                    "appsrc name=src ! "
+                    "tensor_filter name=f framework=jax-xla "
+                    "model=fusion_passthru max-batch=2 batch-timeout=50 ! "
+                    "tensor_decoder name=d mode=image_segment "
+                    f"option1=tflite-deeplab {extra} ! tensor_sink name=out"
+                )
+                pipe.start()
+                for i, p in enumerate(preds):
+                    pipe["src"].push(TensorFrame([p], pts=float(i)))
+                pipe["src"].end_of_stream()
+                pipe.wait(timeout=60)
+                assert pipe["d"]._fused is (key == "fused")
+                results[key] = [
+                    (np.asarray(f.tensors[0]).copy(),
+                     f.meta["classes_present"])
+                    for f in pipe["out"].frames
+                ]
+                pipe.stop()
+        finally:
+            unregister_jax_model("fusion_passthru")
+        assert len(results["fused"]) == len(results["host"]) == 3
+        for (f_rgba, f_cls), (h_rgba, h_cls) in zip(
+            results["fused"], results["host"]
+        ):
+            np.testing.assert_array_equal(f_rgba, h_rgba)
+            assert f_cls == h_cls
+
+
 class TestBatchFrame:
     def test_split_roundtrip(self):
         frames = [
