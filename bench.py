@@ -87,10 +87,10 @@ def probe_backend(tries: int, timeout_s: float) -> str:
 
 def quant_applied(which: str) -> bool:
     """True when BENCH_QUANT actually changes the model that runs —
-    mobilenet (int8 convs) and vit (int8 dense) have int8 paths; one
+    mobilenet/ssd (int8 convs) and vit (int8 dense) have int8 paths; one
     definition keeps the executed pipeline and the emitted row label in
     agreement."""
-    return which in ("mobilenet", "vit") and os.environ.get(
+    return which in ("mobilenet", "ssd", "vit") and os.environ.get(
         "BENCH_QUANT", ""
     ) in ("1", "int8")
 
@@ -172,6 +172,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
 
         priors = write_box_priors("/tmp/nns_bench_priors.txt")
         size, family, props = 300, "ssd_mobilenet_v2", {"dtype": dtype}
+        if quant_applied(which):
+            props["quantize"] = "int8"
         decoder = (
             "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
             f"option2={labels_path} option3={priors} option4=300:300 "

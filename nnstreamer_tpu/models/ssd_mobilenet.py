@@ -67,6 +67,11 @@ def write_box_priors(path: str) -> str:
 class SSDMobileNetV2(nn.Module):
     num_classes: int = 91
     dtype: Any = jnp.bfloat16
+    # int8 MXU path for the backbone + extra feature convs (where the
+    # FLOPs are); the tiny loc/conf heads stay float32 — box regression
+    # is precision-sensitive and the heads are a rounding error of the
+    # compute (≙ the reference's quantized-tflite ssd flagship)
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -76,20 +81,24 @@ class SSDMobileNetV2(nn.Module):
             x = x.astype(self.dtype)
         feats: List[jnp.ndarray] = []
         c = _make_divisible(32)
-        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype)(x)
+        x = ConvBN(c, (3, 3), strides=2, dtype=self.dtype,
+                   quant=self.quant)(x)
         for t, ch, n, s in _CFG:
             out_c = _make_divisible(ch)
             for i in range(n):
                 x = InvertedResidual(out_c, s if i == 0 else 1, t,
-                                     dtype=self.dtype)(x)
+                                     dtype=self.dtype, quant=self.quant)(x)
             if ch == 96:
                 feats.append(x)   # stride 16 -> 19x19 @ 300
-        x = ConvBN(_make_divisible(1280), (1, 1), dtype=self.dtype)(x)
+        x = ConvBN(_make_divisible(1280), (1, 1), dtype=self.dtype,
+                   quant=self.quant)(x)
         feats.append(x)           # stride 32 -> 10x10
         # extra SSD feature layers down to 1x1
         for ch in (512, 256, 256, 128):
-            x = ConvBN(ch // 2, (1, 1), dtype=self.dtype)(x)
-            x = ConvBN(ch, (3, 3), strides=2, dtype=self.dtype)(x)
+            x = ConvBN(ch // 2, (1, 1), dtype=self.dtype,
+                       quant=self.quant)(x)
+            x = ConvBN(ch, (3, 3), strides=2, dtype=self.dtype,
+                       quant=self.quant)(x)
             feats.append(x)
 
         locs, confs = [], []
@@ -120,7 +129,10 @@ def build(custom_props=None):
         # other sizes would desync priors from the head outputs
         raise ValueError("ssd_mobilenet_v2 supports size=300 only")
     classes = int(props.get("classes", "91"))
-    model = SSDMobileNetV2(num_classes=classes, dtype=dtype)
+    model = SSDMobileNetV2(
+        num_classes=classes, dtype=dtype,
+        quant=props.get("quantize", "") == "int8",
+    )
     params = host_init(
         model.init,
         int(props.get("seed", "0")),

@@ -75,6 +75,29 @@ def test_mobilenet_quantized_runs(rng):
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+def test_ssd_quantized_shares_weights_and_tracks_float(rng):
+    """int8 SSD backbone: same param tree as the float build (heads stay
+    float32), finite outputs, box/score signal correlated with float."""
+    from nnstreamer_tpu.models import build
+
+    f_q, p_q, _, _ = build(
+        "ssd_mobilenet_v2",
+        {"dtype": "float32", "quantize": "int8", "seed": "3"},
+    )
+    f_f, p_f, _, _ = build(
+        "ssd_mobilenet_v2", {"dtype": "float32", "seed": "3"}
+    )
+    for a, b in zip(jax.tree.leaves(p_q), jax.tree.leaves(p_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    imgs = rng.integers(0, 255, (1, 300, 300, 3), np.uint8)
+    loc_q, conf_q = (np.asarray(o) for o in f_q(p_q, [imgs]))
+    loc_f, conf_f = (np.asarray(o) for o in f_f(p_f, [imgs]))
+    assert loc_q.shape == loc_f.shape and conf_q.shape == conf_f.shape
+    assert np.all(np.isfinite(loc_q)) and np.all(np.isfinite(conf_q))
+    corr = np.corrcoef(conf_q.ravel(), conf_f.ravel())[0, 1]
+    assert corr > 0.8, corr
+
+
 def test_mobilenet_quantized_tracks_float(rng):
     """Same weights, quantized vs float forward: logits stay correlated
     (dynamic-range PTQ keeps the prediction signal)."""

@@ -16,10 +16,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # (BENCH_MODEL, extra env) — mobilenet runs device- AND host-sourced so the
 # headline number is published alongside its transfer-inclusive variant
 ROWS = [
-    ("mobilenet", {}),
+    ("mobilenet", {"BENCH_RAW": "1"}),  # headline + same-window raw ref
     ("mobilenet", {"BENCH_HOST": "1"}),
     ("mobilenet", {"BENCH_QUANT": "1"}),  # int8 MXU path
+    ("mobilenet", {"BENCH_BATCH": "256"}),  # amortizes per-batch link RTTs
     ("ssd", {}),
+    ("ssd", {"BENCH_QUANT": "1"}),  # int8 backbone
     ("yolov5", {}),
     ("posenet", {}),
     ("vit", {}),
