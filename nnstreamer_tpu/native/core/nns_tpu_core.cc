@@ -107,6 +107,36 @@ int nns_oq_pop (void *h, double timeout_s, void **out)
   return 0;
 }
 
+/* Bulk pop: wait (like nns_oq_pop) for the FIRST item, then drain up to
+ * max_n without further waiting — one lock/wakeup cycle per micro-batch
+ * instead of one per frame.  Returns the item count (>0), -1 = timeout,
+ * -2 = closed-and-drained. */
+int nns_oq_pop_n (void *h, size_t max_n, double timeout_s, void **out)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  std::unique_lock<std::mutex> lk (q->m);
+  WaiterGuard wg (q);
+  auto ready = [q] { return q->closed || !q->items.empty (); };
+  if (timeout_s < 0) {
+    q->not_empty.wait (lk, ready);
+  } else if (!q->not_empty.wait_for (
+                 lk, std::chrono::duration<double> (timeout_s), ready)) {
+    return -1;
+  }
+  if (q->items.empty ())
+    return -2; /* closed */
+  size_t n = 0;
+  while (n < max_n && !q->items.empty ()) {
+    out[n++] = q->items.front ();
+    q->items.pop_front ();
+  }
+  if (n > 1)
+    q->not_full.notify_all (); /* several slots freed at once */
+  else
+    q->not_full.notify_one ();
+  return (int) n;
+}
+
 size_t nns_oq_size (void *h)
 {
   auto *q = static_cast<NnsQueue *> (h);

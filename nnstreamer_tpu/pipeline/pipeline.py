@@ -21,6 +21,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -491,11 +492,12 @@ class Pipeline:
                 self.post(BusMessage("eos", el.name))
 
         def body():
-            stash: Optional[Tuple[int, Any]] = None
+            # items popped from the mailbox but not yet processed (bulk
+            # pops can pull events/other-pad items past a batch boundary)
+            stash: deque = deque()
             while not self._stop_flag.is_set():
-                if stash is not None:
-                    pad, item = stash
-                    stash = None
+                if stash:
+                    pad, item = stash.popleft()
                 else:
                     try:
                         pad, item = el._mailbox.get(timeout=0.1)
@@ -525,19 +527,44 @@ class Pipeline:
                             el, "batch_wait_s", 0.0
                         )
                         frames = [item]
+                        get_many = getattr(el._mailbox, "get_many", None)
                         while len(frames) < want:
+                            # consume stashed items first (a previous bulk
+                            # pop may have pulled qualifying frames); an
+                            # event at the stash head ends the batch IN
+                            # PLACE — never rotate it behind later items
+                            if stash:
+                                p2, nxt = stash[0]
+                                if isinstance(nxt, TensorFrame) and p2 == pad:
+                                    frames.append(stash.popleft()[1])
+                                    continue
+                                break
                             try:
                                 wait = deadline - time.monotonic()
-                                if wait > 0:
-                                    p2, nxt = el._mailbox.get(timeout=wait)
+                                if get_many is not None:
+                                    chunk = get_many(
+                                        want - len(frames),
+                                        timeout=max(0.0, wait),
+                                    )
+                                elif wait > 0:
+                                    chunk = [el._mailbox.get(timeout=wait)]
                                 else:
-                                    p2, nxt = el._mailbox.get_nowait()
+                                    chunk = [el._mailbox.get_nowait()]
                             except queue.Empty:
                                 break
-                            if isinstance(nxt, TensorFrame) and p2 == pad:
-                                frames.append(nxt)
-                            else:
-                                stash = (p2, nxt)  # event/other-pad: after batch
+                            boundary = False
+                            for p2, nxt in chunk:
+                                if (not boundary
+                                        and isinstance(nxt, TensorFrame)
+                                        and p2 == pad):
+                                    frames.append(nxt)
+                                else:
+                                    # event/other-pad item ends the batch;
+                                    # it and everything popped after it
+                                    # run afterwards, in order
+                                    boundary = True
+                                    stash.append((p2, nxt))
+                            if boundary:
                                 break
                         t_in = (
                             time.perf_counter() if tracer is not None else 0.0

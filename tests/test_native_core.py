@@ -55,6 +55,46 @@ class TestNativeMailbox:
         mb.close()
         assert sys.getrefcount(obj) == base
 
+    def test_get_many_bulk_and_refcounts(self):
+        mb = runtime.NativeMailbox(32)
+        obj = object()
+        base = sys.getrefcount(obj)
+        for i in range(10):
+            mb.put((i, obj), timeout=1)
+        first = mb.get_many(4, timeout=1)
+        assert [p[0] for p in first] == [0, 1, 2, 3]
+        rest = mb.get_many(32, timeout=1)  # drains without waiting
+        assert [p[0] for p in rest] == [4, 5, 6, 7, 8, 9]
+        with pytest.raises(queue.Empty):
+            mb.get_many(4, timeout=0.05)
+        del first, rest
+        assert sys.getrefcount(obj) == base  # one DecRef per popped item
+        mb.close()
+
+    def test_get_many_wakes_blocked_producer(self):
+        # bulk pop frees several slots at once; every blocked producer
+        # must wake (notify_all path)
+        mb = runtime.NativeMailbox(2)
+        mb.put_nowait(1)
+        mb.put_nowait(2)
+        done = []
+
+        def producer(v):
+            mb.put(v, timeout=5)
+            done.append(v)
+
+        threads = [threading.Thread(target=producer, args=(v,))
+                   for v in (3, 4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        assert mb.get_many(2, timeout=1) == [1, 2]
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(done) == [3, 4]
+        assert sorted(mb.get_many(2, timeout=1)) == [3, 4]
+        mb.close()
+
     def test_blocking_handoff_across_threads(self):
         mb = runtime.NativeMailbox(1)
         got = []
