@@ -20,7 +20,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 from ..core.log import get_logger
 
