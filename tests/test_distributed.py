@@ -116,6 +116,39 @@ class TestQueryRoundTrip:
         finally:
             server.stop()
 
+    def test_wire_batch_failover_no_loss(self):
+        """retries>0 + wire-batch: a server killed mid-stream fails whole
+        BATCHES over to the surviving server — at-least-once per frame
+        (duplicates legal, loss not)."""
+        import time
+
+        s1, p1 = self.make_server(151)
+        s2, p2 = self.make_server(152)
+        client = parse_pipeline(
+            f"appsrc name=src ! tensor_query_client "
+            f"hosts=localhost:{p1},localhost:{p2} wire-batch=4 "
+            "max-in-flight=2 retries=2 timeout=5 ! tensor_sink name=out"
+        )
+        client.start()
+        try:
+            n = 24
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+                if i == 8:
+                    s1.stop()  # kill one server mid-stream
+                time.sleep(0.01)
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            got = {
+                int(float(f.tensors[0][0]) // 2)
+                for f in client["out"].frames
+            }
+            missing = set(range(n)) - got
+            assert not missing, f"lost frames: {sorted(missing)}"
+        finally:
+            client.stop()
+            s2.stop()
+
     def test_wire_batch_envelope_roundtrip(self):
         from nnstreamer_tpu.core.buffer import TensorFrame
         from nnstreamer_tpu.distributed.wire import (
