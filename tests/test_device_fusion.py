@@ -275,6 +275,38 @@ class TestBoundingBoxFusion:
             unregister_jax_model("fusion_passthru")
 
 
+class TestFusionOnMesh:
+    """Device fusion composes with mesh-sharded serving: the decoder's
+    device half compiles into the SAME GSPMD program that spreads the
+    filter across the device mesh — the multi-chip serving shape (fused
+    postprocess included, only the packed result leaves the mesh)."""
+
+    def test_fused_sharded_matches_fused_single(self, scale_model, labels):
+        results = {}
+        for key, custom in (("single", ""), ("mesh", "mesh_dp:2,mesh_tp:2")):
+            pipe = parse_pipeline(
+                "appsrc name=src ! "
+                f"tensor_filter name=f framework=jax-xla model={scale_model} "
+                f"custom={custom} max-batch=4 batch-timeout=30 ! "
+                f"tensor_decoder name=d mode=image_labeling option1={labels} "
+                "! tensor_sink name=out"
+            )
+            pipe.start()
+            expected = push_frames(pipe)
+            pipe.wait(timeout=60)
+            assert pipe["d"]._fused is True  # fusion engaged on the mesh too
+            if key == "mesh":
+                assert pipe["f"].backend._mesh is not None
+            frames = list(pipe["out"].frames)
+            pipe.stop()
+            assert [f.meta["label_index"] for f in frames] == expected
+            results[key] = [
+                (f.meta["label_index"], round(f.meta["label_score"], 4))
+                for f in frames
+            ]
+        assert results["mesh"] == results["single"]
+
+
 class TestPoseFusion:
     """Device-fused pose decode (≙ tensordec-pose.c): keypoint argmax +
     offset gather run in the filter's XLA program; only (K,3) keypoints
