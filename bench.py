@@ -87,10 +87,10 @@ def probe_backend(tries: int, timeout_s: float) -> str:
 
 def quant_applied(which: str) -> bool:
     """True when BENCH_QUANT actually changes the model that runs —
-    mobilenet/ssd (int8 convs) and vit (int8 dense) have int8 paths; one
-    definition keeps the executed pipeline and the emitted row label in
-    agreement."""
-    return which in ("mobilenet", "ssd", "vit") and os.environ.get(
+    mobilenet/ssd/yolov5 (int8 convs) and vit (int8 dense) have int8
+    paths; one definition keeps the executed pipeline and the emitted row
+    label in agreement."""
+    return which in ("mobilenet", "ssd", "yolov5", "vit") and os.environ.get(
         "BENCH_QUANT", ""
     ) in ("1", "int8")
 
@@ -182,6 +182,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     elif which == "yolov5":
         size = int(os.environ.get("BENCH_SIZE", "640"))
         family, props = "yolov5s", {"dtype": dtype, "size": str(size)}
+        if quant_applied(which):
+            props["quantize"] = "int8"
         decoder = (
             "tensor_decoder mode=bounding_boxes option1=yolov5 "
             f"option2={labels_path} option4={size}:{size} "

@@ -35,12 +35,23 @@ class ConvBnSiLU(nn.Module):
     kernel: int = 1
     stride: int = 1
     dtype: Any = jnp.bfloat16
+    quant: bool = False  # int8 MXU path (ops/quantize.py)
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, (self.kernel, self.kernel),
-                    strides=self.stride, padding="SAME", use_bias=False,
-                    dtype=self.dtype)(x)
+        if self.quant:
+            from ._quant_flax import QuantConv
+
+            # name="Conv_0" keeps the param path (and RNG fold) identical
+            # to nn.Conv: quantized and float builds share weights
+            x = QuantConv(
+                self.features, (self.kernel, self.kernel),
+                strides=self.stride, dtype=self.dtype, name="Conv_0",
+            )(x)
+        else:
+            x = nn.Conv(self.features, (self.kernel, self.kernel),
+                        strides=self.stride, padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
         x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
         return x * jax.nn.sigmoid(x)  # SiLU
 
@@ -49,11 +60,14 @@ class Bottleneck(nn.Module):
     features: int
     shortcut: bool = True
     dtype: Any = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
-        h = ConvBnSiLU(self.features, 1, dtype=self.dtype)(x)
-        h = ConvBnSiLU(self.features, 3, dtype=self.dtype)(h)
+        h = ConvBnSiLU(self.features, 1, dtype=self.dtype,
+                       quant=self.quant)(x)
+        h = ConvBnSiLU(self.features, 3, dtype=self.dtype,
+                       quant=self.quant)(h)
         return x + h if self.shortcut and x.shape[-1] == self.features else h
 
 
@@ -62,15 +76,18 @@ class C3(nn.Module):
     n: int = 1
     shortcut: bool = True
     dtype: Any = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
         c = self.features // 2
-        a = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
+        a = ConvBnSiLU(c, 1, dtype=self.dtype, quant=self.quant)(x)
         for _ in range(self.n):
-            a = Bottleneck(c, self.shortcut, dtype=self.dtype)(a)
-        b = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
-        return ConvBnSiLU(self.features, 1, dtype=self.dtype)(
+            a = Bottleneck(c, self.shortcut, dtype=self.dtype,
+                           quant=self.quant)(a)
+        b = ConvBnSiLU(c, 1, dtype=self.dtype, quant=self.quant)(x)
+        return ConvBnSiLU(self.features, 1, dtype=self.dtype,
+                          quant=self.quant)(
             jnp.concatenate([a, b], -1)
         )
 
@@ -78,15 +95,17 @@ class C3(nn.Module):
 class SPPF(nn.Module):
     features: int
     dtype: Any = jnp.bfloat16
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
         c = self.features // 2
-        x = ConvBnSiLU(c, 1, dtype=self.dtype)(x)
+        x = ConvBnSiLU(c, 1, dtype=self.dtype, quant=self.quant)(x)
         p1 = nn.max_pool(x, (5, 5), padding="SAME")
         p2 = nn.max_pool(p1, (5, 5), padding="SAME")
         p3 = nn.max_pool(p2, (5, 5), padding="SAME")
-        return ConvBnSiLU(self.features, 1, dtype=self.dtype)(
+        return ConvBnSiLU(self.features, 1, dtype=self.dtype,
+                          quant=self.quant)(
             jnp.concatenate([x, p1, p2, p3], -1)
         )
 
@@ -100,6 +119,9 @@ class YOLOv5s(nn.Module):
     num_classes: int = 80
     size: int = 640
     dtype: Any = jnp.bfloat16
+    # int8 MXU backbone/neck; the per-scale detect heads stay float32
+    # (precision-sensitive box regression, negligible FLOPs)
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -107,29 +129,31 @@ class YOLOv5s(nn.Module):
             x = x.astype(self.dtype) / 255.0
         else:
             x = x.astype(self.dtype)
-        d = self.dtype
+        d, q = self.dtype, self.quant
         # backbone (depth/width of the "s" variant)
-        x = ConvBnSiLU(32, 6, 2, dtype=d)(x)       # P1/2
-        x = ConvBnSiLU(64, 3, 2, dtype=d)(x)       # P2/4
-        x = C3(64, 1, dtype=d)(x)
-        x = ConvBnSiLU(128, 3, 2, dtype=d)(x)      # P3/8
-        p3 = C3(128, 2, dtype=d)(x)
-        x = ConvBnSiLU(256, 3, 2, dtype=d)(p3)     # P4/16
-        p4 = C3(256, 3, dtype=d)(x)
-        x = ConvBnSiLU(512, 3, 2, dtype=d)(p4)     # P5/32
-        x = C3(512, 1, dtype=d)(x)
-        p5 = SPPF(512, dtype=d)(x)
+        x = ConvBnSiLU(32, 6, 2, dtype=d, quant=q)(x)       # P1/2
+        x = ConvBnSiLU(64, 3, 2, dtype=d, quant=q)(x)       # P2/4
+        x = C3(64, 1, dtype=d, quant=q)(x)
+        x = ConvBnSiLU(128, 3, 2, dtype=d, quant=q)(x)      # P3/8
+        p3 = C3(128, 2, dtype=d, quant=q)(x)
+        x = ConvBnSiLU(256, 3, 2, dtype=d, quant=q)(p3)     # P4/16
+        p4 = C3(256, 3, dtype=d, quant=q)(x)
+        x = ConvBnSiLU(512, 3, 2, dtype=d, quant=q)(p4)     # P5/32
+        x = C3(512, 1, dtype=d, quant=q)(x)
+        p5 = SPPF(512, dtype=d, quant=q)(x)
         # neck (FPN + PAN)
-        h5 = ConvBnSiLU(256, 1, dtype=d)(p5)
-        h4 = C3(256, 1, shortcut=False, dtype=d)(
+        h5 = ConvBnSiLU(256, 1, dtype=d, quant=q)(p5)
+        h4 = C3(256, 1, shortcut=False, dtype=d, quant=q)(
             jnp.concatenate([_upsample2(h5), p4], -1))
-        h4r = ConvBnSiLU(128, 1, dtype=d)(h4)
-        h3 = C3(128, 1, shortcut=False, dtype=d)(
+        h4r = ConvBnSiLU(128, 1, dtype=d, quant=q)(h4)
+        h3 = C3(128, 1, shortcut=False, dtype=d, quant=q)(
             jnp.concatenate([_upsample2(h4r), p3], -1))      # out P3
-        h4o = C3(256, 1, shortcut=False, dtype=d)(
-            jnp.concatenate([ConvBnSiLU(128, 3, 2, dtype=d)(h3), h4r], -1))
-        h5o = C3(512, 1, shortcut=False, dtype=d)(
-            jnp.concatenate([ConvBnSiLU(256, 3, 2, dtype=d)(h4o), h5], -1))
+        h4o = C3(256, 1, shortcut=False, dtype=d, quant=q)(
+            jnp.concatenate(
+                [ConvBnSiLU(128, 3, 2, dtype=d, quant=q)(h3), h4r], -1))
+        h5o = C3(512, 1, shortcut=False, dtype=d, quant=q)(
+            jnp.concatenate(
+                [ConvBnSiLU(256, 3, 2, dtype=d, quant=q)(h4o), h5], -1))
 
         # detect head: per scale, raw conv -> sigmoid -> grid/anchor decode
         outs = []
@@ -176,7 +200,10 @@ def build(custom_props=None):
     with_nms = props.get("nms", "0") in ("1", "true")
     iou_thr = float(props.get("iou", "0.45"))
     nms_topk = int(props.get("nms_topk", "300"))
-    model = YOLOv5s(num_classes=classes, size=size, dtype=dtype)
+    model = YOLOv5s(
+        num_classes=classes, size=size, dtype=dtype,
+        quant=props.get("quantize", "") == "int8",
+    )
     params = host_init(
         model.init,
         int(props.get("seed", "0")),

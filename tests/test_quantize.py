@@ -98,6 +98,29 @@ def test_ssd_quantized_shares_weights_and_tracks_float(rng):
     assert corr > 0.8, corr
 
 
+def test_yolov5_quantized_shares_weights_and_tracks_float(rng):
+    """int8 yolov5 backbone/neck at a tiny size: weight-shared with the
+    float build, finite head outputs, correlated predictions."""
+    from nnstreamer_tpu.models import build
+
+    f_q, p_q, _, _ = build(
+        "yolov5s",
+        {"dtype": "float32", "quantize": "int8", "size": "64", "seed": "2"},
+    )
+    f_f, p_f, _, _ = build(
+        "yolov5s", {"dtype": "float32", "size": "64", "seed": "2"}
+    )
+    for a, b in zip(jax.tree.leaves(p_q), jax.tree.leaves(p_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    imgs = rng.integers(0, 255, (1, 64, 64, 3), np.uint8)
+    y_q = np.asarray(f_q(p_q, [imgs])[0])
+    y_f = np.asarray(f_f(p_f, [imgs])[0])
+    assert y_q.shape == y_f.shape
+    assert np.all(np.isfinite(y_q))
+    corr = np.corrcoef(y_q.ravel(), y_f.ravel())[0, 1]
+    assert corr > 0.8, corr
+
+
 def test_mobilenet_quantized_tracks_float(rng):
     """Same weights, quantized vs float forward: logits stay correlated
     (dynamic-range PTQ keeps the prediction signal)."""

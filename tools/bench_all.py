@@ -23,6 +23,7 @@ ROWS = [
     ("ssd", {}),
     ("ssd", {"BENCH_QUANT": "1"}),  # int8 backbone
     ("yolov5", {}),
+    ("yolov5", {"BENCH_QUANT": "1"}),  # int8 backbone/neck
     ("posenet", {}),
     ("vit", {}),
     ("mnist_trainer", {}),
