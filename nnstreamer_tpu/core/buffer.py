@@ -77,7 +77,11 @@ class TensorFrame:
 
     def to_host(self) -> "TensorFrame":
         """Materialize all payloads as numpy arrays (device -> host),
-        overlapping the per-tensor transfers (see :func:`materialize`)."""
+        overlapping the per-tensor transfers (see :func:`materialize`).
+        Already-host frames return self — the common sink-side case must
+        not pay a per-frame dataclass copy."""
+        if all(type(t) is np.ndarray for t in self.tensors):
+            return self
         return self.with_tensors(materialize(self.tensors))
 
 

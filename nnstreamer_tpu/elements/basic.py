@@ -43,9 +43,19 @@ class AppSrc(SourceElement):
         "framerate": Property(str, "", "n/d framerate stamped on frames without pts"),
     }
 
+    @staticmethod
+    def _make_queue(depth: int):
+        # native condvar mailbox when built (GIL-released blocking puts,
+        # bulk drain in frames()); stdlib queue otherwise
+        from ..native.runtime import NativeMailbox, available
+
+        if available():
+            return NativeMailbox(depth)
+        return _queue.Queue(maxsize=depth)
+
     def __init__(self, name=None):
         super().__init__(name)
-        self._q: "_queue.Queue" = _queue.Queue(maxsize=self.PROPERTIES["max-buffers"].default)
+        self._q = self._make_queue(self.PROPERTIES["max-buffers"].default)
         self._spec: StreamSpec = ANY
         self._count = 0
 
@@ -54,7 +64,7 @@ class AppSrc(SourceElement):
         # reaches the producer (≙ appsrc max-buffers/block)
         depth = int(self.props["max-buffers"])
         if self._q.maxsize != depth and self._q.empty():
-            self._q = _queue.Queue(maxsize=depth)
+            self._q = self._make_queue(depth)
 
     def set_spec(self, spec: StreamSpec) -> None:
         self._spec = spec
@@ -93,17 +103,23 @@ class AppSrc(SourceElement):
         self._q.put(None)
 
     def frames(self) -> Iterator[TensorFrame]:
+        get_many = getattr(self._q, "get_many", None)
         while True:
             try:
-                item = self._q.get(timeout=0.1)
+                if get_many is not None:
+                    # bulk drain: one native call per burst, not per frame
+                    items = get_many(32, timeout=0.1)
+                else:
+                    items = [self._q.get(timeout=0.1)]
             except _queue.Empty:
                 # stay responsive to pipeline stop while idle
                 if self._pipeline is not None and self._pipeline._stop_flag.is_set():
                     return
                 continue
-            if item is None:
-                return
-            yield item
+            for item in items:
+                if item is None:
+                    return
+                yield item
 
 
 @element("videotestsrc")
