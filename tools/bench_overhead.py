@@ -122,6 +122,15 @@ def main() -> int:
         "pipeline_fps": round(pipe_fps, 1),
         "raw_fps": round(raw_fps, 1),
         "ratio": round(pipe_fps / raw_fps, 3),
+        # the >=0.9 contract applies to REAL models (compute-bound); the
+        # tiny model isolates absolute framework cost per batch instead
+        "regime": (
+            "dispatch-bound: ratio not meaningful, read "
+            "framework_ms_per_batch" if which == "tiny" else "compute-bound"
+        ),
+        "framework_ms_per_batch": round(
+            (1.0 / pipe_fps - 1.0 / raw_fps) * batch * 1e3, 2
+        ),
         "platform": "cpu" if os.environ.get(
             "BENCH_OVERHEAD_PLATFORM", "cpu") == "cpu" else "accel",
     }))
