@@ -36,8 +36,14 @@ ROWS = [
 def main() -> int:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_ROWS.json"
     results = []
-    for model, extra in ROWS:
+    for i, (model, extra) in enumerate(ROWS):
         env = {**os.environ, "BENCH_MODEL": model, **extra}
+        if i > 0:
+            # the first row already proved the backend answers; later
+            # rows keep their probes short so a 10-row sweep fits a
+            # narrow tunnel-up window
+            env.setdefault("BENCH_PROBE_TRIES", "1")
+            env.setdefault("BENCH_PROBE_TIMEOUT", "60")
         print(f"[bench_all] {model} {extra or ''}...", flush=True)
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py")],
@@ -60,8 +66,17 @@ def main() -> int:
             }
         print(f"[bench_all]   -> {json.dumps(row)}", flush=True)
         results.append(row)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+        # incremental write: a kill mid-sweep keeps completed rows
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+        if "unavailable" in str(row.get("error", "")) and not os.environ.get(
+            "BENCH_ALL_KEEP_GOING"
+        ):
+            # tunnel down: every later row would burn its probe budget on
+            # the same outage — fail the sweep fast and diagnosable
+            print("[bench_all] backend unavailable; aborting remaining "
+                  "rows (BENCH_ALL_KEEP_GOING=1 overrides)", flush=True)
+            break
     print(f"[bench_all] wrote {out_path}")
     return 0
 
