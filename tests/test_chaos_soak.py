@@ -117,12 +117,15 @@ class TestChaosSoak:
                 time.sleep(0.02)  # ~50 fps sustained
 
             tx["src"].end_of_stream()
-            tx.wait(timeout=30)
+            tx.wait(timeout=60)
             # publisher must end clean: all QoS-1 publishes acknowledged
-            assert tx["snk"]._client is None or tx["snk"]._client.unacked() == 0
+            # (bounded drain first — a loaded CI box can still be
+            # retransmitting when EOS lands)
+            if tx["snk"]._client is not None:
+                assert tx["snk"]._client.drain(20.0) == 0
             tx.stop()
 
-            deadline = time.time() + 20
+            deadline = time.time() + 40
             while (len(rx["out"].frames) < n_total
                    and time.time() < deadline):
                 time.sleep(0.1)
@@ -146,7 +149,7 @@ class TestChaosSoak:
             np.testing.assert_allclose(arr, np.full((4,), i * w), rtol=1e-5)
 
         # no leaked workers: thread population returns to baseline
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline:
             leaked = [
                 t for t in threading.enumerate()
