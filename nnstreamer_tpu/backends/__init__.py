@@ -12,5 +12,5 @@ from .custom_easy import CustomEasy, register_custom_easy, unregister_custom_eas
 registry.register_lazy(registry.KIND_FILTER, "jax-xla", "nnstreamer_tpu.backends.jax_xla:JaxXla")
 registry.register_lazy(registry.KIND_FILTER, "python3", "nnstreamer_tpu.backends.python3:Python3Backend")
 registry.register_lazy(registry.KIND_FILTER, "torch", "nnstreamer_tpu.backends.torch_cpu:TorchBackend")
-registry.register_lazy(registry.KIND_FILTER, "tflite", "nnstreamer_tpu.backends.tflite_import:TFLiteImportBackend")
+registry.register_lazy(registry.KIND_FILTER, "tflite", "nnstreamer_tpu.backends.tflite_import:TFLiteBackend")
 registry.register_lazy(registry.KIND_FILTER, "custom", "nnstreamer_tpu.backends.custom_native:CustomNative")

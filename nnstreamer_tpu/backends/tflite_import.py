@@ -1,17 +1,29 @@
-"""tflite filter backend (gated): run .tflite models via an available
-TFLite runtime.
+"""tflite filter backend: run .tflite models on TPU by lowering to XLA.
 
-Reference: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc``
-(1677 LoC — TFLiteInterpreter/TFLiteCore, delegates, double-buffered
-reload).  This image ships no TensorFlow/TFLite runtime, so this backend
-*gates*: it registers (so ``framework=auto`` extension priority works and
-pipelines fail with a clear message) and activates only when
-``tflite_runtime`` or ``tensorflow.lite`` is importable — mirroring the
-reference's practice of skipping gracefully when a subplugin .so is absent
-(SURVEY §4: tests skip if the .so or model is missing).
+Reference capability: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc``
+(TFLiteInterpreter/TFLiteCore — open a .tflite, expose tensor info, invoke,
+double-buffered reload).  The reference wraps the TFLite CPU interpreter;
+here the flatbuffer is parsed in-process (``importers/tflite_reader.py``,
+no TensorFlow dependency) and the whole graph is lowered to ONE jit-traced
+JAX function (``importers/tflite_lower.py``), so a third-party model file
+runs on the MXU with the same machinery as native JAX models.
 
-For TPU execution of converted models, export to a jax callable and use
-``framework=jax-xla`` instead.
+Subclasses :class:`JaxXla`, inheriting the TPU-first runtime behaviors:
+shape-bucketed compilation, native ``invoke_batch`` (one XLA call per
+micro-batch), input donation, device-resident outputs, ``dtype:bfloat16``
+param casting, ``mesh_*`` sharded serving, and double-buffered hot reload.
+
+Custom props (beyond JaxXla's):
+
+* ``fake_quant:false`` — skip per-tensor requantization simulation for
+  quantized models (faster; activations stay float between ops).  Default
+  on (reproduces the integer kernels' saturation/rounding to within one
+  quantum).
+
+Batch semantics: TFLite graphs bake a leading batch dim (usually 1) into
+their shapes.  Per-frame ``invoke`` matches the declared shapes; the
+micro-batched path stacks frames on a new leading axis and the model fn
+vmaps over it, so the MXU still sees one large batched program.
 """
 
 from __future__ import annotations
@@ -21,66 +33,60 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
-from .base import FilterBackend
+from .jax_xla import JaxXla
+from .base import register_backend
 
 
-def _find_interpreter():
-    try:
-        from tflite_runtime.interpreter import Interpreter  # type: ignore
-        return Interpreter
-    except ImportError:
-        pass
-    try:
-        # attribute access, not `from tensorflow.lite import ...`: tf
-        # exposes the lite namespace through a lazy loader that defeats
-        # direct from-imports
-        import tensorflow as tf  # type: ignore
-
-        return tf.lite.Interpreter
-    except (ImportError, AttributeError):
-        return None
-
-
-class TFLiteImportBackend(FilterBackend):
+class TFLiteBackend(JaxXla):
     NAME = "tflite"
-
-    def __init__(self):
-        super().__init__()
-        self._interp = None
 
     @staticmethod
     def available() -> bool:
-        return _find_interpreter() is not None
+        return True
 
-    def open(self, model_path: Optional[str], props: Dict[str, Any]) -> None:
-        super().open(model_path, props)
-        Interpreter = _find_interpreter()
-        if Interpreter is None:
-            raise RuntimeError(
-                "tflite backend: no TFLite runtime in this environment "
-                "(install tflite_runtime, or convert the model and use "
-                "framework=jax-xla)")
-        self._interp = Interpreter(model_path=model_path)
-        self._interp.allocate_tensors()
+    def framework_info(self):
+        info = super().framework_info()
+        info.verify_model_path = True
+        return info
 
-    def close(self) -> None:
-        self._interp = None
+    def _resolve_model(self, model_path: Optional[str]):
+        import jax
 
-    def _specs(self, details) -> StreamSpec:
-        return StreamSpec(
-            tuple(TensorSpec(tuple(int(x) for x in d["shape"]), d["dtype"])
-                  for d in details),
-            FORMAT_STATIC,
-        )
+        from ..importers.tflite_reader import read_tflite
+        from ..importers.tflite_lower import _Lowering
 
-    def get_model_info(self) -> Tuple[Optional[StreamSpec], Optional[StreamSpec]]:
-        return (self._specs(self._interp.get_input_details()),
-                self._specs(self._interp.get_output_details()))
+        if not model_path:
+            raise ValueError("tflite backend requires model=<file.tflite>")
+        model = read_tflite(model_path)
+        fake_quant = self.custom_props.get(
+            "fake_quant", "true").lower() not in ("0", "false", "no")
+        lowering = _Lowering(model, fake_quant=fake_quant)
+        params = lowering.params()
+        lowering.drop_host_consts()  # run() always gets the params pytree
+        in_ranks = tuple(len(model.tensors[i].shape) for i in model.inputs)
 
-    def invoke(self, inputs: List[Any]) -> List[Any]:
-        ins = self._interp.get_input_details()
-        for d, a in zip(ins, inputs):
-            self._interp.set_tensor(d["index"], np.asarray(a, d["dtype"]))
-        self._interp.invoke()
-        return [self._interp.get_tensor(d["index"])
-                for d in self._interp.get_output_details()]
+        def fn(p, xs: List[Any]) -> List[Any]:
+            if all(x.ndim == r + 1 for x, r in zip(xs, in_ranks)):
+                # micro-batched frames: vmap the whole graph over the
+                # stacking axis — still a single XLA program
+                return list(jax.vmap(
+                    lambda *a: lowering.run(p, *a))(*xs))
+            return list(lowering.run(p, *xs))
+
+        def spec_of(indices) -> StreamSpec:
+            return StreamSpec(
+                tuple(
+                    TensorSpec(tuple(model.tensors[i].shape),
+                               np.dtype(model.tensors[i].dtype))
+                    for i in indices
+                ),
+                FORMAT_STATIC,
+            )
+
+        return fn, params, spec_of(model.inputs), spec_of(model.outputs)
+
+
+# Back-compat alias (the pre-round-4 gated shim's class name)
+TFLiteImportBackend = TFLiteBackend
+
+register_backend(TFLiteBackend)

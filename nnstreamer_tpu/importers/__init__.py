@@ -1,0 +1,15 @@
+"""Model importers: load third-party model formats and lower them to JAX.
+
+The reference achieves "drop a model file in and it runs" through ~20
+vendor-runtime subplugins under ``ext/nnstreamer/tensor_filter/`` (each
+wraps an external interpreter).  On TPU there is exactly one runtime that
+matters — XLA — so the TPU-native equivalent is an *importer*: parse the
+foreign format, lower the graph to jnp, and let jax-xla run it.  First
+format: TFLite flatbuffers (the reference's flagship format,
+``tensor_filter_tensorflow_lite.cc``).
+"""
+
+from .tflite_reader import TFLiteModel, read_tflite
+from .tflite_lower import lower_tflite
+
+__all__ = ["TFLiteModel", "read_tflite", "lower_tflite"]

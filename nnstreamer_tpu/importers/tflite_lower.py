@@ -1,0 +1,781 @@
+"""Lower a parsed TFLite graph to a jittable JAX function.
+
+Replaces the reference's CPU-interpreter execution
+(``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc:488-540``,
+``TFLiteInterpreter::invoke`` — per-tensor memcpy into interpreter slots,
+``interpreter->Invoke()``) with an XLA-native design: the whole graph is
+traced ONCE into a single jit program, so every conv/matmul lands on the
+MXU and XLA fuses the elementwise tail ops — no per-op interpreter
+dispatch at runtime.
+
+Quantized models (uint8/int8 per TFLite quantization spec) execute in
+*fake-quant simulation*: constants are dequantized at load time
+(per-channel where ``quantized_dimension`` says so); activations run in
+float32; every tensor that carries quantization parameters is re-quantized
+(round → clip to the dtype's limits → dequantize) at op boundaries, which
+reproduces the integer kernels' saturation/rounding semantics to within
+one quantum.  Graph inputs/outputs keep their declared integer dtypes so
+the pipeline-facing contract matches the reference tflite subplugin's.
+
+The op set covers the common CNN inventory (conv / depthwise / pool /
+dense / elementwise / shape ops / resize / softmax …) — enough for the
+reference's own test models (mobilenet_v2 quant, deeplabv3, add, FC nets).
+Unsupported ops raise ``TFLiteLowerError`` naming the op, at *load* time.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tflite_reader import TFLiteModel, TFLOp, TFLTensor, QuantParams
+
+
+class TFLiteLowerError(NotImplementedError):
+    pass
+
+
+# integer limits for fake-quant clipping
+_QLIMITS = {
+    "uint8": (0, 255),
+    "int8": (-128, 127),
+    "int16": (-32768, 32767),
+    "int32": (-2**31, 2**31 - 1),
+    "int64": (-2**63, 2**63 - 1),
+    "uint32": (0, 2**32 - 1),
+}
+
+
+def _dequantize_const(t: TFLTensor) -> np.ndarray:
+    """Constant tensor -> compute-domain numpy: quantized weights/biases
+    dequantize to float32 (honoring per-channel scales); fp16 widens;
+    integer-typed non-quantized constants keep their dtype (they may feed
+    genuine integer math)."""
+    data = np.asarray(t.data)
+    q = t.quant
+    if q is None or t.dtype not in _QLIMITS:
+        return data.astype(np.float32) if t.dtype == "float16" else data
+    scale, zp = q.scale, q.zero_point.astype(np.float32)
+    if q.per_channel:
+        # broadcast scale along quantized_dimension
+        shape = [1] * data.ndim
+        shape[q.quantized_dimension] = scale.size
+        scale = scale.reshape(shape)
+        zp = zp.reshape(shape)
+    else:
+        scale = scale[0]
+        zp = zp[0]
+    return (data.astype(np.float32) - zp) * scale
+
+
+def _fake_quant(x, q: QuantParams, dtype: str):
+    """Round-trip x through the tensor's integer grid (simulates the
+    integer kernels' output requantization)."""
+    lo, hi = _QLIMITS[dtype]
+    scale = float(q.scale[0])
+    zp = float(q.zero_point[0])
+    qx = jnp.clip(jnp.round(x / scale + zp), lo, hi)
+    return (qx - zp) * scale
+
+
+def _quantize_out(x, q: QuantParams, dtype: str):
+    lo, hi = _QLIMITS[dtype]
+    scale = float(q.scale[0])
+    zp = float(q.zero_point[0])
+    return jnp.clip(jnp.round(x / scale + zp), lo, hi).astype(np.dtype(dtype))
+
+
+def _dequantize_in(x, q: QuantParams):
+    return (x.astype(jnp.float32) - float(q.zero_point[0])) * float(q.scale[0])
+
+
+def _activate(x, name: Optional[str]):
+    if name is None:
+        return x
+    if name == "relu":
+        return jax.nn.relu(x)
+    if name == "relu6":
+        return jnp.clip(x, 0.0, 6.0)
+    if name == "relu_n1_to_1":
+        return jnp.clip(x, -1.0, 1.0)
+    if name == "tanh":
+        return jnp.tanh(x)
+    raise TFLiteLowerError(f"fused activation {name!r} not supported")
+
+
+def _same_pads(in_size: int, stride: int, kernel: int, dilation: int = 1
+               ) -> Tuple[int, int]:
+    """TFLite/TF SAME padding: total pad for one spatial dim."""
+    eff_k = (kernel - 1) * dilation + 1
+    out = -(-in_size // stride)  # ceil
+    total = max(0, (out - 1) * stride + eff_k - in_size)
+    return total // 2, total - total // 2
+
+
+def _conv_padding(opts, x_shape, k_h, k_w):
+    if opts["padding"] == "VALID":
+        return [(0, 0), (0, 0)]
+    return [
+        _same_pads(x_shape[1], opts["stride_h"], k_h, opts.get("dilation_h", 1)),
+        _same_pads(x_shape[2], opts["stride_w"], k_w, opts.get("dilation_w", 1)),
+    ]
+
+
+def _resize_coords(out_size: int, in_size: int, align_corners: bool,
+                   half_pixel: bool):
+    """Source sampling coordinates for one spatial dim (all three TFLite
+    coordinate conventions)."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners and out_size > 1:
+        scale = (in_size - 1) / (out_size - 1)
+        return i * scale
+    scale = in_size / out_size
+    if half_pixel:
+        return jnp.maximum((i + 0.5) * scale - 0.5, 0.0)
+    return i * scale
+
+
+def _resize_bilinear(x, out_h: int, out_w: int, align_corners: bool,
+                     half_pixel: bool):
+    n, h, w, c = x.shape
+    ys = _resize_coords(out_h, h, align_corners, half_pixel)
+    xs = _resize_coords(out_w, w, align_corners, half_pixel)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0.astype(jnp.float32))[None, :, None, None]
+    wx = (xs - x0.astype(jnp.float32))[None, None, :, None]
+    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _resize_nearest(x, out_h: int, out_w: int, align_corners: bool,
+                    half_pixel: bool):
+    n, h, w, c = x.shape
+    ys = _resize_coords(out_h, h, align_corners, half_pixel)
+    xs = _resize_coords(out_w, w, align_corners, half_pixel)
+    # TFLite nearest: round-half-away for half_pixel/align, floor otherwise
+    if half_pixel or align_corners:
+        yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+    else:
+        yi = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    return x[:, yi][:, :, xi]
+
+
+def _pool(x, opts, kind: str):
+    pads = [(0, 0)] + _conv_padding(
+        opts, x.shape, opts["filter_h"], opts["filter_w"]) + [(0, 0)]
+    window = (1, opts["filter_h"], opts["filter_w"], 1)
+    strides = (1, opts["stride_h"], opts["stride_w"], 1)
+    if kind == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        # divide by the true (edge-clipped) window size, as TFLite does
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        count = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        out = summed / count
+    return _activate(out, opts.get("activation"))
+
+
+class _Lowering:
+    """One pass over the graph building a closure env of constants and a
+    list of (op, impl) steps; `__call__` replays the steps under jit."""
+
+    def __init__(self, model: TFLiteModel, fake_quant: bool = True):
+        self.m = model
+        self.fake_quant = fake_quant
+        # trace-time shape constants (SHAPE / BROADCAST_ARGS results):
+        # XLA needs static shapes, so shape-producing ops fold to numpy
+        # here and stay usable as shape arguments downstream
+        self.static: Dict[int, np.ndarray] = {}
+        # when on, every op output is checked against the shape the file
+        # declares for that tensor — a structural proof that our
+        # padding/stride/layout semantics match what the TFLite converter
+        # computed.  Only valid for unbatched (declared-shape) calls.
+        self.validate_shapes = False
+        # float32 views of every constant, dequantized once at load
+        self.consts: Dict[int, np.ndarray] = {}
+        # constants that must stay integer (shape/axis/pad arguments)
+        self.raw_consts: Dict[int, np.ndarray] = {}
+        for t in model.tensors:
+            if t.is_const:
+                self.raw_consts[t.index] = np.asarray(t.data)
+                self.consts[t.index] = _dequantize_const(t)
+        unsupported = sorted({
+            op.opcode for op in model.ops
+            if op.opcode.split(":")[0] not in _OP_IMPLS})
+        if unsupported:
+            raise TFLiteLowerError(
+                f"unsupported tflite ops: {', '.join(unsupported)} "
+                f"(supported: {', '.join(sorted(_OP_IMPLS))})")
+
+    def params(self) -> Dict[int, np.ndarray]:
+        """The constants as a pytree: pass to :meth:`run` so the caller
+        controls placement (device_put / bf16 cast / mesh sharding)."""
+        return dict(self.consts)
+
+    def drop_host_consts(self) -> None:
+        """Release the host-side dequantized-constant copies.  A caller
+        that took :meth:`params` (and will always use :meth:`run` with
+        that pytree) doesn't need the ``val()`` fallback — dropping the
+        dict avoids keeping a second full float32 copy of every weight in
+        host RAM next to the device copy.  ``raw_consts`` stays: those
+        are the trace-time shape/axis/pad lookups (and they are views
+        into the single mmap-like file buffer, not copies)."""
+        self.consts = {}
+
+    # -- value access during trace -----------------------------------------
+    def val(self, env, idx: int):
+        """Compute-domain value of tensor idx (dequantized constants)."""
+        if idx < 0:
+            return None
+        if idx in env:
+            return env[idx]
+        return jnp.asarray(self.consts[idx])
+
+    def raw(self, idx: int) -> np.ndarray:
+        """Integer-domain constant (shape vectors, pad matrices, axes),
+        either from the file or folded at trace time (SHAPE etc.)."""
+        if idx in self.raw_consts:
+            return self.raw_consts[idx]
+        if idx in self.static:
+            return self.static[idx]
+        raise TFLiteLowerError(
+            f"tensor {idx} must be a constant (dynamic shapes are not "
+            "jittable; XLA requires static shapes)")
+
+    def out_quant(self, x, idx: int):
+        """Quantization boundary for an op output.
+
+        fake_quant=True: full round-trip through the integer grid.
+        fake_quant=False: keep only the RANGE CLAMP.  The clamp is load-
+        bearing, not an approximation knob: TOCO-era models encode fused
+        ReLU6 in the output quant range (scale*255 ~= 6, zp=0), so
+        dropping it entirely would remove the activations.
+        """
+        t = self.m.tensors[idx]
+        if (t.quant is None or t.dtype not in _QLIMITS
+                or t.quant.per_channel):
+            return x
+        if self.fake_quant:
+            return _fake_quant(x, t.quant, t.dtype)
+        lo, hi = _QLIMITS[t.dtype]
+        scale = float(t.quant.scale[0])
+        zp = float(t.quant.zero_point[0])
+        return jnp.clip(x, (lo - zp) * scale, (hi - zp) * scale)
+
+    # -- the jittable function ---------------------------------------------
+    def __call__(self, *inputs):
+        return self.run(self.consts, *inputs)
+
+    def run(self, consts: Dict[int, Any], *inputs):
+        """Trace the graph with an externally-placed constants pytree."""
+        m = self.m
+        if len(inputs) != len(m.inputs):
+            raise ValueError(
+                f"model takes {len(m.inputs)} inputs, got {len(inputs)}")
+        env: Dict[int, Any] = dict(consts)
+        self.static = {}
+        for idx, x in zip(m.inputs, inputs):
+            t = m.tensors[idx]
+            x = jnp.asarray(x)
+            if t.quant is not None and t.dtype in _QLIMITS:
+                x = _dequantize_in(x, t.quant)
+            elif x.dtype in (jnp.uint8, jnp.int8) and t.dtype == "float32":
+                x = x.astype(jnp.float32)
+            env[idx] = x
+        for op in m.ops:
+            impl = _OP_IMPLS[op.opcode.split(":")[0]]
+            impl(self, env, op)
+            if self.validate_shapes:
+                for out_idx in op.outputs:
+                    decl = m.tensors[out_idx].shape
+                    got = tuple(env[out_idx].shape)
+                    if decl and got != decl:
+                        raise TFLiteLowerError(
+                            f"{op.opcode}: tensor {out_idx} "
+                            f"({m.tensors[out_idx].name}) computed shape "
+                            f"{got} != declared {decl}")
+        outs = []
+        for idx in m.outputs:
+            t = m.tensors[idx]
+            x = env[idx]
+            if t.quant is not None and t.dtype in _QLIMITS:
+                x = _quantize_out(x, t.quant, t.dtype)
+            elif t.dtype in ("int32", "int64", "bool"):
+                x = x.astype(np.dtype(t.dtype))
+            outs.append(x)
+        return tuple(outs)
+
+
+# -- op implementations -----------------------------------------------------
+# Each: (lowering, env, op) -> writes env[op.outputs[...]]
+
+def _op_conv2d(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    w = L.val(env, op.inputs[1])            # [O, Kh, Kw, I]
+    b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 else None
+    o = op.options
+    pads = _conv_padding(o, x.shape, w.shape[1], w.shape[2])
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=(o["stride_h"], o["stride_w"]),
+        padding=pads,
+        rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+        dimension_numbers=("NHWC", "OHWI", "NHWC"),
+    )
+    if b is not None:
+        y = y + b
+    env[op.outputs[0]] = L.out_quant(_activate(y, o["activation"]),
+                                     op.outputs[0])
+
+
+def _op_depthwise(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    w = L.val(env, op.inputs[1])            # [1, Kh, Kw, I*mult]
+    b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 else None
+    o = op.options
+    in_ch = x.shape[3]
+    kh, kw = w.shape[1], w.shape[2]
+    # HWIO with I=1, feature_group_count=in_ch -> per-channel conv
+    w = jnp.reshape(jnp.transpose(w, (1, 2, 0, 3)), (kh, kw, 1, -1))
+    pads = _conv_padding(o, x.shape, kh, kw)
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=(o["stride_h"], o["stride_w"]),
+        padding=pads,
+        rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=in_ch,
+    )
+    if b is not None:
+        y = y + b
+    env[op.outputs[0]] = L.out_quant(_activate(y, o["activation"]),
+                                     op.outputs[0])
+
+
+def _op_transpose_conv(L: _Lowering, env, op: TFLOp):
+    # inputs: [output_shape(const), weights(O,Kh,Kw,I), x, (bias)]
+    out_shape = tuple(int(v) for v in L.raw(op.inputs[0]))
+    w = L.val(env, op.inputs[1])
+    x = L.val(env, op.inputs[2])
+    b = L.val(env, op.inputs[3]) if len(op.inputs) > 3 else None
+    o = op.options
+    sh, sw = o["stride_h"], o["stride_w"]
+    kh, kw = w.shape[1], w.shape[2]
+    # gradient-style transpose conv: lhs-dilate x by the stride, then a
+    # VALID conv with the spatially-flipped kernel and full padding
+    if o["padding"] == "SAME":
+        pt, pb = _same_pads(out_shape[1], sh, kh)
+        pl, pr = _same_pads(out_shape[2], sw, kw)
+    else:
+        pt = pb = pl = pr = 0
+    w_flip = jnp.flip(w, axis=(1, 2))       # [O,Kh,Kw,I] flipped
+    w_t = jnp.transpose(w_flip, (1, 2, 0, 3))  # HW O I -> use IOHW mapping
+    y = lax.conv_general_dilated(
+        x, w_t,
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pt, kh - 1 - pb), (kw - 1 - pl, kw - 1 - pr)],
+        lhs_dilation=(sh, sw),
+        dimension_numbers=("NHWC", "HWOI", "NHWC"),
+    )
+    y = y[:, :out_shape[1], :out_shape[2], :]
+    if b is not None:
+        y = y + b
+    env[op.outputs[0]] = L.out_quant(
+        _activate(y, o.get("activation")), op.outputs[0])
+
+
+def _op_fully_connected(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    w = L.val(env, op.inputs[1])            # [O, I]
+    b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 and op.inputs[2] >= 0 else None
+    o = op.options
+    if o.get("weights_format", 0) != 0:
+        raise TFLiteLowerError("FULLY_CONNECTED shuffled-weights format")
+    if not o.get("keep_num_dims", False):
+        x = jnp.reshape(x, (-1, w.shape[1]))
+    y = x @ w.T
+    if b is not None:
+        y = y + b
+    env[op.outputs[0]] = L.out_quant(_activate(y, o["activation"]),
+                                     op.outputs[0])
+
+
+def _op_pool_avg(L: _Lowering, env, op: TFLOp):
+    env[op.outputs[0]] = L.out_quant(
+        _pool(L.val(env, op.inputs[0]), op.options, "avg"), op.outputs[0])
+
+
+def _op_pool_max(L: _Lowering, env, op: TFLOp):
+    env[op.outputs[0]] = L.out_quant(
+        _pool(L.val(env, op.inputs[0]), op.options, "max"), op.outputs[0])
+
+
+def _binop(fn):
+    def impl(L: _Lowering, env, op: TFLOp):
+        a = L.val(env, op.inputs[0])
+        b = L.val(env, op.inputs[1])
+        y = _activate(fn(a, b), op.options.get("activation"))
+        env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+    return impl
+
+
+def _unop(fn, quant: bool = True):
+    def impl(L: _Lowering, env, op: TFLOp):
+        y = fn(L.val(env, op.inputs[0]))
+        env[op.outputs[0]] = L.out_quant(y, op.outputs[0]) if quant else y
+    return impl
+
+
+def _op_reshape(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    if len(op.inputs) > 1 and op.inputs[1] >= 0:
+        shape = [int(v) for v in L.raw(op.inputs[1]).ravel()]
+    else:
+        shape = list(op.options.get("new_shape") or
+                     L.m.tensors[op.outputs[0]].shape)
+    env[op.outputs[0]] = jnp.reshape(x, shape)
+
+
+def _op_softmax(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    y = jax.nn.softmax(x * op.options.get("beta", 1.0), axis=-1)
+    env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+
+
+def _op_concat(L: _Lowering, env, op: TFLOp):
+    parts = [L.val(env, i) for i in op.inputs]
+    y = jnp.concatenate(parts, axis=op.options["axis"])
+    env[op.outputs[0]] = L.out_quant(
+        _activate(y, op.options.get("activation")), op.outputs[0])
+
+
+def _op_pad(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    pads = L.raw(op.inputs[1]).reshape(-1, 2)
+    value = 0.0
+    if len(op.inputs) > 2 and op.inputs[2] >= 0:       # PADV2 constant
+        value = float(np.asarray(L.raw(op.inputs[2])).ravel()[0])
+    env[op.outputs[0]] = jnp.pad(
+        x, [(int(a), int(b)) for a, b in pads], constant_values=value)
+
+
+def _op_mirror_pad(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    pads = L.raw(op.inputs[1]).reshape(-1, 2)
+    env[op.outputs[0]] = jnp.pad(
+        x, [(int(a), int(b)) for a, b in pads], mode=op.options["mode"])
+
+
+def _reduce(fn):
+    def impl(L: _Lowering, env, op: TFLOp):
+        x = L.val(env, op.inputs[0])
+        axes = tuple(int(v) for v in L.raw(op.inputs[1]).ravel())
+        y = fn(x, axis=axes, keepdims=op.options.get("keep_dims", False))
+        env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+    return impl
+
+
+def _op_strided_slice(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    begin = L.raw(op.inputs[1]).ravel()
+    end = L.raw(op.inputs[2]).ravel()
+    strides = L.raw(op.inputs[3]).ravel()
+    o = op.options
+    if o.get("ellipsis_mask") or o.get("new_axis_mask"):
+        raise TFLiteLowerError("STRIDED_SLICE ellipsis/new-axis masks")
+    idx = []
+    for d in range(x.ndim):
+        if d >= begin.size:
+            idx.append(slice(None))
+            continue
+        b = None if (o["begin_mask"] >> d) & 1 else int(begin[d])
+        e = None if (o["end_mask"] >> d) & 1 else int(end[d])
+        s = int(strides[d])
+        if (o["shrink_axis_mask"] >> d) & 1:
+            idx.append(int(begin[d]))
+        else:
+            idx.append(slice(b, e, s))
+    env[op.outputs[0]] = x[tuple(idx)]
+
+
+def _op_slice(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    begin = [int(v) for v in L.raw(op.inputs[1]).ravel()]
+    size = [int(v) for v in L.raw(op.inputs[2]).ravel()]
+    size = [x.shape[d] - begin[d] if s == -1 else s for d, s in enumerate(size)]
+    env[op.outputs[0]] = lax.dynamic_slice(x, begin, size)
+
+
+def _op_transpose(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    perm = [int(v) for v in L.raw(op.inputs[1]).ravel()]
+    env[op.outputs[0]] = jnp.transpose(x, perm)
+
+
+def _op_resize_bilinear(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    out_h, out_w = (int(v) for v in L.raw(op.inputs[1]).ravel())
+    y = _resize_bilinear(x, out_h, out_w, op.options["align_corners"],
+                         op.options["half_pixel_centers"])
+    env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+
+
+def _op_resize_nearest(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    out_h, out_w = (int(v) for v in L.raw(op.inputs[1]).ravel())
+    y = _resize_nearest(x, out_h, out_w, op.options["align_corners"],
+                        op.options["half_pixel_centers"])
+    env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+
+
+def _op_squeeze(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    dims = op.options.get("squeeze_dims") or None
+    env[op.outputs[0]] = jnp.squeeze(
+        x, axis=tuple(dims) if dims else None)
+
+
+def _op_expand_dims(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    axis = int(L.raw(op.inputs[1]).ravel()[0])
+    env[op.outputs[0]] = jnp.expand_dims(x, axis)
+
+
+def _op_shape(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    dtype = np.dtype(op.options.get("out_dtype") or "int32")
+    L.static[op.outputs[0]] = np.asarray(x.shape, dtype)
+    # traced view stays int32: x64 is disabled under jit and shapes fit
+    env[op.outputs[0]] = jnp.asarray(x.shape, jnp.int32)
+
+
+def _op_broadcast_args(L: _Lowering, env, op: TFLOp):
+    a = tuple(int(v) for v in L.raw(op.inputs[0]).ravel())
+    b = tuple(int(v) for v in L.raw(op.inputs[1]).ravel())
+    shape = np.asarray(np.broadcast_shapes(a, b), np.int32)
+    L.static[op.outputs[0]] = shape
+    env[op.outputs[0]] = jnp.asarray(shape)
+
+
+def _op_broadcast_to(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    shape = tuple(int(v) for v in L.raw(op.inputs[1]).ravel())
+    env[op.outputs[0]] = jnp.broadcast_to(x, shape)
+
+
+def _op_batch_matmul(L: _Lowering, env, op: TFLOp):
+    a = L.val(env, op.inputs[0])
+    b = L.val(env, op.inputs[1])
+    env[op.outputs[0]] = L.out_quant(jnp.matmul(a, b), op.outputs[0])
+
+
+def _op_cast(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    out_dtype = op.options.get("out_dtype") or L.m.tensors[op.outputs[0]].dtype
+    env[op.outputs[0]] = x.astype(np.dtype(out_dtype))
+
+
+def _op_arg_max(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    axis = int(L.raw(op.inputs[1]).ravel()[0])
+    env[op.outputs[0]] = jnp.argmax(x, axis=axis).astype(
+        np.dtype(op.options.get("output_type") or "int64"))
+
+
+def _op_arg_min(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    axis = int(L.raw(op.inputs[1]).ravel()[0])
+    env[op.outputs[0]] = jnp.argmin(x, axis=axis).astype(
+        np.dtype(op.options.get("output_type") or "int64"))
+
+
+def _op_gather(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    if op.inputs[1] in L.raw_consts:
+        idx = jnp.asarray(L.raw(op.inputs[1]))
+    else:
+        idx = env[op.inputs[1]].astype(jnp.int32)
+    env[op.outputs[0]] = jnp.take(x, idx, axis=op.options.get("axis", 0))
+
+
+def _op_pack(L: _Lowering, env, op: TFLOp):
+    parts = [L.val(env, i) for i in op.inputs]
+    env[op.outputs[0]] = jnp.stack(parts, axis=op.options.get("axis", 0))
+
+
+def _op_unpack(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    axis = op.options.get("axis", 0)
+    for j, out_idx in enumerate(op.outputs):
+        env[out_idx] = lax.index_in_dim(x, j, axis=axis, keepdims=False)
+
+
+def _op_split(L: _Lowering, env, op: TFLOp):
+    # inputs: [axis(const), x]
+    axis = int(L.raw(op.inputs[0]).ravel()[0])
+    x = L.val(env, op.inputs[1])
+    parts = jnp.split(x, len(op.outputs), axis=axis)
+    for out_idx, part in zip(op.outputs, parts):
+        env[out_idx] = part
+
+
+def _op_split_v(L: _Lowering, env, op: TFLOp):
+    # inputs: [x, size_splits(const), axis(const)]
+    x = L.val(env, op.inputs[0])
+    sizes = [int(v) for v in L.raw(op.inputs[1]).ravel()]
+    axis = int(L.raw(op.inputs[2]).ravel()[0])
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    for out_idx, part in zip(op.outputs, jnp.split(x, bounds, axis=axis)):
+        env[out_idx] = part
+
+
+def _op_tile(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    reps = [int(v) for v in L.raw(op.inputs[1]).ravel()]
+    env[op.outputs[0]] = jnp.tile(x, reps)
+
+
+def _op_space_to_depth(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    b = op.options["block_size"]
+    n, h, w, c = x.shape
+    y = x.reshape(n, h // b, b, w // b, b, c)
+    y = jnp.transpose(y, (0, 1, 3, 2, 4, 5)).reshape(n, h // b, w // b, c * b * b)
+    env[op.outputs[0]] = y
+
+
+def _op_depth_to_space(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    b = op.options["block_size"]
+    n, h, w, c = x.shape
+    y = x.reshape(n, h, w, b, b, c // (b * b))
+    y = jnp.transpose(y, (0, 1, 3, 2, 4, 5)).reshape(n, h * b, w * b, c // (b * b))
+    env[op.outputs[0]] = y
+
+
+def _op_l2_norm(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    y = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=-1, keepdims=True), 1e-6))
+    env[op.outputs[0]] = L.out_quant(
+        _activate(y, op.options.get("activation")), op.outputs[0])
+
+
+def _op_prelu(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    alpha = L.val(env, op.inputs[1])
+    env[op.outputs[0]] = L.out_quant(
+        jnp.where(x >= 0, x, x * alpha), op.outputs[0])
+
+
+def _op_leaky_relu(L: _Lowering, env, op: TFLOp):
+    x = L.val(env, op.inputs[0])
+    a = op.options.get("alpha", 0.0)
+    env[op.outputs[0]] = L.out_quant(jnp.where(x >= 0, x, x * a),
+                                     op.outputs[0])
+
+
+def _op_dequantize(L: _Lowering, env, op: TFLOp):
+    # value is already float in our env; just pass through
+    env[op.outputs[0]] = L.val(env, op.inputs[0])
+
+
+def _op_quantize(L: _Lowering, env, op: TFLOp):
+    env[op.outputs[0]] = L.out_quant(L.val(env, op.inputs[0]), op.outputs[0])
+
+
+_OP_IMPLS: Dict[str, Callable] = {
+    "CONV_2D": _op_conv2d,
+    "DEPTHWISE_CONV_2D": _op_depthwise,
+    "TRANSPOSE_CONV": _op_transpose_conv,
+    "FULLY_CONNECTED": _op_fully_connected,
+    "AVERAGE_POOL_2D": _op_pool_avg,
+    "MAX_POOL_2D": _op_pool_max,
+    "ADD": _binop(jnp.add),
+    "SUB": _binop(jnp.subtract),
+    "MUL": _binop(jnp.multiply),
+    "DIV": _binop(jnp.divide),
+    "MAXIMUM": _binop(jnp.maximum),
+    "MINIMUM": _binop(jnp.minimum),
+    "SQUARED_DIFFERENCE": _binop(lambda a, b: (a - b) ** 2),
+    "POW": _binop(jnp.power),
+    "FLOOR_DIV": _binop(lambda a, b: jnp.floor(a / b)),
+    "GREATER": _binop(lambda a, b: (a > b)),
+    "EQUAL": _binop(lambda a, b: (a == b)),
+    "RELU": _unop(jax.nn.relu),
+    "RELU6": _unop(lambda x: jnp.clip(x, 0.0, 6.0)),
+    "RELU_N1_TO_1": _unop(lambda x: jnp.clip(x, -1.0, 1.0)),
+    "LOGISTIC": _unop(jax.nn.sigmoid),
+    "TANH": _unop(jnp.tanh),
+    "HARD_SWISH": _unop(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0),
+    "EXP": _unop(jnp.exp),
+    "LOG": _unop(jnp.log),
+    "SQRT": _unop(jnp.sqrt),
+    "RSQRT": _unop(lambda x: 1.0 / jnp.sqrt(x)),
+    "SQUARE": _unop(jnp.square),
+    "ABS": _unop(jnp.abs),
+    "NEG": _unop(jnp.negative),
+    "SIN": _unop(jnp.sin),
+    "SOFTMAX": _op_softmax,
+    "RESHAPE": _op_reshape,
+    "CONCATENATION": _op_concat,
+    "PAD": _op_pad,
+    "MIRROR_PAD": _op_mirror_pad,
+    "MEAN": _reduce(jnp.mean),
+    "SUM": _reduce(jnp.sum),
+    "REDUCE_MAX": _reduce(jnp.max),
+    "REDUCE_MIN": _reduce(jnp.min),
+    "REDUCE_PROD": _reduce(jnp.prod),
+    "STRIDED_SLICE": _op_strided_slice,
+    "SLICE": _op_slice,
+    "TRANSPOSE": _op_transpose,
+    "RESIZE_BILINEAR": _op_resize_bilinear,
+    "RESIZE_NEAREST_NEIGHBOR": _op_resize_nearest,
+    "SQUEEZE": _op_squeeze,
+    "EXPAND_DIMS": _op_expand_dims,
+    "SHAPE": _op_shape,
+    "BROADCAST_ARGS": _op_broadcast_args,
+    "BROADCAST_TO": _op_broadcast_to,
+    "BATCH_MATMUL": _op_batch_matmul,
+    "CAST": _op_cast,
+    "ARG_MAX": _op_arg_max,
+    "ARG_MIN": _op_arg_min,
+    "GATHER": _op_gather,
+    "PACK": _op_pack,
+    "UNPACK": _op_unpack,
+    "SPLIT": _op_split,
+    "SPLIT_V": _op_split_v,
+    "TILE": _op_tile,
+    "SPACE_TO_DEPTH": _op_space_to_depth,
+    "DEPTH_TO_SPACE": _op_depth_to_space,
+    "L2_NORMALIZATION": _op_l2_norm,
+    "PRELU": _op_prelu,
+    "LEAKY_RELU": _op_leaky_relu,
+    "DEQUANTIZE": _op_dequantize,
+    "QUANTIZE": _op_quantize,
+}
+
+
+def lower_tflite(model: TFLiteModel, jit: bool = True,
+                 fake_quant: bool = True) -> Callable:
+    """Build a callable ``fn(*inputs) -> tuple(outputs)`` from the graph.
+
+    Inputs/outputs follow the model's declared dtypes (quantized models
+    take/return uint8/int8).  With ``jit=True`` the whole graph compiles
+    into one XLA program.
+    """
+    lowering = _Lowering(model, fake_quant=fake_quant)
+    return jax.jit(lowering) if jit else lowering

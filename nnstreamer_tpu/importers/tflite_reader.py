@@ -1,0 +1,500 @@
+"""TFLite flatbuffer reader — no TensorFlow dependency.
+
+Parses ``.tflite`` files directly against the public TFLite schema
+(``tensorflow/lite/schema/schema.fbs``, file identifier ``TFL3``) using
+the stock ``flatbuffers`` Python runtime's generic ``Table`` accessors —
+the same machinery flatc-generated readers are sugar over.  The vtable
+slot numbers below follow the schema's field declaration order, which is
+what flatc assigns and is frozen by TFLite's compatibility guarantee.
+
+Reference capability being replaced:
+``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc:158-276``
+(TFLiteInterpreter wraps the TFLite C++ interpreter).  Here the file is
+parsed in-process and lowered to jnp (see ``tflite_lower.py``) so the
+model runs on TPU through XLA instead of a CPU interpreter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import flatbuffers.number_types as N
+from flatbuffers import encode
+from flatbuffers.table import Table
+
+FILE_IDENTIFIER = b"TFL3"
+
+# -- schema enums -----------------------------------------------------------
+
+# TensorType (schema.fbs)
+TENSOR_DTYPES = {
+    0: "float32", 1: "float16", 2: "int32", 3: "uint8", 4: "int64",
+    5: "string", 6: "bool", 7: "int16", 8: "complex64", 9: "int8",
+    10: "float64", 11: "complex128", 12: "uint64", 13: "resource",
+    14: "variant", 15: "uint32", 16: "uint16", 17: "int4",
+}
+
+# BuiltinOperator — names for the codes the lowerer handles (plus a few
+# neighbours so error messages for unsupported models are readable)
+BUILTIN_OPS = {
+    0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
+    4: "DEPTHWISE_CONV_2D", 5: "DEPTH_TO_SPACE", 6: "DEQUANTIZE",
+    9: "FULLY_CONNECTED", 11: "L2_NORMALIZATION", 14: "LOGISTIC",
+    17: "MAX_POOL_2D", 18: "MUL", 19: "RELU", 20: "RELU_N1_TO_1",
+    21: "RELU6", 22: "RESHAPE", 23: "RESIZE_BILINEAR", 25: "SOFTMAX",
+    26: "SPACE_TO_DEPTH", 28: "TANH", 32: "CUSTOM", 34: "PAD",
+    36: "GATHER", 39: "TRANSPOSE", 40: "MEAN", 41: "SUB", 42: "DIV",
+    43: "SQUEEZE", 45: "STRIDED_SLICE", 47: "EXP", 49: "SPLIT",
+    53: "CAST", 54: "PRELU", 55: "MAXIMUM", 56: "ARG_MAX", 57: "MINIMUM",
+    59: "NEG", 61: "GREATER", 65: "SLICE", 66: "SIN", 67: "TRANSPOSE_CONV",
+    69: "TILE", 70: "EXPAND_DIMS", 71: "EQUAL", 73: "LOG", 74: "SUM",
+    75: "SQRT", 76: "RSQRT", 77: "SHAPE", 78: "POW", 79: "ARG_MIN",
+    81: "REDUCE_PROD", 82: "REDUCE_MAX", 83: "PACK", 88: "UNPACK",
+    89: "REDUCE_MIN", 90: "FLOOR_DIV", 92: "SQUARE", 97: "RESIZE_NEAREST_NEIGHBOR",
+    98: "LEAKY_RELU", 99: "SQUARED_DIFFERENCE", 100: "MIRROR_PAD",
+    101: "ABS", 102: "SPLIT_V", 114: "QUANTIZE", 117: "HARD_SWISH",
+    126: "BATCH_MATMUL", 130: "BROADCAST_TO", 145: "BROADCAST_ARGS",
+}
+
+PADDING = {0: "SAME", 1: "VALID"}
+ACTIVATIONS = {0: None, 1: "relu", 2: "relu_n1_to_1", 3: "relu6",
+               4: "tanh", 5: "sign_bit"}
+
+# -- generic flatbuffer field helpers --------------------------------------
+
+def _vt(slot: int) -> int:
+    return 4 + 2 * slot
+
+
+def _scalar(t: Table, slot: int, flags, default):
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return default
+    return t.Get(flags, t.Pos + o)
+
+
+def _string(t: Table, slot: int) -> Optional[str]:
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return None
+    return t.String(t.Pos + o).decode("utf-8", "replace")
+
+
+def _table(t: Table, slot: int) -> Optional[Table]:
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return None
+    return Table(t.Bytes, t.Indirect(t.Pos + o))
+
+
+def _union_table(t: Table, slot: int) -> Optional[Table]:
+    """A union *value* field: stored like a table offset."""
+    return _table(t, slot)
+
+
+def _vec_np(t: Table, slot: int, flags) -> np.ndarray:
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return np.zeros(0, N.to_numpy_type(flags))
+    return t.GetVectorAsNumpy(flags, o)
+
+
+def _vec_tables(t: Table, slot: int) -> List[Table]:
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return []
+    n = t.VectorLen(o)
+    start = t.Vector(o)
+    return [Table(t.Bytes, t.Indirect(start + 4 * j)) for j in range(n)]
+
+
+def _vec_bytes_zero_copy(t: Table, slot: int) -> Optional[memoryview]:
+    """[ubyte] vector as a zero-copy view into the file buffer."""
+    o = t.Offset(_vt(slot))
+    if o == 0:
+        return None
+    n = t.VectorLen(o)
+    start = t.Vector(o)
+    return memoryview(t.Bytes)[start:start + n]
+
+
+# -- parsed-model dataclasses ----------------------------------------------
+
+@dataclass
+class QuantParams:
+    scale: np.ndarray          # per-tensor (len 1) or per-channel
+    zero_point: np.ndarray
+    quantized_dimension: int = 0
+
+    @property
+    def per_channel(self) -> bool:
+        return self.scale.size > 1
+
+
+@dataclass
+class TFLTensor:
+    index: int
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+    buffer: int
+    quant: Optional[QuantParams] = None
+    data: Optional[np.ndarray] = None   # constant data (None for activations)
+
+    @property
+    def is_const(self) -> bool:
+        return self.data is not None
+
+
+@dataclass
+class TFLOp:
+    opcode: str
+    inputs: List[int]           # tensor indices; -1 = optional-absent
+    outputs: List[int]
+    options: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TFLiteModel:
+    version: int
+    description: str
+    tensors: List[TFLTensor]
+    inputs: List[int]
+    outputs: List[int]
+    ops: List[TFLOp]
+
+    def op_histogram(self) -> Dict[str, int]:
+        h: Dict[str, int] = {}
+        for op in self.ops:
+            h[op.opcode] = h.get(op.opcode, 0) + 1
+        return h
+
+
+# -- options decoding -------------------------------------------------------
+# Each decoder maps (options Table) -> dict of the fields the lowerer uses.
+# Slot numbers are the schema declaration order of each options table.
+
+def _opt_conv2d(t: Table) -> Dict[str, Any]:
+    return {
+        "padding": PADDING[_scalar(t, 0, N.Int8Flags, 0)],
+        "stride_w": _scalar(t, 1, N.Int32Flags, 1) or 1,
+        "stride_h": _scalar(t, 2, N.Int32Flags, 1) or 1,
+        "activation": ACTIVATIONS.get(_scalar(t, 3, N.Int8Flags, 0)),
+        "dilation_w": _scalar(t, 4, N.Int32Flags, 1) or 1,
+        "dilation_h": _scalar(t, 5, N.Int32Flags, 1) or 1,
+    }
+
+
+def _opt_depthwise(t: Table) -> Dict[str, Any]:
+    return {
+        "padding": PADDING[_scalar(t, 0, N.Int8Flags, 0)],
+        "stride_w": _scalar(t, 1, N.Int32Flags, 1) or 1,
+        "stride_h": _scalar(t, 2, N.Int32Flags, 1) or 1,
+        "depth_multiplier": _scalar(t, 3, N.Int32Flags, 1) or 1,
+        "activation": ACTIVATIONS.get(_scalar(t, 4, N.Int8Flags, 0)),
+        "dilation_w": _scalar(t, 5, N.Int32Flags, 1) or 1,
+        "dilation_h": _scalar(t, 6, N.Int32Flags, 1) or 1,
+    }
+
+
+def _opt_pool2d(t: Table) -> Dict[str, Any]:
+    return {
+        "padding": PADDING[_scalar(t, 0, N.Int8Flags, 0)],
+        "stride_w": _scalar(t, 1, N.Int32Flags, 1) or 1,
+        "stride_h": _scalar(t, 2, N.Int32Flags, 1) or 1,
+        "filter_w": _scalar(t, 3, N.Int32Flags, 1) or 1,
+        "filter_h": _scalar(t, 4, N.Int32Flags, 1) or 1,
+        "activation": ACTIVATIONS.get(_scalar(t, 5, N.Int8Flags, 0)),
+    }
+
+
+def _opt_fully_connected(t: Table) -> Dict[str, Any]:
+    return {
+        "activation": ACTIVATIONS.get(_scalar(t, 0, N.Int8Flags, 0)),
+        "weights_format": _scalar(t, 1, N.Int8Flags, 0),
+        "keep_num_dims": bool(_scalar(t, 2, N.BoolFlags, 0)),
+    }
+
+
+def _opt_softmax(t: Table) -> Dict[str, Any]:
+    return {"beta": _scalar(t, 0, N.Float32Flags, 1.0) or 1.0}
+
+
+def _opt_activation_only(t: Table) -> Dict[str, Any]:
+    return {"activation": ACTIVATIONS.get(_scalar(t, 0, N.Int8Flags, 0))}
+
+
+def _opt_reshape(t: Table) -> Dict[str, Any]:
+    return {"new_shape": _vec_np(t, 0, N.Int32Flags).tolist()}
+
+
+def _opt_concat(t: Table) -> Dict[str, Any]:
+    return {
+        "axis": _scalar(t, 0, N.Int32Flags, 0),
+        "activation": ACTIVATIONS.get(_scalar(t, 1, N.Int8Flags, 0)),
+    }
+
+
+def _opt_reducer(t: Table) -> Dict[str, Any]:
+    return {"keep_dims": bool(_scalar(t, 0, N.BoolFlags, 0))}
+
+
+def _opt_strided_slice(t: Table) -> Dict[str, Any]:
+    return {
+        "begin_mask": _scalar(t, 0, N.Int32Flags, 0),
+        "end_mask": _scalar(t, 1, N.Int32Flags, 0),
+        "ellipsis_mask": _scalar(t, 2, N.Int32Flags, 0),
+        "new_axis_mask": _scalar(t, 3, N.Int32Flags, 0),
+        "shrink_axis_mask": _scalar(t, 4, N.Int32Flags, 0),
+    }
+
+
+def _opt_resize_bilinear(t: Table) -> Dict[str, Any]:
+    return {
+        "align_corners": bool(_scalar(t, 2, N.BoolFlags, 0)),
+        "half_pixel_centers": bool(_scalar(t, 3, N.BoolFlags, 0)),
+    }
+
+
+def _opt_resize_nearest(t: Table) -> Dict[str, Any]:
+    return {
+        "align_corners": bool(_scalar(t, 0, N.BoolFlags, 0)),
+        "half_pixel_centers": bool(_scalar(t, 1, N.BoolFlags, 0)),
+    }
+
+
+def _opt_leaky_relu(t: Table) -> Dict[str, Any]:
+    return {"alpha": _scalar(t, 0, N.Float32Flags, 0.0)}
+
+
+def _opt_pack(t: Table) -> Dict[str, Any]:
+    return {"values_count": _scalar(t, 0, N.Int32Flags, 0),
+            "axis": _scalar(t, 1, N.Int32Flags, 0)}
+
+
+def _opt_unpack(t: Table) -> Dict[str, Any]:
+    return {"num": _scalar(t, 0, N.Int32Flags, 0),
+            "axis": _scalar(t, 1, N.Int32Flags, 0)}
+
+
+def _opt_gather(t: Table) -> Dict[str, Any]:
+    return {"axis": _scalar(t, 0, N.Int32Flags, 0),
+            "batch_dims": _scalar(t, 1, N.Int32Flags, 0)}
+
+
+def _opt_arg_minmax(t: Table) -> Dict[str, Any]:
+    return {"output_type": TENSOR_DTYPES.get(
+        _scalar(t, 0, N.Int8Flags, 4), "int64")}
+
+
+def _opt_split(t: Table) -> Dict[str, Any]:
+    return {"num_splits": _scalar(t, 0, N.Int32Flags, 0)}
+
+
+def _opt_squeeze(t: Table) -> Dict[str, Any]:
+    return {"squeeze_dims": _vec_np(t, 0, N.Int32Flags).tolist()}
+
+
+def _opt_cast(t: Table) -> Dict[str, Any]:
+    return {
+        "in_dtype": TENSOR_DTYPES.get(_scalar(t, 0, N.Int8Flags, 0)),
+        "out_dtype": TENSOR_DTYPES.get(_scalar(t, 1, N.Int8Flags, 0)),
+    }
+
+
+def _opt_space_depth(t: Table) -> Dict[str, Any]:
+    return {"block_size": _scalar(t, 0, N.Int32Flags, 0)}
+
+
+def _opt_mirror_pad(t: Table) -> Dict[str, Any]:
+    return {"mode": {0: "reflect", 1: "symmetric"}[_scalar(t, 0, N.Int8Flags, 0)]}
+
+
+def _opt_transpose_conv(t: Table) -> Dict[str, Any]:
+    return {
+        "padding": PADDING[_scalar(t, 0, N.Int8Flags, 0)],
+        "stride_w": _scalar(t, 1, N.Int32Flags, 1) or 1,
+        "stride_h": _scalar(t, 2, N.Int32Flags, 1) or 1,
+        "activation": ACTIVATIONS.get(_scalar(t, 3, N.Int8Flags, 0)),
+    }
+
+
+def _opt_shape(t: Table) -> Dict[str, Any]:
+    return {"out_dtype": TENSOR_DTYPES.get(_scalar(t, 0, N.Int8Flags, 2), "int32")}
+
+
+# opcode name -> options decoder (the BuiltinOptions union member that
+# accompanies each op is fixed by the schema, so dispatching on the
+# opcode is equivalent to dispatching on builtin_options_type)
+_OPT_DECODERS = {
+    "CONV_2D": _opt_conv2d,
+    "DEPTHWISE_CONV_2D": _opt_depthwise,
+    "AVERAGE_POOL_2D": _opt_pool2d,
+    "MAX_POOL_2D": _opt_pool2d,
+    "FULLY_CONNECTED": _opt_fully_connected,
+    "SOFTMAX": _opt_softmax,
+    "ADD": _opt_activation_only,
+    "SUB": _opt_activation_only,
+    "MUL": _opt_activation_only,
+    "DIV": _opt_activation_only,
+    "L2_NORMALIZATION": _opt_activation_only,
+    "RESHAPE": _opt_reshape,
+    "CONCATENATION": _opt_concat,
+    "MEAN": _opt_reducer,
+    "SUM": _opt_reducer,
+    "REDUCE_MAX": _opt_reducer,
+    "REDUCE_MIN": _opt_reducer,
+    "REDUCE_PROD": _opt_reducer,
+    "STRIDED_SLICE": _opt_strided_slice,
+    "RESIZE_BILINEAR": _opt_resize_bilinear,
+    "RESIZE_NEAREST_NEIGHBOR": _opt_resize_nearest,
+    "LEAKY_RELU": _opt_leaky_relu,
+    "PACK": _opt_pack,
+    "UNPACK": _opt_unpack,
+    "GATHER": _opt_gather,
+    "ARG_MAX": _opt_arg_minmax,
+    "ARG_MIN": _opt_arg_minmax,
+    "SPLIT": _opt_split,
+    "SQUEEZE": _opt_squeeze,
+    "CAST": _opt_cast,
+    "SPACE_TO_DEPTH": _opt_space_depth,
+    "DEPTH_TO_SPACE": _opt_space_depth,
+    "MIRROR_PAD": _opt_mirror_pad,
+    "TRANSPOSE_CONV": _opt_transpose_conv,
+    "SHAPE": _opt_shape,
+}
+
+
+# -- top-level parse --------------------------------------------------------
+
+class TFLiteParseError(ValueError):
+    pass
+
+
+class _EmptyTable:
+    """Stand-in for an omitted options table: every field reads as absent,
+    so decoders produce the schema defaults."""
+
+    Bytes = b"\x00" * 8
+    Pos = 4
+
+    def Offset(self, _vt):
+        return 0
+
+
+_EMPTY_TABLE = _EmptyTable()
+
+
+def _parse_quant(t: Optional[Table]) -> Optional[QuantParams]:
+    if t is None:
+        return None
+    scale = _vec_np(t, 2, N.Float32Flags)
+    zp = _vec_np(t, 3, N.Int64Flags)
+    if scale.size == 0:
+        return None
+    if zp.size == 0:
+        zp = np.zeros_like(scale, dtype=np.int64)
+    return QuantParams(
+        scale=scale.astype(np.float32),
+        zero_point=zp.astype(np.int64),
+        quantized_dimension=_scalar(t, 6, N.Int32Flags, 0),
+    )
+
+
+def read_tflite(path_or_bytes, subgraph: int = 0) -> TFLiteModel:
+    """Parse a .tflite file (or bytes) into a TFLiteModel."""
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        buf = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            buf = f.read()
+    if len(buf) < 8:
+        raise TFLiteParseError("file too small to be a tflite flatbuffer")
+    if buf[4:8] != FILE_IDENTIFIER:
+        raise TFLiteParseError(
+            f"bad file identifier {buf[4:8]!r} (expected {FILE_IDENTIFIER!r})")
+
+    root = Table(buf, encode.Get(N.UOffsetTFlags.packer_type, buf, 0))
+    version = _scalar(root, 0, N.Uint32Flags, 0)
+
+    # operator_codes: resolve each to a builtin name.  Newer files put the
+    # code in the int32 `builtin_code` field (slot 3) and clamp the legacy
+    # int8 field (slot 0) at 127; `max` of the two is the documented rule.
+    opcodes: List[str] = []
+    for oc in _vec_tables(root, 1):
+        legacy = _scalar(oc, 0, N.Int8Flags, 0)
+        modern = _scalar(oc, 3, N.Int32Flags, 0)
+        code = max(int(legacy), int(modern))
+        name = BUILTIN_OPS.get(code)
+        if name is None:
+            name = f"BUILTIN_{code}"
+        if name == "CUSTOM":
+            name = f"CUSTOM:{_string(oc, 1) or '?'}"
+        opcodes.append(name)
+
+    buffers = _vec_tables(root, 4)
+    subgraphs = _vec_tables(root, 2)
+    if not subgraphs:
+        raise TFLiteParseError("model has no subgraphs")
+    if subgraph >= len(subgraphs):
+        raise TFLiteParseError(
+            f"subgraph {subgraph} out of range ({len(subgraphs)} present)")
+    sg = subgraphs[subgraph]
+
+    tensors: List[TFLTensor] = []
+    for i, tt in enumerate(_vec_tables(sg, 0)):
+        shape = tuple(int(x) for x in _vec_np(tt, 0, N.Int32Flags))
+        dtype_code = _scalar(tt, 1, N.Int8Flags, 0)
+        dtype = TENSOR_DTYPES.get(dtype_code)
+        if dtype is None:
+            raise TFLiteParseError(
+                f"tensor {i}: unknown TensorType code {dtype_code}")
+        buf_idx = _scalar(tt, 2, N.Uint32Flags, 0)
+        data = None
+        if 0 < buf_idx < len(buffers):
+            raw = _vec_bytes_zero_copy(buffers[buf_idx], 0)
+            if raw is not None and len(raw) > 0:
+                if dtype in ("string", "resource", "variant"):
+                    raise TFLiteParseError(
+                        f"tensor {i}: unsupported constant dtype {dtype}")
+                arr = np.frombuffer(raw, dtype=np.dtype(dtype))
+                data = arr.reshape(shape) if shape else arr.reshape(())
+        tensors.append(TFLTensor(
+            index=i,
+            name=_string(tt, 3) or f"t{i}",
+            shape=shape,
+            dtype=dtype,
+            buffer=buf_idx,
+            quant=_parse_quant(_table(tt, 4)),
+            data=data,
+        ))
+
+    ops: List[TFLOp] = []
+    for ot in _vec_tables(sg, 3):
+        idx = _scalar(ot, 0, N.Uint32Flags, 0)
+        if idx >= len(opcodes):
+            raise TFLiteParseError(f"opcode index {idx} out of range")
+        name = opcodes[idx]
+        decoder = _OPT_DECODERS.get(name)
+        options: Dict[str, Any] = {}
+        if decoder is not None:
+            opt_table = _union_table(ot, 4)
+            options = decoder(opt_table if opt_table is not None
+                              else _EMPTY_TABLE)
+        ops.append(TFLOp(
+            opcode=name,
+            inputs=[int(x) for x in _vec_np(ot, 1, N.Int32Flags)],
+            outputs=[int(x) for x in _vec_np(ot, 2, N.Int32Flags)],
+            options=options,
+        ))
+
+    return TFLiteModel(
+        version=version,
+        description=_string(root, 3) or "",
+        tensors=tensors,
+        inputs=[int(x) for x in _vec_np(sg, 1, N.Int32Flags)],
+        outputs=[int(x) for x in _vec_np(sg, 2, N.Int32Flags)],
+        ops=ops,
+    )

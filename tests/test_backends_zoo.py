@@ -142,15 +142,14 @@ def test_torch_backend_in_pipeline_auto(torchscript_model):
     np.testing.assert_allclose(pipe["out"].frames[0].tensors[0], 3.0)
 
 
-# -- tflite backend (gated) ---------------------------------------------------
+# -- tflite backend (real importer since round 4) -----------------------------
 
-def test_tflite_backend_gates_cleanly():
-    from nnstreamer_tpu.backends.tflite_import import TFLiteImportBackend
-    be = TFLiteImportBackend()
-    if TFLiteImportBackend.available():
-        pytest.skip("tflite runtime present; gating path not applicable")
-    with pytest.raises(RuntimeError, match="no TFLite runtime"):
-        be.open("model.tflite", {})
+def test_tflite_backend_rejects_non_tflite():
+    from nnstreamer_tpu.backends.tflite_import import TFLiteBackend
+    from nnstreamer_tpu.importers.tflite_reader import TFLiteParseError
+    be = TFLiteBackend()
+    with pytest.raises((TFLiteParseError, FileNotFoundError, ValueError)):
+        be.open(__file__, {})  # a .py file is not a tflite flatbuffer
 
 
 # -- custom native (.so over the C ABI) --------------------------------------

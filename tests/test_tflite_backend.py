@@ -1,38 +1,27 @@
-"""tflite backend: real .tflite models through the interpreter runtime.
+"""tflite backend: .tflite models through pipelines, on the XLA lowering.
 
-≙ reference ``tests/nnstreamer_filter_tensorflow2_lite/runTest.sh`` —
-skips gracefully when no TFLite runtime is present (SURVEY §4 practice),
-runs a real converted model otherwise.
+≙ reference ``tests/nnstreamer_filter_tensorflow2_lite/runTest.sh``
+(explicit framework=, framework=auto detection, single-shot invoke,
+model info) — but the backend lowers the flatbuffer to JAX in-process
+(``backends/tflite_import.py``); no TFLite runtime exists or is needed.
 """
 
 import numpy as np
 import pytest
 
-from nnstreamer_tpu.backends.tflite_import import TFLiteImportBackend
+from nnstreamer_tpu.backends.tflite_import import TFLiteBackend
 from nnstreamer_tpu.elements.filter import SingleShot, detect_framework
 from nnstreamer_tpu.pipeline import parse_pipeline
 
-pytestmark = pytest.mark.skipif(
-    not TFLiteImportBackend.available(), reason="no TFLite runtime in image"
-)
+from test_tflite_import import (
+    MOBILENET_QUANT, MODELS, build_affine_tflite, needs_ref_models)
 
 
 @pytest.fixture(scope="module")
 def tflite_model(tmp_path_factory):
-    """A tiny y = 2x + 1 model converted to .tflite."""
-    import tensorflow as tf
-
-    class M(tf.Module):
-        @tf.function(input_signature=[tf.TensorSpec((1, 4), tf.float32)])
-        def f(self, x):
-            return {"y": x * 2.0 + 1.0}
-
-    m = M()
-    conv = tf.lite.TFLiteConverter.from_concrete_functions(
-        [m.f.get_concrete_function()], m
-    )
+    """y = 2x + 1 on (1, 4) float32, built with the flatbuffers Builder."""
     path = tmp_path_factory.mktemp("tfl") / "affine.tflite"
-    path.write_bytes(conv.convert())
+    path.write_bytes(build_affine_tflite())
     return str(path)
 
 
@@ -54,7 +43,7 @@ class TestTFLiteBackend:
 
     def test_framework_auto_detects_tflite(self, tflite_model):
         # no arch: custom prop -> jax-xla cannot load a raw .tflite, so
-        # extension priority falls through to the tflite runtime
+        # extension priority falls through to the importer backend
         assert detect_framework(tflite_model) == "tflite"
 
     def test_single_shot(self, tflite_model):
@@ -63,9 +52,85 @@ class TestTFLiteBackend:
             np.testing.assert_allclose(np.asarray(out), np.ones((1, 4)))
 
     def test_model_info(self, tflite_model):
-        be = TFLiteImportBackend()
+        be = TFLiteBackend()
         be.open(tflite_model, {})
         in_spec, out_spec = be.get_model_info()
         assert in_spec.tensors[0].shape == (1, 4)
         assert out_spec.tensors[0].shape == (1, 4)
         be.close()
+
+    def test_invoke_batch_vmaps(self, tflite_model):
+        """Micro-batched frames (extra leading axis) go through one vmapped
+        XLA call and match per-frame results."""
+        be = TFLiteBackend()
+        be.open(tflite_model, {})
+        try:
+            xs = np.stack([np.full((1, 4), float(i), np.float32)
+                           for i in range(5)])          # (5, 1, 4)
+            (out,) = be.invoke_batch([xs])
+            out = np.asarray(out)
+            assert out.shape == (5, 1, 4)
+            np.testing.assert_allclose(out, xs * 2 + 1)
+        finally:
+            be.close()
+
+    def test_reload_double_buffered(self, tflite_model, tmp_path):
+        """reload() swaps to a different .tflite without reopening."""
+        import flatbuffers
+        from test_tflite_import import (
+            _buffer, _ivec, _model, _opcode, _operator, _subgraph,
+            _tensor, _F32, _MUL)
+
+        b = flatbuffers.Builder(1024)
+        bufs = [_buffer(b, b""),
+                _buffer(b, np.full(4, 5.0, np.float32).tobytes())]
+        tens = [_tensor(b, (1, 4), _F32, 0, "x"),
+                _tensor(b, (1, 4), _F32, 1, "w"),
+                _tensor(b, (1, 4), _F32, 0, "y")]
+        ops = [_operator(b, 0, [0, 1], [2])]
+        sg = _subgraph(b, tens, [0], [2], ops)
+        b.Finish(_model(b, [_opcode(b, _MUL)], [sg], bufs),
+                 file_identifier=b"TFL3")
+        other = tmp_path / "times5.tflite"
+        other.write_bytes(bytes(b.Output()))
+
+        be = TFLiteBackend()
+        be.open(tflite_model, {})
+        try:
+            x = np.ones((1, 4), np.float32)
+            np.testing.assert_allclose(np.asarray(be.invoke([x])[0]),
+                                       np.full((1, 4), 3.0))
+            be.reload(str(other))
+            np.testing.assert_allclose(np.asarray(be.invoke([x])[0]),
+                                       np.full((1, 4), 5.0))
+        finally:
+            be.close()
+
+
+@needs_ref_models
+class TestTFLiteRealModels:
+    def test_mobilenet_quant_pipeline(self):
+        """The reference's flagship quant model end-to-end in a pipeline:
+        uint8 image in, uint8 scores out, image_labeling-compatible."""
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_filter framework=tflite "
+            f"model={MOBILENET_QUANT} ! tensor_sink name=out"
+        )
+        pipe.start()
+        img = np.random.default_rng(0).integers(
+            0, 256, (1, 224, 224, 3), np.uint8)
+        pipe["src"].push(img)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=300)
+        frames = pipe["out"].frames
+        pipe.stop()
+        out = np.asarray(frames[0].tensors[0])
+        assert out.shape == (1, 1001) and out.dtype == np.uint8
+
+    def test_singleshot_fake_quant_prop(self):
+        with SingleShot("tflite", MOBILENET_QUANT,
+                        custom="fake_quant:false") as m:
+            img = np.random.default_rng(1).integers(
+                0, 256, (1, 224, 224, 3), np.uint8)
+            (out,) = m.invoke([img])
+            assert np.asarray(out).shape == (1, 1001)
