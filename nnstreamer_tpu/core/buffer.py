@@ -132,6 +132,21 @@ class BatchFrame(TensorFrame):
         ]
 
 
+def start_host_copies(tensors: Sequence[Any]) -> None:
+    """Kick off async device->host copies for every device tensor (no-op
+    for host arrays).  Callers that park outputs (the filter's dispatch
+    window) call this at park time so the transfer overlaps later
+    compute; :func:`materialize` calls it so N outputs cost ~one round
+    trip instead of N serialized ones."""
+    for t in tensors:
+        start = getattr(t, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:
+                pass  # stale/donated buffer: np.asarray later decides
+
+
 def materialize(tensors: Sequence[Any]) -> List[np.ndarray]:
     """Bring a tensor list to host, overlapping the transfers.
 
@@ -140,13 +155,7 @@ def materialize(tensors: Sequence[Any]) -> List[np.ndarray]:
     outputs cost ~one round trip instead of N serialized ones — a hidden
     per-batch cost on every host boundary (BatchFrame.split, the unfused
     micro-batch path, sinks)."""
-    for t in tensors:
-        start = getattr(t, "copy_to_host_async", None)
-        if start is not None:
-            try:
-                start()
-            except Exception:
-                pass  # stale/donated buffer: np.asarray below decides
+    start_host_copies(tensors)
     return [np.asarray(t) for t in tensors]
 
 

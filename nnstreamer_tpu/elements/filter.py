@@ -30,7 +30,7 @@ import numpy as np
 from ..backends.base import FilterBackend, find_backend, parse_accelerator
 from ..core import config as nns_config
 from ..core import registry
-from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
+from ..core.buffer import BatchFrame, CustomEvent, Flush, TensorFrame
 from ..core.model_uri import resolve_model_uri
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import ElementError, Property, TransformElement, element
@@ -176,6 +176,12 @@ class TensorFilter(TransformElement):
         "batch-timeout": Property(
             int, 0, "ms to wait filling a micro-batch (0 = only drain queued)"
         ),
+        "dispatch-depth": Property(
+            int, 4,
+            "micro-batches kept in flight before blocking on the oldest "
+            "(JAX async dispatch: batch k+1 is stacked and dispatched while "
+            "k still computes/transfers; 1 = synchronous)",
+        ),
         # ≙ GstShark/NNShark tracing (SURVEY §5.1) done the XLA-native way
         "trace": Property(int, 0, "1 = capture a jax.profiler trace while running"),
         "trace-dir": Property(str, "/tmp/nns_tpu_trace", "profiler output dir"),
@@ -203,6 +209,9 @@ class TensorFilter(TransformElement):
         # set by the pipeline's device-fusion pass (NOT the user prop, so a
         # restart without the pass re-fusing leaves the chain unfused)
         self._auto_batch_through = False
+        # in-flight micro-batches: (device outputs, source frames) awaiting
+        # materialization (the depth-N dispatch window, VERDICT r3 #2)
+        self._inflight: deque = deque()
 
     @property
     def batch_through_active(self) -> bool:
@@ -310,6 +319,7 @@ class TensorFilter(TransformElement):
             self._tracing = trace_start(self.props["trace-dir"])
 
     def stop(self) -> None:
+        self._inflight.clear()
         if getattr(self, "_tracing", False):
             from ..core.profiler import trace_stop
 
@@ -426,7 +436,11 @@ class TensorFilter(TransformElement):
         """Micro-batched path: scheduler hands N frames; one invoke_batch."""
         assert self.backend is not None
         if len(frames) == 1:
-            return [(0, self.transform(frames[0]))]
+            # queue-starved moment: drain the in-flight window first so
+            # this frame cannot overtake older parked batches
+            results = self._drain_inflight()
+            results.append((0, self.transform(frames[0])))
+            return results
         comb = self._in_comb
         per_frame = [
             [f.tensors[i] for _, i in comb] if comb else list(f.tensors) for f in frames
@@ -447,8 +461,36 @@ class TensorFilter(TransformElement):
             # Downstream (fused decoder / chained filter / sink) splits or
             # materializes at the real host boundary.
             return [(0, BatchFrame.from_frames(out_b, frames))]
-        # one overlapped device->host transfer pass for all output tensors
-        # (not per frame), then zero-copy numpy views per frame
+        # depth-N in-flight dispatch: park this batch's (async) device
+        # outputs and only block on the OLDEST once the window is full —
+        # stacking/dispatching batch k+1 then overlaps batch k's compute
+        # and its device->host transfer (started async below).  The raw
+        # benchmark sustains its rate at exactly this structure
+        # (bench.py BENCH_RAW depth-4); the reference's steady state is
+        # synchronous map->invoke->append (tensor_filter.c:642-930).
+        depth = max(1, int(self.props["dispatch-depth"]))
+        if depth > 1 and any(
+            hasattr(o, "copy_to_host_async") for o in out_b
+        ):
+            from ..core.buffer import start_host_copies
+
+            start_host_copies(out_b)
+            self._inflight.append((out_b, frames))
+            results: List[Tuple[int, TensorFrame]] = []
+            while len(self._inflight) > depth - 1:
+                results.extend(self._emit_oldest_inflight())
+            return results
+        # synchronous path: drain any batches parked while the window was
+        # active (depth lowered mid-stream / backend change) first, so the
+        # current batch cannot overtake them
+        return self._drain_inflight() + self._emit_batch(out_b, frames)
+
+    def _emit_batch(
+        self, out_b: List[Any], frames: List[TensorFrame]
+    ) -> List[Tuple[int, TensorFrame]]:
+        """Materialize one micro-batch's outputs (one overlapped
+        device->host pass for all tensors, then zero-copy views per
+        frame)."""
         from ..core.buffer import materialize
 
         out_np = materialize(out_b)
@@ -460,8 +502,36 @@ class TensorFilter(TransformElement):
             )
         return results
 
+    def _emit_oldest_inflight(self) -> List[Tuple[int, TensorFrame]]:
+        out_b, frames = self._inflight.popleft()
+        return self._emit_batch(out_b, frames)
+
+    def _drain_inflight(self) -> List[Tuple[int, TensorFrame]]:
+        results: List[Tuple[int, TensorFrame]] = []
+        while self._inflight:
+            results.extend(self._emit_oldest_inflight())
+        return results
+
+    def handle_eos(self, pad: int) -> List[Tuple[int, TensorFrame]]:
+        """Drain the in-flight window before EOS propagates."""
+        return self._drain_inflight()
+
+    def handle_idle(self) -> List[Tuple[int, TensorFrame]]:
+        """Scheduler idle hook: the input went quiet, so overlap has
+        nothing left to win — release the parked batches instead of
+        withholding a live stream's tail until the next frame/EOS."""
+        return self._drain_inflight()
+
     # -- events -------------------------------------------------------------
     def handle_event(self, pad, ev):
+        if isinstance(ev, Flush):
+            # a flush drops queued frames; in-flight results are frames too
+            self._inflight.clear()
+            return super().handle_event(pad, ev)
+        # any other in-band event must not overtake parked frames (events
+        # and frames share one ordered queue, core/buffer.py) — emit the
+        # window first, then the event
+        drained = self._drain_inflight()
         if isinstance(ev, CustomEvent) and ev.name == "reload-model":
             # ≙ RELOAD_MODEL framework event (tested by
             # tests/nnstreamer_filter_reload in the reference)
@@ -470,8 +540,8 @@ class TensorFilter(TransformElement):
             elif self.backend is not None:
                 self.backend.reload(ev.data.get("model", self.props["model"]))
                 self.log.info("model reloaded from %s", ev.data.get("model"))
-            return []  # swallow
-        return super().handle_event(pad, ev)
+            return drained  # event swallowed; parked frames still flow
+        return drained + list(super().handle_event(pad, ev) or [])
 
 
 class SingleShot:

@@ -502,6 +502,15 @@ class Pipeline:
                     try:
                         pad, item = el._mailbox.get(timeout=0.1)
                     except queue.Empty:
+                        # idle hook: elements holding deferred output (the
+                        # filter's dispatch window) release it when the
+                        # input goes quiet — a live stream's tail must not
+                        # wait for the next frame or EOS
+                        idle = getattr(el, "handle_idle", None)
+                        if idle is not None:
+                            for sp, out in idle() or []:
+                                if not self._push(el, sp, out):
+                                    return
                         continue
                 if item is _STOP:
                     return

@@ -215,3 +215,137 @@ class TestBatching:
         assert [f.pts for f in outs] == pytest.approx([i * 0.1 for i in range(20)])
         # batching actually engaged: fewer invokes than frames
         assert pipe["f"].backend is None  # stopped
+
+
+class TestDispatchDepth:
+    """Depth-N in-flight dispatch: the filter parks device outputs of up
+    to dispatch-depth micro-batches and only blocks on the oldest, so
+    batch k+1's stack/dispatch overlaps batch k's compute + transfer
+    (VERDICT r3 #2; the reference's steady state is synchronous
+    map->invoke->append, tensor_filter.c:642-930)."""
+
+    @pytest.fixture(autouse=True)
+    def _affine(self):
+        from nnstreamer_tpu.backends.jax_xla import (
+            register_jax_model, unregister_jax_model)
+        register_jax_model(
+            "ddepth_affine", lambda p, xs: [xs[0] * 2.0 + 1.0], None)
+        yield
+        unregister_jax_model("ddepth_affine")
+
+    def _run(self, n, extra=""):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=jax-xla "
+            f"model=ddepth_affine max-batch=4 {extra} ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(n):
+            pipe["src"].push(np.float32([i]), pts=i * 0.01)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        return frames
+
+    def test_order_and_completeness_at_default_depth(self):
+        frames = self._run(50)
+        assert len(frames) == 50
+        assert [float(f.tensors[0][0]) for f in frames] == [
+            2.0 * i + 1.0 for i in range(50)]
+        # pts rides along unchanged through the parked window
+        assert [f.pts for f in frames] == pytest.approx(
+            [i * 0.01 for i in range(50)])
+
+    def test_depth_1_is_synchronous_and_equivalent(self):
+        frames = self._run(30, extra="dispatch-depth=1")
+        assert [float(f.tensors[0][0]) for f in frames] == [
+            2.0 * i + 1.0 for i in range(30)]
+
+    def test_eos_drains_parked_window(self):
+        """With a huge depth the window would hold everything until EOS;
+        every frame must still come out, in order."""
+        frames = self._run(20, extra="dispatch-depth=64")
+        assert [float(f.tensors[0][0]) for f in frames] == [
+            2.0 * i + 1.0 for i in range(20)]
+
+    def test_window_bookkeeping_unit(self):
+        """Direct element-level check that parking happens (no pipeline)."""
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "jax-xla")
+        el.set_property("model", "ddepth_affine")
+        el.set_property("max-batch", 4)
+        el.set_property("dispatch-depth", 3)
+        el.start()
+        try:
+            from nnstreamer_tpu.core.buffer import TensorFrame
+
+            def batch(i0):
+                return [TensorFrame((np.float32([i]),)) for i in range(i0, i0 + 4)]
+
+            out1 = el.handle_frame_batch(0, batch(0))
+            assert out1 == [] and len(el._inflight) == 1
+            out2 = el.handle_frame_batch(0, batch(4))
+            assert out2 == [] and len(el._inflight) == 2
+            out3 = el.handle_frame_batch(0, batch(8))  # window full: emits oldest
+            assert len(out3) == 4 and len(el._inflight) == 2
+            assert [float(f.tensors[0][0]) for _, f in out3] == [1.0, 3.0, 5.0, 7.0]
+            drained = el.handle_eos(0)
+            assert len(drained) == 8 and not el._inflight
+            # flush discards parked frames
+            el.handle_frame_batch(0, batch(12))
+            assert len(el._inflight) == 1
+            from nnstreamer_tpu.core.buffer import Flush
+            el.handle_event(0, Flush())
+            assert not el._inflight
+        finally:
+            el.stop()
+
+    def test_idle_drains_parked_window_without_eos(self):
+        """Live-stream gap: parked batches must flow out on scheduler idle,
+        not wait for the next frame or EOS."""
+        import time as _t
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=jax-xla "
+            "model=ddepth_affine max-batch=4 dispatch-depth=64 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        seen = []
+        pipe["out"].connect_new_data(lambda f: seen.append(float(f.tensors[0][0])))
+        for i in range(12):
+            pipe["src"].push(np.float32([i]))
+        # no EOS: within the idle poll period the window must drain
+        deadline = _t.monotonic() + 10
+        while len(seen) < 12 and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        try:
+            assert seen == [2.0 * i + 1.0 for i in range(12)]
+        finally:
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=10)
+            pipe.stop()
+
+    def test_event_does_not_overtake_parked_frames(self):
+        """A custom event pushed after frames must reach downstream after
+        them even while they are parked in the dispatch window."""
+        from nnstreamer_tpu.core.buffer import CustomEvent, TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "jax-xla")
+        el.set_property("model", "ddepth_affine")
+        el.set_property("max-batch", 4)
+        el.set_property("dispatch-depth", 8)
+        el.start()
+        try:
+            frames = [TensorFrame((np.float32([i]),)) for i in range(4)]
+            assert el.handle_frame_batch(0, frames) == []
+            outs = el.handle_event(0, CustomEvent("app-marker", {}))
+            # parked frames come out BEFORE the (forwarded) event
+            kinds = [type(o).__name__ for _, o in outs]
+            assert kinds[:4] == ["TensorFrame"] * 4
+            assert not el._inflight
+        finally:
+            el.stop()

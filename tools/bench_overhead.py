@@ -81,7 +81,7 @@ def main() -> int:
     pipe = parse_pipeline(
         "appsrc name=src max-buffers=512 ! "
         "tensor_filter name=f framework=jax-xla model=ovh_model "
-        f"max-batch={batch} batch-timeout=20 ! "
+        f"max-batch={batch} batch-timeout=20 dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} ! "
         f"tensor_decoder mode=image_labeling option1={labels} ! "
         "tensor_sink name=out max-stored=1",
         name="overhead",
