@@ -21,6 +21,7 @@ Env knobs:
   BENCH_RAW       1 = also measure the bare jitted model at the same
                   batch (adds raw_fps / pipeline_vs_raw to the row — the
                   framework-overhead contract: pipeline >= 0.9x raw)
+  BENCH_DEPTH     micro-batches kept in flight by the filter (default 4)
   BENCH_PLATFORM  cpu = force CPU (debug; numbers not comparable)
   BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT  backend probe retry knobs
 """
@@ -212,7 +213,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     pipe = parse_pipeline(
         "appsrc name=src max-buffers=512 ! "
         "tensor_filter name=f framework=jax-xla model=bench_model "
-        f"max-batch={batch} batch-timeout=20 latency=1 throughput=1 ! "
+        f"max-batch={batch} batch-timeout=20 latency=1 throughput=1 "
+        f"dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} ! "
         + decoder
         + "tensor_sink name=out max-stored=1",
         name="bench",

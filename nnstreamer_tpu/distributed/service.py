@@ -45,6 +45,10 @@ from .wire import (
 
 log = get_logger("distributed")
 
+
+class CapsMismatch(ValueError):
+    """Client/server schemas parse but do not intersect."""
+
 _ident = lambda b: b  # bytes-in/bytes-out (de)serializers  # noqa: E731
 identity_codec = _ident  # shared by every gRPC element (query/edge/stream)
 GRPC_OPTS = [
@@ -69,31 +73,40 @@ class QueryServerCore:
         self._client_seq = itertools.count(1)
         self.caps: Optional[str] = None  # serversrc announces
         self._server: Optional[grpc.Server] = None
+        self._tcp = None  # raw-TCP transport (tcp_query.TcpQueryServer)
         self.refs = 0
+
+    # -- transport-agnostic handlers ----------------------------------------
+    def check_caps(self, client_caps: str) -> str:
+        """Caps handshake: intersect client/server schemas.  Raises
+        :class:`CapsMismatch` on a genuine schema conflict and plain
+        ``ValueError`` on unparseable caps.  Shared by every transport."""
+        server_caps = self.caps or ""
+        if server_caps and client_caps:
+            a = StreamSpec.from_string(client_caps)
+            b = StreamSpec.from_string(server_caps)
+            if a.intersect(b) is None:
+                raise CapsMismatch(
+                    f"caps mismatch: client {client_caps} "
+                    f"vs server {server_caps}"
+                )
+        return server_caps
 
     # -- rpc handlers -------------------------------------------------------
     def _handshake(self, request: bytes, context) -> bytes:
-        client_caps = request.decode()
-        server_caps = self.caps or ""
-        if server_caps and client_caps:
-            try:
-                a = StreamSpec.from_string(client_caps)
-                b = StreamSpec.from_string(server_caps)
-                if a.intersect(b) is None:
-                    context.abort(
-                        grpc.StatusCode.FAILED_PRECONDITION,
-                        f"caps mismatch: client {client_caps} vs server {server_caps}",
-                    )
-            except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        return server_caps.encode()
+        try:
+            return self.check_caps(request.decode()).encode()
+        except CapsMismatch as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
-    def _invoke(self, request: bytes, context) -> bytes:
-        # wire micro-batch envelope: N frames ride one RPC (amortizes the
-        # per-RPC transport cost); the server pipeline still sees N
-        # ordinary frames, answers are collected back in stream order
-        batched = is_batch_payload(request)
-        frames = decode_frames(request) if batched else [decode_frame(request)]
+    def process(self, frames: List[TensorFrame], timeout: float
+                ) -> List[TensorFrame]:
+        """Route frames through the paired server pipeline and collect the
+        answers in stream order.  Shared by every transport (gRPC unary
+        handler, raw-TCP connection threads).  Raises TimeoutError when
+        the pipeline produces no answer in time."""
         client_id = next(self._client_seq)
         answer_q: "queue.Queue[TensorFrame]" = queue.Queue(len(frames))
         with self._pending_lock:
@@ -102,7 +115,6 @@ class QueryServerCore:
             for frame in frames:
                 frame.meta["client_id"] = client_id
                 self.ingress.put((client_id, frame), timeout=10)
-            timeout = float(context.time_remaining() or 30.0)
             answers = []
             deadline = time.monotonic() + min(timeout, 300.0)
             for _ in frames:
@@ -113,16 +125,28 @@ class QueryServerCore:
                         )
                     )
                 except queue.Empty:
-                    context.abort(
-                        grpc.StatusCode.DEADLINE_EXCEEDED,
-                        "server pipeline produced no answer in time",
-                    )
-            if batched:
-                return encode_frames(answers)
-            return encode_frame(answers[0])
+                    raise TimeoutError(
+                        "server pipeline produced no answer in time"
+                    ) from None
+            return answers
         finally:
             with self._pending_lock:
                 self._pending.pop(client_id, None)
+
+    def _invoke(self, request: bytes, context) -> bytes:
+        # wire micro-batch envelope: N frames ride one RPC (amortizes the
+        # per-RPC transport cost); the server pipeline still sees N
+        # ordinary frames, answers are collected back in stream order
+        batched = is_batch_payload(request)
+        frames = decode_frames(request) if batched else [decode_frame(request)]
+        try:
+            answers = self.process(
+                frames, float(context.time_remaining() or 30.0))
+        except TimeoutError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        if batched:
+            return encode_frames(answers)
+        return encode_frame(answers[0])
 
     def resolve(self, client_id: int, frame: TensorFrame) -> bool:
         """serversink delivers an answer to the waiting client RPC."""
@@ -161,10 +185,24 @@ class QueryServerCore:
         self._server.start()
         log.info("query server on :%d", self.port)
 
+    def start_tcp(self) -> None:
+        """Serve over the raw-TCP zero-copy transport instead of gRPC
+        (connect-type=tcp; ≙ the reference's nns-edge TCP default)."""
+        if self._tcp is not None:
+            return
+        from .tcp_query import TcpQueryServer
+
+        self._tcp = TcpQueryServer(self, port=self.port)
+        self._tcp.start()
+        self.port = self._tcp.port
+
     def stop(self) -> None:
         if self._server is not None:
             self._server.stop(grace=0.5)
             self._server = None
+        if self._tcp is not None:
+            self._tcp.stop()
+            self._tcp = None
 
 
 _servers_lock = threading.Lock()

@@ -69,7 +69,11 @@ def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def encode_frame(frame: TensorFrame) -> bytes:
+def encode_frame_parts(frame: TensorFrame) -> list:
+    """Vectored encoding: the frame as a list of buffer objects with NO
+    payload copies — tensor data rides as memoryviews of the arrays.
+    Callers either gather-send the parts directly (``socket.sendmsg``,
+    zero user-space copies) or join them (``encode_frame``)."""
     meta = json.dumps(_clean_meta(frame.meta)).encode()
     pts = frame.pts if frame.pts is not None else math.nan
     parts = [
@@ -80,11 +84,19 @@ def encode_frame(frame: TensorFrame) -> bytes:
     for t in frame.tensors:
         arr = np.ascontiguousarray(np.asarray(t))
         spec = TensorSpec(tuple(arr.shape), arr.dtype)
-        payload = arr.tobytes()
         parts.append(pack_flex_header(spec))
-        parts.append(_PLEN.pack(len(payload)))
-        parts.append(payload)
-    return b"".join(parts)
+        parts.append(_PLEN.pack(arr.nbytes))
+        parts.append(arr.reshape(-1).view(np.uint8).data)
+    return parts
+
+
+def parts_nbytes(parts) -> int:
+    return sum(memoryview(p).nbytes for p in parts)
+
+
+def encode_frame(frame: TensorFrame) -> bytes:
+    return b"".join(bytes(p) if not isinstance(p, bytes) else p
+                    for p in encode_frame_parts(frame))
 
 
 # -- multi-frame envelope (wire micro-batching) -----------------------------
@@ -93,17 +105,24 @@ _BHEAD = struct.Struct("<IH")
 _BLEN = struct.Struct("<Q")
 
 
+def encode_frames_parts(frames) -> list:
+    """Vectored multi-frame envelope (u32 'NNSB' | u16 count | per frame
+    u64 len + NNSQ parts) — no payload copies, for gather-sends."""
+    parts = [_BHEAD.pack(_BMAGIC, len(frames))]
+    for f in frames:
+        fparts = encode_frame_parts(f)
+        parts.append(_BLEN.pack(parts_nbytes(fparts)))
+        parts.extend(fparts)
+    return parts
+
+
 def encode_frames(frames) -> bytes:
     """Pack several frames into ONE envelope (u32 'NNSB' | u16 count |
     per frame u64 len + NNSQ bytes).  The query path uses this to
     amortize per-RPC transport overhead over a micro-batch — the wire
     analog of the filter's batched XLA invoke."""
-    parts = [_BHEAD.pack(_BMAGIC, len(frames))]
-    for f in frames:
-        blob = encode_frame(f)
-        parts.append(_BLEN.pack(len(blob)))
-        parts.append(blob)
-    return b"".join(parts)
+    return b"".join(bytes(p) if not isinstance(p, bytes) else p
+                    for p in encode_frames_parts(frames))
 
 
 def decode_frames(buf: bytes):

@@ -45,7 +45,10 @@ class TensorQueryServerSrc(SourceElement):
         "port": Property(int, 0, "listen port (0 = ephemeral)"),
         "host": Property(str, "[::]", "bind address"),
         "id": Property(int, 0, "pairs this src with the serversink of same id"),
-        "connect-type": Property(str, "grpc", "reference parity (always grpc)"),
+        "connect-type": Property(
+            str, "grpc",
+            "transport: grpc (interop default) | tcp (zero-copy raw TCP, "
+            "≙ reference nns-edge TCP)"),
         "caps": Property(str, "", "announced input schema for the handshake"),
     }
 
@@ -57,7 +60,14 @@ class TensorQueryServerSrc(SourceElement):
         self._core = get_query_server(self.props["id"], self.props["port"])
         if self.props["caps"]:
             self._core.caps = self.props["caps"]
-        self._core.start()
+        ct = self.props["connect-type"]
+        if ct == "tcp":
+            self._core.start_tcp()
+        elif ct == "grpc":
+            self._core.start()
+        else:
+            raise ElementError(
+                f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
         # expose the actually-bound port (ephemeral binds)
         self.props["port"] = self._core.port
 
@@ -136,6 +146,10 @@ class TensorQueryClient(Element):
         # cost exactly like the filter's batched XLA invoke amortizes
         # dispatch.  1 = per-frame RPCs (reference parity).
         "wire-batch": Property(int, 1, "max frames per RPC (1 = no batching)"),
+        "connect-type": Property(
+            str, "grpc",
+            "transport: grpc (interop default) | tcp (zero-copy raw TCP "
+            "with sendmsg gather-writes and a per-client socket pool)"),
     }
 
     def __init__(self, name=None):
@@ -165,9 +179,24 @@ class TensorQueryClient(Element):
             targets.append((self.props["host"], self.props["port"]))
         if not targets or any(p == 0 for _, p in targets):
             raise ElementError(f"{self.name}: query client needs host/port")
-        self._conns = [
-            QueryConnection(h, p, self.props["timeout"]) for h, p in targets
-        ]
+        ct = self.props["connect-type"]
+        if ct == "tcp":
+            from ..distributed.tcp_query import TcpQueryConnection
+
+            self._conns = [
+                TcpQueryConnection(
+                    h, p, self.props["timeout"],
+                    nconns=max(1, int(self.props["max-in-flight"])),
+                ) for h, p in targets
+            ]
+        elif ct == "grpc":
+            self._conns = [
+                QueryConnection(h, p, self.props["timeout"])
+                for h, p in targets
+            ]
+        else:
+            raise ElementError(
+                f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.props["max-in-flight"])
         )

@@ -501,3 +501,148 @@ class TestEdgeHybrid:
         finally:
             rx.stop()
             mqtt.close()
+
+
+class TestTcpQueryTransport:
+    """connect-type=tcp: the zero-copy raw-TCP data plane
+    (distributed/tcp_query.py; ≙ reference nns-edge TCP framing,
+    tensor_query_client.c:657-699).  Same QueryServerCore semantics as
+    gRPC — caps handshake, client routing, wire micro-batching — over
+    sendmsg gather-writes and a per-client socket pool."""
+
+    def make_server(self, sid, fw="scaler", custom="factor:2", caps=""):
+        caps_prop = f"caps={caps} " if caps else ""
+        pipe = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id={sid} port=0 "
+            f"connect-type=tcp {caps_prop}! "
+            f"tensor_filter framework={fw} custom={custom} ! "
+            f"tensor_query_serversink id={sid}"
+        )
+        pipe.start()
+        return pipe, pipe["ssrc"].props["port"]
+
+    def test_offload_roundtrip_ordered(self):
+        server, port = self.make_server(301)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "connect-type=tcp max-in-flight=4 ! tensor_sink name=out"
+            )
+            client.start()
+            for i in range(8):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=20)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [i * 2.0 for i in range(8)]
+        finally:
+            server.stop()
+
+    def test_wire_batch_roundtrip(self):
+        server, port = self.make_server(302)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "connect-type=tcp wire-batch=4 max-in-flight=4 ! "
+                "tensor_sink name=out"
+            )
+            client.start()
+            n = 11
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=20)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [i * 2.0 for i in range(n)]
+        finally:
+            server.stop()
+
+    def test_large_payload_intact(self):
+        """150 KB frames survive the gather-send / recv_into path
+        bit-exactly (partial sendmsg/recv handling)."""
+        server, port = self.make_server(303, fw="scaler", custom="factor:1")
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "connect-type=tcp wire-batch=2 ! tensor_sink name=out"
+            )
+            client.start()
+            rng = np.random.default_rng(0)
+            payloads = [rng.integers(0, 255, (224, 224, 3)).astype(np.float32)
+                        for _ in range(4)]
+            for p in payloads:
+                client["src"].push(p)
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            outs = [np.asarray(f.tensors[0]) for f in client["out"].frames]
+            assert len(outs) == 4
+            for got, want in zip(outs, payloads):
+                np.testing.assert_array_equal(got, want)
+        finally:
+            server.stop()
+
+    def test_handshake_caps_mismatch_fails(self):
+        from nnstreamer_tpu.distributed.tcp_query import TcpQueryConnection
+
+        server, port = self.make_server(
+            304, caps="other/tensors,num_tensors=1,dimensions=2,types=float32")
+        try:
+            conn = TcpQueryConnection("127.0.0.1", port, timeout=5)
+            try:
+                with pytest.raises(RuntimeError, match="caps mismatch"):
+                    conn.handshake(
+                        "other/tensors,num_tensors=1,dimensions=7,types=uint8")
+                # matching caps pass
+                got = conn.handshake(
+                    "other/tensors,num_tensors=1,dimensions=2,types=float32")
+                assert "float32" in got
+            finally:
+                conn.close()
+        finally:
+            server.stop()
+
+    def test_dead_server_raises_promptly(self):
+        from nnstreamer_tpu.distributed.tcp_query import TcpQueryConnection
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        conn = TcpQueryConnection("127.0.0.1", 1, timeout=2)  # nothing there
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                conn.invoke(TensorFrame((np.float32([1]),)))
+        finally:
+            conn.close()
+
+    def test_socket_pool_parallel_invokes(self):
+        """N threads invoking concurrently each get their own socket;
+        results match their requests (no cross-talk)."""
+        import threading
+
+        from nnstreamer_tpu.distributed.tcp_query import TcpQueryConnection
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        server, port = self.make_server(305)
+        try:
+            conn = TcpQueryConnection("127.0.0.1", port, timeout=10, nconns=4)
+            errs, results = [], {}
+
+            def worker(i):
+                try:
+                    out = conn.invoke(TensorFrame((np.float32([i]),)))
+                    results[i] = float(out.tensors[0][0])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20)
+            conn.close()
+            assert not errs
+            assert results == {i: i * 2.0 for i in range(8)}
+        finally:
+            server.stop()

@@ -92,7 +92,7 @@ _SERVER_ECHO = _SERVER_COMMON + """
 from nnstreamer_tpu.backends.custom_easy import register_custom_easy
 register_custom_easy("echo", lambda inputs: [np.asarray(inputs[0])])
 pipe = parse_pipeline(
-    "tensor_query_serversrc name=src port=0 ! "
+    "tensor_query_serversrc name=src port=0 connect-type={ct} ! "
     "tensor_filter framework=custom-easy model=echo ! "
     "tensor_query_serversink"
 )
@@ -106,13 +106,14 @@ _SCRIPTS = {"sleepy": _SERVER_SLEEPY, "real": _SERVER_REAL,
 
 
 def run_scale(mode: str, n_servers: int, frames: int,
-              work_ms: float, payload, wire_batch: int = 1) -> float:
+              work_ms: float, payload, wire_batch: int = 1,
+              connect_type: str = "grpc") -> float:
     from nnstreamer_tpu.pipeline import parse_pipeline
 
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("XLA_FLAGS", None)
     procs, ports = [], []
-    script = _SCRIPTS[mode].format(root=ROOT, work_ms=work_ms)
+    script = _SCRIPTS[mode].format(root=ROOT, work_ms=work_ms, ct=connect_type)
     try:
         for _ in range(n_servers):
             p = subprocess.Popen(
@@ -132,6 +133,7 @@ def run_scale(mode: str, n_servers: int, frames: int,
         pipe = parse_pipeline(
             f"appsrc name=a max-buffers={frames + 8} ! "
             f"tensor_query_client hosts={hosts} timeout=120 "
+            f"connect-type={connect_type} "
             f"max-in-flight={inflight} wire-batch={wire_batch} ! "
             "tensor_sink name=out",
             name=f"fanout{n_servers}",
@@ -199,17 +201,20 @@ def main() -> int:
             # client-ceiling matrix: payload size × wire batching — the
             # two levers deciding whether ONE client can pump chip rate.
             # 2 echo servers keep the server side off the critical path.
-            for payload, wb in (
-                (mobilenet_frame, 1), (mobilenet_frame, 8),
-                (np.zeros((8,), np.float32), 8),
+            for payload, wb, ct in (
+                (mobilenet_frame, 1, "grpc"), (mobilenet_frame, 8, "grpc"),
+                (mobilenet_frame, 1, "tcp"), (mobilenet_frame, 8, "tcp"),
+                (np.zeros((8,), np.float32), 8, "tcp"),
+                (np.zeros((8,), np.float32), 8, "grpc"),
             ):
                 fps = run_scale("echo", 2, frames, work_ms, payload,
-                                wire_batch=wb)
+                                wire_batch=wb, connect_type=ct)
                 emit({
                     "metric": "query_client_ceiling_fps",
                     "mode": "echo", "n_servers": 2,
                     "value": round(fps, 1), "unit": "fps",
                     "platform": "cpu-loopback",
+                    "connect_type": ct,
                     "payload_bytes": int(payload.nbytes),
                     "wire_batch": wb,
                 })
