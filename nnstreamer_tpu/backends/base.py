@@ -14,8 +14,9 @@ TPU-native deltas:
   — the micro-batching hook the filter element uses to amortize dispatch
   into one XLA call (the reference has no batching; this is the ≥1000 fps
   lever, SURVEY §7 stage 4).
-* device placement is advisory (``accelerator`` strings parse to a wish
-  list; XLA owns placement on TPU).
+* device placement is real: ``accelerator`` wish lists resolve to a
+  concrete ``jax.Device`` in wish order, with a ``.N`` ordinal extension
+  (``jax_xla.pick_device``) — two filters can pin two different chips.
 * backends may keep outputs on device (jax.Array) — zero-copy between
   chained filters (≙ allocate-in-invoke + GstMemory mapping).
 """

@@ -85,6 +85,76 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+# accelerator wish name -> candidate jax platform names, in probe order.
+# On this hardware "tpu" may surface as platform "tpu" or "axon"; "npu"
+# wishes (reference edgetpu/srnpu parlance) map to the TPU too.
+_WISH_PLATFORMS = {
+    "auto": (None,),
+    "default": (None,),
+    "tpu": ("tpu", "axon"),
+    "npu": ("tpu", "axon"),
+    "npu.edgetpu": ("tpu", "axon"),
+    "gpu": ("gpu", "cuda", "rocm"),
+    "cpu": ("cpu",),
+    "cpu.simd": ("cpu",),
+}
+
+# the wish vocabulary is owned by base.KNOWN_ACCELERATORS (the parse
+# side); this mapping must cover it so parse/placement cannot drift
+from .base import KNOWN_ACCELERATORS as _KNOWN
+
+assert set(_WISH_PLATFORMS) == set(_KNOWN), (
+    sorted(set(_WISH_PLATFORMS) ^ set(_KNOWN)))
+del _KNOWN
+
+
+def pick_device(wishes):
+    """Resolve an accelerator wish list to a concrete jax.Device.
+
+    Honors the reference's ordered-wish semantics
+    (``tensor_filter_common.c:2719-2878``: first available hardware in
+    the list wins) plus a TPU-native extension: a ``.N`` suffix pins a
+    specific device ordinal — ``accelerator=true:tpu.1,cpu`` means
+    "second TPU chip, else CPU".  ``auto``/``default`` take the process
+    default device.  Unknown / unavailable wishes fall through to the
+    next; an exhausted list falls back to the default device.
+    """
+    import jax
+
+    from ..core.log import get_logger
+
+    family_fallback = None  # first wish whose PLATFORM exists at all
+    for wish in wishes:
+        name = wish.strip().lower()
+        idx = 0
+        # trailing .N = device ordinal (distinct from variant suffixes
+        # like cpu.simd / npu.edgetpu, which are non-numeric)
+        head, _, tail = name.rpartition(".")
+        if tail.isdigit() and head:
+            name, idx = head, int(tail)
+        platforms = _WISH_PLATFORMS.get(name)
+        if platforms is None:
+            continue
+        for plat in platforms:
+            try:
+                devs = jax.devices(plat) if plat else jax.devices()
+            except RuntimeError:
+                continue
+            if idx < len(devs):
+                return devs[idx]
+            if family_fallback is None and devs:
+                family_fallback = devs[0]
+    if family_fallback is not None:
+        # an ordinal overshot but the requested platform FAMILY exists:
+        # stay in that family rather than silently inverting an explicit
+        # cpu-only (or tpu-only) request onto the process default
+        get_logger("jax-xla").warning(
+            "accelerator wish list %s unsatisfiable as written; using %s",
+            wishes, family_fallback)
+        return family_fallback
+    return jax.devices()[0]
+
+
 class JaxXla(FilterBackend):
     NAME = "jax-xla"
 
@@ -170,11 +240,7 @@ class JaxXla(FilterBackend):
         self._fn, self._params, self._in_spec, self._out_spec = self._resolve_model(
             model_path
         )
-        wishes = props.get("accelerators") or ["auto"]
-        if wishes and wishes[0] == "cpu":
-            self._device = jax.devices("cpu")[0]
-        else:
-            self._device = jax.devices()[0]
+        self._device = pick_device(props.get("accelerators") or ["auto"])
         # cache keyed off the device we will actually compile for (on CPU
         # the auto-enabled cache only emits AOT feature-mismatch noise)
         enable_compile_cache(platform=self._device.platform)
@@ -347,7 +413,14 @@ class JaxXla(FilterBackend):
             # device-side scatter/collective, not a host bounce
             return jax.device_put(a, sharding)
         if isinstance(a, jax.Array):
-            return a
+            # zero-copy pass-through only when the array already lives on
+            # THIS filter's device; a chained upstream filter pinned to a
+            # different chip hands us its residents — move them (device-
+            # to-device, no host bounce) or jit would raise incompatible-
+            # devices / silently ignore the pin
+            if a.devices() == {self._device}:
+                return a
+            return jax.device_put(a, self._device)
         return jax.device_put(np.asarray(a), self._device)
 
     # -- execution ----------------------------------------------------------

@@ -13,7 +13,8 @@ TPU-native deltas:
   single biggest throughput lever on TPU (per-frame Python dispatch cannot
   reach 1000 fps; one XLA call on a batch can).  Timestamps/metadata of each
   frame are preserved; outputs are split back per-frame.
-* accelerator strings parse but are advisory — XLA owns placement.
+* accelerator wish lists resolve to a concrete device (``true:tpu.1,cpu``
+  pins the second chip) — see ``backends.jax_xla.pick_device``.
 * backends may return device-resident jax.Arrays; the filter passes them
   through untouched (zero-copy chaining).
 """
@@ -163,7 +164,7 @@ class TensorFilter(TransformElement):
         "framework": Property(str, "auto", "backend name or 'auto'"),
         "model": Property(str, "", "model path / registry key"),
         "custom": Property(str, "", "backend-specific options 'k1:v1,k2:v2'"),
-        "accelerator": Property(str, "", "'true:tpu,cpu' wish list (advisory)"),
+        "accelerator": Property(str, "", "'true:tpu.N,cpu' ordered wish list -> real device pinning"),
         "input-combination": Property(str, "", "subset/reorder input tensors, e.g. '0,2'"),
         "output-combination": Property(str, "", "compose output from 'iN'/'oN' tensors"),
         "latency": Property(int, 0, "1 = enable per-invoke latency measurement"),

@@ -1,0 +1,141 @@
+"""accelerator prop -> real device placement.
+
+≙ reference ``accelerator=true:hw1,hw2`` ordered-wish parsing
+(``tensor_filter_common.c:2719-2878``), which there only selects a
+vendor delegate.  Here the wish list resolves to a concrete
+``jax.Device`` (with a ``.N`` ordinal extension), so two filters in one
+process can pin to two different chips — the bridge between the
+single-chip element API and multi-device serving (VERDICT r3 weak #6).
+
+Runs on the conftest's 8-virtual-CPU-device platform.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.jax_xla import (
+    pick_device, register_jax_model, unregister_jax_model)
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+@pytest.fixture(autouse=True)
+def _model():
+    register_jax_model("accl_affine", lambda p, xs: [xs[0] + 1.0], None)
+    yield
+    unregister_jax_model("accl_affine")
+
+
+class TestPickDevice:
+    def test_ordinal_suffix(self):
+        devs = jax.devices("cpu")
+        assert pick_device(["cpu.3"]) is devs[3]
+        assert pick_device(["cpu.0"]) is devs[0]
+        assert pick_device(["cpu"]) is devs[0]
+
+    def test_ordered_fallthrough(self):
+        # no TPU on the test platform: tpu wish falls through to cpu.2
+        devs = jax.devices("cpu")
+        assert pick_device(["tpu", "cpu.2"]) is devs[2]
+
+    def test_out_of_range_ordinal_falls_through(self):
+        devs = jax.devices("cpu")
+        assert pick_device(["cpu.99", "cpu.1"]) is devs[1]
+
+    def test_unknown_wish_skipped(self):
+        devs = jax.devices("cpu")
+        assert pick_device(["vendorsdk", "cpu.1"]) is devs[1]
+
+    def test_exhausted_list_falls_back_to_default(self):
+        assert pick_device(["tpu.5", "gpu"]) is jax.devices()[0]
+
+    def test_auto(self):
+        assert pick_device(["auto"]) is jax.devices()[0]
+
+
+class TestPipelinePinning:
+    def test_two_filters_two_devices(self):
+        """Two chained filters with distinct ordinals run on distinct
+        devices; each filter's outputs are committed to ITS device."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f1 framework=jax-xla model=accl_affine "
+            "accelerator=true:cpu.1 ! "
+            "tensor_filter name=f2 framework=jax-xla model=accl_affine "
+            "accelerator=true:cpu.3 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        try:
+            d1 = pipe["f1"].backend._device
+            d2 = pipe["f2"].backend._device
+            assert d1 is jax.devices("cpu")[1]
+            assert d2 is jax.devices("cpu")[3]
+            assert d1 is not d2
+            # and the compute really lands there: invoke through the
+            # backends directly and inspect output residency
+            (o1,) = pipe["f1"].backend.invoke([np.float32([1.0])])
+            (o2,) = pipe["f2"].backend.invoke([np.float32([1.0])])
+            assert list(o1.devices()) == [d1]
+            assert list(o2.devices()) == [d2]
+        finally:
+            pipe["src"].end_of_stream()
+            pipe.stop()
+
+    def test_accelerator_false_forces_cpu(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=jax-xla model=accl_affine "
+            "accelerator=false ! tensor_sink name=out"
+        )
+        pipe.start()
+        try:
+            assert pipe["f"].backend._device.platform == "cpu"
+        finally:
+            pipe["src"].end_of_stream()
+            pipe.stop()
+
+    def test_end_to_end_values_cross_device(self):
+        """Frames hop f1(dev1) -> f2(dev3) -> host sink; values intact."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter framework=jax-xla model=accl_affine "
+            "accelerator=true:cpu.1 ! "
+            "tensor_filter framework=jax-xla model=accl_affine "
+            "accelerator=true:cpu.3 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(4):
+            pipe["src"].push(np.float32([i]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=20)
+        vals = [float(f.tensors[0][0]) for f in pipe["out"].frames]
+        pipe.stop()
+        assert vals == [i + 2.0 for i in range(4)]
+
+    def test_unsatisfiable_ordinal_stays_in_family(self):
+        """cpu.99 with no later wish must stay on CPU (family fallback),
+        never invert an explicit cpu-only request onto the default
+        device (the TPU on real hardware)."""
+        dev = pick_device(["cpu.99"])
+        assert dev.platform == "cpu"
+
+    def test_cross_device_handoff_is_moved_not_ignored(self):
+        """An upstream filter's device-resident output pinned elsewhere is
+        moved to this filter's device, and compute runs there."""
+        import jax
+        from nnstreamer_tpu.backends.jax_xla import JaxXla
+
+        b1, b2 = JaxXla(), JaxXla()
+        b1.open("accl_affine", {"accelerators": ["cpu.1"]})
+        b2.open("accl_affine", {"accelerators": ["cpu.3"]})
+        try:
+            (o1,) = b1.invoke([np.float32([1.0])])
+            assert list(o1.devices()) == [jax.devices("cpu")[1]]
+            (o2,) = b2.invoke([o1])  # committed to cpu.1, pinned cpu.3
+            assert list(o2.devices()) == [jax.devices("cpu")[3]]
+            assert float(np.asarray(o2)[0]) == 3.0
+        finally:
+            b1.close()
+            b2.close()
