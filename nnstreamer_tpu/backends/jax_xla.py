@@ -100,11 +100,15 @@ _WISH_PLATFORMS = {
 }
 
 # the wish vocabulary is owned by base.KNOWN_ACCELERATORS (the parse
-# side); this mapping must cover it so parse/placement cannot drift
+# side); this mapping must cover it so parse/placement cannot drift.
+# Explicit raise (not assert): must survive python -O
 from .base import KNOWN_ACCELERATORS as _KNOWN
 
-assert set(_WISH_PLATFORMS) == set(_KNOWN), (
-    sorted(set(_WISH_PLATFORMS) ^ set(_KNOWN)))
+if set(_WISH_PLATFORMS) != set(_KNOWN):
+    raise ImportError(
+        "accelerator vocabulary drift between base.KNOWN_ACCELERATORS and "
+        f"jax_xla._WISH_PLATFORMS: {sorted(set(_WISH_PLATFORMS) ^ set(_KNOWN))}"
+    )
 del _KNOWN
 
 

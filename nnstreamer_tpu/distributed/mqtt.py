@@ -202,6 +202,26 @@ class MiniBroker:
         )
         self._redeliver.start()
 
+    def has_subscriber(self, topic: str) -> bool:
+        """True when some LIVE session holds a subscription matching
+        ``topic`` — the event-driven readiness signal tests use instead
+        of sleeping an arbitrary margin after starting a subscriber."""
+        with self._lock:
+            return any(
+                sess.sock is not None and topic_matches(pat, topic)
+                for sess in self._sessions.values()
+                for pat in sess.subs
+            )
+
+    def wait_subscriber(self, topic: str, timeout_s: float = 10.0) -> bool:
+        """Block until :meth:`has_subscriber` (bounded); True on success."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.has_subscriber(topic):
+                return True
+            time.sleep(0.01)
+        return False
+
     def close(self) -> None:
         self._stop.set()
         try:

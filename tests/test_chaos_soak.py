@@ -89,9 +89,25 @@ class TestChaosSoak:
             "pub-topic=chaos/t qos=1 client-id=chaos-tx"
         )
         tx.start()
-        time.sleep(0.3)  # subscription lands
+        # event-driven readiness: the broker REPORTS the live subscription
+        # (no sleep margin to outrun on a loaded box)
+        assert b1.wait_subscriber("chaos/t", 10), "subscription never landed"
 
+        # event-driven delivery tracking: the sink wakes this when every
+        # DISTINCT frame index has arrived (QoS-1 duplicates are legal and
+        # must not satisfy the count early)
+        all_delivered = threading.Event()
         n_total = 60
+        seen_idx = set()
+
+        def _on_frame(f):
+            if f.pts is not None:
+                seen_idx.add(int(round(f.pts)))
+            if len(seen_idx) >= n_total:
+                all_delivered.set()
+
+        rx["out"].connect_new_data(_on_frame)
+
         reload_at = 40  # model switch point (weight 2.0 -> 3.0)
         broker = b1
         try:
@@ -108,27 +124,27 @@ class TestChaosSoak:
                     while (len(rx["out"].frames) < reload_at
                            and time.time() < deadline):
                         time.sleep(0.05)
+                    # the reload event and subsequent frames ride ONE
+                    # ordered queue — no settling sleep needed
                     tx["src"].push_event(
                         CustomEvent("reload-model", {"model": "chaos_m2"})
                     )
-                    time.sleep(0.2)
                 tx["src"].push(np.full((4,), float(i), np.float32),
                                pts=float(i))
                 time.sleep(0.02)  # ~50 fps sustained
 
             tx["src"].end_of_stream()
             tx.wait(timeout=60)
-            # publisher must end clean: all QoS-1 publishes acknowledged
-            # (bounded drain first — a loaded CI box can still be
-            # retransmitting when EOS lands)
+            # publisher must end clean: all QoS-1 publishes acknowledged.
+            # Every wait here is event-driven (returns the instant the
+            # condition lands); the bounds are pathology caps only, so
+            # generous values cost nothing on success and cannot flake a
+            # loaded box (the 41419f3 lesson)
             if tx["snk"]._client is not None:
                 assert tx["snk"]._client.drain(20.0) == 0
             tx.stop()
 
-            deadline = time.time() + 40
-            while (len(rx["out"].frames) < n_total
-                   and time.time() < deadline):
-                time.sleep(0.1)
+            all_delivered.wait(timeout=40)
             frames = list(rx["out"].frames)
             rx.stop()
         finally:
@@ -149,6 +165,7 @@ class TestChaosSoak:
             np.testing.assert_allclose(arr, np.full((4,), i * w), rtol=1e-5)
 
         # no leaked workers: thread population returns to baseline
+        # (early-exit poll; the cap is a pathology bound, not a margin)
         deadline = time.time() + 30
         while time.time() < deadline:
             leaked = [
@@ -203,7 +220,9 @@ class TestChaosSoak:
                         except Exception:
                             time.sleep(0.2)
                     assert rx2 is not None
-                    time.sleep(0.3)  # client notices + reconnects
+                    # no settling sleep: the sink's retry-timeout covers
+                    # the reconnect window; mid-kill drops are legal and
+                    # the post-restart resume is verified event-bound below
                 tx["a"].push(np.full((3,), float(i), np.float32))
                 time.sleep(0.02)
             deadline = time.time() + 15
