@@ -157,3 +157,34 @@ class TestGenerationOffload:
         finally:
             srv.kill()
             srv.wait(timeout=10)
+
+
+def test_fanout_server_template_pins_core():
+    """bench_fanout's server template: sched_setaffinity line executes
+    (pin to the first ALLOWED cpu id — cpuset-restricted hosts may not
+    include 0) and the server still boots and prints its port."""
+    import subprocess
+    import sys as _sys
+
+    if not hasattr(os, "sched_getaffinity"):
+        pytest.skip("no sched_getaffinity on this platform")
+    sys_path = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _sys.path.insert(0, os.path.join(sys_path, "tools"))
+    try:
+        import bench_fanout
+    finally:
+        _sys.path.pop(0)
+    pin_to = min(os.sched_getaffinity(0))
+    script = bench_fanout._SCRIPTS["echo"].format(
+        root=sys_path, work_ms=1, ct="tcp", pin_core=pin_to)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen([_sys.executable, "-c", script],
+                         stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = p.stdout.readline()
+        assert line.startswith("PORT "), line
+        assert len(os.sched_getaffinity(p.pid)) == 1
+    finally:
+        p.kill()
+        p.wait(timeout=10)
