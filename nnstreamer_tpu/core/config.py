@@ -27,6 +27,11 @@ import threading
 from typing import Dict, List, Optional
 
 _ENV_PREFIX = "NNS_TPU_"
+
+# serialized jax.export artifact extensions — the ONE list the auto-detect
+# allowlist (elements/filter.py), the priority defaults below, and the
+# jax-xla loader all derive from
+EXPORTED_MODEL_EXTS = (".jaxexport", ".stablehlo")
 _lock = threading.RLock()
 _parser: Optional[configparser.ConfigParser] = None
 _loaded_from: Optional[str] = None
@@ -127,6 +132,7 @@ def framework_priority(model_ext: str) -> List[str]:
         "msgpack": ["jax-xla"],
         "orbax": ["jax-xla"],
         "jax": ["jax-xla"],
+        **{e.lstrip("."): ["jax-xla"] for e in EXPORTED_MODEL_EXTS},
         "pt": ["torch"],
         "pth": ["torch"],
         "py": ["python3"],

@@ -90,6 +90,7 @@ def detect_framework(model_path: str, custom: str = "") -> str:
         if (
             cand == "jax-xla"
             and ext not in ("", ".py", ".msgpack")
+            and ext not in nns_config.EXPORTED_MODEL_EXTS
             and not has_arch
         ):
             continue
