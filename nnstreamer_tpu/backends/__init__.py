@@ -13,4 +13,5 @@ registry.register_lazy(registry.KIND_FILTER, "jax-xla", "nnstreamer_tpu.backends
 registry.register_lazy(registry.KIND_FILTER, "python3", "nnstreamer_tpu.backends.python3:Python3Backend")
 registry.register_lazy(registry.KIND_FILTER, "torch", "nnstreamer_tpu.backends.torch_cpu:TorchBackend")
 registry.register_lazy(registry.KIND_FILTER, "tflite", "nnstreamer_tpu.backends.tflite_import:TFLiteBackend")
+registry.register_lazy(registry.KIND_FILTER, "onnx", "nnstreamer_tpu.backends.onnx_import:OnnxBackend")
 registry.register_lazy(registry.KIND_FILTER, "custom", "nnstreamer_tpu.backends.custom_native:CustomNative")

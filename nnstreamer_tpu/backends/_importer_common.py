@@ -1,0 +1,50 @@
+"""Shared machinery for importer backends (tflite / onnx).
+
+Both backends lower a foreign graph to a ``lowering.run(params, *xs)``
+callable with fixed per-frame input ranks; the JaxXla plumbing then
+needs (a) a model fn that vmaps the whole graph when it receives
+micro-batched frames (one extra leading axis) and (b) StreamSpecs built
+from the file's declared shapes with dynamic dims falling back to
+stream-derived negotiation.  One implementation here so the two
+importers cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+
+
+def batching_model_fn(run: Callable, in_ranks: Sequence[int]) -> Callable:
+    """Wrap ``run(params, *xs)`` as ``fn(params, xs)``: per-frame calls
+    pass through; micro-batched calls (every input one rank higher than
+    declared) vmap the whole graph — still a single XLA program.  A
+    declared rank of -1 (unknown) disables batch detection for that
+    input."""
+    import jax
+
+    def fn(params, xs: List[Any]) -> List[Any]:
+        if all(r >= 0 and x.ndim == r + 1 for x, r in zip(xs, in_ranks)):
+            return list(jax.vmap(lambda *a: run(params, *a))(*xs))
+        return list(run(params, *xs))
+
+    return fn
+
+
+def spec_from_shapes(
+    entries: Sequence[Tuple[Optional[Sequence[Optional[int]]], Optional[str]]],
+) -> Optional[StreamSpec]:
+    """(shape, dtype) pairs -> StreamSpec; None when any shape/dtype is
+    unknown or has dynamic dims (negotiation derives it from the stream
+    instead)."""
+    tensors = []
+    for shape, dtype in entries:
+        if shape is None or dtype is None or any(
+                d is None or (isinstance(d, int) and d < 0) for d in shape):
+            return None
+        tensors.append(TensorSpec(tuple(int(d) for d in shape),
+                                  np.dtype(dtype)))
+    return StreamSpec(tuple(tensors), FORMAT_STATIC)

@@ -1,0 +1,63 @@
+"""onnx filter backend: run .onnx models on TPU by lowering to XLA.
+
+Reference capability: the reference runs ONNX through vendor subplugins
+(``ext/nnstreamer/tensor_filter/tensor_filter_openvino.cc``,
+``tensor_filter_snpe.cc``, TensorRT's onnx parser) — each embeds a
+closed runtime.  Here the protobuf is parsed in-process
+(``importers/onnx_reader.py``, no ``onnx`` package) and the graph lowers
+to ONE jit-traced JAX function (``importers/onnx_lower.py``), so a
+third-party .onnx file runs on the MXU with the same machinery as
+native JAX models.
+
+Subclasses :class:`JaxXla` — shape-bucketed compilation, vmapped
+``invoke_batch``, donation, device residency, ``dtype:bfloat16``
+casting, ``mesh_*`` sharded serving, double-buffered reload all
+inherited (same shape as the tflite importer backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
+from .jax_xla import JaxXla
+from .base import register_backend
+
+
+class OnnxBackend(JaxXla):
+    NAME = "onnx"
+
+    @staticmethod
+    def available() -> bool:
+        return True
+
+    def framework_info(self):
+        info = super().framework_info()
+        info.verify_model_path = True
+        return info
+
+    def _resolve_model(self, model_path: Optional[str]):
+        from ..importers.onnx_reader import read_onnx
+        from ..importers.onnx_lower import _Lowering
+        from ._importer_common import batching_model_fn, spec_from_shapes
+
+        if not model_path:
+            raise ValueError("onnx backend requires model=<file.onnx>")
+        model = read_onnx(model_path)
+        lowering = _Lowering(model)
+        params = lowering.params()
+        lowering.drop_host_consts()
+        in_ranks = tuple(
+            len(vi.shape) if vi.shape is not None else -1
+            for vi in model.inputs)
+        return (
+            batching_model_fn(lowering.run, in_ranks),
+            params,
+            spec_from_shapes([(vi.shape, vi.dtype) for vi in model.inputs]),
+            spec_from_shapes([(vi.shape, vi.dtype) for vi in model.outputs]),
+        )
+
+
+register_backend(OnnxBackend)

@@ -50,10 +50,9 @@ class TFLiteBackend(JaxXla):
         return info
 
     def _resolve_model(self, model_path: Optional[str]):
-        import jax
-
         from ..importers.tflite_reader import read_tflite
         from ..importers.tflite_lower import _Lowering
+        from ._importer_common import batching_model_fn, spec_from_shapes
 
         if not model_path:
             raise ValueError("tflite backend requires model=<file.tflite>")
@@ -64,26 +63,16 @@ class TFLiteBackend(JaxXla):
         params = lowering.params()
         lowering.drop_host_consts()  # run() always gets the params pytree
         in_ranks = tuple(len(model.tensors[i].shape) for i in model.inputs)
-
-        def fn(p, xs: List[Any]) -> List[Any]:
-            if all(x.ndim == r + 1 for x, r in zip(xs, in_ranks)):
-                # micro-batched frames: vmap the whole graph over the
-                # stacking axis — still a single XLA program
-                return list(jax.vmap(
-                    lambda *a: lowering.run(p, *a))(*xs))
-            return list(lowering.run(p, *xs))
-
-        def spec_of(indices) -> StreamSpec:
-            return StreamSpec(
-                tuple(
-                    TensorSpec(tuple(model.tensors[i].shape),
-                               np.dtype(model.tensors[i].dtype))
-                    for i in indices
-                ),
-                FORMAT_STATIC,
-            )
-
-        return fn, params, spec_of(model.inputs), spec_of(model.outputs)
+        return (
+            batching_model_fn(lowering.run, in_ranks),
+            params,
+            spec_from_shapes([(model.tensors[i].shape,
+                               model.tensors[i].dtype)
+                              for i in model.inputs]),
+            spec_from_shapes([(model.tensors[i].shape,
+                               model.tensors[i].dtype)
+                              for i in model.outputs]),
+        )
 
 
 # Back-compat alias (the pre-round-4 gated shim's class name)

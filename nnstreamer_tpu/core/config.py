@@ -129,6 +129,7 @@ def framework_priority(model_ext: str) -> List[str]:
         return pri
     defaults: Dict[str, List[str]] = {
         "tflite": ["jax-xla", "tflite"],
+        "onnx": ["jax-xla", "onnx"],
         "msgpack": ["jax-xla"],
         "orbax": ["jax-xla"],
         "jax": ["jax-xla"],

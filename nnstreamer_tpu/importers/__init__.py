@@ -11,5 +11,8 @@ format: TFLite flatbuffers (the reference's flagship format,
 
 from .tflite_reader import TFLiteModel, read_tflite
 from .tflite_lower import lower_tflite
+from .onnx_reader import OnnxModel, read_onnx
+from .onnx_lower import lower_onnx
 
-__all__ = ["TFLiteModel", "read_tflite", "lower_tflite"]
+__all__ = ["TFLiteModel", "read_tflite", "lower_tflite",
+           "OnnxModel", "read_onnx", "lower_onnx"]

@@ -1,0 +1,724 @@
+"""Lower a parsed ONNX graph to a jittable JAX function.
+
+Same design as ``tflite_lower.py``: the whole graph traces ONCE into a
+single XLA program (convs/matmuls on the MXU, elementwise fused by XLA),
+versus the reference's vendor-runtime subplugins that interpret per-op.
+
+ONNX is NCHW; lowering keeps that layout (XLA lays out for TPU itself).
+Shape-computation chains (Shape → Gather → Unsqueeze → Concat → Reshape,
+the pattern torch exports emit) fold at trace time: ops whose inputs are
+all statically known compute in numpy and stay usable as shape/axis
+arguments — XLA requires static shapes, so data-dependent shapes are
+rejected at load with a clear error.
+
+Covered op set: the common CNN/MLP/attention inventory (Conv /
+ConvTranspose / pools / Gemm / MatMul / BatchNorm / LayerNorm /
+activations / reductions / shape ops / Resize / Pad / Slice / Concat /
+Split / Where / comparisons / Erf-Gelu).  Unsupported ops raise
+``OnnxLowerError`` naming the op at load time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .onnx_reader import OnnxModel, OnnxNode
+
+
+class OnnxLowerError(NotImplementedError):
+    pass
+
+
+def _act_pads(pads: Sequence[int], ndim: int) -> List[Tuple[int, int]]:
+    """ONNX pads [x1b, x2b, ..., x1e, x2e, ...] -> per-spatial (lo, hi)."""
+    half = len(pads) // 2
+    return [(int(pads[i]), int(pads[i + half])) for i in range(half)]
+
+
+def _auto_pad(auto_pad: bytes, in_shape, kernel, strides, dilations):
+    """SAME_UPPER / SAME_LOWER / VALID handling (deprecated but emitted)."""
+    mode = auto_pad.decode() if isinstance(auto_pad, bytes) else auto_pad
+    if mode == "VALID":
+        return [(0, 0)] * len(in_shape)
+    out = []
+    for i, (size, k, s, d) in enumerate(
+            zip(in_shape, kernel, strides, dilations)):
+        eff = (k - 1) * d + 1
+        total = max(0, (-(-size // s) - 1) * s + eff - size)
+        lo = total // 2
+        hi = total - lo
+        if mode == "SAME_LOWER":
+            lo, hi = hi, lo
+        out.append((lo, hi))
+    return out
+
+
+class _Lowering:
+    def __init__(self, model: OnnxModel):
+        self.m = model
+        self.consts: Dict[str, np.ndarray] = dict(model.initializers)
+        # Constant nodes are initializer-equivalent: fold them at load
+        for node in model.nodes:
+            if node.op_type == "Constant":
+                val = node.attrs.get("value")
+                if val is None:
+                    for k in ("value_float", "value_int"):
+                        if k in node.attrs:
+                            val = np.asarray(node.attrs[k])
+                if val is None:
+                    raise OnnxLowerError(
+                        "Constant node without tensor value")
+                self.consts[node.outputs[0]] = np.asarray(val)
+        unsupported = sorted({
+            n.op_type for n in model.nodes
+            if n.op_type not in _OP_IMPLS and n.op_type != "Constant"})
+        if unsupported:
+            raise OnnxLowerError(
+                f"unsupported onnx ops: {', '.join(unsupported)} "
+                f"(supported: {', '.join(sorted(_OP_IMPLS))})")
+        # trace-time static values (shape chains); reset per run
+        self.static: Dict[str, np.ndarray] = {}
+
+    def params(self) -> Dict[str, np.ndarray]:
+        return dict(self.consts)
+
+    def drop_host_consts(self) -> None:
+        """See tflite_lower.drop_host_consts — the params pytree owns the
+        weights once the caller takes it; keep only the small arrays the
+        trace needs as static shape/axis arguments."""
+        self.consts = {k: v for k, v in self.consts.items() if v.size <= 256}
+
+    # -- value access -------------------------------------------------------
+    def val(self, env, name: str):
+        if not name:
+            return None
+        if name in env:
+            return env[name]
+        if name in self.consts:
+            return jnp.asarray(self.consts[name])
+        raise OnnxLowerError(f"tensor {name!r} undefined (graph order?)")
+
+    def static_val(self, env, name: str) -> np.ndarray:
+        """Integer-domain static value (shape vectors, axes, pads)."""
+        if name in self.static:
+            return self.static[name]
+        if name in self.consts:
+            return np.asarray(self.consts[name])
+        raise OnnxLowerError(
+            f"tensor {name!r} must be statically known (XLA needs static "
+            "shapes; data-dependent shape arguments are not supported)")
+
+    def maybe_static(self, env, name: str) -> Optional[np.ndarray]:
+        if name in self.static:
+            return self.static[name]
+        if name in self.consts:
+            return np.asarray(self.consts[name])
+        return None
+
+    def set_out(self, env, node: OnnxNode, value, static=None) -> None:
+        env[node.outputs[0]] = value
+        if static is not None:
+            self.static[node.outputs[0]] = np.asarray(static)
+
+    # -- the jittable function ---------------------------------------------
+    def __call__(self, *inputs):
+        return self.run(self.consts, *inputs)
+
+    def run(self, consts: Dict[str, Any], *inputs):
+        m = self.m
+        if len(inputs) != len(m.inputs):
+            raise ValueError(
+                f"model takes {len(m.inputs)} inputs, got {len(inputs)}")
+        env: Dict[str, Any] = dict(consts)
+        self.static = {}
+        for vi, x in zip(m.inputs, inputs):
+            env[vi.name] = jnp.asarray(x)
+        for node in m.nodes:
+            if node.op_type == "Constant":
+                continue  # folded at load
+            _OP_IMPLS[node.op_type](self, env, node)
+        return tuple(env[vi.name] for vi in m.outputs)
+
+
+# -- op implementations ------------------------------------------------------
+
+def _ints(node: OnnxNode, key: str, default=None):
+    v = node.attrs.get(key, default)
+    return None if v is None else [int(x) for x in v]
+
+
+def _op_conv(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])            # NCHW
+    w = L.val(env, node.inputs[1])            # [O, I/g, kH, kW]
+    b = L.val(env, node.inputs[2]) if len(node.inputs) > 2 else None
+    spatial = x.ndim - 2
+    kernel = _ints(node, "kernel_shape") or list(w.shape[2:])
+    strides = _ints(node, "strides") or [1] * spatial
+    dilations = _ints(node, "dilations") or [1] * spatial
+    group = int(node.attrs.get("group", 1))
+    auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+    if auto_pad and auto_pad not in (b"NOTSET", "NOTSET"):
+        pads = _auto_pad(auto_pad, x.shape[2:], kernel, strides, dilations)
+    else:
+        pads = _act_pads(_ints(node, "pads") or [0] * (2 * spatial), x.ndim)
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else None
+    if spatial == 1:
+        # lift 1-D conv to 2-D (XLA tiles 2-D convs onto the MXU)
+        x2 = x[:, :, None, :]
+        w2 = w[:, :, None, :]
+        y = lax.conv_general_dilated(
+            x2, w2, window_strides=(1, strides[0]),
+            padding=[(0, 0), pads[0]],
+            rhs_dilation=(1, dilations[0]),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=group)
+        y = y[:, :, 0, :]
+    elif spatial == 2:
+        y = lax.conv_general_dilated(
+            x, w, window_strides=tuple(strides), padding=pads,
+            rhs_dilation=tuple(dilations), dimension_numbers=dn,
+            feature_group_count=group)
+    else:
+        raise OnnxLowerError(f"Conv with {spatial} spatial dims")
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * spatial)
+    L.set_out(env, node, y)
+
+
+def _op_conv_transpose(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])            # NCHW
+    w = L.val(env, node.inputs[1])            # [I, O/g, kH, kW]
+    b = L.val(env, node.inputs[2]) if len(node.inputs) > 2 else None
+    if int(node.attrs.get("group", 1)) != 1:
+        raise OnnxLowerError("grouped ConvTranspose")
+    spatial = x.ndim - 2
+    if spatial != 2:
+        raise OnnxLowerError("ConvTranspose only 2-D")
+    strides = _ints(node, "strides") or [1, 1]
+    pads = _act_pads(_ints(node, "pads") or [0, 0, 0, 0], x.ndim)
+    out_pads = _ints(node, "output_padding") or [0, 0]
+    kh, kw = w.shape[2], w.shape[3]
+    # gradient-style: lhs-dilate by stride, VALID conv with flipped kernel
+    w_flip = jnp.flip(w, axis=(2, 3))
+    w_t = jnp.transpose(w_flip, (1, 0, 2, 3))  # [O, I, kH, kW]
+    y = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0][0], kh - 1 - pads[0][1] + out_pads[0]),
+                 (kw - 1 - pads[1][0], kw - 1 - pads[1][1] + out_pads[1])],
+        lhs_dilation=tuple(strides),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    L.set_out(env, node, y)
+
+
+def _pool(L: _Lowering, env, node: OnnxNode, kind: str):
+    x = L.val(env, node.inputs[0])
+    spatial = x.ndim - 2
+    kernel = _ints(node, "kernel_shape")
+    strides = _ints(node, "strides") or [1] * spatial
+    if _ints(node, "dilations", [1] * spatial) != [1] * spatial:
+        raise OnnxLowerError(f"{node.op_type} with dilations")
+    if int(node.attrs.get("ceil_mode", 0)):
+        raise OnnxLowerError(f"{node.op_type} ceil_mode")
+    auto_pad = node.attrs.get("auto_pad", b"NOTSET")
+    if auto_pad and auto_pad not in (b"NOTSET", "NOTSET"):
+        pads = _auto_pad(auto_pad, x.shape[2:], kernel, strides,
+                         [1] * spatial)
+    else:
+        pads = _act_pads(_ints(node, "pads") or [0] * (2 * spatial), x.ndim)
+    window = (1, 1) + tuple(kernel)
+    wstrides = (1, 1) + tuple(strides)
+    wpads = [(0, 0), (0, 0)] + pads
+    if kind == "max":
+        y = lax.reduce_window(x, -jnp.inf, lax.max, window, wstrides, wpads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, wstrides, wpads)
+        if int(node.attrs.get("count_include_pad", 0)):
+            y = summed / float(np.prod(kernel))
+        else:
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add, window, wstrides, wpads)
+            y = summed / counts
+    L.set_out(env, node, y)
+
+
+def _op_gemm(L: _Lowering, env, node: OnnxNode):
+    a = L.val(env, node.inputs[0])
+    b = L.val(env, node.inputs[1])
+    c = L.val(env, node.inputs[2]) if len(node.inputs) > 2 else None
+    alpha = float(node.attrs.get("alpha", 1.0))
+    beta = float(node.attrs.get("beta", 1.0))
+    if int(node.attrs.get("transA", 0)):
+        a = a.T
+    if int(node.attrs.get("transB", 0)):
+        b = b.T
+    y = alpha * (a @ b)
+    if c is not None and beta:
+        y = y + beta * c
+    L.set_out(env, node, y)
+
+
+def _op_batchnorm(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    scale = L.val(env, node.inputs[1])
+    bias = L.val(env, node.inputs[2])
+    mean = L.val(env, node.inputs[3])
+    var = L.val(env, node.inputs[4])
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mean.reshape(shape)) * (
+        scale.reshape(shape) / jnp.sqrt(var.reshape(shape) + eps)
+    ) + bias.reshape(shape)
+    L.set_out(env, node, y)
+
+
+def _op_layernorm(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    scale = L.val(env, node.inputs[1])
+    bias = L.val(env, node.inputs[2]) if len(node.inputs) > 2 else None
+    axis = int(node.attrs.get("axis", -1))
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=axes, keepdims=True)
+    y = (x - mu) / jnp.sqrt(var + eps) * scale
+    if bias is not None:
+        y = y + bias
+    L.set_out(env, node, y)
+
+
+def _op_reshape(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    shape = [int(v) for v in L.static_val(env, node.inputs[1]).ravel()]
+    if not int(node.attrs.get("allowzero", 0)):
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    L.set_out(env, node, jnp.reshape(x, shape))
+
+
+def _op_flatten(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    axis = int(node.attrs.get("axis", 1)) % (x.ndim + 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    L.set_out(env, node, jnp.reshape(x, (lead, -1)))
+
+
+def _op_transpose(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    perm = _ints(node, "perm") or list(range(x.ndim))[::-1]
+    L.set_out(env, node, jnp.transpose(x, perm))
+
+
+def _op_concat(L: _Lowering, env, node: OnnxNode):
+    parts = [L.val(env, n) for n in node.inputs]
+    axis = int(node.attrs.get("axis", 0))
+    statics = [L.maybe_static(env, n) for n in node.inputs]
+    static = (np.concatenate(statics, axis=axis)
+              if all(s is not None for s in statics) else None)
+    L.set_out(env, node, jnp.concatenate(parts, axis=axis), static)
+
+
+def _op_softmax(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    axis = int(node.attrs.get("axis", -1))
+    L.set_out(env, node, jax.nn.softmax(x, axis=axis))
+
+
+def _op_clip(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    lo = (L.val(env, node.inputs[1])
+          if len(node.inputs) > 1 and node.inputs[1] else
+          node.attrs.get("min"))
+    hi = (L.val(env, node.inputs[2])
+          if len(node.inputs) > 2 and node.inputs[2] else
+          node.attrs.get("max"))
+    y = x
+    if lo is not None:
+        y = jnp.maximum(y, lo)
+    if hi is not None:
+        y = jnp.minimum(y, hi)
+    L.set_out(env, node, y)
+
+
+def _op_shape(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    shape = np.asarray(x.shape, np.int64)
+    L.set_out(env, node, jnp.asarray(shape.astype(np.int32)), shape)
+
+
+def _op_gather(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    axis = int(node.attrs.get("axis", 0))
+    idx_static = L.maybe_static(env, node.inputs[1])
+    x_static = L.maybe_static(env, node.inputs[0])
+    if idx_static is not None and x_static is not None:
+        static = np.take(x_static, idx_static.astype(np.int64), axis=axis)
+    else:
+        static = None
+    idx = (jnp.asarray(idx_static.astype(np.int32))
+           if idx_static is not None
+           else env[node.inputs[1]].astype(jnp.int32))
+    L.set_out(env, node, jnp.take(x, idx, axis=axis), static)
+
+
+def _op_unsqueeze(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    if len(node.inputs) > 1:                   # opset >= 13: axes input
+        axes = [int(v) for v in L.static_val(env, node.inputs[1]).ravel()]
+    else:
+        axes = _ints(node, "axes")
+    y = x
+    for ax in sorted(a % (x.ndim + len(axes)) for a in axes):
+        y = jnp.expand_dims(y, ax)
+    s = L.maybe_static(env, node.inputs[0])
+    static = None
+    if s is not None:
+        static = s
+        for ax in sorted(a % (s.ndim + len(axes)) for a in axes):
+            static = np.expand_dims(static, ax)
+    L.set_out(env, node, y, static)
+
+
+def _op_squeeze(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    if len(node.inputs) > 1 and node.inputs[1]:
+        axes = tuple(int(v) % x.ndim
+                     for v in L.static_val(env, node.inputs[1]).ravel())
+    else:
+        axes = tuple(_ints(node, "axes") or
+                     [i for i, d in enumerate(x.shape) if d == 1])
+    L.set_out(env, node, jnp.squeeze(x, axis=axes))
+
+
+def _op_slice(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    if len(node.inputs) > 1:                   # opset >= 10: inputs
+        starts = L.static_val(env, node.inputs[1]).ravel()
+        ends = L.static_val(env, node.inputs[2]).ravel()
+        axes = (L.static_val(env, node.inputs[3]).ravel()
+                if len(node.inputs) > 3 and node.inputs[3]
+                else np.arange(len(starts)))
+        steps = (L.static_val(env, node.inputs[4]).ravel()
+                 if len(node.inputs) > 4 and node.inputs[4]
+                 else np.ones(len(starts), np.int64))
+    else:                                      # opset 1 attrs
+        starts = np.asarray(_ints(node, "starts"))
+        ends = np.asarray(_ints(node, "ends"))
+        axes = np.asarray(_ints(node, "axes") or range(len(starts)))
+        steps = np.ones(len(starts), np.int64)
+    idx: List[Any] = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        ax = int(ax) % x.ndim
+        idx[ax] = slice(int(st), None if en >= 2**31 - 1 else int(en),
+                        int(sp))
+    s = L.maybe_static(env, node.inputs[0])
+    static = s[tuple(idx)] if s is not None else None
+    L.set_out(env, node, x[tuple(idx)], static)
+
+
+def _op_split(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    axis = int(node.attrs.get("axis", 0)) % x.ndim
+    if len(node.inputs) > 1 and node.inputs[1]:
+        sizes = [int(v) for v in L.static_val(env, node.inputs[1]).ravel()]
+    else:
+        sizes = _ints(node, "split")
+    if sizes:
+        bounds = np.cumsum(sizes)[:-1].tolist()
+        parts = jnp.split(x, bounds, axis=axis)
+    else:
+        parts = jnp.split(x, len(node.outputs), axis=axis)
+    for name, part in zip(node.outputs, parts):
+        env[name] = part
+
+
+def _op_pad(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    mode = node.attrs.get("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if len(node.inputs) > 1:
+        pads = [int(v) for v in L.static_val(env, node.inputs[1]).ravel()]
+        value = 0.0
+        if len(node.inputs) > 2 and node.inputs[2]:
+            value = float(np.asarray(
+                L.static_val(env, node.inputs[2])).ravel()[0])
+    else:
+        pads = _ints(node, "pads")
+        value = float(node.attrs.get("value", 0.0))
+    half = len(pads) // 2
+    widths = [(pads[i], pads[i + half]) for i in range(half)]
+    if mode == "constant":
+        y = jnp.pad(x, widths, constant_values=value)
+    elif mode in ("reflect", "edge"):
+        y = jnp.pad(x, widths, mode="reflect" if mode == "reflect"
+                    else "edge")
+    else:
+        raise OnnxLowerError(f"Pad mode {mode!r}")
+    L.set_out(env, node, y)
+
+
+def _op_resize(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])             # NCHW
+    if x.ndim != 4:
+        raise OnnxLowerError("Resize only 4-D NCHW")
+    def sizes_from_scales(scales) -> Optional[List[int]]:
+        scales = np.asarray(scales, np.float64).ravel()
+        if not scales.size:
+            return None
+        return [int(round(d * s)) for d, s in zip(x.shape, scales)]
+
+    sizes = None
+    if len(node.inputs) > 3 and node.inputs[3]:
+        # Resize-11+: [X, roi, scales, sizes]
+        sizes = [int(v) for v in L.static_val(env, node.inputs[3]).ravel()]
+    elif len(node.inputs) > 2 and node.inputs[2]:
+        sizes = sizes_from_scales(L.static_val(env, node.inputs[2]))
+    elif len(node.inputs) > 1 and node.inputs[1]:
+        # Resize-10 / Upsample-9: [X, scales]
+        sizes = sizes_from_scales(L.static_val(env, node.inputs[1]))
+    elif node.attrs.get("scales"):
+        # Upsample-7: scales attribute
+        sizes = sizes_from_scales(node.attrs["scales"])
+    if sizes is None:
+        raise OnnxLowerError("Resize/Upsample without static scales/sizes")
+    mode = node.attrs.get("mode", b"nearest")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    coord = node.attrs.get(
+        "coordinate_transformation_mode", b"half_pixel")
+    coord = coord.decode() if isinstance(coord, bytes) else coord
+    out_h, out_w = sizes[2], sizes[3]
+    # reuse the tflite coordinate machinery (NHWC) via a transpose
+    from .tflite_lower import _resize_bilinear, _resize_nearest
+
+    xn = jnp.transpose(x, (0, 2, 3, 1))
+    align = coord == "align_corners"
+    half = coord in ("half_pixel", "pytorch_half_pixel")
+    if mode == "nearest":
+        yn = _resize_nearest(xn, out_h, out_w, align, half)
+    elif mode in ("linear", "cubic"):          # cubic approximated linear
+        yn = _resize_bilinear(xn, out_h, out_w, align, half)
+    else:
+        raise OnnxLowerError(f"Resize mode {mode!r}")
+    L.set_out(env, node, jnp.transpose(yn, (0, 3, 1, 2)))
+
+
+def _op_cast(L: _Lowering, env, node: OnnxNode):
+    from .onnx_reader import ONNX_DTYPES
+
+    x = L.val(env, node.inputs[0])
+    to = ONNX_DTYPES.get(int(node.attrs.get("to", 1)), "float32")
+    np_dtype = np.dtype("int32" if to == "int64" else to)
+    s = L.maybe_static(env, node.inputs[0])
+    L.set_out(env, node, x.astype(np_dtype),
+              None if s is None else s.astype(np.dtype(to)))
+
+
+def _op_constant_of_shape(L: _Lowering, env, node: OnnxNode):
+    shape = [int(v) for v in L.static_val(env, node.inputs[0]).ravel()]
+    value = node.attrs.get("value")
+    fill = float(np.asarray(value).ravel()[0]) if value is not None else 0.0
+    dtype = np.asarray(value).dtype if value is not None else np.float32
+    L.set_out(env, node, jnp.full(shape, fill, dtype),
+              np.full(shape, fill, dtype))
+
+
+def _op_expand(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    shape = [int(v) for v in L.static_val(env, node.inputs[1]).ravel()]
+    # ONNX Expand broadcast: dims of 1 in shape take x's dim
+    full = list(np.broadcast_shapes(tuple(x.shape), tuple(shape)))
+    L.set_out(env, node, jnp.broadcast_to(x, full))
+
+
+def _op_reduce(fn, default_keep=1):
+    def impl(L: _Lowering, env, node: OnnxNode):
+        x = L.val(env, node.inputs[0])
+        if len(node.inputs) > 1 and node.inputs[1]:   # opset >= 18
+            axes = tuple(int(v) % x.ndim
+                         for v in L.static_val(env, node.inputs[1]).ravel())
+        else:
+            raw = _ints(node, "axes")
+            axes = (tuple(a % x.ndim for a in raw) if raw
+                    else tuple(range(x.ndim)))
+        keep = bool(int(node.attrs.get("keepdims", default_keep)))
+        L.set_out(env, node, fn(x, axis=axes, keepdims=keep))
+    return impl
+
+
+def _op_argmax(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    axis = int(node.attrs.get("axis", 0))
+    keep = bool(int(node.attrs.get("keepdims", 1)))
+    y = jnp.argmax(x, axis=axis).astype(jnp.int32)
+    if keep:
+        y = jnp.expand_dims(y, axis)
+    L.set_out(env, node, y)
+
+
+def _binop(fn):
+    def impl(L: _Lowering, env, node: OnnxNode):
+        a = L.val(env, node.inputs[0])
+        b = L.val(env, node.inputs[1])
+        sa = L.maybe_static(env, node.inputs[0])
+        sb = L.maybe_static(env, node.inputs[1])
+        static = None
+        if sa is not None and sb is not None:
+            try:
+                static = fn(sa, sb)
+            except Exception:  # noqa: BLE001 — fold is best-effort
+                static = None
+        L.set_out(env, node, fn(a, b), static)
+    return impl
+
+
+def _unop(fn):
+    def impl(L: _Lowering, env, node: OnnxNode):
+        L.set_out(env, node, fn(L.val(env, node.inputs[0])))
+    return impl
+
+
+def _op_identity(L: _Lowering, env, node: OnnxNode):
+    L.set_out(env, node, L.val(env, node.inputs[0]),
+              L.maybe_static(env, node.inputs[0]))
+
+
+def _op_dropout(L: _Lowering, env, node: OnnxNode):
+    # inference: identity; optional mask output = all true
+    x = L.val(env, node.inputs[0])
+    env[node.outputs[0]] = x
+    if len(node.outputs) > 1:
+        env[node.outputs[1]] = jnp.ones(x.shape, bool)
+
+
+def _op_where(L: _Lowering, env, node: OnnxNode):
+    c = L.val(env, node.inputs[0])
+    a = L.val(env, node.inputs[1])
+    b = L.val(env, node.inputs[2])
+    L.set_out(env, node, jnp.where(c, a, b))
+
+
+def _op_prelu(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    alpha = L.val(env, node.inputs[1])
+    L.set_out(env, node, jnp.where(x >= 0, x, x * alpha))
+
+
+def _op_lrn(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])             # NCHW
+    size = int(node.attrs["size"])
+    alpha = float(node.attrs.get("alpha", 1e-4))
+    beta = float(node.attrs.get("beta", 0.75))
+    bias = float(node.attrs.get("k", 1.0))
+    half = size // 2
+    sq = x * x
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    acc = sum(sq[:, i:i + x.shape[1]] for i in range(size))
+    L.set_out(env, node, x / (bias + alpha / size * acc) ** beta)
+
+
+_OP_IMPLS: Dict[str, Callable] = {
+    "Conv": _op_conv,
+    "ConvTranspose": _op_conv_transpose,
+    "MaxPool": lambda L, e, n: _pool(L, e, n, "max"),
+    "AveragePool": lambda L, e, n: _pool(L, e, n, "avg"),
+    "GlobalAveragePool": _unop(
+        lambda x: jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)),
+    "GlobalMaxPool": _unop(
+        lambda x: jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)),
+    "Gemm": _op_gemm,
+    "MatMul": _binop(jnp.matmul),
+    "BatchNormalization": _op_batchnorm,
+    "LayerNormalization": _op_layernorm,
+    "InstanceNormalization": lambda L, e, n: _op_instancenorm(L, e, n),
+    "LRN": _op_lrn,
+    "Relu": _unop(jax.nn.relu),
+    "LeakyRelu": lambda L, e, n: L.set_out(
+        e, n, jnp.where(
+            L.val(e, n.inputs[0]) >= 0, L.val(e, n.inputs[0]),
+            L.val(e, n.inputs[0]) * float(n.attrs.get("alpha", 0.01)))),
+    "PRelu": _op_prelu,
+    "Sigmoid": _unop(jax.nn.sigmoid),
+    "HardSigmoid": lambda L, e, n: L.set_out(
+        e, n, jnp.clip(
+            L.val(e, n.inputs[0]) * float(n.attrs.get("alpha", 0.2))
+            + float(n.attrs.get("beta", 0.5)), 0.0, 1.0)),
+    "Tanh": _unop(jnp.tanh),
+    "Erf": _unop(jax.scipy.special.erf),
+    "Gelu": _unop(jax.nn.gelu),
+    "Softplus": _unop(jax.nn.softplus),
+    "Softmax": _op_softmax,
+    "LogSoftmax": lambda L, e, n: L.set_out(
+        e, n, jax.nn.log_softmax(
+            L.val(e, n.inputs[0]), axis=int(n.attrs.get("axis", -1)))),
+    "Clip": _op_clip,
+    "Add": _binop(jnp.add),
+    "Sub": _binop(jnp.subtract),
+    "Mul": _binop(jnp.multiply),
+    "Div": _binop(jnp.divide),
+    "Pow": _binop(jnp.power),
+    "Min": _binop(jnp.minimum),
+    "Max": _binop(jnp.maximum),
+    "Equal": _binop(lambda a, b: a == b),
+    "Greater": _binop(lambda a, b: a > b),
+    "Less": _binop(lambda a, b: a < b),
+    "Sqrt": _unop(jnp.sqrt),
+    "Exp": _unop(jnp.exp),
+    "Log": _unop(jnp.log),
+    "Abs": _unop(jnp.abs),
+    "Neg": _unop(jnp.negative),
+    "Floor": _unop(jnp.floor),
+    "Ceil": _unop(jnp.ceil),
+    "Reciprocal": _unop(lambda x: 1.0 / x),
+    "Reshape": _op_reshape,
+    "Flatten": _op_flatten,
+    "Transpose": _op_transpose,
+    "Concat": _op_concat,
+    "Shape": _op_shape,
+    "Gather": _op_gather,
+    "Unsqueeze": _op_unsqueeze,
+    "Squeeze": _op_squeeze,
+    "Slice": _op_slice,
+    "Split": _op_split,
+    "Pad": _op_pad,
+    "Resize": _op_resize,
+    "Upsample": _op_resize,
+    "Cast": _op_cast,
+    "ConstantOfShape": _op_constant_of_shape,
+    "Expand": _op_expand,
+    "ReduceMean": _op_reduce(jnp.mean),
+    "ReduceSum": _op_reduce(jnp.sum),
+    "ReduceMax": _op_reduce(jnp.max),
+    "ReduceMin": _op_reduce(jnp.min),
+    "ReduceProd": _op_reduce(jnp.prod),
+    "ArgMax": _op_argmax,
+    "Identity": _op_identity,
+    "Dropout": _op_dropout,
+    "Where": _op_where,
+}
+
+
+def _op_instancenorm(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    scale = L.val(env, node.inputs[1])
+    bias = L.val(env, node.inputs[2])
+    eps = float(node.attrs.get("epsilon", 1e-5))
+    axes = tuple(range(2, x.ndim))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    L.set_out(env, node, (x - mu) / jnp.sqrt(var + eps)
+              * scale.reshape(shape) + bias.reshape(shape))
+
+
+def lower_onnx(model: OnnxModel, jit: bool = True) -> Callable:
+    """Build ``fn(*inputs) -> tuple(outputs)`` from the ONNX graph."""
+    lowering = _Lowering(model)
+    return jax.jit(lowering) if jit else lowering

@@ -1,0 +1,377 @@
+"""ONNX importer: parse .onnx protobufs and lower to XLA.
+
+No ``onnx`` package (and no torch.onnx export) exists in this image, so
+test models are hand-encoded with a minimal protobuf writer below — an
+independent encoder against the public onnx.proto3 schema — and op
+semantics are cross-checked against torch (an independent conv/pool
+implementation).  ≙ reference onnx-capable subplugin tests
+(``tests/nnstreamer_filter_*``), but the runtime here is XLA.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.importers.onnx_reader import (
+    OnnxParseError, read_onnx)
+from nnstreamer_tpu.importers.onnx_lower import (
+    OnnxLowerError, _Lowering, lower_onnx)
+
+
+# -- minimal protobuf writer (public onnx.proto3 field numbers) --------------
+
+def _varint(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(fno: int, wt: int, payload: bytes) -> bytes:
+    return _varint((fno << 3) | wt) + payload
+
+
+def _ld(fno: int, data: bytes) -> bytes:
+    return _field(fno, 2, _varint(len(data)) + data)
+
+
+def _vint(fno: int, v: int) -> bytes:
+    return _field(fno, 0, _varint(v))
+
+
+_DTYPE_CODES = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6,
+                "int64": 7, "bool": 9, "float64": 11}
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    out = b"".join(_vint(1, int(d)) for d in arr.shape)
+    out += _vint(2, _DTYPE_CODES[str(arr.dtype)])
+    out += _ld(8, name.encode())
+    out += _ld(9, arr.tobytes())
+    return out
+
+
+def attr_proto(name: str, value) -> bytes:
+    out = _ld(1, name.encode())
+    if isinstance(value, float):
+        out += _field(2, 5, struct.pack("<f", value)) + _vint(20, 1)
+    elif isinstance(value, bool) or isinstance(value, int):
+        out += _vint(3, int(value)) + _vint(20, 2)
+    elif isinstance(value, bytes):
+        out += _ld(4, value) + _vint(20, 3)
+    elif isinstance(value, np.ndarray):
+        out += _ld(5, tensor_proto("", value)) + _vint(20, 4)
+    elif isinstance(value, (list, tuple)) and value and isinstance(
+            value[0], float):
+        out += _ld(7, b"".join(struct.pack("<f", v) for v in value))
+        out += _vint(20, 6)
+    elif isinstance(value, (list, tuple)):
+        out += _ld(8, b"".join(_varint(int(v)) for v in value))
+        out += _vint(20, 7)
+    else:
+        raise TypeError(type(value))
+    return out
+
+
+def node_proto(op: str, inputs, outputs, **attrs) -> bytes:
+    out = b"".join(_ld(1, i.encode()) for i in inputs)
+    out += b"".join(_ld(2, o.encode()) for o in outputs)
+    out += _ld(4, op.encode())
+    out += b"".join(_ld(5, attr_proto(k, v)) for k, v in attrs.items())
+    return out
+
+
+def value_info(name: str, shape, dtype="float32") -> bytes:
+    dims = b"".join(_ld(1, _vint(1, int(d))) for d in shape)
+    tensor_type = _vint(1, _DTYPE_CODES[dtype]) + _ld(2, dims)
+    return _ld(1, name.encode()) + _ld(2, _ld(1, tensor_type))
+
+
+def model_proto(nodes, initializers, inputs, outputs, opset=13) -> bytes:
+    graph = b"".join(_ld(1, n) for n in nodes)
+    graph += b"".join(_ld(5, t) for t in initializers)
+    graph += b"".join(_ld(11, v) for v in inputs)
+    graph += b"".join(_ld(12, v) for v in outputs)
+    model = _vint(1, 8)                       # ir_version
+    model += _ld(8, _vint(2, opset))          # opset_import
+    model += _ld(7, graph)
+    return model
+
+
+# -- fixture models ----------------------------------------------------------
+
+def build_mlp() -> bytes:
+    """x(1,8) -> Gemm(w1,b1) -> Relu -> Gemm(w2,b2) -> Softmax."""
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((8, 16), np.float32)
+    b1 = rng.standard_normal(16).astype(np.float32)
+    w2 = rng.standard_normal((16, 4), np.float32)
+    b2 = rng.standard_normal(4).astype(np.float32)
+    nodes = [
+        node_proto("Gemm", ["x", "w1", "b1"], ["h"]),
+        node_proto("Relu", ["h"], ["hr"]),
+        node_proto("Gemm", ["hr", "w2", "b2"], ["logits"]),
+        node_proto("Softmax", ["logits"], ["y"], axis=-1),
+    ]
+    inits = [tensor_proto("w1", w1), tensor_proto("b1", b1),
+             tensor_proto("w2", w2), tensor_proto("b2", b2)]
+    blob = model_proto(
+        nodes, inits,
+        [value_info("x", (1, 8))], [value_info("y", (1, 4))])
+    return blob, (w1, b1, w2, b2)
+
+
+def build_cnn() -> bytes:
+    """x(1,3,16,16) -> Conv(s2,p1) -> BatchNorm -> Relu -> MaxPool(2) ->
+    GlobalAveragePool -> Flatten -> Gemm."""
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((8, 3, 3, 3), np.float32) * 0.2
+    b = rng.standard_normal(8).astype(np.float32)
+    gamma = rng.random(8).astype(np.float32) + 0.5
+    beta = rng.standard_normal(8).astype(np.float32)
+    mean = rng.standard_normal(8).astype(np.float32)
+    var = rng.random(8).astype(np.float32) + 0.5
+    fc_w = rng.standard_normal((8, 5), np.float32)
+    fc_b = rng.standard_normal(5).astype(np.float32)
+    nodes = [
+        node_proto("Conv", ["x", "w", "b"], ["c"],
+                   kernel_shape=[3, 3], strides=[2, 2], pads=[1, 1, 1, 1]),
+        node_proto("BatchNormalization",
+                   ["c", "gamma", "beta", "mean", "var"], ["bn"],
+                   epsilon=1e-5),
+        node_proto("Relu", ["bn"], ["r"]),
+        node_proto("MaxPool", ["r"], ["p"],
+                   kernel_shape=[2, 2], strides=[2, 2]),
+        node_proto("GlobalAveragePool", ["p"], ["g"]),
+        node_proto("Flatten", ["g"], ["f"], axis=1),
+        node_proto("Gemm", ["f", "fc_w", "fc_b"], ["y"]),
+    ]
+    inits = [tensor_proto(n, a) for n, a in [
+        ("w", w), ("b", b), ("gamma", gamma), ("beta", beta),
+        ("mean", mean), ("var", var), ("fc_w", fc_w), ("fc_b", fc_b)]]
+    blob = model_proto(
+        nodes, inits,
+        [value_info("x", (1, 3, 16, 16))], [value_info("y", (1, 5))])
+    return blob, (w, b, gamma, beta, mean, var, fc_w, fc_b)
+
+
+def build_shape_chain() -> bytes:
+    """The torch-export flatten idiom: Shape -> Gather -> Unsqueeze ->
+    Concat with [-1] -> Reshape."""
+    nodes = [
+        node_proto("Shape", ["x"], ["s"]),
+        node_proto("Gather", ["s", "i0"], ["n"], axis=0),
+        node_proto("Unsqueeze", ["n", "ax0"], ["nu"]),
+        node_proto("Concat", ["nu", "minus1"], ["tgt"], axis=0),
+        node_proto("Reshape", ["x", "tgt"], ["y"]),
+    ]
+    inits = [
+        tensor_proto("i0", np.asarray(0, np.int64)),
+        tensor_proto("ax0", np.asarray([0], np.int64)),
+        tensor_proto("minus1", np.asarray([-1], np.int64)),
+    ]
+    return model_proto(
+        nodes, inits,
+        [value_info("x", (2, 3, 4))], [value_info("y", (2, 12))])
+
+
+# -- parser ------------------------------------------------------------------
+
+class TestOnnxReader:
+    def test_rejects_garbage(self):
+        with pytest.raises(OnnxParseError):
+            read_onnx(b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+        with pytest.raises(OnnxParseError):
+            read_onnx(b"TFL3 is not onnx....")
+
+    def test_mlp_structure(self):
+        blob, _ = build_mlp()
+        m = read_onnx(blob)
+        assert m.opset == 13
+        assert [vi.name for vi in m.inputs] == ["x"]  # inits excluded
+        assert m.inputs[0].shape == (1, 8)
+        assert m.op_histogram() == {
+            "Gemm": 2, "Relu": 1, "Softmax": 1}
+        assert m.initializers["w1"].shape == (8, 16)
+
+    def test_negative_int_attr(self):
+        blob, _ = build_mlp()
+        m = read_onnx(blob)
+        soft = [n for n in m.nodes if n.op_type == "Softmax"][0]
+        assert soft.attrs["axis"] == -1  # two's-complement varint decode
+
+
+# -- lowering ----------------------------------------------------------------
+
+class TestOnnxLowering:
+    def test_mlp_matches_numpy(self):
+        blob, (w1, b1, w2, b2) = build_mlp()
+        fn = lower_onnx(read_onnx(blob))
+        x = np.random.default_rng(2).standard_normal((1, 8)).astype(
+            np.float32)
+        (y,) = fn(x)
+        h = np.maximum(x @ w1 + b1, 0)
+        logits = h @ w2 + b2
+        e = np.exp(logits - logits.max())
+        want = e / e.sum()
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5)
+
+    def test_cnn_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        blob, (w, b, gamma, beta, mean, var, fc_w, fc_b) = build_cnn()
+        fn = lower_onnx(read_onnx(blob))
+        x = np.random.default_rng(3).standard_normal(
+            (1, 3, 16, 16)).astype(np.float32)
+        (y,) = fn(x)
+
+        xt = torch.from_numpy(x)
+        c = F.conv2d(xt, torch.from_numpy(w), torch.from_numpy(b),
+                     stride=2, padding=1)
+        bn = F.batch_norm(c, torch.from_numpy(mean), torch.from_numpy(var),
+                          torch.from_numpy(gamma), torch.from_numpy(beta),
+                          eps=1e-5)
+        p = F.max_pool2d(F.relu(bn), 2, 2)
+        g = p.mean(dim=(2, 3))
+        want = (g @ torch.from_numpy(fc_w) + torch.from_numpy(fc_b)).numpy()
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_shape_chain_folds(self):
+        fn = lower_onnx(read_onnx(build_shape_chain()))
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        (y,) = fn(x)
+        np.testing.assert_array_equal(np.asarray(y), x.reshape(2, 12))
+
+    def test_unsupported_op_clear_error(self):
+        nodes = [node_proto("NonMaxSuppression", ["x"], ["y"])]
+        blob = model_proto(nodes, [], [value_info("x", (1,))],
+                           [value_info("y", (1,))])
+        with pytest.raises(OnnxLowerError, match="NonMaxSuppression"):
+            _Lowering(read_onnx(blob))
+
+    def test_data_dependent_shape_clear_error(self):
+        # Reshape target computed from runtime DATA (not shapes) must be
+        # rejected, not silently mis-traced
+        nodes = [
+            node_proto("Cast", ["x"], ["xi"], to=7),
+            node_proto("Reshape", ["x", "xi"], ["y"]),
+        ]
+        blob = model_proto(nodes, [], [value_info("x", (2,))],
+                           [value_info("y", (2,))])
+        fn = lower_onnx(read_onnx(blob), jit=False)
+        with pytest.raises(OnnxLowerError, match="statically known"):
+            fn(np.ones(2, np.float32))
+
+
+# -- backend -----------------------------------------------------------------
+
+class TestOnnxBackend:
+    @pytest.fixture()
+    def mlp_file(self, tmp_path):
+        blob, _ = build_mlp()
+        p = tmp_path / "mlp.onnx"
+        p.write_bytes(blob)
+        return str(p)
+
+    def test_framework_auto_pipeline(self, mlp_file):
+        from nnstreamer_tpu.elements.filter import detect_framework
+        from nnstreamer_tpu.pipeline import parse_pipeline
+
+        assert detect_framework(mlp_file) == "onnx"
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_filter framework=auto "
+            f"model={mlp_file} ! tensor_sink name=out"
+        )
+        pipe.start()
+        for _ in range(3):
+            pipe["src"].push(np.ones((1, 8), np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        outs = [np.asarray(f.tensors[0]) for f in pipe["out"].frames]
+        pipe.stop()
+        assert len(outs) == 3 and outs[0].shape == (1, 4)
+        np.testing.assert_allclose(outs[0].sum(), 1.0, rtol=1e-5)
+
+    def test_invoke_batch_vmaps(self, mlp_file):
+        from nnstreamer_tpu.backends.onnx_import import OnnxBackend
+
+        be = OnnxBackend()
+        be.open(mlp_file, {})
+        try:
+            xs = np.random.default_rng(4).standard_normal(
+                (6, 1, 8)).astype(np.float32)
+            (out,) = be.invoke_batch([xs])
+            out = np.asarray(out)
+            assert out.shape == (6, 1, 4)
+            for i in range(6):
+                (want,) = be.invoke([xs[i]])
+                np.testing.assert_allclose(out[i], np.asarray(want),
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            be.close()
+
+    def test_model_info(self, mlp_file):
+        from nnstreamer_tpu.backends.onnx_import import OnnxBackend
+
+        be = OnnxBackend()
+        be.open(mlp_file, {})
+        try:
+            in_spec, out_spec = be.get_model_info()
+            assert in_spec.tensors[0].shape == (1, 8)
+            assert out_spec.tensors[0].shape == (1, 4)
+        finally:
+            be.close()
+
+
+class TestFixedPaths:
+    def test_auto_pad_valid_is_zero_padding(self):
+        import torch
+        import torch.nn.functional as F
+
+        rng = np.random.default_rng(5)
+        w = rng.standard_normal((4, 3, 3, 3), np.float32)
+        nodes = [node_proto("Conv", ["x", "w"], ["y"],
+                            kernel_shape=[3, 3], strides=[1, 1],
+                            auto_pad=b"VALID")]
+        blob = model_proto(nodes, [tensor_proto("w", w)],
+                           [value_info("x", (1, 3, 5, 5))],
+                           [value_info("y", (1, 4, 3, 3))])
+        fn = lower_onnx(read_onnx(blob))
+        x = rng.standard_normal((1, 3, 5, 5)).astype(np.float32)
+        (y,) = fn(x)
+        assert np.asarray(y).shape == (1, 4, 3, 3)  # not SAME's 5x5
+        want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w)).numpy()
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("style", ["attr", "input1"])
+    def test_upsample_scales(self, style):
+        if style == "attr":  # Upsample-7
+            nodes = [node_proto("Upsample", ["x"], ["y"],
+                                mode=b"nearest",
+                                scales=[1.0, 1.0, 2.0, 2.0])]
+            inits = []
+        else:                # Upsample-9 / Resize-10: scales at inputs[1]
+            nodes = [node_proto("Upsample", ["x", "sc"], ["y"],
+                                mode=b"nearest")]
+            inits = [tensor_proto(
+                "sc", np.asarray([1.0, 1.0, 2.0, 2.0], np.float32))]
+        blob = model_proto(nodes, inits,
+                           [value_info("x", (1, 2, 3, 3))],
+                           [value_info("y", (1, 2, 6, 6))])
+        fn = lower_onnx(read_onnx(blob))
+        x = np.arange(18, dtype=np.float32).reshape(1, 2, 3, 3)
+        (y,) = fn(x)
+        y = np.asarray(y)
+        assert y.shape == (1, 2, 6, 6)
+        np.testing.assert_array_equal(y, x.repeat(2, 2).repeat(2, 3))
