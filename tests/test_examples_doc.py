@@ -14,12 +14,30 @@ DOC = os.path.join(
 )
 
 
+def _fenced_blocks(text):
+    """Line-based fence parser: (language, body) pairs.  A regex over
+    the whole file mis-pairs fences as soon as language-tagged blocks
+    (```python) interleave with plain ones."""
+    blocks, cur, lang = [], None, None
+    for line in text.splitlines():
+        if line.startswith("```"):
+            if cur is None:
+                lang, cur = line[3:].strip(), []
+            else:
+                blocks.append((lang, "\n".join(cur)))
+                cur = None
+        elif cur is not None:
+            cur.append(line)
+    assert cur is None, "unclosed ``` fence in examples.md"
+    return blocks
+
+
 def _our_pipelines():
     text = open(DOC).read()
     out = []
-    for block in re.findall(r"```\n(.*?)```", text, re.S):
-        if "gst-launch-1.0" in block:
-            continue  # reference side of the comparison
+    for lang, block in _fenced_blocks(text):
+        if lang or "gst-launch-1.0" in block:
+            continue  # python snippets / reference side of the comparison
         # strip comments, join backslash continuations
         block = re.sub(r"^#.*$", "", block, flags=re.M)
         block = block.replace("\\\n", " ")
