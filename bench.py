@@ -436,6 +436,7 @@ def main() -> None:
         "batch": int(os.environ.get("BENCH_BATCH", "128")),
         "dtype": os.environ.get("BENCH_DTYPE", "bfloat16"),
         "quantize": "int8" if quant_applied(which) else None,
+        "dispatch_depth": int(os.environ.get("BENCH_DEPTH", "4")),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"

@@ -6,6 +6,8 @@ model info) — but the backend lowers the flatbuffer to JAX in-process
 (``backends/tflite_import.py``); no TFLite runtime exists or is needed.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -134,3 +136,27 @@ class TestTFLiteRealModels:
                 0, 256, (1, 224, 224, 3), np.uint8)
             (out,) = m.invoke([img])
             assert np.asarray(out).shape == (1, 1001)
+
+    def test_deeplab_pipeline_with_segment_decoder(self):
+        """The reference's deeplabv3 .tflite end-to-end: importer backend
+        + tensor_decoder mode=image_segment (tflite-deeplab layout), the
+        canonical reference segmentation pipeline."""
+        model = os.path.join(MODELS, "deeplabv3_257_mv_gpu.tflite")
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_filter framework=auto model={model} ! "
+            "tensor_decoder mode=image_segment option1=tflite-deeplab ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        x = np.random.default_rng(6).random(
+            (1, 257, 257, 3), np.float32) * 2 - 1
+        pipe["src"].push(x)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=300)
+        frames = pipe["out"].frames
+        pipe.stop()
+        out = np.asarray(frames[0].tensors[0])
+        # the decoder emits a palette-rendered RGBA overlay of the argmax
+        # class grid plus a classes_present meta summary
+        assert out.shape == (257, 257, 4) and out.dtype == np.uint8
+        assert "classes_present" in frames[0].meta

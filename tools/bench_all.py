@@ -17,6 +17,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # headline number is published alongside its transfer-inclusive variant
 ROWS = [
     ("mobilenet", {"BENCH_RAW": "1"}),  # headline + same-window raw ref
+    # depth ablation: same window, synchronous dispatch — quantifies what
+    # the depth-4 in-flight window buys on the chip (VERDICT r3 #2)
+    ("mobilenet", {"BENCH_RAW": "1", "BENCH_DEPTH": "1"}),
     ("mobilenet", {"BENCH_HOST": "1"}),
     ("mobilenet", {"BENCH_QUANT": "1"}),  # int8 MXU path
     ("mobilenet", {"BENCH_BATCH": "256"}),  # amortizes per-batch link RTTs
