@@ -16,9 +16,14 @@ param casting, ``mesh_*`` sharded serving, and double-buffered hot reload.
 Custom props (beyond JaxXla's):
 
 * ``fake_quant:false`` — skip per-tensor requantization simulation for
-  quantized models (faster; activations stay float between ops).  Default
-  on (reproduces the integer kernels' saturation/rounding to within one
+  quantized models (faster; activations stay float between ops; the
+  range clamps — which encode fused ReLU6 — are kept).  Default on
+  (reproduces the integer kernels' saturation/rounding to within one
   quantum).
+* ``int8:true`` — quantized conv/depthwise/dense execute as TRUE int8
+  integer arithmetic (int8×int8→int32, the MXU's double-rate path, with
+  the standard zero-point expansion) instead of dequantized float.  The
+  perf mode for quantized imports on TPU.
 
 Batch semantics: TFLite graphs bake a leading batch dim (usually 1) into
 their shapes.  Per-frame ``invoke`` matches the declared shapes; the
@@ -59,7 +64,10 @@ class TFLiteBackend(JaxXla):
         model = read_tflite(model_path)
         fake_quant = self.custom_props.get(
             "fake_quant", "true").lower() not in ("0", "false", "no")
-        lowering = _Lowering(model, fake_quant=fake_quant)
+        int8_compute = self.custom_props.get(
+            "int8", "").lower() in ("1", "true", "yes")
+        lowering = _Lowering(model, fake_quant=fake_quant,
+                             int8_compute=int8_compute)
         params = lowering.params()
         lowering.drop_host_consts()  # run() always gets the params pytree
         in_ranks = tuple(len(model.tensors[i].shape) for i in model.inputs)

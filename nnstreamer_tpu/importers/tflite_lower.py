@@ -193,9 +193,16 @@ class _Lowering:
     """One pass over the graph building a closure env of constants and a
     list of (op, impl) steps; `__call__` replays the steps under jit."""
 
-    def __init__(self, model: TFLiteModel, fake_quant: bool = True):
+    def __init__(self, model: TFLiteModel, fake_quant: bool = True,
+                 int8_compute: bool = False):
         self.m = model
         self.fake_quant = fake_quant
+        # int8_compute: quantized conv/depthwise/dense run as TRUE integer
+        # arithmetic — int8×int8→int32 on the MXU (2× the bf16 rate) with
+        # the standard zero-point expansion, instead of dequantized float.
+        # Elementwise stays float (XLA fuses it; the FLOPs are in the
+        # convs).  See _int8_conv_core for the algebra.
+        self.int8_compute = int8_compute
         # trace-time shape constants (SHAPE / BROADCAST_ARGS results):
         # XLA needs static shapes, so shape-producing ops fold to numpy
         # here and stay usable as shape arguments downstream
@@ -221,10 +228,28 @@ class _Lowering:
                 f"unsupported tflite ops: {', '.join(unsupported)} "
                 f"(supported: {', '.join(sorted(_OP_IMPLS))})")
 
+    def _int8_weight_indices(self) -> set:
+        """Tensor indices whose weights the int8 path reads RAW (baked
+        into the trace as int8 constants) — their dequantized float
+        copies must not ride the params pytree too."""
+        if not self.int8_compute:
+            return set()
+        out = set()
+        for op in self.m.ops:
+            if op.opcode in ("CONV_2D", "DEPTHWISE_CONV_2D",
+                             "FULLY_CONNECTED"):
+                _, _, ok = _int8_quant_triple(self, op)
+                if ok:
+                    out.add(op.inputs[1])
+        return out
+
     def params(self) -> Dict[int, np.ndarray]:
         """The constants as a pytree: pass to :meth:`run` so the caller
-        controls placement (device_put / bf16 cast / mesh sharding)."""
-        return dict(self.consts)
+        controls placement (device_put / bf16 cast / mesh sharding).
+        Weights consumed by the int8 path are excluded (they ship as
+        int8 trace constants; a float copy would waste 4× the HBM)."""
+        skip = self._int8_weight_indices()
+        return {k: v for k, v in self.consts.items() if k not in skip}
 
     def drop_host_consts(self) -> None:
         """Release the host-side dequantized-constant copies.  A caller
@@ -320,11 +345,142 @@ class _Lowering:
         return tuple(outs)
 
 
+# -- true-int8 compute core --------------------------------------------------
+
+def _int8_quant_triple(L: _Lowering, op: TFLOp):
+    """(in_q, w_tensor, usable) for the int8 path: requires per-tensor
+    quant on the input activation and the (constant) weights."""
+    t_in = L.m.tensors[op.inputs[0]]
+    t_w = L.m.tensors[op.inputs[1]]
+    ok = (
+        L.int8_compute
+        and t_in.quant is not None and not t_in.quant.per_channel
+        and t_in.dtype in ("uint8", "int8")
+        and t_w.quant is not None and not t_w.quant.per_channel
+        and t_w.is_const and t_w.dtype in ("uint8", "int8")
+    )
+    return t_in, t_w, ok
+
+
+def _to_i8(q_vals: np.ndarray, dtype: str):
+    """Quantized values -> int8 with the matching zero-point shift
+    (uint8 shifts by 128 so the full 0..255 range fits int8)."""
+    if dtype == "uint8":
+        return (q_vals.astype(np.int32) - 128).astype(np.int8), 128
+    return q_vals.astype(np.int8), 0
+
+
+def _int8_operands(L: _Lowering, op: TFLOp, x):
+    """Shared int8 prep: (x_i8, zp_in_p, s_in, w_i8_np, zp_w_p, s_w) —
+    the float-domain activation quantized to shifted int8 and the raw
+    weights shifted to int8, ready for the zero-point expansion."""
+    t_in, t_w, _ = _int8_quant_triple(L, op)
+    s_in = float(t_in.quant.scale[0])
+    zp_in = int(t_in.quant.zero_point[0])
+    s_w = float(t_w.quant.scale[0])
+    zp_w = int(t_w.quant.zero_point[0])
+    q_x = jnp.round(x / s_in) + zp_in
+    shift_in = 128 if t_in.dtype == "uint8" else 0
+    x_i8 = (q_x - shift_in).astype(jnp.int8)
+    w_i8_np, shift_w = _to_i8(np.asarray(t_w.data), t_w.dtype)
+    return x_i8, zp_in - shift_in, s_in, w_i8_np, zp_w - shift_w, s_w
+
+
+def _int8_epilogue(L: _Lowering, env, op: TFLOp, acc, s_in: float,
+                   s_w: float):
+    """Accumulator -> float domain + bias + fused activation."""
+    y = acc.astype(jnp.float32) * (s_in * s_w)
+    b = (L.val(env, op.inputs[2])
+         if len(op.inputs) > 2 and op.inputs[2] >= 0 else None)
+    if b is not None:
+        y = y + b
+    return _activate(y, op.options["activation"])
+
+
+def _int8_conv_core(L: _Lowering, env, op: TFLOp, x, depthwise: bool):
+    """Quantized conv as integer arithmetic.
+
+    With q_x = x/s_in + zp_in and q_w the stored weights, the real-valued
+    conv expands to
+
+      s_in*s_w * [ conv(q_x - 128, q_w - 128)
+                   - zp_w' * patchsum(q_x - 128)
+                   - zp_in' * sum(q_w - 128)
+                   + K * zp_in' * zp_w' ]
+
+    (primed zero points are shifted by the same 128).  The first conv is
+    int8×int8→int32 — the MXU's double-rate path; the patch-sum is a
+    ones-kernel conv, C_out× cheaper than the main one.  Output returns
+    to the float domain for the fused elementwise tail.
+    """
+    o = op.options
+    x_i8, zp_in_p, s_in, w_i8_np, zp_w_p, s_w = _int8_operands(L, op, x)
+
+    kh, kw = w_i8_np.shape[1], w_i8_np.shape[2]
+    strides = (o["stride_h"], o["stride_w"])
+    dil = (o.get("dilation_h", 1), o.get("dilation_w", 1))
+    # SAME padding must contribute REAL zero, i.e. the shifted zero
+    # point — XLA's implicit conv padding injects 0 in the shifted int8
+    # domain (= a nonzero real value), so pad explicitly and run VALID
+    sp = _conv_padding(o, x.shape, kh, kw)
+    if any(p != (0, 0) for p in sp):
+        x_i8 = jnp.pad(
+            x_i8, [(0, 0), sp[0], sp[1], (0, 0)],
+            constant_values=np.int8(zp_in_p))
+    pads = [(0, 0), (0, 0)]
+
+    if depthwise:
+        in_ch = x.shape[3]
+        w_i8 = jnp.reshape(
+            jnp.transpose(jnp.asarray(w_i8_np), (1, 2, 0, 3)),
+            (kh, kw, 1, -1))
+        dn = ("NHWC", "HWIO", "NHWC")
+        groups = in_ch
+        sum_w = w_i8_np.astype(np.int64).sum(axis=(0, 1, 2))  # per ch*mult
+        ones = jnp.ones((kh, kw, 1, w_i8.shape[-1]), jnp.int8)
+    else:
+        w_i8 = jnp.asarray(w_i8_np)                   # [O, kh, kw, I]
+        dn = ("NHWC", "OHWI", "NHWC")
+        groups = 1
+        sum_w = w_i8_np.astype(np.int64).sum(axis=(1, 2, 3))  # per O
+        ones = jnp.ones((1, kh, kw, x.shape[3]), jnp.int8)
+
+    acc = lax.conv_general_dilated(
+        x_i8, w_i8, window_strides=strides, padding=pads,
+        rhs_dilation=dil, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+
+    if zp_w_p:
+        if depthwise:
+            # per-channel patch sums, broadcast across the multiplier
+            psum = lax.conv_general_dilated(
+                x_i8, ones, window_strides=strides, padding=pads,
+                rhs_dilation=dil, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=x.shape[3],
+                preferred_element_type=jnp.int32)
+        else:
+            psum = lax.conv_general_dilated(
+                x_i8, ones, window_strides=strides, padding=pads,
+                rhs_dilation=dil, dimension_numbers=("NHWC", "OHWI", "NHWC"),
+                preferred_element_type=jnp.int32)
+        acc = acc - zp_w_p * psum
+    k_elems = kh * kw * (1 if depthwise else x.shape[3])
+    acc = acc - jnp.asarray(zp_in_p * sum_w, jnp.int32)
+    acc = acc + jnp.int32(k_elems * zp_in_p * zp_w_p)
+    return _int8_epilogue(L, env, op, acc, s_in, s_w)
+
+
 # -- op implementations -----------------------------------------------------
 # Each: (lowering, env, op) -> writes env[op.outputs[...]]
 
 def _op_conv2d(L: _Lowering, env, op: TFLOp):
     x = L.val(env, op.inputs[0])
+    _, _, int8_ok = _int8_quant_triple(L, op)
+    if int8_ok:
+        y = _int8_conv_core(L, env, op, x, depthwise=False)
+        env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+        return
     w = L.val(env, op.inputs[1])            # [O, Kh, Kw, I]
     b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 else None
     o = op.options
@@ -344,6 +500,11 @@ def _op_conv2d(L: _Lowering, env, op: TFLOp):
 
 def _op_depthwise(L: _Lowering, env, op: TFLOp):
     x = L.val(env, op.inputs[0])
+    _, _, int8_ok = _int8_quant_triple(L, op)
+    if int8_ok:
+        y = _int8_conv_core(L, env, op, x, depthwise=True)
+        env[op.outputs[0]] = L.out_quant(y, op.outputs[0])
+        return
     w = L.val(env, op.inputs[1])            # [1, Kh, Kw, I*mult]
     b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 else None
     o = op.options
@@ -400,11 +561,34 @@ def _op_transpose_conv(L: _Lowering, env, op: TFLOp):
 
 def _op_fully_connected(L: _Lowering, env, op: TFLOp):
     x = L.val(env, op.inputs[0])
-    w = L.val(env, op.inputs[1])            # [O, I]
-    b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 and op.inputs[2] >= 0 else None
     o = op.options
     if o.get("weights_format", 0) != 0:
         raise TFLiteLowerError("FULLY_CONNECTED shuffled-weights format")
+    _, t_w, int8_ok = _int8_quant_triple(L, op)
+    if int8_ok:
+        # dense int8: same zero-point expansion as the conv core, on a
+        # plain MXU matmul contracted over the LAST axis (keep_num_dims
+        # inputs may be rank > 2)
+        in_features = np.asarray(t_w.data).shape[1]
+        if not o.get("keep_num_dims", False):
+            x = jnp.reshape(x, (-1, in_features))
+        x_i8, zp_in_p, s_in, w_i8_np, zp_w_p, s_w = _int8_operands(
+            L, op, x)
+        acc = lax.dot_general(
+            x_i8, jnp.asarray(w_i8_np),
+            (((x_i8.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        if zp_w_p:
+            acc = acc - zp_w_p * jnp.sum(
+                x_i8.astype(jnp.int32), axis=-1, keepdims=True)
+        sum_w = w_i8_np.astype(np.int64).sum(axis=1)
+        acc = acc - jnp.asarray(zp_in_p * sum_w, jnp.int32)
+        acc = acc + jnp.int32(in_features * zp_in_p * zp_w_p)
+        env[op.outputs[0]] = L.out_quant(
+            _int8_epilogue(L, env, op, acc, s_in, s_w), op.outputs[0])
+        return
+    w = L.val(env, op.inputs[1])            # [O, I]
+    b = L.val(env, op.inputs[2]) if len(op.inputs) > 2 and op.inputs[2] >= 0 else None
     if not o.get("keep_num_dims", False):
         x = jnp.reshape(x, (-1, w.shape[1]))
     y = x @ w.T
@@ -770,12 +954,15 @@ _OP_IMPLS: Dict[str, Callable] = {
 
 
 def lower_tflite(model: TFLiteModel, jit: bool = True,
-                 fake_quant: bool = True) -> Callable:
+                 fake_quant: bool = True,
+                 int8_compute: bool = False) -> Callable:
     """Build a callable ``fn(*inputs) -> tuple(outputs)`` from the graph.
 
     Inputs/outputs follow the model's declared dtypes (quantized models
     take/return uint8/int8).  With ``jit=True`` the whole graph compiles
-    into one XLA program.
+    into one XLA program; ``int8_compute`` runs quantized conv/dense as
+    true int8×int8→int32 MXU arithmetic.
     """
-    lowering = _Lowering(model, fake_quant=fake_quant)
+    lowering = _Lowering(model, fake_quant=fake_quant,
+                         int8_compute=int8_compute)
     return jax.jit(lowering) if jit else lowering

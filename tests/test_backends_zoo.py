@@ -262,3 +262,61 @@ def test_converter_unknown_subplugin_n():
     with pytest.raises(Exception, match="unknown converter subplugin"):
         pipe.start()
     pipe.stop()
+
+
+# -- torch backend against the reference repo's own .pt artifacts -------------
+
+_REF_MODELS = "/root/reference/tests/test_models/models"
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_MODELS),
+                    reason="reference test models not present")
+class TestTorchReferenceArtifacts:
+    """The reference's own TorchScript files run unmodified
+    (≙ tests/nnstreamer_filter_pytorch/runTest.sh)."""
+
+    def test_lenet5(self):
+        from nnstreamer_tpu.backends.torch_cpu import TorchBackend
+
+        be = TorchBackend()
+        be.open(os.path.join(_REF_MODELS, "pytorch_lenet5.pt"), {})
+        try:
+            # NHWC, as the reference pipeline feeds raw frames
+            # (the module permutes internally)
+            img = np.zeros((1, 28, 28, 1), np.float32)
+            (out,) = be.invoke([img])
+            assert out.shape == (1, 10)  # digit logits
+        finally:
+            be.close()
+
+    def test_two_input_two_output(self):
+        from nnstreamer_tpu.backends.torch_cpu import TorchBackend
+
+        be = TorchBackend()
+        be.open(os.path.join(
+            _REF_MODELS, "sample_3x4_two_input_two_output.pt"), {})
+        try:
+            a = np.ones((3, 4), np.float32)
+            b = np.full((3, 4), 2.0, np.float32)
+            outs = be.invoke([a, b])
+            assert len(outs) == 2
+            assert all(o.shape == (3, 4) for o in outs)
+        finally:
+            be.close()
+
+    def test_lenet5_pipeline_auto(self):
+        model = os.path.join(_REF_MODELS, "pytorch_lenet5.pt")
+        from nnstreamer_tpu.elements.filter import detect_framework
+
+        assert detect_framework(model) == "torch"
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_filter framework=auto model={model} "
+            "! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(np.zeros((1, 28, 28, 1), np.float32))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=60)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert np.asarray(frames[0].tensors[0]).shape == (1, 10)

@@ -363,3 +363,92 @@ class TestQuantExec:
         xs = (np.arange(0, 256) - 10) * 0.5  # exactly on the uint8 grid
         out = np.asarray(_fake_quant(xs.astype(np.float32), q, "uint8"))
         np.testing.assert_allclose(out, xs, atol=1e-6)
+
+
+class TestInt8Compute:
+    """int8:true — quantized conv/dense as true integer arithmetic
+    (int8×int8→int32 MXU path with zero-point expansion)."""
+
+    @staticmethod
+    def _conv_model():
+        from nnstreamer_tpu.importers.tflite_reader import (
+            QuantParams, TFLOp, TFLTensor, TFLiteModel)
+
+        rng = np.random.default_rng(0)
+        H = W = 5
+        CI, CO, K = 3, 4, 3
+        s_in, zp_in = 0.02, 120
+        s_w, zp_w = 0.05, 131
+        s_out, zp_out = 0.1, 7
+        q_x = rng.integers(0, 256, (1, H, W, CI)).astype(np.uint8)
+        q_w = rng.integers(0, 256, (CO, K, K, CI)).astype(np.uint8)
+        q_b = rng.integers(-500, 500, CO).astype(np.int32)
+
+        def qp(s, z):
+            return QuantParams(np.array([s], np.float32),
+                               np.array([z], np.int64))
+
+        tensors = [
+            TFLTensor(0, "x", (1, H, W, CI), "uint8", 0, qp(s_in, zp_in)),
+            TFLTensor(1, "w", (CO, K, K, CI), "uint8", 1,
+                      qp(s_w, zp_w), q_w),
+            TFLTensor(2, "b", (CO,), "int32", 2, qp(s_in * s_w, 0), q_b),
+            TFLTensor(3, "y", (1, H, W, CO), "uint8", 0,
+                      qp(s_out, zp_out)),
+        ]
+        ops = [TFLOp("CONV_2D", [0, 1, 2], [3], {
+            "padding": "SAME", "stride_w": 1, "stride_h": 1,
+            "activation": None, "dilation_w": 1, "dilation_h": 1})]
+        model = TFLiteModel(3, "", tensors, [0], [3], ops)
+        return model, q_x, q_w, q_b, (s_in, zp_in, s_w, zp_w, s_out, zp_out)
+
+    def test_conv_bit_exact_vs_integer_reference(self):
+        """SAME-padded quantized conv matches an exact float64 reference
+        to ZERO quanta (incl. the padded border, where implicit conv
+        padding would inject a wrong shifted zero)."""
+        import itertools
+
+        model, q_x, q_w, q_b, (s_in, zp_in, s_w, zp_w, s_out, zp_out) = (
+            self._conv_model())
+        H = W = 5
+        K = 3
+        CO = q_w.shape[0]
+        x_real = (q_x.astype(np.float64) - zp_in) * s_in
+        w_real = (q_w.astype(np.float64) - zp_w) * s_w
+        pad = K // 2
+        xp = np.pad(x_real, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        ref = np.zeros((1, H, W, CO))
+        for i, j, o in itertools.product(range(H), range(W), range(CO)):
+            ref[0, i, j, o] = (xp[0, i:i + K, j:j + K, :] * w_real[o]).sum()
+        ref += q_b * (s_in * s_w)
+        q_ref = np.clip(np.round(ref / s_out + zp_out), 0, 255)
+
+        (y,) = _Lowering(model, int8_compute=True)(q_x)
+        np.testing.assert_array_equal(
+            np.asarray(y).astype(np.int64), q_ref.astype(np.int64))
+
+    @needs_ref_models
+    def test_mobilenet_int8_agrees_with_fake_quant(self):
+        img = np.random.default_rng(9).integers(
+            0, 256, (1, 224, 224, 3), np.uint8)
+        (y_f,) = lower_tflite(read_tflite(MOBILENET_QUANT))(img)
+        (y_i,) = lower_tflite(read_tflite(MOBILENET_QUANT),
+                              int8_compute=True)(img)
+        y_f = np.asarray(y_f).astype(np.int64)
+        y_i = np.asarray(y_i).astype(np.int64)
+        assert np.abs(y_f - y_i).max() <= 3  # rounding-path differences
+        assert y_f.argmax() == y_i.argmax()
+
+    @needs_ref_models
+    def test_backend_int8_prop(self):
+        from nnstreamer_tpu.backends.tflite_import import TFLiteBackend
+
+        be = TFLiteBackend()
+        be.open(MOBILENET_QUANT, {"custom": "int8:true"})
+        try:
+            img = np.random.default_rng(10).integers(
+                0, 256, (1, 224, 224, 3), np.uint8)
+            (out,) = be.invoke([img])
+            assert np.asarray(out).shape == (1, 1001)
+        finally:
+            be.close()
