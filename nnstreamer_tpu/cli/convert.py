@@ -1,0 +1,123 @@
+"""nns-tpu-convert: ahead-of-time model conversion to the native format.
+
+Imports a third-party model (.tflite / .onnx), lowers the whole graph to
+JAX, and serializes it as a ``.jaxexport`` artifact — compile-ready
+StableHLO with the weights baked in.  The converted file loads with zero
+import cost and no importer in the serving path:
+
+    nns-tpu-convert mobilenet_v2_quant.tflite model.jaxexport
+    nns-tpu-launch "appsrc ! tensor_filter model=model.jaxexport ! ..."
+
+Reference analog: vendor offline compilers around the subplugin zoo
+(SNPE's snpe-onnx-to-dlc, edgetpu_compiler, trtexec --saveEngine …) —
+here the "engine" is a portable StableHLO module and the compiler is XLA
+at load time.
+
+Options:
+  --batch-polymorphic / --fixed   symbolic leading batch dim (default) or
+                                  the file's declared shapes only
+  --int8                          tflite quantized models: lower conv /
+                                  depthwise / dense to true int8 MXU
+                                  arithmetic before export
+  --fake-quant=off                tflite: relax per-tensor requantization
+                                  (range clamps kept)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def convert(src: str, dst: str, batch_polymorphic: bool = True,
+            int8: bool = False, fake_quant: bool = True) -> dict:
+    """Returns a summary dict (inputs/outputs/ops) for reporting."""
+    import numpy as np
+
+    from ..backends.jax_xla import export_model
+
+    ext = os.path.splitext(src)[1].lower()
+    if ext == ".tflite":
+        from ..importers.tflite_reader import read_tflite
+        from ..importers.tflite_lower import _Lowering
+
+        model = read_tflite(src)
+        lowering = _Lowering(model, fake_quant=fake_quant,
+                             int8_compute=int8)
+        frame_specs = [
+            (model.tensors[i].shape, model.tensors[i].dtype)
+            for i in model.inputs
+        ]
+        histogram = model.op_histogram()
+    elif ext == ".onnx":
+        from ..importers.onnx_reader import read_onnx
+        from ..importers.onnx_lower import _Lowering
+
+        model = read_onnx(src)
+        lowering = _Lowering(model)
+        frame_specs = []
+        for vi in model.inputs:
+            if vi.shape is None or vi.dtype is None or any(
+                    d is None or d < 0 for d in vi.shape):
+                raise SystemExit(
+                    f"{src}: input {vi.name!r} has dynamic dims; "
+                    "conversion needs concrete shapes")
+            frame_specs.append((vi.shape, vi.dtype))
+        histogram = model.op_histogram()
+    else:
+        raise SystemExit(f"unsupported source format {ext!r} "
+                         "(want .tflite or .onnx)")
+
+    params = lowering.params()
+    # same batch semantics as the serving path: the exporter's symbolic
+    # leading dim vmaps over the graph (shape-sensitive ops like Conv
+    # must never see the extra axis)
+    from ..backends._importer_common import batching_model_fn
+
+    fn = batching_model_fn(
+        lowering.run, [len(s) for s, _ in frame_specs])
+    export_model(fn, params, frame_specs, dst,
+                 batch_polymorphic=batch_polymorphic)
+    return {
+        "source": src,
+        "artifact": dst,
+        "bytes": os.path.getsize(dst),
+        "inputs": [tuple(s) for s, _ in frame_specs],
+        "ops": histogram,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nns-tpu-convert", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("src", help="source model (.tflite or .onnx)")
+    ap.add_argument("dst", nargs="?", default=None,
+                    help="output artifact (default: <src>.jaxexport)")
+    ap.add_argument("--fixed", action="store_true",
+                    help="export the file's declared shapes only "
+                         "(no symbolic batch dim)")
+    ap.add_argument("--int8", action="store_true",
+                    help="tflite: true int8 MXU arithmetic")
+    ap.add_argument("--fake-quant", choices=("on", "off"), default="on")
+    args = ap.parse_args(argv)
+
+    dst = args.dst or os.path.splitext(args.src)[0] + ".jaxexport"
+    summary = convert(
+        args.src, dst,
+        batch_polymorphic=not args.fixed,
+        int8=args.int8,
+        fake_quant=args.fake_quant == "on",
+    )
+    ops = ", ".join(f"{k}×{v}" for k, v in sorted(summary["ops"].items()))
+    print(f"{summary['source']} -> {summary['artifact']} "
+          f"({summary['bytes']} bytes)")
+    print(f"  inputs: {summary['inputs']}")
+    print(f"  ops: {ops}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -597,6 +597,65 @@ def _op_dropout(L: _Lowering, env, node: OnnxNode):
         env[node.outputs[1]] = jnp.ones(x.shape, bool)
 
 
+def _qparams(L: _Lowering, env, node: OnnxNode):
+    """(scale, zero_point, axis) for Quantize/DequantizeLinear."""
+    scale = np.asarray(L.static_val(env, node.inputs[1]), np.float32)
+    zp = (np.asarray(L.static_val(env, node.inputs[2]))
+          if len(node.inputs) > 2 and node.inputs[2]
+          else np.zeros_like(scale, np.int64))
+    return scale, zp, int(node.attrs.get("axis", 1))
+
+
+def _per_axis_shape(arr_ndim: int, axis: int, size: int):
+    shape = [1] * arr_ndim
+    shape[axis % arr_ndim] = size
+    return shape
+
+
+def _op_quantize_linear(L: _Lowering, env, node: OnnxNode):
+    """QDQ-style quantization boundary: x -> clip(round(x/s)+zp).  Kept
+    in the integer dtype so a following DequantizeLinear restores the
+    grid exactly (the QDQ pattern quantization-aware exporters emit)."""
+    x = L.val(env, node.inputs[0])
+    scale, zp, axis = _qparams(L, env, node)
+    # the zero-point initializer's dtype names the target integer type
+    # (spec default uint8 when absent — our zeros placeholder is int64)
+    if zp.dtype == np.int64:
+        np_dtype = np.dtype("uint8")
+    elif zp.dtype in (np.dtype("int8"), np.dtype("uint8"),
+                      np.dtype("int16"), np.dtype("uint16"),
+                      np.dtype("int32")):
+        np_dtype = zp.dtype
+    else:
+        raise OnnxLowerError(
+            f"QuantizeLinear to {zp.dtype} not supported")
+    lo, hi = (np.iinfo(np_dtype).min, np.iinfo(np_dtype).max)
+    if scale.size > 1:
+        shape = _per_axis_shape(x.ndim, axis, scale.size)
+        s = scale.reshape(shape)
+        z = zp.astype(np.float32).reshape(shape)
+    else:
+        s = float(scale.ravel()[0])
+        z = float(zp.ravel()[0])
+    # spec order: round(x/s) THEN add zp (an odd zp must not shift
+    # round-half-even tie results)
+    q = jnp.clip(jnp.round(x / s) + z, lo, hi)
+    env[node.outputs[0]] = q.astype(np_dtype)
+
+
+def _op_dequantize_linear(L: _Lowering, env, node: OnnxNode):
+    x = L.val(env, node.inputs[0])
+    scale, zp, axis = _qparams(L, env, node)
+    if scale.size > 1:
+        shape = _per_axis_shape(x.ndim, axis, scale.size)
+        s = jnp.asarray(scale.reshape(shape))
+        z = jnp.asarray(zp.astype(np.float32).reshape(shape))
+    else:
+        s = float(scale.ravel()[0])
+        z = float(zp.ravel()[0])
+    env[node.outputs[0]] = (x.astype(jnp.float32) - z) * s
+
+
 def _op_where(L: _Lowering, env, node: OnnxNode):
     c = L.val(env, node.inputs[0])
     a = L.val(env, node.inputs[1])
@@ -702,6 +761,8 @@ _OP_IMPLS: Dict[str, Callable] = {
     "Identity": _op_identity,
     "Dropout": _op_dropout,
     "Where": _op_where,
+    "QuantizeLinear": _op_quantize_linear,
+    "DequantizeLinear": _op_dequantize_linear,
 }
 
 

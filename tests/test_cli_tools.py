@@ -185,3 +185,66 @@ class TestInspectCli:
         assert main(["tensor_filt"]) == 1
         out = capsys.readouterr().out
         assert "did you mean" in out and "tensor_filter" in out
+
+
+class TestConvertCli:
+    """nns-tpu-convert: third-party model -> native .jaxexport artifact
+    (≙ vendor offline compilers: snpe-onnx-to-dlc, edgetpu_compiler)."""
+
+    def test_tflite_roundtrip(self, tmp_path):
+        from test_tflite_import import build_affine_tflite
+        from nnstreamer_tpu.cli.convert import main as convert_main
+        from nnstreamer_tpu.elements.filter import SingleShot
+
+        src = tmp_path / "aff.tflite"
+        src.write_bytes(build_affine_tflite())
+        dst = tmp_path / "aff.jaxexport"
+        assert convert_main([str(src), str(dst)]) == 0
+        with SingleShot("jax-xla", str(dst)) as m:
+            (out,) = m.invoke([np.full((1, 4), 3.0, np.float32)])
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.full((1, 4), 7.0))
+
+    def test_onnx_default_output_name(self, tmp_path):
+        from test_onnx_import import build_mlp
+        from nnstreamer_tpu.cli.convert import main as convert_main
+
+        blob, _ = build_mlp()
+        src = tmp_path / "mlp.onnx"
+        src.write_bytes(blob)
+        assert convert_main([str(src)]) == 0
+        assert (tmp_path / "mlp.jaxexport").exists()
+
+    def test_unsupported_format_fails_clearly(self, tmp_path):
+        from nnstreamer_tpu.cli.convert import main as convert_main
+
+        src = tmp_path / "model.caffemodel"
+        src.write_bytes(b"x")
+        with pytest.raises(SystemExit, match="unsupported source format"):
+            convert_main([str(src)])
+
+    def test_convert_conv_model_batch_polymorphic(self, tmp_path):
+        """Shape-sensitive graphs (Conv) convert with the default
+        symbolic batch dim and serve micro-batched (regression: the
+        extra axis must vmap, never reach the conv)."""
+        from test_onnx_import import build_cnn
+        from nnstreamer_tpu.cli.convert import main as convert_main
+        from nnstreamer_tpu.backends.jax_xla import JaxXla
+
+        blob, _ = build_cnn()
+        src = tmp_path / "cnn.onnx"
+        src.write_bytes(blob)
+        dst = tmp_path / "cnn.jaxexport"
+        assert convert_main([str(src), str(dst)]) == 0
+        be = JaxXla()
+        be.open(str(dst), {})
+        try:
+            xs = np.random.default_rng(0).standard_normal(
+                (3, 1, 3, 16, 16)).astype(np.float32)
+            (out,) = be.invoke_batch([xs])
+            assert np.asarray(out).shape == (3, 1, 5)
+            (o1,) = be.invoke([xs[0]])
+            np.testing.assert_allclose(np.asarray(out)[0],
+                                       np.asarray(o1), rtol=1e-5)
+        finally:
+            be.close()

@@ -375,3 +375,55 @@ class TestFixedPaths:
         y = np.asarray(y)
         assert y.shape == (1, 2, 6, 6)
         np.testing.assert_array_equal(y, x.repeat(2, 2).repeat(2, 3))
+
+
+class TestQDQ:
+    """QuantizeLinear/DequantizeLinear — the QDQ pattern quantization-
+    aware exporters emit around float ops."""
+
+    def test_qdq_roundtrip_on_grid(self):
+        # x -> Q(s=0.5, zp=10, uint8) -> DQ -> y: on-grid values survive
+        nodes = [
+            node_proto("QuantizeLinear", ["x", "s", "zp"], ["q"]),
+            node_proto("DequantizeLinear", ["q", "s", "zp"], ["y"]),
+        ]
+        inits = [tensor_proto("s", np.asarray(0.5, np.float32)),
+                 tensor_proto("zp", np.asarray(10, np.uint8))]
+        blob = model_proto(nodes, inits, [value_info("x", (8,))],
+                           [value_info("y", (8,))])
+        fn = lower_onnx(read_onnx(blob))
+        xs = ((np.arange(8) * 30) - 5 + 0.0).astype(np.float32) * 0.5
+        (y,) = fn(xs)
+        want = (np.clip(np.round(xs / 0.5 + 10), 0, 255) - 10) * 0.5
+        np.testing.assert_allclose(np.asarray(y), want, atol=1e-6)
+
+    def test_qdq_conv_sandwich(self):
+        """DQ(weights) + QDQ activations around a Conv — the standard
+        quantized-onnx graph shape — matches the float conv on the
+        dequantized operands."""
+        import torch
+        import torch.nn.functional as F
+
+        rng = np.random.default_rng(11)
+        q_w = rng.integers(0, 255, (4, 3, 3, 3)).astype(np.uint8)
+        s_w, zp_w = np.float32(0.03), np.uint8(128)
+        nodes = [
+            node_proto("DequantizeLinear", ["qw", "sw", "zpw"], ["w"]),
+            node_proto("Conv", ["x", "w"], ["y"],
+                       kernel_shape=[3, 3], strides=[1, 1],
+                       pads=[1, 1, 1, 1]),
+        ]
+        inits = [tensor_proto("qw", q_w),
+                 tensor_proto("sw", np.asarray(s_w)),
+                 tensor_proto("zpw", np.asarray(zp_w))]
+        blob = model_proto(nodes, inits,
+                           [value_info("x", (1, 3, 8, 8))],
+                           [value_info("y", (1, 4, 8, 8))])
+        fn = lower_onnx(read_onnx(blob))
+        x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+        (y,) = fn(x)
+        w_real = (q_w.astype(np.float32) - 128) * 0.03
+        want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w_real),
+                        padding=1).numpy()
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
+                                   atol=1e-4)
