@@ -348,16 +348,29 @@ class _Lowering:
 # -- true-int8 compute core --------------------------------------------------
 
 def _int8_quant_triple(L: _Lowering, op: TFLOp):
-    """(in_q, w_tensor, usable) for the int8 path: requires per-tensor
-    quant on the input activation and the (constant) weights."""
+    """(in_q, w_tensor, usable) for the int8 path: per-tensor quant on
+    the input activation; weights either per-tensor, or per-channel
+    SYMMETRIC int8 (all zero points 0 — the TFLite int8 spec's standard
+    layout, where the per-channel scale just vectorizes the epilogue)."""
     t_in = L.m.tensors[op.inputs[0]]
     t_w = L.m.tensors[op.inputs[1]]
+    # per-channel scales must index the OUTPUT-channel axis (dim 3 for
+    # depthwise [1,kh,kw,C*m], dim 0 otherwise) — anything else falls
+    # back to fake-quant, which handles arbitrary quantized_dimension
+    out_dim = 3 if op.opcode == "DEPTHWISE_CONV_2D" else 0
+    w_ok = (
+        t_w.quant is not None and t_w.is_const
+        and t_w.dtype in ("uint8", "int8")
+        and (not t_w.quant.per_channel
+             or (t_w.dtype == "int8"
+                 and not t_w.quant.zero_point.any()
+                 and t_w.quant.quantized_dimension == out_dim))
+    )
     ok = (
         L.int8_compute
         and t_in.quant is not None and not t_in.quant.per_channel
         and t_in.dtype in ("uint8", "int8")
-        and t_w.quant is not None and not t_w.quant.per_channel
-        and t_w.is_const and t_w.dtype in ("uint8", "int8")
+        and w_ok
     )
     return t_in, t_w, ok
 
@@ -373,23 +386,34 @@ def _to_i8(q_vals: np.ndarray, dtype: str):
 def _int8_operands(L: _Lowering, op: TFLOp, x):
     """Shared int8 prep: (x_i8, zp_in_p, s_in, w_i8_np, zp_w_p, s_w) —
     the float-domain activation quantized to shifted int8 and the raw
-    weights shifted to int8, ready for the zero-point expansion."""
+    weights shifted to int8, ready for the zero-point expansion.
+    ``s_w`` is a scalar for per-tensor weights or a per-output-channel
+    vector for the symmetric per-channel layout (zp 0, no shift)."""
     t_in, t_w, _ = _int8_quant_triple(L, op)
     s_in = float(t_in.quant.scale[0])
     zp_in = int(t_in.quant.zero_point[0])
-    s_w = float(t_w.quant.scale[0])
-    zp_w = int(t_w.quant.zero_point[0])
     q_x = jnp.round(x / s_in) + zp_in
     shift_in = 128 if t_in.dtype == "uint8" else 0
     x_i8 = (q_x - shift_in).astype(jnp.int8)
-    w_i8_np, shift_w = _to_i8(np.asarray(t_w.data), t_w.dtype)
-    return x_i8, zp_in - shift_in, s_in, w_i8_np, zp_w - shift_w, s_w
+    if t_w.quant.per_channel:
+        w_i8_np = np.asarray(t_w.data).astype(np.int8)
+        zp_w_p = 0
+        s_w = t_w.quant.scale.astype(np.float32)
+    else:
+        w_i8_np, shift_w = _to_i8(np.asarray(t_w.data), t_w.dtype)
+        zp_w_p = int(t_w.quant.zero_point[0]) - shift_w
+        s_w = float(t_w.quant.scale[0])
+    return x_i8, zp_in - shift_in, s_in, w_i8_np, zp_w_p, s_w
 
 
-def _int8_epilogue(L: _Lowering, env, op: TFLOp, acc, s_in: float,
-                   s_w: float):
-    """Accumulator -> float domain + bias + fused activation."""
-    y = acc.astype(jnp.float32) * (s_in * s_w)
+def _int8_epilogue(L: _Lowering, env, op: TFLOp, acc, s_in: float, s_w):
+    """Accumulator -> float domain + bias + fused activation.  ``s_w``
+    may be a per-output-channel vector; output channels are the last
+    axis in every consumer (NHWC conv, dense), so it broadcasts."""
+    if np.ndim(s_w):
+        y = acc.astype(jnp.float32) * jnp.asarray(s_in * s_w)
+    else:
+        y = acc.astype(jnp.float32) * (s_in * s_w)
     b = (L.val(env, op.inputs[2])
          if len(op.inputs) > 2 and op.inputs[2] >= 0 else None)
     if b is not None:

@@ -452,3 +452,161 @@ class TestInt8Compute:
             assert np.asarray(out).shape == (1, 1001)
         finally:
             be.close()
+
+    def test_per_channel_symmetric_int8_conv_bit_exact(self):
+        """The TFLite int8 spec's standard layout: per-channel symmetric
+        int8 weights (zp 0), per-tensor int8 activations."""
+        import itertools
+
+        from nnstreamer_tpu.importers.tflite_reader import (
+            QuantParams, TFLOp, TFLTensor, TFLiteModel)
+
+        rng = np.random.default_rng(1)
+        H = W = 4
+        CI, CO, K = 2, 3, 3
+        s_in, zp_in = 0.04, -5
+        s_w_vec = np.array([0.02, 0.05, 0.013], np.float32)
+        s_out, zp_out = 0.08, 3
+        q_x = rng.integers(-128, 128, (1, H, W, CI)).astype(np.int8)
+        q_w = rng.integers(-127, 128, (CO, K, K, CI)).astype(np.int8)
+        q_b = rng.integers(-200, 200, CO).astype(np.int32)
+
+        tensors = [
+            TFLTensor(0, "x", (1, H, W, CI), "int8", 0, QuantParams(
+                np.array([s_in], np.float32), np.array([zp_in]))),
+            TFLTensor(1, "w", (CO, K, K, CI), "int8", 1, QuantParams(
+                s_w_vec, np.zeros(CO, np.int64), 0), q_w),
+            TFLTensor(2, "b", (CO,), "int32", 2, QuantParams(
+                s_in * s_w_vec, np.zeros(CO, np.int64), 0), q_b),
+            TFLTensor(3, "y", (1, H, W, CO), "int8", 0, QuantParams(
+                np.array([s_out], np.float32), np.array([zp_out]))),
+        ]
+        ops = [TFLOp("CONV_2D", [0, 1, 2], [3], {
+            "padding": "SAME", "stride_w": 1, "stride_h": 1,
+            "activation": None, "dilation_w": 1, "dilation_h": 1})]
+        model = TFLiteModel(3, "", tensors, [0], [3], ops)
+
+        x_real = (q_x.astype(np.float64) - zp_in) * s_in
+        w_real = q_w.astype(np.float64) * s_w_vec[:, None, None, None]
+        pad = K // 2
+        xp = np.pad(x_real, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        ref = np.zeros((1, H, W, CO))
+        for i, j, o in itertools.product(range(H), range(W), range(CO)):
+            ref[0, i, j, o] = (xp[0, i:i + K, j:j + K, :] * w_real[o]).sum()
+        ref += q_b * (s_in * s_w_vec)
+        q_ref = np.clip(np.round(ref / s_out + zp_out), -128, 127)
+
+        (y,) = _Lowering(model, int8_compute=True)(q_x)
+        np.testing.assert_array_equal(
+            np.asarray(y).astype(np.int64), q_ref.astype(np.int64))
+
+    def test_per_channel_symmetric_int8_depthwise_bit_exact(self):
+        """Depthwise per-channel (quantized_dimension=3, the multiplier-
+        ordered last axis — the TFLite int8 spec's primary user)."""
+        import itertools
+
+        from nnstreamer_tpu.importers.tflite_reader import (
+            QuantParams, TFLOp, TFLTensor, TFLiteModel)
+
+        rng = np.random.default_rng(2)
+        H = W = 4
+        C, K = 3, 3
+        s_in, zp_in = 0.03, 4
+        s_w_vec = np.array([0.015, 0.04, 0.02], np.float32)
+        s_out, zp_out = 0.06, -2
+        q_x = rng.integers(-128, 128, (1, H, W, C)).astype(np.int8)
+        q_w = rng.integers(-127, 128, (1, K, K, C)).astype(np.int8)
+        q_b = rng.integers(-200, 200, C).astype(np.int32)
+
+        tensors = [
+            TFLTensor(0, "x", (1, H, W, C), "int8", 0, QuantParams(
+                np.array([s_in], np.float32), np.array([zp_in]))),
+            TFLTensor(1, "w", (1, K, K, C), "int8", 1, QuantParams(
+                s_w_vec, np.zeros(C, np.int64), 3), q_w),
+            TFLTensor(2, "b", (C,), "int32", 2, QuantParams(
+                s_in * s_w_vec, np.zeros(C, np.int64), 0), q_b),
+            TFLTensor(3, "y", (1, H, W, C), "int8", 0, QuantParams(
+                np.array([s_out], np.float32), np.array([zp_out]))),
+        ]
+        ops = [TFLOp("DEPTHWISE_CONV_2D", [0, 1, 2], [3], {
+            "padding": "SAME", "stride_w": 1, "stride_h": 1,
+            "depth_multiplier": 1, "activation": None,
+            "dilation_w": 1, "dilation_h": 1})]
+        model = TFLiteModel(3, "", tensors, [0], [3], ops)
+
+        x_real = (q_x.astype(np.float64) - zp_in) * s_in
+        w_real = q_w.astype(np.float64) * s_w_vec
+        pad = K // 2
+        xp = np.pad(x_real, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        ref = np.zeros((1, H, W, C))
+        for i, j, c in itertools.product(range(H), range(W), range(C)):
+            ref[0, i, j, c] = (
+                xp[0, i:i + K, j:j + K, c] * w_real[0, :, :, c]).sum()
+        ref += q_b * (s_in * s_w_vec)
+        q_ref = np.clip(np.round(ref / s_out + zp_out), -128, 127)
+
+        (y,) = _Lowering(model, int8_compute=True)(q_x)
+        np.testing.assert_array_equal(
+            np.asarray(y).astype(np.int64), q_ref.astype(np.int64))
+
+    def test_per_channel_symmetric_int8_dense_bit_exact(self):
+        from nnstreamer_tpu.importers.tflite_reader import (
+            QuantParams, TFLOp, TFLTensor, TFLiteModel)
+
+        rng = np.random.default_rng(3)
+        I, O = 6, 4
+        s_in, zp_in = 0.05, 11
+        s_w_vec = (rng.random(O).astype(np.float32) + 0.5) * 0.02
+        s_out, zp_out = 0.09, 1
+        q_x = rng.integers(-128, 128, (1, I)).astype(np.int8)
+        q_w = rng.integers(-127, 128, (O, I)).astype(np.int8)
+        q_b = rng.integers(-100, 100, O).astype(np.int32)
+
+        tensors = [
+            TFLTensor(0, "x", (1, I), "int8", 0, QuantParams(
+                np.array([s_in], np.float32), np.array([zp_in]))),
+            TFLTensor(1, "w", (O, I), "int8", 1, QuantParams(
+                s_w_vec, np.zeros(O, np.int64), 0), q_w),
+            TFLTensor(2, "b", (O,), "int32", 2, QuantParams(
+                s_in * s_w_vec, np.zeros(O, np.int64), 0), q_b),
+            TFLTensor(3, "y", (1, O), "int8", 0, QuantParams(
+                np.array([s_out], np.float32), np.array([zp_out]))),
+        ]
+        ops = [TFLOp("FULLY_CONNECTED", [0, 1, 2], [3], {
+            "activation": None, "weights_format": 0,
+            "keep_num_dims": False})]
+        model = TFLiteModel(3, "", tensors, [0], [3], ops)
+
+        x_real = (q_x.astype(np.float64) - zp_in) * s_in
+        w_real = q_w.astype(np.float64) * s_w_vec[:, None]
+        ref = x_real @ w_real.T + q_b * (s_in * s_w_vec)
+        q_ref = np.clip(np.round(ref / s_out + zp_out), -128, 127)
+
+        (y,) = _Lowering(model, int8_compute=True)(q_x)
+        np.testing.assert_array_equal(
+            np.asarray(y).astype(np.int64), q_ref.astype(np.int64))
+
+    def test_per_channel_wrong_axis_falls_back_to_fake_quant(self):
+        """quantized_dimension on a non-output axis must NOT take the
+        int8 path (its epilogue assumes output-channel scales)."""
+        from nnstreamer_tpu.importers.tflite_reader import (
+            QuantParams, TFLOp, TFLTensor, TFLiteModel)
+
+        q_w = np.ones((2, 3, 3, 2), np.int8)
+        tensors = [
+            TFLTensor(0, "x", (1, 4, 4, 2), "int8", 0, QuantParams(
+                np.array([0.1], np.float32), np.array([0]))),
+            TFLTensor(1, "w", (2, 3, 3, 2), "int8", 1, QuantParams(
+                np.array([0.1, 0.2], np.float32),
+                np.zeros(2, np.int64), 3), q_w),  # axis 3 = input chans
+            TFLTensor(3, "y", (1, 4, 4, 2), "int8", 0, QuantParams(
+                np.array([0.2], np.float32), np.array([0]))),
+        ]
+        ops = [TFLOp("CONV_2D", [0, 1], [2], {
+            "padding": "SAME", "stride_w": 1, "stride_h": 1,
+            "activation": None, "dilation_w": 1, "dilation_h": 1})]
+        model = TFLiteModel(3, "", tensors, [0], [2], ops)
+        L = _Lowering(model, int8_compute=True)
+        from nnstreamer_tpu.importers.tflite_lower import _int8_quant_triple
+        _, _, ok = _int8_quant_triple(L, model.ops[0])
+        assert not ok  # falls back; fake-quant handles any quant dim
