@@ -396,7 +396,8 @@ def _int8_operands(L: _Lowering, op: TFLOp, x):
     shift_in = 128 if t_in.dtype == "uint8" else 0
     x_i8 = (q_x - shift_in).astype(jnp.int8)
     if t_w.quant.per_channel:
-        w_i8_np = np.asarray(t_w.data).astype(np.int8)
+        # guard guarantees int8 already: no copy
+        w_i8_np = np.asarray(t_w.data).astype(np.int8, copy=False)
         zp_w_p = 0
         s_w = t_w.quant.scale.astype(np.float32)
     else:

@@ -599,7 +599,7 @@ class TestInt8Compute:
             TFLTensor(1, "w", (2, 3, 3, 2), "int8", 1, QuantParams(
                 np.array([0.1, 0.2], np.float32),
                 np.zeros(2, np.int64), 3), q_w),  # axis 3 = input chans
-            TFLTensor(3, "y", (1, 4, 4, 2), "int8", 0, QuantParams(
+            TFLTensor(2, "y", (1, 4, 4, 2), "int8", 0, QuantParams(
                 np.array([0.2], np.float32), np.array([0]))),
         ]
         ops = [TFLOp("CONV_2D", [0, 1], [2], {

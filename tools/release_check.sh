@@ -60,6 +60,25 @@ run "$WORK/venv/bin/nns-tpu-inspect" queue
 run "$WORK/venv/bin/nns-tpu-check" --help
 JAX_PLATFORMS=cpu run "$WORK/venv/bin/nns-tpu-launch" \
   "videotestsrc num-buffers=4 ! tensor_converter ! tensor_transform mode=arithmetic option=typecast:float32,div:255 ! tensor_sink"
+# offline model conversion (importer -> .jaxexport), when the reference
+# test models are around to convert (override with NNS_REF_TFLITE)
+REF_TFLITE="${NNS_REF_TFLITE:-/root/reference/tests/test_models/models/add.tflite}"
+if [ ! -f "$REF_TFLITE" ]; then
+  say "convert->serve gate SKIPPED (no reference model at $REF_TFLITE)"
+fi
+if [ -f "$REF_TFLITE" ]; then
+  (cd /tmp && JAX_PLATFORMS=cpu run "$VPY" -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+from nnstreamer_tpu.cli.convert import main
+import numpy as np
+assert main(['$REF_TFLITE', '$WORK/add.jaxexport']) == 0
+from nnstreamer_tpu import SingleShot
+with SingleShot('jax-xla', '$WORK/add.jaxexport') as m:
+    (out,) = m.invoke([np.float32([1.5])])
+    assert float(np.asarray(out)[0]) == 3.5, out
+print('convert->serve OK')
+")
+fi
 
 # 5. a real pipeline through the installed package (filter + decoder)
 say "smoke pipeline (jax filter + decoder, CPU)"
