@@ -17,11 +17,8 @@ inherited (same shape as the tflite importer backend).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Optional
 
-import numpy as np
-
-from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from .jax_xla import JaxXla
 from .base import register_backend
 

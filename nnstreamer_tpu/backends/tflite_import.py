@@ -33,11 +33,8 @@ vmaps over it, so the MXU still sees one large batched program.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Optional
 
-import numpy as np
-
-from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from .jax_xla import JaxXla
 from .base import register_backend
 

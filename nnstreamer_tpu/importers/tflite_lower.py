@@ -26,8 +26,7 @@ Unsupported ops raise ``TFLiteLowerError`` naming the op, at *load* time.
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 

