@@ -427,3 +427,73 @@ class TestQDQ:
                         padding=1).numpy()
         np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4,
                                    atol=1e-4)
+
+
+class TestTransformerBlock:
+    """A single-head attention + LayerNorm + Gelu MLP block — the
+    transformer op subset (MatMul/Transpose/Softmax/LayerNormalization/
+    Erf-Gelu/Add) cross-checked against torch."""
+
+    def test_attention_block_matches_torch(self):
+        import torch
+
+        rng = np.random.default_rng(12)
+        T, D = 5, 8
+        wq = rng.standard_normal((D, D), np.float32) * 0.3
+        wk = rng.standard_normal((D, D), np.float32) * 0.3
+        wv = rng.standard_normal((D, D), np.float32) * 0.3
+        g = (rng.random(D).astype(np.float32) + 0.5)
+        b = rng.standard_normal(D).astype(np.float32) * 0.1
+        w1 = rng.standard_normal((D, 2 * D), np.float32) * 0.3
+        scale = np.float32(1.0 / np.sqrt(D))
+        inv_sqrt2 = np.float32(1.0 / np.sqrt(2.0))
+
+        nodes = [
+            # LayerNorm(x)
+            node_proto("LayerNormalization", ["x", "g", "b"], ["ln"],
+                       axis=-1, epsilon=1e-5),
+            # q,k,v projections
+            node_proto("MatMul", ["ln", "wq"], ["q"]),
+            node_proto("MatMul", ["ln", "wk"], ["k"]),
+            node_proto("MatMul", ["ln", "wv"], ["v"]),
+            # scores = softmax(q @ k^T / sqrt(D))
+            node_proto("Transpose", ["k"], ["kT"], perm=[1, 0]),
+            node_proto("MatMul", ["q", "kT"], ["qk"]),
+            node_proto("Mul", ["qk", "scale"], ["qks"]),
+            node_proto("Softmax", ["qks"], ["att"], axis=-1),
+            node_proto("MatMul", ["att", "v"], ["ctx"]),
+            # residual + exact GELU MLP (x * 0.5 * (1 + erf(x/sqrt(2))))
+            node_proto("Add", ["x", "ctx"], ["res"]),
+            node_proto("MatMul", ["res", "w1"], ["h"]),
+            node_proto("Mul", ["h", "inv_sqrt2"], ["h_s"]),
+            node_proto("Erf", ["h_s"], ["h_erf"]),
+            node_proto("Add", ["h_erf", "one"], ["h_1p"]),
+            node_proto("Mul", ["h", "h_1p"], ["h_m"]),
+            node_proto("Mul", ["h_m", "half"], ["y"]),
+        ]
+        inits = [tensor_proto(n, a) for n, a in [
+            ("wq", wq), ("wk", wk), ("wv", wv), ("g", g), ("b", b),
+            ("w1", w1), ("scale", np.asarray(scale)),
+            ("inv_sqrt2", np.asarray(inv_sqrt2)),
+            ("one", np.asarray(np.float32(1.0))),
+            ("half", np.asarray(np.float32(0.5)))]]
+        blob = model_proto(nodes, inits,
+                           [value_info("x", (T, D))],
+                           [value_info("y", (T, 2 * D))],
+                           opset=17)  # LayerNormalization needs >= 17
+        fn = lower_onnx(read_onnx(blob))
+        x = rng.standard_normal((T, D)).astype(np.float32)
+        (y,) = fn(x)
+
+        xt = torch.from_numpy(x)
+        ln = torch.nn.functional.layer_norm(
+            xt, (D,), torch.from_numpy(g), torch.from_numpy(b), eps=1e-5)
+        q = ln @ torch.from_numpy(wq)
+        k = ln @ torch.from_numpy(wk)
+        v = ln @ torch.from_numpy(wv)
+        att = torch.softmax(q @ k.T * float(scale), dim=-1)
+        res = xt + att @ v
+        h = res @ torch.from_numpy(w1)
+        want = (h * 0.5 * (1 + torch.erf(h / np.sqrt(2.0)))).numpy()
+        np.testing.assert_allclose(np.asarray(y), want, rtol=2e-4,
+                                   atol=2e-4)
