@@ -64,6 +64,8 @@ def iter_fields(buf: memoryview) -> Iterator[Tuple[int, int, Any]]:
             val, off = _read_varint(buf, off)
             yield fno, wt, val
         elif wt == 1:        # 64-bit
+            if off + 8 > n:
+                raise OnnxParseError("truncated 64-bit field")
             val = buf[off:off + 8]
             off += 8
             yield fno, wt, val
@@ -74,6 +76,8 @@ def iter_fields(buf: memoryview) -> Iterator[Tuple[int, int, Any]]:
             yield fno, wt, buf[off:off + ln]
             off += ln
         elif wt == 5:        # 32-bit
+            if off + 4 > n:
+                raise OnnxParseError("truncated 32-bit field")
             val = buf[off:off + 4]
             off += 4
             yield fno, wt, val
@@ -198,6 +202,9 @@ def _decode_tensor(view: memoryview) -> Tuple[str, np.ndarray]:
         else:
             arr = np.asarray(int_data, dtype=np_dtype)
     else:
+        if int(np.prod(shape, dtype=np.int64)) > (1 << 28):
+            raise OnnxParseError(
+                f"tensor {name!r}: declared dims {shape} with no data")
         arr = np.zeros(shape, np_dtype)
     if dtype_name == "bfloat16":
         # widen via bit manipulation: bf16 is the top half of f32
@@ -299,7 +306,21 @@ def _decode_value_info(view: memoryview) -> OnnxValueInfo:
 
 
 def read_onnx(path_or_bytes) -> OnnxModel:
-    """Parse a .onnx file (or bytes) into an OnnxModel."""
+    """Parse a .onnx file (or bytes) into an OnnxModel.
+
+    Model files cross trust boundaries; every malformed input fails with
+    :class:`OnnxParseError` — low-level decode errors (struct/unicode/
+    numpy) never escape raw."""
+    try:
+        return _read_onnx(path_or_bytes)
+    except OnnxParseError:
+        raise
+    except (struct.error, UnicodeDecodeError, ValueError, IndexError,
+            KeyError, OverflowError, TypeError, MemoryError) as e:
+        raise OnnxParseError(f"malformed onnx protobuf: {e}") from e
+
+
+def _read_onnx(path_or_bytes) -> OnnxModel:
     if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
         buf = memoryview(bytes(path_or_bytes))
     else:

@@ -16,6 +16,7 @@ model runs on TPU through XLA instead of a CPU interpreter.
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -106,6 +107,10 @@ def _vec_tables(t: Table, slot: int) -> List[Table]:
     if o == 0:
         return []
     n = t.VectorLen(o)
+    if n * 4 > len(t.Bytes):
+        # a table-offset vector cannot outnumber the file's bytes/4 —
+        # corrupted counts must not drive a near-infinite loop
+        raise TFLiteParseError(f"corrupt vector length {n}")
     start = t.Vector(o)
     return [Table(t.Bytes, t.Indirect(start + 4 * j)) for j in range(n)]
 
@@ -404,7 +409,21 @@ def _parse_quant(t: Optional[Table]) -> Optional[QuantParams]:
 
 
 def read_tflite(path_or_bytes, subgraph: int = 0) -> TFLiteModel:
-    """Parse a .tflite file (or bytes) into a TFLiteModel."""
+    """Parse a .tflite file (or bytes) into a TFLiteModel.
+
+    Model files cross trust boundaries; every malformed input fails with
+    :class:`TFLiteParseError` — low-level decode errors (flatbuffers
+    range checks, struct/numpy) never escape raw."""
+    try:
+        return _read_tflite(path_or_bytes, subgraph)
+    except TFLiteParseError:
+        raise
+    except (TypeError, ValueError, IndexError, KeyError, OverflowError,
+            UnicodeDecodeError, MemoryError, struct.error) as e:
+        raise TFLiteParseError(f"malformed tflite flatbuffer: {e}") from e
+
+
+def _read_tflite(path_or_bytes, subgraph: int = 0) -> TFLiteModel:
     if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
         buf = bytes(path_or_bytes)
     else:

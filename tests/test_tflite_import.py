@@ -610,3 +610,34 @@ class TestInt8Compute:
         from nnstreamer_tpu.importers.tflite_lower import _int8_quant_triple
         _, _, ok = _int8_quant_triple(L, model.ops[0])
         assert not ok  # falls back; fake-quant handles any quant dim
+
+
+class TestParserRobustness:
+    """Untrusted .tflite bytes must raise parse errors — never crash or
+    hang (model files cross trust boundaries)."""
+
+    def test_fuzz_tflite_reader(self):
+        rng = np.random.default_rng(0)
+        blob = build_affine_tflite()
+        for _ in range(300):
+            buf = bytearray(blob)
+            for _ in range(rng.integers(1, 10)):
+                buf[rng.integers(8, len(buf))] = rng.integers(0, 256)
+            try:
+                m = read_tflite(bytes(buf))
+                try:
+                    _Lowering(m)
+                except Exception:
+                    pass  # lowering may reject; must not hang/segfault
+            except TFLiteParseError:
+                pass  # the ONLY exception type allowed to escape
+
+    def test_fuzz_random_bytes_with_magic(self):
+        rng = np.random.default_rng(1)
+        for n in (16, 64, 1024):
+            buf = bytearray(rng.integers(0, 256, n, dtype=np.uint8))
+            buf[4:8] = b"TFL3"  # valid identifier, garbage body
+            try:
+                read_tflite(bytes(buf))
+            except Exception as e:
+                assert isinstance(e, TFLiteParseError), repr(e)
