@@ -22,6 +22,9 @@ Env knobs:
                   batch (adds raw_fps / pipeline_vs_raw to the row — the
                   framework-overhead contract: pipeline >= 0.9x raw)
   BENCH_DEPTH     micro-batches kept in flight by the filter (default 4)
+  BENCH_INGEST    block = frames enter pre-batched (one BatchFrame per
+                  micro-batch, ≙ converter frames-per-tensor); default
+                  per-frame pushes
   BENCH_PLATFORM  cpu = force CPU (debug; numbers not comparable)
   BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT  backend probe retry knobs
 """
@@ -151,6 +154,14 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     of pushing the whole run past the kill."""
     import numpy as np
 
+    # fail BEFORE any pipeline/device work: a zero-block run would
+    # otherwise publish a plausible-looking 0-fps row
+    if os.environ.get("BENCH_INGEST", "") == "block" and n_frames < batch:
+        raise SystemExit(
+            f"BENCH_INGEST=block needs BENCH_FRAMES >= batch "
+            f"({n_frames} < {batch})"
+        )
+
     from nnstreamer_tpu.backends.jax_xla import register_jax_model
     from nnstreamer_tpu.models import build
     from nnstreamer_tpu.pipeline import parse_pipeline
@@ -228,11 +239,24 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     pool = [
         rng.integers(0, 255, (size, size, 3), dtype=np.uint8) for _ in range(16)
     ]
+    # BENCH_INGEST=block: frames enter pre-batched, one BatchFrame per
+    # micro-batch (≙ the reference converter's frames-per-tensor batching)
+    # — per-frame Python ingest/stacking costs are paid once per block.
+    # fps still counts LOGICAL frames (the sink splits the batch).
+    ingest_block = os.environ.get("BENCH_INGEST", "") == "block"
+    blocks = []
+    if ingest_block:
+        blocks = [
+            np.stack([pool[(i + j) % len(pool)] for j in range(batch)])
+            for i in range(4)
+        ]
     if not host_frames:
         import jax
 
         pool = [jax.device_put(p) for p in pool]
+        blocks = [jax.device_put(b) for b in blocks]
         jax.block_until_ready(pool)
+        jax.block_until_ready(blocks)
 
     pipe.start()
     src, sink = pipe["src"], pipe["out"]
@@ -243,8 +267,12 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     # warmup: trigger compiles for the full bucket and any tail buckets
     done = {"n": 0}
     sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
-    for i in range(batch * 2):
-        src.push(pool[i % len(pool)])
+    if ingest_block:
+        for i in range(2):
+            src.push_block(blocks[i % len(blocks)])
+    else:
+        for i in range(batch * 2):
+            src.push(pool[i % len(pool)])
     t_wait = time.time()
     while done["n"] < batch * 2 and time.time() - t_wait < warmup_cap:
         time.sleep(0.01)
@@ -266,8 +294,13 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     measure_cap = max(30.0, deadline_ts - time.time() - 15.0)
     done["n"] = 0
     t0 = time.perf_counter()
-    for i in range(n_frames):
-        src.push(pool[i % len(pool)])
+    if ingest_block:
+        n_frames = (n_frames // batch) * batch
+        for i in range(n_frames // batch):
+            src.push_block(blocks[i % len(blocks)])
+    else:
+        for i in range(n_frames):
+            src.push(pool[i % len(pool)])
     while done["n"] < n_frames and time.perf_counter() - t0 < measure_cap:
         time.sleep(0.005)
     dt = time.perf_counter() - t0
@@ -437,6 +470,10 @@ def main() -> None:
         "dtype": os.environ.get("BENCH_DTYPE", "bfloat16"),
         "quantize": "int8" if quant_applied(which) else None,
         "dispatch_depth": int(os.environ.get("BENCH_DEPTH", "4")),
+        "ingest": (
+            "block" if os.environ.get("BENCH_INGEST", "") == "block"
+            else "frame"
+        ),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"

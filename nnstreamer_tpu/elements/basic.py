@@ -14,7 +14,7 @@ import queue as _queue
 import threading
 import time
 from fractions import Fraction
-from typing import Any, Callable, Iterator, List, Optional
+from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -30,6 +30,12 @@ from ..pipeline.element import (
     element,
 )
 
+
+
+def _frame_interval(framerate: str) -> float:
+    """Seconds per frame from an "n/d" framerate string ("30" == "30/1")."""
+    n, _, d = framerate.partition("/")
+    return float(Fraction(int(d or 1), int(n)))
 
 @element("appsrc")
 class AppSrc(SourceElement):
@@ -89,9 +95,57 @@ class AppSrc(SourceElement):
         if frame.pts is None:
             fr = self.props["framerate"]
             if fr:
-                n, _, d = fr.partition("/")
-                frame.pts = self._count * float(Fraction(int(d or 1), int(n)))
+                frame.pts = self._count * _frame_interval(fr)
         self._count += 1
+        self._q.put(frame)
+
+    def push_block(
+        self, arrays: Any, pts: Optional[Sequence[Optional[float]]] = None
+    ) -> None:
+        """Push N logical frames as ONE pre-batched stream item.
+
+        ``arrays`` is a tensor (or list of tensors) whose LEADING axis is
+        the frame axis — the block travels the pipeline as a single
+        :class:`BatchFrame`, so per-frame mailbox/stacking costs are paid
+        once per block instead of once per frame (≙ the reference
+        converter's ``frames-per-tensor`` batching,
+        gsttensor_converter.c frames-per-tensor).  Batch-capable elements
+        (tensor_filter micro-batching, fused decoders) consume the batch
+        axis directly; sinks and decoders split it back out.  Other
+        per-frame elements (transform/if/...) are NOT batch-aware — feed
+        blocks straight into a tensor_filter, or keep per-frame pushes
+        when such an element sits upstream of it."""
+        tensors = (
+            list(arrays) if isinstance(arrays, (list, tuple)) else [arrays]
+        )
+        tensors = [t if hasattr(t, "shape") else np.asarray(t) for t in tensors]
+        n = int(tensors[0].shape[0])
+        if n == 0:
+            return  # an empty block carries no frames: explicit no-op
+        for t in tensors[1:]:
+            if int(t.shape[0]) != n:
+                raise ValueError(
+                    f"push_block: tensors disagree on the frame axis "
+                    f"({n} vs {int(t.shape[0])})"
+                )
+        if pts is not None and len(pts) != n:
+            raise ValueError(
+                f"push_block: {len(pts)} pts for {n} frames — a mismatched "
+                "frames_info silently misaligns rows downstream"
+            )
+        if pts is None:
+            fr = self.props["framerate"]
+            if fr:
+                dt = _frame_interval(fr)
+                pts = [(self._count + i) * dt for i in range(n)]
+            else:
+                pts = [None] * n
+        frame = BatchFrame(
+            tensors=tensors,
+            pts=pts[0],
+            frames_info=[(p, None, {}) for p in pts],
+        )
+        self._count += n
         self._q.put(frame)
 
     def push_event(self, event) -> None:
@@ -147,8 +201,7 @@ class VideoTestSrc(SourceElement):
 
     def frames(self) -> Iterator[TensorFrame]:
         h, w = self.props["height"], self.props["width"]
-        n, _, d = self.props["framerate"].partition("/")
-        dt = float(Fraction(int(d or 1), int(n)))
+        dt = _frame_interval(self.props["framerate"])
         rng = np.random.default_rng(self.props["seed"])
         count = self.props["num-buffers"]
         i = 0

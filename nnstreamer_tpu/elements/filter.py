@@ -159,6 +159,41 @@ def _stack_tensors(arrs: List[Any]):
     return np.stack([np.asarray(a) for a in arrs])
 
 
+def _concat_tensors(arrs: List[Any]):
+    """Concatenate along the existing batch axis (block-ingest merge).
+
+    Unlike :func:`_stack_tensors` the operand count here is the number of
+    QUEUE ITEMS (a handful), not the number of logical frames, so an eager
+    concat is one cheap dispatch and needs no jit cache."""
+    if len(arrs) == 1:
+        return arrs[0]
+    if any(
+        type(a).__module__.split(".")[0] == "jaxlib" or hasattr(a, "sharding")
+        for a in arrs
+    ):
+        # ANY device-resident piece keeps the concat on device — a host
+        # np.concatenate would drag every device block through a sync
+        # transfer only for invoke_batch to re-upload the result
+        import jax.numpy as jnp
+
+        return jnp.concatenate(arrs, axis=0)
+    return np.concatenate([np.asarray(a) for a in arrs], axis=0)
+
+
+def _logical_infos(
+    frames: Sequence[TensorFrame],
+) -> List[Tuple[Optional[float], Optional[float], Dict[str, Any]]]:
+    """Flatten (pts, duration, meta) per LOGICAL frame across a mixed list
+    of plain frames and BatchFrames, in stream order."""
+    infos: List[Tuple[Optional[float], Optional[float], Dict[str, Any]]] = []
+    for f in frames:
+        if isinstance(f, BatchFrame):
+            infos.extend(f.frames_info)
+        else:
+            infos.append((f.pts, f.duration, f.meta))
+    return infos
+
+
 @element("tensor_filter")
 class TensorFilter(TransformElement):
     PROPERTIES = {
@@ -262,6 +297,11 @@ class TensorFilter(TransformElement):
         self._auto_batch_through = False  # re-set by the fusion pass, or not
         self._in_comb = _parse_combination(self.props["input-combination"])
         self._out_comb = _parse_combination(self.props["output-combination"])
+        # constant per run: does output-combination read any INPUT tensor?
+        # (an outputs-only combination must not drag input blocks to host)
+        self._out_needs_inputs = self._out_comb is not None and any(
+            src == "i" for src, _ in self._out_comb
+        )
         if self.props["batch-through"] and self._out_comb:
             # the BatchFrame fast path bypasses _compose_outputs; refusing
             # beats emitting a layout that depends on queue depth
@@ -427,9 +467,18 @@ class TensorFilter(TransformElement):
         import time
 
         t0 = time.perf_counter()
-        outputs = self.backend.timed_invoke(inputs)
-        nlogical = frame.batch_size if isinstance(frame, BatchFrame) else 1
-        self._record_stats(time.perf_counter() - t0, nlogical)
+        if isinstance(frame, BatchFrame):
+            # a pre-batched block on a single-invoke path (max-batch=1,
+            # invoke-dynamic, backend without native batching): the batch
+            # axis must still mean "batch" — invoke() would treat it as
+            # part of one frame's shape (and a mesh backend would
+            # REPLICATE instead of shard).  invoke_batch's per-frame
+            # fallback covers batchless backends.
+            outputs = self.backend.timed_invoke_batch(inputs)
+            self._record_stats(time.perf_counter() - t0, frame.batch_size)
+        else:
+            outputs = self.backend.timed_invoke(inputs)
+            self._record_stats(time.perf_counter() - t0, 1)
         return frame.with_tensors(self._compose_outputs(frame.tensors, outputs))
 
     def handle_frame_batch(
@@ -437,6 +486,11 @@ class TensorFilter(TransformElement):
     ) -> List[Tuple[int, TensorFrame]]:
         """Micro-batched path: scheduler hands N frames; one invoke_batch."""
         assert self.backend is not None
+        if any(isinstance(f, BatchFrame) for f in frames):
+            # block ingest (≙ converter frames-per-tensor batching,
+            # gsttensor_converter.c frames-per-tensor): the batch axis
+            # already exists — skip per-frame stacking entirely
+            return self._handle_prebatched(frames)
         if len(frames) == 1:
             # queue-starved moment: drain the in-flight window first so
             # this frame cannot overtake older parked batches
@@ -451,25 +505,41 @@ class TensorFilter(TransformElement):
         batched = [
             _stack_tensors([pf[t] for pf in per_frame]) for t in range(ntensors)
         ]
+        return self._run_batch(batched, frames, len(frames))
+
+    def _run_batch(
+        self, batched: List[Any], frames: List[TensorFrame], nlogical: int
+    ) -> List[Tuple[int, TensorFrame]]:
+        """Shared micro-batch tail: one invoke_batch + stats, then either
+        batch-through (device residency: the whole micro-batch leaves as
+        ONE frame, outputs still on device — no host sync here, so the
+        next batch's stack/dispatch overlaps this one's compute; downstream
+        fused decoder / chained filter / sink splits or materializes at the
+        real host boundary) or the depth-N dispatch window."""
         import time
 
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
-        self._record_stats(time.perf_counter() - t0, len(frames))
+        self._record_stats(time.perf_counter() - t0, nlogical)
         if self.batch_through_active:
-            # device residency: the whole micro-batch leaves as ONE frame,
-            # outputs still on device (jax.Array) — no host sync here, so
-            # the next batch's stack/dispatch overlaps this one's compute.
-            # Downstream (fused decoder / chained filter / sink) splits or
-            # materializes at the real host boundary.
-            return [(0, BatchFrame.from_frames(out_b, frames))]
-        # depth-N in-flight dispatch: park this batch's (async) device
-        # outputs and only block on the OLDEST once the window is full —
-        # stacking/dispatching batch k+1 then overlaps batch k's compute
-        # and its device->host transfer (started async below).  The raw
-        # benchmark sustains its rate at exactly this structure
-        # (bench.py BENCH_RAW depth-4); the reference's steady state is
-        # synchronous map->invoke->append (tensor_filter.c:642-930).
+            infos = _logical_infos(frames)
+            p, d, m = infos[0]
+            return [(0, BatchFrame(
+                tensors=list(out_b), pts=p, duration=d, meta=dict(m),
+                frames_info=infos,
+            ))]
+        return self._dispatch_or_park(out_b, frames)
+
+    def _dispatch_or_park(
+        self, out_b: List[Any], frames: List[TensorFrame]
+    ) -> List[Tuple[int, TensorFrame]]:
+        """Depth-N in-flight dispatch: park this batch's (async) device
+        outputs and only block on the OLDEST once the window is full —
+        stacking/dispatching batch k+1 then overlaps batch k's compute
+        and its device->host transfer (started async below).  The raw
+        benchmark sustains its rate at exactly this structure
+        (bench.py BENCH_RAW depth-4); the reference's steady state is
+        synchronous map->invoke->append (tensor_filter.c:642-930)."""
         depth = max(1, int(self.props["dispatch-depth"]))
         if depth > 1 and any(
             hasattr(o, "copy_to_host_async") for o in out_b
@@ -487,21 +557,103 @@ class TensorFilter(TransformElement):
         # current batch cannot overtake them
         return self._drain_inflight() + self._emit_batch(out_b, frames)
 
+    def _handle_prebatched(
+        self, frames: List[TensorFrame]
+    ) -> List[Tuple[int, TensorFrame]]:
+        """Frames that already carry a batch axis (BatchFrame block ingest,
+        possibly mixed with plain frames): concatenate on axis 0 — usually a
+        no-op because the scheduler hands exactly one full block — and run
+        invoke_batch.  input-combination selects tensor INDICES, which
+        applies to batched tensors unchanged; output-combination's
+        per-logical input rows are sliced in _emit_batch.  A block larger
+        than max-batch is chunked here (lazy device slices) so max-batch
+        keeps bounding the invoke's batch axis — the compiled-bucket /
+        HBM-budget contract — even though the scheduler never splits a
+        queue item."""
+        comb = self._in_comb
+        pieces: List[List[Any]] = []
+        for f in frames:
+            tens = [f.tensors[i] for _, i in comb] if comb else list(f.tensors)
+            if isinstance(f, BatchFrame):
+                pieces.append(tens)
+            else:
+                pieces.append([
+                    t[None] if hasattr(t, "shape") else np.asarray(t)[None]
+                    for t in tens
+                ])
+        if len(pieces) == 1:
+            batched = pieces[0]
+        else:
+            batched = [
+                _concat_tensors([p[t] for p in pieces])
+                for t in range(len(pieces[0]))
+            ]
+        nlogical = sum(getattr(f, "batch_size", 1) for f in frames)
+        mb = max(1, int(self.props["max-batch"]))
+        if nlogical <= mb:
+            return self._run_batch(batched, frames, nlogical)
+        # out-combination 'iN' entries index ORIGINAL input tensors; when
+        # in-combination narrowed `batched`, the chunks' synthetic frames
+        # must carry the originals for _emit_batch to slice
+        if self._out_needs_inputs and comb:
+            origs = [
+                list(f.tensors) if isinstance(f, BatchFrame) else [
+                    t[None] if hasattr(t, "shape") else np.asarray(t)[None]
+                    for t in f.tensors
+                ]
+                for f in frames
+            ]
+            carry = [
+                _concat_tensors([p[t] for p in origs])
+                for t in range(len(origs[0]))
+            ] if len(origs) > 1 else origs[0]
+        else:
+            carry = batched
+        infos = _logical_infos(frames)
+        results: List[Tuple[int, TensorFrame]] = []
+        for k in range(0, nlogical, mb):
+            chunk = [t[k:k + mb] for t in batched]
+            cinfos = infos[k:k + mb]
+            syn = BatchFrame(
+                tensors=[t[k:k + mb] for t in carry],
+                pts=cinfos[0][0], duration=cinfos[0][1],
+                meta=dict(cinfos[0][2]), frames_info=list(cinfos),
+            )
+            results.extend(self._run_batch(chunk, [syn], len(cinfos)))
+        return results
+
     def _emit_batch(
         self, out_b: List[Any], frames: List[TensorFrame]
     ) -> List[Tuple[int, TensorFrame]]:
         """Materialize one micro-batch's outputs (one overlapped
         device->host pass for all tensors, then zero-copy views per
-        frame)."""
+        frame).  ``frames`` may mix plain frames (one output row each)
+        and BatchFrames (``batch_size`` consecutive rows)."""
         from ..core.buffer import materialize
 
         out_np = materialize(out_b)
         results = []
-        for b, f in enumerate(frames):
-            outs = [o[b] for o in out_np]
-            results.append(
-                (0, f.with_tensors(self._compose_outputs(f.tensors, outs)))
-            )
+        b = 0
+        for f in frames:
+            if isinstance(f, BatchFrame):
+                # only an 'iN' entry reads inputs; "o0"-style output
+                # subsetting must not drag the whole input block to host
+                ins_np = materialize(f.tensors) if self._out_needs_inputs else None
+                for j, (p, d, m) in enumerate(f.frames_info):
+                    outs = [o[b + j] for o in out_np]
+                    if self._out_comb:
+                        ins = [t[j] for t in ins_np] if ins_np is not None else []
+                        outs = self._compose_outputs(ins, outs)
+                    results.append(
+                        (0, TensorFrame(outs, pts=p, duration=d, meta=dict(m)))
+                    )
+                b += f.batch_size
+            else:
+                outs = [o[b] for o in out_np]
+                results.append(
+                    (0, f.with_tensors(self._compose_outputs(f.tensors, outs)))
+                )
+                b += 1
         return results
 
     def _emit_oldest_inflight(self) -> List[Tuple[int, TensorFrame]]:

@@ -536,8 +536,12 @@ class Pipeline:
                             el, "batch_wait_s", 0.0
                         )
                         frames = [item]
+                        # LOGICAL frame count: a block-ingest BatchFrame
+                        # counts as its batch_size, so max-batch bounds the
+                        # invoke's batch axis, not the queue-item count
+                        nlog = getattr(item, "batch_size", 1)
                         get_many = getattr(el._mailbox, "get_many", None)
-                        while len(frames) < want:
+                        while nlog < want:
                             # consume stashed items first (a previous bulk
                             # pop may have pulled qualifying frames); an
                             # event at the stash head ends the batch IN
@@ -546,13 +550,14 @@ class Pipeline:
                                 p2, nxt = stash[0]
                                 if isinstance(nxt, TensorFrame) and p2 == pad:
                                     frames.append(stash.popleft()[1])
+                                    nlog += getattr(nxt, "batch_size", 1)
                                     continue
                                 break
                             try:
                                 wait = deadline - time.monotonic()
                                 if get_many is not None:
                                     chunk = get_many(
-                                        want - len(frames),
+                                        want - nlog,
                                         timeout=max(0.0, wait),
                                     )
                                 elif wait > 0:
@@ -565,8 +570,15 @@ class Pipeline:
                             for p2, nxt in chunk:
                                 if (not boundary
                                         and isinstance(nxt, TensorFrame)
-                                        and p2 == pad):
+                                        and p2 == pad
+                                        and nlog < want):
+                                    # nlog<want re-checked per item: blocks
+                                    # count as batch_size, so a bulk pop
+                                    # (item-granular) can overshoot the
+                                    # LOGICAL bound mid-chunk — the excess
+                                    # stashes for the next micro-batch
                                     frames.append(nxt)
+                                    nlog += getattr(nxt, "batch_size", 1)
                                 else:
                                     # event/other-pad item ends the batch;
                                     # it and everything popped after it
