@@ -120,8 +120,6 @@ class AppSrc(SourceElement):
         )
         tensors = [t if hasattr(t, "shape") else np.asarray(t) for t in tensors]
         n = int(tensors[0].shape[0])
-        if n == 0:
-            return  # an empty block carries no frames: explicit no-op
         for t in tensors[1:]:
             if int(t.shape[0]) != n:
                 raise ValueError(
@@ -133,6 +131,8 @@ class AppSrc(SourceElement):
                 f"push_block: {len(pts)} pts for {n} frames — a mismatched "
                 "frames_info silently misaligns rows downstream"
             )
+        if n == 0:
+            return  # a VALID empty block carries no frames: explicit no-op
         if pts is None:
             fr = self.props["framerate"]
             if fr:

@@ -27,7 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..core import registry
-from ..core.buffer import TensorFrame
+from ..core.buffer import BatchFrame, TensorFrame
 from ..core.types import (
     ANY,
     FORMAT_STATIC,
@@ -45,6 +45,13 @@ from .. import converters as _converters  # noqa: F401 — registers subplugins
 class TensorConverter(Element):
     PROPERTIES = {
         "frames-per-tensor": Property(int, 1, "batch N media frames into one tensor"),
+        "emit-blocks": Property(
+            bool, False,
+            "with frames-per-tensor > 1: emit a transparent BatchFrame of N "
+            "logical frames (per-frame schema/pts preserved; batch-capable "
+            "elements consume the batch axis, sinks/decoders split) instead "
+            "of one shape-changed stacked tensor",
+        ),
         "input-dim": Property(str, "", "octet mode: target dims (reference dialect)"),
         "input-type": Property(str, "", "octet mode: target element type"),
         "mode": Property(str, "", "external converter: 'custom:<subplugin-name>'"),
@@ -135,7 +142,10 @@ class TensorConverter(Element):
                 return ANY
             fpt = self.props["frames-per-tensor"]
             fr = in_spec.media.framerate
-            if fpt > 1:
+            if fpt > 1 and not self.props["emit-blocks"]:
+                # reference semantics: one shape-changed frame per group
+                # (3:W:H:1 -> 3:W:H:N); emit-blocks keeps the per-frame
+                # schema — a BatchFrame is a transport batch, not a shape
                 t = t.with_batch(fpt)
                 if fr is not None:
                     fr = fr / fpt
@@ -144,6 +154,8 @@ class TensorConverter(Element):
         if octet is not None:
             return StreamSpec((octet,), FORMAT_STATIC, in_spec.framerate)
         fpt = self.props["frames-per-tensor"]
+        if self.props["emit-blocks"]:
+            fpt = 1  # schema/framerate unchanged: blocks are transparent
         if in_spec.tensors:
             tensors = tuple(
                 t.with_batch(fpt) if fpt > 1 else t for t in in_spec.tensors
@@ -242,6 +254,9 @@ class TensorConverter(Element):
         self._pending.append(frame)
         if len(self._pending) < fpt:
             return []
+        return self._emit_group()
+
+    def _emit_group(self):
         group, self._pending = self._pending, []
         ntensors = len(group[0].tensors)
         stacked = [
@@ -249,11 +264,20 @@ class TensorConverter(Element):
             for i in range(ntensors)
         ]
         first = group[0]
+        if self.props["emit-blocks"]:
+            # transparent batch: per-logical pts/meta survive; downstream
+            # batch-capable elements consume, sinks/decoders split
+            return [(0, BatchFrame.from_frames(stacked, group))]
         out = first.with_tensors(stacked)
         out.duration = sum(f.duration or 0.0 for f in group) or None
         return [(0, out)]
 
     def handle_eos(self, pad):
+        if self.props["emit-blocks"] and self._pending:
+            # a partial block changes no schema — emit it instead of
+            # dropping (divergence from the reference's shape-changing
+            # stacking, which must drop incomplete groups)
+            return self._emit_group()
         # drop a partial trailing batch (reference drops incomplete frames)
         self._pending.clear()
         return []

@@ -180,6 +180,33 @@ def _concat_tensors(arrs: List[Any]):
     return np.concatenate([np.asarray(a) for a in arrs], axis=0)
 
 
+def _batched_tensors(
+    frames: Sequence[TensorFrame], select: Optional[List[int]]
+) -> List[Any]:
+    """Expand a mixed plain/BatchFrame list into ONE batched tensor list:
+    plain frames gain a length-1 batch axis, blocks pass through, pieces
+    concatenate per tensor index.  ``select`` optionally narrows to the
+    given tensor indices (input-combination)."""
+    pieces: List[List[Any]] = []
+    for f in frames:
+        tens = (
+            [f.tensors[i] for i in select] if select is not None
+            else list(f.tensors)
+        )
+        if not isinstance(f, BatchFrame):
+            tens = [
+                t[None] if hasattr(t, "shape") else np.asarray(t)[None]
+                for t in tens
+            ]
+        pieces.append(tens)
+    if len(pieces) == 1:
+        return pieces[0]
+    return [
+        _concat_tensors([p[t] for p in pieces])
+        for t in range(len(pieces[0]))
+    ]
+
+
 def _logical_infos(
     frames: Sequence[TensorFrame],
 ) -> List[Tuple[Optional[float], Optional[float], Dict[str, Any]]]:
@@ -571,23 +598,9 @@ class TensorFilter(TransformElement):
         HBM-budget contract — even though the scheduler never splits a
         queue item."""
         comb = self._in_comb
-        pieces: List[List[Any]] = []
-        for f in frames:
-            tens = [f.tensors[i] for _, i in comb] if comb else list(f.tensors)
-            if isinstance(f, BatchFrame):
-                pieces.append(tens)
-            else:
-                pieces.append([
-                    t[None] if hasattr(t, "shape") else np.asarray(t)[None]
-                    for t in tens
-                ])
-        if len(pieces) == 1:
-            batched = pieces[0]
-        else:
-            batched = [
-                _concat_tensors([p[t] for p in pieces])
-                for t in range(len(pieces[0]))
-            ]
+        batched = _batched_tensors(
+            frames, [i for _, i in comb] if comb else None
+        )
         nlogical = sum(getattr(f, "batch_size", 1) for f in frames)
         mb = max(1, int(self.props["max-batch"]))
         if nlogical <= mb:
@@ -596,17 +609,7 @@ class TensorFilter(TransformElement):
         # in-combination narrowed `batched`, the chunks' synthetic frames
         # must carry the originals for _emit_batch to slice
         if self._out_needs_inputs and comb:
-            origs = [
-                list(f.tensors) if isinstance(f, BatchFrame) else [
-                    t[None] if hasattr(t, "shape") else np.asarray(t)[None]
-                    for t in f.tensors
-                ]
-                for f in frames
-            ]
-            carry = [
-                _concat_tensors([p[t] for p in origs])
-                for t in range(len(origs[0]))
-            ] if len(origs) > 1 else origs[0]
+            carry = _batched_tensors(frames, None)
         else:
             carry = batched
         infos = _logical_infos(frames)
@@ -632,17 +635,27 @@ class TensorFilter(TransformElement):
         from ..core.buffer import materialize
 
         out_np = materialize(out_b)
+        # only the tensor indices an 'iN' entry actually reads get pulled
+        # to host; "o0"-style output subsetting (and unreferenced input
+        # tensors) must not drag input blocks over the link
+        need_idx = sorted({
+            i for src, i in (self._out_comb or []) if src == "i"
+        }) if self._out_needs_inputs else []
         results = []
         b = 0
         for f in frames:
             if isinstance(f, BatchFrame):
-                # only an 'iN' entry reads inputs; "o0"-style output
-                # subsetting must not drag the whole input block to host
-                ins_np = materialize(f.tensors) if self._out_needs_inputs else None
+                ins_np: List[Any] = [None] * len(f.tensors)
+                if need_idx:
+                    mats = materialize([f.tensors[i] for i in need_idx])
+                    for k, i in enumerate(need_idx):
+                        ins_np[i] = mats[k]
                 for j, (p, d, m) in enumerate(f.frames_info):
                     outs = [o[b + j] for o in out_np]
                     if self._out_comb:
-                        ins = [t[j] for t in ins_np] if ins_np is not None else []
+                        ins = [
+                            (t[j] if t is not None else None) for t in ins_np
+                        ]
                         outs = self._compose_outputs(ins, outs)
                     results.append(
                         (0, TensorFrame(outs, pts=p, duration=d, meta=dict(m)))

@@ -361,6 +361,80 @@ class TestBlockIngestGuards:
         _expect(frames, list(range(6)), pts=[float(i) for i in range(6)])
 
 
+class TestConverterEmitBlocks:
+    """tensor_converter emit-blocks=true: frames-per-tensor batching that
+    emits a transparent BatchFrame (per-frame schema/pts preserved) instead
+    of the reference's shape-changed stacked tensor — block ingest from
+    pipeline text alone, no appsrc API needed."""
+
+    def test_media_pipeline_blocks_end_to_end(self):
+        pipe = parse_pipeline(
+            "videotestsrc num-buffers=12 pattern=solid width=8 height=8 "
+            "framerate=10/1 ! tensor_converter frames-per-tensor=4 "
+            "emit-blocks=true ! tensor_filter framework=jax-xla "
+            "model=blk_img max-batch=4 ! tensor_sink name=out"
+        )
+        from nnstreamer_tpu.backends.jax_xla import (
+            register_jax_model, unregister_jax_model)
+        # batch-polymorphic like the zoo models: (H,W,C) -> (1,) per frame,
+        # (B,H,W,C) -> (B,1) per block (schema negotiates UNBATCHED)
+        register_jax_model(
+            "blk_img", lambda p, xs: [xs[0].astype("float32").mean(
+                axis=(-3, -2, -1))[..., None]], None)
+        try:
+            pipe.start()
+            pipe.wait(timeout=30)
+            frames = pipe["out"].frames
+            pipe.stop()
+            # all 12 logical frames come back, at the SOURCE framerate
+            assert len(frames) == 12
+            assert [f.pts for f in frames] == pytest.approx(
+                [i * 0.1 for i in range(12)]
+            )
+            # solid pattern: frame i has value (i*8)%256 everywhere
+            got = [float(f.tensors[0][0]) for f in frames]
+            assert got == pytest.approx([(i * 8) % 256 for i in range(12)])
+        finally:
+            unregister_jax_model("blk_img")
+
+    def test_partial_tail_block_is_emitted_not_dropped(self):
+        """10 frames at frames-per-tensor=4 -> blocks of 4,4,2: the tail
+        block flushes at EOS (no schema change, so no reason to drop —
+        documented divergence from the reference's stacking mode)."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_converter frames-per-tensor=4 "
+            "emit-blocks=true ! tensor_filter framework=jax-xla "
+            "model=blk_affine max-batch=4 ! tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(10):
+            pipe["src"].push(np.float32([i]), pts=i * 0.1)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert len(frames) == 10
+        _expect(frames, list(range(10)),
+                pts=[i * 0.1 for i in range(10)])
+
+    def test_stacking_mode_unchanged_without_emit_blocks(self):
+        """Reference semantics intact: fpt=4 without emit-blocks emits
+        shape-changed frames and drops the partial tail."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_converter frames-per-tensor=4 ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        for i in range(10):
+            pipe["src"].push(np.float32([i]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert len(frames) == 2  # 4+4, tail of 2 dropped
+        assert frames[0].tensors[0].shape == (4, 1)
+
+
 class TestBatchFrameUnit:
     def test_batchframe_through_push_roundtrip(self):
         """AppSrc.push accepts a hand-built BatchFrame (it IS a
