@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import grpc
 
-from ..core.buffer import TensorFrame
+from ..core.buffer import BatchFrame, TensorFrame
 from ..core.log import get_logger
 from ..core.types import StreamSpec
 from .wire import (
@@ -114,7 +114,8 @@ class QueryServerCore:
         try:
             for frame in frames:
                 frame.meta["client_id"] = client_id
-                self.ingress.put((client_id, frame), timeout=10)
+            for item in self._ingress_items(frames):
+                self.ingress.put((client_id, item), timeout=10)
             answers = []
             deadline = time.monotonic() + min(timeout, 300.0)
             for _ in frames:
@@ -132,6 +133,28 @@ class QueryServerCore:
         finally:
             with self._pending_lock:
                 self._pending.pop(client_id, None)
+
+    def _ingress_items(self, frames: List[TensorFrame]) -> List[TensorFrame]:
+        """block_ingress: a wire micro-batch becomes ONE BatchFrame so the
+        server pipeline pays per-frame Python costs once per batch; falls
+        back to per-frame injection when the batch is not uniform (mixed
+        shapes/dtypes cannot share a batch axis)."""
+        if not getattr(self, "block_ingress", False) or len(frames) <= 1:
+            return frames
+        import numpy as np
+
+        # EXPLICIT uniformity check — np.stack would silently promote
+        # mixed dtypes (and a count mismatch only raises one way), turning
+        # the promised per-frame fallback into wrong batched inputs
+        arrs = [[np.asarray(t) for t in f.tensors] for f in frames]
+        sig0 = [(a.shape, a.dtype) for a in arrs[0]]
+        for row in arrs[1:]:
+            if [(a.shape, a.dtype) for a in row] != sig0:
+                return frames
+        stacked = [
+            np.stack([row[i] for row in arrs]) for i in range(len(sig0))
+        ]
+        return [BatchFrame.from_frames(stacked, frames)]
 
     def _invoke(self, request: bytes, context) -> bytes:
         # wire micro-batch envelope: N frames ride one RPC (amortizes the

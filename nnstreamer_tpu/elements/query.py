@@ -22,7 +22,7 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Deque, Iterator, List, Optional, Tuple
 
-from ..core.buffer import CustomEvent, TensorFrame
+from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
     QueryConnection,
@@ -50,6 +50,12 @@ class TensorQueryServerSrc(SourceElement):
             "transport: grpc (interop default) | tcp (zero-copy raw TCP, "
             "≙ reference nns-edge TCP)"),
         "caps": Property(str, "", "announced input schema for the handshake"),
+        "block-ingress": Property(
+            bool, False,
+            "inject each wire micro-batch as ONE BatchFrame so the server "
+            "pipeline pays per-frame costs once per batch (the answers "
+            "split back per client in the serversink)",
+        ),
     }
 
     def __init__(self, name=None):
@@ -60,6 +66,7 @@ class TensorQueryServerSrc(SourceElement):
         self._core = get_query_server(self.props["id"], self.props["port"])
         if self.props["caps"]:
             self._core.caps = self.props["caps"]
+        self._core.block_ingress = bool(self.props["block-ingress"])
         ct = self.props["connect-type"]
         if ct == "tcp":
             self._core.start_tcp()
@@ -112,6 +119,12 @@ class TensorQueryServerSink(SinkElement):
             self._core = None
 
     def render(self, frame):
+        if isinstance(frame, BatchFrame):
+            # block-ingress answers: resolve each logical frame (client_id
+            # rides in the per-frame meta captured at injection)
+            for f in frame.split():
+                self.render(f)
+            return
         client_id = frame.meta.get("client_id")
         if client_id is None:
             raise ElementError(
@@ -311,7 +324,8 @@ class TensorQueryClient(Element):
         return super().handle_event(pad, ev)
 
     def handle_frame(self, pad, frame):
-        return self._dispatch(frame)
+        # one shared path: blocks flatten onto the wire micro-batch envelope
+        return self.handle_frame_batch(pad, [frame])
 
     # scheduler micro-batch hooks: with wire-batch > 1 the pipeline drains
     # already-queued frames into handle_frame_batch (batch_wait_s = 0 so
@@ -323,6 +337,11 @@ class TensorQueryClient(Element):
     batch_wait_s = 0.0
 
     def handle_frame_batch(self, pad, frames):
+        if any(isinstance(f, BatchFrame) for f in frames):
+            logical: List[TensorFrame] = []
+            for f in frames:
+                logical.extend(f.split() if isinstance(f, BatchFrame) else [f])
+            frames = logical
         if len(frames) == 1:
             return self._dispatch(frames[0])
         return self._dispatch(list(frames))

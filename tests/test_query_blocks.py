@@ -1,0 +1,201 @@
+"""Query data plane × block ingest.
+
+Client side: a BatchFrame maps onto the wire micro-batch envelope (one RPC
+per block).  Server side: ``tensor_query_serversrc block-ingress=true``
+injects each wire micro-batch as ONE BatchFrame so the server pipeline
+pays per-frame Python costs once per batch; the serversink splits answers
+back per client RPC.
+
+Reference analog: the nns-edge data plane delivers frames individually
+(tensor_query_serversrc.c create :67) — block ingress is the TPU-native
+delta that lets a remote stream saturate a chip.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.jax_xla import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+@pytest.fixture(autouse=True)
+def _model():
+    register_jax_model("qblk_affine", lambda p, xs: [xs[0] * 2.0], None)
+    yield
+    unregister_jax_model("qblk_affine")
+
+
+def _server(sid, extra_src="", fw="jax-xla", model="qblk_affine",
+            max_batch=8, custom=""):
+    model_tok = f"model={model} " if model else ""
+    custom_tok = f"custom={custom} " if custom else ""
+    pipe = parse_pipeline(
+        f"tensor_query_serversrc name=ssrc id={sid} port=0 {extra_src} ! "
+        f"tensor_filter framework={fw} {model_tok}{custom_tok}"
+        f"max-batch={max_batch} ! "
+        f"tensor_query_serversink id={sid}"
+    )
+    pipe.start()
+    return pipe, pipe["ssrc"].props["port"]
+
+
+class TestClientBlocks:
+    def test_pushed_blocks_map_to_wire_batches(self):
+        """push_block upstream of a query client: one RPC per block, answers
+        split back per frame in order."""
+        server, port = _server(501)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "wire-batch=8 ! tensor_sink name=out"
+            )
+            client.start()
+            for b in range(3):
+                client["src"].push_block(
+                    np.arange(b * 8, b * 8 + 8, dtype=np.float32)[:, None],
+                    pts=[0.1 * i for i in range(b * 8, b * 8 + 8)],
+                )
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            frames = client["out"].frames
+            assert len(frames) == 24
+            vals = [float(f.tensors[0][0]) for f in frames]
+            assert vals == [2.0 * i for i in range(24)]
+        finally:
+            server.stop()
+
+    def test_mixed_blocks_and_frames_through_client(self):
+        server, port = _server(502)
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "wire-batch=4 ! tensor_sink name=out"
+            )
+            client.start()
+            client["src"].push(np.float32([100.0]))
+            client["src"].push_block(np.float32([[0.0], [1.0], [2.0]]))
+            client["src"].push(np.float32([200.0]))
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [200.0, 0.0, 2.0, 4.0, 400.0]
+        finally:
+            server.stop()
+
+
+class TestServerBlockIngress:
+    def test_block_ingress_batches_server_invokes(self):
+        """block-ingress=true: the server filter sees whole wire batches
+        (traced batch axes > 1), results identical and ordered."""
+        sizes = set()
+
+        def fn(p, xs):
+            sizes.add(int(xs[0].shape[0]))
+            return [xs[0] * 2.0]
+
+        register_jax_model("qblk_sizes", fn, None)
+        try:
+            server, port = _server(
+                503, extra_src="block-ingress=true", model="qblk_sizes"
+            )
+            try:
+                client = parse_pipeline(
+                    f"appsrc name=src ! tensor_query_client port={port} "
+                    "wire-batch=8 ! tensor_sink name=out"
+                )
+                client.start()
+                for b in range(2):
+                    client["src"].push_block(
+                        np.arange(b * 8, b * 8 + 8, dtype=np.float32)[:, None]
+                    )
+                client["src"].end_of_stream()
+                client.wait(timeout=30)
+                client.stop()
+                vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+                assert vals == [2.0 * i for i in range(16)]
+                # the server pipeline actually ran batched invokes
+                assert max(sizes) > 1, f"server never saw a batch: {sizes}"
+            finally:
+                server.stop()
+        finally:
+            unregister_jax_model("qblk_sizes")
+
+    def test_block_ingress_tcp_transport(self):
+        """Same contract over the raw-TCP transport (shared process())."""
+        server, port = _server(
+            504, extra_src="connect-type=tcp block-ingress=true"
+        )
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "connect-type=tcp wire-batch=8 ! tensor_sink name=out"
+            )
+            client.start()
+            for i in range(16):
+                client["src"].push(np.float32([i]))
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            vals = [float(f.tensors[0][0]) for f in client["out"].frames]
+            assert vals == [2.0 * i for i in range(16)]
+        finally:
+            server.stop()
+
+    def test_block_ingress_mixed_dtype_falls_back(self):
+        """Same shapes, different dtypes: np.stack would silently promote —
+        the explicit uniformity check must inject per-frame instead."""
+        server, port = _server(
+            506, extra_src="block-ingress=true", fw="scaler", model="",
+            custom="factor:2", max_batch=1,
+        )
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "wire-batch=4 ! tensor_sink name=out"
+            )
+            client.start()
+            client["src"].push(np.float32([1.0]))
+            client["src"].push(np.int32([2]))
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            frames = client["out"].frames
+            assert len(frames) == 2
+            assert frames[0].tensors[0].dtype == np.float32
+            assert frames[1].tensors[0].dtype == np.int32
+            np.testing.assert_allclose(frames[0].tensors[0], [2.0])
+            np.testing.assert_array_equal(frames[1].tensors[0], [4])
+        finally:
+            server.stop()
+
+    def test_block_ingress_nonuniform_falls_back(self):
+        """A wire batch with mixed shapes cannot share a batch axis: the
+        server injects per-frame (scaler fake is shape-polymorphic)."""
+        server, port = _server(
+            505, extra_src="block-ingress=true", fw="scaler", model="",
+            max_batch=1,
+        )
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "wire-batch=4 ! tensor_sink name=out"
+            )
+            client.start()
+            client["src"].push(np.float32([1.0]))
+            client["src"].push(np.float32([1.0, 2.0]))  # different shape
+            client["src"].push(np.float32([3.0]))
+            client["src"].end_of_stream()
+            client.wait(timeout=30)
+            client.stop()
+            frames = client["out"].frames
+            assert len(frames) == 3
+            np.testing.assert_allclose(frames[0].tensors[0], [2.0])
+            np.testing.assert_allclose(frames[1].tensors[0], [2.0, 4.0])
+            np.testing.assert_allclose(frames[2].tensors[0], [6.0])
+        finally:
+            server.stop()
