@@ -25,12 +25,15 @@ Env knobs:
   BENCH_INGEST    block = frames enter pre-batched (one BatchFrame per
                   micro-batch, ≙ converter frames-per-tensor); default
                   per-frame pushes
+  BENCH_SINK_SPLIT 0 = sink delivers whole blocks to callbacks (skips the
+                  per-frame fan-out; counters use batch_size)
   BENCH_PLATFORM  cpu = force CPU (debug; numbers not comparable)
   BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT  backend probe retry knobs
 """
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -221,13 +224,33 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     fn, params, in_spec, out_spec = build(family, props)
     register_jax_model("bench_model", fn, params, in_spec, out_spec)
 
+    sink_split = os.environ.get("BENCH_SINK_SPLIT", "1") not in ("0", "false")
+    if not sink_split:
+        # whole-block delivery: the decoder's host half must also keep
+        # blocks whole (vectorized decode_fused_batch) or it re-splits.
+        # Fail LOUD for decoders without that path — a silently-split
+        # pipeline would publish a row labeled sink_split:false that
+        # measured the default configuration
+        from nnstreamer_tpu.core import registry as _registry
+
+        mode = re.search(r"mode=([a-z_0-9]+)", decoder).group(1)
+        dec_cls = _registry.get(_registry.KIND_DECODER, mode)
+        if not hasattr(dec_cls, "decode_fused_batch"):
+            raise SystemExit(
+                f"BENCH_SINK_SPLIT=0: decoder mode {mode!r} has no "
+                "decode_fused_batch (whole-block delivery unsupported)"
+            )
+        decoder = decoder.replace(
+            "tensor_decoder ", "tensor_decoder split-batches=false ", 1
+        )
     pipe = parse_pipeline(
         "appsrc name=src max-buffers=512 ! "
         "tensor_filter name=f framework=jax-xla model=bench_model "
         f"max-batch={batch} batch-timeout=20 latency=1 throughput=1 "
         f"dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} ! "
         + decoder
-        + "tensor_sink name=out max-stored=1",
+        + "tensor_sink name=out max-stored=1"
+        + ("" if sink_split else " split-batches=false"),
         name="bench",
     )
     # frame pool: realistic uint8 camera frames, cycled (generation off the
@@ -266,7 +289,13 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
 
     # warmup: trigger compiles for the full bucket and any tail buckets
     done = {"n": 0}
-    sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+    # counts LOGICAL frames either way: split mode delivers per-frame
+    # (batch_size absent -> 1), block-delivery mode delivers whole blocks
+    sink.connect_new_data(
+        lambda f: done.__setitem__(
+            "n", done["n"] + getattr(f, "batch_size", 1)
+        )
+    )
     if ingest_block:
         for i in range(2):
             src.push_block(blocks[i % len(blocks)])
@@ -473,6 +502,9 @@ def main() -> None:
         "ingest": (
             "block" if os.environ.get("BENCH_INGEST", "") == "block"
             else "frame"
+        ),
+        "sink_split": os.environ.get("BENCH_SINK_SPLIT", "1") not in (
+            "0", "false"
         ),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(

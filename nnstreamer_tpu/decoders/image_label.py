@@ -79,3 +79,28 @@ class ImageLabeling:
         """Host finishing after device_fn: tensor is [[idx, score]]."""
         packed = np.asarray(frame.tensors[0], np.float64).reshape(-1)
         return self._emit(frame, int(packed[0]), float(packed[1]))
+
+    def decode_fused_batch(self, frame, in_spec):
+        """Vectorized host finish for a whole block: one (B, 2) packed
+        tensor in, one BatchFrame of (1,) label indices out, per-logical
+        labels stamped into frames_info meta (decoder split-batches=false;
+        at chip rates the per-frame fan-out dominates the decode)."""
+        from ..core.buffer import BatchFrame
+
+        packed = np.asarray(frame.tensors[0], np.float64).reshape(-1, 2)
+        idx = packed[:, 0].astype(np.int32)
+        labels = self.labels
+        infos = []
+        for j, (p, d, m) in enumerate(frame.frames_info):
+            m2 = dict(m)
+            i = int(idx[j])
+            m2["label_index"] = i
+            m2["label_score"] = float(packed[j, 1])
+            if labels and i < len(labels):
+                m2["label"] = labels[i]
+            infos.append((p, d, m2))
+        return BatchFrame(
+            tensors=[idx[:, None]],
+            pts=frame.pts, duration=frame.duration, meta=dict(frame.meta),
+            frames_info=infos,
+        )

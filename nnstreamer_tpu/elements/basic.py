@@ -229,6 +229,11 @@ class TensorSink(SinkElement):
         "max-stored": Property(int, 0, "retain at most N frames (0 = all)"),
         "to-host": Property(bool, True, "materialize device arrays on render"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        "split-batches": Property(
+            bool, True,
+            "fan incoming BatchFrames back out to per-frame callbacks "
+            "(false = deliver the block whole; callbacks check batch_size)",
+        ),
     }
 
     def __init__(self, name=None):
@@ -241,9 +246,11 @@ class TensorSink(SinkElement):
         self._callbacks.append(cb)
 
     def render(self, frame: TensorFrame) -> None:
-        if isinstance(frame, BatchFrame):
+        if isinstance(frame, BatchFrame) and self.props["split-batches"]:
             # batch-through chains end here: fan the micro-batch back out
             # so callbacks/stored frames see per-frame granularity
+            # (split-batches=false delivers the block whole — at chip-rate
+            # streams the per-frame fan-out is itself the bottleneck)
             for f in frame.split():
                 self.render(f)
             return

@@ -41,6 +41,12 @@ class TensorDecoder(TransformElement):
             "(subplugin device_fn) into the upstream jax-xla filter's XLA "
             "program; never = always decode on host",
         ),
+        "split-batches": Property(
+            bool, True,
+            "fan incoming BatchFrames out to per-frame decodes (false = "
+            "decode the block vectorized and pass it downstream whole, "
+            "when the subplugin implements decode_fused_batch)",
+        ),
     }
 
     def __init__(self, name=None):
@@ -104,6 +110,14 @@ class TensorDecoder(TransformElement):
         # host finisher runs per logical frame.
         if isinstance(frame, BatchFrame):
             spec = self.sink_specs.get(0, ANY)
+            if (
+                self._fused
+                and not self.props["split-batches"]
+                and hasattr(self._dec, "decode_fused_batch")
+            ):
+                # vectorized host finish: the block stays whole (chip-rate
+                # streams: the per-frame fan-out is itself a bottleneck)
+                return [(0, self._dec.decode_fused_batch(frame, spec))]
             dec = self._dec.decode_fused if self._fused else self._dec.decode
             return [(0, dec(f, spec)) for f in frame.split()]
         return super().handle_frame(pad, frame)

@@ -435,6 +435,94 @@ class TestConverterEmitBlocks:
         assert frames[0].tensors[0].shape == (4, 1)
 
 
+class TestWholeBlockDelivery:
+    """decoder/sink split-batches=false: blocks stay whole through the
+    fused decode (vectorized decode_fused_batch) and arrive at callbacks
+    as BatchFrames — the per-frame fan-out disappears from the hot path."""
+
+    def _pipe(self, labels, sink_split):
+        from nnstreamer_tpu.backends.jax_xla import register_jax_model
+        register_jax_model("blk_pass", lambda p, xs: [xs[0]], None)
+        extra = "" if sink_split else " split-batches=false"
+        return parse_pipeline(
+            "appsrc name=src ! tensor_filter framework=jax-xla "
+            "model=blk_pass max-batch=8 ! "
+            f"tensor_decoder mode=image_labeling option1={labels}{extra} ! "
+            f"tensor_sink name=out{extra}"
+        )
+
+    def test_blocks_survive_to_callbacks_with_labels(self):
+        import tempfile
+
+        from nnstreamer_tpu.backends.jax_xla import unregister_jax_model
+        with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                         delete=False) as f:
+            f.write("\n".join(f"L{i}" for i in range(5)))
+            labels = f.name
+        try:
+            pipe = self._pipe(labels, sink_split=False)
+            got = []
+            pipe["out"].connect_new_data(got.append)
+            pipe.start()
+            rows = np.float32(
+                [np.eye(5, dtype=np.float32)[i % 5] for i in range(16)]
+            )
+            pipe["src"].push_block(rows[:8], pts=[float(i) for i in range(8)])
+            pipe["src"].push_block(rows[8:], pts=[float(i) for i in range(8, 16)])
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            pipe.stop()
+            # callbacks received whole blocks...
+            assert all(isinstance(f, BatchFrame) for f in got)
+            assert sum(f.batch_size for f in got) == 16
+            # ...with per-logical labels/pts in frames_info
+            flat = [
+                (p, m.get("label"))
+                for f in got for (p, d, m) in f.frames_info
+            ]
+            assert flat == [(float(i), f"L{i % 5}") for i in range(16)]
+        finally:
+            unregister_jax_model("blk_pass")
+
+    def test_split_results_identical_to_block_delivery(self):
+        import tempfile
+
+        from nnstreamer_tpu.backends.jax_xla import unregister_jax_model
+        with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                         delete=False) as f:
+            f.write("\n".join(f"L{i}" for i in range(5)))
+            labels = f.name
+        rows = np.float32(
+            [np.eye(5, dtype=np.float32)[(3 * i) % 5] for i in range(12)]
+        )
+        try:
+            results = {}
+            for split in (True, False):
+                pipe = self._pipe(labels, sink_split=split)
+                pipe.start()
+                pipe["src"].push_block(
+                    rows, pts=[float(i) for i in range(12)]
+                )
+                pipe["src"].end_of_stream()
+                pipe.wait(timeout=30)
+                frames = pipe["out"].frames
+                pipe.stop()
+                if split:
+                    results[split] = [
+                        (f.pts, f.meta.get("label"), int(f.tensors[0][0]))
+                        for f in frames
+                    ]
+                else:
+                    results[split] = [
+                        (p, m.get("label"), int(f.tensors[0][j, 0]))
+                        for f in frames
+                        for j, (p, d, m) in enumerate(f.frames_info)
+                    ]
+            assert results[True] == results[False]
+        finally:
+            unregister_jax_model("blk_pass")
+
+
 class TestBatchFrameUnit:
     def test_batchframe_through_push_roundtrip(self):
         """AppSrc.push accepts a hand-built BatchFrame (it IS a

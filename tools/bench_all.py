@@ -21,6 +21,11 @@ ROWS = [
     # amortized across the micro-batch — the pipeline_vs_raw >= 0.9
     # configuration on a host whose per-frame dispatch can't keep up
     ("mobilenet", {"BENCH_RAW": "1", "BENCH_INGEST": "block"}),
+    # + whole-block delivery (sink/decoder keep blocks intact): removes
+    # the per-frame fan-out on the output side too — the peak streaming
+    # configuration for hosts far slower than the chip
+    ("mobilenet", {"BENCH_RAW": "1", "BENCH_INGEST": "block",
+                   "BENCH_SINK_SPLIT": "0"}),
     # depth ablation: same window, synchronous dispatch — quantifies what
     # the depth-4 in-flight window buys on the chip (VERDICT r3 #2)
     ("mobilenet", {"BENCH_RAW": "1", "BENCH_DEPTH": "1"}),

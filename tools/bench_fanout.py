@@ -115,7 +115,8 @@ _SCRIPTS = {"sleepy": _SERVER_SLEEPY, "real": _SERVER_REAL,
 
 def run_scale(mode: str, n_servers: int, frames: int,
               work_ms: float, payload, wire_batch: int = 1,
-              connect_type: str = "grpc") -> "tuple[float, bool, int]":
+              connect_type: str = "grpc",
+              block_ingest: bool = False) -> "tuple[float, bool, int]":
     from nnstreamer_tpu.pipeline import parse_pipeline
 
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
@@ -174,8 +175,18 @@ def run_scale(mode: str, n_servers: int, frames: int,
         if len(pipe["out"].frames) < n_warm:
             raise RuntimeError(f"warmup incomplete ({mode}, N={n_servers})")
         t0 = time.perf_counter()
-        for _ in range(frames):
-            pipe["a"].push(payload)
+        if block_ingest and wire_batch > 1:
+            # blocks map 1:1 onto the wire-batch envelope: per-frame push/
+            # scheduler costs are paid once per RPC instead of once per
+            # frame — the client-ceiling configuration for block streams
+            import numpy as _np
+
+            block = _np.stack([_np.asarray(payload)] * wire_batch)
+            for _ in range(frames // wire_batch):
+                pipe["a"].push_block(block)
+        else:
+            for _ in range(frames):
+                pipe["a"].push(payload)
         pipe["a"].end_of_stream()
         pipe.wait(timeout=300)
         done = len(pipe["out"].frames) - n_warm
@@ -227,14 +238,19 @@ def main() -> int:
             # client-ceiling matrix: payload size × wire batching — the
             # two levers deciding whether ONE client can pump chip rate.
             # 2 echo servers keep the server side off the critical path.
-            for payload, wb, ct in (
-                (mobilenet_frame, 1, "grpc"), (mobilenet_frame, 8, "grpc"),
-                (mobilenet_frame, 1, "tcp"), (mobilenet_frame, 8, "tcp"),
-                (np.zeros((8,), np.float32), 8, "tcp"),
-                (np.zeros((8,), np.float32), 8, "grpc"),
+            for payload, wb, ct, blk in (
+                (mobilenet_frame, 1, "grpc", False),
+                (mobilenet_frame, 8, "grpc", False),
+                (mobilenet_frame, 1, "tcp", False),
+                (mobilenet_frame, 8, "tcp", False),
+                (mobilenet_frame, 8, "tcp", True),
+                (mobilenet_frame, 32, "tcp", True),
+                (np.zeros((8,), np.float32), 8, "tcp", False),
+                (np.zeros((8,), np.float32), 8, "grpc", False),
             ):
                 fps, _, _ = run_scale("echo", 2, frames, work_ms, payload,
-                                      wire_batch=wb, connect_type=ct)
+                                      wire_batch=wb, connect_type=ct,
+                                      block_ingest=blk)
                 emit({
                     "metric": "query_client_ceiling_fps",
                     "mode": "echo", "n_servers": 2,
@@ -243,6 +259,7 @@ def main() -> int:
                     "connect_type": ct,
                     "payload_bytes": int(payload.nbytes),
                     "wire_batch": wb,
+                    "ingest": "block" if blk else "frame",
                 })
             continue
         payload = (
