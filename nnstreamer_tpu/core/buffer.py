@@ -95,9 +95,14 @@ class BatchFrame(TensorFrame):
     caps throughput long before the MXU does, so batch-capable element
     chains (filter -> fused decoder -> sink) move whole micro-batches —
     usually still device-resident — and split only at a host boundary.
-    Produced by tensor_filter in batch-through mode; any element built on
-    ``with_tensors``/``pick`` preserves the batch (dataclasses.replace
-    keeps the subclass), and sinks/decoders split via :meth:`split`.
+    Produced by tensor_filter in batch-through mode and by block ingest
+    (``AppSrc.push_block`` / converter ``emit-blocks``).  ``with_tensors``/
+    ``pick`` preserve the subclass (dataclasses.replace), but delivery of
+    a WHOLE block to an element additionally requires that element to set
+    ``Element.BATCH_AWARE = True`` — the scheduler splits blocks into
+    logical frames before anything else (per-frame semantics are the
+    default; the batch fast path is an opt-in).  Sinks/decoders split via
+    :meth:`split`.
     """
 
     frames_info: List[Tuple[Optional[float], Optional[float], Dict[str, Any]]] = field(

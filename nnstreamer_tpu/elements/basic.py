@@ -225,6 +225,8 @@ class TensorSink(SinkElement):
     cap retained frames.
     """
 
+    BATCH_AWARE = True  # splits blocks itself (split-batches prop)
+
     PROPERTIES = {
         "max-stored": Property(int, 0, "retain at most N frames (0 = all)"),
         "to-host": Property(bool, True, "materialize device arrays on render"),
@@ -279,6 +281,8 @@ class Queue(TransformElement):
     ``leaky=upstream`` drops the incoming frame, ``leaky=downstream``
     drops the oldest queued frame.  Events are never dropped."""
 
+    BATCH_AWARE = True  # batch-transparent pass-through
+
     PROPERTIES = {
         "max-buffers": Property(int, 16, "bounded queue depth (backpressure)"),
         "leaky": Property(
@@ -309,13 +313,17 @@ class Queue(TransformElement):
 
 @element("identity")
 class Identity(TransformElement):
+    BATCH_AWARE = True  # batch-transparent; sleep scales per logical frame
+
     PROPERTIES = {
         "sleep": Property(float, 0.0, "artificial per-frame delay, seconds (tests)"),
     }
 
     def transform(self, frame):
         if self.props["sleep"]:
-            time.sleep(self.props["sleep"])
+            time.sleep(
+                self.props["sleep"] * getattr(frame, "batch_size", 1)
+            )
         return frame
 
 
@@ -323,6 +331,8 @@ class Identity(TransformElement):
 class Tee(Element):
     """1:N fan-out; frames are pushed to every linked src pad (payloads are
     shared, not copied — downstream must not mutate in place)."""
+
+    BATCH_AWARE = True  # batch-transparent fan-out
 
     NUM_SRC_PADS = None  # request pads
 
@@ -339,6 +349,8 @@ class CapsFilter(TransformElement):
 
     The parser creates one for bare schema strings between ``!`` links.
     """
+
+    BATCH_AWARE = True  # batch-transparent
 
     PROPERTIES = {"caps": Property(str, "", "tensors schema string")}
 
@@ -368,6 +380,8 @@ class Join(Element):
     ≙ ``gst/join/gstjoin.c``: whichever sink pad receives data first pushes
     through; no collation.
     """
+
+    BATCH_AWARE = True  # batch-transparent forwarding
 
     NUM_SINK_PADS = None
 

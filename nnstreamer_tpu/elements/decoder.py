@@ -28,6 +28,8 @@ _N_OPTIONS = 9  # reference carries option1..option9
 
 @element("tensor_decoder")
 class TensorDecoder(TransformElement):
+    BATCH_AWARE = True  # splits blocks itself (or keeps them whole, fused)
+
     PROPERTIES = {
         "mode": Property(str, "", "decoder subplugin name"),
         **{

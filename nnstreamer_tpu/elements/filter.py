@@ -223,6 +223,8 @@ def _logical_infos(
 
 @element("tensor_filter")
 class TensorFilter(TransformElement):
+    BATCH_AWARE = True  # consumes the batch axis (micro-batching)
+
     PROPERTIES = {
         "framework": Property(str, "auto", "backend name or 'auto'"),
         "model": Property(str, "", "model path / registry key"),

@@ -101,6 +101,8 @@ class TensorQueryServerSrc(SourceElement):
 
 @element("tensor_query_serversink")
 class TensorQueryServerSink(SinkElement):
+    BATCH_AWARE = True  # splits block answers per client RPC
+
     PROPERTIES = {
         "id": Property(int, 0, "pairs with the serversrc of the same id"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
@@ -138,6 +140,8 @@ class TensorQueryServerSink(SinkElement):
 class TensorQueryClient(Element):
     """Looks like a local filter; actually round-trips frames through remote
     server pipeline(s) with pipelined, order-preserving dispatch."""
+
+    BATCH_AWARE = True  # maps blocks onto the wire micro-batch envelope
 
     PROPERTIES = {
         "host": Property(str, "localhost", "server host"),

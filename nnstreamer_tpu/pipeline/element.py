@@ -132,6 +132,13 @@ class Element:
         ``handle_event``, ``start``/``stop`` as needed.
     """
 
+    #: a BatchFrame (N logical frames, one stream item) reaches this
+    #: element whole ONLY when True; otherwise the scheduler splits it
+    #: into per-frame calls first.  Opt in when the element either
+    #: consumes the batch axis (tensor_filter) or is batch-transparent
+    #: (queue/tee/capsfilter) or splits blocks itself (tensor_sink).
+    BATCH_AWARE = False
+
     FACTORY_NAME = "element"
     NUM_SINK_PADS: Optional[int] = 1
     NUM_SRC_PADS: Optional[int] = 1
@@ -277,6 +284,7 @@ class SinkElement(Element):
     """Element with no src pads; consumes frames via ``render()``."""
 
     NUM_SRC_PADS = 0
+    # non-aware sinks receive logical frames (the scheduler splits blocks)
 
     def render(self, frame: TensorFrame) -> None:
         raise NotImplementedError

@@ -25,7 +25,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from ..core.buffer import EOS, CapsEvent, Event, Flush, TensorFrame
+from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, Flush, TensorFrame
 from ..core.log import get_logger
 from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
 from .element import Element, ElementError, SinkElement, SourceElement
@@ -605,7 +605,18 @@ class Pipeline:
                         t_in = (
                             time.perf_counter() if tracer is not None else 0.0
                         )
-                        outs = el.handle_frame(pad, item) or []
+                        if (isinstance(item, BatchFrame)
+                                and not el.BATCH_AWARE):
+                            # block safety net: per-frame elements (if/
+                            # crop/transform/wire sinks/...) get logical
+                            # frames, never a surprise batch axis —
+                            # semantics first, blocks are an opt-in
+                            # optimization (BATCH_AWARE)
+                            outs = []
+                            for lf in item.split():
+                                outs.extend(el.handle_frame(pad, lf) or [])
+                        else:
+                            outs = el.handle_frame(pad, item) or []
                         if tracer is not None:
                             tracer.frame_out(
                                 el.name, t_in, time.perf_counter(),

@@ -523,6 +523,55 @@ class TestWholeBlockDelivery:
             unregister_jax_model("blk_pass")
 
 
+class TestBatchAwareSafetyNet:
+    """Non-batch-aware elements must see LOGICAL frames: the scheduler
+    splits blocks before per-frame elements (transform/if/...), so a block
+    upstream can never smuggle a surprise batch axis into per-frame
+    semantics (Element.BATCH_AWARE opt-in)."""
+
+    def test_transform_sees_logical_frames(self):
+        """mode=transpose on (2,3) frames would corrupt on a (B,2,3) batch
+        axis; with the safety net, blocks and per-frame pushes agree."""
+        def run(push):
+            pipe = parse_pipeline(
+                "appsrc name=src ! tensor_transform mode=transpose "
+                "option=1:0 ! tensor_sink name=out"
+            )
+            pipe.start()
+            push(pipe["src"])
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            frames = pipe["out"].frames
+            pipe.stop()
+            return [np.asarray(f.tensors[0]) for f in frames]
+
+        data = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+        per_frame = run(lambda s: [s.push(d) for d in data])
+        per_block = run(lambda s: s.push_block(data))
+        assert len(per_block) == 4
+        for a, b in zip(per_frame, per_block):
+            assert a.shape == (3, 2)
+            np.testing.assert_array_equal(a, b)
+
+    def test_tensor_if_routes_per_logical_frame(self):
+        """Data-dependent routing must evaluate each logical frame, not
+        the whole block once."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_if name=cond compared-value=A_VALUE "
+            "compared-value-option=0:0 supplied-value=10 operator=GE "
+            "then=PASSTHROUGH else=SKIP ! tensor_sink name=out"
+        )
+        pipe.start()
+        vals = np.float32([[3.0], [15.0], [7.0], [22.0]])
+        pipe["src"].push_block(vals)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        frames = pipe["out"].frames
+        pipe.stop()
+        got = [float(f.tensors[0][0]) for f in frames]
+        assert got == [15.0, 22.0]
+
+
 class TestBatchFrameUnit:
     def test_batchframe_through_push_roundtrip(self):
         """AppSrc.push accepts a hand-built BatchFrame (it IS a
