@@ -44,6 +44,11 @@ ROWS = [
     ("yolov5", {"BENCH_QUANT": "1"}),  # int8 backbone/neck
     ("posenet", {}),
     ("vit", {}),
+    # latency-optimized serving config (BASELINE.md tracks p50 per-frame
+    # latency): small batch, synchronous dispatch — the fps column is NOT
+    # the headline, the e2e_latency fields are
+    ("mobilenet", {"BENCH_BATCH": "8", "BENCH_DEPTH": "1",
+                   "BENCH_FRAMES": "1024"}),
     ("mnist_trainer", {}),
     # LAST on purpose, and sized to finish inside its deadline: over the
     # dev tunnel (~30 MB/s) a full 4096-frame host-sourced run cannot
@@ -55,17 +60,66 @@ ROWS = [
 ]
 
 
+def _row_sig(model, extra):
+    return {"model": model, **{k: str(v) for k, v in sorted(extra.items())}}
+
+
+def _write_rows(out_path, results):
+    """Atomic checkpoint: a kill mid-dump must never truncate the artifact
+    the resume feature exists to preserve."""
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=2)
+    os.replace(tmp, out_path)
+
+
 def main() -> int:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_ROWS.json"
     results = []
-    for i, (model, extra) in enumerate(ROWS):
+    done_sigs = []
+    if os.environ.get("BENCH_ALL_RESUME", "") in ("1", "true"):
+        # the tunnel comes and goes in windows: re-runs keep every
+        # successful row already captured and only re-measure the rest
+        try:
+            with open(out_path) as f:
+                prior = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            prior = []
+        valid_sigs = [_row_sig(m, e) for m, e in ROWS]
+        dropped = 0
+        for row in prior:
+            sig = row.get("_sig")
+            if (row.get("value") is not None and sig in valid_sigs
+                    and sig not in done_sigs):
+                results.append(row)
+                done_sigs.append(sig)
+            else:
+                # sig-less (pre-resume artifact) or a config since edited
+                # out of ROWS: re-measure fresh rather than publish stale
+                dropped += 1
+        if dropped and prior:
+            # never destroy data the new run won't reproduce verbatim
+            _write_rows(out_path + ".bak", prior)
+            print(f"[bench_all] resume: {dropped} prior row(s) unmatched "
+                  f"(no/stale _sig) — re-measuring; originals saved to "
+                  f"{out_path}.bak", flush=True)
+        if results:
+            print(f"[bench_all] resume: keeping {len(results)} prior rows",
+                  flush=True)
+    executed = 0
+    for model, extra in ROWS:
+        sig = _row_sig(model, extra)
+        if sig in done_sigs:
+            continue
         env = {**os.environ, "BENCH_MODEL": model, **extra}
-        if i > 0:
-            # the first row already proved the backend answers; later
-            # rows keep their probes short so a 10-row sweep fits a
-            # narrow tunnel-up window
+        if executed > 0:
+            # the first EXECUTED row already proved the backend answers;
+            # later rows keep their probes short so a full sweep fits a
+            # narrow tunnel-up window (resume runs skip completed rows,
+            # so row 0 of the list may not be the prover)
             env.setdefault("BENCH_PROBE_TRIES", "1")
             env.setdefault("BENCH_PROBE_TIMEOUT", "60")
+        executed += 1
         print(f"[bench_all] {model} {extra or ''}...", flush=True)
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py")],
@@ -87,10 +141,10 @@ def main() -> int:
                 "error": f"no JSON line (rc={r.returncode})",
             }
         print(f"[bench_all]   -> {json.dumps(row)}", flush=True)
+        row["_sig"] = sig  # resume key (self-describing row provenance)
         results.append(row)
-        # incremental write: a kill mid-sweep keeps completed rows
-        with open(out_path, "w") as f:
-            json.dump(results, f, indent=2)
+        # incremental atomic write: a kill mid-sweep keeps completed rows
+        _write_rows(out_path, results)
         if "unavailable" in str(row.get("error", "")) and not os.environ.get(
             "BENCH_ALL_KEEP_GOING"
         ):
