@@ -28,8 +28,9 @@ from jax import lax
 _NEG_INF = -1e30  # large-negative instead of -inf: avoids NaN in exp-diff
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, seq_len: int, block_q: int, valid_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *lse_ref, block_k: int,
+                  causal: bool, scale: float, seq_len: int, block_q: int,
+                  valid_len: int):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     q_ref (block_q, D); k_ref/v_ref (T, D) — the whole K/V for this head
@@ -85,6 +86,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         n_kv_eff = n_kv
     m, l, acc = lax.fori_loop(0, n_kv_eff, body, (m0, l0, acc0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    if lse_ref:
+        # per-row log-sum-exp of the (masked) scores: the cross-block
+        # merge statistic for ring attention (sequence parallelism);
+        # fully-masked rows keep a large-negative lse (l == 0)
+        lse = jnp.where(
+            l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF
+        )
+        lse_ref[0][:] = lse[:, None].astype(jnp.float32)
 
 
 try:  # imported lazily below for environments without pallas
@@ -96,30 +105,80 @@ except ImportError:  # pragma: no cover
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "interpret",
-                     "valid_len"),
+                     "valid_len", "with_lse"),
 )
 def _flash_bh(qf, kf, vf, causal: bool, block_q: int, block_k: int,
-              interpret: bool, valid_len: int):
-    """(BH, T, D) inputs -> (BH, T, D); grid over (BH, T/block_q)."""
-    BH, T, D = qf.shape
+              interpret: bool, valid_len: int, with_lse: bool = False):
+    """(BH, Tq, D) + (BH, Tk, D) K/V -> (BH, Tq, D) [+ (BH, Tq, 1) f32
+    lse]; grid over (BH, Tq/block_q).  Tk may differ from Tq (ring hops /
+    partial-key calls) — causal requires Tq == Tk (aligned positions)."""
+    BH, Tq, D = qf.shape
+    Tk = kf.shape[1]
+    assert not causal or Tq == Tk, "causal flash needs aligned q/k positions"
     scale = 1.0 / (D**0.5)
     kern = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-        seq_len=T, block_q=block_q, valid_len=valid_len,
+        seq_len=Tk, block_q=block_q, valid_len=valid_len,
     )
-    return pl.pallas_call(
+    # under shard_map (ring hops) outputs must declare their varying
+    # mesh axes (jax >= 0.9 vma typing); inherit from the traced input
+    vma = getattr(qf.aval, "vma", None)
+
+    def _sds(shape, dtype):
+        if vma:
+            try:
+                return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+            except TypeError:  # pragma: no cover — older jax
+                pass
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    out_shape = [_sds((BH, Tq, D), qf.dtype)]
+    out_specs = [pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0))]
+    if with_lse:
+        # trailing length-1 lane dim keeps the ref 2-D for Mosaic tiling
+        out_shape.append(_sds((BH, Tq, 1), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0))
+        )
+    res = pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), qf.dtype),
-        grid=(BH, T // block_q),
+        out_shape=out_shape,
+        grid=(BH, Tq // block_q),
         in_specs=[
             # None squeezes the batch*head dim out of the kernel refs
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=out_specs,
         interpret=interpret,
     )(qf, kf, vf)
+    return res if with_lse else res[0]
+
+
+def _kernel_usable(Tq, Tk, D, dtype, bq, bk, interpret, causal=False,
+                   aligned=True):
+    """Shared gate for both entry points: can the Pallas kernel run here,
+    or must the call fall back to the fused-XLA reference path?  One
+    predicate so the two entry points can never drift to different
+    fallback shapes."""
+    if pl is None:
+        return False
+    if jax.default_backend() != "tpu" and not interpret:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    # VMEM: one head's full K/V + the q block + f32 accumulators; past
+    # ~3/4 of the ~16 MB VMEM fall back instead of an opaque Mosaic
+    # overflow.  Constrains only the compiled kernel, not the interpreter.
+    vmem_est = (2 * Tk * D) * itemsize + bq * D * (itemsize + 4) \
+        + bq * bk * 4
+    if vmem_est > 12 * 1024 * 1024 and not interpret:
+        return False
+    if interpret and max(Tq, Tk) > 4096:
+        return False
+    if causal and not aligned:
+        return False
+    return True
 
 
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
@@ -130,7 +189,6 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     (tests), else the fused-XLA reference path (same numerics contract).
     """
     B, T, H, D = q.shape
-    platform = jax.default_backend()
     # interpret mode is for TESTS only (explicitly requested): it executes
     # the kernel block-by-block in the interpreter, orders of magnitude
     # slower than XLA.  Off-TPU without an explicit request -> reference.
@@ -150,21 +208,7 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
         T_pad = -(-T // blk) * blk
         # T_pad >= blk >= both requested blocks, and divides both
         bq, bk = min(block_q, T_pad), min(block_k, T_pad)
-    # VMEM budget: the kernel holds one head's full K/V plus the q block
-    # and f32 accumulators; past ~3/4 of the ~16 MB VMEM, fall back to the
-    # reference path instead of an opaque Mosaic overflow
-    itemsize = jnp.dtype(q.dtype).itemsize
-    vmem_est = (2 * T_pad * D) * itemsize + bq * D * (itemsize + 4) \
-        + bq * bk * 4
-    if (
-        pl is None
-        or (platform != "tpu" and not interpret)
-        # VMEM constrains only the compiled kernel, not the interpreter —
-        # gating interpret runs too would make kernel tests at big shapes
-        # silently compare reference to reference
-        or (vmem_est > 12 * 1024 * 1024 and not interpret)
-        or (interpret and T > 4096)
-    ):
+    if not _kernel_usable(T_pad, T_pad, D, q.dtype, bq, bk, interpret):
         from ..parallel.ring_attention import reference_attention
 
         return reference_attention(q, k, v, causal=causal).astype(q.dtype)
@@ -184,3 +228,65 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     if T_pad != T:
         out = out[:, :T]
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(q, k, v, *, causal: bool = True, block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Exact attention + per-row log-sum-exp: (B, T, H, D) ->
+    ((B, T, H, D), (B, H, T) f32).
+
+    The lse is the cross-block merge statistic: two attention partials
+    over disjoint key sets combine exactly as
+
+        lse = logaddexp(lse1, lse2)
+        out = out1 * exp(lse1 - lse) + out2 * exp(lse2 - lse)
+
+    which is how ``parallel/ring_attention.py`` composes this kernel
+    across the ``sp`` ring (each hop's K/V block -> one kernel call).
+    Falls back to the fused-XLA reference (same contract) off-TPU unless
+    ``interpret=True``.
+    """
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    if interpret is None:
+        interpret = False
+    bq, bk = min(block_q, T), min(block_k, Tk)
+    if (
+        not _kernel_usable(T, Tk, D, q.dtype, bq, bk, interpret,
+                           causal=causal, aligned=(T == Tk))
+        or T % bq or Tk % bk  # ring blocks are uniform; no padding path
+    ):
+        return reference_attention_lse(q, k, v, causal=causal)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    out, lse = _flash_bh(
+        qf, kf, vf, causal, bq, bk, bool(interpret), valid_len=Tk,
+        with_lse=True,
+    )
+    return (
+        out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, T),
+    )
+
+
+def reference_attention_lse(q, k, v, causal: bool = True):
+    """Unsharded exact attention + lse (kernel-free contract twin)."""
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (D**0.5)
+    if causal:
+        assert T == Tk, "causal reference needs aligned q/k positions"
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    lse = jax.nn.logsumexp(s, axis=-1)  # (B,H,T); -inf on fully-masked rows
+    p = jnp.exp(s - jnp.where(jnp.isinf(lse), 0.0, lse)[..., None])
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    lse = jnp.where(jnp.isinf(lse), jnp.float32(_NEG_INF), lse)
+    return out, lse.astype(jnp.float32)

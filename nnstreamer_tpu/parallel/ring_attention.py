@@ -72,6 +72,64 @@ def _merge(m1, o1, l1, m2, o2, l2):
     return m, o, l
 
 
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
+                      interpret: bool, ring_size: int):
+    """Per-shard body with the Pallas flash kernel as the block primitive.
+
+    Each ring hop holds one remote K/V block; the block's attention runs
+    as ONE flash-attention kernel call (``ops/flash_attention.py``
+    ``with_lse``), and partials merge across hops by the exact
+    (out, lse) recurrence.  Hop cases under causal masking:
+
+    * hop 0 — the device's own block: intra-block causal (kernel
+      ``causal=True``; local positions are aligned, no offset needed);
+    * source block strictly BEFORE mine: fully visible
+      (``causal=False``);
+    * source block AFTER mine: fully masked — the kernel still runs
+      (same cost shape as the jnp path, which masks everything to -inf)
+      but its contribution is zeroed via lse = -inf before the merge.
+
+    The hop loop is a Python unroll over the STATIC ``ring_size`` (the
+    mesh axis length), so each hop keeps a static kernel configuration;
+    visibility of later hops depends on the traced device index and is
+    applied as a select on lse.
+    """
+    from ..ops.flash_attention import _NEG_INF, flash_attention_lse
+
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    # this body runs under check_vma=False (the pallas interpreter emits
+    # constants without vma, tripping strict varying-axes typing), so the
+    # accumulators need no vary_over marking
+    lse_acc = jnp.full((B, H, T), _NEG_INF, jnp.float32)
+    o_acc = jnp.zeros(q.shape, jnp.float32)
+    perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+    k_cur, v_cur = k, v
+    for i in range(ring_size):
+        src = (my_idx - i) % ring_size  # traced; block owner of k_cur
+        o_b, lse_b = flash_attention_lse(
+            q, k_cur, v_cur, causal=(causal and i == 0),
+            interpret=interpret,
+        )
+        if causal and i > 0:
+            visible = src < my_idx  # traced whole-block visibility
+            lse_b = jnp.where(visible, lse_b, _NEG_INF)
+        # exact two-partial merge (the kernel's online-softmax recurrence
+        # lifted to whole blocks)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        a_acc = jnp.exp(lse_acc - lse_new)
+        a_b = jnp.exp(lse_b - lse_new)
+        o_acc = (
+            o_acc * a_acc.transpose(0, 2, 1)[..., None]
+            + o_b.astype(jnp.float32) * a_b.transpose(0, 2, 1)[..., None]
+        )
+        lse_acc = lse_new
+        if i + 1 < ring_size:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
 def _ring_attn_local(q, k, v, *, axis_name: str, all_axes, causal: bool):
     """Per-shard body (runs under shard_map): local Q stays put, K/V blocks
     ring-rotate `axis_size` times."""
@@ -116,12 +174,20 @@ def ring_attention(
     seq_axis: str = "sp",
     batch_axes=("dp",),
     causal: bool = True,
+    use_flash: bool = False,
+    interpret: bool = False,
 ):
     """Exact multi-head attention with the sequence dim sharded on
     ``seq_axis`` and batch on ``batch_axes``.
 
     q/k/v: (B, T, H, D) global shapes; T must divide by mesh[seq_axis].
     Returns (B, T, H, D) with the same sharding.
+
+    ``use_flash=True`` runs each ring hop's block product as ONE Pallas
+    flash-attention kernel call (ring-flash composition: VMEM-streamed
+    scores inside the hop, exact (out, lse) merge across hops) — the
+    long-context configuration on real TPU.  ``interpret`` forces the
+    kernel interpreter (CPU tests).
     """
     batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
     batch_spec = (
@@ -131,14 +197,32 @@ def ring_attention(
     )
     spec = P(batch_spec, seq_axis, None, None)
     all_axes = tuple(batch_axes) + (seq_axis,)
-    fn = shard_map(
-        functools.partial(
-            _ring_attn_local, axis_name=seq_axis, all_axes=all_axes, causal=causal
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
+    if use_flash:
+        body = functools.partial(
+            _ring_flash_local, axis_name=seq_axis,
+            causal=causal, interpret=interpret,
+            ring_size=mesh.shape[seq_axis],
+        )
+    else:
+        body = functools.partial(
+            _ring_attn_local, axis_name=seq_axis, all_axes=all_axes,
+            causal=causal,
+        )
+    kwargs = {}
+    if use_flash:
+        # the pallas interpreter/lowering emits internal constants without
+        # vma; jax's documented workaround is to disable the check for
+        # this body (the jnp ring keeps strict vma typing)
+        kwargs["check_vma"] = False
+    try:
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            **kwargs,
+        )
+    except TypeError:  # pragma: no cover — older jax without check_vma
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
     return fn(q, k, v)
 
 
