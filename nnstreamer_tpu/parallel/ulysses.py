@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring_attention import ring_attention, shard_map
+from .ring_attention import reference_attention, ring_attention, shard_map
 
 
 def _local_attention(q, k, v, causal: bool):
@@ -94,15 +94,32 @@ def ulysses_attention(
 
 def sequence_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
                        batch_axes=("dp",), causal: bool = True,
-                       strategy: str = "auto"):
+                       strategy: str = "auto", use_flash: bool = False,
+                       interpret: bool = False):
     """Pick a sequence-parallel attention strategy.
 
     ``auto``: Ulysses when the head count divides the ``sp`` axis (two
     ICI all-to-alls), else ring (sp-1 neighbor ppermutes).  Both exact.
+    ``ring-flash`` (or ``use_flash=True`` with ring) runs each ring hop
+    as one Pallas flash-attention kernel call.
     """
     sp = mesh.shape.get(seq_axis, 1)
+    if strategy == "ring-flash":
+        strategy, use_flash = "ring", True
     if strategy == "auto":
-        strategy = "ulysses" if sp > 1 and q.shape[2] % sp == 0 else "ring"
+        # an explicit flash request pins the ring path: auto-resolving to
+        # ulysses would silently drop it and re-materialize the full
+        # (T x T_local) score matrix the caller opted out of
+        strategy = (
+            "ring" if use_flash
+            else "ulysses" if sp > 1 and q.shape[2] % sp == 0
+            else "ring"
+        )
+    elif strategy == "ulysses" and use_flash:
+        raise ValueError(
+            "use_flash applies to the ring path; pass strategy='ring' or "
+            "'ring-flash' (ulysses has no per-hop kernel)"
+        )
     if strategy == "ulysses":
         return ulysses_attention(
             q, k, v, mesh, seq_axis=seq_axis, batch_axes=batch_axes,
@@ -111,9 +128,11 @@ def sequence_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
     if strategy == "ring":
         return ring_attention(
             q, k, v, mesh, seq_axis=seq_axis, batch_axes=batch_axes,
-            causal=causal,
+            causal=causal, use_flash=use_flash, interpret=interpret,
         )
-    raise ValueError(f"unknown strategy {strategy!r} (auto|ulysses|ring)")
+    raise ValueError(
+        f"unknown strategy {strategy!r} (auto|ulysses|ring|ring-flash)"
+    )
 
 
 __all__ = [

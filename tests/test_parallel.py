@@ -209,6 +209,23 @@ class TestUlyssesAttention:
             ref = reference_attention(q, k, v, causal=True)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_ring_flash_strategy(self, mesh8):
+        """strategy='ring-flash': each ring hop is one Pallas kernel call
+        (interpret mode on CPU), exact vs the oracle."""
+        from nnstreamer_tpu.parallel.ulysses import sequence_attention
+
+        rng = jax.random.PRNGKey(7)
+        q, k, v = (
+            jax.random.normal(r, (2, 32, 2, 8), jnp.float32)
+            for r in jax.random.split(rng, 3)
+        )
+        out = sequence_attention(
+            q, k, v, mesh8, causal=True, strategy="ring-flash",
+            interpret=True,
+        )
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
     def test_bf16(self, mesh8):
         from nnstreamer_tpu.parallel.ulysses import ulysses_attention
 
