@@ -72,6 +72,31 @@ def main() -> int:
             time.sleep(0.002)
         infer_count["pushed"] = i
 
+    # -- leg 1b: block-ingest inference (BatchFrame path endurance) ---------
+    blk = parse_pipeline(
+        "appsrc name=src max-buffers=64 ! "
+        "tensor_filter framework=jax-xla model=soak_m max-batch=32 "
+        "dispatch-depth=4 ! tensor_sink name=out max-stored=1")
+    blk_count = {"n": 0}
+    blk.start()
+    blk["out"].connect_new_data(
+        lambda f: blk_count.__setitem__("n", blk_count["n"] + 1))
+
+    def blk_feeder():
+        i = 0
+        while time.monotonic() < deadline:
+            try:
+                block = np.arange(
+                    i, i + 32, dtype=np.float32
+                )[:, None] % 251
+                blk["src"].push_block(block)
+                i += 32
+            except Exception as e:  # noqa: BLE001
+                errors.append(("block", repr(e)))
+                return
+            time.sleep(0.01)
+        blk_count["pushed"] = i
+
     # -- leg 2: MQTT QoS-1 with broker chaos --------------------------------
     broker = MiniBroker(retransmit_s=0.3)
     port = broker.port
@@ -165,7 +190,7 @@ time.sleep({minutes * 60 + 120})
         q_count["pushed"] = i
 
     feeders = [threading.Thread(target=f, daemon=True)
-               for f in (infer_feeder, mqtt_feeder, query_feeder)]
+               for f in (infer_feeder, blk_feeder, mqtt_feeder, query_feeder)]
     t0 = time.monotonic()
     for t in feeders:
         t.start()
@@ -173,12 +198,15 @@ time.sleep({minutes * 60 + 120})
         time.sleep(5)
         el = time.monotonic() - t0
         print(f"[soak] {el/60:5.1f}m  infer={infer_count['n']} "
+              f"block={blk_count['n']} "
               f"mqtt={len(mqtt_seen)} query={q_count['n']} "
               f"errors={len(errors)}", flush=True)
 
     # drain: EOS every leg, bounded waits
     infer["src"].end_of_stream()
     infer.wait(timeout=60)
+    blk["src"].end_of_stream()
+    blk.wait(timeout=60)
     tx["src"].end_of_stream()
     tx.wait(timeout=60)
     unacked = (tx["snk"]._client.drain(30.0)
@@ -188,6 +216,7 @@ time.sleep({minutes * 60 + 120})
     dt = time.monotonic() - t0
 
     infer_done = infer_count["n"]
+    blk_done = blk_count["n"]
     q_done = q_count["n"]
     deadline2 = time.time() + 60
     while len(mqtt_seen) < mqtt_state.get("pushed", 0) and \
@@ -195,6 +224,7 @@ time.sleep({minutes * 60 + 120})
         time.sleep(0.2)
 
     infer.stop()
+    blk.stop()
     tx.stop()
     rx.stop()
     qcli.stop()
@@ -223,6 +253,9 @@ time.sleep({minutes * 60 + 120})
             "infer": {"pushed": infer_count.get("pushed"),
                       "delivered": infer_done,
                       "fps": round(infer_done / dt, 1)},
+            "block_infer": {"pushed": blk_count.get("pushed"),
+                            "delivered": blk_done,
+                            "fps": round(blk_done / dt, 1)},
             "mqtt_qos1": {"pushed": mqtt_pushed,
                           "delivered_distinct": len(mqtt_seen),
                           "missing": len(mqtt_missing),
@@ -237,6 +270,7 @@ time.sleep({minutes * 60 + 120})
         "ok": (not errors and not leaked and not mqtt_missing
                and unacked == 0
                and infer_done == infer_count.get("pushed")
+               and blk_done == blk_count.get("pushed")
                and q_done == q_count.get("pushed")),
     }
     with open(out_path, "w") as f:
