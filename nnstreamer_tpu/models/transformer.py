@@ -119,9 +119,10 @@ class Block(nn.Module):
                 strategy=cfg.sp_strategy,
             )
         elif cfg.attn_impl == "flash":
-            from ..ops.flash_attention import flash_attention
+            from ..ops.flash_attention import flash_attention_grad
 
-            attn = flash_attention(q, k, v, causal=True)
+            # differentiable wrapper: kernel forward, recompute backward
+            attn = flash_attention_grad(q, k, v, True)
         else:
             attn = reference_attention(q, k, v, causal=True)
         attn = attn.reshape(B, T, D)

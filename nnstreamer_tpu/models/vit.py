@@ -50,9 +50,10 @@ class EncoderBlock(nn.Module):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         if self.attn_impl == "flash":
-            from ..ops.flash_attention import flash_attention
+            from ..ops.flash_attention import flash_attention_grad
 
-            a = flash_attention(q, k, v, causal=False)
+            # differentiable wrapper: kernel forward, recompute backward
+            a = flash_attention_grad(q, k, v, False)
         else:
             from ..parallel.ring_attention import reference_attention
 

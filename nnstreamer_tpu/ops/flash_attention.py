@@ -290,3 +290,58 @@ def reference_attention_lse(q, k, v, causal: bool = True):
     ).astype(q.dtype)
     lse = jnp.where(jnp.isinf(lse), jnp.float32(_NEG_INF), lse)
     return out, lse.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Differentiable wrapper: kernel forward, recompute backward.
+#
+# The Pallas kernel defines no VJP; a hand-written backward kernel is the
+# eventual optimization, but the standard interim pattern is forward-fast /
+# backward-recompute: the forward saves only (q, k, v) as residuals, and
+# the backward re-derives gradients through an f32-accumulated XLA
+# reference attention.  NOTE the O(T) memory property is the FORWARD's:
+# the recompute backward still materializes the (B,H,T,T) score matrix
+# under XLA autodiff, so training peak memory stays O(T^2) per layer
+# until a backward kernel lands (long-context training shards T via
+# parallel/ring_attention.py instead).  The model zoo's flash branches
+# call this entry point; inference-only code may call flash_attention.
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_grad(q, k, v, causal: bool = True, block_q: int = 128,
+                         block_k: int = 128,
+                         interpret: Optional[bool] = None):
+    """Differentiable flash attention: (B, T, H, D) -> (B, T, H, D).
+
+    Forward runs the Pallas kernel (or its documented fallbacks);
+    backward recomputes through ``reference_attention`` under XLA
+    autodiff — same numerics contract, no score matrix saved between
+    passes."""
+    return flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def ref(q_, k_, v_):
+        # f32 score accumulation + f32 softmax, matching the kernel's
+        # forward numerics — a bf16 recompute would round the softmax
+        # row-sums and skew gradients ~2% at T=128 (growing with T)
+        out, _ = reference_attention_lse(q_, k_, v_, causal=causal)
+        return out.astype(q_.dtype)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention_grad.defvjp(_fa_fwd, _fa_bwd)

@@ -174,3 +174,41 @@ class TestRingFlash:
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             atol=2e-2, rtol=2e-2,
         )
+
+
+class TestFlashAttentionGrad:
+    """flash_attention_grad: kernel forward, recompute backward — grads
+    must match full XLA autodiff through the reference."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        from nnstreamer_tpu.ops.flash_attention import flash_attention_grad
+
+        q, k, v = _qkv(B=1, T=32, H=2, D=8, seed=9)
+
+        def loss_flash(q, k, v):
+            o = flash_attention_grad(q, k, v, causal, 16, 16, True)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = reference_attention(q, k, v, causal=causal).astype(q.dtype)
+            return jnp.sum(o * o)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5
+            )
+
+    def test_forward_value_is_kernel_output(self):
+        from nnstreamer_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_attention_grad,
+        )
+
+        q, k, v = _qkv(B=1, T=32, H=2, D=8, seed=10)
+        a = flash_attention_grad(q, k, v, True, 16, 16, True)
+        b = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                            interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
