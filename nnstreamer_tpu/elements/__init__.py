@@ -21,6 +21,7 @@ for _mod in (
     "sparse",
     "datarepo",
     "trainer",
+    "generator",
     "query",
     "edge",
     "debug",

@@ -1,0 +1,177 @@
+"""tensor_generator: streaming autoregressive generation (net-new).
+
+The serving shape of interactive LLM inference, which the reference has no
+analog for (its closest relative is recurrence emulation through
+tensor_repo loops, ``tests/nnstreamer_repo_lstm``): ONE prompt frame in,
+token CHUNKS streamed out as they decode.  Downstream elements
+(detokenizer → sink / query serversink) run CONCURRENTLY with the next
+chunk's decode — the pipeline's per-element threads are the streaming
+transport, no extra machinery.
+
+TPU-first structure: the zoo transformer's KV cache (device-resident
+pytree) is carried across jitted calls — prefill is one causal pass, each
+chunk is one ``lax.scan`` segment (compile buckets: one per distinct
+chunk length, i.e. the chunk size + one tail).  Python dispatch cost is
+per CHUNK, not per token.  Sampling (greedy/temperature/top-k, per-step
+key folding) is bit-identical to one-shot ``generate:<N>`` serving
+(``models/transformer.py make_stream_generate``).
+
+Emission contract: ``handle_frame`` returns a GENERATOR; the scheduler
+pushes each yielded frame downstream as it is produced (frames stream,
+they do not wait for the full completion).  Each chunk frame carries
+tokens (B, n) int32 plus meta ``stream_seq`` (source frame seq),
+``chunk_index``, ``tokens_done`` and ``final``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import BatchFrame
+from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
+from ..pipeline.element import Element, ElementError, Property, element
+
+
+@element("tensor_generator")
+class TensorGenerator(Element):
+    # a block of prompts streams each logical prompt in order (lazy chain)
+    BATCH_AWARE = True
+
+    PROPERTIES = {
+        "custom": Property(
+            str, "",
+            "zoo-transformer dialect: vocab:N,d_model:N,heads:N,layers:N,"
+            "d_ff:N,seq:N,seed:N[,temperature:F,top_k:N,gen_seed:N]",
+        ),
+        "max-new": Property(int, 32, "tokens to generate per prompt"),
+        "chunk": Property(int, 8, "tokens per streamed chunk frame"),
+        "max-buffers": Property(int, 0, "mailbox depth override"),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._prefill = None
+        self._decode = None
+        self._params = None
+        self._max_seq = 0
+        self._jit_chunks: Dict[int, Any] = {}
+
+    def start(self):
+        import jax
+
+        from ..models.transformer import build_stream
+
+        props = {}
+        for part in self.props["custom"].split(","):
+            if ":" in part:
+                k, _, v = part.partition(":")
+                props[k.strip()] = v.strip()
+        props.pop("arch", None)  # tolerated for zoo-dialect symmetry
+        prefill, decode_chunk, params, self._max_seq = build_stream(props)
+        self._prefill = jax.jit(prefill)
+        self._decode = decode_chunk
+        self._params = params
+        self._jit_chunks = {}
+
+    def stop(self):
+        self._prefill = self._decode = self._params = None
+        self._jit_chunks.clear()
+
+    def _decode_n(self, n: int):
+        import jax
+
+        fn = self._jit_chunks.get(n)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, cache, tok, t0: self._decode(p, cache, tok, t0, n)
+            )
+            self._jit_chunks[n] = fn
+        return fn
+
+    # -- negotiation --------------------------------------------------------
+    def accept_spec(self, pad, spec):
+        return spec
+
+    def derive_spec(self, pad=0):
+        # chunk length varies (tail chunk): flexible stream
+        return StreamSpec((), FORMAT_FLEXIBLE)
+
+    # -- processing ---------------------------------------------------------
+    def handle_frame(self, pad, frame):
+        assert self._prefill is not None, f"{self.name} not started"
+        if isinstance(frame, BatchFrame):
+            # lazily chain one stream per logical prompt: chunk frames of
+            # prompt j still leave BEFORE prompt j+1 starts decoding
+            logical = frame.split()
+
+            def multi():
+                for lf in logical:
+                    yield from self._stream_one(lf)
+
+            return multi()
+        return self._stream_one(frame)
+
+    def _stream_one(self, frame):
+        prompt = np.asarray(frame.tensors[0])
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        if prompt.ndim != 2 or prompt.dtype.kind not in "iu":
+            raise ElementError(
+                f"{self.name}: prompt must be int tokens (B, Tp) or (Tp,), "
+                f"got {prompt.shape} {prompt.dtype}"
+            )
+        max_new = int(self.props["max-new"])
+        chunk = max(1, int(self.props["chunk"]))
+        if prompt.shape[1] + max_new > self._max_seq:
+            # the cache ring would wrap and pos_embed would index past
+            # max_seq — fail loud instead of streaming corrupt tokens
+            raise ElementError(
+                f"{self.name}: prompt {prompt.shape[1]} + max-new "
+                f"{max_new} exceeds the model's seq {self._max_seq}"
+            )
+        if max_new <= 0:
+            return []
+
+        def stream():
+            cache, tok = self._prefill(self._params, prompt.astype(np.int32))
+            done = 0
+            idx = 0
+            pending = [np.asarray(tok)[:, None]]  # token 1 (from prefill)
+            pending_n = 1
+            t = 1
+            while True:
+                emit_now = pending_n >= chunk or (t >= max_new)
+                if emit_now and pending_n:
+                    toks = (
+                        pending[0] if len(pending) == 1
+                        else np.concatenate(pending, axis=1)
+                    )
+                    done += toks.shape[1]
+                    out = frame.with_tensors([toks.astype(np.int32)])
+                    out.meta.update(
+                        stream_seq=frame.seq, chunk_index=idx,
+                        tokens_done=done, final=bool(t >= max_new),
+                    )
+                    idx += 1
+                    pending.clear()
+                    pending_n = 0
+                    yield (0, out)
+                if t >= max_new:
+                    return
+                n = min(chunk - pending_n, max_new - t)
+                cache2, tok2, toks = self._decode_n(n)(
+                    self._params, cache, tok, t
+                )
+                # materialize BEFORE yielding: emission must mean "these
+                # tokens exist", not "their computation was dispatched"
+                pending.append(np.asarray(toks))
+                pending_n += toks.shape[1]
+                cache, tok = cache2, tok2
+                t += n
+
+        return stream()
+
+    def handle_eos(self, pad):
+        return []

@@ -203,19 +203,64 @@ def make_generate(
     deterministic for a given ``seed`` (the key is folded per step and
     per batch row).
 
-    Two phases inside one traced function: chunked PREFILL — a single
-    full-attention forward over the whole prompt that fills the K/V cache
-    (long prompts cost one pass, not Tp sequential steps) — then a
-    ``lax.scan`` decoding one token per step over static-shape cache
-    rings.  The backend jit-compiles one XLA program per (B, Tp) bucket;
-    no per-token Python dispatch, no growing shapes.  The serving analog
-    of the reference's recurrence emulation (``tests/nnstreamer_repo_lstm``
+    Expressed ON TOP of :func:`make_stream_generate`'s halves — chunked
+    PREFILL (one causal pass fills the K/V cache) + ONE decode_chunk
+    scan over the remaining tokens — so the one-shot and streaming paths
+    share a single implementation and stay bit-equal by construction.
+    The backend jit-compiles one XLA program per (B, Tp) bucket; no
+    per-token Python dispatch, no growing shapes.  The serving analog of
+    the reference's recurrence emulation (``tests/nnstreamer_repo_lstm``
     loops frames through tensor_repo); here the loop lives inside the
     compiled program.
     """
+    prefill, decode_chunk = make_stream_generate(
+        cfg, temperature=temperature, top_k=top_k, seed=seed
+    )
+
+    def gen(params, prompt):  # (B, Tp) int32
+        B, Tp = prompt.shape
+        if Tp + max_new > cfg.max_seq:
+            raise ValueError(
+                f"prompt {Tp} + generate {max_new} exceeds max_seq "
+                f"{cfg.max_seq}"
+            )
+        cache, first = prefill(params, prompt)
+        if max_new <= 1:
+            generated = first[:, None]
+        else:
+            _, _, rest = decode_chunk(params, cache, first, 1, max_new - 1)
+            generated = jnp.concatenate([first[:, None], rest], axis=1)
+        return jnp.concatenate([prompt, generated], axis=1)
+
+    return gen
+
+
+def make_stream_generate(
+    cfg: TransformerConfig,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+):
+    """Chunked KV-cache decoding for STREAMING serving: unlike
+    :func:`make_generate` (whole completion in one traced program), this
+    returns two jittable halves whose cache pytree is carried BETWEEN
+    calls by the caller, so tokens can leave the pipeline while later
+    chunks are still decoding:
+
+    * ``prefill(params, prompt (B,Tp)) -> (cache, first_tok (B,))`` —
+      one causal pass fills the cache and picks token 1;
+    * ``decode_chunk(params, cache, tok, t0, n) -> (cache, last_tok,
+      toks (B, n))`` — n more tokens via one ``lax.scan`` (compile
+      buckets: one per distinct n; callers use a fixed chunk + one tail).
+
+    ``elements/generator.py`` streams these through a pipeline.  Sampling
+    semantics (greedy / temperature / top-k, per-step key folding) are
+    IDENTICAL to make_generate — the streamed token sequence is
+    bit-equal to the one-shot path for the same seed.
+    """
     model_dec = TransformerLM(cfg, decode=True)
 
-    def pick(logits, key):  # (B, V) -> (B,) next token
+    def pick(logits, key):  # (B, V) -> (B,)
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = logits.astype(jnp.float32) / temperature
@@ -226,17 +271,10 @@ def make_generate(
             scaled = jnp.where(scaled >= kth, scaled, -1e30)
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
-    def gen(params, prompt):  # (B, Tp) int32
+    key0 = jax.random.PRNGKey(seed)
+
+    def prefill(params, prompt):
         B, Tp = prompt.shape
-        total = Tp + max_new
-        if total > cfg.max_seq:
-            raise ValueError(
-                f"prompt {Tp} + generate {max_new} exceeds max_seq "
-                f"{cfg.max_seq}"
-            )
-        # empty-cache state: eval_shape gives the cache tree's structure
-        # without tracing the whole init (whose random params would be
-        # dead code), and zeros ARE the empty state (index=0)
         cache_shapes = jax.eval_shape(
             lambda: model_dec.init(
                 jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32)
@@ -245,36 +283,51 @@ def make_generate(
         cache0 = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
         )
-        variables = {"params": params["params"]}
-
-        key0 = jax.random.PRNGKey(seed)
-
-        # phase 1: prefill the cache with ONE causal pass over the prompt
         logits_p, upd = model_dec.apply(
-            {**variables, "cache": cache0}, prompt, mutable=["cache"]
+            {"params": params["params"], "cache": cache0},
+            prompt, mutable=["cache"],
         )
-        first = pick(logits_p[:, -1, :], key0)
+        return upd["cache"], pick(logits_p[:, -1, :], key0)
 
-        # phase 2: decode max_new - 1 more tokens, one per scan step
-        def step(carry, t):
+    def decode_chunk(params, cache, tok, t0, n):
+        """n is static per compile bucket; t0 is traced (key folding)."""
+
+        def step(carry, i):
             cache, tok = carry
-            logits, upd2 = model_dec.apply(
-                {**variables, "cache": cache},
-                tok[:, None],
-                mutable=["cache"],
+            logits, upd = model_dec.apply(
+                {"params": params["params"], "cache": cache},
+                tok[:, None], mutable=["cache"],
             )
-            nxt = pick(logits[:, -1, :], jax.random.fold_in(key0, t + 1))
-            return (upd2["cache"], nxt), nxt
+            nxt = pick(logits[:, -1, :], jax.random.fold_in(key0, t0 + i))
+            return (upd["cache"], nxt), nxt
 
-        (_, _), rest = jax.lax.scan(
-            step, (upd["cache"], first), jnp.arange(max_new - 1)
+        (cache, tok), toks = jax.lax.scan(
+            step, (cache, tok), jnp.arange(n)
         )
-        generated = jnp.concatenate(
-            [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
-        )
-        return jnp.concatenate([prompt, generated], axis=1)
+        return cache, tok, jnp.moveaxis(toks, 0, 1)  # (B, n)
 
-    return gen
+    return prefill, decode_chunk
+
+
+def build_stream(props: Dict[str, str]):
+    """Factory for the streaming-generation element: same ``custom``
+    dialect (and seed semantics: ``seed`` = params, ``gen_seed`` =
+    sampling) as the zoo transformer, so the streamed tokens are
+    bit-equal to ``generate:<N>`` one-shot serving.  Returns
+    (prefill, decode_chunk, params, max_seq)."""
+    cfg = _cfg_from_props(props)
+    params = host_init(
+        TransformerLM(cfg).init,
+        int(props.get("seed", "0")),
+        np.zeros((1, min(8, cfg.max_seq)), np.int32),
+    )
+    prefill, decode_chunk = make_stream_generate(
+        cfg,
+        temperature=float(props.get("temperature", "0")),
+        top_k=int(props.get("top_k", "0")),
+        seed=int(props.get("gen_seed", "0")),
+    )
+    return prefill, decode_chunk, params, cfg.max_seq
 
 
 def build(custom_props=None):

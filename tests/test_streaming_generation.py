@@ -1,0 +1,166 @@
+"""tensor_generator: streaming KV-cache decoding through a pipeline.
+
+Oracle: the streamed chunk concatenation must be BIT-EQUAL to the
+one-shot ``generate:<N>`` path (same params seed, same sampling seed,
+same per-step key folding) — streaming is a transport change, never a
+sampling change.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import build
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+PROPS = {
+    "dtype": "float32", "vocab": 61, "d_model": 32, "heads": 2,
+    "layers": 2, "d_ff": 64, "seq": 64, "seed": 11,
+}
+CUSTOM = ",".join(f"{k}:{v}" for k, v in PROPS.items())
+
+
+def _oneshot(prompt, n):
+    fn, params, _, _ = build(
+        "transformer", {**PROPS, "generate": str(n)}
+    )
+    out = np.asarray(fn(params, [prompt])[0])
+    return out[:, prompt.shape[1]:]
+
+
+def _run_stream(prompt, n, chunk, extra_custom=""):
+    custom = CUSTOM + ("," + extra_custom if extra_custom else "")
+    pipe = parse_pipeline(
+        f"appsrc name=src ! tensor_generator custom={custom} "
+        f"max-new={n} chunk={chunk} ! tensor_sink name=out"
+    )
+    pipe.start()
+    pipe["src"].push(prompt)
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=120)
+    frames = pipe["out"].frames
+    pipe.stop()
+    return frames
+
+
+class TestStreamingGeneration:
+    def test_chunks_equal_oneshot_tokens(self, rng):
+        prompt = rng.integers(0, PROPS["vocab"], (1, 7)).astype(np.int32)
+        n, chunk = 13, 4
+        frames = _run_stream(prompt, n, chunk)
+        # ceil((n - 1 prefill-token rounds into chunks)): emission sizes
+        # are chunk-aligned with one tail
+        toks = np.concatenate([np.asarray(f.tensors[0]) for f in frames],
+                              axis=1)
+        want = _oneshot(prompt, n)
+        np.testing.assert_array_equal(toks, want)
+        # chunk metadata is coherent and ordered
+        assert [f.meta["chunk_index"] for f in frames] == list(
+            range(len(frames))
+        )
+        assert frames[-1].meta["final"] is True
+        assert all(f.meta["final"] is False for f in frames[:-1])
+        assert frames[-1].meta["tokens_done"] == n
+        assert all(f.meta["stream_seq"] is not None for f in frames)
+        assert len(frames) == -(-n // chunk)
+
+    def test_batched_prompts(self, rng):
+        prompt = rng.integers(0, PROPS["vocab"], (3, 5)).astype(np.int32)
+        n, chunk = 8, 3
+        frames = _run_stream(prompt, n, chunk)
+        toks = np.concatenate([np.asarray(f.tensors[0]) for f in frames],
+                              axis=1)
+        assert toks.shape == (3, n)
+        np.testing.assert_array_equal(toks, _oneshot(prompt, n))
+
+    def test_sampling_stream_matches_oneshot(self, rng):
+        """temperature/top-k sampling: per-step key folding must line up
+        across the chunk boundaries (gen_seed dialect)."""
+        prompt = rng.integers(0, PROPS["vocab"], (1, 4)).astype(np.int32)
+        n = 9
+        fn, params, _, _ = build(
+            "transformer",
+            {**PROPS, "generate": str(n), "temperature": "0.8",
+             "top_k": "7", "gen_seed": "3"},
+        )
+        want = np.asarray(fn(params, [prompt])[0])[:, prompt.shape[1]:]
+        frames = _run_stream(
+            prompt, n, 4, "temperature:0.8,top_k:7,gen_seed:3"
+        )
+        toks = np.concatenate([np.asarray(f.tensors[0]) for f in frames],
+                              axis=1)
+        np.testing.assert_array_equal(toks, want)
+
+    def test_detokenizer_streams_text(self, rng):
+        """Full streaming-serving pipeline: generator -> detokenizer ->
+        sink; each chunk arrives as text."""
+        prompt = rng.integers(0, 61, (1, 4)).astype(np.int32)
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_generator custom={CUSTOM} "
+            "max-new=6 chunk=2 ! tensor_decoder mode=detokenizer ! "
+            "tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(prompt)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=120)
+        frames = pipe["out"].frames
+        pipe.stop()
+        assert len(frames) == 3
+        assert all(isinstance(f.meta.get("text"), str) for f in frames)
+        assert frames[-1].meta["final"] is True
+        text = "".join(f.meta["text"] for f in frames)
+        want = _oneshot(prompt, 6).ravel()
+        want_text = bytes(
+            int(t) if 0 <= t < 256 else ord("?") for t in want
+        ).decode("utf-8", errors="replace")
+        assert text == want_text
+
+    def test_max_new_zero_emits_nothing(self, rng):
+        prompt = rng.integers(0, PROPS["vocab"], (1, 4)).astype(np.int32)
+        frames = _run_stream(prompt, 0, 4)
+        assert frames == []
+
+    def test_block_of_prompts_streams_in_order(self, rng):
+        """A BatchFrame of prompts: each logical prompt streams its own
+        chunk sequence, in prompt order (lazy chain, BATCH_AWARE)."""
+        prompts = rng.integers(0, PROPS["vocab"], (2, 5)).astype(np.int32)
+        n, chunk = 6, 4
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_generator custom={CUSTOM} "
+            f"max-new={n} chunk={chunk} ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push_block(prompts)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=120)
+        frames = pipe["out"].frames
+        pipe.stop()
+        # 2 prompts x ceil(6/4) = 2 chunks each, grouped by stream_seq
+        assert len(frames) == 4
+        seqs = [f.meta["stream_seq"] for f in frames]
+        assert seqs[0] == seqs[1] and seqs[2] == seqs[3]
+        assert seqs[0] != seqs[2]
+        for j in range(2):
+            toks = np.concatenate(
+                [np.asarray(f.tensors[0]) for f in frames[2 * j:2 * j + 2]],
+                axis=1,
+            )
+            np.testing.assert_array_equal(
+                toks, _oneshot(prompts[j:j + 1], n)
+            )
+
+    def test_overrun_fails_loud(self, rng):
+        """prompt + max-new beyond the model's seq must error, not stream
+        corrupt tokens (cache ring wrap / pos_embed overflow)."""
+        prompt = rng.integers(0, PROPS["vocab"], (1, 60)).astype(np.int32)
+        pipe = parse_pipeline(
+            f"appsrc name=src ! tensor_generator custom={CUSTOM} "
+            "max-new=32 chunk=8 ! tensor_sink name=out"
+        )
+        pipe.start()
+        pipe["src"].push(prompt)
+        pipe["src"].end_of_stream()
+        with pytest.raises(Exception, match="exceeds the model's seq"):
+            pipe.wait(timeout=60)
+        pipe.stop()
+
