@@ -23,6 +23,7 @@ client_id meta is still attached for in-pipeline visibility and parity.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import queue
 import threading
@@ -101,14 +102,13 @@ class QueryServerCore:
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
-    def process(self, frames: List[TensorFrame], timeout: float
-                ) -> List[TensorFrame]:
-        """Route frames through the paired server pipeline and collect the
-        answers in stream order.  Shared by every transport (gRPC unary
-        handler, raw-TCP connection threads).  Raises TimeoutError when
-        the pipeline produces no answer in time."""
+    @contextlib.contextmanager
+    def _pending_client(self, frames: List[TensorFrame], qsize: int = 0):
+        """Register a fresh client slot, stamp+inject the frames, and
+        guarantee cleanup — the shared bookkeeping of the unary
+        (:meth:`process`) and streaming (:meth:`_invoke_stream`) paths."""
         client_id = next(self._client_seq)
-        answer_q: "queue.Queue[TensorFrame]" = queue.Queue(len(frames))
+        answer_q: "queue.Queue[TensorFrame]" = queue.Queue(qsize)
         with self._pending_lock:
             self._pending[client_id] = answer_q
         try:
@@ -116,6 +116,18 @@ class QueryServerCore:
                 frame.meta["client_id"] = client_id
             for item in self._ingress_items(frames):
                 self.ingress.put((client_id, item), timeout=10)
+            yield answer_q
+        finally:
+            with self._pending_lock:
+                self._pending.pop(client_id, None)
+
+    def process(self, frames: List[TensorFrame], timeout: float
+                ) -> List[TensorFrame]:
+        """Route frames through the paired server pipeline and collect the
+        answers in stream order.  Shared by every transport (gRPC unary
+        handler, raw-TCP connection threads).  Raises TimeoutError when
+        the pipeline produces no answer in time."""
+        with self._pending_client(frames, qsize=len(frames)) as answer_q:
             answers = []
             deadline = time.monotonic() + min(timeout, 300.0)
             for _ in frames:
@@ -130,9 +142,6 @@ class QueryServerCore:
                         "server pipeline produced no answer in time"
                     ) from None
             return answers
-        finally:
-            with self._pending_lock:
-                self._pending.pop(client_id, None)
 
     def _ingress_items(self, frames: List[TensorFrame]) -> List[TensorFrame]:
         """block_ingress: a wire micro-batch becomes ONE BatchFrame so the
@@ -171,6 +180,40 @@ class QueryServerCore:
             return encode_frames(answers)
         return encode_frame(answers[0])
 
+    def _invoke_stream(self, request: bytes, context):
+        """Server-streaming invoke: ONE request frame in, answer frames
+        streamed out as the server pipeline produces them, until an
+        answer carries ``meta["final"] is True`` (the tensor_generator
+        chunk contract) — remote interactive serving: tokens reach the
+        client while later chunks are still decoding.
+
+        Non-streaming server graphs work too: a plain 1:1 pipeline's
+        single answer has no ``final`` meta, so exactly one message is
+        streamed and the stream closes via the sentinel check below."""
+        frame = decode_frame(request)
+        with self._pending_client([frame]) as answer_q:
+            # the CLIENT's deadline governs the whole stream (a long
+            # generation is the point); hard backstop only against
+            # deadline-less channels
+            deadline = time.monotonic() + min(
+                float(context.time_remaining() or 30.0), 3600.0
+            )
+            while True:
+                try:
+                    ans = answer_q.get(
+                        timeout=max(0.0, deadline - time.monotonic())
+                    )
+                except queue.Empty:
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "server pipeline produced no (further) answer in time",
+                    )
+                yield encode_frame(ans)
+                # a non-streaming graph emits exactly one answer with no
+                # "final" key -> treat absent as final
+                if ans.meta.get("final", True):
+                    return
+
     def resolve(self, client_id: int, frame: TensorFrame) -> bool:
         """serversink delivers an answer to the waiting client RPC."""
         with self._pending_lock:
@@ -191,6 +234,10 @@ class QueryServerCore:
             ),
             "Invoke": grpc.unary_unary_rpc_method_handler(
                 self._invoke, request_deserializer=_ident, response_serializer=_ident
+            ),
+            "InvokeStream": grpc.unary_stream_rpc_method_handler(
+                self._invoke_stream,
+                request_deserializer=_ident, response_serializer=_ident,
             ),
         }
         self._server = grpc.server(
@@ -278,6 +325,10 @@ class QueryConnection:
         self._handshake = self._channel.unary_unary(
             "/nns.Query/Handshake", request_serializer=_ident, response_deserializer=_ident
         )
+        self._invoke_stream_rpc = self._channel.unary_stream(
+            "/nns.Query/InvokeStream",
+            request_serializer=_ident, response_deserializer=_ident,
+        )
 
     def handshake(self, caps: str) -> str:
         return self._handshake(caps.encode(), timeout=self.timeout).decode()
@@ -287,6 +338,16 @@ class QueryConnection:
             encode_frame(frame), timeout=timeout or self.timeout
         )
         return decode_frame(data)
+
+    def invoke_stream(self, frame: TensorFrame,
+                      timeout: Optional[float] = None):
+        """Server-streaming invoke: yields answer frames as they arrive
+        (the last one is final-flagged or has no ``final`` meta).
+        ``timeout`` bounds the WHOLE stream."""
+        for data in self._invoke_stream_rpc(
+            encode_frame(frame), timeout=timeout or self.timeout
+        ):
+            yield decode_frame(data)
 
     def invoke_batch(self, frames: List[TensorFrame],
                      timeout: Optional[float] = None) -> List[TensorFrame]:

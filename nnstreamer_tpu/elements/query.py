@@ -163,6 +163,13 @@ class TensorQueryClient(Element):
         # cost exactly like the filter's batched XLA invoke amortizes
         # dispatch.  1 = per-frame RPCs (reference parity).
         "wire-batch": Property(int, 1, "max frames per RPC (1 = no batching)"),
+        "stream": Property(
+            bool, False,
+            "server-streaming invoke (gRPC): answer frames are emitted as "
+            "the remote pipeline produces them until a final-flagged one "
+            "arrives — remote streaming generation; incompatible with "
+            "wire-batch > 1 and connect-type=tcp",
+        ),
         "connect-type": Property(
             str, "grpc",
             "transport: grpc (interop default) | tcp (zero-copy raw TCP "
@@ -197,6 +204,17 @@ class TensorQueryClient(Element):
         if not targets or any(p == 0 for _, p in targets):
             raise ElementError(f"{self.name}: query client needs host/port")
         ct = self.props["connect-type"]
+        if self.props["stream"]:
+            if ct != "grpc":
+                raise ElementError(
+                    f"{self.name}: stream=true needs connect-type=grpc "
+                    "(server-streaming RPC)"
+                )
+            if int(self.props["wire-batch"]) > 1:
+                raise ElementError(
+                    f"{self.name}: stream=true is per-request; "
+                    "wire-batch must be 1"
+                )
         if ct == "tcp":
             from ..distributed.tcp_query import TcpQueryConnection
 
@@ -346,9 +364,58 @@ class TensorQueryClient(Element):
             for f in frames:
                 logical.extend(f.split() if isinstance(f, BatchFrame) else [f])
             frames = logical
+        if self.props["stream"]:
+            # sequential per-request streams: chunk frames of request j
+            # leave BEFORE request j+1 is sent (the scheduler pushes each
+            # yielded frame immediately)
+            def streams():
+                for f in frames:
+                    yield from self._stream_invoke(f)
+
+            return streams()
         if len(frames) == 1:
             return self._dispatch(frames[0])
         return self._dispatch(list(frames))
+
+    def _stream_invoke(self, frame):
+        """One server-streaming request: healthy-first server order, whole
+        streams fail over only BEFORE the first answer arrives (a stream
+        broken mid-way surfaces as an error — replaying half a generation
+        could duplicate tokens at the consumer)."""
+        import time as _time
+
+        order = self._healthy_order(self._rr % len(self._conns))
+        self._rr += 1
+        # retries=0 means SINGLE attempt: a request the server may already
+        # have ingested must not be silently re-executed elsewhere unless
+        # the user opted into at-least-once via retries>0 (same contract
+        # as _invoke_failover)
+        attempts = min(len(order), 1 + max(0, self.props["retries"]))
+        timeout = self.props["timeout"]
+        err: Optional[BaseException] = None
+        for i in order[:attempts]:
+            conn = self._conns[i]
+            started = False
+            try:
+                for ans in conn.invoke_stream(frame, timeout):
+                    started = True
+                    self._down_until.pop(i, None)
+                    yield (0, ans)
+                return
+            except Exception as e:  # noqa: BLE001 — transport boundary
+                if started:
+                    raise  # mid-stream break: no safe replay
+                err = e
+                # short cooldown: the stream timeout is minutes-scale (a
+                # whole generation), not a health signal
+                self._down_until[i] = _time.monotonic() + min(
+                    float(timeout), 10.0
+                )
+                self.log.warning(
+                    "stream to %s failed before first answer: %s",
+                    conn.addr, e,
+                )
+        raise err if err is not None else RuntimeError("no servers")
 
     def _dispatch(self, frame_or_batch):
         first = self._rr % len(self._conns)

@@ -164,3 +164,85 @@ class TestStreamingGeneration:
             pipe.wait(timeout=60)
         pipe.stop()
 
+
+
+class TestRemoteStreaming:
+    """Streaming generation across the query data plane: a generator
+    server pipeline streams chunk frames back over ONE server-streaming
+    RPC; the client emits them as they arrive."""
+
+    def test_remote_stream_roundtrip(self, rng):
+        n, chunk = 10, 4
+        server = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id=701 port=0 ! "
+            f"tensor_generator custom={CUSTOM} max-new={n} chunk={chunk} ! "
+            f"tensor_query_serversink id=701"
+        )
+        server.start()
+        port = server["ssrc"].props["port"]
+        try:
+            client = parse_pipeline(
+                f"appsrc name=src ! tensor_query_client port={port} "
+                "stream=true timeout=120 ! tensor_sink name=out"
+            )
+            client.start()
+            prompt = rng.integers(0, PROPS["vocab"], (1, 6)).astype(np.int32)
+            client["src"].push(prompt)
+            client["src"].end_of_stream()
+            client.wait(timeout=180)
+            frames = client["out"].frames
+            client.stop()
+            assert len(frames) == -(-n // chunk)
+            assert [f.meta["chunk_index"] for f in frames] == list(
+                range(len(frames))
+            )
+            assert frames[-1].meta["final"] is True
+            toks = np.concatenate(
+                [np.asarray(f.tensors[0]) for f in frames], axis=1
+            )
+            np.testing.assert_array_equal(toks, _oneshot(prompt, n))
+        finally:
+            server.stop()
+
+    def test_stream_with_plain_filter_server(self, rng):
+        """A non-streaming server graph under stream=true: exactly one
+        answer per request (absent final meta closes the stream)."""
+        from nnstreamer_tpu.backends.jax_xla import (
+            register_jax_model, unregister_jax_model)
+
+        register_jax_model("qstream_aff", lambda p, xs: [xs[0] * 2.0], None)
+        try:
+            server = parse_pipeline(
+                "tensor_query_serversrc name=ssrc id=702 port=0 ! "
+                "tensor_filter framework=jax-xla model=qstream_aff ! "
+                "tensor_query_serversink id=702"
+            )
+            server.start()
+            port = server["ssrc"].props["port"]
+            try:
+                client = parse_pipeline(
+                    f"appsrc name=src ! tensor_query_client port={port} "
+                    "stream=true ! tensor_sink name=out"
+                )
+                client.start()
+                for i in range(4):
+                    client["src"].push(np.float32([i]))
+                client["src"].end_of_stream()
+                client.wait(timeout=60)
+                frames = client["out"].frames
+                client.stop()
+                vals = [float(f.tensors[0][0]) for f in frames]
+                assert vals == [0.0, 2.0, 4.0, 6.0]
+            finally:
+                server.stop()
+        finally:
+            unregister_jax_model("qstream_aff")
+
+    def test_stream_rejects_bad_config(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_query_client port=1 stream=true "
+            "wire-batch=4 ! tensor_sink name=out"
+        )
+        with pytest.raises(Exception, match="wire-batch"):
+            pipe.start()
+        pipe.stop()
