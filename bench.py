@@ -31,6 +31,8 @@ Env knobs:
   BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT  backend probe retry knobs
 """
 
+import contextlib
+import fcntl
 import json
 import os
 import re
@@ -43,12 +45,217 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 _T0 = time.time()  # child-process start; deadline windows anchor here
 NORTH_STAR_FPS = 1000.0  # BASELINE.json north star, MobileNet headline row
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+EVIDENCE_PATH = os.path.join(_HERE, "BENCH_EVIDENCE.json")
+ROWS_PATH = os.path.join(_HERE, "BENCH_ROWS.json")
+
+# the config axes that make two rows comparable; a banked row may only
+# stand in for a live one when every axis matches
+_SIG_KEYS = (
+    "metric", "model", "batch", "dtype", "quantize", "dispatch_depth",
+    "ingest", "sink_split", "input", "platform",
+)
+# rows captured before an axis existed carry its then-implicit value
+_SIG_DEFAULTS = {"ingest": "frame", "sink_split": True}
+
+
+def _sig(row: dict, exclude: tuple = ()) -> str:
+    return "|".join(
+        f"{k}={row.get(k, _SIG_DEFAULTS.get(k))}"
+        for k in _SIG_KEYS if k not in exclude
+    )
+
+
+def _bankable(row: dict) -> bool:
+    """One predicate for both sides of the evidence cache: what bank_row
+    stores is exactly what lookup_banked may return."""
+    return (
+        isinstance(row, dict) and row.get("value") is not None
+        and not row.get("stale") and row.get("platform") != "cpu"
+    )
+
+
+def _utc_iso(ts: float = None) -> str:
+    return time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() if ts is None else ts)
+    )
+
+
+@contextlib.contextmanager
+def _cache_lock(path: str):
+    """Serialize read-modify-replace on the evidence cache: overlapping
+    bench processes (manual run during a sweep) must not erase each
+    other's banked rows.  Best-effort — lock failure degrades to the
+    unsynchronized behavior rather than blocking the bench."""
+    lock_path = path + ".lock"
+    f = None
+    try:
+        f = open(lock_path, "w")
+        fcntl.flock(f, fcntl.LOCK_EX)
+    except OSError:
+        pass
+    try:
+        yield
+    finally:
+        if f is not None:
+            try:
+                fcntl.flock(f, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            f.close()
+
+
+def bank_row(row: dict, path: str = None) -> None:
+    """Persist a successful chip row into the evidence cache.
+
+    The dev tunnel to the chip wedges for hours-to-days (round-2/round-4
+    post-mortems): a probe window that happens to land during an outage
+    must not erase evidence captured hours earlier in the same round
+    (BENCH_r04.json was `value: null` while BENCH_ROWS.json held a 1.82x
+    headline).  Every non-null, non-stale, non-CPU row is banked keyed by
+    its config signature; `main` falls back to it when the live probe
+    fails."""
+    if not _bankable(row):
+        return
+    path = path or EVIDENCE_PATH
+    with _cache_lock(path):
+        try:
+            with open(path) as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            cache = {}
+        if not isinstance(cache, dict):
+            cache = {}
+        cache[_sig(row)] = {"captured_at": _utc_iso(), "row": row}
+        _write_cache(cache, path)
+
+
+def _write_cache(cache: dict, path: str) -> None:
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        # banking is best-effort: a full disk / read-only checkout must
+        # not crash a run that just SUCCEEDED before its row is emitted
+        sys.stderr.write(f"[bench] evidence bank failed: {e}\n")
+
+
+def lookup_banked(meta: dict, metric: str, path: str = None,
+                  rows_path: str = None) -> tuple:
+    """(row, captured_at, source) for the banked evidence row matching this
+    config, or (None, None, None).  Checks the evidence cache first, then
+    seeds from the sweep artifact (rows banked before the cache existed,
+    stamped with the file's mtime since they carry no timestamp).
+
+    Platform matching is two-pass: exact first, then platform-wildcard
+    over non-cpu rows — when the probe FAILS the caller only has the env
+    label (``JAX_PLATFORMS`` may be unset or "axon,cpu" while rows were
+    banked under the probed name "axon"), and a label mismatch must not
+    erase real chip evidence.  The caller keeps the banked row's own
+    platform field, so evidence is never relabeled across platforms."""
+    want_meta = {**meta, "metric": metric}
+    want = _sig(want_meta)
+    want_wild = _sig(want_meta, exclude=("platform",))
+
+    def _match(candidates):
+        # candidates: iterable of (row, captured_at, source)
+        for exact in (True, False):
+            for row, since, source in candidates:
+                if not _bankable(row):
+                    continue
+                if exact and _sig(row) == want:
+                    return row, since, source
+                if not exact and _sig(row, exclude=("platform",)) == want_wild:
+                    return row, since, source
+        return None, None, None
+
+    cands = []
+    try:
+        with open(path or EVIDENCE_PATH) as f:
+            cache = json.load(f)
+        if isinstance(cache, dict):
+            cands = [
+                (ent.get("row", {}), ent.get("captured_at", "unknown"),
+                 "BENCH_EVIDENCE.json")
+                for ent in cache.values() if isinstance(ent, dict)
+            ]
+    except (OSError, ValueError):
+        pass
+    hit = _match(cands)
+    if hit[0] is not None:
+        return hit
+    rows_path = rows_path or ROWS_PATH
+    try:
+        with open(rows_path) as f:
+            rows = json.load(f)
+        if isinstance(rows, list):
+            mtime = _utc_iso(os.path.getmtime(rows_path))
+            src = os.path.basename(rows_path)
+            # promote EVERY bankable seed row into the cache now: sweep
+            # re-runs overwrite the rows file (bench_all checkpoints from
+            # row 1), so pre-cache evidence read once must survive in
+            # BENCH_EVIDENCE.json for every config, not just this one
+            promote = {
+                _sig(row): {"captured_at": mtime, "row": row}
+                for row in rows if _bankable(row)
+            }
+            if promote:
+                ev_path = path or EVIDENCE_PATH
+                with _cache_lock(ev_path):
+                    try:
+                        with open(ev_path) as f:
+                            existing = json.load(f)
+                    except (OSError, ValueError):
+                        existing = {}
+                    if not isinstance(existing, dict):
+                        existing = {}
+                    # existing (possibly newer) entries win over seeds
+                    merged = {**promote, **existing}
+                    if merged != existing:
+                        _write_cache(merged, ev_path)
+            return _match([(row, mtime, src) for row in rows])
+    except (OSError, ValueError):
+        pass
+    return None, None, None
+
 
 def emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
-def probe_backend(tries: int, timeout_s: float) -> str:
+def emit_failure(metric: str, unit: str, meta: dict, err: str) -> None:
+    """Emit the failure row — but never a bare null when banked evidence
+    for the exact same configuration exists on disk.  The stale row keeps
+    the banked value/latency fields and adds `stale`/`stale_since`/
+    `stale_source`/`live_error` so the driver artifact records both the
+    evidence and the fact that this window's live attempt failed.
+    BENCH_NO_STALE=1 restores the bare-null behavior (debug)."""
+    no_stale = os.environ.get("BENCH_NO_STALE", "").lower() in (
+        "1", "true", "yes",
+    )
+    # mirror bank_row's cpu exclusion on the LOOKUP side too: a failed
+    # forced-cpu run must never be answered with banked chip evidence
+    # relabeled platform=cpu
+    if not no_stale and meta.get("platform") != "cpu":
+        row, since, source = lookup_banked(meta, metric)
+        if row is not None:
+            # banked row wins key-for-key (notably platform: evidence is
+            # never relabeled to this window's env string); meta only
+            # fills fields the banked row lacks
+            emit({
+                **meta, **row, "stale": True, "stale_since": since,
+                "stale_source": source, "live_error": err,
+            })
+            return
+    emit({
+        "metric": metric, "value": None, "unit": unit,
+        "vs_baseline": None, "error": err, **meta,
+    })
+
+
+def probe_backend(tries: int, timeout_s: float) -> tuple:
     """Verify the accelerator backend actually initializes and can run an
     op, from a THROWAWAY subprocess with a hard timeout.
 
@@ -58,7 +265,10 @@ def probe_backend(tries: int, timeout_s: float) -> str:
     the bench can retry with backoff and fail SOFT with a diagnosable JSON
     line instead of rc=1/rc=124 and a stack trace (BENCH_r01.json).
 
-    Returns "" on success, else a short error description.
+    Returns ("", platform) on success — platform is the ACTUAL probed
+    device platform (e.g. "axon"), not the env label, so a silent
+    jax fallback to CPU can never be measured-and-banked as chip
+    evidence — else (short error description, "").
     """
     probe_src = (
         "import jax, jax.numpy as jnp;"
@@ -76,7 +286,10 @@ def probe_backend(tries: int, timeout_s: float) -> str:
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if r.returncode == 0 and "PROBE_OK" in r.stdout:
-                return ""
+                toks = r.stdout.split()
+                i = toks.index("PROBE_OK") if "PROBE_OK" in toks else -1
+                plat = toks[i + 1] if 0 <= i < len(toks) - 1 else ""
+                return "", plat
             tail = (r.stderr or r.stdout).strip().splitlines()
             last_err = (
                 f"probe rc={r.returncode}: {tail[-1] if tail else 'no output'}"
@@ -89,7 +302,7 @@ def probe_backend(tries: int, timeout_s: float) -> str:
         )
         if attempt < tries:
             time.sleep(min(10.0 * attempt, 30.0))
-    return last_err
+    return last_err, ""
 
 
 def quant_applied(which: str) -> bool:
@@ -233,7 +446,13 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         # measured the default configuration
         from nnstreamer_tpu.core import registry as _registry
 
-        mode = re.search(r"mode=([a-z_0-9]+)", decoder).group(1)
+        m = re.search(r"mode=([a-z_0-9]+)", decoder)
+        if m is None:
+            raise SystemExit(
+                "BENCH_SINK_SPLIT=0: whole-block delivery needs a "
+                f"tensor_decoder with a mode= (got {decoder!r})"
+            )
+        mode = m.group(1)
         dec_cls = _registry.get(_registry.KIND_DECODER, mode)
         if not hasattr(dec_cls, "decode_fused_batch"):
             raise SystemExit(
@@ -516,17 +735,20 @@ def main() -> None:
         # worst case ~4.5 min (2 x 120s + backoff): the fail-soft JSON row
         # must land well inside the driver's own kill window — a healthy
         # tunnel probes in 10-30s, so 120s also covers "slow but alive"
-        err = probe_backend(
+        err, probed_platform = probe_backend(
             tries=int(os.environ.get("BENCH_PROBE_TRIES", "2")),
             timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
         )
         if err:
-            emit({
-                "metric": metric, "value": None,
-                "unit": unit, "vs_baseline": None,
-                "error": f"accelerator backend unavailable: {err}", **meta,
-            })
+            emit_failure(
+                metric, unit, meta,
+                f"accelerator backend unavailable: {err}",
+            )
             return
+        if probed_platform:
+            # the label the row (and its evidence-cache entry) carries is
+            # what the probe SAW, not what the env claimed
+            meta["platform"] = probed_platform
 
     deadline = float(os.environ.get("BENCH_DEADLINE", "420"))
     tries = int(os.environ.get("BENCH_TRIES", "2"))
@@ -534,15 +756,14 @@ def main() -> None:
     for attempt in range(1, tries + 1):
         row, err = run_child(deadline)
         if row is not None:
-            emit({**row, **meta})
+            merged = {**row, **meta}
+            bank_row(merged)
+            emit(merged)
             return
         sys.stderr.write(
             f"[bench] attempt {attempt}/{tries} failed: {err}\n"
         )
-    emit({
-        "metric": metric, "value": None, "unit": unit,
-        "vs_baseline": None, "error": err, **meta,
-    })
+    emit_failure(metric, unit, meta, err)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import queue
+from collections import deque
 import threading
 import time
 from concurrent import futures
@@ -71,6 +72,9 @@ class QueryServerCore:
         self.ingress: "queue.Queue[Tuple[int, TensorFrame]]" = queue.Queue(64)
         self._pending: Dict[int, "queue.Queue[TensorFrame]"] = {}
         self._pending_lock = threading.Lock()
+        # client ids whose stream closed via the absent-'final'-key
+        # heuristic (bounded; diagnosis only — see resolve())
+        self._heuristic_closed: "deque[int]" = deque(maxlen=64)
         self._client_seq = itertools.count(1)
         self.caps: Optional[str] = None  # serversrc announces
         self._server: Optional[grpc.Server] = None
@@ -210,16 +214,36 @@ class QueryServerCore:
                     )
                 yield encode_frame(ans)
                 # a non-streaming graph emits exactly one answer with no
-                # "final" key -> treat absent as final
+                # "final" key -> treat absent as final.  A multi-answer
+                # graph MUST stamp meta["final"] (False on intermediate
+                # chunks) or its stream truncates here — resolve() flags
+                # the dropped answers with the cause.
                 if ans.meta.get("final", True):
+                    if "final" not in ans.meta:
+                        cid = ans.meta.get("client_id")
+                        if cid is not None:
+                            with self._pending_lock:
+                                self._heuristic_closed.append(cid)
                     return
 
     def resolve(self, client_id: int, frame: TensorFrame) -> bool:
         """serversink delivers an answer to the waiting client RPC."""
         with self._pending_lock:
             q = self._pending.get(client_id)
+            heuristic = q is None and client_id in self._heuristic_closed
         if q is None:
-            log.warning("no pending client %s (answer dropped)", client_id)
+            if heuristic:
+                log.warning(
+                    "no pending client %s (answer dropped): its stream was "
+                    "closed because an earlier answer carried no 'final' "
+                    "meta key — multi-answer server graphs must stamp "
+                    "meta['final']=False on intermediate answers",
+                    client_id,
+                )
+            else:
+                log.warning(
+                    "no pending client %s (answer dropped)", client_id
+                )
             return False
         q.put(frame)
         return True

@@ -49,7 +49,9 @@ class TensorConverter(Element):
             bool, False,
             "with frames-per-tensor > 1: emit a transparent BatchFrame of N "
             "logical frames (per-frame schema/pts preserved; batch-capable "
-            "elements consume the batch axis, sinks/decoders split) instead "
+            "elements consume the batch axis, sinks/decoders split; at EOS "
+            "a partial trailing block may be SMALLER than N — batch-"
+            "bucketed consumers compile one tail bucket) instead "
             "of one shape-changed stacked tensor",
         ),
         "input-dim": Property(str, "", "octet mode: target dims (reference dialect)"),

@@ -114,7 +114,12 @@ def _flash_bh(qf, kf, vf, causal: bool, block_q: int, block_k: int,
     partial-key calls) — causal requires Tq == Tk (aligned positions)."""
     BH, Tq, D = qf.shape
     Tk = kf.shape[1]
-    assert not causal or Tq == Tk, "causal flash needs aligned q/k positions"
+    if causal and Tq != Tk:
+        # ValueError, not assert: survives python -O — a misaligned direct
+        # caller must fail loud, never silently mis-mask
+        raise ValueError(
+            f"causal flash needs aligned q/k positions (Tq={Tq}, Tk={Tk})"
+        )
     scale = 1.0 / (D**0.5)
     kern = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, scale=scale,

@@ -587,6 +587,18 @@ class Pipeline:
                                     stash.append((p2, nxt))
                             if boundary:
                                 break
+                        if not el.BATCH_AWARE:
+                            # same safety net as the per-frame branch: the
+                            # block opt-in is BATCH_AWARE, not the mere
+                            # presence of handle_frame_batch — a future
+                            # batch-capable element that hasn't opted in
+                            # still gets logical frames only
+                            frames = [
+                                lf for f in frames for lf in (
+                                    f.split() if isinstance(f, BatchFrame)
+                                    else (f,)
+                                )
+                            ]
                         t_in = (
                             time.perf_counter() if tracer is not None else 0.0
                         )

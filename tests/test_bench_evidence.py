@@ -1,0 +1,230 @@
+"""Bench evidence cache: a wedged-tunnel probe window must never turn a
+round with banked chip evidence into a bare `value: null` driver artifact
+(round-4 post-mortem: BENCH_r04.json was null while BENCH_ROWS.json held
+the 1.82x headline captured hours earlier in the same round).
+
+Covers bank_row/lookup_banked/emit_failure directly (no device needed).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+_spec = importlib.util.spec_from_file_location("bench_module", _BENCH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+HEADLINE_META = {
+    "model": "mobilenet", "batch": 128, "dtype": "bfloat16",
+    "quantize": None, "dispatch_depth": 4, "ingest": "frame",
+    "sink_split": True, "input": "device", "platform": "axon",
+}
+METRIC = "mobilenet_v2_image_labeling_fps_per_chip"
+
+
+def _row(**over):
+    row = {
+        "metric": METRIC, "value": 1821.1, "unit": "fps",
+        "vs_baseline": 1.821, **HEADLINE_META,
+    }
+    row.update(over)
+    return row
+
+
+@pytest.fixture
+def cache_paths(tmp_path, monkeypatch):
+    ev = str(tmp_path / "EVIDENCE.json")
+    rows = str(tmp_path / "ROWS.json")
+    monkeypatch.setattr(bench, "EVIDENCE_PATH", ev)
+    monkeypatch.setattr(bench, "ROWS_PATH", rows)
+    return ev, rows
+
+
+class TestBankAndLookup:
+    def test_roundtrip(self, cache_paths):
+        ev, _ = cache_paths
+        bench.bank_row(_row())
+        got, since, source = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+        assert source == "BENCH_EVIDENCE.json"
+        assert since  # ISO timestamp recorded at bank time
+
+    def test_null_and_cpu_and_stale_rows_not_banked(self, cache_paths):
+        bench.bank_row(_row(value=None))
+        bench.bank_row(_row(platform="cpu"))
+        bench.bank_row(_row(stale=True))
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got is None
+
+    def test_config_mismatch_never_matches(self, cache_paths):
+        bench.bank_row(_row())
+        for key, val in [
+            ("batch", 256), ("quantize", "int8"), ("ingest", "block"),
+            ("dispatch_depth", 1), ("input", "host"), ("dtype", "float32"),
+        ]:
+            got, _, _ = bench.lookup_banked(
+                {**HEADLINE_META, key: val}, METRIC
+            )
+            assert got is None, f"{key}={val} wrongly matched banked row"
+
+    def test_newest_wins_on_rebank(self, cache_paths):
+        bench.bank_row(_row(value=1500.0))
+        bench.bank_row(_row(value=1821.1))
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+
+    def test_seeds_from_sweep_rows_file(self, cache_paths):
+        # rows captured before the cache existed (BENCH_ROWS.json) lack
+        # ingest/sink_split keys: defaults must apply (frame / split)
+        _, rows = cache_paths
+        legacy = _row()
+        del legacy["ingest"], legacy["sink_split"]
+        with open(rows, "w") as f:
+            json.dump([_row(value=None), legacy], f)
+        got, since, source = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+        assert source == "ROWS.json"
+        assert since  # file mtime stamped
+
+    def test_seed_rows_promoted_before_rows_file_overwritten(
+        self, cache_paths
+    ):
+        # bench_all re-checkpoints the rows file from row 1: evidence for
+        # OTHER configs read once during an outage must survive in the
+        # cache even after the rows file is gutted
+        ev, rows = cache_paths
+        other = _row(
+            metric="ssd_mobilenet_v2_bbox_fps_per_chip", model="ssd",
+            value=900.0,
+        )
+        with open(rows, "w") as f:
+            json.dump([_row(), other], f)
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+        with open(rows, "w") as f:  # sweep overwrites the rows file
+            json.dump([], f)
+        got, _, src = bench.lookup_banked(
+            {**HEADLINE_META, "model": "ssd"},
+            "ssd_mobilenet_v2_bbox_fps_per_chip",
+        )
+        assert got["value"] == 900.0
+        assert src == "BENCH_EVIDENCE.json"
+
+    def test_seed_promotion_never_overwrites_newer_cache_entry(
+        self, cache_paths
+    ):
+        ev, rows = cache_paths
+        bench.bank_row(_row(value=2000.0))  # fresher than the seed
+        with open(rows, "w") as f:
+            json.dump([_row(value=1500.0)], f)
+        # force the rows-file pass with a miss on another config first
+        bench.lookup_banked({**HEADLINE_META, "batch": 999}, METRIC)
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 2000.0
+
+    @pytest.mark.parametrize(
+        "payload", ["{not json", "[]", '{"k": "notadict"}', "null"]
+    )
+    def test_corrupt_cache_files_fail_soft(self, cache_paths, payload):
+        # invalid JSON AND valid-but-wrong-shape JSON (list/str/null):
+        # neither side of the cache may crash on either
+        ev, rows = cache_paths
+        for p in (ev, rows):
+            with open(p, "w") as f:
+                f.write(payload)
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got is None
+        bench.bank_row(_row())  # overwrites the corrupt cache
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+
+
+class TestEmitFailure:
+    def _capture(self, capsys, meta, err):
+        bench.emit_failure(METRIC, "fps", meta, err)
+        return json.loads(capsys.readouterr().out.strip())
+
+    def test_stale_fallback_keeps_value_and_marks_it(
+        self, cache_paths, capsys
+    ):
+        bench.bank_row(_row())
+        out = self._capture(capsys, HEADLINE_META, "probe timed out")
+        assert out["value"] == 1821.1
+        assert out["stale"] is True
+        assert out["live_error"] == "probe timed out"
+        assert out["stale_source"] == "BENCH_EVIDENCE.json"
+        assert out["stale_since"]
+
+    def test_no_evidence_emits_null_row(self, cache_paths, capsys):
+        out = self._capture(capsys, HEADLINE_META, "probe timed out")
+        assert out["value"] is None
+        assert out["error"] == "probe timed out"
+
+    def test_platform_label_mismatch_still_finds_chip_evidence(
+        self, cache_paths, capsys
+    ):
+        # probe-failure windows only know the env label (unset -> "default",
+        # or "axon,cpu"): banked axon evidence must still match, and the
+        # emitted row must KEEP the banked platform, not the env label
+        bench.bank_row(_row(platform="axon"))
+        for env_label in ("default", "axon,cpu"):
+            out = self._capture(
+                capsys, {**HEADLINE_META, "platform": env_label}, "wedged"
+            )
+            assert out["value"] == 1821.1, env_label
+            assert out["platform"] == "axon", env_label
+
+    def test_cpu_platform_never_gets_chip_evidence(
+        self, cache_paths, capsys
+    ):
+        # a failed BENCH_PLATFORM=cpu run must not emit the banked axon
+        # row relabeled platform=cpu (fabricated CPU performance)
+        bench.bank_row(_row())
+        out = self._capture(
+            capsys, {**HEADLINE_META, "platform": "cpu"}, "deadline"
+        )
+        assert out["value"] is None
+
+    def test_bench_no_stale_opt_out(self, cache_paths, capsys, monkeypatch):
+        bench.bank_row(_row())
+        monkeypatch.setenv("BENCH_NO_STALE", "1")
+        out = self._capture(capsys, HEADLINE_META, "probe timed out")
+        assert out["value"] is None
+
+    def test_stale_row_never_rebanked_as_fresh(self, cache_paths, capsys):
+        # an emitted stale row fed back through bank_row (as a future main
+        # might) must not refresh the evidence timestamp
+        bench.bank_row(_row())
+        out = self._capture(capsys, HEADLINE_META, "err")
+        ev = cache_paths[0]
+        before = json.load(open(ev))
+        bench.bank_row(out)
+        assert json.load(open(ev)) == before
+
+
+class TestMainIntegration:
+    def test_probe_failure_emits_stale_headline(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """main() end-to-end: probe fails -> stale banked row, not null."""
+        bench.bank_row(_row())
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: ("down", "")
+        )
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        for k in (
+            "BENCH_MODEL", "BENCH_BATCH", "BENCH_DTYPE", "BENCH_QUANT",
+            "BENCH_DEPTH", "BENCH_INGEST", "BENCH_SINK_SPLIT", "BENCH_HOST",
+            "BENCH_PLATFORM", "BENCH_NO_STALE",
+        ):
+            monkeypatch.delenv(k, raising=False)
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] == 1821.1
+        assert out["stale"] is True
+        assert "down" in out["live_error"]

@@ -89,8 +89,11 @@ def main() -> int:
         dropped = 0
         for row in prior:
             sig = row.get("_sig")
-            if (row.get("value") is not None and sig in valid_sigs
-                    and sig not in done_sigs):
+            if (row.get("value") is not None and not row.get("stale")
+                    and sig in valid_sigs and sig not in done_sigs):
+                # stale rows (bench.py evidence-cache fallback) are
+                # banked evidence, not this sweep's measurement — always
+                # re-measure them when the tunnel answers
                 results.append(row)
                 done_sigs.append(sig)
             else:
@@ -145,7 +148,9 @@ def main() -> int:
         results.append(row)
         # incremental atomic write: a kill mid-sweep keeps completed rows
         _write_rows(out_path, results)
-        if "unavailable" in str(row.get("error", "")) and not os.environ.get(
+        # a stale-fallback row reports its live failure under live_error
+        live_fail = str(row.get("error", "")) + str(row.get("live_error", ""))
+        if "unavailable" in live_fail and not os.environ.get(
             "BENCH_ALL_KEEP_GOING"
         ):
             # tunnel down: every later row would burn its probe budget on
