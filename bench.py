@@ -763,6 +763,29 @@ def main() -> None:
         sys.stderr.write(
             f"[bench] attempt {attempt}/{tries} failed: {err}\n"
         )
+    # discriminate WHY the child failed before reaching for banked
+    # evidence: a tunnel that wedged mid-run (probe now fails too — the
+    # r4 host-row scenario) justifies the stale fallback; a backend that
+    # still answers means the bench itself regressed, and masking a code
+    # bug with yesterday's headline would be fabrication.
+    if not force_cpu:
+        recheck_err, _ = probe_backend(
+            tries=1,
+            timeout_s=min(
+                60.0,
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
+            ),
+        )
+        if not recheck_err:
+            # backend still answers -> the bench itself regressed
+            emit({
+                "metric": metric, "value": None, "unit": unit,
+                "vs_baseline": None,
+                "error": f"{err} (backend healthy: not a tunnel outage)",
+                **meta,
+            })
+            return
+        err = f"{err}; re-probe: {recheck_err}"
     emit_failure(metric, unit, meta, err)
 
 

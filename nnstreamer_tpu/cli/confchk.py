@@ -45,6 +45,15 @@ def report() -> str:
         names = sorted(registry.get_all(kind))
         lines.append("")
         lines.append(f"{kind} subplugins ({len(names)}):")
+        if kind == registry.KIND_CUSTOM and not names:
+            # the custom kind holds RUNTIME registrations (tensor_if
+            # custom conditions via register_if_condition, ≙ the
+            # reference's nnstreamer_if_custom_register) — empty at
+            # import time by design, not a missing subplugin class
+            lines.append(
+                "  (runtime-registered tensor_if conditions; none "
+                "registered in this process)"
+            )
         for n in names:
             desc = registry.get_custom_property_desc(kind, n)
             if desc:  # Dict[str, str] -> readable "key: help" list

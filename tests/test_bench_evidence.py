@@ -208,6 +208,48 @@ class TestEmitFailure:
 
 
 class TestMainIntegration:
+    def test_bench_regression_on_healthy_backend_stays_null(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """Probe passes but every run_child attempt fails: the backend is
+        healthy, so this is a bench/code regression — masking it with
+        yesterday's banked headline would be fabrication."""
+        bench.bank_row(_row())
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: ("", "axon")
+        )
+        monkeypatch.setattr(
+            bench, "run_child", lambda *a, **k: (None, "child crashed")
+        )
+        for k in ("BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE"):
+            monkeypatch.delenv(k, raising=False)
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None
+        assert "backend healthy" in out["error"]
+
+    def test_midrun_wedge_falls_back_to_banked_row(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """Probe passes, run fails, re-probe fails (tunnel wedged
+        MID-RUN, the r4 host-row scenario): stale fallback applies."""
+        bench.bank_row(_row())
+        probes = iter([("", "axon"), ("wedged", "")])
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: next(probes)
+        )
+        monkeypatch.setattr(
+            bench, "run_child",
+            lambda *a, **k: (None, "run exceeded deadline"),
+        )
+        for k in ("BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE"):
+            monkeypatch.delenv(k, raising=False)
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] == 1821.1
+        assert out["stale"] is True
+        assert "re-probe" in out["live_error"]
+
     def test_probe_failure_emits_stale_headline(
         self, cache_paths, monkeypatch, capsys
     ):
