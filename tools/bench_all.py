@@ -148,11 +148,13 @@ def main() -> int:
         results.append(row)
         # incremental atomic write: a kill mid-sweep keeps completed rows
         _write_rows(out_path, results)
-        # a stale-fallback row reports its live failure under live_error
+        # a stale-fallback row reports its live failure under live_error;
+        # "re-probe:" marks a mid-run wedge (initial probe passed, the
+        # post-failure probe did not) — same dead tunnel, same abort
         live_fail = str(row.get("error", "")) + str(row.get("live_error", ""))
-        if "unavailable" in live_fail and not os.environ.get(
-            "BENCH_ALL_KEEP_GOING"
-        ):
+        if (
+            "unavailable" in live_fail or "re-probe:" in live_fail
+        ) and not os.environ.get("BENCH_ALL_KEEP_GOING"):
             # tunnel down: every later row would burn its probe budget on
             # the same outage — fail the sweep fast and diagnosable
             print("[bench_all] backend unavailable; aborting remaining "
