@@ -22,6 +22,8 @@ Env knobs:
                   batch (adds raw_fps / pipeline_vs_raw to the row — the
                   framework-overhead contract: pipeline >= 0.9x raw)
   BENCH_DEPTH     micro-batches kept in flight by the filter (default 4)
+  BENCH_BATCH_TIMEOUT  ms a partial micro-batch waits for fill (default
+                  20; latency-optimized rows use 2)
   BENCH_INGEST    block = frames enter pre-batched (one BatchFrame per
                   micro-batch, ≙ converter frames-per-tensor); default
                   per-frame pushes
@@ -53,10 +55,11 @@ ROWS_PATH = os.path.join(_HERE, "BENCH_ROWS.json")
 # stand in for a live one when every axis matches
 _SIG_KEYS = (
     "metric", "model", "batch", "dtype", "quantize", "dispatch_depth",
-    "ingest", "sink_split", "input", "platform",
+    "ingest", "sink_split", "input", "platform", "batch_timeout_ms",
 )
 # rows captured before an axis existed carry its then-implicit value
-_SIG_DEFAULTS = {"ingest": "frame", "sink_split": True}
+_SIG_DEFAULTS = {"ingest": "frame", "sink_split": True,
+                 "batch_timeout_ms": 20}
 
 
 def _sig(row: dict, exclude: tuple = ()) -> str:
@@ -64,6 +67,29 @@ def _sig(row: dict, exclude: tuple = ()) -> str:
         f"{k}={row.get(k, _SIG_DEFAULTS.get(k))}"
         for k in _SIG_KEYS if k not in exclude
     )
+
+
+# the RUN default for bench (distinct from _SIG_DEFAULTS, which records
+# the historical implicit value of already-banked rows and must stay 20)
+BATCH_TIMEOUT_DEFAULT_MS = "20"
+
+
+def _normalize_cache(cache: dict) -> dict:
+    """Rekey every entry by its row's RECOMPUTED signature (the key may
+    predate a signature-axis addition) and dedupe collisions keeping the
+    newest ``captured_at`` — latest-evidence-wins must survive schema
+    evolution, not just same-key overwrites."""
+    out: dict = {}
+    for ent in cache.values():
+        if not isinstance(ent, dict) or not isinstance(ent.get("row"), dict):
+            continue
+        key = _sig(ent["row"])
+        old = out.get(key)
+        if old is None or str(ent.get("captured_at", "")) >= str(
+            old.get("captured_at", "")
+        ):
+            out[key] = ent
+    return out
 
 
 def _bankable(row: dict) -> bool:
@@ -126,6 +152,7 @@ def bank_row(row: dict, path: str = None) -> None:
             cache = {}
         if not isinstance(cache, dict):
             cache = {}
+        cache = _normalize_cache(cache)
         cache[_sig(row)] = {"captured_at": _utc_iso(), "row": row}
         _write_cache(cache, path)
 
@@ -179,7 +206,7 @@ def lookup_banked(meta: dict, metric: str, path: str = None,
             cands = [
                 (ent.get("row", {}), ent.get("captured_at", "unknown"),
                  "BENCH_EVIDENCE.json")
-                for ent in cache.values() if isinstance(ent, dict)
+                for ent in _normalize_cache(cache).values()
             ]
     except (OSError, ValueError):
         pass
@@ -462,10 +489,18 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         decoder = decoder.replace(
             "tensor_decoder ", "tensor_decoder split-batches=false ", 1
         )
+    # batch-timeout: how long a partial micro-batch waits for fill.  20 ms
+    # suits throughput configs (the e2e latency instrument below pushes
+    # LONE frames, which would eat the whole wait); the latency-optimized
+    # row overrides it down so p50 measures serving, not the fill timer.
+    batch_timeout_ms = os.environ.get(
+        "BENCH_BATCH_TIMEOUT", BATCH_TIMEOUT_DEFAULT_MS
+    )
     pipe = parse_pipeline(
         "appsrc name=src max-buffers=512 ! "
         "tensor_filter name=f framework=jax-xla model=bench_model "
-        f"max-batch={batch} batch-timeout=20 latency=1 throughput=1 "
+        f"max-batch={batch} batch-timeout={batch_timeout_ms} "
+        "latency=1 throughput=1 "
         f"dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} ! "
         + decoder
         + "tensor_sink name=out max-stored=1"
@@ -725,6 +760,9 @@ def main() -> None:
         "sink_split": os.environ.get("BENCH_SINK_SPLIT", "1") not in (
             "0", "false"
         ),
+        "batch_timeout_ms": int(os.environ.get(
+            "BENCH_BATCH_TIMEOUT", BATCH_TIMEOUT_DEFAULT_MS
+        )),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"

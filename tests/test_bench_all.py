@@ -1,0 +1,188 @@
+"""Sweep-runner logic tests (tools/bench_all.py).
+
+The sweep is the critical action of a rare tunnel-up window: resume must
+keep real rows, re-measure stale/unknown ones, abort fast on both outage
+signatures, and never corrupt the artifact.  bench.py itself is faked —
+these tests exercise the RUNNER, not the measurement.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BA = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "tools", "bench_all.py"
+)
+_spec = importlib.util.spec_from_file_location("bench_all_module", _BA)
+ba = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ba)
+
+
+class _FakeRun:
+    """Stands in for subprocess.run(bench.py): returns queued JSON rows."""
+
+    def __init__(self, rows):
+        self.rows = list(rows)
+        self.calls = []
+
+    def __call__(self, argv, capture_output, text, env):
+        self.calls.append(dict(env))
+        row = self.rows.pop(0) if self.rows else {"value": None,
+                                                  "error": "exhausted"}
+
+        class R:
+            returncode = 0
+            stdout = json.dumps(row) + "\n"
+            stderr = ""
+
+        return R()
+
+
+@pytest.fixture
+def runner(tmp_path, monkeypatch):
+    out = str(tmp_path / "ROWS.json")
+    monkeypatch.setattr(sys, "argv", ["bench_all.py", out])
+    monkeypatch.chdir(tmp_path)
+    # ambient shell knobs (e.g. left over from a manual sweep) must not
+    # flip test outcomes
+    for k in ("BENCH_ALL_RESUME", "BENCH_ALL_KEEP_GOING",
+              "BENCH_PROBE_TRIES", "BENCH_PROBE_TIMEOUT"):
+        monkeypatch.delenv(k, raising=False)
+
+    def run(rows, env=None):
+        fake = _FakeRun(rows)
+        monkeypatch.setattr(ba.subprocess, "run", fake)
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        rc = ba.main()
+        with open(out) as f:
+            return rc, json.load(f), fake
+
+    return run, out
+
+
+GOOD = {"metric": "m", "value": 100.0, "unit": "fps", "vs_baseline": None}
+
+
+def test_all_rows_executed_and_written(runner):
+    run, _ = runner
+    rc, rows, fake = run([GOOD] * len(ba.ROWS))
+    assert rc == 0
+    assert len(rows) == len(ba.ROWS)
+    assert all(r["value"] == 100.0 and "_sig" in r for r in rows)
+
+
+def test_abort_on_unavailable(runner):
+    run, _ = runner
+    bad = {"value": None, "error": "accelerator backend unavailable: x"}
+    rc, rows, fake = run([bad] + [GOOD] * 5)
+    assert len(rows) == 1  # aborted after the first outage row
+    assert len(fake.calls) == 1
+
+
+def test_abort_on_midrun_wedge_stale_row(runner):
+    run, _ = runner
+    stale = {
+        "value": 1821.1, "stale": True,
+        "live_error": "run exceeded deadline; re-probe: probe timed out",
+    }
+    rc, rows, fake = run([stale] + [GOOD] * 5)
+    assert len(rows) == 1
+    assert len(fake.calls) == 1
+
+
+def test_keep_going_overrides_abort(runner):
+    run, _ = runner
+    bad = {"value": None, "error": "accelerator backend unavailable: x"}
+    rc, rows, fake = run(
+        [bad] * len(ba.ROWS), env={"BENCH_ALL_KEEP_GOING": "1"}
+    )
+    assert len(rows) == len(ba.ROWS)
+
+
+class TestResume:
+    def _prior(self, out, rows):
+        with open(out, "w") as f:
+            json.dump(rows, f)
+
+    def test_resume_keeps_good_rows_and_measures_rest(self, runner):
+        run, out = runner
+        model0, extra0 = ba.ROWS[0]
+        self._prior(out, [
+            {**GOOD, "value": 555.0, "_sig": ba._row_sig(model0, extra0)},
+        ])
+        rc, rows, fake = run(
+            [GOOD] * (len(ba.ROWS) - 1), env={"BENCH_ALL_RESUME": "1"}
+        )
+        assert len(rows) == len(ba.ROWS)
+        assert rows[0]["value"] == 555.0  # kept, not re-measured
+        assert len(fake.calls) == len(ba.ROWS) - 1
+
+    def test_resume_remeasures_stale_and_null_and_unknown(self, runner):
+        run, out = runner
+        model0, extra0 = ba.ROWS[0]
+        model1, extra1 = ba.ROWS[1]
+        self._prior(out, [
+            {**GOOD, "stale": True, "_sig": ba._row_sig(model0, extra0)},
+            {"value": None, "_sig": ba._row_sig(model1, extra1)},
+            {**GOOD, "_sig": {"model": "retired-config"}},
+            {**GOOD},  # sig-less pre-resume row
+        ])
+        rc, rows, fake = run(
+            [GOOD] * len(ba.ROWS), env={"BENCH_ALL_RESUME": "1"}
+        )
+        assert len(fake.calls) == len(ba.ROWS)  # everything re-measured
+        # originals preserved in .bak before being dropped
+        with open(out + ".bak") as f:
+            assert len(json.load(f)) == 4
+
+    def test_resume_corrupt_prior_starts_fresh(self, runner):
+        run, out = runner
+        with open(out, "w") as f:
+            f.write("{broken")
+        rc, rows, fake = run(
+            [GOOD] * len(ba.ROWS), env={"BENCH_ALL_RESUME": "1"}
+        )
+        assert len(rows) == len(ba.ROWS)
+
+    def test_duplicate_sigs_kept_once(self, runner):
+        run, out = runner
+        model0, extra0 = ba.ROWS[0]
+        sig = ba._row_sig(model0, extra0)
+        self._prior(out, [
+            {**GOOD, "value": 1.0, "_sig": sig},
+            {**GOOD, "value": 2.0, "_sig": sig},
+        ])
+        rc, rows, fake = run(
+            [GOOD] * (len(ba.ROWS) - 1), env={"BENCH_ALL_RESUME": "1"}
+        )
+        kept = [r for r in rows if r.get("_sig") == sig]
+        assert len(kept) == 1 and kept[0]["value"] == 1.0
+
+
+def test_probe_budget_shortened_after_first_executed_row(runner):
+    run, _ = runner
+    rc, rows, fake = run([GOOD] * len(ba.ROWS))
+    assert "BENCH_PROBE_TRIES" not in fake.calls[0] or (
+        fake.calls[0].get("BENCH_PROBE_TRIES") != "1"
+    )
+    assert fake.calls[1]["BENCH_PROBE_TRIES"] == "1"
+    assert fake.calls[1]["BENCH_PROBE_TIMEOUT"] == "60"
+
+
+def test_rows_include_block_int8_latency_and_host_last(runner):
+    # the sweep must carry the VERDICT-demanded configurations, and the
+    # risky host-sourced row must run LAST (tunnel kill hazard)
+    extras = [e for _, e in ba.ROWS]
+    assert {"BENCH_RAW": "1", "BENCH_INGEST": "block"} in extras
+    assert any(e.get("BENCH_QUANT") == "1" for e in extras)
+    assert any(e.get("BENCH_BATCH_TIMEOUT") == "2" for e in extras)
+    assert any(
+        e.get("BENCH_INGEST") == "block" and e.get("BENCH_QUANT") == "1"
+        for e in extras
+    )
+    assert ba.ROWS[-1][1].get("BENCH_HOST") == "1"
+    assert int(ba.ROWS[-1][1].get("BENCH_FRAMES", "4096")) <= 512

@@ -78,6 +78,35 @@ class TestBankAndLookup:
         got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
         assert got["value"] == 1821.1
 
+    def test_legacy_keys_migrate_newest_wins(self, cache_paths):
+        # entries banked before a signature-axis addition sit under OLD
+        # key strings; on the next bank/lookup they are rekeyed by their
+        # recomputed sig and the NEWEST captured_at wins the collision
+        ev, _ = cache_paths
+        legacy = _row(value=1500.0)
+        with open(ev, "w") as f:
+            json.dump({
+                "old|key|string": {
+                    "captured_at": "2026-07-30T00:00:00Z", "row": legacy,
+                },
+            }, f)
+        bench.bank_row(_row(value=1821.1))  # fresher, same config
+        got, since, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+        cache = json.load(open(ev))
+        assert list(cache) == [bench._sig(legacy)]  # one rekeyed entry
+
+    def test_legacy_key_lookup_without_rebank(self, cache_paths):
+        ev, _ = cache_paths
+        with open(ev, "w") as f:
+            json.dump({
+                "old|key|string": {
+                    "captured_at": "2026-07-30T00:00:00Z", "row": _row(),
+                },
+            }, f)
+        got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+
     def test_seeds_from_sweep_rows_file(self, cache_paths):
         # rows captured before the cache existed (BENCH_ROWS.json) lack
         # ingest/sink_split keys: defaults must apply (frame / split)

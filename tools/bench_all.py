@@ -38,6 +38,11 @@ ROWS = [
     # cheapest per-frame device time + fewest per-batch round trips: the
     # most likely >=1000 fps configuration on a compute-rate-throttled link
     ("mobilenet", {"BENCH_QUANT": "1", "BENCH_BATCH": "256"}),
+    # every lever at once: block ingest + whole-block delivery + int8 MXU
+    # + batch 256 — the "don't stop at parity" configuration
+    ("mobilenet", {"BENCH_RAW": "1", "BENCH_INGEST": "block",
+                   "BENCH_SINK_SPLIT": "0", "BENCH_QUANT": "1",
+                   "BENCH_BATCH": "256"}),
     ("ssd", {}),
     ("ssd", {"BENCH_QUANT": "1"}),  # int8 backbone
     ("yolov5", {}),
@@ -48,7 +53,7 @@ ROWS = [
     # latency): small batch, synchronous dispatch — the fps column is NOT
     # the headline, the e2e_latency fields are
     ("mobilenet", {"BENCH_BATCH": "8", "BENCH_DEPTH": "1",
-                   "BENCH_FRAMES": "1024"}),
+                   "BENCH_FRAMES": "1024", "BENCH_BATCH_TIMEOUT": "2"}),
     ("mnist_trainer", {}),
     # LAST on purpose, and sized to finish inside its deadline: over the
     # dev tunnel (~30 MB/s) a full 4096-frame host-sourced run cannot
