@@ -226,11 +226,38 @@ class QueryServerCore:
                                 self._heuristic_closed.append(cid)
                     return
 
-    def resolve(self, client_id: int, frame: TensorFrame) -> bool:
-        """serversink delivers an answer to the waiting client RPC."""
+    def resolve(self, client_id: int, frame: TensorFrame,
+                limit: int = 0) -> bool:
+        """serversink delivers an answer to the waiting client RPC.
+        ``limit`` > 0 bounds queued answers per client (≙ serversink
+        `limit` prop); excess answers are dropped with a warning."""
         with self._pending_lock:
+            # membership check, limit check, AND the put share the lock:
+            # a client timing out concurrently pops its queue in
+            # _pending_client's finally (also under this lock), so an
+            # answer can never land in an abandoned queue and report
+            # success, and concurrent resolvers cannot overshoot `limit`
             q = self._pending.get(client_id)
             heuristic = q is None and client_id in self._heuristic_closed
+            if q is not None:
+                if limit > 0 and q.qsize() >= limit:
+                    log.warning(
+                        "client %s answer queue at limit %d (answer "
+                        "dropped)", client_id, limit,
+                    )
+                    return False
+                # never a blocking put: a timed-out client abandons its
+                # queue with no consumer — a blocked put would wedge the
+                # serversink thread forever (drop + warn instead)
+                try:
+                    q.put_nowait(frame)
+                    return True
+                except queue.Full:
+                    log.warning(
+                        "client %s answer queue full (answer dropped)",
+                        client_id,
+                    )
+                    return False
         if q is None:
             if heuristic:
                 log.warning(
@@ -244,9 +271,7 @@ class QueryServerCore:
                 log.warning(
                     "no pending client %s (answer dropped)", client_id
                 )
-            return False
-        q.put(frame)
-        return True
+        return False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:

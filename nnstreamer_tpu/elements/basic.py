@@ -236,6 +236,12 @@ class TensorSink(SinkElement):
             "fan incoming BatchFrames back out to per-frame callbacks "
             "(false = deliver the block whole; callbacks check batch_size)",
         ),
+        # ≙ gsttensor_sink.c props: gate/throttle the new-data signal
+        # (frames are still stored either way)
+        "emit-signal": Property(bool, True, "emit new-data callbacks"),
+        "signal-rate": Property(
+            int, 0, "max new-data callbacks per second (0 = every frame)"
+        ),
     }
 
     def __init__(self, name=None):
@@ -243,6 +249,7 @@ class TensorSink(SinkElement):
         self.frames: List[TensorFrame] = []
         self._callbacks: List[Callable[[TensorFrame], None]] = []
         self.eos_received = threading.Event()
+        self._last_signal_ts = 0.0
 
     def connect_new_data(self, cb: Callable[[TensorFrame], None]) -> None:
         self._callbacks.append(cb)
@@ -262,6 +269,14 @@ class TensorSink(SinkElement):
         self.frames.append(frame)
         if limit and len(self.frames) > limit:
             self.frames.pop(0)
+        if not self.props["emit-signal"]:
+            return
+        rate = self.props["signal-rate"]
+        if rate > 0:
+            now = time.monotonic()
+            if now - self._last_signal_ts < 1.0 / rate:
+                return
+            self._last_signal_ts = now
         for cb in self._callbacks:
             cb(frame)
 

@@ -22,6 +22,7 @@ decoded; unknown media goes to converter subplugins (registry kind
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -58,15 +59,22 @@ class TensorConverter(Element):
         "input-type": Property(str, "", "octet mode: target element type"),
         "mode": Property(str, "", "external converter: 'custom:<subplugin-name>'"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        "set-timestamp": Property(
+            bool, True,
+            "stamp arrival-relative pts on frames that carry none "
+            "(≙ gsttensor_converter set-timestamp)",
+        ),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
         self._pending: List[TensorFrame] = []
         self._sub = None  # external converter subplugin instance
+        self._ts_base = None  # set-timestamp: arrival-time origin
 
     # -- negotiation --------------------------------------------------------
     def start(self):
+        self._ts_base = None  # pts restarts with the stream (restartable)
         mode = self.props["mode"]
         if mode:
             kind, _, sub = mode.partition(":")
@@ -249,7 +257,18 @@ class TensorConverter(Element):
         return frame.with_tensors(tensors)
 
     def handle_frame(self, pad, frame):
+        orig = frame
         frame = self._convert_one(frame)
+        if self.props["set-timestamp"] and frame.pts is None:
+            # ≙ gsttensor_converter set-timestamp: stamp arrival-relative
+            # running time on sources that don't timestamp (octet/appsrc).
+            # Never mutate an aliased input in place (a custom subplugin
+            # may return its input unchanged; tee siblings share it)
+            if frame is orig:
+                frame = frame.with_tensors(list(frame.tensors))
+            if self._ts_base is None:
+                self._ts_base = time.monotonic()
+            frame.pts = time.monotonic() - self._ts_base
         fpt = self.props["frames-per-tensor"]
         if fpt <= 1:
             return [(0, frame)]

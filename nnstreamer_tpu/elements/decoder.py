@@ -37,6 +37,10 @@ class TensorDecoder(TransformElement):
             for i in range(1, _N_OPTIONS + 1)
         },
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        "config-file": Property(
+            str, "", "key=value file applied as properties (explicit "
+            "pipeline-text properties win; ≙ gsttensor_decoder config-file)"
+        ),
         "device-fused": Property(
             str, "auto",
             "auto = let the pipeline fold this decoder's device half "
@@ -75,6 +79,7 @@ class TensorDecoder(TransformElement):
         self._fused = True
 
     def start(self):
+        self._apply_config_file()
         self._fused = False  # re-fused (or not) by the pass on every start
         mode = self.props["mode"]
         if not mode:

@@ -248,6 +248,28 @@ class TensorFilter(TransformElement):
             "(JAX async dispatch: batch k+1 is stacked and dispatched while "
             "k still computes/transfers; 1 = synchronous)",
         ),
+        # manual model-info override (≙ tensor_filter_common.c props
+        # input/inputtype/inputname/inputranks + output side): declare or
+        # force I/O schemas for backends that cannot infer them (custom
+        # functions, raw .so) or to reshape shape-polymorphic models
+        "input": Property(str, "", "manual input dims 'd:d:d[,d:d]' (reference dialect)"),
+        "input-type": Property(str, "", "manual input element types 't[,t]'"),
+        "inputname": Property(str, "", "manual input tensor names"),
+        "inputranks": Property(str, "", "true ranks of manual input dims"),
+        "output": Property(str, "", "manual output dims (validated/declared)"),
+        "output-type": Property(str, "", "manual output element types"),
+        "outputname": Property(str, "", "manual output tensor names"),
+        "outputranks": Property(str, "", "true ranks of manual output dims"),
+        "inputlayout": Property(
+            str, "", "NCHW|NHWC|ANY per input (recorded; XLA owns layout)"
+        ),
+        "outputlayout": Property(
+            str, "", "NCHW|NHWC|ANY per output (recorded; XLA owns layout)"
+        ),
+        "config-file": Property(
+            str, "", "key=value file applied as properties (explicit "
+            "pipeline-text properties win)"
+        ),
         # ≙ GstShark/NNShark tracing (SURVEY §5.1) done the XLA-native way
         "trace": Property(int, 0, "1 = capture a jax.profiler trace while running"),
         "trace-dir": Property(str, "/tmp/nns_tpu_trace", "profiler output dir"),
@@ -321,7 +343,111 @@ class TensorFilter(TransformElement):
         return max(0, int(self.props["batch-timeout"])) / 1000.0
 
     # -- lifecycle ----------------------------------------------------------
+    @staticmethod
+    def _apply_rank(shape: tuple, rank: int) -> tuple:
+        """Trim/pad OUTERMOST (numpy-leading) unit dims so the shape has
+        the declared true rank (≙ inputranks/outputranks, which exist in
+        the reference to disambiguate trailing-1 dims of the padded dim
+        string)."""
+        shape = tuple(shape)
+        while len(shape) > rank:
+            if shape[0] not in (1, None):
+                raise ElementError(
+                    f"cannot reduce shape {shape} to rank {rank}: leading "
+                    f"dim {shape[0]} != 1"
+                )
+            shape = shape[1:]
+        while len(shape) < rank:
+            shape = (1,) + shape
+        return shape
+
+    def _manual_spec(self, side: str) -> Optional[StreamSpec]:
+        """Build the manual model-info override for 'input'/'output' from
+        the reference-dialect props, or None when not configured."""
+        from ..core.types import (
+            FORMAT_STATIC,
+            TensorSpec,
+            dtype_from_name,
+            parse_dims_string,
+        )
+
+        dims_text = self.props[side]
+        types_text = self.props[f"{side}-type"]
+        if not dims_text and not types_text:
+            return None
+        if not dims_text or not types_text:
+            raise ElementError(
+                f"{self.name}: {side} and {side}-type must be given together"
+            )
+        dims = [d for d in dims_text.split(",") if d.strip()]
+        types = [t.strip() for t in types_text.split(",") if t.strip()]
+        if len(dims) != len(types):
+            raise ElementError(
+                f"{self.name}: {side} declares {len(dims)} tensors but "
+                f"{side}-type declares {len(types)}"
+            )
+        names_key = "inputname" if side == "input" else "outputname"
+        ranks_key = "inputranks" if side == "input" else "outputranks"
+        names = self.props[names_key].split(",") if self.props[names_key] else []
+        ranks = [
+            int(r) for r in self.props[ranks_key].split(",") if r.strip()
+        ] if self.props[ranks_key] else []
+        specs = []
+        for i, (d, t) in enumerate(zip(dims, types)):
+            try:
+                shape = parse_dims_string(d)
+                if i < len(ranks):
+                    shape = self._apply_rank(shape, ranks[i])
+                spec = TensorSpec(
+                    shape, dtype_from_name(t),
+                    names[i].strip() if i < len(names) else "",
+                )
+            except (ValueError, ElementError) as e:
+                raise ElementError(f"{self.name}: {side}[{i}]: {e}") from None
+            specs.append(spec)
+        return StreamSpec(tuple(specs), FORMAT_STATIC, None)
+
+    @staticmethod
+    def _as_stream_spec(s) -> Optional[StreamSpec]:
+        """Normalize a backend model-info value — None | StreamSpec |
+        sequence of TensorSpec | sequence of (shape, dtype) — into a
+        StreamSpec, or None when empty/unknown."""
+        if s is None:
+            return None
+        if isinstance(s, StreamSpec):
+            return s if s.tensors else None
+        from ..core.types import FORMAT_STATIC, TensorSpec
+
+        tensors = []
+        for t in s:
+            if isinstance(t, TensorSpec):
+                tensors.append(t)
+            else:
+                shape, dt = t
+                tensors.append(TensorSpec(tuple(shape), np.dtype(dt)))
+        return (
+            StreamSpec(tuple(tensors), FORMAT_STATIC, None)
+            if tensors else None
+        )
+
+    _LAYOUTS = ("", "none", "any", "nchw", "nhwc")
+
+    def _check_layouts(self) -> None:
+        for key in ("inputlayout", "outputlayout"):
+            for i, lay in enumerate(
+                x.strip().lower()
+                for x in self.props[key].split(",") if x.strip()
+            ):
+                if lay not in self._LAYOUTS:
+                    raise ElementError(
+                        f"{self.name}: {key}[{i}]: unknown layout {lay!r} "
+                        f"(want NCHW|NHWC|ANY|NONE); note XLA owns physical "
+                        "layout on TPU — this prop is declarative"
+                    )
+
     def start(self) -> None:
+        self._apply_config_file()
+        self._check_layouts()
         self._tracing = False
         self._auto_batch_through = False  # re-set by the fusion pass, or not
         self._in_comb = _parse_combination(self.props["input-combination"])
@@ -382,6 +508,52 @@ class TensorFilter(TransformElement):
             self.backend = make()
             self._owns_backend = True
         self._model_in, self._model_out = self.backend.get_model_info()
+        in_override = self._manual_spec("input")
+        out_override = self._manual_spec("output")
+        if in_override is not None:
+            if not self._owns_backend:
+                # a shared backend's model info is visible to every filter
+                # on the key: mutating it (set_input_info) mid-run would
+                # desynchronize siblings' negotiated schemas
+                raise ElementError(
+                    f"{self.name}: manual input override is incompatible "
+                    "with shared-tensor-filter-key (set it on a non-shared "
+                    "filter)"
+                )
+            model_in = self._as_stream_spec(self._model_in)
+            if model_in is None:
+                # backend cannot infer (custom fn / raw .so): declare
+                self._model_in = in_override
+                try:
+                    derived = self.backend.set_input_info(in_override)
+                    if self._as_stream_spec(derived) is not None:
+                        self._model_out = derived
+                except NotImplementedError:
+                    pass
+            elif not in_override.is_compatible(model_in):
+                # flexible ('?'/0) override dims are wildcards — only a
+                # genuinely conflicting declaration forces a reshape
+                # force-reshape a shape-polymorphic model (≙ SET_INPUT_INFO)
+                try:
+                    self._model_out = self.backend.set_input_info(in_override)
+                except NotImplementedError:
+                    raise ElementError(
+                        f"{self.name}: input={self.props['input']} conflicts "
+                        f"with the model's declared input and backend "
+                        f"{fw!r} cannot reshape"
+                    ) from None
+                self._model_in = in_override
+        if out_override is not None:
+            model_out = self._as_stream_spec(self._model_out)
+            if model_out is None:
+                self._model_out = out_override
+            elif not out_override.is_compatible(model_out):
+                raise ElementError(
+                    f"{self.name}: output={self.props['output']}/"
+                    f"{self.props['output-type']} does not match the "
+                    f"model's output "
+                    f"{tuple((t.shape, str(t.dtype)) for t in model_out.tensors)}"
+                )
         # trace only after the backend opened: a start() failure must not
         # leak a profiler reference (pipeline won't call stop() on us then)
         if self.props["trace"]:

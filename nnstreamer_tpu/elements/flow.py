@@ -316,7 +316,28 @@ class TensorRate(TransformElement):
         "throttle": Property(bool, True, "drop-only (no duplication)"),
         "silent": Property(bool, True, "suppress per-frame counter logs"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # read-only QoS counters ≙ gsttensor_rate.c:955-977
+        "in": Property(int, 0, "input frame count (read-only)"),
+        "out": Property(int, 0, "output frame count (read-only)"),
+        "duplicate": Property(int, 0, "duplicated frame count (read-only)"),
+        "drop": Property(int, 0, "dropped frame count (read-only)"),
     }
+
+    _COUNTER_ATTRS = {
+        "in": "in_frames", "out": "out_frames",
+        "duplicate": "duplicated", "drop": "dropped",
+    }
+
+    def get_property(self, key):
+        attr = self._COUNTER_ATTRS.get(key.replace("_", "-"))
+        if attr is not None:
+            return getattr(self, attr)
+        return super().get_property(key)
+
+    def set_property(self, key, value):
+        if key.replace("_", "-") in self._COUNTER_ATTRS:
+            raise ElementError(f"{self.name}: {key!r} is read-only")
+        super().set_property(key, value)
 
     def __init__(self, name=None):
         super().__init__(name)

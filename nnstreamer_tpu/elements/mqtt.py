@@ -41,6 +41,34 @@ _HDR = struct.Struct(">8sdd")  # magic, base_epoch, sent_epoch
 _MAGIC = b"NNSMQTT1"
 
 
+def _ref_alias(el, canonical: str, reference: str):
+    """One rule for reference-spelled alias pairs (cleansession vs
+    clean-session): the reference spelling wins when EXPLICITLY set,
+    else the canonical prop's value applies."""
+    if reference in el._explicit_props:
+        return el.props[reference]
+    return el.props[canonical]
+
+
+def _effective_qos(el) -> int:
+    """mqtt-qos (reference name) wins when set (>= 0), else qos."""
+    mq = el.props.get("mqtt-qos", -1)
+    return mq if mq >= 0 else el.props["qos"]
+
+
+def _apply_debug(el) -> None:
+    """debug=true = verbose logging for THIS run, without mutating the
+    user-visible `silent` prop (an explicit silent= wins; the level is
+    re-derived on every start, so clearing debug restores quiet)."""
+    import logging
+
+    if "silent" in el._explicit_props:
+        return  # explicit silent= wins over debug
+    el.log.setLevel(
+        logging.DEBUG if el.props["debug"] else logging.NOTSET
+    )
+
+
 @element("mqttsink")
 class MqttSink(SinkElement):
     PROPERTIES = {
@@ -58,6 +86,28 @@ class MqttSink(SinkElement):
         # after a broker restart subscriptions are re-established before
         # QoS-1 redelivery lands (see distributed/mqtt.py)
         "reconnect-delay": Property(float, 1.0, "initial reconnect backoff, s"),
+        # reference-name props (gst/mqtt/mqttsink.c): mqtt-qos/cleansession
+        # are the reference spellings of qos/clean-session
+        "mqtt-qos": Property(int, -1, "alias of qos (reference name; -1 = unset)"),
+        "clean-session": Property(bool, True, "false = persistent session"),
+        "cleansession": Property(
+            bool, True, "alias of clean-session (reference name)"
+        ),
+        "keep-alive-interval": Property(int, 60, "MQTT keepalive, seconds"),
+        "max-buffer-size": Property(
+            int, 0, "max encoded message bytes (0 = unlimited; larger drops "
+            "with a warning)"
+        ),
+        "ntp-sync": Property(
+            bool, True,
+            "stamp the base-epoch header for cross-device pts rebasing "
+            "(clock assumed NTP/chrony-disciplined; ≙ mqttsink ntp-sync)"
+        ),
+        "ntp-srvs": Property(
+            str, "", "NTP servers (recorded; time discipline is the "
+            "fleet's — systemd-timesyncd/chrony — not per-element)"
+        ),
+        "debug": Property(bool, False, "verbose logging (≙ reference debug)"),
     }
 
     def __init__(self, name=None):
@@ -67,18 +117,26 @@ class MqttSink(SinkElement):
         self._sent = 0
         self._encode = wire.encode_frame
 
+    def _effective_qos(self) -> int:
+        return _effective_qos(self)
+
     def start(self) -> None:
         if not self.props["pub-topic"]:
             raise ElementError(f"{self.name}: pub-topic is required")
+        _apply_debug(self)
         self._encode, _ = wire.get_codec(self.props["idl"])
+        clean = _ref_alias(self, "clean-session", "cleansession")
         self._client = MqttClient(
             self.props["host"], self.props["port"],
             client_id=self.props["client-id"],
+            keepalive=self.props["keep-alive-interval"],
+            clean_session=clean,
             reconnect_delay_s=self.props["reconnect-delay"],
         )
         # pipeline base-time as epoch (≙ ntputil-derived base in the sink's
-        # message header) — receivers rebase against their own base
-        self._base_epoch = time.time()
+        # message header) — receivers rebase against their own base.
+        # ntp-sync=false: no epoch (receivers keep their own pts domain)
+        self._base_epoch = time.time() if self.props["ntp-sync"] else 0.0
         self._sent = 0
 
     def stop(self) -> None:
@@ -100,9 +158,16 @@ class MqttSink(SinkElement):
         payload = _HDR.pack(_MAGIC, self._base_epoch, time.time()) + (
             self._encode(frame)
         )
+        cap = self.props["max-buffer-size"]
+        if cap and len(payload) > cap:
+            self.log.warning(
+                "message %d bytes exceeds max-buffer-size %d (dropped)",
+                len(payload), cap,
+            )
+            return
         self._client.publish(
             self.props["pub-topic"], payload,
-            retain=self.props["retain"], qos=self.props["qos"],
+            retain=self.props["retain"], qos=self._effective_qos(),
         )
         self._sent += 1
 
@@ -124,6 +189,18 @@ class MqttSrc(SourceElement):
         # and a stable client-id for no-loss across subscriber restarts
         "qos": Property(int, 0, "subscription QoS: 0 | 1 (at-least-once)"),
         "clean-session": Property(bool, True, "false = persistent session"),
+        # reference-name props (gst/mqtt/mqttsrc.c)
+        "mqtt-qos": Property(int, -1, "alias of qos (reference name; -1 = unset)"),
+        "cleansession": Property(
+            bool, True, "alias of clean-session (reference name)"
+        ),
+        "keep-alive-interval": Property(int, 60, "MQTT keepalive, seconds"),
+        "debug": Property(bool, False, "verbose logging (≙ reference debug)"),
+        "is-live": Property(
+            bool, True,
+            "live source semantics (a broker feed is always live; false is "
+            "accepted for reference parity and ignored)"
+        ),
     }
 
     def __init__(self, name=None):
@@ -141,18 +218,22 @@ class MqttSrc(SourceElement):
         if not self.props["sub-topic"]:
             raise ElementError(f"{self.name}: sub-topic is required")
         self._stopping = threading.Event()  # fresh per run (restartable)
+        _apply_debug(self)
         _, self._decode_payload = wire.get_codec(self.props["idl"])
         self._q = _queue.Queue(self.props["max-msg-buf-size"])
+        clean = _ref_alias(self, "clean-session", "cleansession")
+        qos = _effective_qos(self)
         self._client = MqttClient(
             self.props["host"], self.props["port"],
             client_id=self.props["client-id"],
+            keepalive=self.props["keep-alive-interval"],
             reconnect_delay_s=self.props["reconnect-delay"],
-            clean_session=self.props["clean-session"],
+            clean_session=clean,
         )
         self._base_epoch = time.time()
         self._client.subscribe(
             self.props["sub-topic"], self._on_message,
-            qos=min(1, max(0, self.props["qos"])),
+            qos=min(1, max(0, qos)),
         )
 
     def stop(self) -> None:
@@ -200,8 +281,10 @@ class MqttSrc(SourceElement):
                 self.log.warning("undecodable MQTT frame: %s", e)
                 continue
             # cross-device timestamp rebasing (reference sync doc): shift the
-            # sender's stream clock into ours via the epoch difference
-            if frame.pts is not None:
+            # sender's stream clock into ours via the epoch difference.
+            # base_epoch 0.0 = sender published with ntp-sync=false: no
+            # shared epoch, receivers keep the sender's pts domain as-is
+            if frame.pts is not None and base_epoch > 0.0:
                 frame.pts += base_epoch - self._base_epoch
             frame.meta["mqtt-sent-epoch"] = sent_epoch
             frame.meta["mqtt-latency-s"] = max(0.0, time.time() - sent_epoch)

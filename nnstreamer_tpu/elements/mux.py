@@ -187,15 +187,51 @@ class TensorSplit(Element):
 
     PROPERTIES = {
         "tensorseg": Property(str, "", "comma sizes, e.g. '2,1' along the dim"),
+        "tensorpick": Property(
+            str, "",
+            "emit only these segment indices, in order (e.g. '0,2'); "
+            "empty = all segments",
+        ),
         "option": Property(str, "0", "reference dim index to split on"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
     }
 
+    _seg_cache: Optional[List[int]] = None
+    _pick_cache: Optional[List[int]] = None
+
+    def start(self):
+        # parse-once convention (hot path stays parse-free, like
+        # tensor_transform); direct handle_frame calls without start()
+        # (unit tests) fall back to parsing per call
+        self._seg_cache = self._sizes()
+        self._pick_cache = self._picks(len(self._seg_cache))
+
+    def stop(self):
+        self._seg_cache = self._pick_cache = None
+
     def _sizes(self) -> List[int]:
+        if self._seg_cache is not None:
+            return self._seg_cache
         text = self.props["tensorseg"]
         if not text:
             raise ElementError(f"{self.name}: tensor_split requires tensorseg=")
         return [int(x) for x in text.split(",") if x.strip()]
+
+    def _picks(self, nseg: int) -> List[int]:
+        """Pad index -> segment index (≙ gsttensor_split.c tensorpick)."""
+        if self._pick_cache is not None:
+            return self._pick_cache
+        text = self.props["tensorpick"]
+        if not text:
+            return list(range(nseg))
+        picks = [int(x) for x in text.split(",") if x.strip()]
+        bad = [p for p in picks if not 0 <= p < nseg]
+        if bad:
+            raise ElementError(
+                f"{self.name}: tensorpick {bad} out of range for "
+                f"{nseg} segments"
+            )
+        return picks
 
     def _np_axis(self, rank: int) -> int:
         try:
@@ -221,11 +257,12 @@ class TensorSplit(Element):
             return ANY
         t = in_spec.tensors[0]
         sizes = self._sizes()
-        if pad >= len(sizes):
+        picks = self._picks(len(sizes))
+        if pad >= len(picks):
             return ANY
         axis = self._np_axis(len(t.shape))
         dims = list(t.shape)
-        dims[axis] = sizes[pad]
+        dims[axis] = sizes[picks[pad]]
         return StreamSpec(
             (TensorSpec(tuple(dims), t.dtype, t.name),),
             in_spec.fmt,
@@ -236,12 +273,16 @@ class TensorSplit(Element):
         arr = np.asarray(frame.tensors[0])
         sizes = self._sizes()
         axis = self._np_axis(arr.ndim)
-        out = []
+        offsets = []
         off = 0
-        for p, size in enumerate(sizes):
-            sl = [slice(None)] * arr.ndim
-            sl[axis] = slice(off, off + size)
+        for size in sizes:
+            offsets.append((off, size))
             off += size
+        out = []
+        for p, seg in enumerate(self._picks(len(sizes))):
             if p < len(self.srcpads) and self.srcpads[p].is_linked:
+                o, size = offsets[seg]
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(o, o + size)
                 out.append((p, frame.with_tensors([arr[tuple(sl)]])))
         return out

@@ -106,6 +106,9 @@ class TensorQueryServerSink(SinkElement):
     PROPERTIES = {
         "id": Property(int, 0, "pairs with the serversrc of the same id"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # ≙ tensor_query_serversink.c `limit`: bound per-client queued
+        # answers; excess answers are dropped with a warning
+        "limit": Property(int, 0, "max queued answers per client (0 = unbounded)"),
     }
 
     def __init__(self, name=None):
@@ -133,7 +136,9 @@ class TensorQueryServerSink(SinkElement):
                 f"{self.name}: frame lacks client_id meta (did it pass through "
                 "an element that drops meta?)"
             )
-        self._core.resolve(int(client_id), frame)
+        self._core.resolve(
+            int(client_id), frame, limit=self.props["limit"]
+        )
 
 
 @element("tensor_query_client")

@@ -105,6 +105,10 @@ class TensorSrcIIO(SourceElement):
         "device": Property(str, "", "IIO device name"),
         "device-number": Property(int, -1, "IIO device number (alternative)"),
         "trigger": Property(str, "", "trigger name to attach (optional)"),
+        "trigger-number": Property(
+            int, -1, "trigger by index: attaches 'trigger<N>' (≙ reference "
+            "trigger-number; -1 = unset)"
+        ),
         "silent": Property(bool, True, "suppress per-buffer logs"),
         "channels": Property(str, "auto", "auto | all | comma list of names"),
         "buffer-capacity": Property(int, 1, "samples per output frame"),
@@ -207,6 +211,19 @@ class TensorSrcIIO(SourceElement):
         except ValueError:
             return default
 
+    def _resolve_trigger(self) -> str:
+        """Trigger NAME to write into current_trigger: the `trigger` prop
+        verbatim, or — with `trigger-number` — trigger<N>'s sysfs `name`
+        file (current_trigger wants the name, not the directory; the dir
+        name is the fallback for nameless triggers)."""
+        trig = self.props["trigger"]
+        if trig or self.props["trigger-number"] < 0:
+            return trig
+        n = self.props["trigger-number"]
+        return _read(
+            os.path.join(self.props["iio-base-dir"], f"trigger{n}", "name")
+        ) or f"trigger{n}"
+
     def start(self) -> None:
         self._device_dir, entry = self._find_device()
         self._chans = self._scan_channels(self._device_dir)
@@ -214,7 +231,7 @@ class TensorSrcIIO(SourceElement):
         if freq > 0:
             _write(os.path.join(self._device_dir, "sampling_frequency"),
                    str(freq))
-        trig = self.props["trigger"]
+        trig = self._resolve_trigger()
         if trig:
             if not _write(
                 os.path.join(self._device_dir, "trigger", "current_trigger"),

@@ -45,12 +45,39 @@ class TensorTrainer(Element):
         "checkpoint-interval": Property(int, 1, "epochs between checkpoints"),
         "checkpoint-keep": Property(int, 3, "checkpoints retained (0 = all)"),
         "resume": Property(bool, False, "resume from newest checkpoint"),
+        # ≙ gsttensor_trainer.c PROP_READY_TO_COMPLETE_TRAINING: setting
+        # true on a RUNNING trainer finishes training gracefully (current
+        # data drained, model saved, completion event fired)
+        "ready-to-complete": Property(
+            bool, False, "true = finish training now (runtime-settable)"
+        ),
     }
+
+    def set_property(self, key, value):
+        super().set_property(key, value)
+        if (
+            key.replace("_", "-") == "ready-to-complete"
+            and self.props["ready-to-complete"]
+        ):
+            if self.backend is not None and self._created:
+                # mirror the reference contract: graceful early finish
+                # while training is live
+                if hasattr(self.backend, "end_of_data"):
+                    self._finish_requested = True
+                    self.backend.end_of_data()
+            else:
+                # ≙ the reference's PLAYING-state-only warning; the flag
+                # is honored when training goes live (handle_frame)
+                self.log.warning(
+                    "ready-to-complete set before training started; will "
+                    "finish after the first pushed batch"
+                )
 
     def __init__(self, name=None):
         super().__init__(name)
         self.backend = None
         self._created = False
+        self._finish_requested = False
         self.training_complete = threading.Event()
         self._stats_lock = threading.Lock()
         self._stats_pending = []  # epoch stats awaiting downstream emission
@@ -66,6 +93,7 @@ class TensorTrainer(Element):
         self.backend.add_listener(self._on_event)
         # reset run state so a restarted pipeline waits for the new run
         self.training_complete.clear()
+        self._finish_requested = False
         with self._stats_lock:
             self._stats_pending = []
 
@@ -114,6 +142,13 @@ class TensorTrainer(Element):
             self.backend.start()
             self._created = True
         self.backend.push_data(frame)
+        if (
+            self.props["ready-to-complete"] and not self._finish_requested
+            and hasattr(self.backend, "end_of_data")
+        ):
+            # flag was set before training went live: honor it now
+            self._finish_requested = True
+            self.backend.end_of_data()
         self._check_backend_error()
         return self._drain_stats()
 

@@ -62,11 +62,17 @@ class TensorTransform(TransformElement):
         "option": Property(str, "", "mode-specific option string"),
         "acceleration": Property(bool, True, "kept for reference parity (no-op)"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # ≙ gsttensor_transform.c `apply`: comma list of tensor indices
+        # the op applies to; others pass through untouched
+        "apply": Property(
+            str, "", "tensor indices to transform (empty = all)"
+        ),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
         self._op: Optional[_Op] = None
+        self._apply_idx: Optional[set] = None
 
     # -- option parsing (done once at start; hot path stays parse-free) -----
     def start(self):
@@ -78,6 +84,18 @@ class TensorTransform(TransformElement):
         if builder is None:
             raise ElementError(f"{self.name}: unknown transform mode {mode!r}")
         self._op = builder(option)
+        apply_opt = self.props["apply"]
+        self._apply_idx = (
+            {int(x) for x in apply_opt.split(",") if x.strip()}
+            if apply_opt else None
+        )
+        if self._apply_idx is not None and any(
+            i < 0 for i in self._apply_idx
+        ):
+            raise ElementError(
+                f"{self.name}: apply indices must be >= 0 "
+                f"(got {sorted(self._apply_idx)})"
+            )
 
     def _build_typecast(self, option: str) -> _Op:
         dtype = dtype_from_name(option)
@@ -202,16 +220,37 @@ class TensorTransform(TransformElement):
         return _Op(apply, lambda t: t)
 
     # -- negotiation / processing -------------------------------------------
+    def _applies(self, i: int) -> bool:
+        return self._apply_idx is None or i in self._apply_idx
+
+    def accept_spec(self, pad, spec):
+        # a typo'd apply index must fail loud at negotiation, not become
+        # a silent no-op (mirror of tensor_split's tensorpick range check)
+        if self._apply_idx is not None and spec.tensors:
+            bad = [i for i in self._apply_idx if i >= len(spec.tensors)]
+            if bad:
+                raise ElementError(
+                    f"{self.name}: apply indices {sorted(bad)} out of "
+                    f"range for a {len(spec.tensors)}-tensor stream"
+                )
+        return spec
+
     def derive_spec(self, pad=0):
         in_spec = self.sink_specs.get(0, ANY)
         if self._op is None or not in_spec.tensors:
             return in_spec
         return StreamSpec(
-            tuple(self._op.spec(t) for t in in_spec.tensors),
+            tuple(
+                self._op.spec(t) if self._applies(i) else t
+                for i, t in enumerate(in_spec.tensors)
+            ),
             in_spec.fmt,
             in_spec.framerate,
         )
 
     def transform(self, frame: TensorFrame) -> TensorFrame:
         assert self._op is not None, f"{self.name} not started"
-        return frame.with_tensors([self._op.apply(t) for t in frame.tensors])
+        return frame.with_tensors([
+            self._op.apply(t) if self._applies(i) else t
+            for i, t in enumerate(frame.tensors)
+        ])

@@ -34,6 +34,12 @@ from ..core.types import ANY, StreamSpec
 # ---------------------------------------------------------------------------
 # Property system (≙ GObject properties)
 # ---------------------------------------------------------------------------
+# properties every element answers, merged under each class's declared
+# PROPERTIES (a class declaring its own wins) — ≙ the reference's
+# near-universal GObject props (silent on ~every element)
+COMMON_PROPERTIES: Dict[str, "Property"] = {}  # filled after Property def
+
+
 @dataclass
 class Property:
     """Declared element property: type-checked, string-parsable."""
@@ -60,6 +66,14 @@ class Property:
             except Exception:
                 raise ValueError(f"cannot convert {value!r} to {self.type.__name__}")
         return self.convert(value) if self.convert else value
+
+
+COMMON_PROPERTIES.update({
+    # ≙ the reference's universal `silent` prop (e.g. gsttensor_rate.c
+    # PROP_SILENT: "Don't produce verbose output"): false lowers this
+    # element's logger to DEBUG so per-frame diagnostics stream out
+    "silent": Property(bool, True, "false = verbose (debug-level) logging"),
+})
 
 
 class ElementError(RuntimeError):
@@ -148,8 +162,12 @@ class Element:
         self.name = name or f"{self.FACTORY_NAME}{id(self) & 0xFFFF}"
         self.log = get_logger(self.name)
         self.props: Dict[str, Any] = {
-            k: p.default for k, p in self.PROPERTIES.items()
+            **{k: p.default for k, p in COMMON_PROPERTIES.items()},
+            **{k: p.default for k, p in self.PROPERTIES.items()},
         }
+        # keys set explicitly (pipeline text / API) — lets config-file
+        # style bulk application defer to explicit settings
+        self._explicit_props: set = set()
         nsrc = self.NUM_SRC_PADS if self.NUM_SRC_PADS is not None else 0
         self.srcpads: List[SrcPad] = [SrcPad(self, i) for i in range(nsrc)]
         self.sink_specs: Dict[int, StreamSpec] = {}
@@ -159,16 +177,64 @@ class Element:
     # -- properties ---------------------------------------------------------
     def set_property(self, key: str, value: Any) -> None:
         key = key.replace("_", "-")
-        decl = self.PROPERTIES.get(key)
+        decl = self.PROPERTIES.get(key) or COMMON_PROPERTIES.get(key)
         if decl is None:
             raise ElementError(f"{self.name}: unknown property {key!r}")
         self.props[key] = decl.parse(value)
+        self._explicit_props.add(key)
+        if key == "silent":
+            import logging
+
+            self.log.setLevel(
+                logging.NOTSET if self.props[key] else logging.DEBUG
+            )
 
     def get_property(self, key: str) -> Any:
         key = key.replace("_", "-")
         if key not in self.props:
             raise ElementError(f"{self.name}: unknown property {key!r}")
         return self.props[key]
+
+    def _apply_config_file(self) -> None:
+        """≙ the reference's filter/decoder `config-file` prop: key=value
+        lines become properties; properties set explicitly in the
+        pipeline text win.  Elements that declare the prop call this at
+        the top of start()."""
+        path = self.props.get("config-file", "")
+        if not path:
+            return
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            raise ElementError(f"{self.name}: config-file: {e}") from None
+        for ln, raw in enumerate(lines, 1):
+            line = raw.strip()
+            # comment lines only — an inline '#' may be part of a value
+            # (custom=color:#ff0000, paths), so never truncate mid-line
+            if not line or line.startswith("#"):
+                continue
+            key, sep, value = line.partition("=")
+            if not sep:
+                raise ElementError(
+                    f"{self.name}: config-file {path}:{ln}: expected "
+                    f"key=value, got {raw!r}"
+                )
+            key = key.strip().replace("_", "-")
+            if key == "config-file":
+                raise ElementError(
+                    f"{self.name}: config-file {path}:{ln}: nested "
+                    "config-file not allowed"
+                )
+            if key in self._explicit_props:
+                continue
+            try:
+                self.set_property(key, value.strip())
+            except (ElementError, ValueError) as e:
+                raise ElementError(
+                    f"{self.name}: config-file {path}:{ln}: {e}"
+                ) from None
+            self._explicit_props.discard(key)  # config values stay soft
 
     # -- pads ---------------------------------------------------------------
     def request_src_pad(self) -> SrcPad:

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Generate Documentation/prop-parity.md: reference element properties vs
+this framework's, with curated annotations for intentional differences.
+
+Reference props are extracted from the reference sources' g_param_spec_*
+installs; ours from each element class's PROPERTIES (+COMMON_PROPERTIES).
+Run: python tools/prop_parity.py [--check]   (--check: exit 1 if an
+unannotated gap appears — used as a CI-style guard)
+"""
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+sys.path.insert(0, ROOT)
+
+# reference element -> source files holding its g_param_spec installs
+REF_SOURCES = {
+    "tensor_filter": [
+        "gst/nnstreamer/tensor_filter/tensor_filter_common.c",
+        "gst/nnstreamer/tensor_filter/tensor_filter.c",
+    ],
+    "tensor_converter": ["gst/nnstreamer/elements/gsttensor_converter.c"],
+    "tensor_transform": ["gst/nnstreamer/elements/gsttensor_transform.c"],
+    "tensor_decoder": ["gst/nnstreamer/elements/gsttensor_decoder.c"],
+    "tensor_if": ["gst/nnstreamer/elements/gsttensor_if.c"],
+    "tensor_aggregator": ["gst/nnstreamer/elements/gsttensor_aggregator.c"],
+    "tensor_rate": ["gst/nnstreamer/elements/gsttensor_rate.c"],
+    "tensor_crop": ["gst/nnstreamer/elements/gsttensor_crop.c"],
+    "tensor_mux": ["gst/nnstreamer/elements/gsttensor_mux.c"],
+    "tensor_demux": ["gst/nnstreamer/elements/gsttensor_demux.c"],
+    "tensor_merge": ["gst/nnstreamer/elements/gsttensor_merge.c"],
+    "tensor_split": ["gst/nnstreamer/elements/gsttensor_split.c"],
+    "tensor_sink": ["gst/nnstreamer/elements/gsttensor_sink.c"],
+    "tensor_query_client": [
+        "gst/nnstreamer/tensor_query/tensor_query_client.c"],
+    "tensor_query_serversrc": [
+        "gst/nnstreamer/tensor_query/tensor_query_serversrc.c"],
+    "tensor_query_serversink": [
+        "gst/nnstreamer/tensor_query/tensor_query_serversink.c"],
+    "tensor_trainer": ["gst/nnstreamer/elements/gsttensor_trainer.c"],
+    "datareposrc": ["gst/datarepo/gstdatareposrc.c"],
+    "datareposink": ["gst/datarepo/gstdatareposink.c"],
+    "edgesink": ["gst/edge/edge_sink.c"],
+    "edgesrc": ["gst/edge/edge_src.c"],
+    "tensor_sparse_enc": ["gst/nnstreamer/elements/gsttensor_sparseenc.c"],
+    "tensor_sparse_dec": ["gst/nnstreamer/elements/gsttensor_sparsedec.c"],
+    "tensor_reposink": ["gst/nnstreamer/elements/gsttensor_reposink.c"],
+    "tensor_reposrc": ["gst/nnstreamer/elements/gsttensor_reposrc.c"],
+    "mqttsink": ["gst/mqtt/mqttsink.c"],
+    "mqttsrc": ["gst/mqtt/mqttsrc.c"],
+    "tensor_src_iio": ["gst/nnstreamer/elements/gsttensor_srciio.c"],
+}
+
+# reference prop -> (our name | None, note).  None = intentionally not a
+# property here; the note says where the capability lives instead.
+ANNOTATIONS = {
+    ("*", "sub-plugins"): (
+        None, "read-only discovery list -> `nns-tpu-check` CLI (confchk)"),
+    ("tensor_filter", "inputranks"): ("inputranks", "declarative rank fix"),
+    ("tensor_filter", "outputranks"): ("outputranks", "declarative rank fix"),
+    ("tensor_filter", "inputlayout"): (
+        "inputlayout", "validated + recorded; XLA owns physical layout"),
+    ("tensor_filter", "outputlayout"): (
+        "outputlayout", "validated + recorded; XLA owns physical layout"),
+    ("tensor_transform", "transpose-rank-limit"): (
+        None, "no rank cap here: transpose handles any rank <= 16"),
+    ("tensor_query_client", "dest-host"): (
+        None, "broker-discovery addressing; direct host:port + hosts= "
+        "round-robin cover the capability (hybrid discovery via edge "
+        "elements)"),
+    ("tensor_query_client", "dest-port"): (None, "see dest-host"),
+    ("tensor_query_client", "topic"): (None, "see dest-host"),
+    ("tensor_query_serversrc", "dest-host"): (None, "see client dest-host"),
+    ("tensor_query_serversrc", "dest-port"): (None, "see client dest-host"),
+    ("tensor_query_serversrc", "topic"): (None, "see client dest-host"),
+    ("tensor_query_serversrc", "timeout"): (
+        None, "ingress is push-based here; client timeout + server "
+        "deadline (gRPC context) bound waits"),
+    ("tensor_query_serversrc", "is-live"): (
+        None, "always live (pushsrc semantics built in)"),
+    ("tensor_query_serversink", "connect-type"): (
+        None, "transport chosen by the serversrc pair"),
+    ("tensor_query_serversink", "timeout"): (
+        None, "answers resolve in-process; RPC deadline governs"),
+    ("mqttsink", "pub-wait-timeout"): (
+        None, "QoS-1 drain window on stop() (bounded) covers the intent"),
+    ("mqttsrc", "debug"): ("debug", None),
+    ("tensor_src_iio", "poll-timeout"): ("poll-timeout", None),
+    ("edgesink", "wait-connection"): (
+        None, "pub/sub broker holds the stream; subscribers attach "
+        "anytime (no blocking-for-first-subscriber mode)"),
+    ("edgesink", "connection-timeout"): (None, "see wait-connection"),
+    ("edgesrc", "host"): (
+        None, "subscriber dials dest-host/dest-port (broker); a local "
+        "bind address is not needed"),
+    ("edgesrc", "port"): (None, "see host"),
+    ("datareposrc", "caps"): (
+        None, "schema comes from the JSON meta (self-describing dataset)"),
+    ("tensor_reposrc", "caps"): (
+        None, "repo slots carry their schema; negotiated downstream"),
+    ("mqttsink", "num-buffers"): ("num-buffers", None),
+    ("tensor_converter", "mode"): ("mode", None),
+}
+
+# our-name aliases: reference name -> our spelling
+ALIASES = {
+    "inputtype": "input-type",
+    "outputtype": "output-type",
+    "compared-value-option": "compared-value-option",
+    "cleansession": "cleansession",
+    "mqtt-qos": "mqtt-qos",
+    "clean-session": "clean-session",
+    "emit-signal": "emit-signal",
+}
+
+OUR_NAME = {
+    "tensor_sparse_enc": "tensor_sparse_enc",
+    "tensor_sparse_dec": "tensor_sparse_dec",
+}
+
+
+def ref_props(element):
+    pat = re.compile(r'g_param_spec_\w+\s*\(\s*"([^"]+)"')
+    props = []
+    for rel in REF_SOURCES[element]:
+        path = os.path.join(REF, rel)
+        with open(path, errors="replace") as f:
+            props += pat.findall(f.read())
+    return list(dict.fromkeys(props))
+
+
+def our_props(element):
+    from nnstreamer_tpu.pipeline.element import (
+        COMMON_PROPERTIES,
+        ELEMENT_TYPES,
+    )
+
+    cls = ELEMENT_TYPES[OUR_NAME.get(element, element)]
+    return set(cls.PROPERTIES) | set(COMMON_PROPERTIES)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # importing the element packages triggers registration
+    import nnstreamer_tpu.elements  # noqa: F401
+
+    check = "--check" in sys.argv
+    lines = [
+        "# Property parity: reference elements vs nnstreamer_tpu",
+        "",
+        "Generated by `python tools/prop_parity.py` (re-run after adding",
+        "element properties).  Reference props are extracted from the",
+        "`g_param_spec_*` installs in the reference sources; `covered by`",
+        "names the mechanism when the capability intentionally lives",
+        "elsewhere than a same-named property.",
+        "",
+    ]
+    unannotated = []
+    for el in REF_SOURCES:
+        ours = our_props(el)
+        rows = []
+        n_same = 0
+        for p in ref_props(el):
+            note = ANNOTATIONS.get((el, p)) or ANNOTATIONS.get(("*", p))
+            if p in ours or p.replace("_", "-") in ours:
+                n_same += 1
+                continue
+            if ALIASES.get(p) in ours:
+                rows.append(f"| `{p}` | `{ALIASES[p]}` | renamed |")
+            elif note is not None:
+                target, text = note
+                if target and target in ours:
+                    rows.append(f"| `{p}` | `{target}` | {text or ''} |")
+                    n_same += 1
+                    continue
+                rows.append(f"| `{p}` | — | covered by: {text} |")
+            else:
+                rows.append(f"| `{p}` | — | **GAP (unannotated)** |")
+                unannotated.append((el, p))
+        lines.append(f"## {el}")
+        lines.append("")
+        lines.append(
+            f"{n_same} reference props present under the same name."
+        )
+        if rows:
+            lines.append("")
+            lines.append("| reference prop | ours | note |")
+            lines.append("|---|---|---|")
+            lines.extend(rows)
+        lines.append("")
+    out = os.path.join(ROOT, "Documentation", "prop-parity.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}")
+    if unannotated:
+        print(f"{len(unannotated)} unannotated gap(s):")
+        for el, p in unannotated:
+            print(f"  {el}.{p}")
+        if check:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
