@@ -1,0 +1,126 @@
+"""Hybrid control plane: MQTT-retained endpoint announce/discover.
+
+One implementation of the reference's MQTT-hybrid split (control over
+MQTT, data direct — nnstreamer-edge HYBRID connect type, ``CHANGES:8-13``)
+shared by the edge elements (single retained announce per topic) and the
+tensor_query elements (one retained announce per server instance under a
+topic prefix, wildcard discovery for pod fan-out).
+
+Contract: an announce is a RETAINED JSON object carrying at least
+``{"host", "port"}``; deleting it is publishing an empty retained payload
+on the same topic (MQTT 3.3.1.3 tombstone).  "Announced" implies
+"discoverable": publishes are QoS-1 and drained before returning.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..core.log import get_logger
+from .mqtt import MqttClient
+
+log = get_logger("hybrid")
+
+
+class Announcement:
+    """A live retained announce; ``clear()`` tombstones it."""
+
+    def __init__(self, broker_host: str, broker_port: int, topic: str,
+                 info: dict, logger=None):
+        self.topic = topic
+        self.log = logger or log
+        self._client = MqttClient(broker_host, broker_port)
+        self._client.publish(
+            topic, json.dumps(info).encode(), retain=True, qos=1
+        )
+        # QoS-1 ack before the caller proceeds: "started" must imply
+        # "discoverable", or a client racing the start misses the server
+        if self._client.drain(5.0):
+            self.log.warning(
+                "endpoint announce on %s unacknowledged by the broker",
+                topic,
+            )
+
+    def clear(self) -> None:
+        """Delete the retained announce (empty retained payload): late
+        clients must not dial a released port."""
+        if self._client is None:
+            return
+        try:
+            self._client.publish(self.topic, b"", retain=True, qos=1)
+            if self._client.drain(5.0):
+                self.log.warning(
+                    "retained-announce delete on %s unacknowledged; a "
+                    "stale endpoint may remain on the broker", self.topic,
+                )
+        except OSError:
+            pass
+        self._client.close()
+        self._client = None
+
+
+def discover_endpoints(
+    broker_host: str, broker_port: int, topic_filter: str,
+    timeout_s: float, settle_s: float = 0.25,
+    validate: Optional[Callable[[str, dict], bool]] = None,
+    logger=None,
+) -> Dict[str, Tuple[str, int]]:
+    """Collect retained announces matching ``topic_filter`` (wildcards ok).
+
+    Waits (bounded by ``timeout_s``) for the first announce, then a short
+    settle window so a whole pod's retained set is gathered.  Tombstones
+    received during the window REMOVE their entry — a server that shuts
+    down mid-discovery must not be dialed.  ``validate(topic, info)``
+    filters announces (e.g. transport match).  Returns {topic: (host,
+    port)}; empty when nothing (valid) was announced.
+    """
+    lg = logger or log
+    found: Dict[str, Tuple[str, int]] = {}
+    lock = threading.Lock()
+
+    def on_msg(topic: str, payload: bytes) -> None:
+        if not payload:
+            with lock:
+                found.pop(topic, None)  # tombstone: server went away
+            return
+        try:
+            info = json.loads(payload.decode())
+            entry = (str(info["host"]), int(info["port"]))
+        except (ValueError, KeyError, TypeError):
+            lg.warning("undecodable announce on %s", topic)
+            return
+        if validate is not None and not validate(topic, info):
+            return
+        with lock:
+            found[topic] = entry
+
+    client = MqttClient(broker_host, broker_port)
+    try:
+        client.subscribe(topic_filter, on_msg, qos=0)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with lock:
+                n = len(found)
+            if n:
+                time.sleep(settle_s)  # gather the rest of the pod
+                break
+            time.sleep(0.02)
+    finally:
+        client.close()
+    with lock:
+        return dict(found)
+
+
+def probe_endpoint(host: str, port: int, timeout_s: float = 0.5) -> bool:
+    """TCP connect probe: a crashed server never tombstones its retained
+    announce, so discoverers drop endpoints that no longer accept."""
+    import socket
+
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s):
+            return True
+    except OSError:
+        return False

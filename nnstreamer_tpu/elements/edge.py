@@ -68,7 +68,7 @@ class EdgeSink(SinkElement):
         self._broker = None
         self._pub: Optional[EdgePublisher] = None
         self._wall_base: Optional[float] = None
-        self._mqtt = None
+        self._announcement = None
         self._tcp = None
 
     def start(self):
@@ -90,45 +90,29 @@ class EdgeSink(SinkElement):
         if mode == "hybrid":
             # control plane: retained announce on the MQTT broker at
             # dest-host:dest-port; data stays on the local gRPC broker
-            import json
+            # (shared machinery: distributed/hybrid.py)
+            from ..distributed.hybrid import Announcement
 
-            from ..distributed.mqtt import MqttClient
-
-            self._mqtt = MqttClient(
-                self.props["dest-host"], self.props["dest-port"]
-            )
-            self._mqtt.publish(
-                _control_topic(self.props["topic"]),
-                json.dumps(
-                    {"host": self.props["host"], "port": self._broker.port}
-                ).encode(),
-                retain=True, qos=1,
-            )
+            try:
+                self._announcement = Announcement(
+                    self.props["dest-host"], self.props["dest-port"],
+                    _control_topic(self.props["topic"]),
+                    {"host": self.props["host"], "port": self._broker.port},
+                    logger=self.log,
+                )
+            except Exception:
+                # rollback won't stop a failed element: release the
+                # started data broker ourselves
+                self.stop()
+                raise
 
     def stop(self):
         if self._pub is not None:
             self._pub.close()
             self._pub = None
-        if self._mqtt is not None:
-            try:
-                # clear the retained announce (empty retained payload =
-                # delete, MQTT §3.3.1.3) so later subscribers don't dial
-                # the released data port; QoS 1 + bounded drain so the
-                # delete actually reaches the broker before we hang up
-                self._mqtt.publish(
-                    _control_topic(self.props["topic"]), b"",
-                    retain=True, qos=1,
-                )
-                left = self._mqtt.drain(5.0)
-                if left:
-                    self.log.warning(
-                        "retained-announce delete unacknowledged; a stale "
-                        "endpoint may remain on the MQTT broker"
-                    )
-            except OSError:
-                pass
-            self._mqtt.close()
-            self._mqtt = None
+        if self._announcement is not None:
+            self._announcement.clear()
+            self._announcement = None
         if self._tcp is not None:
             self._tcp.close()
             self._tcp = None
@@ -193,33 +177,25 @@ class EdgeSrc(SourceElement):
         self._sub: Optional[EdgeSubscriber] = None
 
     def _discover(self) -> tuple:
-        """Hybrid control plane: read the retained announce from MQTT."""
-        import json
-        import queue as q
-
-        from ..distributed.mqtt import MqttClient
+        """Hybrid control plane: read the retained announce from MQTT
+        (shared machinery: distributed/hybrid.py; single fixed topic, so
+        no settle window is needed)."""
+        from ..distributed.hybrid import discover_endpoints
         from ..pipeline.element import ElementError
 
-        got: "q.Queue[bytes]" = q.Queue(1)
-        client = MqttClient(self.props["dest-host"], self.props["dest-port"])
-        try:
-            client.subscribe(
-                _control_topic(self.props["topic"]),
-                # empty payload = retained-announce deletion, not an offer
-                lambda t, p: got.put_nowait(p) if p else None,
+        found = discover_endpoints(
+            self.props["dest-host"], self.props["dest-port"],
+            _control_topic(self.props["topic"]),
+            timeout_s=self.props["discovery-timeout"], settle_s=0.0,
+            logger=self.log,
+        )
+        if not found:
+            raise ElementError(
+                f"{self.name}: no edge announce for topic "
+                f"{self.props['topic']!r} within "
+                f"{self.props['discovery-timeout']}s"
             )
-            try:
-                payload = got.get(timeout=self.props["discovery-timeout"])
-            except q.Empty:
-                raise ElementError(
-                    f"{self.name}: no edge announce for topic "
-                    f"{self.props['topic']!r} within "
-                    f"{self.props['discovery-timeout']}s"
-                ) from None
-        finally:
-            client.close()
-        info = json.loads(payload)
-        return info["host"], int(info["port"])
+        return next(iter(found.values()))
 
     def start(self):
         if self.props["connect-type"] == "tcp":

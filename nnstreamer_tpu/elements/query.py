@@ -50,6 +50,15 @@ class TensorQueryServerSrc(SourceElement):
             "transport: grpc (interop default) | tcp (zero-copy raw TCP, "
             "≙ reference nns-edge TCP)"),
         "caps": Property(str, "", "announced input schema for the handshake"),
+        # hybrid discovery (≙ reference connect-type=HYBRID: MQTT control
+        # plane + direct data plane): announce this server's endpoint as a
+        # RETAINED message on nns/query/<topic>/<instance> so clients
+        # resolve servers from the broker instead of static host:port
+        "topic": Property(str, "", "announce endpoint under this topic"),
+        "dest-host": Property(str, "localhost", "MQTT broker host (discovery)"),
+        "dest-port": Property(
+            int, 0, "MQTT broker port (0 = announcing disabled)"
+        ),
         "block-ingress": Property(
             bool, False,
             "inject each wire micro-batch as ONE BatchFrame so the server "
@@ -61,6 +70,7 @@ class TensorQueryServerSrc(SourceElement):
     def __init__(self, name=None):
         super().__init__(name)
         self._core = None
+        self._announcement = None
 
     def start(self):
         self._core = get_query_server(self.props["id"], self.props["port"])
@@ -77,8 +87,47 @@ class TensorQueryServerSrc(SourceElement):
                 f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
         # expose the actually-bound port (ephemeral binds)
         self.props["port"] = self._core.port
+        if self.props["topic"] and self.props["dest-port"] > 0:
+            try:
+                self._announce()
+            except Exception:
+                # pipeline rollback only stops elements whose start()
+                # SUCCEEDED: release the acquired core ourselves or the
+                # listener/refcount leaks for the process lifetime
+                self.stop()
+                raise
+
+    def _announce(self) -> None:
+        """Retained per-instance endpoint announce on the MQTT control
+        plane (shared machinery: distributed/hybrid.py)."""
+        import os as _os
+        import uuid as _uuid
+
+        from ..distributed.hybrid import Announcement
+
+        host = self.props["host"]
+        if host in ("[::]", "0.0.0.0", ""):
+            # a bind-all address is not dialable; announce loopback and
+            # let multi-host deployments set host= to a reachable address
+            host = "127.0.0.1"
+        # instance id must be unique across the POD, not just this
+        # process: element names repeat (every pipeline calls its entry
+        # "src"), so pid+uuid disambiguates both in- and cross-process
+        self._announcement = Announcement(
+            self.props["dest-host"], self.props["dest-port"],
+            f"nns/query/{self.props['topic']}/"
+            f"{self.name}-{_os.getpid()}-{_uuid.uuid4().hex[:8]}",
+            {
+                "host": host, "port": self._core.port,
+                "connect_type": self.props["connect-type"],
+            },
+            logger=self.log,
+        )
 
     def stop(self):
+        if self._announcement is not None:
+            self._announcement.clear()
+            self._announcement = None
         if self._core is not None:
             release_query_server(self.props["id"])
             self._core = None
@@ -152,6 +201,18 @@ class TensorQueryClient(Element):
         "host": Property(str, "localhost", "server host"),
         "port": Property(int, 0, "server port"),
         "hosts": Property(str, "", "multi-server fan-out 'h1:p1,h2:p2' (round-robin)"),
+        # hybrid discovery (≙ reference connect-type=HYBRID): resolve the
+        # server set from retained announces on nns/query/<topic>/# at the
+        # MQTT broker, instead of static host/hosts — pod membership then
+        # changes on the broker, not in every client's pipeline text
+        "topic": Property(str, "", "discover servers under this topic"),
+        "dest-host": Property(str, "localhost", "MQTT broker host (discovery)"),
+        "dest-port": Property(
+            int, 0, "MQTT broker port (0 = discovery disabled)"
+        ),
+        "discovery-timeout": Property(
+            float, 5.0, "s to wait for at least one announced server"
+        ),
         "timeout": Property(float, 10.0, "per-request timeout, seconds"),
         "max-in-flight": Property(int, 8, "pipelined outstanding requests"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
@@ -191,9 +252,69 @@ class TensorQueryClient(Element):
         # considered down (skipped by round-robin; retried after cooldown)
         self._down_until: dict = {}
 
+    def _discover_targets(self) -> List[Tuple[str, int]]:
+        """Resolve the server set from retained announces under
+        nns/query/<topic>/# (shared machinery: distributed/hybrid.py),
+        transport-filtered, deduplicated, and liveness-probed — a crashed
+        server never tombstones its announce, so stale endpoints are
+        dropped here instead of failing the whole client at handshake."""
+        from ..distributed.hybrid import discover_endpoints, probe_endpoint
+
+        want_ct = self.props["connect-type"]
+
+        def validate(topic: str, info: dict) -> bool:
+            got_ct = info.get("connect_type", want_ct)
+            if got_ct != want_ct:
+                self.log.warning(
+                    "announce %s speaks %s, client wants %s (skipped)",
+                    topic, got_ct, want_ct,
+                )
+                return False
+            return True
+
+        found = discover_endpoints(
+            self.props["dest-host"], self.props["dest-port"],
+            f"nns/query/{self.props['topic']}/#",
+            timeout_s=self.props["discovery-timeout"],
+            validate=validate, logger=self.log,
+        )
+        # probe CONCURRENTLY: N stale announces must cost one probe
+        # timeout total, not N serial timeouts on the client's start path
+        candidates = sorted(set(found.values()))
+        with ThreadPoolExecutor(max_workers=max(1, len(candidates))) as ex:
+            alive = list(ex.map(
+                lambda hp: probe_endpoint(*hp), candidates
+            ))
+        targets = []
+        for (host, port), ok in zip(candidates, alive):
+            if ok:
+                targets.append((host, port))
+            else:
+                self.log.warning(
+                    "announced endpoint %s:%d not accepting (stale "
+                    "announce from a crashed server?) — skipped",
+                    host, port,
+                )
+        if not targets:
+            raise ElementError(
+                f"{self.name}: no live server announced on topic "
+                f"{self.props['topic']!r} within "
+                f"{self.props['discovery-timeout']}s"
+            )
+        return targets
+
     def start(self):
+        ct = self.props["connect-type"]
+        if ct not in ("grpc", "tcp"):
+            # validate BEFORE discovery: a typo'd connect-type must fail
+            # with this message, not filter every announce and surface as
+            # a misleading discovery timeout
+            raise ElementError(
+                f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
         targets: List[Tuple[str, int]] = []
-        if self.props["hosts"]:
+        if self.props["topic"] and self.props["dest-port"] > 0:
+            targets = self._discover_targets()
+        elif self.props["hosts"]:
             for part in self.props["hosts"].split(","):
                 part = part.strip()
                 if not part:

@@ -1,0 +1,165 @@
+"""Hybrid discovery for tensor_query (≙ reference connect-type=HYBRID:
+MQTT control plane announces endpoints, data flows directly).
+
+Servers publish retained announces under nns/query/<topic>/<instance>;
+clients resolve the server set from the broker instead of static
+host:port — pod membership changes on the broker, not in pipeline text.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.custom_easy import (
+    register_custom_easy,
+    unregister_custom_easy,
+)
+from nnstreamer_tpu.distributed.mqtt import MiniBroker
+from nnstreamer_tpu.pipeline import parse_pipeline
+from nnstreamer_tpu.pipeline.element import ElementError, make_element
+
+
+@pytest.fixture
+def broker():
+    b = MiniBroker()
+    yield b
+    b.close()
+
+
+def _server(broker, i, topic="pods"):
+    sp = parse_pipeline(
+        f"tensor_query_serversrc name=src id={10 + i} port=0 "
+        f"connect-type=tcp topic={topic} dest-host=127.0.0.1 "
+        f"dest-port={broker.port} ! "
+        "tensor_filter framework=custom-easy model=qh_double ! "
+        f"tensor_query_serversink id={10 + i}",
+        name=f"qh-server-{i}",
+    )
+    sp.start()
+    return sp
+
+
+class TestHybridDiscovery:
+    def test_client_discovers_and_round_robins_two_servers(self, broker):
+        register_custom_easy(
+            "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
+        )
+        servers = []
+        try:
+            servers = [_server(broker, i) for i in range(2)]
+            client = parse_pipeline(
+                "appsrc name=a ! "
+                f"tensor_query_client name=q topic=pods dest-host=127.0.0.1 "
+                f"dest-port={broker.port} discovery-timeout=10 "
+                "connect-type=tcp timeout=30 ! "
+                "tensor_sink name=out",
+                name="qh-client",
+            )
+            client.start()
+            # both endpoints resolved from the broker
+            assert len(client["q"]._conns) == 2
+            for i in range(8):
+                client["a"].push(np.full((4,), float(i), np.float32))
+            client["a"].end_of_stream()
+            client.wait(timeout=60)
+            got = [
+                np.asarray(f.tensors[0]) for f in client["out"].frames
+            ]
+            client.stop()
+            assert len(got) == 8
+            for i, arr in enumerate(got):
+                assert np.allclose(arr, 2.0 * i), (i, arr)
+        finally:
+            for sp in servers:
+                sp.stop()
+            unregister_custom_easy("qh_double")
+
+    def test_stopped_server_clears_retained_announce(self, broker):
+        register_custom_easy(
+            "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
+        )
+        try:
+            sp = _server(broker, 7, topic="ephemeral")
+            sp.stop()
+            # tombstoned: discovery must now time out, not dial the dead port
+            el = make_element(
+                "tensor_query_client",
+                **{"topic": "ephemeral", "dest-host": "127.0.0.1",
+                   "dest-port": broker.port, "discovery-timeout": 1.0,
+                   "connect-type": "tcp"},
+            )
+            with pytest.raises(ElementError, match="server announced"):
+                el.start()
+        finally:
+            unregister_custom_easy("qh_double")
+
+    def test_discovery_timeout_without_broker_announces(self, broker):
+        el = make_element(
+            "tensor_query_client",
+            **{"topic": "nobody-home", "dest-host": "127.0.0.1",
+               "dest-port": broker.port, "discovery-timeout": 0.5},
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ElementError, match="server announced"):
+            el.start()
+        assert time.monotonic() - t0 < 5.0
+
+    def test_stale_announce_from_crashed_server_skipped(self, broker):
+        """A crashed server never tombstones its retained announce; the
+        client's liveness probe must drop it and use the live server."""
+        import json
+
+        from nnstreamer_tpu.distributed.mqtt import MqttClient
+
+        register_custom_easy(
+            "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
+        )
+        servers = []
+        try:
+            # fake crash leftover: retained announce for a port nobody owns
+            c = MqttClient("127.0.0.1", broker.port)
+            c.publish(
+                "nns/query/mixed/crashed-1",
+                json.dumps({"host": "127.0.0.1", "port": 1,
+                            "connect_type": "tcp"}).encode(),
+                retain=True, qos=1,
+            )
+            assert c.drain(5.0) == 0
+            c.close()
+            servers = [_server(broker, 5, topic="mixed")]
+            el = make_element(
+                "tensor_query_client",
+                **{"topic": "mixed", "dest-host": "127.0.0.1",
+                   "dest-port": broker.port, "discovery-timeout": 5.0,
+                   "connect-type": "tcp"},
+            )
+            el.start()
+            try:
+                assert len(el._conns) == 1  # only the live server
+            finally:
+                el.stop()
+        finally:
+            for sp in servers:
+                sp.stop()
+            unregister_custom_easy("qh_double")
+
+    def test_connect_type_mismatch_announces_skipped(self, broker):
+        register_custom_easy(
+            "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
+        )
+        servers = []
+        try:
+            servers = [_server(broker, 3, topic="tcponly")]  # announces tcp
+            el = make_element(
+                "tensor_query_client",
+                **{"topic": "tcponly", "dest-host": "127.0.0.1",
+                   "dest-port": broker.port, "discovery-timeout": 1.0,
+                   "connect-type": "grpc"},
+            )
+            with pytest.raises(ElementError, match="server announced"):
+                el.start()
+        finally:
+            for sp in servers:
+                sp.stop()
+            unregister_custom_easy("qh_double")
