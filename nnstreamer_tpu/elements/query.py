@@ -18,6 +18,7 @@ north star: linear 1->8 chip scaling).
 from __future__ import annotations
 
 import queue as _queue
+import threading
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Deque, Iterator, List, Optional, Tuple
@@ -190,6 +191,27 @@ class TensorQueryServerSink(SinkElement):
         )
 
 
+class _PoolState:
+    """One generation of the client's connection pool.
+
+    ``conns``/``targets`` are index-aligned tuples; ``down_until`` is the
+    health map for THIS generation only (a worker that captured an older
+    state writes health marks into that retired state, never into a
+    successor where the index means a different server).  ``epoch``
+    identifies the start()-run the pool belongs to: a leftover worker
+    from a previous run can neither trigger a swap of, nor resend a dead
+    run's frame into, the new run's pool."""
+
+    __slots__ = ("conns", "targets", "gen", "epoch", "down_until")
+
+    def __init__(self, conns, targets, gen, epoch=-1):
+        self.conns = tuple(conns)
+        self.targets = tuple(targets)
+        self.gen = gen
+        self.epoch = epoch
+        self.down_until: dict = {}
+
+
 @element("tensor_query_client")
 class TensorQueryClient(Element):
     """Looks like a local filter; actually round-trips frames through remote
@@ -244,13 +266,27 @@ class TensorQueryClient(Element):
 
     def __init__(self, name=None):
         super().__init__(name)
-        self._conns: List[QueryConnection] = []
+        # connection-pool state is one immutable-per-generation snapshot
+        # (_PoolState): workers capture it ONCE per request, so an elastic
+        # pool swap can never shrink a list under a concurrent indexer or
+        # cross-wire health marks between generations
+        self._pstate = _PoolState((), (), 0)
         self._pool: Optional[ThreadPoolExecutor] = None
         self._inflight: Deque[Future] = deque()
         self._rr = 0
-        # health tracking: conn index -> monotonic time until which it is
-        # considered down (skipped by round-robin; retried after cooldown)
-        self._down_until: dict = {}
+        # topic-mode elastic recovery: swap serialization + stop guard
+        self._rediscover_lock = threading.Lock()
+        # leader election for the discovery I/O itself: one broker
+        # round-trip per failure wave, losers piggy-back on the swap
+        self._discover_leader = threading.Lock()
+        self._last_discovery_ts = float("-inf")
+        self._stopped = True
+        self._run_epoch = 0  # bumped per start(); scopes pool generations
+
+    @property
+    def _conns(self) -> tuple:
+        """Current pool's connections (tests and negotiation read this)."""
+        return self._pstate.conns
 
     def _discover_targets(self) -> List[Tuple[str, int]]:
         """Resolve the server set from retained announces under
@@ -341,34 +377,44 @@ class TensorQueryClient(Element):
                     f"{self.name}: stream=true is per-request; "
                     "wire-batch must be 1"
                 )
+        self._run_epoch += 1
+        self._pstate = _PoolState(
+            self._make_conns(targets), targets, 0, epoch=self._run_epoch
+        )
+        self._stopped = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.props["max-in-flight"])
+        )
+
+    def _make_conns(self, targets: List[Tuple[str, int]]) -> list:
+        ct = self.props["connect-type"]
         if ct == "tcp":
             from ..distributed.tcp_query import TcpQueryConnection
 
-            self._conns = [
+            return [
                 TcpQueryConnection(
                     h, p, self.props["timeout"],
                     nconns=max(1, int(self.props["max-in-flight"])),
                 ) for h, p in targets
             ]
-        elif ct == "grpc":
-            self._conns = [
-                QueryConnection(h, p, self.props["timeout"])
-                for h, p in targets
-            ]
-        else:
-            raise ElementError(
-                f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
-        self._pool = ThreadPoolExecutor(
-            max_workers=max(1, self.props["max-in-flight"])
-        )
+        return [
+            QueryConnection(h, p, self.props["timeout"])
+            for h, p in targets
+        ]
 
     def stop(self):
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
-        for c in self._conns:
+        # flag FIRST (without the lock): an in-flight rediscovery holds
+        # the lock across discovery I/O — it re-checks _stopped before
+        # swapping, so stop() never waits out a discovery timeout, and no
+        # pool can be created after stop and leak
+        self._stopped = True
+        with self._rediscover_lock:
+            ps, self._pstate = self._pstate, _PoolState((), (), 0)
+        for c in ps.conns:
             c.close()
-        self._conns = []
         self._inflight.clear()
 
     # caps handshake at negotiation time (≙ edge CAPS event exchange)
@@ -409,44 +455,186 @@ class TensorQueryClient(Element):
                 out.append((0, got))
         return out
 
-    def _healthy_order(self, first: int) -> List[int]:
-        """Conn indices starting at `first`, known-down ones (cooldown not
-        expired) pushed to the back so a hung server doesn't eat a full
-        timeout per frame."""
+    @staticmethod
+    def _healthy_order(ps: "_PoolState", first: int) -> List[int]:
+        """Conn indices of ``ps`` starting at `first`, known-down ones
+        (cooldown not expired) pushed to the back so a hung server
+        doesn't eat a full timeout per frame."""
         import time
 
         now = time.monotonic()
-        order = [(first + k) % len(self._conns) for k in range(len(self._conns))]
-        healthy = [i for i in order if self._down_until.get(i, 0) <= now]
+        order = [(first + k) % len(ps.conns) for k in range(len(ps.conns))]
+        healthy = [i for i in order if ps.down_until.get(i, 0) <= now]
         return healthy + [i for i in order if i not in healthy]
 
-    def _invoke_failover(self, frame, first: int):
+    def _rediscover(self, failed_ps: "_PoolState") -> bool:
+        """Topic mode elastic recovery: refresh the server set from the
+        broker and swap the connection pool.
+
+        ``failed_ps`` is the pool the CALLER's failures happened on: one
+        discovery per failure wave — workers whose failures predate an
+        already-completed swap piggy-back on it; a worker whose failure
+        was CAUSED by a swap (its pool is retired) or that belongs to a
+        PREVIOUS run (epoch mismatch after stop/start) never triggers a
+        cascade or a ghost resend into the new run.
+
+        All network I/O (broker discovery, conn building, caps
+        handshakes) happens OUTSIDE the swap lock so stop() and
+        concurrent workers never wait out a discovery timeout; the lock
+        only guards the pointer swap.  Endpoints unchanged across the
+        swap REUSE their live connection (a healthy server must not have
+        its channel closed under other workers' in-flight requests);
+        vanished endpoints' conns are closed (those servers are gone —
+        their requests are doomed anyway)."""
+        import time as _time
+
+        if not (self.props["topic"] and self.props["dest-port"] > 0):
+            return False
+        if self._stopped:
+            return False
+        cur = self._pstate
+        if cur.epoch != failed_ps.epoch:
+            return False  # stale worker from a previous run
+        if cur.gen != failed_ps.gen:
+            return True  # another worker already swapped this wave
+        # leader election: a whole failure wave (up to max-in-flight
+        # workers failing together) costs ONE broker discovery — losers
+        # queue here and piggy-back on the leader's swap
+        with self._discover_leader:
+            if self._stopped:
+                return False
+            cur = self._pstate
+            if cur.epoch != failed_ps.epoch:
+                return False
+            if cur.gen != failed_ps.gen:
+                return True  # the leader swapped while we waited
+            now = _time.monotonic()
+            cooldown = max(1.0, float(self.props["discovery-timeout"]))
+            if now - self._last_discovery_ts < cooldown:
+                # persistently bad pool (e.g. a hung-but-accepting
+                # server): don't convert EVERY frame's error path into a
+                # discovery stall + broker round-trip
+                return False
+            self._last_discovery_ts = now
+            try:
+                targets = self._discover_targets()
+            except (ElementError, OSError) as e:
+                # incl. an unreachable broker (correlated failure):
+                # refresh failure is non-fatal, the ORIGINAL error
+                # surfaces
+                self.log.warning("re-discovery failed: %s", e)
+                return False
+            by_ep = dict(zip(cur.targets, cur.conns))
+            spec = self.sink_specs.get(0)
+            conns, kept_targets, created = [], [], []
+            for ep in targets:
+                conn = by_ep.get(ep)
+                if conn is None:
+                    try:
+                        conn = self._make_conns([ep])[0]
+                        if spec is not None and spec.tensors:
+                            conn.handshake(spec.to_string())
+                    except Exception as e:  # noqa: BLE001 — transport
+                        self.log.warning(
+                            "replacement endpoint %s:%d unusable: %s "
+                            "(skipped)", ep[0], ep[1], e,
+                        )
+                        continue
+                    created.append(conn)
+                conns.append(conn)
+                kept_targets.append(ep)
+            if not conns:
+                self.log.warning(
+                    "re-discovery found no usable server (all handshakes "
+                    "failed)"
+                )
+                return False
+            # only the tiny pointer swap shares a lock with stop()
+            with self._rediscover_lock:
+                if self._stopped:
+                    retired, swapped = list(created), False
+                else:
+                    retired = [c for c in cur.conns if c not in conns]
+                    self._pstate = _PoolState(
+                        conns, kept_targets, cur.gen + 1, epoch=cur.epoch
+                    )
+                    swapped = True
+        if swapped:
+            self.log.info(
+                "re-discovered %d server(s): %s", len(kept_targets),
+                ",".join(f"{h}:{p}" for h, p in kept_targets),
+            )
+        for c in retired:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown of dead conns
+                pass
+        return swapped
+
+    @staticmethod
+    def _provably_unsent(err: BaseException) -> bool:
+        """True when the failure class proves the request never reached a
+        server, making a resend safe even under the at-most-once default:
+        a refused dial (tcp), or a gRPC UNAVAILABLE whose detail is a
+        connect failure (grpc wraps refused dials in RpcError)."""
+        if isinstance(err, ConnectionRefusedError):
+            return True
+        try:
+            import grpc
+
+            if isinstance(err, grpc.RpcError):
+                code = getattr(err, "code", lambda: None)()
+                detail = str(getattr(err, "details", lambda: "")()).lower()
+                return code == grpc.StatusCode.UNAVAILABLE and (
+                    "connection refused" in detail
+                    or "failed to connect" in detail
+                )
+        except ImportError:  # pragma: no cover
+            pass
+        return False
+
+    def _invoke_failover(self, frame, first: int, rediscovered: bool = False):
         """One request: try the assigned (healthy-first) server, fail over
         round-robin to the others, `retries` extra attempts total.
-        ``frame`` may be a list (wire micro-batch) -> list comes back."""
+        ``frame`` may be a list (wire micro-batch) -> list comes back.
+
+        Topic mode: when every attempt fails, the server set is refreshed
+        from the broker (pod membership may have changed) and the request
+        retried ONCE against the new pool — but only when the failure
+        class proves the request never reached a server or the user opted
+        into at-least-once via retries>0; a timed-out request may have
+        been ingested and must not silently re-execute."""
         import time
 
+        ps = self._pstate  # ONE snapshot: swaps never shrink our indices
+        if not ps.conns:
+            raise RuntimeError(f"{self.name}: no connections (stopped?)")
         attempts = 1 + max(0, self.props["retries"])
         timeout = self.props["timeout"]
-        order = self._healthy_order(first)
+        order = self._healthy_order(ps, first)
         err: Optional[BaseException] = None
         for k in range(attempts):
             i = order[k % len(order)]
-            conn = self._conns[i]
+            conn = ps.conns[i]
             try:
                 if isinstance(frame, list):
                     result = conn.invoke_batch(frame, timeout)
                 else:
                     result = conn.invoke(frame, timeout)
-                self._down_until.pop(i, None)
+                ps.down_until.pop(i, None)
                 return result
             except Exception as e:  # noqa: BLE001 — transport boundary
                 err = e
-                self._down_until[i] = time.monotonic() + timeout
+                ps.down_until[i] = time.monotonic() + timeout
                 self.log.warning(
                     "query to %s failed (attempt %d/%d): %s",
                     conn.addr, k + 1, attempts, e,
                 )
+        safe_to_resend = (
+            self.props["retries"] > 0 or self._provably_unsent(err)
+        )
+        if not rediscovered and self._rediscover(ps) and safe_to_resend:
+            return self._invoke_failover(frame, first, rediscovered=True)
         raise err  # all attempts failed -> surfaced on the bus
 
     _DRAIN_EVENT = "_nns_query_drain"
@@ -503,14 +691,20 @@ class TensorQueryClient(Element):
             return self._dispatch(frames[0])
         return self._dispatch(list(frames))
 
-    def _stream_invoke(self, frame):
+    def _stream_invoke(self, frame, rediscovered: bool = False):
         """One server-streaming request: healthy-first server order, whole
         streams fail over only BEFORE the first answer arrives (a stream
         broken mid-way surfaces as an error — replaying half a generation
-        could duplicate tokens at the consumer)."""
+        could duplicate tokens at the consumer).  Topic mode recovers
+        elastically like the unary path: pre-first-answer failure of all
+        attempts refreshes the pool and retries once under the same
+        resend-safety contract."""
         import time as _time
 
-        order = self._healthy_order(self._rr % len(self._conns))
+        ps = self._pstate  # snapshot (same contract as _invoke_failover)
+        if not ps.conns:
+            raise RuntimeError(f"{self.name}: no connections (stopped?)")
+        order = self._healthy_order(ps, self._rr % len(ps.conns))
         self._rr += 1
         # retries=0 means SINGLE attempt: a request the server may already
         # have ingested must not be silently re-executed elsewhere unless
@@ -520,12 +714,12 @@ class TensorQueryClient(Element):
         timeout = self.props["timeout"]
         err: Optional[BaseException] = None
         for i in order[:attempts]:
-            conn = self._conns[i]
+            conn = ps.conns[i]
             started = False
             try:
                 for ans in conn.invoke_stream(frame, timeout):
                     started = True
-                    self._down_until.pop(i, None)
+                    ps.down_until.pop(i, None)
                     yield (0, ans)
                 return
             except Exception as e:  # noqa: BLE001 — transport boundary
@@ -534,17 +728,22 @@ class TensorQueryClient(Element):
                 err = e
                 # short cooldown: the stream timeout is minutes-scale (a
                 # whole generation), not a health signal
-                self._down_until[i] = _time.monotonic() + min(
+                ps.down_until[i] = _time.monotonic() + min(
                     float(timeout), 10.0
                 )
                 self.log.warning(
                     "stream to %s failed before first answer: %s",
                     conn.addr, e,
                 )
+        if err is not None and not rediscovered:
+            safe = self.props["retries"] > 0 or self._provably_unsent(err)
+            if self._rediscover(ps) and safe:
+                yield from self._stream_invoke(frame, rediscovered=True)
+                return
         raise err if err is not None else RuntimeError("no servers")
 
     def _dispatch(self, frame_or_batch):
-        first = self._rr % len(self._conns)
+        first = self._rr % max(1, len(self._pstate.conns))
         self._rr += 1
         fut = self._pool.submit(self._invoke_failover, frame_or_batch, first)
         fut.add_done_callback(self._notify_done)

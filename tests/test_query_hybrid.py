@@ -144,6 +144,57 @@ class TestHybridDiscovery:
                 sp.stop()
             unregister_custom_easy("qh_double")
 
+    def test_elastic_rediscovery_after_pod_replacement(self, broker):
+        """The pod's only server dies and a REPLACEMENT (different port)
+        announces on the same topic: a topic-mode client must refresh
+        from the broker mid-stream and deliver on the new server —
+        elastic recovery as a broker-membership change.
+
+        retries=1 opts into at-least-once: a request that died with the
+        old server cannot be PROVEN un-ingested (socket closed mid-
+        receive), so re-execution on the new pod requires the same opt-in
+        as ordinary failover.  Without it the topology still refreshes,
+        but the in-flight request surfaces its error."""
+        register_custom_easy(
+            "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
+        )
+        old = new = None
+        try:
+            old = _server(broker, 30, topic="elastic")
+            client = parse_pipeline(
+                "appsrc name=a ! "
+                f"tensor_query_client name=q topic=elastic retries=1 "
+                f"dest-host=127.0.0.1 dest-port={broker.port} "
+                "discovery-timeout=10 connect-type=tcp timeout=5 ! "
+                "tensor_sink name=out",
+                name="qh-elastic",
+            )
+            client.start()
+            client["a"].push(np.full((4,), 1.0, np.float32))
+            deadline = time.monotonic() + 30
+            while (
+                len(client["out"].frames) < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert len(client["out"].frames) == 1
+            # pod replacement: old dies (tombstoned), new announces
+            old.stop()
+            old = None
+            new = _server(broker, 31, topic="elastic")
+            client["a"].push(np.full((4,), 3.0, np.float32))
+            client["a"].end_of_stream()
+            client.wait(timeout=60)
+            got = [np.asarray(f.tensors[0]) for f in client["out"].frames]
+            client.stop()
+            assert len(got) == 2, got
+            assert np.allclose(got[1], 6.0)
+        finally:
+            for sp in (old, new):
+                if sp is not None:
+                    sp.stop()
+            unregister_custom_easy("qh_double")
+
     def test_connect_type_mismatch_announces_skipped(self, broker):
         register_custom_easy(
             "qh_double", lambda xs: [np.asarray(xs[0]) * 2.0]
