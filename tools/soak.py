@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""Endurance soak: three concurrent pipelines under sustained load.
+"""Endurance soak: five concurrent pipelines under sustained load.
 
 Runs (for SOAK_MINUTES, default 20):
   * an in-process jax-xla inference pipeline (micro-batched, dispatch
     window active) fed continuously;
+  * a block-ingest (BatchFrame) variant of the same;
   * an MQTT QoS-1 leg through the in-repo broker with a broker
-    kill+rebind every ~2 minutes;
+    kill+rebind every ~SOAK_KILL_S seconds;
   * a raw-TCP query offload leg (echo server subprocess) with wire
-    batching.
+    batching;
+  * an ELASTIC hybrid-query leg: topic-discovered server pod, blue-green
+    HARD-killed and replaced every ~SOAK_KILL_S — the client must ride
+    stale-announce probing + re-discovery + retries=1 resend.
 
-Asserts across the whole run: no frame loss on the lossless legs
-(at-least-once on MQTT, exactly-once in-proc/tcp), thread population
-returns to baseline, native pool balanced.  Writes one JSON artifact
-(default SOAK.json) with per-leg frame counts and rates.
+Asserts: no frame loss on the lossless legs (exactly-once in-proc/tcp,
+at-least-once distinct on MQTT), PROGRESS after every pod replacement on
+the elastic leg (at-least-once across a replacement window is not
+provably lossless — losses are REPORTED, not asserted zero), thread
+population back to baseline.  Writes one JSON artifact (default
+SOAK.json) with per-leg counts/rates.
 
 ≙ the reference's soak/longevity practice (SSAT repeated pipelines,
 gst leak checks) — condensed into one self-checking harness.
@@ -145,7 +151,10 @@ def main() -> int:
         mqtt_state["pushed"] = i
 
     # -- leg 3: raw-TCP query offload ---------------------------------------
-    server_script = f"""
+    # ONE echo-server template serves both query legs (static and
+    # elastic); only the serversrc properties differ
+    def _query_server_script(src_props: str) -> str:
+        return f"""
 import sys; sys.path.insert(0, {ROOT!r})
 import jax; jax.config.update("jax_platforms", "cpu")
 import numpy as np, time
@@ -153,20 +162,29 @@ from nnstreamer_tpu.backends.custom_easy import register_custom_easy
 from nnstreamer_tpu.pipeline import parse_pipeline
 register_custom_easy("soak_echo", lambda xs: [np.asarray(xs[0])])
 pipe = parse_pipeline(
-    "tensor_query_serversrc name=src port=0 connect-type=tcp ! "
+    "tensor_query_serversrc name=src port=0 connect-type=tcp {src_props} ! "
     "tensor_filter framework=custom-easy model=soak_echo ! "
     "tensor_query_serversink")
 pipe.start()
 print("PORT", pipe["src"].props["port"], flush=True)
 time.sleep({minutes * 60 + 120})
 """
+
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("XLA_FLAGS", None)
-    srv = subprocess.Popen([sys.executable, "-c", server_script],
-                           stdout=subprocess.PIPE, text=True, env=env)
-    line = srv.stdout.readline()
-    assert line.startswith("PORT "), line
-    qport = int(line.split()[1])
+
+    def _spawn_query_server(src_props: str):
+        p = subprocess.Popen(
+            [sys.executable, "-c", _query_server_script(src_props)],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        line = p.stdout.readline()
+        assert line.startswith("PORT "), (
+            f"query server died during startup: {line!r}"
+        )
+        return p, int(line.split()[1])
+
+    srv, qport = _spawn_query_server("")
     qcli = parse_pipeline(
         f"appsrc name=src max-buffers=128 ! "
         f"tensor_query_client port={qport} connect-type=tcp timeout=30 "
@@ -189,8 +207,83 @@ time.sleep({minutes * 60 + 120})
             time.sleep(0.005)
         q_count["pushed"] = i
 
+    # -- leg 4: elastic hybrid query (pod replacement under load) -----------
+    # A STABLE discovery broker (the chaos broker above loses retained
+    # announces on kill — servers would have to re-announce); servers are
+    # HARD-killed (no tombstone) and respawned on fresh ports every
+    # kill_s, so the client must ride stale-announce probing + topic
+    # re-discovery + at-least-once resend (retries=1) across every
+    # replacement.  Success = continued delivery after each replacement;
+    # a brief pod-down window may drop in-flight requests (at-least-once
+    # is not lossless when NO server exists), so the assertion is
+    # progress, not zero-loss.
+    disc_broker = MiniBroker()
+
+    def spawn_elastic_server():
+        p, _port = _spawn_query_server(
+            f"topic=soak-elastic dest-host=127.0.0.1 "
+            f"dest-port={disc_broker.port}"
+        )
+        return p
+
+    e_state = {"srv": None, "replacements": 0, "progress": []}
+    e_count = {"n": 0}
+
+    def elastic_feeder():
+        ecli = None
+        try:
+            # setup INSIDE the try: a spawn/start crash must land in
+            # `errors`, not die silently on a daemon thread
+            e_state["srv"] = spawn_elastic_server()
+            ecli = parse_pipeline(
+                "appsrc name=src max-buffers=64 ! "
+                "tensor_query_client topic=soak-elastic dest-host=127.0.0.1 "
+                f"dest-port={disc_broker.port} discovery-timeout=15 "
+                "retries=1 connect-type=tcp timeout=10 ! "
+                "tensor_sink name=out max-stored=1")
+            ecli.start()
+            ecli["out"].connect_new_data(
+                lambda f: e_count.__setitem__("n", e_count["n"] + 1))
+            i = 0
+            last_kill = time.monotonic()
+            e_state["active_from"] = last_kill  # post-setup: spawn+start
+            payload = np.zeros((512,), np.float32)
+            while time.monotonic() < deadline:
+                try:
+                    ecli["src"].push(payload)
+                    i += 1
+                    if time.monotonic() - last_kill > kill_s:
+                        # blue-green pod replacement: the NEW server is
+                        # announced BEFORE the old is HARD-killed (its
+                        # stale announce stays — probing must skip it);
+                        # in-flight requests on the old server fail and
+                        # ride re-discovery + retries=1 resend
+                        before = e_count["n"]
+                        new_srv = spawn_elastic_server()
+                        e_state["srv"].kill()
+                        e_state["srv"].wait(timeout=10)
+                        e_state["srv"] = new_srv
+                        e_state["replacements"] += 1
+                        e_state["progress"].append(before)
+                        last_kill = time.monotonic()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("elastic", repr(e)))
+                    return
+                time.sleep(0.02)
+            e_count["pushed"] = i
+            e_state["active_s"] = time.monotonic() - e_state["active_from"]
+            ecli["src"].end_of_stream()
+            ecli.wait(timeout=120)
+            e_count["final"] = e_count["n"]
+        except Exception as e:  # noqa: BLE001 — setup/teardown failures
+            errors.append(("elastic", repr(e)))
+        finally:
+            if ecli is not None:
+                ecli.stop()
+
     feeders = [threading.Thread(target=f, daemon=True)
-               for f in (infer_feeder, blk_feeder, mqtt_feeder, query_feeder)]
+               for f in (infer_feeder, blk_feeder, mqtt_feeder, query_feeder,
+                         elastic_feeder)]
     t0 = time.monotonic()
     for t in feeders:
         t.start()
@@ -200,6 +293,7 @@ time.sleep({minutes * 60 + 120})
         print(f"[soak] {el/60:5.1f}m  infer={infer_count['n']} "
               f"block={blk_count['n']} "
               f"mqtt={len(mqtt_seen)} query={q_count['n']} "
+              f"elastic={e_count['n']}/{e_state['replacements']}repl "
               f"errors={len(errors)}", flush=True)
 
     # drain: EOS every leg, bounded waits
@@ -231,6 +325,10 @@ time.sleep({minutes * 60 + 120})
     mqtt_state["broker"].close()
     srv.kill()
     srv.wait(timeout=10)
+    if e_state["srv"] is not None:
+        e_state["srv"].kill()
+        e_state["srv"].wait(timeout=10)
+    disc_broker.close()
 
     # leak check
     leak_deadline = time.time() + 30
@@ -264,6 +362,24 @@ time.sleep({minutes * 60 + 120})
             "tcp_query": {"pushed": q_count.get("pushed"),
                           "delivered": q_done,
                           "fps": round(q_done / dt, 1)},
+            "elastic_hybrid": {
+                "pushed": e_count.get("pushed"),
+                "delivered": e_count.get("final", e_count["n"]),
+                "replacements": e_state["replacements"],
+                # at-least-once across replacement windows: losses and
+                # resend duplicates are REPORTED, not asserted away
+                "lost": max(
+                    0,
+                    (e_count.get("pushed") or 0)
+                    - e_count.get("final", e_count["n"]),
+                ),
+                "duplicates": max(
+                    0,
+                    e_count.get("final", e_count["n"])
+                    - (e_count.get("pushed") or 0),
+                ),
+                "progress_at_kill": e_state["progress"],
+            },
         },
         "errors": errors,
         "leaked_threads": [t.name for t in leaked],
@@ -271,7 +387,23 @@ time.sleep({minutes * 60 + 120})
                and unacked == 0
                and infer_done == infer_count.get("pushed")
                and blk_done == blk_count.get("pushed")
-               and q_done == q_count.get("pushed")),
+               and q_done == q_count.get("pushed")
+               # elastic leg contract = PROGRESS through replacements
+               # (delivery strictly advances between consecutive kills
+               # and after the last one), plus at least one replacement
+               # whenever the leg's ACTIVE window (post-setup — the
+               # server subprocess import can eat a short run's budget)
+               # was long enough to schedule one
+               and e_count.get("final", 0) > 0
+               and (e_state.get("active_s", 0) < kill_s
+                    or e_state["replacements"] >= 1)
+               and all(
+                   b > a for a, b in zip(
+                       e_state["progress"],
+                       e_state["progress"][1:]
+                       + [e_count.get("final", 0)],
+                   )
+               )),
     }
     with open(out_path, "w") as f:
         json.dump(result, f, indent=2)
