@@ -148,7 +148,7 @@ def start_host_copies(tensors: Sequence[Any]) -> None:
         if start is not None:
             try:
                 start()
-            except Exception:
+            except Exception:  # allow-silent: prefetch hint only
                 pass  # stale/donated buffer: np.asarray later decides
 
 

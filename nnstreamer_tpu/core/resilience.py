@@ -1,0 +1,524 @@
+"""Resilience primitives: retry policies, circuit breakers, error
+classification, and deterministic fault injection.
+
+Reference analog: NNStreamer's always-on deployments survive flaky
+cameras, dropped offload links, and bad frames (the query elements'
+timeout/retry knobs, nnstreamer-edge reconnect logic).  The reproduction
+was strictly fail-stop before this module; these primitives are shared
+by the pipeline supervisor (``pipeline/pipeline.py``), the query client
+(``elements/query.py``), and the raw-TCP transports
+(``distributed/tcp_query.py``).
+
+Design rules:
+
+* **Injectable time.** Every time-dependent class takes ``clock`` (and
+  ``sleep`` where it blocks) so tests run on a fake clock — tier-1 must
+  never wait out a real backoff.
+* **Deterministic jitter.** Jitter comes from a seedable
+  ``random.Random``, never the process-global RNG.
+* **Zero hot-path cost when idle.** ``FaultInjector.check`` is a plain
+  dict lookup guarded by one bool; un-armed sites cost ~nothing.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from .log import get_logger
+
+log = get_logger("resilience")
+
+
+# ---------------------------------------------------------------------------
+# Transient-vs-fatal error classification
+# ---------------------------------------------------------------------------
+# Transient: the operation may succeed if simply re-tried (network blips,
+# timeouts, resource exhaustion).  Fatal: retrying cannot help (bad
+# arguments, schema mismatches, programming errors) — retry loops must
+# fail fast instead of burning their deadline budget on them.
+_TRANSIENT_TYPES: Tuple[Type[BaseException], ...] = (
+    ConnectionError,
+    TimeoutError,
+    InterruptedError,
+    BrokenPipeError,
+    OSError,  # includes socket.timeout/socket.error
+)
+_FATAL_TYPES: Tuple[Type[BaseException], ...] = (
+    TypeError,
+    ValueError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    NotImplementedError,
+)
+
+
+class TransientError(RuntimeError):
+    """Raise (or wrap with) this to force transient classification."""
+
+
+class FatalError(RuntimeError):
+    """Raise (or wrap with) this to force fatal classification."""
+
+
+class RemoteApplicationError(RuntimeError):
+    """The remote ANSWERED — with an application-level error reply.
+
+    The round trip itself succeeded, so this must never count against
+    the remote's health (circuit breakers, down-cooldowns): a stream of
+    poison frames must not trip a breaker open against a healthy
+    server."""
+
+
+def is_remote_application_error(err: BaseException) -> bool:
+    """True when the failure is an application-level reply from a live
+    server (transport worked), as opposed to a connectivity/timeout
+    fault.  Health machinery (breakers, cooldowns) must ignore these."""
+    if isinstance(err, RemoteApplicationError):
+        return True
+    try:
+        import grpc
+
+        if isinstance(err, grpc.RpcError):
+            code = getattr(err, "code", lambda: None)()
+            # a status the server DECIDED to send ≠ a dead server
+            return code not in (
+                None,
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                grpc.StatusCode.CANCELLED,
+            )
+    except ImportError:  # pragma: no cover — grpc is a baked-in dep
+        pass
+    return False
+
+
+def register_transient(*types: Type[BaseException]) -> None:
+    """Extend the transient set (e.g. a transport's own error type)."""
+    global _TRANSIENT_TYPES
+    _TRANSIENT_TYPES = _TRANSIENT_TYPES + tuple(types)
+
+
+def register_fatal(*types: Type[BaseException]) -> None:
+    global _FATAL_TYPES
+    _FATAL_TYPES = _FATAL_TYPES + tuple(types)
+
+
+def is_transient(err: BaseException) -> bool:
+    """Best-effort classification; unknown exception types default to
+    transient (an always-on pipeline prefers one wasted retry over a
+    dropped stream), except the known-fatal program-error set."""
+    if not isinstance(err, Exception):
+        return False  # KeyboardInterrupt/SystemExit/GeneratorExit: never retry
+    if isinstance(err, FatalError):
+        return False
+    if isinstance(err, TransientError):
+        return True
+    # explicit marker wins over the type tables (a transport can stamp
+    # an exception it re-raises without subclassing)
+    marked = getattr(err, "nns_transient", None)
+    if marked is not None:
+        return bool(marked)
+    # gRPC: UNAVAILABLE / DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED retry;
+    # INVALID_ARGUMENT / UNIMPLEMENTED etc. do not
+    try:
+        import grpc
+
+        if isinstance(err, grpc.RpcError):
+            code = getattr(err, "code", lambda: None)()
+            return code in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                grpc.StatusCode.ABORTED,
+            )
+    except ImportError:  # pragma: no cover — grpc is a baked-in dep
+        pass
+    if isinstance(err, _FATAL_TYPES):
+        return False
+    if isinstance(err, _TRANSIENT_TYPES):
+        return True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter under a total deadline budget.
+
+    ``max_attempts`` bounds tries (first call included); ``deadline_s``
+    bounds the *total* wall time spent inside :meth:`call` — a retry
+    whose backoff would overrun the budget is not taken.  ``jitter`` is
+    the +/- fraction applied to each delay (0.25 = 25%), drawn from a
+    seedable RNG for reproducible tests.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    deadline_s: Optional[float] = None
+    classify: Callable[[BaseException], bool] = field(default=is_transient)
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retry #`attempt` (1-based: after the first
+        failure attempt=1)."""
+        raw = min(
+            self.max_delay_s,
+            self.base_delay_s * (self.multiplier ** max(0, attempt - 1)),
+        )
+        if self.jitter:
+            raw *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, raw)
+
+    def call(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        **kwargs: Any,
+    ) -> Any:
+        """Run ``fn`` under this policy.  ``on_retry(attempt, err,
+        delay)`` fires before each backoff; fatal errors and budget
+        exhaustion re-raise the last error immediately."""
+        start = clock()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except (KeyboardInterrupt, SystemExit, GeneratorExit):
+                raise  # interrupts must never be absorbed into a retry
+            except BaseException as e:  # noqa: BLE001 — policy boundary
+                attempt += 1
+                if not self.classify(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.delay_for(attempt)
+                if self.deadline_s is not None:
+                    elapsed = clock() - start
+                    if elapsed + delay >= self.deadline_s:
+                        raise
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                if delay > 0:
+                    sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+class CircuitOpenError(ConnectionError):
+    """Raised by :meth:`CircuitBreaker.call` while the circuit is open.
+
+    Subclasses ConnectionError so existing transport-boundary handlers
+    (and :func:`is_transient`) treat a tripped breaker as a transient,
+    fail-fast condition."""
+
+
+class CircuitBreaker:
+    """Classic closed / open / half-open breaker on a rolling window.
+
+    * **closed**: calls flow; failures are timestamped into a rolling
+      ``window_s`` deque — reaching ``failure_threshold`` failures
+      inside the window trips the breaker open.
+    * **open**: calls are refused (``allow()`` False /
+      :class:`CircuitOpenError`) until ``reset_timeout_s`` passes.
+    * **half-open**: up to ``half_open_max`` probe calls are let
+      through; one success closes the breaker (and clears the window),
+      one failure re-opens it for another ``reset_timeout_s``.
+
+    Thread-safe; ``clock`` is injectable for fake-clock tests.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        window_s: float = 30.0,
+        reset_timeout_s: float = 5.0,
+        half_open_max: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "",
+    ):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.window_s = float(window_s)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_max = max(1, int(half_open_max))
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures: List[float] = []
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probes = 0
+        self._last_probe_at = 0.0
+        self._trips = 0  # lifetime count of closed/half-open -> open
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._peek_state()
+
+    @property
+    def trip_count(self) -> int:
+        with self._lock:
+            return self._trips
+
+    def _peek_state(self) -> str:
+        # lock held: open lazily decays into half-open on inspection
+        now = self._clock()
+        if self._state == self.OPEN:
+            if now - self._opened_at >= self.reset_timeout_s:
+                self._state = self.HALF_OPEN
+                self._probes = 0
+        elif (
+            self._state == self.HALF_OPEN
+            and self._probes >= self.half_open_max
+            and now - self._last_probe_at >= self.reset_timeout_s
+        ):
+            # a probe slot was reserved but its outcome never recorded
+            # (caller abandoned mid-call — e.g. a generator torn down by
+            # pipeline stop): self-heal by opening a new probe window
+            # instead of staying wedged half-open forever
+            self._probes = 0
+        return self._state
+
+    def allow(self) -> bool:
+        """True if a call may proceed now (reserves a probe slot while
+        half-open)."""
+        with self._lock:
+            st = self._peek_state()
+            if st == self.CLOSED:
+                return True
+            if st == self.HALF_OPEN and self._probes < self.half_open_max:
+                self._probes += 1
+                self._last_probe_at = self._clock()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures.clear()
+            if self._state != self.CLOSED:
+                log.info("breaker %s: closed (probe succeeded)", self.name)
+            self._state = self.CLOSED
+            self._probes = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            now = self._clock()
+            st = self._peek_state()
+            if st == self.HALF_OPEN and self._probes > 0:
+                # a granted probe failed: straight back to open.  With no
+                # probe outstanding this is a STALE in-flight failure
+                # (request older than the open window, e.g. a timeout
+                # longer than reset_timeout) — it falls through to plain
+                # window accounting instead of re-opening and bumping
+                # trip_count for a probe that never ran.
+                self._state = self.OPEN
+                self._opened_at = now
+                self._probes = 0
+                self._trips += 1
+                log.warning("breaker %s: re-opened (probe failed)", self.name)
+                return
+            self._failures.append(now)
+            cutoff = now - self.window_s
+            self._failures = [t for t in self._failures if t >= cutoff]
+            if (
+                st == self.CLOSED
+                and len(self._failures) >= self.failure_threshold
+            ):
+                self._state = self.OPEN
+                self._opened_at = now
+                self._trips += 1
+                log.warning(
+                    "breaker %s: OPEN (%d failures in %.1fs)",
+                    self.name, len(self._failures), self.window_s,
+                )
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit {self.name or 'breaker'} is {self.state}"
+            )
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._peek_state(),
+                "recent_failures": len(self._failures),
+                "trips": self._trips,
+            }
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+class _FaultPlan:
+    """One armed site: decides per-invocation whether to raise."""
+
+    def __init__(
+        self,
+        exc: Any = None,
+        rate: float = 0.0,
+        times: Optional[int] = None,
+        after: int = 0,
+        every: Optional[int] = None,
+        seed: int = 0,
+        callback: Optional[Callable[[int], Optional[BaseException]]] = None,
+    ):
+        self.exc = exc if exc is not None else TransientError("injected fault")
+        self.rate = float(rate)
+        self.times = times  # max number of faults to fire (None = forever)
+        self.after = int(after)  # skip the first N invocations
+        self.every = every  # fire on every Nth invocation (deterministic)
+        self.callback = callback
+        self._rng = random.Random(seed)
+        self.calls = 0
+        self.fired = 0
+
+    def decide(self) -> Optional[BaseException]:
+        i = self.calls
+        self.calls += 1
+        if self.callback is not None:
+            err = self.callback(i)
+            if err is not None:
+                self.fired += 1
+            return err
+        if i < self.after:
+            return None
+        if self.times is not None and self.fired >= self.times:
+            return None
+        hit = (
+            ((i - self.after) % self.every == 0) if self.every
+            else (self._rng.random() < self.rate)
+        )
+        if not hit:
+            return None
+        self.fired += 1
+        exc = self.exc
+        if isinstance(exc, type):
+            return exc("injected fault")
+        try:
+            # fresh instance per fire: concurrent raisers of ONE shared
+            # instance would cross-contaminate __traceback__/__context__
+            return type(exc)(*exc.args)
+        except Exception:  # exotic ctor signature: fall back to sharing
+            return exc
+
+
+class FaultInjector:
+    """Process-wide registry of named fault sites.
+
+    Production code sprinkles ``FAULTS.check("tcp_query.send")`` at
+    interesting boundaries; the check is a no-op until a test *arms*
+    the site::
+
+        FAULTS.arm("tcp_query.send", rate=0.3, seed=7,
+                   exc=ConnectionResetError)
+        ...
+        FAULTS.reset()   # in teardown, always
+
+    Determinism: rate-based plans draw from their own seeded RNG, and
+    ``every=N`` fires on exactly every Nth invocation — two runs with
+    the same seed inject the same fault sequence.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: Dict[str, _FaultPlan] = {}
+        self._armed = False  # one-bool fast path for un-instrumented runs
+
+    def arm(
+        self,
+        site: str,
+        exc: Any = None,
+        rate: float = 1.0,
+        times: Optional[int] = None,
+        after: int = 0,
+        every: Optional[int] = None,
+        seed: int = 0,
+        callback: Optional[Callable[[int], Optional[BaseException]]] = None,
+    ) -> None:
+        """Arm `site`.  ``exc`` may be an exception instance or class;
+        ``rate`` is the per-invocation fault probability (1.0 = always),
+        ``every=N`` switches to strictly periodic injection, ``after``
+        skips the first invocations, ``times`` caps total faults, and
+        ``callback(i)`` takes full control (return an exception or
+        None)."""
+        with self._lock:
+            self._plans[site] = _FaultPlan(
+                exc=exc, rate=rate, times=times, after=after,
+                every=every, seed=seed, callback=callback,
+            )
+            self._armed = True
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._plans.pop(site, None)
+            self._armed = bool(self._plans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._plans.clear()
+            self._armed = False
+
+    def is_armed(self) -> bool:
+        """Fast gate for call sites whose site NAME is costly to build
+        (f-strings on per-frame paths): skip check() entirely when no
+        plan is armed."""
+        return self._armed
+
+    def check(self, site: str) -> None:
+        """Raise the planned fault for `site`, if armed (hot-path no-op
+        otherwise)."""
+        if not self._armed:
+            return
+        with self._lock:
+            plan = self._plans.get(site)
+            if plan is None:
+                return
+            err = plan.decide()
+        if err is not None:
+            log.debug("fault injected at %s: %r", site, err)
+            raise err
+
+    def stats(self, site: str) -> Dict[str, int]:
+        """{calls, fired} counters for an armed (or just-disarmed) site;
+        zeros if never armed."""
+        with self._lock:
+            plan = self._plans.get(site)
+            if plan is None:
+                return {"calls": 0, "fired": 0}
+            return {"calls": plan.calls, "fired": plan.fired}
+
+    def armed_sites(self) -> List[str]:
+        with self._lock:
+            return sorted(self._plans)
+
+
+#: the process-wide injector every instrumented site consults
+FAULTS = FaultInjector()

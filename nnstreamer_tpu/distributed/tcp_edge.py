@@ -23,6 +23,7 @@ import threading
 from typing import Dict, Iterator, List, Optional
 
 from ..core.log import get_logger
+from ..core.resilience import FAULTS
 
 log = get_logger("tcp_edge")
 
@@ -103,10 +104,14 @@ class TcpEdgeServer:
         delivered, dead = 0, []
         for sock, wlock in targets:
             try:
+                FAULTS.check("tcp_edge.publish")
                 with wlock:
                     sock.sendall(header + payload)
                 delivered += 1
             except (socket.timeout, OSError):
+                # audit contract: a subscriber whose send failed is
+                # evicted and closed below — never kept for the next
+                # publish (a wedged peer would stall every fan-out)
                 dead.append((sock, wlock))
         if dead:
             with self._lock:
@@ -162,16 +167,21 @@ class TcpEdgeSubscriber:
     def payloads(self, idle_timeout: Optional[float] = None
                  ) -> Iterator[bytes]:
         """Yield raw frame payloads until the publisher hangs up (or
-        `idle_timeout` seconds pass without one)."""
+        `idle_timeout` seconds pass without one).  The socket is closed
+        when the stream ends for any reason — a broken stream must not
+        park a dead fd on the subscriber until GC."""
         self._sock.settimeout(idle_timeout)
-        while not self._closed:
-            try:
-                (plen,) = _LEN.unpack(_read_exact(self._sock, _LEN.size))
-                if plen > _MAX_FRAME:
-                    raise ConnectionError("absurd frame length")
-                yield _read_exact(self._sock, plen)
-            except (ConnectionError, OSError):
-                return
+        try:
+            while not self._closed:
+                try:
+                    (plen,) = _LEN.unpack(_read_exact(self._sock, _LEN.size))
+                    if plen > _MAX_FRAME:
+                        raise ConnectionError("absurd frame length")
+                    yield _read_exact(self._sock, plen)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            self.close()
 
     def close(self) -> None:
         self._closed = True

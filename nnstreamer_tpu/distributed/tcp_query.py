@@ -39,6 +39,7 @@ from typing import List, Optional, Tuple
 
 from ..core.buffer import TensorFrame
 from ..core.log import get_logger
+from ..core.resilience import FAULTS, RemoteApplicationError
 from .wire import (
     WireError,
     decode_frame,
@@ -135,13 +136,30 @@ class TcpQueryConnection:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
-    def _checkout(self, timeout: float) -> socket.socket:
+    def _checkout(self, timeout: float,
+                  fresh: bool = False) -> Tuple[socket.socket, bool]:
+        """Returns ``(sock, reused)`` — `reused` means the socket came
+        from the idle pool and may have gone stale while parked (the
+        peer can close an idle connection at any time); `_roundtrip`
+        uses it to decide whether a send-phase failure merits one
+        fresh-dial retry.  ``fresh=True`` (the retry) guarantees a NEW
+        dial: the idle pool is drained and closed first — a send failure
+        on one parked socket means the peer restarted, so every other
+        parked socket is equally suspect."""
         with self._cv:
             while True:
                 if self._closed:
                     raise ConnectionError("connection closed")
-                if self._free:
-                    return self._free.pop()
+                if fresh:
+                    while self._free:
+                        stale = self._free.pop()
+                        self._live -= 1
+                        try:
+                            stale.close()
+                        except OSError:
+                            pass
+                elif self._free:
+                    return self._free.pop(), True
                 if self._live < self._nconns:
                     self._live += 1
                     break
@@ -149,7 +167,7 @@ class TcpQueryConnection:
                     raise TimeoutError(
                         f"no free connection to {self.addr} in {timeout}s")
         try:
-            return self._connect()
+            return self._connect(), False
         except Exception:
             with self._cv:
                 self._live -= 1
@@ -170,23 +188,51 @@ class TcpQueryConnection:
 
     def _roundtrip(self, mtype: int, parts: List,
                    timeout: Optional[float]) -> Tuple[int, memoryview]:
+        """One request/response exchange.
+
+        Failure contract (audited — see Documentation/resilience.md):
+        a socket that raised during send OR recv is closed and evicted
+        from the pool (``broken=True`` checkin), never handed to the
+        next caller.  A send-phase failure on a REUSED socket gets one
+        retry on a fresh dial: an idle pooled connection the peer
+        half-closed fails exactly there, and an incompletely-sent
+        request provably never executed server-side, so the resend is
+        safe even at-most-once.  Recv-phase failures are never retried
+        here — the server may already have processed the request; the
+        caller's retry policy owns that decision."""
         timeout = self._timeout if timeout is None else timeout
-        sock = self._checkout(timeout)
-        broken = True
-        try:
-            sock.settimeout(timeout)
-            _send_msg(sock, mtype, parts, deadline_s=timeout)
-            rtype, body, _ = _recv_msg(sock)
-            broken = False
-            return rtype, body
-        finally:
-            self._checkin(sock, broken)
+        for attempt in (0, 1):
+            sock, reused = self._checkout(timeout, fresh=(attempt == 1))
+            broken = True
+            sent = False
+            try:
+                sock.settimeout(timeout)
+                FAULTS.check("tcp_query.send")
+                _send_msg(sock, mtype, parts, deadline_s=timeout)
+                sent = True
+                FAULTS.check("tcp_query.recv")
+                rtype, body, _ = _recv_msg(sock)
+                broken = False
+                return rtype, body
+            except (ConnectionError, OSError) as e:
+                if (attempt == 0 and reused and not sent
+                        and not isinstance(e, TimeoutError)):
+                    log.debug(
+                        "stale pooled socket to %s (%s); retrying on a "
+                        "fresh connection", self.addr, e)
+                    continue
+                raise
+            finally:
+                self._checkin(sock, broken)
+        raise AssertionError("unreachable")  # loop always returns/raises
 
     # -- public API ---------------------------------------------------------
     def handshake(self, caps: str) -> str:
         rtype, body = self._roundtrip(_T_HANDSHAKE, [caps.encode()], None)
         if rtype == _T_ERROR:
-            raise RuntimeError(bytes(body).decode())
+            # RemoteApplicationError (a RuntimeError): the server is UP
+            # and answered — health machinery must not count this
+            raise RemoteApplicationError(bytes(body).decode())
         return bytes(body).decode()
 
     def invoke(self, frame: TensorFrame,
@@ -194,7 +240,7 @@ class TcpQueryConnection:
         rtype, body = self._roundtrip(
             _T_QUERY, encode_frame_parts(frame), timeout)
         if rtype == _T_ERROR:
-            raise RuntimeError(bytes(body).decode())
+            raise RemoteApplicationError(bytes(body).decode())
         return decode_frame(body)
 
     def invoke_batch(self, frames: List[TensorFrame],
@@ -202,7 +248,7 @@ class TcpQueryConnection:
         rtype, body = self._roundtrip(
             _T_QUERY, encode_frames_parts(frames), timeout)
         if rtype == _T_ERROR:
-            raise RuntimeError(bytes(body).decode())
+            raise RemoteApplicationError(bytes(body).decode())
         return decode_frames(body)
 
     def close(self) -> None:

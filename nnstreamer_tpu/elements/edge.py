@@ -161,6 +161,12 @@ class EdgeSrc(SourceElement):
     PROPERTIES = {
         "dest-host": Property(str, "localhost", "broker host (hybrid: MQTT broker)"),
         "dest-port": Property(int, 0, "broker port (hybrid: MQTT broker)"),
+        # multi-remote failover (resilience layer): candidate publishers
+        # tried in order at connect AND reconnect time — a dead primary
+        # degrades to the next remote instead of failing the stream
+        "dest-hosts": Property(
+            str, "", "failover publisher list 'h1:p1,h2:p2' (overrides "
+            "dest-host/dest-port; direct/tcp only)"),
         "topic": Property(str, "nns", "pub/sub topic"),
         "caps": Property(str, "", "announced schema"),
         "connect-type": Property(
@@ -170,11 +176,24 @@ class EdgeSrc(SourceElement):
         ),
         "discovery-timeout": Property(float, 10.0, "hybrid: seconds to wait for the announce"),
         "rebase-pts": Property(bool, True, "rebase pts into the local clock"),
+        # elastic recovery: an unexpectedly-ended stream (publisher died,
+        # link dropped) is re-dialed — cycling through dest-hosts — with
+        # exponential backoff, instead of silently ending the source.
+        # 0 keeps the historical end-on-hangup behavior.
+        "max-reconnects": Property(
+            int, 0, "re-dial attempts PER stream break (the budget "
+            "refills on every successful reconnect; 0 = end the stream, "
+            "historical behavior)"),
+        "reconnect-backoff": Property(
+            float, 0.2, "base seconds between re-dials (doubles per "
+            "attempt, capped at 2s)"),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
         self._sub: Optional[EdgeSubscriber] = None
+        self._targets: list = []
+        self._next_target = 0
 
     def _discover(self) -> tuple:
         """Hybrid control plane: read the retained announce from MQTT
@@ -197,20 +216,57 @@ class EdgeSrc(SourceElement):
             )
         return next(iter(found.values()))
 
-    def start(self):
+    def _parse_targets(self) -> list:
+        from ..pipeline.element import parse_host_list
+
+        raw = self.props["dest-hosts"]
+        if not raw:
+            return [(self.props["dest-host"], self.props["dest-port"])]
+        return parse_host_list(raw, self.name, "dest-hosts")
+
+    def _dial(self, host: str, port: int, probe: bool = False):
         if self.props["connect-type"] == "tcp":
             from ..distributed.tcp_edge import TcpEdgeSubscriber
 
-            self._sub = _TcpFrameSubscriber(TcpEdgeSubscriber(
-                self.props["dest-host"], self.props["dest-port"],
-                self.props["topic"],
+            return _TcpFrameSubscriber(TcpEdgeSubscriber(
+                host, port, self.props["topic"],
             ))
-            return
+        if probe or len(self._targets) > 1:
+            # gRPC channels connect lazily and never fail at dial time,
+            # which would make dest-hosts failover (and the reconnect
+            # budget) a silent no-op: probe the endpoint for real before
+            # declaring this dial a success.  Initial single-target
+            # start() stays lazy — a subscriber may legitimately start
+            # before its publisher exists.
+            from ..distributed.hybrid import probe_endpoint
+
+            if not probe_endpoint(host, port):
+                raise ConnectionError(
+                    f"edge endpoint {host}:{port} not accepting")
+        return EdgeSubscriber(host, port, self.props["topic"])
+
+    def _connect_any(self, probe: bool = False):
+        """Dial the target list starting at the rotation cursor; first
+        answering publisher wins (multi-remote failover)."""
+        last: Optional[BaseException] = None
+        n = len(self._targets)
+        for k in range(n):
+            host, port = self._targets[(self._next_target + k) % n]
+            try:
+                sub = self._dial(host, port, probe=probe)
+                self._next_target = (self._next_target + k) % n
+                return sub
+            except Exception as e:  # noqa: BLE001 — transport boundary
+                last = e
+                self.log.warning("edge dial %s:%d failed: %s", host, port, e)
+        raise last if last is not None else ConnectionError("no edge targets")
+
+    def start(self):
         if self.props["connect-type"] == "hybrid":
-            host, port = self._discover()
+            self._targets = [self._discover()]
         else:
-            host, port = self.props["dest-host"], self.props["dest-port"]
-        self._sub = EdgeSubscriber(host, port, self.props["topic"])
+            self._targets = self._parse_targets()
+        self._sub = self._connect_any()
 
     def stop(self):
         if self._sub is not None:
@@ -221,35 +277,98 @@ class EdgeSrc(SourceElement):
         text = self.props["caps"]
         return StreamSpec.from_string(text) if text else ANY
 
+    def _stopping(self) -> bool:
+        return (
+            self._pipeline is not None
+            and self._pipeline._stop_flag.is_set()
+        )
+
+    def _backoff_wait(self, delay: float) -> bool:
+        """Sleep `delay` seconds; True if the pipeline stopped meanwhile."""
+        if self._pipeline is not None:
+            return self._pipeline._stop_flag.wait(delay)
+        time.sleep(delay)
+        return False
+
     def frames(self) -> Iterator[TensorFrame]:
         import threading
 
-        out: "_queue.Queue[Optional[TensorFrame]]" = _queue.Queue(64)
-
-        def pump():
-            try:
-                for frame in self._sub.frames():
-                    out.put(frame)
-            except Exception:  # stream cancelled / broker gone
-                pass
-            finally:
-                out.put(None)
-
-        t = threading.Thread(target=pump, daemon=True, name=f"{self.name}-pump")
-        t.start()
         local_epoch = time.time()
+        reconnects_left = int(self.props["max-reconnects"])
+        failed_redials = 0
         while True:
-            try:
-                frame = out.get(timeout=0.1)
-            except _queue.Empty:
-                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
-                    return
-                continue
-            if frame is None:
+            out: "_queue.Queue[Optional[TensorFrame]]" = _queue.Queue(64)
+            sub = self._sub
+
+            def pump(sub=sub, out=out):
+                try:
+                    for frame in sub.frames():
+                        out.put(frame)
+                except Exception:  # allow-silent: stream cancelled /
+                    pass  # broker gone — the None below IS the signal
+                finally:
+                    out.put(None)
+
+            t = threading.Thread(
+                target=pump, daemon=True, name=f"{self.name}-pump")
+            t.start()
+            while True:
+                try:
+                    frame = out.get(timeout=0.1)
+                except _queue.Empty:
+                    if self._stopping():
+                        return
+                    continue
+                if frame is None:
+                    break  # stream ended — fall through to reconnect logic
+                if self.props["rebase-pts"] and frame.pts is not None:
+                    wall_base = frame.meta.get("wall_base")
+                    if wall_base is not None:
+                        # publisher wall-clock of this frame, rebased local
+                        frame.pts = (wall_base + frame.pts) - local_epoch
+                yield frame
+            if self._stopping():
                 return
-            if self.props["rebase-pts"] and frame.pts is not None:
-                wall_base = frame.meta.get("wall_base")
-                if wall_base is not None:
-                    # publisher wall-clock time of this frame, rebased local
-                    frame.pts = (wall_base + frame.pts) - local_epoch
-            yield frame
+            # elastic recovery: the publisher hung up (or died) — re-dial
+            # with RetryPolicy backoff (capped exponential + jitter: N
+            # subscribers that lost the same publisher must not redial in
+            # synchronized bursts), rotating through dest-hosts so a dead
+            # primary fails over to the next remote
+            from ..core.resilience import RetryPolicy
+
+            base = max(0.0, float(self.props["reconnect-backoff"]))
+            policy = RetryPolicy(
+                base_delay_s=base, max_delay_s=2.0, jitter=0.1)
+            while reconnects_left > 0:
+                reconnects_left -= 1
+                delay = policy.delay_for(failed_redials + 1) if base else 0.0
+                if delay > 0 and self._backoff_wait(delay):
+                    return
+                try:
+                    old, self._sub = self._sub, None
+                    if old is not None:
+                        old.close()
+                    if self.props["connect-type"] == "hybrid":
+                        # the publisher may have come back on a NEW
+                        # endpoint: re-read its retained announce rather
+                        # than redialing the one captured at start()
+                        self._targets = [self._discover()]
+                    self._next_target = (
+                        (self._next_target + 1) % max(1, len(self._targets))
+                    )
+                    # probe=True: a re-dial must verify the peer is real
+                    # (lazy gRPC channels would otherwise refill the
+                    # budget forever against a permanently dead publisher)
+                    self._sub = self._connect_any(probe=True)
+                    failed_redials = 0
+                    # per-break budget: a recovered stream starts fresh —
+                    # N isolated publisher restarts over weeks must not
+                    # add up to silent stream death
+                    reconnects_left = int(self.props["max-reconnects"])
+                    self.log.info("edge stream re-established")
+                    break
+                except Exception as e:  # noqa: BLE001 — transport boundary
+                    failed_redials += 1
+                    self.log.warning("edge reconnect failed: %s", e)
+            else:
+                return  # budget exhausted (or 0): end of stream

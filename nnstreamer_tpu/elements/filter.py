@@ -33,6 +33,7 @@ from ..core import config as nns_config
 from ..core import registry
 from ..core.buffer import BatchFrame, CustomEvent, Flush, TensorFrame
 from ..core.model_uri import resolve_model_uri
+from ..core.resilience import FAULTS
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import ElementError, Property, TransformElement, element
 
@@ -667,6 +668,7 @@ class TensorFilter(TransformElement):
         inputs = [frame.tensors[i] for _, i in comb] if comb else list(frame.tensors)
         import time
 
+        FAULTS.check("filter.invoke")
         t0 = time.perf_counter()
         if isinstance(frame, BatchFrame):
             # a pre-batched block on a single-invoke path (max-batch=1,
@@ -719,6 +721,7 @@ class TensorFilter(TransformElement):
         real host boundary) or the depth-N dispatch window."""
         import time
 
+        FAULTS.check("filter.invoke")
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, nlogical)

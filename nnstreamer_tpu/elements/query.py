@@ -24,6 +24,12 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Deque, Iterator, List, Optional, Tuple
 
 from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
+from ..core.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    is_remote_application_error,
+)
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
     QueryConnection,
@@ -191,6 +197,14 @@ class TensorQueryServerSink(SinkElement):
         )
 
 
+def _check_degrade(v: str) -> str:
+    # eager validation: a typo here must fail at set time, not silently
+    # behave like `error` and only surface during an outage
+    if v not in ("error", "passthrough", "skip"):
+        raise ValueError(f"degrade {v!r} (want error | passthrough | skip)")
+    return v
+
+
 class _PoolState:
     """One generation of the client's connection pool.
 
@@ -218,6 +232,11 @@ class TensorQueryClient(Element):
     server pipeline(s) with pipelined, order-preserving dispatch."""
 
     BATCH_AWARE = True  # maps blocks onto the wire micro-batch envelope
+    #: answers are pipelined: an error raised while handling frame B may
+    #: belong to in-flight frame A, so the scheduler's skip/restart
+    #: policies cannot attribute it — this element degrades via its own
+    #: `degrade=` property instead (the worker always runs it fail-stop)
+    SUPERVISES_OWN_ERRORS = True
 
     PROPERTIES = {
         "host": Property(str, "localhost", "server host"),
@@ -245,6 +264,26 @@ class TensorQueryClient(Element):
         # possibly to another server) — opt in only for idempotent server
         # pipelines; 0 matches the reference's single-timeout semantics
         "retries": Property(int, 0, "re-send attempts per request (0 = none; >0 = at-least-once delivery)"),
+        # resilience knobs (core/resilience.py; Documentation/resilience.md)
+        "retry-backoff": Property(
+            float, 0.05,
+            "base seconds between failover attempts (doubles per attempt, "
+            "capped at 1s; 0 = immediate)"),
+        "breaker-threshold": Property(
+            int, 5,
+            "per-remote circuit breaker: consecutive-window failures that "
+            "trip it open (0 = breaker disabled)"),
+        "breaker-reset": Property(
+            float, 5.0, "seconds a tripped breaker stays open before "
+            "half-open probing"),
+        # what the STREAM sees when every remote/attempt is exhausted:
+        # error (default, surfaces per the element's error-policy) |
+        # passthrough (emit the request frame unanswered — degrade to a
+        # camera-only stream) | skip (drop the frame with a warning)
+        "degrade": Property(
+            str, "error",
+            "on total remote failure: error | passthrough | skip",
+            convert=_check_degrade),
         # wire micro-batching (TPU-first, no reference analog): drain
         # whatever frames are ALREADY queued (no added latency) and ship
         # up to N of them in ONE RPC — amortizes the per-RPC transport
@@ -282,6 +321,14 @@ class TensorQueryClient(Element):
         self._last_discovery_ts = float("-inf")
         self._stopped = True
         self._run_epoch = 0  # bumped per start(); scopes pool generations
+        # per-remote circuit breakers, keyed by "host:port" — they OUTLIVE
+        # pool swaps (trip counts are part of the health story) and are
+        # shared by every worker thread (CircuitBreaker is thread-safe)
+        self._breakers: dict = {}
+        self._breakers_lock = threading.Lock()
+        self._degraded = 0  # frames answered by degrade= instead of a server
+        self._evicted_breaker_trips = 0  # trips of breakers evicted on swaps
+        self._retry_policy = RetryPolicy()  # rebuilt from props in start()
 
     @property
     def _conns(self) -> tuple:
@@ -340,6 +387,13 @@ class TensorQueryClient(Element):
         return targets
 
     def start(self):
+        if self.props.get("error-policy", "fail-stop") != "fail-stop":
+            self.log.warning(
+                "error-policy=%s is ignored on the query client "
+                "(pipelined in-flight answers make frame attribution "
+                "ambiguous) — use degrade=passthrough|skip instead",
+                self.props["error-policy"],
+            )
         ct = self.props["connect-type"]
         if ct not in ("grpc", "tcp"):
             # validate BEFORE discovery: a typo'd connect-type must fail
@@ -351,16 +405,9 @@ class TensorQueryClient(Element):
         if self.props["topic"] and self.props["dest-port"] > 0:
             targets = self._discover_targets()
         elif self.props["hosts"]:
-            for part in self.props["hosts"].split(","):
-                part = part.strip()
-                if not part:
-                    continue
-                h, sep, p = part.rpartition(":")
-                if not sep or not h or not p.isdigit():
-                    raise ElementError(
-                        f"{self.name}: bad hosts entry {part!r} (want host:port)"
-                    )
-                targets.append((h, int(p)))
+            from ..pipeline.element import parse_host_list
+
+            targets = parse_host_list(self.props["hosts"], self.name, "hosts")
         else:
             targets.append((self.props["host"], self.props["port"]))
         if not targets or any(p == 0 for _, p in targets):
@@ -382,6 +429,18 @@ class TensorQueryClient(Element):
             self._make_conns(targets), targets, 0, epoch=self._run_epoch
         )
         self._stopped = False
+        # failover pacing: delay_for(k) gives the capped-exponential,
+        # seeded-jitter backoff between attempt k and k+1
+        self._retry_policy = RetryPolicy(
+            max_attempts=1 + max(0, int(self.props["retries"])),
+            base_delay_s=max(0.0, float(self.props["retry-backoff"])),
+            max_delay_s=1.0,
+            jitter=0.1,
+            # unseeded: jitter exists to DE-synchronize clients — a fixed
+            # seed would give every client the same backoff sequence and
+            # recreate the thundering herd it is meant to prevent
+            seed=None,
+        )
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.props["max-in-flight"])
         )
@@ -448,23 +507,65 @@ class TensorQueryClient(Element):
             if not block_all and not fut.done():
                 break
             self._inflight.popleft()
-            got = fut.result()  # raises on RPC error -> bus
+            got = fut.result()  # raises on RPC error -> error-policy/bus
+            if got is None:
+                continue  # degrade=skip swallowed the frame (warned)
             if isinstance(got, list):  # wire-batched request
                 out.extend((0, f) for f in got)
             else:
                 out.append((0, got))
         return out
 
-    @staticmethod
-    def _healthy_order(ps: "_PoolState", first: int) -> List[int]:
+    def _breaker_for(self, target: Tuple[str, int]) -> Optional[CircuitBreaker]:
+        """The (lazily created) circuit breaker for one remote; None when
+        disabled via breaker-threshold=0.  Keyed by endpoint so state —
+        including lifetime trip counts — survives elastic pool swaps."""
+        threshold = int(self.props["breaker-threshold"])
+        if threshold <= 0:
+            return None
+        key = f"{target[0]}:{target[1]}"
+        with self._breakers_lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = CircuitBreaker(
+                    failure_threshold=threshold,
+                    window_s=max(1.0, float(self.props["timeout"]) * 4),
+                    reset_timeout_s=float(self.props["breaker-reset"]),
+                    name=f"{self.name}->{key}",
+                )
+                self._breakers[key] = b
+            return b
+
+    def health_info(self) -> dict:
+        """Element-specific health merged into ``Pipeline.health()``:
+        per-remote breaker snapshots + degrade counters."""
+        with self._breakers_lock:
+            breakers = {k: b.snapshot() for k, b in self._breakers.items()}
+        return {
+            "breakers": breakers,
+            "breaker_trips_evicted": self._evicted_breaker_trips,
+            "degraded_frames": self._degraded,
+            "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
+        }
+
+    def _healthy_order(self, ps: "_PoolState", first: int) -> List[int]:
         """Conn indices of ``ps`` starting at `first`, known-down ones
-        (cooldown not expired) pushed to the back so a hung server
-        doesn't eat a full timeout per frame."""
+        (cooldown not expired, or circuit breaker open) pushed to the
+        back so a hung server doesn't eat a full timeout per frame."""
         import time
 
         now = time.monotonic()
         order = [(first + k) % len(ps.conns) for k in range(len(ps.conns))]
-        healthy = [i for i in order if ps.down_until.get(i, 0) <= now]
+
+        def fine(i: int) -> bool:
+            if ps.down_until.get(i, 0) > now:
+                return False
+            b = self._breaker_for(ps.targets[i])
+            # peek only — allow() reserves half-open probe slots and must
+            # be called exactly once, at attempt time
+            return b is None or b.state != CircuitBreaker.OPEN
+
+        healthy = [i for i in order if fine(i)]
         return healthy + [i for i in order if i not in healthy]
 
     def _rediscover(self, failed_ps: "_PoolState") -> bool:
@@ -564,10 +665,18 @@ class TensorQueryClient(Element):
                 "re-discovered %d server(s): %s", len(kept_targets),
                 ",".join(f"{h}:{p}" for h, p in kept_targets),
             )
+            # evict breakers for endpoints the swap dropped — ephemeral
+            # pod IPs would otherwise grow the dict for the element's
+            # lifetime; their trip history folds into one counter
+            keep = {f"{h}:{p}" for h, p in kept_targets}
+            with self._breakers_lock:
+                for key in [k for k in self._breakers if k not in keep]:
+                    self._evicted_breaker_trips += (
+                        self._breakers.pop(key).trip_count)
         for c in retired:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001 — teardown of dead conns
+            except Exception:  # allow-silent: teardown of dead conns
                 pass
         return swapped
 
@@ -611,10 +720,38 @@ class TensorQueryClient(Element):
             raise RuntimeError(f"{self.name}: no connections (stopped?)")
         attempts = 1 + max(0, self.props["retries"])
         timeout = self.props["timeout"]
+        retry_policy = self._retry_policy
         order = self._healthy_order(ps, first)
         err: Optional[BaseException] = None
+        open_err: Optional[BaseException] = None
+        cursor = 0
         for k in range(attempts):
-            i = order[k % len(order)]
+            if self._stopped:
+                break
+            # next remote whose breaker admits a call — open breakers are
+            # skipped WITHOUT consuming a retry attempt (failing fast on a
+            # known-dead remote must not shrink the budget for live ones)
+            i = breaker = None
+            for _ in range(len(order)):
+                cand = order[cursor % len(order)]
+                cursor += 1
+                b = self._breaker_for(ps.targets[cand])
+                if b is None or b.allow():
+                    i, breaker = cand, b
+                    break
+                open_err = CircuitOpenError(
+                    f"{ps.conns[cand].addr} circuit {b.state}")
+            if i is None:
+                # every remote's breaker is open: burn this attempt on the
+                # backoff instead of failing the whole budget instantly —
+                # the reset window may grant a half-open probe before the
+                # attempts run out (a 1s blip must not drop 5s of frames)
+                if k + 1 < attempts and not self._stopped:
+                    delay = retry_policy.delay_for(k + 1)
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                break
             conn = ps.conns[i]
             try:
                 if isinstance(frame, list):
@@ -622,20 +759,46 @@ class TensorQueryClient(Element):
                 else:
                     result = conn.invoke(frame, timeout)
                 ps.down_until.pop(i, None)
+                if breaker is not None:
+                    breaker.record_success()
                 return result
             except Exception as e:  # noqa: BLE001 — transport boundary
                 err = e
-                ps.down_until[i] = time.monotonic() + timeout
+                if is_remote_application_error(e):
+                    # the server ANSWERED (with an error reply): it is
+                    # healthy — poison frames must not trip its breaker
+                    # or cool it down; retries may still help (e.g. a
+                    # full-ingress reply, or another remote's capacity)
+                    if breaker is not None:
+                        breaker.record_success()
+                else:
+                    if breaker is not None:
+                        breaker.record_failure()
+                    ps.down_until[i] = time.monotonic() + timeout
                 self.log.warning(
                     "query to %s failed (attempt %d/%d): %s",
                     conn.addr, k + 1, attempts, e,
                 )
+                if k + 1 < attempts:
+                    # RetryPolicy backoff between failover attempts so a
+                    # flapping link isn't hammered (capped exponential +
+                    # seeded jitter)
+                    delay = retry_policy.delay_for(k + 1)
+                    if delay > 0:
+                        time.sleep(delay)
+        if err is None:
+            if open_err is not None:
+                err = open_err  # every remote breaker-open, nothing tried
+            else:  # stopped before any attempt
+                raise RuntimeError(f"{self.name}: stopped mid-request")
         safe_to_resend = (
-            self.props["retries"] > 0 or self._provably_unsent(err)
+            self.props["retries"] > 0
+            or self._provably_unsent(err)
+            or isinstance(err, CircuitOpenError)  # never reached a server
         )
         if not rediscovered and self._rediscover(ps) and safe_to_resend:
             return self._invoke_failover(frame, first, rediscovered=True)
-        raise err  # all attempts failed -> surfaced on the bus
+        raise err  # all attempts failed -> degrade= / bus decides from here
 
     _DRAIN_EVENT = "_nns_query_drain"
 
@@ -681,10 +844,25 @@ class TensorQueryClient(Element):
         if self.props["stream"]:
             # sequential per-request streams: chunk frames of request j
             # leave BEFORE request j+1 is sent (the scheduler pushes each
-            # yielded frame immediately)
+            # yielded frame immediately).  degrade= applies here too, but
+            # only to requests that produced NO answer yet — a stream
+            # broken mid-way surfaces as an error regardless (its partial
+            # output already left; neither skip nor passthrough can
+            # retract it).
             def streams():
                 for f in frames:
-                    yield from self._stream_invoke(f)
+                    emitted = 0
+                    try:
+                        for out in self._stream_invoke(f):
+                            emitted += 1
+                            yield out
+                    except Exception as e:  # noqa: BLE001 — transport
+                        mode = self.props["degrade"]
+                        if emitted or mode not in ("passthrough", "skip"):
+                            raise
+                        self._note_degraded(1, mode, e)
+                        if mode == "passthrough":
+                            yield (0, f)
 
             return streams()
         if len(frames) == 1:
@@ -713,39 +891,114 @@ class TensorQueryClient(Element):
         attempts = min(len(order), 1 + max(0, self.props["retries"]))
         timeout = self.props["timeout"]
         err: Optional[BaseException] = None
-        for i in order[:attempts]:
+        open_err: Optional[BaseException] = None
+        tried = 0
+        for i in order:
+            if tried >= attempts:
+                break
             conn = ps.conns[i]
+            breaker = self._breaker_for(ps.targets[i])
+            if breaker is not None and not breaker.allow():
+                # refused by the breaker: note it separately (it must
+                # never mask a real transport error) and don't consume an
+                # attempt slot — the next healthy remote must still get
+                # its dial (same contract as the unary path)
+                open_err = CircuitOpenError(
+                    f"{conn.addr} circuit {breaker.state}")
+                continue
+            tried += 1
             started = False
             try:
                 for ans in conn.invoke_stream(frame, timeout):
                     started = True
                     ps.down_until.pop(i, None)
                     yield (0, ans)
+                if breaker is not None:
+                    # success is recorded on clean COMPLETION (empty
+                    # streams included — a half-open probe slot must not
+                    # leak), never on the first answer: a server that
+                    # reliably crashes mid-stream would otherwise clear
+                    # its failure window every request and never trip
+                    breaker.record_success()
                 return
             except Exception as e:  # noqa: BLE001 — transport boundary
                 if started:
-                    raise  # mid-stream break: no safe replay
+                    # mid-stream break: no safe replay — but it IS a
+                    # health signal; without recording it, a server that
+                    # repeatedly dies mid-stream keeps winning the
+                    # healthy-first ordering over an actually-good one
+                    if not is_remote_application_error(e):
+                        if breaker is not None:
+                            breaker.record_failure()
+                        ps.down_until[i] = _time.monotonic() + min(
+                            float(timeout), 10.0)
+                    raise
                 err = e
-                # short cooldown: the stream timeout is minutes-scale (a
-                # whole generation), not a health signal
-                ps.down_until[i] = _time.monotonic() + min(
-                    float(timeout), 10.0
-                )
+                if is_remote_application_error(e):
+                    if breaker is not None:  # answered: server healthy
+                        breaker.record_success()
+                else:
+                    if breaker is not None:
+                        breaker.record_failure()
+                    # short cooldown: the stream timeout is minutes-scale
+                    # (a whole generation), not a health signal
+                    ps.down_until[i] = _time.monotonic() + min(
+                        float(timeout), 10.0
+                    )
                 self.log.warning(
                     "stream to %s failed before first answer: %s",
                     conn.addr, e,
                 )
+        if err is None:
+            err = open_err  # only breaker refusals happened (or nothing)
         if err is not None and not rediscovered:
-            safe = self.props["retries"] > 0 or self._provably_unsent(err)
+            safe = (
+                self.props["retries"] > 0
+                or self._provably_unsent(err)
+                or isinstance(err, CircuitOpenError)  # never reached a server
+            )
             if self._rediscover(ps) and safe:
                 yield from self._stream_invoke(frame, rediscovered=True)
                 return
         raise err if err is not None else RuntimeError("no servers")
 
+    def _note_degraded(self, n: int, mode: str, err: BaseException) -> None:
+        """Shared degrade bookkeeping (unary + stream paths): counter,
+        log, bus warning — one place so the two paths cannot diverge."""
+        with self._breakers_lock:  # pool workers race this counter
+            self._degraded += n
+        self.log.warning(
+            "all remotes failed for %d frame(s); degrade=%s: %s",
+            n, mode, err,
+        )
+        if self._pipeline is not None:
+            from ..pipeline.pipeline import BusMessage
+
+            self._pipeline.post(BusMessage("warning", self.name, {
+                "degrade": mode, "frames": n, "error": err,
+            }))
+
+    def _invoke_or_degrade(self, frame_or_batch, first: int):
+        """`_invoke_failover` + the degrade= contract: when every remote
+        and retry is exhausted, either surface the error (default), pass
+        the unanswered request frame(s) through, or drop them — so one
+        dead pod degrades the stream instead of killing the pipeline."""
+        try:
+            return self._invoke_failover(frame_or_batch, first)
+        except Exception as e:  # noqa: BLE001 — transport boundary
+            mode = self.props["degrade"]
+            if mode not in ("passthrough", "skip"):
+                raise
+            n = len(frame_or_batch) if isinstance(frame_or_batch, list) else 1
+            self._note_degraded(n, mode, e)
+            if mode == "passthrough":
+                return frame_or_batch
+            return [] if isinstance(frame_or_batch, list) else None
+
     def _dispatch(self, frame_or_batch):
         first = self._rr % max(1, len(self._pstate.conns))
         self._rr += 1
-        fut = self._pool.submit(self._invoke_failover, frame_or_batch, first)
+        fut = self._pool.submit(self._invoke_or_degrade, frame_or_batch, first)
         fut.add_done_callback(self._notify_done)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window

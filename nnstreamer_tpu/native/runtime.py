@@ -260,7 +260,7 @@ class NativeMailbox:
                 # still waits for any waiter mid-exit in C++
                 self._lib.nns_oq_destroy(self._h)
                 self._h = None
-        except Exception:
+        except Exception:  # allow-silent: __del__ during interpreter exit
             pass
 
 
@@ -302,7 +302,7 @@ class BufferPool:
     def __del__(self):  # pragma: no cover
         try:
             self.destroy()
-        except Exception:
+        except Exception:  # allow-silent: GC-order-dependent teardown
             pass
 
 
@@ -355,5 +355,5 @@ class SampleReader:
     def __del__(self):  # pragma: no cover — GC order dependent
         try:
             self.close()
-        except Exception:
+        except Exception:  # allow-silent: GC-order-dependent teardown
             pass

@@ -68,16 +68,75 @@ class Property:
         return self.convert(value) if self.convert else value
 
 
+def _check_error_policy(v: str) -> str:
+    if v not in ("fail-stop", "skip", "restart"):
+        raise ValueError(
+            f"error-policy {v!r} (want fail-stop | skip | restart)"
+        )
+    return v
+
+
 COMMON_PROPERTIES.update({
     # ≙ the reference's universal `silent` prop (e.g. gsttensor_rate.c
     # PROP_SILENT: "Don't produce verbose output"): false lowers this
     # element's logger to DEBUG so per-frame diagnostics stream out
     "silent": Property(bool, True, "false = verbose (debug-level) logging"),
+    # supervision (core/resilience.py + the pipeline worker loop): what
+    # the scheduler does when THIS element raises while processing a
+    # frame.  Events (caps/EOS/flush) always fail-stop — losing one
+    # desynchronizes the stream.  See Documentation/resilience.md.
+    "error-policy": Property(
+        str, "fail-stop",
+        "on frame error: fail-stop (kill the pipeline, default) | skip "
+        "(drop the poisoned frame to the dead-letter queue, warn on the "
+        "bus) | restart (supervisor restarts the element with backoff, "
+        "then retries the frame; degrades to fail-stop after "
+        "max-restarts)",
+        convert=_check_error_policy,
+    ),
+    "max-restarts": Property(
+        int, 3, "restart policy: restarts allowed (within restart-window) "
+        "before degrading to fail-stop"),
+    "restart-backoff": Property(
+        float, 0.05, "restart policy: base backoff seconds (doubles per "
+        "restart, capped at 2s)"),
+    # always-on contract: a budget that never refills would guarantee
+    # eventual degradation — N isolated glitches spread over weeks must
+    # not kill the pipeline the way N back-to-back crash-loops should
+    "restart-window": Property(
+        float, 60.0, "restart policy: seconds of sustained health after "
+        "which the restart budget (and backoff) fully refills; 0 = "
+        "lifetime budget, never refills"),
+    "dead-letter-max": Property(
+        int, 16, "skip policy: poisoned frames retained for inspection "
+        "(older ones roll off; 0 = count drops but retain nothing; the "
+        "drop COUNTER is unbounded)"),
 })
 
 
 class ElementError(RuntimeError):
     pass
+
+
+def parse_host_list(raw: str, owner: str, prop: str) -> List[Tuple[str, int]]:
+    """Parse a 'h1:p1,h2:p2' property value into [(host, port), ...].
+
+    Shared by every element exposing a multi-remote list (query client
+    ``hosts``, edgesrc ``dest-hosts``) so the syntax and its errors
+    cannot drift apart."""
+    targets: List[Tuple[str, int]] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        h, sep, p = part.rpartition(":")
+        if not sep or not h or not p.isdigit():
+            raise ElementError(
+                f"{owner}: bad {prop} entry {part!r} (want host:port)")
+        targets.append((h, int(p)))
+    if not targets:
+        raise ElementError(f"{owner}: {prop} parsed to nothing")
+    return targets
 
 
 # ---------------------------------------------------------------------------

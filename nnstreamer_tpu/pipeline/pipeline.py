@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, Flush, TensorFrame
 from ..core.log import get_logger
+from ..core.resilience import FAULTS
 from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
 from .element import Element, ElementError, SinkElement, SourceElement
 
@@ -116,9 +117,31 @@ class _LeakyMailbox:
 class BusMessage:
     """Out-of-band message to the application (≙ GstMessage)."""
 
-    kind: str  # "error" | "eos" | "element"
+    kind: str  # "error" | "eos" | "element" | "warning" | "health"
     source: str
     data: Any = None
+
+
+@dataclass
+class ElementHealth:
+    """Supervision record for one element (see ``Pipeline.health()``).
+
+    ``dead_letters`` counts every frame dropped under the ``skip``
+    policy for the element's lifetime; ``dlq`` retains only the most
+    recent ``dead-letter-max`` of them as ``(frame, error_repr)`` pairs
+    for post-mortem inspection."""
+
+    state: str = "idle"  # idle|running|restarting|degraded|failed|finished
+    restarts: int = 0  # within the current restart-window (gates the budget)
+    restarts_total: int = 0  # lifetime, for health reporting
+    last_restart_ts: float = 0.0
+    dead_letters: int = 0
+    last_error: str = ""
+    dlq: deque = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.dlq is None:
+            self.dlq = deque(maxlen=16)
 
 
 class Pipeline:
@@ -143,6 +166,8 @@ class Pipeline:
         self._sinks_done = threading.Event()
         self._pending_sinks = 0
         self._sink_lock = threading.Lock()
+        # supervision: per-element health records (error-policy support)
+        self.health_map: Dict[str, ElementHealth] = {}
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
 
@@ -349,6 +374,18 @@ class Pipeline:
                 el._mailbox = self._make_mailbox(
                     size, getattr(el, "leaky_policy", "")
                 )
+        def _dlq_maxlen(el: Element) -> int:
+            v = el.props.get("dead-letter-max")
+            # 0 is a VALID setting (count drops, retain no frame payloads
+            # — large tensors must not pin memory); only absent means 16
+            return 16 if v is None else max(0, int(v))
+
+        self.health_map = {
+            el.name: ElementHealth(
+                state="running", dlq=deque(maxlen=_dlq_maxlen(el)),
+            )
+            for el in self.elements.values()
+        }
         self._stop_flag.clear()
         for el in self.elements.values():
             target = self._run_source if isinstance(el, SourceElement) else self._run_element
@@ -396,12 +433,221 @@ class Pipeline:
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until EOS reached every sink; re-raise the first element
-        error.  ≙ waiting for EOS/ERROR on the GstBus."""
+        error.  ≙ waiting for EOS/ERROR on the GstBus.
+
+        A timed-out wait TEARS THE PIPELINE DOWN (``stop()``) before
+        raising ``TimeoutError``: a stuck pipeline must not leak live
+        worker threads into the caller (they would poison later tests /
+        pipelines in the same process).  A timeout is a terminal
+        condition, not a poll — use ``pop_message``/bus watchers to
+        observe a pipeline that should keep running."""
         finished = self._sinks_done.wait(timeout)
         if self.errors:
             raise self.errors[0]
         if not finished:
+            self.stop()
+            if self.errors:
+                # an error that raced the timeout is the truer cause
+                raise self.errors[0]
             raise TimeoutError(f"pipeline {self.name!r} did not finish in {timeout}s")
+
+    # -- supervision ---------------------------------------------------------
+    def health(self) -> Dict[str, Dict[str, Any]]:
+        """Live supervision snapshot: per-element state, restart count,
+        dead-letter depth/total, and any element-specific health (e.g.
+        the query client's per-remote circuit-breaker states via
+        ``Element.health_info()``).  Cheap enough to poll."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, el in self.elements.items():
+            h = self.health_map.get(name) or ElementHealth()
+            entry: Dict[str, Any] = {
+                "state": h.state,
+                "policy": el.props.get("error-policy", "fail-stop"),
+                "restarts": h.restarts_total,
+                "restarts_window": h.restarts,
+                "dead_letters": h.dead_letters,
+                "dead_letter_depth": len(h.dlq),
+                "last_error": h.last_error,
+            }
+            info = getattr(el, "health_info", None)
+            if info is not None:
+                try:
+                    entry.update(info() or {})
+                except Exception:  # health must never kill the caller
+                    self.log.exception("health_info failed for %s", name)
+            out[name] = entry
+        return out
+
+    def post_health(self) -> None:
+        """Publish the current health snapshot on the bus (kind
+        ``health``); also posted automatically when an element degrades."""
+        self.post(BusMessage("health", self.name, self.health()))
+
+    def _dead_letter(self, el: Element, frames, err: BaseException) -> None:
+        """skip policy: record dropped frame(s) + bus warning."""
+        h = self.health_map[el.name]
+        frames = frames if isinstance(frames, list) else [frames]
+        n = sum(getattr(f, "batch_size", 1) for f in frames)
+        for f in frames:
+            h.dlq.append((f, repr(err)))
+        h.dead_letters += n
+        h.last_error = repr(err)
+        self.log.warning(
+            "%s: dropped %d poisoned frame(s) (error-policy=skip): %s",
+            el.name, n, err,
+        )
+        self.post(BusMessage("warning", el.name, {
+            "policy": "skip", "dropped": n, "error": err,
+        }))
+
+    def _restart_element(self, el: Element, err: BaseException) -> str:
+        """restart policy: stop+start `el` with exponential backoff.
+
+        Returns ``"retry"`` (restarted — re-run the failed call),
+        ``"degraded"`` (max-restarts exhausted or start() itself failed
+        — caller falls back to fail-stop), or ``"stopping"`` (pipeline
+        shut down mid-backoff — caller exits quietly)."""
+        h = self.health_map[el.name]
+        h.last_error = repr(err)
+        limit = int(el.props.get("max-restarts", 3))
+        window = float(el.props.get("restart-window", 60.0) or 0.0)
+        now = time.monotonic()
+        if window > 0 and h.last_restart_ts and (
+                now - h.last_restart_ts) > window:
+            # sustained health since the last restart: the budget (and
+            # the backoff curve) refills — isolated glitches over days
+            # must not accumulate into an inevitable degradation
+            h.restarts = 0
+        h.last_restart_ts = now
+        if h.restarts >= limit:
+            h.state = "degraded"
+            self.log.error(
+                "%s: max-restarts=%d exhausted; degrading to fail-stop",
+                el.name, limit,
+            )
+            self.post(BusMessage("warning", el.name, {
+                "policy": "restart", "degraded": True, "error": err,
+            }))
+            self.post_health()
+            return "degraded"
+        h.restarts += 1
+        h.restarts_total += 1
+        h.state = "restarting"
+        from ..core.resilience import RetryPolicy
+
+        base = float(el.props.get("restart-backoff", 0.05) or 0.0)
+        # RetryPolicy owns the backoff curve (capped exponential + jitter
+        # so many elements restarting together don't thundering-herd)
+        delay = RetryPolicy(
+            base_delay_s=base, max_delay_s=2.0, jitter=0.1,
+        ).delay_for(h.restarts) if base > 0 else 0.0
+        self.log.warning(
+            "%s: restart %d/%d after error (backoff %.3fs): %s",
+            el.name, h.restarts, limit, delay, err,
+        )
+        self.post(BusMessage("warning", el.name, {
+            "policy": "restart", "restart": h.restarts, "error": err,
+        }))
+        try:
+            el.stop()
+        except Exception:
+            self.log.exception("%s: stop() during restart failed", el.name)
+        if delay > 0 and self._stop_flag.wait(delay):
+            return "stopping"
+        if self._stop_flag.is_set():
+            return "stopping"
+        try:
+            el.start()
+        except Exception:  # interrupts must propagate, not "degrade"
+            self.log.exception("%s: start() during restart failed", el.name)
+            h.state = "degraded"
+            self.post_health()
+            return "degraded"
+        h.state = "running"
+        return "retry"
+
+    _SUPERVISED_STOPPING = object()  # sentinel: worker must exit quietly
+
+    def _skip_failed(self, el: Element, frames, err: BaseException,
+                     per_item) -> list:
+        """skip semantics for a failed call: when the call covered
+        MULTIPLE frames and a per-item re-call is available, isolate the
+        poison — re-run each frame alone so one bad frame in a
+        micro-batch doesn't take its batchmates to the dead-letter
+        queue; otherwise dead-letter the whole input.  Assumes the batch
+        call failed atomically (true for the invoke-style elements that
+        batch: one backend call, outputs only on success) — a stateful
+        element that partially consumed the batch before raising would
+        see the survivors twice."""
+        if per_item is not None and isinstance(frames, list) and len(frames) > 1:
+            outs: list = []
+            for f in frames:
+                try:
+                    outs.extend(per_item(f) or [])
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e2:  # noqa: BLE001 — policy boundary
+                    self._dead_letter(el, [f], e2)
+            return outs
+        self._dead_letter(el, frames, err)
+        return []
+
+    def _supervised(self, el: Element, call, frames, per_item=None):
+        """Run one frame-processing call under `el`'s error-policy.
+
+        fail-stop re-raises (the `_guard` boundary turns it into a bus
+        error + pipeline teardown); skip dead-letters the poisoned
+        frame(s) — isolating them per-frame via `per_item` when the
+        failed call was a micro-batch — and yields the rest; restart
+        restarts the element and RETRIES the same call (zero frame loss
+        for transient faults), degrading to fail-stop once max-restarts
+        is exhausted, and treats fatal (bad-input) errors like skip.
+
+        Elements with ``SUPERVISES_OWN_ERRORS`` (async in-flight
+        dispatch, e.g. the query client) always run fail-stop here: an
+        error surfacing during frame B's call may belong to in-flight
+        frame A, so skip/restart would dead-letter or re-dispatch the
+        WRONG frame — such elements degrade via their own mechanism
+        (``degrade=`` on the query client) instead."""
+        policy = el.props.get("error-policy", "fail-stop")
+        if getattr(el, "SUPERVISES_OWN_ERRORS", False):
+            policy = "fail-stop"
+        while True:
+            try:
+                # fault-injection site INSIDE the policy boundary, so
+                # injected faults exercise the same machinery real ones do
+                if FAULTS.is_armed():
+                    FAULTS.check(f"element.{el.name}.handle_frame")
+                result = call()
+                if policy != "fail-stop" and not isinstance(
+                        result, (list, tuple)):
+                    # lazy outputs (generators, e.g. the query client's
+                    # stream mode) raise during ITERATION, which happens
+                    # outside this try under fail-stop; with skip/restart
+                    # the errors must land here, so materialize — the
+                    # cost of supervision is losing output laziness
+                    result = list(result)
+                return result
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — policy boundary
+                if policy == "skip":
+                    return self._skip_failed(el, frames, e, per_item)
+                if policy == "restart":
+                    from ..core.resilience import is_transient
+
+                    if not is_transient(e):
+                        # fatal classification (bad input, schema bug):
+                        # restarting cannot fix the frame — dead-letter
+                        # (isolating within a batch) and keep the restart
+                        # budget for faults a restart CAN cure
+                        return self._skip_failed(el, frames, e, per_item)
+                    verdict = self._restart_element(el, e)
+                    if verdict == "retry":
+                        continue
+                    if verdict == "stopping":
+                        return self._SUPERVISED_STOPPING
+                raise
 
     def run(self, timeout: Optional[float] = None) -> None:
         """start + wait + stop."""
@@ -417,6 +663,10 @@ class Pipeline:
             return fn(*args)
         except BaseException as e:  # noqa: BLE001 — worker boundary
             self.log.exception("element %s failed", el.name)
+            h = self.health_map.get(el.name)
+            if h is not None:
+                h.state = "failed"
+                h.last_error = repr(e)
             self.errors.append(e)
             self.post(BusMessage("error", el.name, e))
             self._stop_flag.set()
@@ -460,14 +710,49 @@ class Pipeline:
                     for sp, ev in outs:
                         self._push(el, sp, ev)
                     continue
+                if FAULTS.is_armed():
+                    FAULTS.check(f"element.{el.name}.frames")
                 if self.tracer is not None:
                     self.tracer.stamp_source(frame)
                 if not self._push(el, 0, frame):
                     return
             for i in range(len(el.srcpads)):
                 self._push(el, i, EOS())
+            h = self.health_map.get(el.name)
+            if h is not None and h.state == "running":
+                h.state = "finished"
 
-        self._guard(el, body)
+        def supervised_body():
+            # source supervision: `restart` re-opens the element (the
+            # flaky-camera case) and re-enters frames() from its current
+            # state — fresh CapsEvents re-negotiate downstream; frames
+            # emitted before the crash are NOT replayed.  `skip` cannot
+            # resume a broken generator mid-frame, so sources treat it
+            # as fail-stop.
+            while True:
+                try:
+                    return body()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — policy boundary
+                    if el.props.get("error-policy") != "restart":
+                        raise
+                    from ..core.resilience import is_transient
+
+                    if not is_transient(e):
+                        # fatal classification: a deterministic bug a
+                        # restart cannot cure — fail fast instead of
+                        # crash-looping through the budget (a source has
+                        # no input frame to dead-letter)
+                        raise
+                    verdict = self._restart_element(el, e)
+                    if verdict == "retry":
+                        continue
+                    if verdict == "stopping":
+                        return
+                    raise
+
+        self._guard(el, supervised_body)
 
     def _run_element(self, el: Element) -> None:
         connected = {
@@ -481,6 +766,9 @@ class Pipeline:
         caps_pads: set = set()
 
         def finish_eos():
+            h = self.health_map.get(el.name)
+            if h is not None and h.state not in ("degraded", "failed"):
+                h.state = "finished"
             if any(p.is_linked for p in el.srcpads):
                 for i in range(len(el.srcpads)):
                     self._push(el, i, EOS())
@@ -522,7 +810,7 @@ class Pipeline:
                             getattr(el._mailbox, "maxsize", 0),
                         )
                     except Exception:
-                        pass
+                        self.log.debug("tracer queue_level failed", exc_info=True)
                 if isinstance(item, TensorFrame):
                     # micro-batching: batch-capable elements drain extra
                     # queued frames and process them in one call (the TPU
@@ -602,7 +890,15 @@ class Pipeline:
                         t_in = (
                             time.perf_counter() if tracer is not None else 0.0
                         )
-                        outs = el.handle_frame_batch(pad, frames) or []
+                        outs = self._supervised(
+                            el,
+                            lambda: el.handle_frame_batch(pad, frames) or [],
+                            frames,
+                            per_item=lambda f, pad=pad: (
+                                el.handle_frame_batch(pad, [f]) or []),
+                        )
+                        if outs is self._SUPERVISED_STOPPING:
+                            return
                         if tracer is not None:
                             tracer.frame_out(
                                 el.name, t_in, time.perf_counter(),
@@ -623,12 +919,30 @@ class Pipeline:
                             # crop/transform/wire sinks/...) get logical
                             # frames, never a surprise batch axis —
                             # semantics first, blocks are an opt-in
-                            # optimization (BATCH_AWARE)
+                            # optimization (BATCH_AWARE).  Each logical
+                            # frame is supervised INDIVIDUALLY: a batch-
+                            # call-then-replay would re-run the already-
+                            # processed prefix on a stateful element
                             outs = []
                             for lf in item.split():
-                                outs.extend(el.handle_frame(pad, lf) or [])
+                                res = self._supervised(
+                                    el,
+                                    lambda lf=lf, pad=pad:
+                                    el.handle_frame(pad, lf) or [],
+                                    lf,
+                                )
+                                if res is self._SUPERVISED_STOPPING:
+                                    return
+                                outs.extend(res)
                         else:
-                            outs = el.handle_frame(pad, item) or []
+                            outs = self._supervised(
+                                el,
+                                lambda item=item, pad=pad:
+                                el.handle_frame(pad, item) or [],
+                                item,
+                            )
+                            if outs is self._SUPERVISED_STOPPING:
+                                return
                         if tracer is not None:
                             tracer.frame_out(
                                 el.name, t_in, time.perf_counter(),

@@ -1,0 +1,937 @@
+"""Resilience layer: retry/backoff (fake clock), circuit breakers,
+error-policy truth table, fault-injected end-to-end recovery, and the
+no-silent-except lint gate.
+
+All tier-1 fast: fake clocks for anything time-shaped, real backoffs
+capped at tens of milliseconds, no sleeps > 0.2s.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.resilience import (
+    FAULTS,
+    CircuitBreaker,
+    CircuitOpenError,
+    FatalError,
+    RetryPolicy,
+    TransientError,
+    is_transient,
+)
+from nnstreamer_tpu.elements.basic import AppSrc, TensorSink
+from nnstreamer_tpu.pipeline import parse_pipeline
+from nnstreamer_tpu.pipeline.element import (
+    ElementError,
+    SourceElement,
+    TransformElement,
+)
+from nnstreamer_tpu.pipeline.pipeline import Pipeline
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+class TestClassification:
+    def test_transient_types(self):
+        for e in (ConnectionError("x"), TimeoutError("x"),
+                  BrokenPipeError("x"), OSError("x"), TransientError("x")):
+            assert is_transient(e), e
+
+    def test_fatal_types(self):
+        for e in (ValueError("x"), TypeError("x"), KeyError("x"),
+                  NotImplementedError("x"), FatalError("x")):
+            assert not is_transient(e), e
+
+    def test_unknown_defaults_transient(self):
+        class Weird(Exception):
+            pass
+
+        assert is_transient(Weird("x"))
+
+    def test_marker_attribute_wins(self):
+        e = ValueError("x")
+        e.nns_transient = True
+        assert is_transient(e)
+        e2 = ConnectionError("x")
+        e2.nns_transient = False
+        assert not is_transient(e2)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy (fake clock — zero real sleeping)
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_backoff_sequence_no_jitter(self):
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.05, multiplier=2.0,
+                        max_delay_s=0.15, jitter=0.0)
+        assert [p.delay_for(k) for k in (1, 2, 3, 4)] == [
+            0.05, 0.10, 0.15, 0.15]  # capped
+
+    def test_jitter_deterministic_per_seed(self):
+        a = RetryPolicy(jitter=0.5, seed=42)
+        b = RetryPolicy(jitter=0.5, seed=42)
+        assert [a.delay_for(k) for k in range(1, 5)] == [
+            b.delay_for(k) for k in range(1, 5)]
+
+    def test_retries_transient_until_success(self):
+        clk = FakeClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.1, jitter=0.0)
+        assert p.call(flaky, sleep=clk.sleep, clock=clk) == "ok"
+        assert len(calls) == 3
+        assert clk.sleeps == [0.1, 0.2]  # exponential, fake-slept
+
+    def test_fatal_not_retried(self):
+        clk = FakeClock()
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("bad schema")
+
+        p = RetryPolicy(max_attempts=5, jitter=0.0)
+        with pytest.raises(ValueError):
+            p.call(broken, sleep=clk.sleep, clock=clk)
+        assert len(calls) == 1 and clk.sleeps == []
+
+    def test_attempts_exhausted_reraises_last(self):
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.01, jitter=0.0)
+        clk = FakeClock()
+        with pytest.raises(ConnectionError):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("down")),
+                   sleep=clk.sleep, clock=clk)
+        assert len(clk.sleeps) == 2  # 3 attempts -> 2 backoffs
+
+    def test_deadline_budget_stops_retries(self):
+        clk = FakeClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            clk.t += 0.4  # each attempt burns 0.4s of budget
+            raise TimeoutError("slow")
+
+        p = RetryPolicy(max_attempts=10, base_delay_s=0.3, jitter=0.0,
+                        deadline_s=1.0)
+        with pytest.raises(TimeoutError):
+            p.call(flaky, sleep=clk.sleep, clock=clk)
+        # 0.4 + 0.3 backoff + 0.4 = 1.1 > 1.0 -> no third attempt
+        assert len(calls) == 2
+
+    def test_on_retry_callback(self):
+        seen = []
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.05, jitter=0.0)
+        clk = FakeClock()
+        with pytest.raises(ConnectionError):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   on_retry=lambda a, e, d: seen.append((a, d)),
+                   sleep=clk.sleep, clock=clk)
+        assert seen == [(1, 0.05), (2, 0.1)]
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker (fake clock)
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def make(self, clk, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return CircuitBreaker(clock=clk, name="t", **kw)
+
+    def test_stays_closed_below_threshold(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed" and b.allow()
+
+    def test_trips_open_at_threshold(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "open" and not b.allow()
+        assert b.trip_count == 1
+
+    def test_rolling_window_forgets_old_failures(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        b.record_failure()
+        b.record_failure()
+        clk.t += 11.0  # both fall out of the 10s window
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_probe_then_close(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.t += 5.0
+        assert b.state == "half-open"
+        assert b.allow()        # the single probe slot
+        assert not b.allow()    # no second probe
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.t += 5.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        assert b.trip_count == 2
+        clk.t += 4.9
+        assert not b.allow()
+        clk.t += 0.2
+        assert b.allow()  # half-open again
+
+    def test_call_wrapper_raises_circuit_open(self):
+        clk = FakeClock()
+        b = self.make(clk, failure_threshold=1)
+        with pytest.raises(RuntimeError):
+            b.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+        with pytest.raises(CircuitOpenError):
+            b.call(lambda: "never runs")
+
+    def test_circuit_open_error_is_transient(self):
+        assert is_transient(CircuitOpenError("open"))
+
+    def test_snapshot(self):
+        clk = FakeClock()
+        b = self.make(clk, failure_threshold=1)
+        b.record_failure()
+        snap = b.snapshot()
+        assert snap["state"] == "open" and snap["trips"] == 1
+
+    def test_stale_inflight_failure_is_not_a_probe_failure(self):
+        # a request older than the open window (timeout > reset_timeout)
+        # failing during half-open must NOT re-open the breaker: no
+        # probe was granted, so there is nothing to fail
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        clk.t += 5.0
+        assert b.state == "half-open"
+        b.record_failure()  # stale in-flight failure, no allow() yet
+        assert b.state == "half-open" and b.trip_count == 1
+        assert b.allow()  # the real probe is still available
+        b.record_success()
+        assert b.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector determinism
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def site_hits(self, **arm_kw):
+        FAULTS.arm("t.site", **arm_kw)
+        hits = []
+        for i in range(20):
+            try:
+                FAULTS.check("t.site")
+                hits.append(0)
+            except BaseException:
+                hits.append(1)
+        FAULTS.disarm("t.site")
+        return hits
+
+    def test_unarmed_is_noop(self):
+        FAULTS.check("never.armed")  # must not raise
+
+    def test_rate_deterministic_same_seed(self):
+        a = self.site_hits(rate=0.4, seed=11)
+        b = self.site_hits(rate=0.4, seed=11)
+        assert a == b and 0 < sum(a) < 20
+
+    def test_every_strictly_periodic(self):
+        hits = self.site_hits(every=4)
+        assert hits == [1 if i % 4 == 0 else 0 for i in range(20)]
+
+    def test_after_and_times(self):
+        hits = self.site_hits(rate=1.0, after=3, times=2)
+        assert hits == [0, 0, 0, 1, 1] + [0] * 15
+
+    def test_custom_exception_and_stats(self):
+        FAULTS.arm("t.exc", exc=BrokenPipeError, every=2)
+        with pytest.raises(BrokenPipeError):
+            FAULTS.check("t.exc")
+        FAULTS.check("t.exc")
+        assert FAULTS.stats("t.exc") == {"calls": 2, "fired": 1}
+
+    def test_callback_controls_everything(self):
+        FAULTS.arm("t.cb", callback=lambda i: OSError("x") if i == 1 else None)
+        FAULTS.check("t.cb")
+        with pytest.raises(OSError):
+            FAULTS.check("t.cb")
+        FAULTS.check("t.cb")
+
+    def test_reset_clears_all(self):
+        FAULTS.arm("t.a", rate=1.0)
+        FAULTS.reset()
+        FAULTS.check("t.a")
+        assert not FAULTS.armed_sites()
+
+
+# ---------------------------------------------------------------------------
+# error-policy truth table (pipeline supervision)
+# ---------------------------------------------------------------------------
+class Pass(TransformElement):
+    """Counting identity element used as the supervision target."""
+
+    FACTORY_NAME = "pass"
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.starts = 0
+        self.stops = 0
+
+    def start(self):
+        self.starts += 1
+
+    def stop(self):
+        self.stops += 1
+
+    def transform(self, frame):
+        return frame
+
+
+def run_policy_pipeline(policy, n=9, site_kw=None, el_props=None,
+                        expect_error=None):
+    """One appsrc ! Pass(policy) ! sink run with faults armed on the
+    Pass element's scheduler site; returns (pipe, sink frames, warnings)."""
+    pipe = Pipeline("tp")
+    src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+    mid.set_property("error-policy", policy)
+    for k, v in (el_props or {}).items():
+        mid.set_property(k, v)
+    pipe.chain(src, mid, sink)
+    warnings = []
+    pipe.add_bus_watcher(
+        lambda m: warnings.append(m) if m.kind == "warning" else None)
+    if site_kw:
+        FAULTS.arm("element.mid.handle_frame", **site_kw)
+    pipe.start()
+    for i in range(n):
+        src.push(np.float32([i]))
+    src.end_of_stream()
+    if expect_error is None:
+        pipe.wait(timeout=20)
+    else:
+        with pytest.raises(expect_error):
+            pipe.wait(timeout=20)
+    return pipe, sink, warnings
+
+
+class TestErrorPolicyTruthTable:
+    def test_invalid_policy_rejected(self):
+        el = Pass("x")
+        with pytest.raises((ElementError, ValueError)):
+            el.set_property("error-policy", "retry-forever")
+
+    def test_invalid_degrade_rejected(self):
+        from nnstreamer_tpu.elements.query import TensorQueryClient
+
+        q = TensorQueryClient("q")
+        with pytest.raises((ElementError, ValueError)):
+            q.set_property("degrade", "pass-through")  # typo must fail EARLY
+
+    def test_fail_stop_default_kills_pipeline(self):
+        pipe, sink, _ = run_policy_pipeline(
+            "fail-stop", site_kw=dict(every=3, exc=ConnectionResetError),
+            expect_error=ConnectionResetError)
+        assert pipe.health()["mid"]["state"] == "failed"
+        pipe.stop()
+
+    def test_skip_drops_to_dead_letter_and_continues(self):
+        pipe, sink, warnings = run_policy_pipeline(
+            "skip", n=9, site_kw=dict(every=3, exc=ConnectionResetError))
+        assert len(sink.frames) == 6  # every 3rd of 9 dropped
+        h = pipe.health()["mid"]
+        assert h["dead_letters"] == 3 and h["state"] == "finished"
+        assert [m for m in warnings if m.data.get("policy") == "skip"]
+        pipe.stop()
+
+    def test_skip_dead_letter_queue_bounded(self):
+        pipe, sink, _ = run_policy_pipeline(
+            "skip", n=10, site_kw=dict(rate=1.0),
+            el_props={"dead-letter-max": 4})
+        h = pipe.health()["mid"]
+        assert len(sink.frames) == 0
+        assert h["dead_letters"] == 10      # lifetime counter unbounded
+        assert h["dead_letter_depth"] == 4  # retention bounded
+        pipe.stop()
+
+    def test_skip_dead_letter_max_zero_retains_nothing(self):
+        # 0 = count drops but pin NO frame payloads in memory
+        pipe, sink, _ = run_policy_pipeline(
+            "skip", n=5, site_kw=dict(rate=1.0),
+            el_props={"dead-letter-max": 0})
+        h = pipe.health()["mid"]
+        assert h["dead_letters"] == 5 and h["dead_letter_depth"] == 0
+        pipe.stop()
+
+    def test_restart_retries_frame_zero_loss(self):
+        pipe, sink, warnings = run_policy_pipeline(
+            "restart", n=8,
+            site_kw=dict(every=4, times=2, exc=TimeoutError),
+            el_props={"restart-backoff": 0.01, "max-restarts": 10})
+        assert len(sink.frames) == 8  # faulted frames retried, zero loss
+        h = pipe.health()["mid"]
+        assert h["restarts"] == 2 and h["state"] == "finished"
+        assert pipe["mid"].stops >= 2 and pipe["mid"].starts >= 3
+        assert [m for m in warnings if "restart" in m.data]
+        pipe.stop()
+
+    def test_restart_degrades_to_fail_stop_after_budget(self):
+        pipe, sink, warnings = run_policy_pipeline(
+            "restart", n=3, site_kw=dict(rate=1.0, exc=ConnectionResetError),
+            el_props={"restart-backoff": 0.0, "max-restarts": 2},
+            expect_error=ConnectionResetError)
+        h = pipe.health()["mid"]
+        assert h["restarts"] == 2
+        assert h["state"] == "failed"  # degraded, then the error surfaced
+        assert [m for m in warnings if m.data.get("degraded")]
+        pipe.stop()
+
+    def test_restart_fatal_error_dead_letters_instead(self):
+        # poison frames (fatal classification) must not burn the restart
+        # budget — a restart cannot fix bad input
+        pipe, sink, warnings = run_policy_pipeline(
+            "restart", n=6, site_kw=dict(every=3, exc=ValueError),
+            el_props={"max-restarts": 1})
+        h = pipe.health()["mid"]
+        assert len(sink.frames) == 4       # 2 poison frames dropped
+        assert h["dead_letters"] == 2
+        assert h["restarts"] == 0          # budget untouched
+        assert h["state"] == "finished"
+        pipe.stop()
+
+    def test_restart_window_refills_budget(self):
+        # two isolated glitches separated by more than restart-window
+        # must NOT accumulate against max-restarts=1 (always-on contract)
+        pipe = Pipeline("tw")
+        src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+        mid.set_property("error-policy", "restart")
+        mid.set_property("max-restarts", 1)
+        mid.set_property("restart-backoff", 0.0)
+        mid.set_property("restart-window", 0.05)
+        pipe.chain(src, mid, sink)
+        FAULTS.arm("element.mid.handle_frame", every=2, times=2,
+                   exc=TimeoutError)  # faults on the 1st and 3rd call
+        pipe.start()
+        src.push(np.float32([0]))  # fault -> restart 1/1
+        deadline = time.monotonic() + 5
+        while (pipe.health()["mid"]["restarts"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.005)      # restart observed before the gap starts
+        time.sleep(0.08)           # sustained health > restart-window
+        src.push(np.float32([1]))
+        src.push(np.float32([2]))  # fault again -> budget had refilled
+        src.end_of_stream()
+        pipe.wait(timeout=20)
+        h = pipe.health()["mid"]
+        assert len(sink.frames) == 3
+        assert h["restarts"] == 2 and h["restarts_window"] == 1
+        assert h["state"] == "finished"
+        pipe.stop()
+
+    def test_events_remain_fail_stop_under_skip(self):
+        # EOS/caps handling is outside the policy boundary: an element
+        # whose frame path skips still completes EOS normally
+        pipe, sink, _ = run_policy_pipeline(
+            "skip", n=4, site_kw=dict(rate=1.0))
+        assert pipe.health()["mid"]["state"] == "finished"
+        pipe.stop()
+
+    def test_skip_isolates_poison_within_micro_batch(self):
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.pipeline.element import Element
+
+        class BatchScaler(Element):
+            """Micro-batching element that chokes on value 7."""
+
+            FACTORY_NAME = "batchscaler"
+            preferred_batch = 4
+            batch_wait_s = 0.05  # let batches actually form
+
+            def handle_frame(self, pad, frame):
+                return self.handle_frame_batch(pad, [frame])
+
+            def handle_frame_batch(self, pad, frames):
+                if any(float(f.tensors[0][0]) == 7.0 for f in frames):
+                    raise RuntimeError("poison value")
+                return [
+                    (0, TensorFrame([f.tensors[0] * 2])) for f in frames
+                ]
+
+        pipe = Pipeline("iso")
+        src, mid, sink = AppSrc("src"), BatchScaler("mid"), TensorSink("out")
+        mid.set_property("error-policy", "skip")
+        pipe.chain(src, mid, sink)
+        pipe.start()
+        n = 8
+        for i in range(n):
+            src.push(np.float32([i]))
+        src.end_of_stream()
+        pipe.wait(timeout=20)
+        h = pipe.health()["mid"]
+        vals = sorted(float(f.tensors[0][0]) for f in sink.frames)
+        # ONLY frame 7 is lost — its batchmates survive via isolation
+        assert vals == [i * 2.0 for i in range(n) if i != 7]
+        assert h["dead_letters"] == 1
+        pipe.stop()
+
+    def test_block_split_skip_processes_each_logical_frame_once(self):
+        # a stateful non-batch-aware element + block ingest + skip: the
+        # poisoned logical frame is dropped alone and NO frame is
+        # processed twice (no batch-call-then-replay on the split path)
+        from nnstreamer_tpu.pipeline.element import TransformElement
+
+        class StatefulDoubler(TransformElement):
+            FACTORY_NAME = "statefuldoubler"
+
+            def __init__(self, name=None):
+                super().__init__(name)
+                self.seen = []
+
+            def transform(self, frame):
+                v = float(frame.tensors[0][0])
+                self.seen.append(v)
+                if v == 2.0:
+                    raise RuntimeError("poison")
+                return frame
+
+        pipe = Pipeline("blk")
+        src, mid, sink = AppSrc("src"), StatefulDoubler("mid"), TensorSink("out")
+        mid.set_property("error-policy", "skip")
+        pipe.chain(src, mid, sink)
+        pipe.start()
+        src.push_block(np.arange(5, dtype=np.float32).reshape(5, 1))
+        src.end_of_stream()
+        pipe.wait(timeout=20)
+        assert mid.seen == [0.0, 1.0, 2.0, 3.0, 4.0]  # once each, in order
+        assert len(sink.frames) == 4
+        assert pipe.health()["mid"]["dead_letters"] == 1
+        pipe.stop()
+
+    def test_source_restart_fatal_fails_fast(self):
+        class BuggyCam(SourceElement):
+            FACTORY_NAME = "buggycam"
+
+            def frames(self):
+                raise ValueError("deterministic bug")
+                yield  # pragma: no cover
+
+        pipe = Pipeline("bug")
+        cam, sink = BuggyCam("cam"), TensorSink("out")
+        cam.set_property("error-policy", "restart")
+        pipe.chain(cam, sink)
+        pipe.start()
+        with pytest.raises(ValueError):
+            pipe.wait(timeout=20)
+        assert pipe.health()["cam"]["restarts"] == 0  # no crash-loop
+        pipe.stop()
+
+    def test_source_restart_reopens_flaky_camera(self):
+        class FlakyCam(SourceElement):
+            FACTORY_NAME = "flakycam"
+
+            def __init__(self, name=None):
+                super().__init__(name)
+                self.cursor = 0
+                self.crashed = False
+
+            def frames(self):
+                from nnstreamer_tpu.core.buffer import TensorFrame
+
+                while self.cursor < 10:
+                    if self.cursor == 4 and not self.crashed:
+                        self.crashed = True
+                        raise ConnectionError("camera unplugged")
+                    i = self.cursor
+                    self.cursor += 1
+                    yield TensorFrame([np.float32([i])])
+
+        pipe = Pipeline("cam")
+        cam, sink = FlakyCam("cam"), TensorSink("out")
+        cam.set_property("error-policy", "restart")
+        cam.set_property("restart-backoff", 0.01)
+        pipe.chain(cam, sink)
+        pipe.start()
+        pipe.wait(timeout=20)
+        assert len(sink.frames) == 10  # resumed from its cursor, no dupes
+        assert pipe.health()["cam"]["restarts"] == 1
+        pipe.stop()
+
+
+# ---------------------------------------------------------------------------
+# wait(timeout) teardown contract
+# ---------------------------------------------------------------------------
+def test_wait_timeout_stops_workers():
+    pipe = Pipeline("hang")
+    src, sink = AppSrc("src"), TensorSink("out")
+    pipe.chain(src, sink)
+    pipe.start()
+    src.push(np.float32([1]))  # no EOS -> wait must time out
+    with pytest.raises(TimeoutError):
+        pipe.wait(timeout=0.15)
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        if not any(t.name in ("src", "out") and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.01)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name in ("src", "out") and t.is_alive()]
+    assert not leaked, f"wait(timeout) leaked workers: {leaked}"
+
+
+# ---------------------------------------------------------------------------
+# tcp query pool hygiene (satellite audit)
+# ---------------------------------------------------------------------------
+class TestTcpPoolHygiene:
+    def make_server(self, sid):
+        pipe = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id={sid} port=0 "
+            "connect-type=tcp ! "
+            "tensor_filter framework=scaler custom=factor:2 ! "
+            f"tensor_query_serversink id={sid}")
+        pipe.start()
+        return pipe, pipe["ssrc"].props["port"]
+
+    def test_recv_failure_evicts_socket_from_pool(self):
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.distributed.tcp_query import TcpQueryConnection
+
+        server, port = self.make_server(941)
+        conn = TcpQueryConnection("localhost", port, timeout=5.0, nconns=2)
+        try:
+            conn.invoke(TensorFrame([np.float32([1])]))
+            assert len(conn._free) == 1
+            FAULTS.arm("tcp_query.recv", times=1, exc=ConnectionResetError)
+            with pytest.raises(ConnectionResetError):
+                conn.invoke(TensorFrame([np.float32([2])]))
+            # the broken socket must be CLOSED and GONE, not pooled
+            assert len(conn._free) == 0 and conn._live == 0
+            FAULTS.reset()
+            out = conn.invoke(TensorFrame([np.float32([3])]))  # fresh dial
+            assert float(out.tensors[0][0]) == 6.0
+        finally:
+            conn.close()
+            server.stop()
+
+    def test_stale_pooled_socket_send_retries_fresh(self):
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.distributed.tcp_query import TcpQueryConnection
+
+        server, port = self.make_server(942)
+        conn = TcpQueryConnection("localhost", port, timeout=5.0, nconns=2)
+        try:
+            conn.invoke(TensorFrame([np.float32([1])]))  # pools one socket
+            # a send-phase failure on the REUSED socket is retried once
+            # on a fresh dial — the caller never sees it
+            FAULTS.arm("tcp_query.send", times=1, exc=BrokenPipeError)
+            out = conn.invoke(TensorFrame([np.float32([2])]))
+            assert float(out.tensors[0][0]) == 4.0
+            assert FAULTS.stats("tcp_query.send")["fired"] == 1
+        finally:
+            conn.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# edgesrc failover dial
+# ---------------------------------------------------------------------------
+def test_edgesrc_dest_hosts_failover_dial():
+    from nnstreamer_tpu.distributed.tcp_edge import TcpEdgeServer
+    from nnstreamer_tpu.elements.edge import EdgeSrc
+
+    srv = TcpEdgeServer(port=0)
+    try:
+        el = EdgeSrc("esrc")
+        el.set_property("connect-type", "tcp")
+        # first target refuses; failover dials the live one
+        el.set_property("dest-hosts", f"localhost:1,localhost:{srv.port}")
+        el.set_property("topic", "tv")
+        el.start()
+        deadline = time.monotonic() + 2.0
+        while srv.subscriber_count("tv") == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.subscriber_count("tv") == 1
+        el.stop()
+    finally:
+        srv.close()
+
+
+def test_edgesrc_direct_dead_targets_fail_loudly():
+    # gRPC channels dial lazily; with dest-hosts failover configured the
+    # dial must PROBE, so dead targets fail start() instead of silently
+    # "connecting" to the first dead endpoint
+    from nnstreamer_tpu.elements.edge import EdgeSrc
+
+    el = EdgeSrc("esrc")
+    el.set_property("connect-type", "direct")
+    el.set_property("dest-hosts", "localhost:1,localhost:2")
+    with pytest.raises(ConnectionError):
+        el.start()
+
+
+def test_remote_application_error_does_not_trip_breaker():
+    # a healthy server answering with error REPLIES (poison frames) must
+    # never open its breaker or mark it down — only transport faults do
+    from nnstreamer_tpu.core.resilience import RemoteApplicationError
+    from nnstreamer_tpu.elements.query import TensorQueryClient, _PoolState
+
+    q = TensorQueryClient("q")
+    q.set_property("breaker-threshold", 2)
+    q.set_property("retries", 0)
+    q.set_property("retry-backoff", 0.0)
+
+    class FakeConn:
+        addr = "fake:1"
+
+        def invoke(self, frame, timeout):
+            raise RemoteApplicationError("undecodable frame")
+
+    q._pstate = _PoolState((FakeConn(),), (("fake", 1),), 0)
+    q._stopped = False
+    for _ in range(5):
+        with pytest.raises(RemoteApplicationError):
+            q._invoke_failover(object(), 0)
+    snap = q.health_info()["breakers"]["fake:1"]
+    assert snap["state"] == "closed" and snap["trips"] == 0
+    assert is_transient(RemoteApplicationError("x"))  # still retryable
+
+
+def test_mid_stream_failure_counts_against_breaker():
+    # a server that repeatedly dies mid-stream must lose its breaker
+    # (record_success on the first answer must not immunize the crash)
+    from nnstreamer_tpu.core.buffer import TensorFrame
+    from nnstreamer_tpu.elements.query import TensorQueryClient, _PoolState
+
+    class MidStreamCrash:
+        addr = "fake:1"
+
+        def invoke_stream(self, frame, timeout):
+            yield TensorFrame([np.float32([1])])
+            raise ConnectionResetError("mid-stream crash")
+
+    q = TensorQueryClient("q")
+    q.set_property("breaker-threshold", 2)
+    q.set_property("stream", True)
+    q._pstate = _PoolState((MidStreamCrash(),), (("fake", 1),), 0)
+    q._stopped = False
+    frame = TensorFrame([np.float32([0])])
+    for _ in range(2):
+        with pytest.raises(ConnectionResetError):
+            list(q._stream_invoke(frame))
+    snap = q.health_info()["breakers"]["fake:1"]
+    assert snap["state"] == "open" and snap["trips"] == 1
+
+
+def test_edgesrc_bad_dest_hosts_rejected():
+    from nnstreamer_tpu.elements.edge import EdgeSrc
+
+    el = EdgeSrc("esrc")
+    el.set_property("dest-hosts", "nonsense")
+    with pytest.raises(ElementError):
+        el.start()
+
+
+# ---------------------------------------------------------------------------
+# lint gate: no silent exception swallowing
+# ---------------------------------------------------------------------------
+def test_no_bare_except():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+    try:
+        import check_no_bare_except
+    finally:
+        sys.path.pop(0)
+    bad = check_no_bare_except.scan()
+    assert not bad, f"silent exception handlers: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault-injected end-to-end offload with failover (acceptance)
+# ---------------------------------------------------------------------------
+class TestChaosEndToEnd:
+    def make_server(self, sid):
+        pipe = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id={sid} port=0 "
+            "connect-type=tcp ! "
+            "tensor_filter framework=scaler custom=factor:2 ! "
+            f"tensor_query_serversink id={sid}")
+        pipe.start()
+        return pipe, pipe["ssrc"].props["port"]
+
+    def test_flaky_transport_and_server_kill_zero_loss(self):
+        """30% transient send faults + one mid-stream server kill with a
+        failover remote: the run completes with zero frame loss beyond
+        the configured skip drops (degrade=skip accounts every one), and
+        health() shows the breaker trips.
+
+        Retries absorb virtually all injected faults; degrade=skip is
+        the accounting backstop for the probabilistic residue (a frame
+        whose 6 attempts ALL draw the 30% fault), so the assertion is an
+        exact identity, not a race."""
+        sa, pa = self.make_server(951)
+        sb, pb = self.make_server(952)
+        FAULTS.arm("tcp_query.send", rate=0.30, seed=7,
+                   exc=ConnectionResetError)
+        # breaker-reset (0.3s) < the retries=5 backoff budget (~0.31s+),
+        # so even if injected faults trip BOTH breakers, a half-open
+        # probe is granted within one frame's attempt budget — the
+        # breaker can never convert the whole run into skip drops
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q connect-type=tcp "
+            f"hosts=localhost:{pa},localhost:{pb} retries=5 "
+            "retry-backoff=0.01 breaker-threshold=3 breaker-reset=0.3 "
+            "degrade=skip timeout=5 max-in-flight=4 ! tensor_sink name=out")
+        client.start()
+        killed = False
+        try:
+            n = 40
+            for i in range(n):
+                client["src"].push(np.float32([i]))
+                if i == 15:
+                    sa.stop()  # mid-stream kill; failover to server B
+                    killed = True
+            client["src"].end_of_stream()
+            client.wait(timeout=60)
+            h = client.health()["q"]
+            vals = sorted(float(f.tensors[0][0]) for f in client["out"].frames)
+            # exact accounting: every pushed frame either answered
+            # (correct value, no dupes) or counted as a skip drop
+            assert len(vals) + h["degraded_frames"] == n, (
+                f"unaccounted loss: {len(vals)} answered + "
+                f"{h['degraded_frames']} skipped != {n}")
+            assert set(vals) <= {i * 2.0 for i in range(n)}
+            assert len(set(vals)) == len(vals)  # ordered-unique answers
+            # retries must absorb nearly everything — skip is a backstop
+            assert h["degraded_frames"] <= 4, h
+            # the dead remote's breaker tripped and the trip is reported
+            dead = h["breakers"].get(f"localhost:{pa}", {})
+            assert dead.get("trips", 0) >= 1, h
+            assert FAULTS.stats("tcp_query.send")["fired"] > 0
+        finally:
+            client.stop()
+            if not killed:
+                sa.stop()
+            sb.stop()
+
+    def test_local_filter_restart_chaos_zero_loss(self):
+        """filter.invoke faults + error-policy=restart: the supervisor
+        restarts the filter and retries, health reports the restarts."""
+        FAULTS.arm("filter.invoke", every=5, times=3, exc=TimeoutError)
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=scaler custom=factor:3 "
+            "error-policy=restart restart-backoff=0.01 max-restarts=10 ! "
+            "tensor_sink name=out")
+        pipe.start()
+        n = 20
+        for i in range(n):
+            pipe["src"].push(np.float32([i]))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        vals = sorted(float(f.tensors[0][0]) for f in pipe["out"].frames)
+        assert vals == [i * 3.0 for i in range(n)]
+        h = pipe.health()["f"]
+        assert h["restarts"] == 3 and h["state"] == "finished"
+        pipe.stop()
+
+    def test_stream_mode_honors_degrade_skip(self):
+        """stream=true: a request that fails on every remote BEFORE its
+        first answer degrades per degrade= instead of killing the
+        pipeline (mid-stream breaks still surface — partial output
+        already left)."""
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q "
+            "host=localhost port=1 stream=true retries=0 retry-backoff=0 "
+            "timeout=0.3 breaker-threshold=0 degrade=skip ! "
+            "tensor_sink name=out")
+        client.start()
+        for i in range(3):
+            client["src"].push(np.float32([i]))
+        client["src"].end_of_stream()
+        client.wait(timeout=30)
+        assert len(client["out"].frames) == 0
+        assert client.health()["q"]["degraded_frames"] == 3
+        client.stop()
+
+    def test_query_client_ignores_worker_skip_policy(self):
+        """The query client supervises its own errors (degrade=): with
+        pipelined in-flight answers, worker-level skip would dead-letter
+        the WRONG frame, so the scheduler runs it fail-stop and failures
+        surface unless degrade= is set."""
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q connect-type=tcp "
+            "host=localhost port=1 retries=0 retry-backoff=0 timeout=0.3 "
+            "breaker-threshold=0 error-policy=skip ! tensor_sink name=out")
+        client.start()
+        client["src"].push(np.float32([0]))
+        client["src"].end_of_stream()
+        with pytest.raises(Exception):
+            client.wait(timeout=20)
+        assert client.health()["q"]["dead_letters"] == 0  # nothing misfiled
+        client.stop()
+
+    def test_degrade_skip_accounts_every_drop(self):
+        """degrade=skip against a dead-only remote: the stream completes,
+        and loss == exactly the skipped frames (the acceptance wording:
+        zero loss beyond the configured skip drops)."""
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q connect-type=tcp "
+            "host=localhost port=1 retries=0 retry-backoff=0 timeout=0.3 "
+            "breaker-threshold=1 breaker-reset=60 degrade=skip ! "
+            "tensor_sink name=out")
+        client.start()
+        n = 6
+        for i in range(n):
+            client["src"].push(np.float32([i]))
+        client["src"].end_of_stream()
+        client.wait(timeout=30)
+        h = client.health()["q"]
+        assert len(client["out"].frames) == 0
+        assert h["degraded_frames"] == n  # every drop accounted
+        assert h["breakers"]["localhost:1"]["trips"] >= 1
+        client.stop()

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Lint gate: no silent exception swallowing in nnstreamer_tpu/.
+
+Flags two patterns that hide failures from the resilience layer (which
+classifies and reports errors — see Documentation/resilience.md):
+
+* bare ``except:`` — catches SystemExit/KeyboardInterrupt too;
+* ``except Exception:`` / ``except BaseException:`` whose body is only
+  ``pass`` — an error black hole (no log, no bus message, no counter).
+
+Narrow handlers with ``pass`` (``except ValueError: pass``) are fine —
+they document exactly what is being ignored.  Genuinely-intended
+swallow-alls (``__del__``, teardown of already-dead resources) carry an
+inline ``# allow-silent: <reason>`` on the ``except`` or ``pass`` line,
+or go on the file:line allowlist below with a reason.
+
+Exit status: 0 clean, 1 violations (printed as file:line).  Run directly
+or via the tier-1 test ``tests/test_resilience.py::test_no_bare_except``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SCAN_DIRS = ["nnstreamer_tpu", "tools"]
+
+# file:line entries that are allowed to keep a flagged pattern, with WHY
+ALLOWLIST: dict = {
+    # (none today — add "path/to/file.py:123" -> "reason" as needed)
+}
+
+_BARE = re.compile(r"^\s*except\s*:\s*(#.*)?$")
+_BROAD = re.compile(r"^\s*except\s+(Exception|BaseException)\s*(as\s+\w+)?\s*:\s*(#.*)?$")
+_PASS = re.compile(r"^\s*pass\s*(#.*)?$")
+_ALLOW = re.compile(r"#\s*allow-silent:\s*\S")
+
+
+def scan(root: Path = ROOT) -> list:
+    bad = []
+    for d in SCAN_DIRS:
+        for path in sorted((root / d).rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            lines = path.read_text(encoding="utf-8").splitlines()
+            for i, line in enumerate(lines, 1):
+                key = f"{rel}:{i}"
+                if _BARE.match(line):
+                    if key not in ALLOWLIST and not _ALLOW.search(line):
+                        bad.append((key, "bare except:"))
+                    continue
+                if _BROAD.match(line) and not _ALLOW.search(line):
+                    # flag only when the handler body is a lone `pass`
+                    # (comment-only lines before it don't count as a body)
+                    j = i
+                    while j < len(lines) and (
+                        not lines[j].strip()
+                        or lines[j].strip().startswith("#")
+                    ):
+                        j += 1
+                    if j < len(lines) and _PASS.match(lines[j]):
+                        indent = len(line) - len(line.lstrip())
+                        body_indent = len(lines[j]) - len(lines[j].lstrip())
+                        more = (
+                            j + 1 < len(lines)
+                            and lines[j + 1].strip()
+                            and (len(lines[j + 1])
+                                 - len(lines[j + 1].lstrip())) > indent
+                        )
+                        if body_indent > indent and not more:
+                            if (key not in ALLOWLIST
+                                    and not _ALLOW.search(lines[j])):
+                                bad.append(
+                                    (key, "except Exception: pass "
+                                     "(silent swallow-all)"))
+    return bad
+
+
+def main() -> int:
+    bad = scan()
+    for key, why in bad:
+        print(f"{key}: {why}")
+    if bad:
+        print(f"\n{len(bad)} silent exception handler(s); log, re-raise, "
+              "narrow the type, or allowlist with a reason "
+              "(tools/check_no_bare_except.py)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
