@@ -1,0 +1,369 @@
+"""Liveness primitives: stall watchdog, deadline QoS, admission control.
+
+PR-1's supervision layer (``core/resilience.py``) handles elements that
+*crash*.  This module covers the failures that never raise: an element
+that silently hangs, a frame that arrives too late to matter, and a
+query server drowning in more in-flight work than it can serve.
+Reference analogs: GStreamer QoS events (``gsttensor_rate.c`` throttle
+feedback) and queue watermarks; the serving-stack version detects
+stalls, sheds late work deterministically, and refuses overload at
+admission instead of timing out deep in the stack.
+
+Design rules (same as resilience.py):
+
+* **Injectable time.**  ``Watchdog`` and the deadline helpers take
+  ``clock`` so tests run on a fake clock.
+* **Zero hot-path cost when idle.**  Heartbeat pings are two attribute
+  stores; the deadline check is one dict lookup on frames that carry no
+  deadline.
+* **Cooperative interruption.**  A hung call cannot be killed from
+  outside; escalation sets the element's interrupt flag and relies on
+  the hung site (an armed ``hang=`` fault, a backend polling
+  ``Element.interrupted``) to surface :class:`StallError`, which the
+  scheduler's restart machinery then handles like any transient fault.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .log import get_logger
+from .resilience import RemoteApplicationError, TransientError
+
+log = get_logger("liveness")
+
+
+class StallError(TransientError):
+    """A hung call was interrupted by the liveness layer.
+
+    Subclasses :class:`TransientError`: a stall is exactly the failure
+    class a restart can cure, so ``error-policy=restart`` /
+    ``stall-policy=restart`` treat it as retryable."""
+
+
+class ServerBusyError(RemoteApplicationError):
+    """The server refused the request at ADMISSION (load shed).
+
+    Subclasses :class:`RemoteApplicationError`: the server answered, so
+    breakers/cooldowns must not count it against the remote's health.
+    Admission-refused requests provably never executed, which makes a
+    resend safe even under at-most-once delivery — clients retry these
+    on a RetryPolicy-paced budget separate from ``retries``."""
+
+    def __init__(self, msg: str = "server busy", retry_after: float = 0.05):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+# ---------------------------------------------------------------------------
+# Deadline QoS
+# ---------------------------------------------------------------------------
+#: frame.meta key holding the absolute expiry instant on the LOCAL
+#: monotonic clock.  Process-local by design: monotonic instants are
+#: meaningless on another host, so transports strip this key and carry a
+#: remaining-budget DURATION on the wire instead (tcp_query header
+#: ``deadline_s`` / gRPC ``context.time_remaining()``); the receiver
+#: re-stamps on its own clock.
+DEADLINE_META = "deadline_ts"
+
+
+def stamp_deadline(
+    frame: Any,
+    budget_s: float,
+    clock: Callable[[], float] = time.monotonic,
+    anchor: Optional[float] = None,
+) -> Any:
+    """Stamp ``frame`` with an absolute deadline.
+
+    Wall-anchored (``anchor=None``): expires ``budget_s`` from now —
+    the serving contract ("answer within X of ingest").  Pts-anchored
+    (``anchor`` = the stream epoch on this clock): expires at
+    ``anchor + pts + budget_s`` — the live-playback contract (a frame
+    due at pts is worthless ``budget_s`` after its slot)."""
+    if anchor is not None and frame.pts is not None:
+        frame.meta[DEADLINE_META] = anchor + frame.pts + float(budget_s)
+    else:
+        frame.meta[DEADLINE_META] = clock() + float(budget_s)
+    return frame
+
+
+def deadline_remaining(
+    frame: Any, clock: Callable[[], float] = time.monotonic
+) -> Optional[float]:
+    """Seconds of budget left (may be negative); None = no deadline.
+    Tolerates meta-less payloads (wire batches hand opaque objects
+    through the same code paths)."""
+    meta = getattr(frame, "meta", None)
+    ts = meta.get(DEADLINE_META) if meta is not None else None
+    if ts is None:
+        return None
+    return ts - clock()
+
+
+def is_expired(
+    frame: Any,
+    now: Optional[float] = None,
+    clock: Callable[[], float] = time.monotonic,
+) -> bool:
+    """True when the frame's budget is exhausted.
+
+    Boundary contract (pinned by the deadline truth table test): a frame
+    is DELIVERED while any budget remains and DROPPED from the instant
+    ``now >= deadline`` — zero remaining budget cannot pay for any
+    downstream work, so the boundary frame is already late."""
+    meta = getattr(frame, "meta", None)
+    ts = meta.get(DEADLINE_META) if meta is not None else None
+    if ts is None:
+        return False
+    return (clock() if now is None else now) >= ts
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+class _Watch:
+    """Per-element watchdog entry: config + heartbeat + counters."""
+
+    __slots__ = (
+        "name", "stall_timeout", "frame_deadline", "policy", "qsize",
+        "on_event", "busy_since", "last_progress", "frames_done",
+        "stalls", "overruns", "_overrun_flagged", "_last_stall_flag",
+    )
+
+    def __init__(self, name, stall_timeout, frame_deadline, policy,
+                 qsize, on_event, now):
+        self.name = name
+        self.stall_timeout = float(stall_timeout)
+        self.frame_deadline = float(frame_deadline)
+        self.policy = policy
+        self.qsize = qsize
+        self.on_event = on_event
+        self.busy_since: Optional[float] = None
+        self.last_progress = now
+        self.frames_done = 0
+        self.stalls = 0
+        self.overruns = 0
+        self._overrun_flagged: Optional[float] = None  # busy episode token
+        self._last_stall_flag = float("-inf")
+
+
+def _check_stall_policy(v: str) -> str:
+    if v not in ("warn", "restart", "fail"):
+        raise ValueError(f"stall-policy {v!r} (want warn | restart | fail)")
+    return v
+
+
+class Watchdog:
+    """Per-element heartbeat registry + stall/overrun monitor.
+
+    The scheduler pings :meth:`begin`/:meth:`done` around every frame
+    call; :meth:`check` sweeps the registry and fires ``on_event(watch,
+    kind, elapsed)`` for each finding:
+
+    * ``"overrun"`` — a single call has been running longer than
+      ``frame_deadline`` (the hung-``handle_frame`` case; flagged once
+      per busy episode).
+    * ``"stall"`` — work is pending (input queued, or a call in flight)
+      but nothing has COMPLETED for ``stall_timeout``: covers both a
+      hang inside a call and a worker wedged outside processing (e.g.
+      blocked pushing downstream).  Re-flagged every ``stall_timeout``;
+      an in-call hang that also overruns is reported as the overrun in
+      that sweep (overrun wins the tie, once per episode).
+
+    Passive by design: no thread of its own.  The pipeline polls
+    :meth:`check` from a sweeper thread; tests call it directly on a
+    fake clock.  Pings are lock-free (two attribute stores on the GIL —
+    a torn read in the sweeper costs one late/spurious finding, never a
+    crash), registration is locked."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._watches: Dict[str, _Watch] = {}
+
+    def register(
+        self,
+        name: str,
+        stall_timeout: float = 0.0,
+        frame_deadline: float = 0.0,
+        policy: str = "warn",
+        qsize: Callable[[], int] = lambda: 0,
+        on_event: Optional[Callable[[_Watch, str, float], None]] = None,
+    ) -> _Watch:
+        w = _Watch(name, stall_timeout, frame_deadline,
+                   _check_stall_policy(policy), qsize, on_event,
+                   self._clock())
+        with self._lock:
+            self._watches[name] = w
+        return w
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._watches.pop(name, None)
+
+    def watch(self, name: str) -> Optional[_Watch]:
+        with self._lock:
+            return self._watches.get(name)
+
+    # -- heartbeat pings (hot path: no lock) --------------------------------
+    def begin(self, w: Optional[_Watch]) -> None:
+        if w is not None:
+            w.busy_since = self._clock()
+
+    def done(self, w: Optional[_Watch]) -> None:
+        if w is not None:
+            w.busy_since = None
+            w.last_progress = self._clock()
+            w.frames_done += 1
+            w._overrun_flagged = None
+
+    # -- monitor -------------------------------------------------------------
+    def min_interval(self) -> float:
+        """Suggested poll period: a quarter of the tightest armed bound."""
+        with self._lock:
+            bounds = [
+                b for w in self._watches.values()
+                for b in (w.stall_timeout, w.frame_deadline) if b > 0
+            ]
+        if not bounds:
+            return 0.5
+        return min(0.5, max(0.01, min(bounds) / 4.0))
+
+    def check(self, now: Optional[float] = None) -> List[Tuple[str, str, float]]:
+        """One sweep; returns ``[(element, kind, elapsed_s), ...]`` and
+        fires each watch's ``on_event`` callback."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            watches = list(self._watches.values())
+        findings: List[Tuple[str, str, float]] = []
+        for w in watches:
+            busy = w.busy_since
+            if (busy is not None and w.frame_deadline > 0
+                    and now - busy >= w.frame_deadline
+                    and w._overrun_flagged != busy):
+                w._overrun_flagged = busy  # once per episode
+                w.overruns += 1
+                findings.append((w.name, "overrun", now - busy))
+                self._fire(w, "overrun", now - busy)
+            elif (w.stall_timeout > 0
+                    and now - w.last_progress >= w.stall_timeout
+                    and now - w._last_stall_flag >= w.stall_timeout):
+                # pending work = queued input OR a call in flight — an
+                # element hung INSIDE handle_frame must be detectable by
+                # stall-timeout alone (frame-deadline is the per-call
+                # refinement, not a prerequisite)
+                if busy is not None:
+                    pending = 1
+                else:
+                    try:
+                        pending = w.qsize()
+                    except Exception:  # allow-silent: mailbox mid-teardown
+                        pending = 0
+                if pending > 0:
+                    w._last_stall_flag = now
+                    w.stalls += 1
+                    elapsed = now - w.last_progress
+                    findings.append((w.name, "stall", elapsed))
+                    self._fire(w, "stall", elapsed)
+        return findings
+
+    def _fire(self, w: _Watch, kind: str, elapsed: float) -> None:
+        log.warning(
+            "watchdog: %s %s for %.3fs (policy=%s)",
+            w.name, kind, elapsed, w.policy,
+        )
+        if w.on_event is not None:
+            try:
+                w.on_event(w, kind, elapsed)
+            except Exception:
+                log.exception("watchdog escalation for %s failed", w.name)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            watches = list(self._watches.values())
+        return {
+            w.name: {
+                "busy": w.busy_since is not None,
+                "frames_done": w.frames_done,
+                "stalls": w.stalls,
+                "overruns": w.overruns,
+            }
+            for w in watches
+        }
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+class AdmissionController:
+    """Bounded in-flight slots with high/low watermark hysteresis.
+
+    ``try_admit`` refuses once ``high`` requests are in flight and keeps
+    refusing until the backlog drains to ``low`` — the hysteresis band
+    prevents admit/refuse flapping right at the limit (reference analog:
+    GstQueue's high/low watermark signals).  ``high <= 0`` = unlimited
+    (admission disabled; counters still track in-flight).
+
+    Thread-safe; refusals are O(1) and allocation-free — the overload
+    path must be the cheapest path in the server."""
+
+    def __init__(self, high: int = 0, low: Optional[int] = None):
+        self.high = int(high)
+        if self.high > 0:
+            # default low = high//2; an explicit 0 is legal and honored
+            # (drain fully before re-admitting — the only choice when
+            # high is 1)
+            self.low = self.high // 2 if low is None else int(low)
+            if not 0 <= self.low < self.high:
+                # a negative low could never clear the shedding band:
+                # the first overload would brick the server into BUSY
+                raise ValueError(
+                    f"low watermark {self.low} must be in [0, "
+                    f"high={self.high})"
+                )
+        else:
+            self.low = 0
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._shedding = False
+        self.admitted = 0
+        self.shed = 0
+
+    def try_admit(self, n: int = 1) -> bool:
+        with self._lock:
+            if self.high > 0:
+                if self._shedding and self._inflight > self.low:
+                    self.shed += n
+                    return False
+                if self._inflight + n > self.high:
+                    self._shedding = True
+                    self.shed += n
+                    return False
+                self._shedding = False
+            self._inflight += n
+            self.admitted += n
+            return True
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - n)
+            if self._shedding and self._inflight <= self.low:
+                self._shedding = False
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "high": self.high,
+                "low": self.low,
+                "shedding": self._shedding,
+                "admitted": self.admitted,
+                "shed": self.shed,
+            }

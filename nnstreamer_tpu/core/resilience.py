@@ -314,6 +314,14 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            if self._peek_state() == self.OPEN:
+                # STALE in-flight success (request predates the trip,
+                # e.g. a slow response from before the failure burst):
+                # closing here would bypass reset_timeout and half-open
+                # probing entirely — under partial loss the breaker
+                # would flap closed on every stray success.  Symmetric
+                # to the stale-failure case in record_failure().
+                return
             self._failures.clear()
             if self._state != self.CLOSED:
                 log.info("breaker %s: closed (probe succeeded)", self.name)
@@ -389,6 +397,8 @@ class _FaultPlan:
         every: Optional[int] = None,
         seed: int = 0,
         callback: Optional[Callable[[int], Optional[BaseException]]] = None,
+        delay: float = 0.0,
+        hang: bool = False,
     ):
         self.exc = exc if exc is not None else TransientError("injected fault")
         self.rate = float(rate)
@@ -396,18 +406,27 @@ class _FaultPlan:
         self.after = int(after)  # skip the first N invocations
         self.every = every  # fire on every Nth invocation (deterministic)
         self.callback = callback
+        # latency faults: delay=S sleeps the caller S seconds at the site
+        # (then proceeds normally); hang=True blocks until cooperatively
+        # interrupted, then raises StallError (core/liveness.py) — the
+        # deterministic stand-in for an element that silently wedges
+        self.delay = float(delay)
+        self.hang = bool(hang)
         self._rng = random.Random(seed)
         self.calls = 0
         self.fired = 0
 
-    def decide(self) -> Optional[BaseException]:
+    def decide(self) -> Optional[Tuple[str, Any]]:
+        """None (no fault) or an action: ``("raise", exc)``,
+        ``("delay", seconds)``, or ``("hang", None)``."""
         i = self.calls
         self.calls += 1
         if self.callback is not None:
             err = self.callback(i)
             if err is not None:
                 self.fired += 1
-            return err
+                return ("raise", err)
+            return None
         if i < self.after:
             return None
         if self.times is not None and self.fired >= self.times:
@@ -419,15 +438,19 @@ class _FaultPlan:
         if not hit:
             return None
         self.fired += 1
+        if self.hang:
+            return ("hang", None)
+        if self.delay > 0:
+            return ("delay", self.delay)
         exc = self.exc
         if isinstance(exc, type):
-            return exc("injected fault")
+            return ("raise", exc("injected fault"))
         try:
             # fresh instance per fire: concurrent raisers of ONE shared
             # instance would cross-contaminate __traceback__/__context__
-            return type(exc)(*exc.args)
+            return ("raise", type(exc)(*exc.args))
         except Exception:  # exotic ctor signature: fall back to sharing
-            return exc
+            return ("raise", exc)
 
 
 class FaultInjector:
@@ -451,6 +474,9 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._plans: Dict[str, _FaultPlan] = {}
         self._armed = False  # one-bool fast path for un-instrumented runs
+        # release valve for in-progress delay/hang faults: reset()/disarm()
+        # set it so no teardown ever waits on an injected wedge
+        self._release = threading.Event()
 
     def arm(
         self,
@@ -462,29 +488,40 @@ class FaultInjector:
         every: Optional[int] = None,
         seed: int = 0,
         callback: Optional[Callable[[int], Optional[BaseException]]] = None,
+        delay: float = 0.0,
+        hang: bool = False,
     ) -> None:
         """Arm `site`.  ``exc`` may be an exception instance or class;
         ``rate`` is the per-invocation fault probability (1.0 = always),
         ``every=N`` switches to strictly periodic injection, ``after``
         skips the first invocations, ``times`` caps total faults, and
         ``callback(i)`` takes full control (return an exception or
-        None)."""
+        None).  ``delay=S`` injects S seconds of latency instead of an
+        error (the call then proceeds); ``hang=True`` blocks the caller
+        until cooperatively interrupted — the site's ``interrupt``
+        callable, the element's interrupt flag, or ``reset()`` — then
+        raises :class:`~..core.liveness.StallError`."""
         with self._lock:
             self._plans[site] = _FaultPlan(
                 exc=exc, rate=rate, times=times, after=after,
                 every=every, seed=seed, callback=callback,
+                delay=delay, hang=hang,
             )
             self._armed = True
+            self._release.clear()
 
     def disarm(self, site: str) -> None:
         with self._lock:
             self._plans.pop(site, None)
             self._armed = bool(self._plans)
+            if not self._armed:
+                self._release.set()
 
     def reset(self) -> None:
         with self._lock:
             self._plans.clear()
             self._armed = False
+            self._release.set()
 
     def is_armed(self) -> bool:
         """Fast gate for call sites whose site NAME is costly to build
@@ -492,19 +529,44 @@ class FaultInjector:
         plan is armed."""
         return self._armed
 
-    def check(self, site: str) -> None:
-        """Raise the planned fault for `site`, if armed (hot-path no-op
-        otherwise)."""
+    def check(self, site: str,
+              interrupt: Optional[Callable[[], bool]] = None) -> None:
+        """Raise/delay/hang per the planned fault for `site`, if armed
+        (hot-path no-op otherwise).  ``interrupt`` is the cooperative
+        escape hatch for latency faults: sites on supervised paths pass
+        the element's interrupt/stop predicate so a watchdog escalation
+        (or pipeline stop) can break an injected hang."""
         if not self._armed:
             return
         with self._lock:
             plan = self._plans.get(site)
             if plan is None:
                 return
-            err = plan.decide()
-        if err is not None:
-            log.debug("fault injected at %s: %r", site, err)
-            raise err
+            action = plan.decide()
+        if action is None:
+            return
+        kind, arg = action
+        if kind == "raise":
+            log.debug("fault injected at %s: %r", site, arg)
+            raise arg
+        if kind == "delay":
+            log.debug("latency fault at %s: %.3fs", site, arg)
+            deadline = time.monotonic() + arg
+            while time.monotonic() < deadline:
+                if (interrupt is not None and interrupt()) or \
+                        self._release.wait(
+                            min(0.005, max(0.0, deadline - time.monotonic()))):
+                    break
+            return
+        # hang: block until someone pulls the plug, then surface as a
+        # stall so restart machinery can treat it like any transient
+        log.debug("hang fault at %s (waiting for interrupt)", site)
+        while not (interrupt is not None and interrupt()):
+            if self._release.wait(0.005):
+                break
+        from .liveness import StallError
+
+        raise StallError(f"injected hang at {site} interrupted")
 
     def stats(self, site: str) -> Dict[str, int]:
         """{calls, fired} counters for an armed (or just-disarmed) site;

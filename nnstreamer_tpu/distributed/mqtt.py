@@ -362,10 +362,17 @@ class MiniBroker:
                 socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                 struct.pack("ll", 5, 0),
             )
+            # bounded handshake: a peer that connects and never sends
+            # CONNECT must not wedge this thread until process exit
+            sock.settimeout(10.0)
             ptype, _, body = _read_packet(sock)
             if ptype != CONNECT:
                 sock.close()
                 return
+            # allow-blocking: post-handshake reads are stream semantics
+            # (clients ping on their own schedule); close() shutdown()s
+            # every client socket, so the blocked recv has an escape
+            sock.settimeout(None)
             sess, present = self._open_session(sock, body)
             sock.sendall(bytes([CONNACK << 4, 2, 1 if present else 0, 0]))
             if present:
@@ -647,7 +654,12 @@ class MqttClient:
         if ptype != CONNACK or body[1] != 0:
             sock.close()
             raise ConnectionError(f"MQTT connect refused: {body!r}")
-        sock.settimeout(None)
+        # bounded read: the ping loop elicits a PINGRESP well inside
+        # every keepalive window, so a silent link for 1.5x keepalive
+        # means the broker is gone — the reader's timeout then lands in
+        # its (ConnectionError, OSError) handler and reconnects, instead
+        # of blocking forever on a black-holed connection
+        sock.settimeout(max(1.0, self._keepalive * 1.5))
         with self._wlock:
             self._sock = sock
         self.connected.set()

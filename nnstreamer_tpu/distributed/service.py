@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import grpc
 
 from ..core.buffer import BatchFrame, TensorFrame
+from ..core.liveness import AdmissionController, ServerBusyError, stamp_deadline
 from ..core.log import get_logger
 from ..core.types import StreamSpec
 from .wire import (
@@ -80,6 +81,14 @@ class QueryServerCore:
         self._server: Optional[grpc.Server] = None
         self._tcp = None  # raw-TCP transport (tcp_query.TcpQueryServer)
         self.refs = 0
+        # overload admission (core/liveness.py): default unlimited; the
+        # serversrc's max-inflight/low-watermark props rebuild it.  Shed
+        # requests are refused with BUSY before touching the ingress
+        # queue — overload answers in O(1) instead of timing out deep in
+        # the pipeline.
+        self.admission = AdmissionController(0)
+        self.busy_retry_after = 0.05
+        self.expired_drops = 0  # requests expired before ingest
 
     # -- transport-agnostic handlers ----------------------------------------
     def check_caps(self, client_caps: str) -> str:
@@ -130,22 +139,37 @@ class QueryServerCore:
         """Route frames through the paired server pipeline and collect the
         answers in stream order.  Shared by every transport (gRPC unary
         handler, raw-TCP connection threads).  Raises TimeoutError when
-        the pipeline produces no answer in time."""
-        with self._pending_client(frames, qsize=len(frames)) as answer_q:
-            answers = []
-            deadline = time.monotonic() + min(timeout, 300.0)
-            for _ in frames:
-                try:
-                    answers.append(
-                        answer_q.get(
-                            timeout=max(0.0, deadline - time.monotonic())
+        the pipeline produces no answer in time, :class:`ServerBusyError`
+        when admission control sheds the request (before any ingest).
+
+        Deadline QoS: each frame is stamped with the request's remaining
+        budget (re-anchored on THIS host's clock — budgets cross the
+        wire, instants don't), so server pipeline elements can expire
+        late work BEFORE the invoke instead of burning chip time on an
+        answer the client has already abandoned."""
+        if not self.admission.try_admit():
+            raise ServerBusyError(retry_after=self.busy_retry_after)
+        try:
+            budget = min(timeout, 300.0)
+            for frame in frames:
+                stamp_deadline(frame, budget)
+            with self._pending_client(frames, qsize=len(frames)) as answer_q:
+                answers = []
+                deadline = time.monotonic() + budget
+                for _ in frames:
+                    try:
+                        answers.append(
+                            answer_q.get(
+                                timeout=max(0.0, deadline - time.monotonic())
+                            )
                         )
-                    )
-                except queue.Empty:
-                    raise TimeoutError(
-                        "server pipeline produced no answer in time"
-                    ) from None
-            return answers
+                    except queue.Empty:
+                        raise TimeoutError(
+                            "server pipeline produced no answer in time"
+                        ) from None
+                return answers
+        finally:
+            self.admission.release()
 
     def _ingress_items(self, frames: List[TensorFrame]) -> List[TensorFrame]:
         """block_ingress: a wire micro-batch becomes ONE BatchFrame so the
@@ -178,6 +202,14 @@ class QueryServerCore:
         try:
             answers = self.process(
                 frames, float(context.time_remaining() or 30.0))
+        except ServerBusyError as e:
+            # RESOURCE_EXHAUSTED ≙ the raw-TCP BUSY reply; the client
+            # transport maps it back to ServerBusyError (backpressure,
+            # not ill-health — see resilience.is_remote_application_error)
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"server busy; retry_after={e.retry_after:.6f}",
+            )
         except TimeoutError as e:
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         if batched:
@@ -195,36 +227,45 @@ class QueryServerCore:
         single answer has no ``final`` meta, so exactly one message is
         streamed and the stream closes via the sentinel check below."""
         frame = decode_frame(request)
-        with self._pending_client([frame]) as answer_q:
-            # the CLIENT's deadline governs the whole stream (a long
-            # generation is the point); hard backstop only against
-            # deadline-less channels
-            deadline = time.monotonic() + min(
-                float(context.time_remaining() or 30.0), 3600.0
+        if not self.admission.try_admit():
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"server busy; retry_after={self.busy_retry_after:.6f}",
             )
-            while True:
-                try:
-                    ans = answer_q.get(
-                        timeout=max(0.0, deadline - time.monotonic())
-                    )
-                except queue.Empty:
-                    context.abort(
-                        grpc.StatusCode.DEADLINE_EXCEEDED,
-                        "server pipeline produced no (further) answer in time",
-                    )
-                yield encode_frame(ans)
-                # a non-streaming graph emits exactly one answer with no
-                # "final" key -> treat absent as final.  A multi-answer
-                # graph MUST stamp meta["final"] (False on intermediate
-                # chunks) or its stream truncates here — resolve() flags
-                # the dropped answers with the cause.
-                if ans.meta.get("final", True):
-                    if "final" not in ans.meta:
-                        cid = ans.meta.get("client_id")
-                        if cid is not None:
-                            with self._pending_lock:
-                                self._heuristic_closed.append(cid)
-                    return
+        try:
+            with self._pending_client([frame]) as answer_q:
+                # the CLIENT's deadline governs the whole stream (a long
+                # generation is the point); hard backstop only against
+                # deadline-less channels
+                deadline = time.monotonic() + min(
+                    float(context.time_remaining() or 30.0), 3600.0
+                )
+                while True:
+                    try:
+                        ans = answer_q.get(
+                            timeout=max(0.0, deadline - time.monotonic())
+                        )
+                    except queue.Empty:
+                        context.abort(
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            "server pipeline produced no (further) answer "
+                            "in time",
+                        )
+                    yield encode_frame(ans)
+                    # a non-streaming graph emits exactly one answer with
+                    # no "final" key -> treat absent as final.  A
+                    # multi-answer graph MUST stamp meta["final"] (False
+                    # on intermediate chunks) or its stream truncates here
+                    # — resolve() flags the dropped answers with the cause.
+                    if ans.meta.get("final", True):
+                        if "final" not in ans.meta:
+                            cid = ans.meta.get("client_id")
+                            if cid is not None:
+                                with self._pending_lock:
+                                    self._heuristic_closed.append(cid)
+                        return
+        finally:
+            self.admission.release()
 
     def resolve(self, client_id: int, frame: TensorFrame,
                 limit: int = 0) -> bool:
@@ -272,6 +313,20 @@ class QueryServerCore:
                     "no pending client %s (answer dropped)", client_id
                 )
         return False
+
+    def liveness_snapshot(self) -> Dict[str, Any]:
+        """Load-shed / admission counters for ``Pipeline.health()`` (the
+        serversrc merges this via ``health_info``)."""
+        snap = self.admission.snapshot()
+        return {
+            "inflight": snap["inflight"],
+            "admitted": snap["admitted"],
+            "load_shed": snap["shed"],
+            "shedding": snap["shedding"],
+            "admission_high": snap["high"],
+            "admission_low": snap["low"],
+            "ingress_depth": self.ingress.qsize(),
+        }
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -379,13 +434,35 @@ class QueryConnection:
             request_serializer=_ident, response_deserializer=_ident,
         )
 
+    @staticmethod
+    def _map_busy(err: grpc.RpcError) -> None:
+        """Translate the server's RESOURCE_EXHAUSTED admission refusal
+        into :class:`ServerBusyError` (≙ the raw-TCP BUSY reply) so both
+        transports surface backpressure identically."""
+        code = getattr(err, "code", lambda: None)()
+        if code != grpc.StatusCode.RESOURCE_EXHAUSTED:
+            return
+        retry_after = 0.05
+        detail = str(getattr(err, "details", lambda: "")() or "")
+        marker = "retry_after="
+        if marker in detail:
+            try:
+                retry_after = float(detail.split(marker, 1)[1].split()[0])
+            except ValueError:
+                pass
+        raise ServerBusyError(retry_after=retry_after) from err
+
     def handshake(self, caps: str) -> str:
         return self._handshake(caps.encode(), timeout=self.timeout).decode()
 
     def invoke(self, frame: TensorFrame, timeout: Optional[float] = None) -> TensorFrame:
-        data = self._invoke(
-            encode_frame(frame), timeout=timeout or self.timeout
-        )
+        try:
+            data = self._invoke(
+                encode_frame(frame), timeout=timeout or self.timeout
+            )
+        except grpc.RpcError as e:
+            self._map_busy(e)
+            raise
         return decode_frame(data)
 
     def invoke_stream(self, frame: TensorFrame,
@@ -393,17 +470,25 @@ class QueryConnection:
         """Server-streaming invoke: yields answer frames as they arrive
         (the last one is final-flagged or has no ``final`` meta).
         ``timeout`` bounds the WHOLE stream."""
-        for data in self._invoke_stream_rpc(
-            encode_frame(frame), timeout=timeout or self.timeout
-        ):
-            yield decode_frame(data)
+        try:
+            for data in self._invoke_stream_rpc(
+                encode_frame(frame), timeout=timeout or self.timeout
+            ):
+                yield decode_frame(data)
+        except grpc.RpcError as e:
+            self._map_busy(e)
+            raise
 
     def invoke_batch(self, frames: List[TensorFrame],
                      timeout: Optional[float] = None) -> List[TensorFrame]:
         """N frames in one RPC (wire micro-batch); answers in order."""
-        data = self._invoke(
-            encode_frames(frames), timeout=timeout or self.timeout
-        )
+        try:
+            data = self._invoke(
+                encode_frames(frames), timeout=timeout or self.timeout
+            )
+        except grpc.RpcError as e:
+            self._map_busy(e)
+            raise
         return decode_frames(data)
 
     def close(self) -> None:

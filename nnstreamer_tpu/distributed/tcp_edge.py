@@ -72,10 +72,15 @@ class TcpEdgeServer:
 
     def _register(self, sock: socket.socket) -> None:
         try:
+            # bounded handshake: a peer that connects and never names a
+            # topic must not wedge this thread until process exit
+            sock.settimeout(10.0)
             (tlen,) = _LEN.unpack(_read_exact(sock, _LEN.size))
             if tlen > 4096:
                 raise ConnectionError("absurd topic length")
             topic = _read_exact(sock, tlen).decode()
+            sock.settimeout(None)  # allow-blocking: send path below is
+            # bounded by SO_SNDTIMEO; this socket is only ever written to
             # bound sends so one wedged subscriber cannot stall publish
             # fan-out for the healthy ones (see MiniBroker._send)
             sock.setsockopt(
@@ -161,6 +166,9 @@ class TcpEdgeSubscriber:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         t = topic.encode()
         self._sock.sendall(_LEN.pack(len(t)) + t)
+        # allow-blocking: a pub/sub stream legitimately idles for as long
+        # as the publisher is quiet; close() shutdown()s the socket, so a
+        # blocked recv always has a bounded escape hatch
         self._sock.settimeout(None)
         self._closed = False
 
@@ -170,6 +178,8 @@ class TcpEdgeSubscriber:
         `idle_timeout` seconds pass without one).  The socket is closed
         when the stream ends for any reason — a broken stream must not
         park a dead fd on the subscriber until GC."""
+        # allow-blocking: idle_timeout=None = stream semantics (see
+        # __init__) — interruptible via close()
         self._sock.settimeout(idle_timeout)
         try:
             while not self._closed:

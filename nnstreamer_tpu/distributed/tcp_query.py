@@ -35,9 +35,11 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from ..core.buffer import TensorFrame
+from ..core.liveness import ServerBusyError
 from ..core.log import get_logger
 from ..core.resilience import FAULTS, RemoteApplicationError
 from .wire import (
@@ -56,6 +58,23 @@ _HDR = struct.Struct("<BQd")
 _T_HANDSHAKE = ord("H")
 _T_QUERY = ord("Q")
 _T_ERROR = ord("E")
+# admission control: the server REFUSED the request before ingest (load
+# shed); body = ascii retry-after seconds.  Clients treat it as transient
+# backpressure (ServerBusyError), never as remote ill-health.
+_T_BUSY = ord("B")
+# the server PIPELINE produced no answer in time.  Distinct from 'E' app
+# errors because it IS a health signal: the client raises TimeoutError so
+# breakers/cooldowns count it — the same classification this condition
+# gets over gRPC (DEADLINE_EXCEEDED).
+_T_TIMEOUT = ord("T")
+
+# liveness bound for the server reader: a peer that begins a message and
+# then stalls (no bytes) this long is dropped instead of wedging the
+# connection thread until process exit
+_MID_MSG_STALL_S = 30.0
+# reply sends get a long-but-bounded timeout (big payloads on a slow
+# link), distinct from the short recv poll used for idle detection
+_SEND_TIMEOUT_S = 30.0
 
 # one gather-send syscall tops out at IOV_MAX buffers; chunk above it
 _IOV_MAX = 512
@@ -107,6 +126,50 @@ def _recv_msg(sock: socket.socket) -> Tuple[int, memoryview, float]:
     if blen > _MAX_BODY:
         raise WireError(f"declared body length {blen} exceeds {_MAX_BODY}")
     return mtype, _recv_exact(sock, blen), deadline_s
+
+
+def _recv_exact_bounded(sock: socket.socket, n: int, stop: threading.Event,
+                        idle_ok: bool = False) -> memoryview:
+    """``_recv_exact`` for the server reader thread: the socket carries a
+    short poll timeout, so idle waits stay responsive to `stop`, and a
+    peer that goes silent MID-read for ``_MID_MSG_STALL_S`` is treated
+    as broken (no unbounded blocking in the reader — audit contract,
+    tools/check_blocking_timeouts.py).  ``idle_ok`` = message-boundary
+    read: the stall bound only starts once the first byte arrives (an
+    idle connection may legitimately wait forever, polling `stop`)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    last_progress = None if idle_ok else time.monotonic()
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            if stop.is_set():
+                raise ConnectionError("server stopping") from None
+            if (last_progress is not None
+                    and time.monotonic() - last_progress >= _MID_MSG_STALL_S):
+                raise ConnectionError(
+                    f"peer stalled mid-message ({got}/{n} bytes)"
+                ) from None
+            continue
+        if r == 0:
+            raise ConnectionError("socket closed mid-receive")
+        got += r
+        last_progress = time.monotonic()
+    return memoryview(buf)
+
+
+def _recv_msg_bounded(sock: socket.socket,
+                      stop: threading.Event) -> Tuple[int, memoryview, float]:
+    """Server-side ``_recv_msg`` with liveness bounds: blocks
+    indefinitely only BETWEEN messages (polling `stop`); within one it
+    inherits the mid-message stall bound."""
+    head = _recv_exact_bounded(sock, _HDR.size, stop, idle_ok=True)
+    mtype, blen, deadline_s = _HDR.unpack(head)
+    if blen > _MAX_BODY:
+        raise WireError(f"declared body length {blen} exceeds {_MAX_BODY}")
+    return mtype, _recv_exact_bounded(sock, blen, stop), deadline_s
 
 
 class TcpQueryConnection:
@@ -227,28 +290,42 @@ class TcpQueryConnection:
         raise AssertionError("unreachable")  # loop always returns/raises
 
     # -- public API ---------------------------------------------------------
-    def handshake(self, caps: str) -> str:
-        rtype, body = self._roundtrip(_T_HANDSHAKE, [caps.encode()], None)
+    @staticmethod
+    def _check_reply(rtype: int, body: memoryview) -> None:
+        if rtype == _T_BUSY:
+            # admission shed: provably never executed, safe to re-send
+            try:
+                retry_after = float(bytes(body).decode() or 0.05)
+            except ValueError:
+                retry_after = 0.05
+            raise ServerBusyError(retry_after=retry_after)
+        if rtype == _T_TIMEOUT:
+            # server pipeline timeout: ill-health, NOT an app reply —
+            # must reach breakers/cooldowns (gRPC parity:
+            # DEADLINE_EXCEEDED)
+            raise TimeoutError(bytes(body).decode())
         if rtype == _T_ERROR:
             # RemoteApplicationError (a RuntimeError): the server is UP
             # and answered — health machinery must not count this
             raise RemoteApplicationError(bytes(body).decode())
+
+    def handshake(self, caps: str) -> str:
+        rtype, body = self._roundtrip(_T_HANDSHAKE, [caps.encode()], None)
+        self._check_reply(rtype, body)
         return bytes(body).decode()
 
     def invoke(self, frame: TensorFrame,
                timeout: Optional[float] = None) -> TensorFrame:
         rtype, body = self._roundtrip(
             _T_QUERY, encode_frame_parts(frame), timeout)
-        if rtype == _T_ERROR:
-            raise RemoteApplicationError(bytes(body).decode())
+        self._check_reply(rtype, body)
         return decode_frame(body)
 
     def invoke_batch(self, frames: List[TensorFrame],
                      timeout: Optional[float] = None) -> List[TensorFrame]:
         rtype, body = self._roundtrip(
             _T_QUERY, encode_frames_parts(frames), timeout)
-        if rtype == _T_ERROR:
-            raise RemoteApplicationError(bytes(body).decode())
+        self._check_reply(rtype, body)
         return decode_frames(body)
 
     def close(self) -> None:
@@ -331,7 +408,10 @@ class TcpQueryServer:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn.settimeout(None)
+            # short poll timeout: the reader thread must never block
+            # unbounded (idle waits poll the stop flag; mid-message
+            # stalls are bounded by _recv_msg_bounded)
+            conn.settimeout(0.5)
             with self._conns_lock:
                 self._conns.append(conn)
             # prune finished handler threads (connection churn must not
@@ -344,16 +424,26 @@ class TcpQueryServer:
             t.start()
             self._conn_threads.append(t)
 
+    def _reply(self, conn: socket.socket, mtype: int, parts: List) -> None:
+        """Send one reply under the send timeout, then restore the short
+        recv-poll timeout (settimeout governs BOTH directions)."""
+        conn.settimeout(_SEND_TIMEOUT_S)
+        try:
+            _send_msg(conn, mtype, parts)
+        finally:
+            conn.settimeout(0.5)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
                 try:
-                    mtype, body, deadline_s = _recv_msg(conn)
+                    mtype, body, deadline_s = _recv_msg_bounded(
+                        conn, self._stop)
                 except WireError as e:
                     # unparseable/oversized header: tell the peer and drop
                     # the connection (framing is lost at this point)
                     try:
-                        _send_msg(conn, _T_ERROR, [str(e).encode()])
+                        self._reply(conn, _T_ERROR, [str(e).encode()])
                     except OSError:
                         pass
                     return
@@ -363,21 +453,39 @@ class TcpQueryServer:
                     if mtype == _T_HANDSHAKE:
                         try:
                             caps = self._core.check_caps(bytes(body).decode())
-                            _send_msg(conn, _T_HANDSHAKE, [caps.encode()])
+                            self._reply(conn, _T_HANDSHAKE, [caps.encode()])
                         except ValueError as e:
-                            _send_msg(conn, _T_ERROR, [str(e).encode()])
+                            self._reply(conn, _T_ERROR, [str(e).encode()])
                     elif mtype == _T_QUERY:
                         batched = is_batch_payload(body)
                         frames = (decode_frames(body) if batched
                                   else [decode_frame(body)])
-                        answers = self._core.process(
-                            frames, deadline_s if deadline_s > 0 else 30.0)
+                        try:
+                            answers = self._core.process(
+                                frames,
+                                deadline_s if deadline_s > 0 else 30.0)
+                        except TimeoutError as e:
+                            # caught HERE, not at the message boundary:
+                            # socket.timeout from the reply sends below is
+                            # the same class and must stay an OSError-path
+                            # connection drop, not a 'T' reply
+                            self._reply(conn, _T_TIMEOUT, [str(e).encode()])
+                            continue
                         parts = (encode_frames_parts(answers) if batched
                                  else encode_frame_parts(answers[0]))
-                        _send_msg(conn, _T_QUERY, parts)
+                        self._reply(conn, _T_QUERY, parts)
                     else:
-                        _send_msg(conn, _T_ERROR,
-                                  [f"unknown message type {mtype}".encode()])
+                        self._reply(
+                            conn, _T_ERROR,
+                            [f"unknown message type {mtype}".encode()])
+                except ServerBusyError as e:
+                    # admission shed: the cheapest possible reply — the
+                    # request never touched the pipeline
+                    try:
+                        self._reply(conn, _T_BUSY,
+                                    [f"{e.retry_after:.6f}".encode()])
+                    except OSError:
+                        return
                 except OSError:
                     return  # peer gone mid-reply
                 except Exception as e:  # noqa: BLE001 — transport boundary:
@@ -385,7 +493,7 @@ class TcpQueryServer:
                     # malformed frame) becomes a protocol error reply; the
                     # connection and its socket survive
                     try:
-                        _send_msg(conn, _T_ERROR, [str(e).encode()])
+                        self._reply(conn, _T_ERROR, [str(e).encode()])
                     except OSError:
                         return
         finally:

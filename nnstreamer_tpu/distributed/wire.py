@@ -23,6 +23,7 @@ from typing import Any, Dict
 import numpy as np
 
 from ..core.buffer import TensorFrame
+from ..core.liveness import DEADLINE_META
 from ..core.types import TensorSpec, pack_flex_header, unpack_flex_header
 
 _MAGIC = 0x4E4E5351  # 'NNSQ'
@@ -61,6 +62,13 @@ def get_codec(name: str):
 def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
     out = {}
     for k, v in meta.items():
+        if k == DEADLINE_META:
+            # deadline QoS (core/liveness.py): an absolute instant on
+            # THIS host's monotonic clock — meaningless to a peer.  The
+            # remaining BUDGET crosses the wire instead (tcp_query
+            # header deadline_s / gRPC time_remaining) and the receiver
+            # re-stamps on its own clock.
+            continue
         try:
             json.dumps(v)
             out[k] = v

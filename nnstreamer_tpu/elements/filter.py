@@ -668,7 +668,7 @@ class TensorFilter(TransformElement):
         inputs = [frame.tensors[i] for _, i in comb] if comb else list(frame.tensors)
         import time
 
-        FAULTS.check("filter.invoke")
+        FAULTS.check("filter.invoke", interrupt=lambda: self.interrupted)
         t0 = time.perf_counter()
         if isinstance(frame, BatchFrame):
             # a pre-batched block on a single-invoke path (max-batch=1,
@@ -721,7 +721,7 @@ class TensorFilter(TransformElement):
         real host boundary) or the depth-N dispatch window."""
         import time
 
-        FAULTS.check("filter.invoke")
+        FAULTS.check("filter.invoke", interrupt=lambda: self.interrupted)
         t0 = time.perf_counter()
         out_b = self.backend.timed_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, nlogical)

@@ -316,16 +316,27 @@ class TensorRate(TransformElement):
         "throttle": Property(bool, True, "drop-only (no duplication)"),
         "silent": Property(bool, True, "suppress per-frame counter logs"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # ≙ the reference's QoS event handling (gsttensor_rate.c
+        # gst_tensor_rate_src_event QOS): downstream deadline misses feed
+        # back here (Pipeline._qos_feedback -> note_qos) and frames up to
+        # the reported late timestamp are shed at the throttle — where
+        # dropping is cheapest — instead of after the expensive work
+        "qos": Property(bool, True, "honor downstream deadline-miss "
+                        "feedback by dropping late-flagged frames here"),
         # read-only QoS counters ≙ gsttensor_rate.c:955-977
         "in": Property(int, 0, "input frame count (read-only)"),
         "out": Property(int, 0, "output frame count (read-only)"),
         "duplicate": Property(int, 0, "duplicated frame count (read-only)"),
         "drop": Property(int, 0, "dropped frame count (read-only)"),
+        "qos-dropped": Property(
+            int, 0, "frames shed by QoS feedback (read-only; also counted "
+            "in drop)"),
     }
 
     _COUNTER_ATTRS = {
         "in": "in_frames", "out": "out_frames",
         "duplicate": "duplicated", "drop": "dropped",
+        "qos-dropped": "qos_dropped",
     }
 
     def get_property(self, key):
@@ -349,12 +360,31 @@ class TensorRate(TransformElement):
         self.out_frames = 0
         self.dropped = 0
         self.duplicated = 0
+        self.qos_dropped = 0
+        # QoS feedback state: frames with pts <= this are shed (a plain
+        # float store/read under the GIL — note_qos is called from
+        # downstream worker threads)
+        self._qos_until = float("-inf")
 
     def start(self):
         self._next_ts = None
         self._last = None
         self.in_frames = self.out_frames = 0
         self.dropped = self.duplicated = 0
+        self.qos_dropped = 0
+        self._qos_until = float("-inf")
+
+    def note_qos(self, pts: Optional[float], lateness: float) -> None:
+        """Deadline-miss feedback from downstream (the pipeline routes
+        every deadline drop to upstream throttlers): shed frames up to
+        the late frame's pts plus the observed lateness — ≙ the
+        reference applying a QoS event's timestamp+jitter
+        (gsttensor_rate.c)."""
+        if not self.props["qos"] or pts is None:
+            return
+        until = pts + max(0.0, lateness)
+        if until > self._qos_until:
+            self._qos_until = until
 
     def _period(self) -> Optional[float]:
         fr = self.props["framerate"]
@@ -374,6 +404,17 @@ class TensorRate(TransformElement):
 
     def transform(self, frame):
         self.in_frames += 1
+        if (frame.pts is not None and frame.pts <= self._qos_until):
+            # QoS throttle: downstream missed deadlines around this
+            # stream time — shed here, before any downstream cost
+            self.dropped += 1
+            self.qos_dropped += 1
+            if not self.props["silent"]:
+                self.log.info(
+                    "rate: qos-shed pts=%.4f (until %.4f)",
+                    frame.pts, self._qos_until,
+                )
+            return None
         period = self._period()
         if period is None or frame.pts is None:
             self.out_frames += 1

@@ -21,9 +21,16 @@ import queue as _queue
 import threading
 from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Deque, Iterator, List, Optional, Tuple
 
 from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
+from ..core.liveness import (
+    DEADLINE_META,
+    AdmissionController,
+    ServerBusyError,
+    deadline_remaining,
+)
 from ..core.resilience import (
     CircuitBreaker,
     CircuitOpenError,
@@ -43,6 +50,7 @@ from ..pipeline.element import (
     SinkElement,
     SourceElement,
     element,
+    enum_prop_check,
 )
 
 
@@ -72,6 +80,18 @@ class TensorQueryServerSrc(SourceElement):
             "pipeline pays per-frame costs once per batch (the answers "
             "split back per client in the serversink)",
         ),
+        # overload admission control (core/liveness.py): refuse work at
+        # the door with a BUSY reply instead of timing out deep in the
+        # stack once the pipeline is saturated
+        "max-inflight": Property(
+            int, 0, "admission high watermark: concurrent requests "
+            "admitted before the server sheds with BUSY (0 = unlimited)"),
+        "low-watermark": Property(
+            int, 0, "admission hysteresis: once shedding, keep refusing "
+            "until in-flight drains to this (0 = max-inflight/2)"),
+        "retry-after": Property(
+            float, 0.05, "seconds suggested to BUSY-shed clients before "
+            "they retry"),
     }
 
     def __init__(self, name=None):
@@ -84,6 +104,14 @@ class TensorQueryServerSrc(SourceElement):
         if self.props["caps"]:
             self._core.caps = self.props["caps"]
         self._core.block_ingress = bool(self.props["block-ingress"])
+        try:
+            self._core.admission = AdmissionController(
+                int(self.props["max-inflight"]),
+                int(self.props["low-watermark"]) or None,
+            )
+        except ValueError as e:
+            raise ElementError(f"{self.name}: {e}") from None
+        self._core.busy_retry_after = float(self.props["retry-after"])
         ct = self.props["connect-type"]
         if ct == "tcp":
             self._core.start_tcp()
@@ -143,6 +171,12 @@ class TensorQueryServerSrc(SourceElement):
         text = self.props["caps"]
         return StreamSpec.from_string(text) if text else ANY
 
+    def health_info(self) -> dict:
+        """Admission/load-shed counters merged into Pipeline.health()."""
+        if self._core is None:
+            return {}
+        return self._core.liveness_snapshot()
+
     def frames(self) -> Iterator[TensorFrame]:
         while True:
             try:
@@ -195,14 +229,6 @@ class TensorQueryServerSink(SinkElement):
         self._core.resolve(
             int(client_id), frame, limit=self.props["limit"]
         )
-
-
-def _check_degrade(v: str) -> str:
-    # eager validation: a typo here must fail at set time, not silently
-    # behave like `error` and only surface during an outage
-    if v not in ("error", "passthrough", "skip"):
-        raise ValueError(f"degrade {v!r} (want error | passthrough | skip)")
-    return v
 
 
 class _PoolState:
@@ -264,6 +290,14 @@ class TensorQueryClient(Element):
         # possibly to another server) — opt in only for idempotent server
         # pipelines; 0 matches the reference's single-timeout semantics
         "retries": Property(int, 0, "re-send attempts per request (0 = none; >0 = at-least-once delivery)"),
+        # BUSY backpressure (server admission control): a shed request
+        # provably never executed, so re-sends are safe even under the
+        # at-most-once default — they get their own RetryPolicy-paced
+        # budget, and never count against the remote's circuit breaker
+        "busy-retries": Property(
+            int, 3, "extra paced re-sends when the server sheds with "
+            "BUSY (separate budget from retries; 0 = treat BUSY like "
+            "any other failure)"),
         # resilience knobs (core/resilience.py; Documentation/resilience.md)
         "retry-backoff": Property(
             float, 0.05,
@@ -283,7 +317,7 @@ class TensorQueryClient(Element):
         "degrade": Property(
             str, "error",
             "on total remote failure: error | passthrough | skip",
-            convert=_check_degrade),
+            convert=enum_prop_check("degrade", "error", "passthrough", "skip")),
         # wire micro-batching (TPU-first, no reference analog): drain
         # whatever frames are ALREADY queued (no added latency) and ship
         # up to N of them in ONE RPC — amortizes the per-RPC transport
@@ -328,6 +362,8 @@ class TensorQueryClient(Element):
         self._breakers_lock = threading.Lock()
         self._degraded = 0  # frames answered by degrade= instead of a server
         self._evicted_breaker_trips = 0  # trips of breakers evicted on swaps
+        self._busy_replies = 0  # BUSY sheds seen (admission backpressure)
+        self._deadline_expired = 0  # requests abandoned: budget ran out
         self._retry_policy = RetryPolicy()  # rebuilt from props in start()
 
     @property
@@ -500,6 +536,29 @@ class TensorQueryClient(Element):
     def derive_spec(self, pad=0):
         return ANY  # the server decides the answer schema
 
+    def _result_budget(self) -> float:
+        """Worst-case seconds one in-flight request may legitimately take
+        (failover attempts x (timeout + backoff) + busy pacing + one
+        rediscovery), doubled, plus slack.  Blocking waits on the
+        in-flight window use this bound so a wedged worker can never
+        hang the element thread forever (audit contract,
+        tools/check_blocking_timeouts.py)."""
+        t = float(self.props["timeout"])
+        attempts = 1 + max(0, int(self.props["retries"]))
+        busy = max(0, int(self.props["busy-retries"]))
+        disc = float(self.props["discovery-timeout"])
+        return 2.0 * ((attempts + busy) * (t + 1.0) + disc) + 30.0
+
+    def _await(self, fut: Future):
+        try:
+            return fut.result(timeout=self._result_budget())
+        except FuturesTimeout:
+            raise TimeoutError(
+                f"{self.name}: in-flight request exceeded the "
+                f"{self._result_budget():.0f}s worst-case budget "
+                "(wedged worker?)"
+            ) from None
+
     def _drain_ready(self, block_all: bool):
         out = []
         while self._inflight:
@@ -507,7 +566,7 @@ class TensorQueryClient(Element):
             if not block_all and not fut.done():
                 break
             self._inflight.popleft()
-            got = fut.result()  # raises on RPC error -> error-policy/bus
+            got = self._await(fut)  # raises on RPC error -> error-policy/bus
             if got is None:
                 continue  # degrade=skip swallowed the frame (warned)
             if isinstance(got, list):  # wire-batched request
@@ -545,6 +604,8 @@ class TensorQueryClient(Element):
             "breakers": breakers,
             "breaker_trips_evicted": self._evicted_breaker_trips,
             "degraded_frames": self._degraded,
+            "busy_replies": self._busy_replies,
+            "deadline_expired": self._deadline_expired,
             "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
         }
 
@@ -702,10 +763,58 @@ class TensorQueryClient(Element):
             pass
         return False
 
+    def _request_timeout(self, frame, base: float):
+        """Per-attempt timeout honoring the frames' deadline QoS budget:
+        ``(timeout, expired)`` where timeout = min(configured, tightest
+        remaining budget).  The remaining budget rides the wire
+        (tcp_query header deadline_s / gRPC deadline) so the server can
+        expire the work before invoke — end-to-end budget propagation."""
+        frames = frame if isinstance(frame, list) else [frame]
+        rem: Optional[float] = None
+        for f in frames:
+            r = deadline_remaining(f)
+            if r is not None:
+                rem = r if rem is None else min(rem, r)
+        if rem is None:
+            return base, False
+        return min(base, rem), rem <= 0
+
+    def _note_busy(self) -> None:
+        with self._breakers_lock:  # pool workers race this counter
+            self._busy_replies += 1
+
+    def _note_expired(self) -> TimeoutError:
+        with self._breakers_lock:
+            self._deadline_expired += 1
+        return TimeoutError(f"{self.name}: deadline expired mid-request")
+
+    def _record_remote_failure(self, breaker, ps: "_PoolState", i: int,
+                               err: BaseException, cooldown_s: float) -> None:
+        """Shared breaker/cooldown classification for a failed attempt
+        (unary + stream paths — one place so they cannot diverge): an
+        application-level reply from a live server is HEALTH, anything
+        else counts against the remote."""
+        import time
+
+        if is_remote_application_error(err):
+            if breaker is not None:
+                breaker.record_success()
+            return
+        if breaker is not None:
+            breaker.record_failure()
+        ps.down_until[i] = time.monotonic() + cooldown_s
+
     def _invoke_failover(self, frame, first: int, rediscovered: bool = False):
         """One request: try the assigned (healthy-first) server, fail over
         round-robin to the others, `retries` extra attempts total.
         ``frame`` may be a list (wire micro-batch) -> list comes back.
+
+        BUSY replies (server admission shed) are transient backpressure,
+        not failures: they never touch the breaker, and get their own
+        ``busy-retries`` budget of RetryPolicy-paced re-sends (safe even
+        at-most-once — an admission-refused request provably never
+        executed).  Frames carrying a deadline stop retrying the moment
+        their budget runs out.
 
         Topic mode: when every attempt fails, the server set is refreshed
         from the broker (pod membership may have changed) and the request
@@ -719,14 +828,25 @@ class TensorQueryClient(Element):
         if not ps.conns:
             raise RuntimeError(f"{self.name}: no connections (stopped?)")
         attempts = 1 + max(0, self.props["retries"])
+        busy_budget = max(0, int(self.props["busy-retries"]))
         timeout = self.props["timeout"]
         retry_policy = self._retry_policy
         order = self._healthy_order(ps, first)
         err: Optional[BaseException] = None
         open_err: Optional[BaseException] = None
         cursor = 0
-        for k in range(attempts):
+        k = 0
+        busy_used = 0
+        expired_terminal = False
+        while k < attempts:
             if self._stopped:
+                break
+            req_timeout, expired = self._request_timeout(frame, timeout)
+            if expired:
+                # the frame's latency budget died during earlier attempts:
+                # an answer can no longer matter — stop burning remotes
+                err = self._note_expired()
+                expired_terminal = True
                 break
             # next remote whose breaker admits a call — open breakers are
             # skipped WITHOUT consuming a retry attempt (failing fast on a
@@ -746,8 +866,9 @@ class TensorQueryClient(Element):
                 # backoff instead of failing the whole budget instantly —
                 # the reset window may grant a half-open probe before the
                 # attempts run out (a 1s blip must not drop 5s of frames)
-                if k + 1 < attempts and not self._stopped:
-                    delay = retry_policy.delay_for(k + 1)
+                k += 1
+                if k < attempts and not self._stopped:
+                    delay = retry_policy.delay_for(k)
                     if delay > 0:
                         time.sleep(delay)
                     continue
@@ -755,35 +876,56 @@ class TensorQueryClient(Element):
             conn = ps.conns[i]
             try:
                 if isinstance(frame, list):
-                    result = conn.invoke_batch(frame, timeout)
+                    result = conn.invoke_batch(frame, req_timeout)
                 else:
-                    result = conn.invoke(frame, timeout)
+                    result = conn.invoke(frame, req_timeout)
                 ps.down_until.pop(i, None)
                 if breaker is not None:
                     breaker.record_success()
                 return result
+            except ServerBusyError as e:
+                err = e
+                self._note_busy()
+                if breaker is not None:
+                    # the server ANSWERED (instantly, at admission): this
+                    # is the healthiest a refusal gets — never a trip
+                    breaker.record_success()
+                if busy_used < busy_budget and not self._stopped:
+                    busy_used += 1  # own budget: attempts stay intact
+                    delay = max(e.retry_after,
+                                retry_policy.delay_for(busy_used))
+                    self.log.debug(
+                        "server %s busy (shed %d/%d); retrying in %.3fs",
+                        conn.addr, busy_used, busy_budget, delay,
+                    )
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue  # rotate to the next remote, paced
+                # busy budget exhausted: consumes attempts now — but
+                # still paced (honoring retry_after): hammering an
+                # already-shedding server with back-to-back attempts
+                # would amplify the very overload BUSY exists to relieve
+                k += 1
+                if k < attempts and not self._stopped:
+                    delay = max(e.retry_after, retry_policy.delay_for(k))
+                    if delay > 0:
+                        time.sleep(delay)
             except Exception as e:  # noqa: BLE001 — transport boundary
                 err = e
-                if is_remote_application_error(e):
-                    # the server ANSWERED (with an error reply): it is
-                    # healthy — poison frames must not trip its breaker
-                    # or cool it down; retries may still help (e.g. a
-                    # full-ingress reply, or another remote's capacity)
-                    if breaker is not None:
-                        breaker.record_success()
-                else:
-                    if breaker is not None:
-                        breaker.record_failure()
-                    ps.down_until[i] = time.monotonic() + timeout
+                # app-error replies (poison frames, full ingress) are
+                # HEALTH — retries may still help elsewhere; transport
+                # faults trip the breaker and cool the remote down
+                self._record_remote_failure(breaker, ps, i, e, timeout)
                 self.log.warning(
                     "query to %s failed (attempt %d/%d): %s",
                     conn.addr, k + 1, attempts, e,
                 )
-                if k + 1 < attempts:
+                k += 1
+                if k < attempts:
                     # RetryPolicy backoff between failover attempts so a
                     # flapping link isn't hammered (capped exponential +
                     # seeded jitter)
-                    delay = retry_policy.delay_for(k + 1)
+                    delay = retry_policy.delay_for(k)
                     if delay > 0:
                         time.sleep(delay)
         if err is None:
@@ -791,10 +933,13 @@ class TensorQueryClient(Element):
                 err = open_err  # every remote breaker-open, nothing tried
             else:  # stopped before any attempt
                 raise RuntimeError(f"{self.name}: stopped mid-request")
+        if expired_terminal:
+            # no answer can matter anymore: skip rediscovery/resend
+            raise err
         safe_to_resend = (
             self.props["retries"] > 0
             or self._provably_unsent(err)
-            or isinstance(err, CircuitOpenError)  # never reached a server
+            or isinstance(err, (CircuitOpenError, ServerBusyError))
         )
         if not rediscovered and self._rediscover(ps) and safe_to_resend:
             return self._invoke_failover(frame, first, rediscovered=True)
@@ -893,9 +1038,15 @@ class TensorQueryClient(Element):
         err: Optional[BaseException] = None
         open_err: Optional[BaseException] = None
         tried = 0
-        for i in order:
-            if tried >= attempts:
-                break
+        busy_budget = max(0, int(self.props["busy-retries"]))
+        busy_used = 0
+        expired_terminal = False
+        deadline_ts = frame.meta.get(DEADLINE_META)
+        cursor = 0
+        refused = 0  # consecutive breaker refusals (bounds the rotation)
+        while tried < attempts and refused < len(order):
+            i = order[cursor % len(order)]
+            cursor += 1
             conn = ps.conns[i]
             breaker = self._breaker_for(ps.targets[i])
             if breaker is not None and not breaker.allow():
@@ -905,13 +1056,22 @@ class TensorQueryClient(Element):
                 # its dial (same contract as the unary path)
                 open_err = CircuitOpenError(
                     f"{conn.addr} circuit {breaker.state}")
+                refused += 1
                 continue
+            refused = 0
             tried += 1
             started = False
             try:
-                for ans in conn.invoke_stream(frame, timeout):
+                req_timeout, expired = self._request_timeout(frame, timeout)
+                if expired:
+                    err = self._note_expired()
+                    expired_terminal = True
+                    break
+                for ans in conn.invoke_stream(frame, req_timeout):
                     started = True
                     ps.down_until.pop(i, None)
+                    if deadline_ts is not None:
+                        ans.meta[DEADLINE_META] = deadline_ts
                     yield (0, ans)
                 if breaker is not None:
                     # success is recorded on clean COMPLETION (empty
@@ -921,6 +1081,28 @@ class TensorQueryClient(Element):
                     # its failure window every request and never trip
                     breaker.record_success()
                 return
+            except ServerBusyError as e:
+                # admission shed: only ever raised BEFORE the first
+                # answer; backpressure, never a breaker/health event
+                err = e
+                self._note_busy()
+                if breaker is not None:
+                    breaker.record_success()
+                if busy_used < busy_budget and not self._stopped:
+                    busy_used += 1
+                    tried -= 1  # own budget: the attempt slot survives
+                    delay = max(e.retry_after,
+                                self._retry_policy.delay_for(busy_used))
+                    if delay > 0:
+                        _time.sleep(delay)
+                elif tried < attempts and not self._stopped:
+                    # budget exhausted: attempts are consumed, but still
+                    # paced — never hammer a shedding server
+                    delay = max(e.retry_after,
+                                self._retry_policy.delay_for(tried))
+                    if delay > 0:
+                        _time.sleep(delay)
+                continue
             except Exception as e:  # noqa: BLE001 — transport boundary
                 if started:
                     # mid-stream break: no safe replay — but it IS a
@@ -934,28 +1116,24 @@ class TensorQueryClient(Element):
                             float(timeout), 10.0)
                     raise
                 err = e
-                if is_remote_application_error(e):
-                    if breaker is not None:  # answered: server healthy
-                        breaker.record_success()
-                else:
-                    if breaker is not None:
-                        breaker.record_failure()
-                    # short cooldown: the stream timeout is minutes-scale
-                    # (a whole generation), not a health signal
-                    ps.down_until[i] = _time.monotonic() + min(
-                        float(timeout), 10.0
-                    )
+                # short cooldown (10s cap): the stream timeout is
+                # minutes-scale (a whole generation), not a health signal
+                self._record_remote_failure(
+                    breaker, ps, i, e, min(float(timeout), 10.0))
                 self.log.warning(
                     "stream to %s failed before first answer: %s",
                     conn.addr, e,
                 )
         if err is None:
             err = open_err  # only breaker refusals happened (or nothing)
+        if expired_terminal:
+            raise err  # no answer can matter anymore: no rediscover/resend
         if err is not None and not rediscovered:
             safe = (
                 self.props["retries"] > 0
                 or self._provably_unsent(err)
-                or isinstance(err, CircuitOpenError)  # never reached a server
+                # breaker-open / admission-shed: never reached the pipeline
+                or isinstance(err, (CircuitOpenError, ServerBusyError))
             )
             if self._rediscover(ps) and safe:
                 yield from self._stream_invoke(frame, rediscovered=True)
@@ -978,13 +1156,32 @@ class TensorQueryClient(Element):
                 "degrade": mode, "frames": n, "error": err,
             }))
 
+    @staticmethod
+    def _carry_deadline(req, ans):
+        """Answers inherit their request's deadline (instants never cross
+        the wire — liveness.DEADLINE_META is host-local), so an answer
+        that arrives after the budget died is expired downstream with
+        exact accounting instead of delivered late."""
+        reqs = req if isinstance(req, list) else [req]
+        answers = ans if isinstance(ans, list) else [ans]
+        for i, a in enumerate(answers):
+            if a is None:
+                continue
+            src = reqs[i] if i < len(reqs) else reqs[-1]
+            ts = src.meta.get(DEADLINE_META)
+            if ts is not None:
+                a.meta[DEADLINE_META] = ts
+        return ans
+
     def _invoke_or_degrade(self, frame_or_batch, first: int):
         """`_invoke_failover` + the degrade= contract: when every remote
         and retry is exhausted, either surface the error (default), pass
         the unanswered request frame(s) through, or drop them — so one
         dead pod degrades the stream instead of killing the pipeline."""
         try:
-            return self._invoke_failover(frame_or_batch, first)
+            return self._carry_deadline(
+                frame_or_batch,
+                self._invoke_failover(frame_or_batch, first))
         except Exception as e:  # noqa: BLE001 — transport boundary
             mode = self.props["degrade"]
             if mode not in ("passthrough", "skip"):
@@ -1002,9 +1199,10 @@ class TensorQueryClient(Element):
         fut.add_done_callback(self._notify_done)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window
-        # is full, then release whatever is complete (in order)
+        # is full, then release whatever is complete (in order); bounded —
+        # a wedged worker must surface, not hang the stream silently
         if len(self._inflight) >= max(1, self.props["max-in-flight"]):
-            self._inflight[0].result()
+            self._await(self._inflight[0])
         return self._drain_ready(block_all=False)
 
     def handle_eos(self, pad):

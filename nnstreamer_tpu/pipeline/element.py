@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
 from ..core.buffer import EOS, CapsEvent, CustomEvent, Event, Flush, TensorFrame
+from ..core.liveness import _check_stall_policy
 from ..core.log import get_logger
 from ..core.types import ANY, StreamSpec
 
@@ -68,12 +69,14 @@ class Property:
         return self.convert(value) if self.convert else value
 
 
-def _check_error_policy(v: str) -> str:
-    if v not in ("fail-stop", "skip", "restart"):
-        raise ValueError(
-            f"error-policy {v!r} (want fail-stop | skip | restart)"
-        )
-    return v
+def enum_prop_check(prop: str, *choices: str):
+    """Converter factory for enum-valued properties: eager validation so
+    a typo fails at set time with a uniform message, not at first use."""
+    def convert(v: str) -> str:
+        if v not in choices:
+            raise ValueError(f"{prop} {v!r} (want {' | '.join(choices)})")
+        return v
+    return convert
 
 
 COMMON_PROPERTIES.update({
@@ -92,7 +95,7 @@ COMMON_PROPERTIES.update({
         "bus) | restart (supervisor restarts the element with backoff, "
         "then retries the frame; degrades to fail-stop after "
         "max-restarts)",
-        convert=_check_error_policy,
+        convert=enum_prop_check("error-policy", "fail-stop", "skip", "restart"),
     ),
     "max-restarts": Property(
         int, 3, "restart policy: restarts allowed (within restart-window) "
@@ -111,6 +114,41 @@ COMMON_PROPERTIES.update({
         int, 16, "skip policy: poisoned frames retained for inspection "
         "(older ones roll off; 0 = count drops but retain nothing; the "
         "drop COUNTER is unbounded)"),
+    # liveness (core/liveness.py + the pipeline watchdog): catches the
+    # failures that never raise — a silent hang, a frame too late to
+    # matter.  See Documentation/resilience.md "Liveness & overload".
+    "frame-deadline": Property(
+        float, 0.0, "watchdog: max seconds ONE frame call may run before "
+        "an overrun is flagged (0 = disabled)"),
+    "stall-timeout": Property(
+        float, 0.0, "watchdog: seconds with input queued but no frame "
+        "completed before a stall is flagged (0 = disabled)"),
+    "stall-policy": Property(
+        str, "warn",
+        "on watchdog stall/overrun: warn (bus warning + health counter) "
+        "| restart (interrupt the hung call cooperatively, then the "
+        "restart machinery retries the frame) | fail (interrupt + tear "
+        "the pipeline down)",
+        convert=_check_stall_policy,
+    ),
+    "late-policy": Property(
+        str, "drop",
+        "frames carrying an expired deadline (core/liveness.py deadline "
+        "QoS): drop (default — dropped before processing, with exact "
+        "accounting in health()) | deliver (process regardless)",
+        convert=enum_prop_check("late-policy", "drop", "deliver"),
+    ),
+    # deadline stamping (sources only; ignored elsewhere): every emitted
+    # frame gets a latency budget that downstream elements honor
+    "deadline-s": Property(
+        float, 0.0, "sources: stamp each emitted frame with this latency "
+        "budget, seconds (0 = no deadline)"),
+    "deadline-anchor": Property(
+        str, "arrival",
+        "deadline-s anchoring: arrival (wall clock at emission — the "
+        "serving contract) | pts (stream epoch + pts — live playback)",
+        convert=enum_prop_check("deadline-anchor", "arrival", "pts"),
+    ),
 })
 
 
@@ -232,6 +270,9 @@ class Element:
         self.sink_specs: Dict[int, StreamSpec] = {}
         self._pipeline = None  # set by Pipeline.add
         self._mailbox = None  # set by Pipeline at start for elements w/ sinks
+        # liveness: set by the watchdog to cooperatively interrupt a hung
+        # call (see `interrupted`); cleared when the stall is handled
+        self._interrupted = threading.Event()
 
     # -- properties ---------------------------------------------------------
     def set_property(self, key: str, value: Any) -> None:
@@ -368,6 +409,23 @@ class Element:
 
     def set_sink_spec(self, pad: int, spec: StreamSpec) -> None:
         self.sink_specs[pad] = self.accept_spec(pad, spec)
+
+    # -- liveness -----------------------------------------------------------
+    @property
+    def interrupted(self) -> bool:
+        """True when the watchdog (stall-policy escalation) or pipeline
+        stop wants this element's current call to give up NOW.
+
+        The cooperative-interruption contract: element code doing long
+        waits or chunked work should poll this between steps and raise
+        :class:`~nnstreamer_tpu.core.liveness.StallError` (or simply
+        return) when set — a hung Python call cannot be killed from
+        outside, so liveness restart/fail escalation only works for
+        calls that cooperate.  Injected ``hang=`` faults poll it."""
+        if self._interrupted.is_set():
+            return True
+        p = self._pipeline
+        return p is not None and p._stop_flag.is_set()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, Flush, TensorFrame
+from ..core.liveness import DEADLINE_META, StallError, Watchdog, stamp_deadline
 from ..core.log import get_logger
 from ..core.resilience import FAULTS
 from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
@@ -131,11 +132,12 @@ class ElementHealth:
     recent ``dead-letter-max`` of them as ``(frame, error_repr)`` pairs
     for post-mortem inspection."""
 
-    state: str = "idle"  # idle|running|restarting|degraded|failed|finished
+    state: str = "idle"  # idle|running|restarting|degraded|failed|finished|stalled
     restarts: int = 0  # within the current restart-window (gates the budget)
     restarts_total: int = 0  # lifetime, for health reporting
     last_restart_ts: float = 0.0
     dead_letters: int = 0
+    deadline_drops: int = 0  # frames expired before this element processed them
     last_error: str = ""
     dlq: deque = None  # type: ignore[assignment]
 
@@ -168,6 +170,13 @@ class Pipeline:
         self._sink_lock = threading.Lock()
         # supervision: per-element health records (error-policy support)
         self.health_map: Dict[str, ElementHealth] = {}
+        # liveness (core/liveness.py): built at start() iff any element
+        # arms stall-timeout/frame-deadline; the sweeper thread polls it
+        self._watchdog: Optional[Watchdog] = None
+        self._watches: Dict[str, Any] = {}
+        self._wd_thread: Optional[threading.Thread] = None
+        self._upstream: Dict[str, List[Element]] = {}  # QoS feedback routing
+        self._qos_warn_ts: Dict[str, float] = {}  # per-element warn throttle
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
 
@@ -387,14 +396,96 @@ class Pipeline:
             for el in self.elements.values()
         }
         self._stop_flag.clear()
+        # upstream adjacency for deadline-QoS feedback (a downstream
+        # deadline drop throttles every upstream tensor_rate, ≙ the
+        # reference's QoS events travelling upstream)
+        self._upstream = {n: [] for n in self.elements}
         for el in self.elements.values():
+            for pad in el.srcpads:
+                for dst, _ in pad.links:
+                    self._upstream[dst.name].append(el)
+        self._arm_watchdog()
+        for el in self.elements.values():
+            el._interrupted.clear()
             target = self._run_source if isinstance(el, SourceElement) else self._run_element
             t = threading.Thread(target=target, args=(el,), name=el.name, daemon=True)
             self._threads.append(t)
         for t in self._threads:
             t.start()
+        if self._wd_thread is not None:
+            self._wd_thread.start()
         self._started = True
         return self
+
+    def _arm_watchdog(self) -> None:
+        """Build the liveness watchdog for every element that armed a
+        stall-timeout / frame-deadline; no-op (zero threads, zero hot-path
+        cost) when nothing is armed."""
+        self._watchdog = None
+        self._watches = {}
+        self._wd_thread = None
+        armed = [
+            el for el in self.elements.values()
+            if float(el.props.get("stall-timeout") or 0.0) > 0
+            or float(el.props.get("frame-deadline") or 0.0) > 0
+        ]
+        if not armed:
+            return
+        self._watchdog = Watchdog()
+        for el in armed:
+            box = el._mailbox
+            qsize = box.qsize if hasattr(box, "qsize") else (lambda: 0)
+            self._watches[el.name] = self._watchdog.register(
+                el.name,
+                stall_timeout=float(el.props.get("stall-timeout") or 0.0),
+                frame_deadline=float(el.props.get("frame-deadline") or 0.0),
+                policy=el.props.get("stall-policy", "warn"),
+                qsize=qsize,
+                on_event=lambda w, kind, elapsed, el=el: self._on_liveness(
+                    el, kind, elapsed),
+            )
+        self._wd_thread = threading.Thread(
+            target=self._watchdog_loop,
+            args=(self._watchdog.min_interval(),),
+            name=f"{self.name}-watchdog", daemon=True,
+        )
+
+    def _watchdog_loop(self, interval: float) -> None:
+        while not self._stop_flag.wait(interval):
+            try:
+                self._watchdog.check()
+            except Exception:  # a sweep bug must never kill liveness
+                self.log.exception("watchdog sweep failed")
+
+    def _on_liveness(self, el: Element, kind: str, elapsed: float) -> None:
+        """Watchdog escalation (runs on the sweeper thread): bus warning
+        always; stall-policy restart/fail additionally interrupt the hung
+        call cooperatively (the worker's StallError handling does the
+        actual restart — only the hung thread itself can retry its
+        frame)."""
+        policy = el.props.get("stall-policy", "warn")
+        h = self.health_map.get(el.name)
+        if h is not None:
+            h.last_error = f"liveness: {kind} after {elapsed:.3f}s"
+        self.post(BusMessage("warning", el.name, {
+            "liveness": kind, "elapsed": elapsed, "policy": policy,
+        }))
+        if policy == "warn":
+            return
+        el._interrupted.set()
+        if policy == "fail":
+            # the element may be wedged non-cooperatively: surface the
+            # failure NOW so wait() raises, instead of hoping the hung
+            # thread ever comes back to report it
+            err = StallError(
+                f"{el.name}: {kind} after {elapsed:.3f}s (stall-policy=fail)"
+            )
+            if h is not None:
+                h.state = "stalled"
+            self.errors.append(err)
+            self.post(BusMessage("error", el.name, err))
+            self._stop_flag.set()
+            self._sinks_done.set()
 
     def _make_mailbox(self, size: int, leaky: str = ""):
         if leaky:
@@ -423,6 +514,14 @@ class Pipeline:
                         pass
         for t in self._threads:
             t.join(timeout=5.0)
+        if self._wd_thread is not None:
+            if self._wd_thread.is_alive():
+                self._wd_thread.join(timeout=2.0)
+            self._wd_thread = None
+            # _watchdog/_watches survive stop(): a straggler worker whose
+            # join timed out may still ping them (harmless — the sweeper
+            # is gone), and health() keeps reporting the final counters;
+            # the next start() rebuilds both in _arm_watchdog()
         for el in self.elements.values():
             try:
                 el.stop()
@@ -467,8 +566,13 @@ class Pipeline:
                 "restarts_window": h.restarts,
                 "dead_letters": h.dead_letters,
                 "dead_letter_depth": len(h.dlq),
+                "deadline_drops": h.deadline_drops,
                 "last_error": h.last_error,
             }
+            w = self._watches.get(name)
+            if w is not None:
+                entry["stalls"] = w.stalls
+                entry["overruns"] = w.overruns
             info = getattr(el, "health_info", None)
             if info is not None:
                 try:
@@ -482,6 +586,66 @@ class Pipeline:
         """Publish the current health snapshot on the bus (kind
         ``health``); also posted automatically when an element degrades."""
         self.post(BusMessage("health", self.name, self.health()))
+
+    # -- deadline QoS ---------------------------------------------------------
+    def _expire_late(self, el: Element, frames: list) -> list:
+        """Deadline QoS: drop frames whose latency budget is exhausted
+        before `el` processes them (``late-policy=drop``), with exact
+        accounting (``health()[el]["deadline_drops"]``), a rate-limited
+        bus warning, and QoS feedback to upstream throttlers
+        (``note_qos``, implemented by tensor_rate).  Frames with no
+        deadline cost one dict lookup each."""
+        keep = None  # lazily forked: the no-drop path must not copy
+        now = time.monotonic()
+        for i, f in enumerate(frames):
+            ts = f.meta.get(DEADLINE_META)
+            # boundary contract: delivered strictly BEFORE the deadline,
+            # dropped from the instant now >= deadline (liveness.is_expired)
+            if ts is None or now < ts:
+                if keep is not None:
+                    keep.append(f)
+                continue
+            if keep is None:
+                if el.props.get("late-policy", "drop") != "drop":
+                    return frames
+                keep = list(frames[:i])
+            n = getattr(f, "batch_size", 1)
+            h = self.health_map.get(el.name)
+            if h is not None:
+                h.deadline_drops += n
+            lateness = now - ts
+            last = self._qos_warn_ts.get(el.name, float("-inf"))
+            if now - last >= 1.0:  # 1/s per element: drops come in bursts
+                self._qos_warn_ts[el.name] = now
+                self.log.warning(
+                    "%s: dropped %d frame(s) %.3fs past deadline "
+                    "(late-policy=drop)", el.name, n, lateness,
+                )
+                self.post(BusMessage("warning", el.name, {
+                    "qos": "deadline", "dropped": n, "lateness": lateness,
+                }))
+            self._qos_feedback(el, f, lateness)
+        return frames if keep is None else keep
+
+    def _qos_feedback(self, el: Element, frame, lateness: float) -> None:
+        """Tell every upstream throttler a deadline was missed (≙ the
+        reference's QoS events travelling upstream to tensor_rate,
+        gsttensor_rate.c): elements exposing ``note_qos(pts, lateness)``
+        hear about it and shed earlier, where dropping is cheapest."""
+        seen = {el.name}
+        stack = [el.name]
+        while stack:
+            for up in self._upstream.get(stack.pop(), ()):
+                if up.name in seen:
+                    continue
+                seen.add(up.name)
+                note = getattr(up, "note_qos", None)
+                if note is not None:
+                    try:
+                        note(frame.pts, lateness)
+                    except Exception:
+                        self.log.exception("note_qos failed for %s", up.name)
+                stack.append(up.name)
 
     def _dead_letter(self, el: Element, frames, err: BaseException) -> None:
         """skip policy: record dropped frame(s) + bus warning."""
@@ -508,6 +672,7 @@ class Pipeline:
         — caller falls back to fail-stop), or ``"stopping"`` (pipeline
         shut down mid-backoff — caller exits quietly)."""
         h = self.health_map[el.name]
+        el._interrupted.clear()  # a liveness interrupt is consumed here
         h.last_error = repr(err)
         limit = int(el.props.get("max-restarts", 3))
         window = float(el.props.get("restart-window", 60.0) or 0.0)
@@ -612,25 +777,68 @@ class Pipeline:
         policy = el.props.get("error-policy", "fail-stop")
         if getattr(el, "SUPERVISES_OWN_ERRORS", False):
             policy = "fail-stop"
+        # locals: stop() may run concurrently with a straggler worker —
+        # the pings must never dereference a half-torn-down pipeline
+        wd, watch = self._watchdog, self._watches.get(el.name)
         while True:
             try:
-                # fault-injection site INSIDE the policy boundary, so
-                # injected faults exercise the same machinery real ones do
-                if FAULTS.is_armed():
-                    FAULTS.check(f"element.{el.name}.handle_frame")
-                result = call()
-                if policy != "fail-stop" and not isinstance(
-                        result, (list, tuple)):
-                    # lazy outputs (generators, e.g. the query client's
-                    # stream mode) raise during ITERATION, which happens
-                    # outside this try under fail-stop; with skip/restart
-                    # the errors must land here, so materialize — the
-                    # cost of supervision is losing output laziness
-                    result = list(result)
+                if el._interrupted.is_set():
+                    # a STALE interrupt (the flagged call completed on
+                    # its own, or the stall was a transient push-block)
+                    # must not leak into this healthy call — it would
+                    # raise a spurious StallError and burn the restart
+                    # budget on an element that is progressing
+                    el._interrupted.clear()
+                if watch is not None:
+                    # heartbeat: the busy window spans the whole call so
+                    # the watchdog can flag a per-frame overrun (pinged
+                    # BEFORE the fault site — an injected hang must land
+                    # inside the monitored window)
+                    wd.begin(watch)
+                try:
+                    # fault-injection site INSIDE the policy boundary, so
+                    # injected faults exercise the same machinery real
+                    # ones do; the interrupt predicate lets watchdog
+                    # escalation / pipeline stop break hang= faults
+                    if FAULTS.is_armed():
+                        FAULTS.check(
+                            f"element.{el.name}.handle_frame",
+                            interrupt=lambda: el.interrupted,
+                        )
+                    result = call()
+                    if policy != "fail-stop" and not isinstance(
+                            result, (list, tuple)):
+                        # lazy outputs (generators, e.g. the query client's
+                        # stream mode) raise during ITERATION, which happens
+                        # outside this try under fail-stop; with skip/restart
+                        # the errors must land here, so materialize — the
+                        # cost of supervision is losing output laziness
+                        result = list(result)
+                finally:
+                    if watch is not None:
+                        # any outcome is progress: the item left the queue
+                        wd.done(watch)
                 return result
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:  # noqa: BLE001 — policy boundary
+                if isinstance(e, StallError):
+                    # a hung call surfaced via cooperative interruption:
+                    # STALL-policy governs (independent of error-policy —
+                    # a fail-stop element can still be stall-restarted)
+                    el._interrupted.clear()
+                    sp = el.props.get("stall-policy", "warn")
+                    if sp == "restart":
+                        verdict = self._restart_element(el, e)
+                        if verdict == "retry":
+                            continue
+                        if verdict == "stopping":
+                            return self._SUPERVISED_STOPPING
+                        raise  # degraded: fall back to fail-stop
+                    if sp == "fail":
+                        raise
+                    # warn (element code raised StallError on its own):
+                    # fall through to the normal error-policy handling
                 if policy == "skip":
                     return self._skip_failed(el, frames, e, per_item)
                 if policy == "restart":
@@ -699,19 +907,58 @@ class Pipeline:
 
     def _run_source(self, el: SourceElement) -> None:
         def body():
+            # deadline QoS stamping (deadline-s prop): every emitted frame
+            # carries a latency budget downstream elements honor.  The pts
+            # anchor (live playback) is the wall instant of the FIRST
+            # frame minus its pts, so frame 0 gets its full budget.
+            budget = float(el.props.get("deadline-s") or 0.0)
+            pts_anchored = el.props.get("deadline-anchor") == "pts"
+            anchor = None
             for i in range(len(el.srcpads)):
                 spec = el.output_spec() if len(el.srcpads) == 1 else el.derive_spec(i)
                 self._push(el, i, CapsEvent(spec))
-            for frame in el.frames():
-                if self._stop_flag.is_set():
-                    return
+            # liveness on sources: the busy window wraps each next() on
+            # the frames() generator (and the per-frame fault site), so
+            # frame-deadline bounds the gap between productions (a
+            # stalled camera/publisher) and stall-timeout catches a
+            # producer hung mid-pull.  Downstream pushes stay OUTSIDE
+            # the window — blocking on backpressure is healthy, not a
+            # stall.
+            wd, watch = self._watchdog, self._watches.get(el.name)
+            frames_it = iter(el.frames())
+            while True:
+                if el._interrupted.is_set():
+                    # stale interrupt from an escalation whose pull
+                    # completed anyway: consume it (see _supervised)
+                    el._interrupted.clear()
+                if watch is not None:
+                    wd.begin(watch)
+                try:
+                    try:
+                        frame = next(frames_it)
+                    except StopIteration:
+                        break
+                    if self._stop_flag.is_set():
+                        return
+                    if not isinstance(frame, Event) and FAULTS.is_armed():
+                        FAULTS.check(f"element.{el.name}.frames",
+                                     interrupt=lambda: el.interrupted)
+                finally:
+                    if watch is not None:
+                        # always clears the busy window (also on stream
+                        # end), or the sweeper would flag a finished
+                        # element's stale episode
+                        wd.done(watch)
                 if isinstance(frame, Event):
                     outs = el.handle_event(0, frame) or []
                     for sp, ev in outs:
                         self._push(el, sp, ev)
                     continue
-                if FAULTS.is_armed():
-                    FAULTS.check(f"element.{el.name}.frames")
+                if budget > 0:
+                    if pts_anchored and anchor is None and frame.pts is not None:
+                        anchor = time.monotonic() - frame.pts
+                    stamp_deadline(frame, budget,
+                                   anchor=anchor if pts_anchored else None)
                 if self.tracer is not None:
                     self.tracer.stamp_source(frame)
                 if not self._push(el, 0, frame):
@@ -735,7 +982,17 @@ class Pipeline:
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except BaseException as e:  # noqa: BLE001 — policy boundary
-                    if el.props.get("error-policy") != "restart":
+                    # a watchdog-interrupted hang (StallError) restarts
+                    # under stall-policy=restart even when error-policy
+                    # is the fail-stop default — same contract as the
+                    # non-source path in _supervised
+                    stall_restart = (
+                        isinstance(e, StallError)
+                        and el.props.get("stall-policy") == "restart")
+                    if isinstance(e, StallError):
+                        el._interrupted.clear()
+                    if (el.props.get("error-policy") != "restart"
+                            and not stall_restart):
                         raise
                     from ..core.resilience import is_transient
 
@@ -887,6 +1144,9 @@ class Pipeline:
                                     else (f,)
                                 )
                             ]
+                        frames = self._expire_late(el, frames)
+                        if not frames:
+                            continue  # whole micro-batch expired
                         t_in = (
                             time.perf_counter() if tracer is not None else 0.0
                         )
@@ -924,7 +1184,7 @@ class Pipeline:
                             # call-then-replay would re-run the already-
                             # processed prefix on a stateful element
                             outs = []
-                            for lf in item.split():
+                            for lf in self._expire_late(el, item.split()):
                                 res = self._supervised(
                                     el,
                                     lambda lf=lf, pad=pad:
@@ -935,6 +1195,8 @@ class Pipeline:
                                     return
                                 outs.extend(res)
                         else:
+                            if not self._expire_late(el, [item]):
+                                continue  # deadline passed: accounted drop
                             outs = self._supervised(
                                 el,
                                 lambda item=item, pad=pad:

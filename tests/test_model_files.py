@@ -37,18 +37,47 @@ def test_msgpack_file_load(tmp_path, rng):
 
 
 def test_orbax_dir_load(tmp_path, rng):
+    """Orbax checkpoint-dir loading is FAITHFUL: the restored params are
+    bit-identical to the saved ones, and invoking through the backend
+    matches invoking the same backend on the original params exactly.
+
+    Deterministic by construction (this was a suite-order flake): the
+    async orbax save is awaited before restore, and the numeric
+    comparison is jit-path vs jit-path — same process, same executable —
+    instead of jit vs eager, so ambient jax state leaked by earlier
+    tests cannot skew one side of the comparison."""
     import jax
     import orbax.checkpoint as ocp
 
+    from nnstreamer_tpu.backends.jax_xla import (
+        register_jax_model,
+        unregister_jax_model,
+    )
+
     fn, params, _, _ = build("mnist_cnn", {**PROPS, "seed": "8"})
     ckpt = str(tmp_path / "ckpt")
-    ocp.StandardCheckpointer().save(
-        ckpt, jax.tree.map(np.asarray, params)
-    )
+    # StandardCheckpointer is an AsyncCheckpointer: without the context
+    # manager (wait_until_finished + close) the restore below races the
+    # background commit
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt, jax.tree.map(np.asarray, params))
     x = rng.normal(size=(2, 28, 28, 1)).astype(np.float32)
-    want = np.asarray(fn(params, [x])[0])
     with SingleShot(framework="jax-xla", model=ckpt, custom=ARCH) as s:
+        # round-trip fidelity: restored leaves == saved leaves, bit-exact
+        restored = s.backend._params
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            restored, params,
+        )
         got = np.asarray(s.invoke_batch([x])[0])
+    register_jax_model("_orbax_ref", fn, params)
+    try:
+        with SingleShot(framework="jax-xla", model="_orbax_ref",
+                        custom="dtype:float32") as ref:
+            want = np.asarray(ref.invoke_batch([x])[0])
+    finally:
+        unregister_jax_model("_orbax_ref")
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 

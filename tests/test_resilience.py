@@ -238,6 +238,23 @@ class TestCircuitBreaker:
         snap = b.snapshot()
         assert snap["state"] == "open" and snap["trips"] == 1
 
+    def test_stale_inflight_success_does_not_close_open_breaker(self):
+        # symmetric to the stale-failure case: a request sent BEFORE the
+        # trip completing while the breaker is open must not bypass
+        # reset_timeout/half-open probing (pipelined clients share one
+        # breaker across in-flight requests)
+        clk = FakeClock()
+        b = self.make(clk)
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "open"
+        b.record_success()  # stale in-flight success
+        assert b.state == "open" and not b.allow()
+        clk.t += 5.0
+        assert b.allow()        # half-open probing still required
+        b.record_success()      # the real probe closes it
+        assert b.state == "closed"
+
     def test_stale_inflight_failure_is_not_a_probe_failure(self):
         # a request older than the open window (timeout > reset_timeout)
         # failing during half-open must NOT re-open the breaker: no

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Lint gate: no unbounded blocking calls in the I/O layers.
+
+The liveness layer (core/liveness.py, Documentation/resilience.md
+"Liveness & overload") exists because a call that blocks forever takes a
+worker thread — and eventually the pipeline — down *silently*.  This
+gate keeps the audited state of ``nnstreamer_tpu/distributed/`` and
+``nnstreamer_tpu/elements/`` from regressing.  Flagged patterns:
+
+* ``sock.settimeout(None)`` — switches a socket to unbounded blocking;
+* zero-argument blocking waits: ``.get()`` / ``.wait()`` / ``.join()``
+  / ``.result()`` (queue pops, event waits, thread joins, and future
+  results must carry a timeout — a wedged peer/worker otherwise parks
+  the caller forever);
+* ``socket.create_connection(...)`` without a ``timeout=``.
+
+Deliberate unbounded blocking (a pub/sub stream idling on a quiet
+publisher, interruptible via ``close()``) carries an inline
+``# allow-blocking: <reason>`` on the flagged line or within the three
+lines above it, or a file:line ALLOWLIST entry below with a reason.
+
+Exit status: 0 clean, 1 violations (printed as file:line).  Run directly
+or via the tier-1 test ``tests/test_liveness.py::test_no_unbounded_blocking``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SCAN_DIRS = ["nnstreamer_tpu/distributed", "nnstreamer_tpu/elements"]
+
+# file:line entries that are allowed to keep a flagged pattern, with WHY
+ALLOWLIST: dict = {
+    # (none today — add "path/to/file.py:123" -> "reason" as needed)
+}
+
+_SETTIMEOUT_NONE = re.compile(r"\.settimeout\(\s*None\s*\)")
+_ZERO_ARG_WAIT = re.compile(r"\.(get|wait|join|result)\(\s*\)")
+_CREATE_CONN = re.compile(r"create_connection\(")
+_ALLOW = re.compile(r"#\s*allow-blocking:\s*\S")
+
+
+def _annotated(lines: list, i: int) -> bool:
+    """allow-blocking on the flagged line or within the 3 lines above."""
+    lo = max(0, i - 4)
+    return any(_ALLOW.search(lines[j]) for j in range(lo, i))
+
+
+def scan(root: Path = ROOT) -> list:
+    bad = []
+    for d in SCAN_DIRS:
+        for path in sorted((root / d).rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            lines = path.read_text(encoding="utf-8").splitlines()
+            for i, line in enumerate(lines, 1):
+                key = f"{rel}:{i}"
+                if key in ALLOWLIST or _annotated(lines, i):
+                    continue
+                if _SETTIMEOUT_NONE.search(line):
+                    bad.append((key, "settimeout(None): unbounded socket"))
+                    continue
+                m = _ZERO_ARG_WAIT.search(line)
+                if m:
+                    bad.append(
+                        (key, f".{m.group(1)}() with no timeout: "
+                         "unbounded wait"))
+                    continue
+                if _CREATE_CONN.search(line):
+                    # the call may span lines; look for timeout= in the
+                    # statement (this line + the next two)
+                    stmt = " ".join(lines[i - 1:i + 2])
+                    if "timeout=" not in stmt:
+                        bad.append(
+                            (key, "create_connection without timeout="))
+    return bad
+
+
+def main() -> int:
+    bad = scan()
+    for key, why in bad:
+        print(f"{key}: {why}")
+    if bad:
+        print(f"\n{len(bad)} unbounded blocking call(s); add a timeout, "
+              "or annotate '# allow-blocking: <reason>' if the block is "
+              "deliberate and interruptible "
+              "(tools/check_blocking_timeouts.py)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
