@@ -13,7 +13,12 @@ failure it still prints one JSON line with an "error" field (fail-soft) so
 the driver artifact is diagnosable instead of a stack trace.
 
 Env knobs:
-  BENCH_MODEL     mobilenet|ssd|yolov5|posenet|mnist_trainer (default mobilenet)
+  BENCH_MODEL     mobilenet|ssd|yolov5|posenet|vit|mnist_trainer|overhead
+                  (default mobilenet; overhead = CPU-safe 5-element
+                  identity passthrough isolating scheduler cost)
+  BENCH_FUSE      0|1 (default 1) streaming-thread fusion for every
+                  pipeline the bench builds (the overhead row always
+                  reports BOTH dataplanes: fused_fps/unfused_fps)
   BENCH_BATCH     micro-batch size (default 128)
   BENCH_FRAMES    measured frames (default 4096)
   BENCH_DTYPE     model dtype (default bfloat16)
@@ -56,10 +61,13 @@ ROWS_PATH = os.path.join(_HERE, "BENCH_ROWS.json")
 _SIG_KEYS = (
     "metric", "model", "batch", "dtype", "quantize", "dispatch_depth",
     "ingest", "sink_split", "input", "platform", "batch_timeout_ms",
+    "fuse",
 )
 # rows captured before an axis existed carry its then-implicit value
+# (fuse=0: pre-fusion rows measured the unfused seed dataplane, so they
+# can never stand in for a fused run)
 _SIG_DEFAULTS = {"ingest": "frame", "sink_split": True,
-                 "batch_timeout_ms": 20}
+                 "batch_timeout_ms": 20, "fuse": 0}
 
 
 def _sig(row: dict, exclude: tuple = ()) -> str:
@@ -385,7 +393,80 @@ METRICS = {
     "posenet": ("posenet_pose_fps_per_chip", "fps"),
     "vit": ("vit_image_labeling_fps_per_chip", "fps"),
     "mnist_trainer": ("mnist_cnn_trainer_epoch_seconds", "s"),
+    # scheduler-overhead row: 5-element identity passthrough (CPU, no
+    # accelerator, no model) — isolates the dataplane's per-frame cost so
+    # a fusion/handoff regression is a one-line measurable delta
+    "overhead": ("scheduler_overhead_passthrough_fps", "fps"),
 }
+
+
+def bench_fuse() -> bool:
+    """BENCH_FUSE=0|1 (default 1): streaming-thread fusion for every
+    pipeline this bench builds; exported to the pipeline layer as
+    NNS_FUSE so parse_pipeline picks it up."""
+    return os.environ.get("BENCH_FUSE", "1").lower() not in (
+        "0", "false", "no",
+    )
+
+
+def overhead_row(deadline_ts: float) -> dict:
+    """Scheduler-overhead microbench: appsrc ! identity x3 ! tensor_sink
+    (5 elements), tiny host frames, CPU-safe (no accelerator, no model).
+    Measures BOTH dataplanes every run — `value` is the configured
+    BENCH_FUSE mode's fps, `fused_fps`/`unfused_fps`/`fuse_speedup`
+    record the tentpole's delta explicitly."""
+    import numpy as np
+
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    n_frames = int(os.environ.get("BENCH_FRAMES", "30000"))
+    pool = [np.zeros((64,), np.float32) for _ in range(16)]
+
+    def run(fuse: bool) -> float:
+        pipe = parse_pipeline(
+            "appsrc name=src max-buffers=256 ! identity ! identity ! "
+            "identity ! tensor_sink name=out max-stored=1",
+            name="overhead", fuse=fuse,
+        )
+        pipe.start()
+        src, sink = pipe["src"], pipe["out"]
+        done = {"n": 0}
+        sink.connect_new_data(
+            lambda f: done.__setitem__("n", done["n"] + 1)
+        )
+        cap = max(10.0, min(60.0, deadline_ts - time.time() - 15.0))
+        for i in range(256):  # warmup: settle thread scheduling
+            src.push(pool[i % 16])
+        t_w = time.time()
+        while done["n"] < 256 and time.time() - t_w < cap:
+            time.sleep(0.005)
+        done["n"] = 0
+        t0 = time.perf_counter()
+        for i in range(n_frames):
+            src.push(pool[i % 16])
+        while done["n"] < n_frames and time.perf_counter() - t0 < cap:
+            time.sleep(0.002)
+        dt = time.perf_counter() - t0
+        measured = done["n"]
+        src.end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        return measured / dt
+
+    fused = run(True)
+    unfused = run(False)
+    value = fused if bench_fuse() else unfused
+    return {
+        "metric": METRICS["overhead"][0],
+        "value": round(value, 1),
+        "unit": "fps",
+        "vs_baseline": None,
+        "fused_fps": round(fused, 1),
+        "unfused_fps": round(unfused, 1),
+        "fuse_speedup": round(fused / unfused, 2) if unfused else None,
+        "chain": "appsrc!identity!identity!identity!tensor_sink",
+        "frames": n_frames,
+    }
 
 
 def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
@@ -702,7 +783,9 @@ def child_main() -> None:
     host_frames = os.environ.get("BENCH_HOST", "0").lower() in (
         "1", "true", "yes",
     )
-    if os.environ.get("BENCH_PLATFORM") == "cpu":
+    # BENCH_FUSE -> pipeline layer (read at Pipeline construction)
+    os.environ["NNS_FUSE"] = "1" if bench_fuse() else "0"
+    if os.environ.get("BENCH_PLATFORM") == "cpu" or which == "overhead":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -712,6 +795,8 @@ def child_main() -> None:
     deadline_ts = _T0 + float(os.environ.get("BENCH_DEADLINE", "420"))
     if which == "mnist_trainer":
         row = trainer_row(dtype, deadline_ts)
+    elif which == "overhead":
+        row = overhead_row(deadline_ts)
     else:
         row = pipeline_row(
             which, batch, n_frames, dtype, host_frames, deadline_ts
@@ -768,7 +853,9 @@ def main() -> None:
     host_frames = os.environ.get("BENCH_HOST", "0").lower() in (
         "1", "true", "yes",
     )
-    force_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
+    # the overhead row never touches an accelerator: CPU-safe by
+    # construction, so the backend probe (and stale fallback) are skipped
+    force_cpu = os.environ.get("BENCH_PLATFORM") == "cpu" or which == "overhead"
     meta = {
         "model": which,
         "batch": int(os.environ.get("BENCH_BATCH", "128")),
@@ -785,6 +872,7 @@ def main() -> None:
         "batch_timeout_ms": int(os.environ.get(
             "BENCH_BATCH_TIMEOUT", BATCH_TIMEOUT_DEFAULT_MS
         )),
+        "fuse": 1 if bench_fuse() else 0,
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"

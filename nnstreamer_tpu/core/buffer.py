@@ -19,7 +19,10 @@ to host.
 from __future__ import annotations
 
 import itertools
+import os
+import sys
 import time
+from collections import deque as _deque
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -127,12 +130,13 @@ class BatchFrame(TensorFrame):
         )
 
     def split(self) -> List[TensorFrame]:
-        """Materialize on host and fan back out into per-frame views."""
+        """Materialize on host and fan back out into per-frame views.
+        Per-frame wrappers come from the frame pool (the split fan-out is
+        the hottest frame allocator at chip-rate streams)."""
         mats = materialize(self.tensors)
+        acquire = FRAME_POOL.acquire
         return [
-            TensorFrame(
-                [m[b] for m in mats], pts=p, duration=d, meta=dict(fm)
-            )
+            acquire([m[b] for m in mats], pts=p, duration=d, meta=dict(fm))
             for b, (p, d, fm) in enumerate(self.frames_info)
         ]
 
@@ -162,6 +166,136 @@ def materialize(tensors: Sequence[Any]) -> List[np.ndarray]:
     micro-batch path, sinks)."""
     start_host_copies(tensors)
     return [np.asarray(t) for t in tensors]
+
+
+# ---------------------------------------------------------------------------
+# Frame pool (hot-path allocation diet)
+# ---------------------------------------------------------------------------
+class FramePool:
+    """Free-list of TensorFrame/BatchFrame carcasses.
+
+    At chip-rate streams the per-frame wrapper objects (dataclass
+    instance, meta dict, seq counter) are real scheduler overhead: every
+    split/emit allocates one and every sink/drop frees one, thousands of
+    times per second.  The pool recycles the *wrapper only* — payload
+    tensors and meta dicts are dropped at recycle time so nothing large is
+    ever pinned by the free list.
+
+    Safety contract: :meth:`recycle` accepts a frame ONLY when the caller
+    provably holds the last reference (``sys.getrefcount`` guard), so a
+    frame retained by an element (``tensor_if`` previous-frame cache, a
+    sink's stored frames, an application callback) can never be reused
+    under its holder.  Call it with at most one local binding:
+    ``pool.recycle(f)``.  Both sides are GIL-atomic (deque append/pop), so
+    any worker thread may acquire/recycle concurrently.
+
+    ``NNS_FRAME_POOL`` sizes the default pool (frames retained per class;
+    0 disables recycling entirely)."""
+
+    __slots__ = (
+        "_free", "_free_batch", "_max_refs", "enabled", "reused", "recycled",
+    )
+
+    def _probe_refs(self, x) -> int:
+        """Observed refcount of an object held by exactly one caller local,
+        seen from inside a method call — the method-call machinery's
+        contribution varies across CPython versions (3.10 keeps an extra
+        stack reference), so the recycle threshold is calibrated, not
+        assumed."""
+        return sys.getrefcount(x)
+
+    def __init__(self, maxsize: int = 1024):
+        self._free: _deque = _deque(maxlen=max(0, maxsize))
+        self._free_batch: _deque = _deque(maxlen=max(0, maxsize // 8))
+        self.enabled = maxsize > 0
+        probe = object()
+        self._max_refs = self._probe_refs(probe)
+        # stats (racy best-effort counters; tests/monitoring only)
+        self.reused = 0
+        self.recycled = 0
+
+    def acquire(
+        self,
+        tensors: List[Any],
+        pts: Optional[float] = None,
+        duration: Optional[float] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> "TensorFrame":
+        """A TensorFrame with the given payload: recycled when a carcass
+        is free, freshly constructed otherwise.  Same signature/cost
+        either way; ``seq`` is always fresh."""
+        try:
+            f = self._free.pop()
+        except IndexError:
+            return TensorFrame(
+                tensors, pts=pts, duration=duration,
+                meta={} if meta is None else meta,
+            )
+        f.tensors = tensors
+        f.pts = pts
+        f.duration = duration
+        f.meta = {} if meta is None else meta
+        f.seq = next(_seq)
+        self.reused += 1
+        return f
+
+    def acquire_batch(
+        self,
+        tensors: List[Any],
+        pts: Optional[float] = None,
+        duration: Optional[float] = None,
+        meta: Optional[Dict[str, Any]] = None,
+        frames_info: Optional[List] = None,
+    ) -> "BatchFrame":
+        try:
+            f = self._free_batch.pop()
+        except IndexError:
+            return BatchFrame(
+                tensors, pts=pts, duration=duration,
+                meta={} if meta is None else meta,
+                frames_info=frames_info or [],
+            )
+        f.tensors = tensors
+        f.pts = pts
+        f.duration = duration
+        f.meta = {} if meta is None else meta
+        f.frames_info = frames_info or []
+        f.seq = next(_seq)
+        self.reused += 1
+        return f
+
+    def recycle(self, frame: Any) -> bool:
+        """Return ``frame``'s carcass to the free list iff the caller holds
+        the only remaining reference; payload/meta references are dropped
+        immediately either way the frame is accepted.  Safe to call
+        speculatively — a still-referenced or foreign object is refused."""
+        if not self.enabled:
+            return False
+        t = type(frame)  # exact types only: subclasses own extra state
+        if t is TensorFrame:
+            if sys.getrefcount(frame) > self._max_refs:
+                return False
+            frame.tensors = None  # type: ignore[assignment] — re-set on acquire
+            frame.meta = None  # type: ignore[assignment]
+            frame.pts = frame.duration = None
+            self._free.append(frame)
+        elif t is BatchFrame:
+            if sys.getrefcount(frame) > self._max_refs:
+                return False
+            frame.tensors = None  # type: ignore[assignment]
+            frame.meta = None  # type: ignore[assignment]
+            frame.frames_info = None  # type: ignore[assignment]
+            frame.pts = frame.duration = None
+            self._free_batch.append(frame)
+        else:
+            return False
+        self.recycled += 1
+        return True
+
+
+#: process-wide default pool used by the scheduler dispatch loop,
+#: BatchFrame.split, and tensor_filter's batch emitter
+FRAME_POOL = FramePool(int(os.environ.get("NNS_FRAME_POOL", "1024")))
 
 
 # ---------------------------------------------------------------------------

@@ -192,9 +192,18 @@ def decode_frame(buf: bytes) -> TensorFrame:
             if len(payload) != plen:
                 raise WireError("truncated tensor payload")
             off += plen
-            tensors.append(
-                np.frombuffer(payload, dtype=spec.dtype).reshape(spec.shape)
-            )
+            # ALIASING CONTRACT: this view shares memory with the receive
+            # buffer (zero-copy decode).  It is explicitly marked
+            # read-only — over an immutable bytes buffer numpy already
+            # refuses writes, but a pooled/reused bytearray receive buffer
+            # would otherwise hand out WRITABLE views, and an in-place
+            # downstream transform would silently corrupt every other
+            # frame decoded from the same buffer.  Elements that need to
+            # mutate must copy first (tensor_transform and friends are
+            # out-of-place, so the common pipelines never pay the copy).
+            arr = np.frombuffer(payload, dtype=spec.dtype)
+            arr.flags.writeable = False
+            tensors.append(arr.reshape(spec.shape))
     except (struct.error, ValueError) as e:
         if isinstance(e, WireError):
             raise

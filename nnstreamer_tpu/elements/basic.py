@@ -18,7 +18,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from ..core.buffer import BatchFrame, TensorFrame
+from ..core.buffer import FRAME_POOL, BatchFrame, TensorFrame
 from ..core.types import ANY, FORMAT_STATIC, StreamSpec, TensorSpec
 from ..pipeline.element import (
     Element,
@@ -268,7 +268,10 @@ class TensorSink(SinkElement):
         limit = self.props["max-stored"]
         self.frames.append(frame)
         if limit and len(self.frames) > limit:
-            self.frames.pop(0)
+            evicted = self.frames.pop(0)
+            # frame-pool recycling: the sink is the end of most frames'
+            # lives; the refcount guard refuses frames a callback retained
+            FRAME_POOL.recycle(evicted)
         if not self.props["emit-signal"]:
             return
         rate = self.props["signal-rate"]
@@ -288,15 +291,20 @@ class TensorSink(SinkElement):
 
 @element("queue")
 class Queue(TransformElement):
-    """Thread-boundary element.  Every element here already runs on its own
-    thread; `queue` remains for pipeline-text compatibility, to set the
-    buffering depth (`max-buffers` maps to the mailbox size), and for the
-    live-pipeline ``leaky`` modes (≙ GstQueue leaky): a full queue then
-    DROPS frames instead of blocking the producer —
-    ``leaky=upstream`` drops the incoming frame, ``leaky=downstream``
-    drops the oldest queued frame.  Events are never dropped."""
+    """Thread-boundary element (≙ GstQueue): the explicit way to break a
+    fused streaming thread.  A linear chain shares ONE worker thread under
+    the scheduler's fusion pass; inserting `queue` ends the segment, giving
+    the downstream half its own thread and a bounded mailbox — use it where
+    pipeline parallelism pays (a slow stage that should overlap its
+    neighbors).  Also sets the buffering depth (`max-buffers` maps to the
+    mailbox size) and provides the live-pipeline ``leaky`` modes (≙
+    GstQueue leaky): a full queue then DROPS frames instead of blocking the
+    producer — ``leaky=upstream`` drops the incoming frame,
+    ``leaky=downstream`` drops the oldest queued frame.  Events are never
+    dropped."""
 
     BATCH_AWARE = True  # batch-transparent pass-through
+    THREAD_BOUNDARY = True  # the explicit fusion boundary
 
     PROPERTIES = {
         "max-buffers": Property(int, 16, "bounded queue depth (backpressure)"),

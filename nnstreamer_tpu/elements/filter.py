@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,7 +31,7 @@ import numpy as np
 from ..backends.base import FilterBackend, find_backend, parse_accelerator
 from ..core import config as nns_config
 from ..core import registry
-from ..core.buffer import BatchFrame, CustomEvent, Flush, TensorFrame
+from ..core.buffer import FRAME_POOL, BatchFrame, CustomEvent, Flush, TensorFrame
 from ..core.model_uri import resolve_model_uri
 from ..core.resilience import FAULTS
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
@@ -122,7 +122,17 @@ def _parse_combination(text: str) -> Optional[List[Tuple[str, int]]]:
     return out or None
 
 
-_stack_jit_cache: Dict[Tuple, Any] = {}
+# bounded LRU: flexible-shape streams mint a new (bucket, shape, dtype)
+# key per distinct frame shape, and each entry pins a compiled XLA
+# program — unbounded growth is a slow leak on long-lived servers.  64
+# entries cover every steady-state pipeline observed (buckets are powers
+# of two, shapes are per-model); eviction just retraces on next use.
+# The lock guards the get/move_to_end/evict compound ops — the cache is
+# module-global and filter workers on different pipelines share it (its
+# cost is noise next to the jitted stack call it fronts).
+_STACK_JIT_MAX = 64
+_stack_jit_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+_stack_jit_lock = threading.Lock()
 
 
 def _stack_tensors(arrs: List[Any]):
@@ -150,10 +160,16 @@ def _stack_tensors(arrs: List[Any]):
         while bucket < n:
             bucket <<= 1
         key = (bucket, tuple(a0.shape), str(a0.dtype))
-        fn = _stack_jit_cache.get(key)
+        with _stack_jit_lock:
+            fn = _stack_jit_cache.get(key)
+            if fn is not None:
+                _stack_jit_cache.move_to_end(key)
         if fn is None:
             fn = jax.jit(lambda *xs: jnp.stack(xs))
-            _stack_jit_cache[key] = fn
+            with _stack_jit_lock:
+                _stack_jit_cache[key] = fn
+                while len(_stack_jit_cache) > _STACK_JIT_MAX:
+                    _stack_jit_cache.popitem(last=False)  # evict LRU
         stacked = fn(*(list(arrs) + [a0] * (bucket - n)))
         # lazy device slice (one op) back to the true count
         return stacked[:n] if bucket != n else stacked
@@ -728,8 +744,8 @@ class TensorFilter(TransformElement):
         if self.batch_through_active:
             infos = _logical_infos(frames)
             p, d, m = infos[0]
-            return [(0, BatchFrame(
-                tensors=list(out_b), pts=p, duration=d, meta=dict(m),
+            return [(0, FRAME_POOL.acquire_batch(
+                list(out_b), pts=p, duration=d, meta=dict(m),
                 frames_info=infos,
             ))]
         return self._dispatch_or_park(out_b, frames)
@@ -834,9 +850,9 @@ class TensorFilter(TransformElement):
                             (t[j] if t is not None else None) for t in ins_np
                         ]
                         outs = self._compose_outputs(ins, outs)
-                    results.append(
-                        (0, TensorFrame(outs, pts=p, duration=d, meta=dict(m)))
-                    )
+                    results.append((0, FRAME_POOL.acquire(
+                        outs, pts=p, duration=d, meta=dict(m),
+                    )))
                 b += f.batch_size
             else:
                 outs = [o[b] for o in out_np]

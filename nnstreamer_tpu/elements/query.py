@@ -56,6 +56,12 @@ from ..pipeline.element import (
 
 @element("tensor_query_serversrc")
 class TensorQueryServerSrc(SourceElement):
+    #: keep a thread boundary below this source: admission control's
+    #: in-flight window only fills when request pull and server-pipeline
+    #: processing overlap — fusing them would serialize the two and make
+    #: max-inflight unreachable
+    FUSE_DOWNSTREAM = False
+
     PROPERTIES = {
         "port": Property(int, 0, "listen port (0 = ephemeral)"),
         "host": Property(str, "[::]", "bind address"),
@@ -263,6 +269,10 @@ class TensorQueryClient(Element):
     #: policies cannot attribute it — this element degrades via its own
     #: `degrade=` property instead (the worker always runs it fail-stop)
     SUPERVISES_OWN_ERRORS = True
+    #: never fuse: the completion callback wakes the worker by injecting a
+    #: drain tick into this element's OWN mailbox (_notify_done) — without
+    #: a private mailbox, live streams would sit on ready answers
+    THREAD_BOUNDARY = True
 
     PROPERTIES = {
         "host": Property(str, "localhost", "server host"),

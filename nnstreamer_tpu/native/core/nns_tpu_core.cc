@@ -137,6 +137,34 @@ int nns_oq_pop_n (void *h, size_t max_n, double timeout_s, void **out)
   return (int) n;
 }
 
+/* Bulk push (block handoff): wait (like nns_oq_push) for space for the
+ * FIRST item, then append as many of the rest as fit without further
+ * waiting — one lock/wakeup cycle per run of outputs instead of one per
+ * frame.  Returns the count consumed (>0), -1 = timeout, -2 = closed. */
+int nns_oq_push_n (void *h, void **objs, size_t n_objs, double timeout_s)
+{
+  auto *q = static_cast<NnsQueue *> (h);
+  std::unique_lock<std::mutex> lk (q->m);
+  WaiterGuard wg (q);
+  auto ready = [q] { return q->closed || q->items.size () < q->capacity; };
+  if (timeout_s < 0) {
+    q->not_full.wait (lk, ready);
+  } else if (!q->not_full.wait_for (
+                 lk, std::chrono::duration<double> (timeout_s), ready)) {
+    return -1;
+  }
+  if (q->closed)
+    return -2;
+  size_t n = 0;
+  while (n < n_objs && q->items.size () < q->capacity)
+    q->items.push_back (objs[n++]);
+  if (n > 1)
+    q->not_empty.notify_all (); /* several items landed at once */
+  else
+    q->not_empty.notify_one ();
+  return (int) n;
+}
+
 size_t nns_oq_size (void *h)
 {
   auto *q = static_cast<NnsQueue *> (h);

@@ -115,6 +115,11 @@ def _load(block: bool = False) -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_double,
             ctypes.POINTER(ctypes.c_void_p),
         ]
+        lib.nns_oq_push_n.restype = ctypes.c_int
+        lib.nns_oq_push_n.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t, ctypes.c_double,
+        ]
         lib.nns_oq_size.restype = ctypes.c_size_t
         lib.nns_oq_size.argtypes = [ctypes.c_void_p]
         lib.nns_oq_close.argtypes = [ctypes.c_void_p]
@@ -182,6 +187,33 @@ class NativeMailbox:
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, timeout=0.0)
+
+    def put_many(self, items: list, timeout: Optional[float] = None) -> int:
+        """Push a run of items in ONE native call (block handoff): waits
+        (bounded) for space for the first, appends the rest as capacity
+        allows — one lock/wakeup cycle per run instead of one per frame.
+        Returns the number of leading items consumed (0 on timeout)."""
+        if self._closed:
+            raise _pyqueue.Full
+        n_items = len(items)
+        if n_items == 0:
+            return 0
+        arr = (ctypes.c_void_p * n_items)()
+        for i, item in enumerate(items):
+            # strong ref per item BEFORE the pointer enters the queue
+            ctypes.pythonapi.Py_IncRef(ctypes.py_object(item))
+            arr[i] = id(item)
+        rc = self._lib.nns_oq_push_n(
+            self._h, arr, n_items,
+            -1.0 if timeout is None else float(timeout),
+        )
+        consumed = max(0, rc)
+        for i in range(consumed, n_items):
+            # unconsumed tail: release the refs taken above
+            ctypes.pythonapi.Py_DecRef(ctypes.py_object(items[i]))
+        if rc == -2:
+            raise _pyqueue.Full  # closed
+        return consumed
 
     def _pop(self, timeout: Optional[float]) -> Any:
         out = ctypes.c_void_p()

@@ -250,6 +250,21 @@ class Element:
     #: (queue/tee/capsfilter) or splits blocks itself (tensor_sink).
     BATCH_AWARE = False
 
+    #: streaming-thread fusion opt-OUT (upstream side): True means this
+    #: element never fuses INTO its upstream's thread — it keeps its own
+    #: worker and mailbox (and, GStreamer-style, drives its fused
+    #: downstream from there).  Set it when the element's semantics NEED
+    #: the mailbox: `queue` (the explicit boundary element) and the query
+    #: client (which wakes its own worker through it).
+    THREAD_BOUNDARY = False
+
+    #: streaming-thread fusion opt-OUT (downstream side): False means
+    #: downstream elements never run inline on THIS element's thread.
+    #: Set False when the pipeline parallelism below this element is
+    #: load-bearing (`tensor_query_serversrc`: admission control's
+    #: in-flight window only fills when pull and processing overlap).
+    FUSE_DOWNSTREAM = True
+
     FACTORY_NAME = "element"
     NUM_SINK_PADS: Optional[int] = 1
     NUM_SRC_PADS: Optional[int] = 1

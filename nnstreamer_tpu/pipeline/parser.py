@@ -41,8 +41,15 @@ class ParseError(ValueError):
     pass
 
 
-def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
-    """Parse a pipeline description into an (unstarted) Pipeline."""
+def parse_pipeline(
+    text: str, name: str = "pipeline", fuse: "bool | None" = None
+) -> Pipeline:
+    """Parse a pipeline description into an (unstarted) Pipeline.
+
+    ``fuse`` controls streaming-thread fusion (None = the ``NNS_FUSE``
+    env default, on): linear chains share one worker thread unless an
+    explicit ``queue`` element inserts a boundary — GStreamer
+    semantics; see Documentation/performance.md."""
     try:
         tokens = shlex.split(text.replace("\n", " "))
     except ValueError as e:
@@ -50,7 +57,7 @@ def parse_pipeline(text: str, name: str = "pipeline") -> Pipeline:
     if not tokens:
         raise ParseError("empty pipeline description")
 
-    pipe = Pipeline(name)
+    pipe = Pipeline(name, fuse=fuse)
     named: Dict[str, Element] = {}
     deferred: List[tuple] = []  # (src_element, target_name) forward links
     current: Optional[Element] = None

@@ -1,12 +1,16 @@
-"""Pipeline scheduler: threaded element graph with bounded queues.
+"""Pipeline scheduler: fused streaming threads with bounded queues.
 
-Reference analog: GStreamer's execution model (L0 in SURVEY.md) — each
-element runs on a streaming thread, connected by pads; ``queue`` elements add
-thread boundaries and bounded buffering creates backpressure.  Here *every*
-element gets its own worker thread and a bounded mailbox, so pipeline
-parallelism (the reference's primary parallelism: elements concurrently
-processing different frames) is the default, and a full mailbox blocks the
-upstream thread — the backpressure analog.
+Reference analog: GStreamer's execution model (L0 in SURVEY.md) — elements
+run on streaming threads connected by pads, and a linear chain SHARES one
+streaming thread unless an explicit ``queue`` element inserts a thread
+boundary.  The scheduler fuses each maximal linear chain into one worker
+(eliding the per-frame mailbox handoffs entirely — the per-buffer-overhead
+bottleneck the NNStreamer papers attack with shared streaming threads);
+branches, muxes, micro-batching elements, and explicit ``queue``s keep
+their own threads and bounded mailboxes, so pipeline parallelism remains
+available exactly where it pays, and a full mailbox blocks the upstream
+thread — the backpressure analog.  ``Pipeline(fuse=False)`` (or
+``NNS_FUSE=0``) restores the one-thread-per-element seed model.
 
 Lifecycle ≙ NULL→PLAYING: ``start()`` negotiates schemas (CapsEvents flow
 before data), spawns workers; ``stop()`` tears down; ``wait()`` joins until
@@ -18,6 +22,7 @@ training stats) to the application (≙ GstBus).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -25,7 +30,15 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from ..core.buffer import EOS, BatchFrame, CapsEvent, Event, Flush, TensorFrame
+from ..core.buffer import (
+    EOS,
+    FRAME_POOL,
+    BatchFrame,
+    CapsEvent,
+    Event,
+    Flush,
+    TensorFrame,
+)
 from ..core.liveness import DEADLINE_META, StallError, Watchdog, stamp_deadline
 from ..core.log import get_logger
 from ..core.resilience import FAULTS
@@ -54,21 +67,29 @@ class _LeakyMailbox:
         self._not_empty = threading.Condition(self._mtx)
         self._not_full = threading.Condition(self._mtx)
 
+    def _put_frame_locked(self, item) -> None:
+        """Leaky policy for ONE frame entry; caller holds the lock.  A
+        frame arriving at a full box either evicts the oldest queued
+        FRAME (``downstream`` — events keep their exact position) or is
+        itself the loss (``upstream``); either way the frame is
+        'consumed' without blocking."""
+        if len(self._dq) >= self._max:
+            if self.policy == "upstream":
+                return  # live semantics: lose the newest frame
+            # downstream: drop the oldest FRAME in place; if only
+            # events are queued, the incoming frame is the loss
+            for i, old in enumerate(self._dq):
+                if isinstance(old[1], TensorFrame):
+                    del self._dq[i]
+                    break
+            else:
+                return
+        self._dq.append(item)
+
     def put_frame(self, item) -> None:
         """Non-blocking frame delivery with the leaky policy."""
         with self._mtx:
-            if len(self._dq) >= self._max:
-                if self.policy == "upstream":
-                    return  # live semantics: lose the newest frame
-                # downstream: drop the oldest FRAME in place; if only
-                # events are queued, the incoming frame is the loss
-                for i, old in enumerate(self._dq):
-                    if isinstance(old[1], TensorFrame):
-                        del self._dq[i]
-                        break
-                else:
-                    return
-            self._dq.append(item)
+            self._put_frame_locked(item)
             self._not_empty.notify()
 
     # -- queue.Queue-compatible subset (events, sentinel, worker get) ----
@@ -89,6 +110,29 @@ class _LeakyMailbox:
 
     def put_nowait(self, item) -> None:
         self.put(item, timeout=0.0)
+
+    def put_many(self, items, timeout: float = 0.0) -> int:
+        """Block handoff: deliver a RUN of ``(pad, item)`` entries under ONE
+        lock acquisition, applying the leaky policy per frame.  Frames never
+        block (drop semantics); the run stops at the first EVENT that does
+        not fit (events must block — the caller retries the remainder).
+        Returns the number of leading items consumed."""
+        n = 0
+        with self._mtx:
+            for entry in items:
+                if isinstance(entry[1], TensorFrame):
+                    self._put_frame_locked(entry)  # never blocks: drop policy
+                    n += 1
+                    continue
+                # event: only append when space exists; otherwise stop the
+                # run — the caller falls back to the blocking put loop
+                if len(self._dq) >= self._max:
+                    break
+                self._dq.append(entry)
+                n += 1
+            if n:
+                self._not_empty.notify()
+        return n
 
     def get(self, timeout: Optional[float] = None):
         with self._mtx:
@@ -146,6 +190,52 @@ class ElementHealth:
             self.dlq = deque(maxlen=16)
 
 
+class _ElemState:
+    """Per-element dispatch state inside one streaming-thread worker.
+
+    Exists for every element (fused or solo) so the dispatch loop touches
+    precomputed locals instead of re-deriving graph facts per frame — part
+    of the hot-path allocation diet."""
+
+    __slots__ = (
+        "el", "connected", "eos_pads", "caps_pads", "finished",
+        "next_state", "next_pad", "out_pad", "watch",
+    )
+
+    def __init__(self, el: Element):
+        self.el = el
+        self.connected: set = {0}
+        self.eos_pads: set = set()
+        self.caps_pads: set = set()
+        self.finished = False
+        # in-segment routing: the fused downstream element (None = outputs
+        # leave through mailboxes), the src pad carrying that link, and the
+        # downstream sink pad it lands on
+        self.next_state: Optional["_ElemState"] = None
+        self.next_pad = 0
+        self.out_pad = 0
+        self.watch = None  # liveness watch, bound at worker start
+
+
+class _Seg:
+    """One streaming thread: a maximal fusable linear chain of elements.
+
+    ``chain[0]`` is the head (a source, or the one element with a mailbox);
+    every later element receives its input inline on the head's thread —
+    GStreamer semantics: elements share a streaming thread unless an
+    explicit ``queue`` boundary is inserted."""
+
+    __slots__ = ("chain", "states")
+
+    def __init__(self, chain: List[Element]):
+        self.chain = chain
+        self.states: Dict[str, _ElemState] = {}
+
+
+def _env_fuse() -> bool:
+    return os.environ.get("NNS_FUSE", "1").lower() not in ("0", "false", "no")
+
+
 class Pipeline:
     """A running graph of elements."""
 
@@ -154,6 +244,7 @@ class Pipeline:
         name: str = "pipeline",
         default_queue_size: int = 16,
         tracer=None,
+        fuse: Optional[bool] = None,
     ):
         self.name = name
         self.log = get_logger(name)
@@ -179,6 +270,11 @@ class Pipeline:
         self._qos_warn_ts: Dict[str, float] = {}  # per-element warn throttle
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
+        # streaming-thread fusion (GStreamer semantics): linear chains share
+        # one worker unless a boundary (queue / batcher / branch) intervenes
+        self._fuse = _env_fuse() if fuse is None else bool(fuse)
+        self._segments: List[_Seg] = []
+        self._seg_of: Dict[str, _Seg] = {}
 
     def to_dot(self) -> str:
         """Graphviz DOT of the element graph (≙ GStreamer's
@@ -337,6 +433,111 @@ class Pipeline:
                 "filter's XLA program)", el.name, dst.name,
             )
 
+    # -- streaming-thread fusion pass (≙ GStreamer: elements share a
+    # streaming thread unless an explicit queue boundary is inserted) ------
+    def _compute_segments(self) -> List[_Seg]:
+        """Partition the element graph into streaming threads: each maximal
+        fusable linear chain becomes ONE worker (intermediate mailboxes are
+        elided entirely).  An edge up->down fuses iff:
+
+        * fusion is enabled (``fuse=``/``NNS_FUSE``),
+        * ``up``'s ONLY outgoing link is to ``down`` and ``down``'s only
+          input is ``up`` (branches/tees/muxes keep thread boundaries),
+        * ``down`` does not declare ``THREAD_BOUNDARY`` (``queue``, the
+          query client — elements whose semantics need a private mailbox;
+          they still drive their own fused downstream, GStreamer-style),
+        * ``up`` does not declare ``FUSE_DOWNSTREAM = False``
+          (``tensor_query_serversrc`` — admission control needs the
+          pipeline parallelism below it),
+        * ``down`` has no leaky policy (leaky drop decisions need a queue),
+        * neither side micro-batches (``preferred_batch > 1`` needs a
+          mailbox to drain batches from, and its downstream boundary is
+          what overlaps invoke with decode).
+
+        Runs after element start() (``preferred_batch`` needs live
+        backends) and after negotiation."""
+        incoming: Dict[str, int] = {n: 0 for n in self.elements}
+        for el in self.elements.values():
+            for pad in el.srcpads:
+                for dst, _ in pad.links:
+                    incoming[dst.name] += 1
+
+        def total_out(el: Element) -> int:
+            return sum(len(p.links) for p in el.srcpads)
+
+        def fusable(up: Element, down: Element) -> bool:
+            if not self._fuse or isinstance(down, SourceElement):
+                return False
+            if total_out(up) != 1 or incoming[down.name] != 1:
+                return False
+            if getattr(down, "THREAD_BOUNDARY", False):
+                return False  # down keeps its own mailbox/thread (queue…)
+            if not getattr(up, "FUSE_DOWNSTREAM", True):
+                return False  # up's downstream parallelism is load-bearing
+            if getattr(down, "leaky_policy", ""):
+                return False
+            if getattr(up, "preferred_batch", 1) > 1 or getattr(
+                    down, "preferred_batch", 1) > 1:
+                return False
+            return True
+
+        fused_up: Dict[str, Element] = {}  # down name -> its fused upstream
+        for el in self.elements.values():
+            if total_out(el) == 1:
+                for pad in el.srcpads:
+                    for dst, _ in pad.links:
+                        if fusable(el, dst):
+                            fused_up[dst.name] = el
+        segs: List[_Seg] = []
+        self._seg_of = {}
+        for el in self.elements.values():
+            if el.name in fused_up:
+                continue  # not a head
+            chain = [el]
+            cur = el
+            while True:
+                nxt = None
+                if total_out(cur) == 1:
+                    for pad in cur.srcpads:
+                        for dst, _ in pad.links:
+                            if fused_up.get(dst.name) is cur:
+                                nxt = dst
+                if nxt is None:
+                    break
+                chain.append(nxt)
+                cur = nxt
+            seg = _Seg(chain)
+            for e in chain:
+                st = _ElemState(e)
+                st.connected = {
+                    pad
+                    for other in self.elements.values()
+                    for sp in other.srcpads
+                    for d, pad in sp.links
+                    if d is e
+                } or {0}
+                seg.states[e.name] = st
+                self._seg_of[e.name] = seg
+            # in-segment routing links
+            for a, b in zip(chain, chain[1:]):
+                sa = seg.states[a.name]
+                for i, pad in enumerate(a.srcpads):
+                    for dst, sink_pad in pad.links:
+                        if dst is b:
+                            sa.next_state = seg.states[b.name]
+                            sa.out_pad = i
+                            sa.next_pad = sink_pad
+            segs.append(seg)
+        if self._fuse and any(len(s.chain) > 1 for s in segs):
+            self.log.info(
+                "fused %d elements onto %d streaming thread(s): %s",
+                len(self.elements), len(segs),
+                " | ".join(
+                    "+".join(e.name for e in s.chain) for s in segs
+                ),
+            )
+        return segs
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "Pipeline":
         if self._started:
@@ -369,20 +570,32 @@ class Pipeline:
         )
         if self._pending_sinks == 0:
             self._sinks_done.set()
-        # mailboxes for every element with sink pads — native C++ condvar
-        # queues when the core library is available (immediate wakeups, GIL
-        # released while blocked), stdlib queue.Queue otherwise
+        # streaming-thread partition (after element start: preferred_batch
+        # needs live backends); mailboxes only where thread boundaries
+        # remain — fused elements receive their input inline, so the
+        # per-frame lock/condvar handoff between them is gone entirely
+        self._segments = self._compute_segments()
+        fused_tail = {
+            e.name for seg in self._segments for e in seg.chain[1:]
+        }
+        # mailboxes for every segment-head element with sink pads — native
+        # C++ condvar queues when the core library is available (immediate
+        # wakeups, GIL released while blocked), stdlib queue.Queue otherwise
         for el in self.elements.values():
-            if not isinstance(el, SourceElement):
-                size = self.default_queue_size
-                if "max-buffers" in el.props and el.props["max-buffers"]:
-                    size = int(el.props["max-buffers"])
-                # a micro-batching element needs its full batch to fit in the
-                # mailbox or batches can never form at max-batch size
-                size = max(size, getattr(el, "preferred_batch", 1))
-                el._mailbox = self._make_mailbox(
-                    size, getattr(el, "leaky_policy", "")
-                )
+            if isinstance(el, SourceElement):
+                continue
+            if el.name in fused_tail:
+                el._mailbox = None  # input arrives inline on the segment
+                continue
+            size = self.default_queue_size
+            if "max-buffers" in el.props and el.props["max-buffers"]:
+                size = int(el.props["max-buffers"])
+            # a micro-batching element needs its full batch to fit in the
+            # mailbox or batches can never form at max-batch size
+            size = max(size, getattr(el, "preferred_batch", 1))
+            el._mailbox = self._make_mailbox(
+                size, getattr(el, "leaky_policy", "")
+            )
         def _dlq_maxlen(el: Element) -> int:
             v = el.props.get("dead-letter-max")
             # 0 is a VALID setting (count drops, retain no frame payloads
@@ -407,8 +620,11 @@ class Pipeline:
         self._arm_watchdog()
         for el in self.elements.values():
             el._interrupted.clear()
-            target = self._run_source if isinstance(el, SourceElement) else self._run_element
-            t = threading.Thread(target=target, args=(el,), name=el.name, daemon=True)
+        for seg in self._segments:
+            t = threading.Thread(
+                target=self._run_segment, args=(seg,),
+                name=seg.chain[0].name, daemon=True,
+            )
             self._threads.append(t)
         for t in self._threads:
             t.start()
@@ -433,7 +649,14 @@ class Pipeline:
             return
         self._watchdog = Watchdog()
         for el in armed:
+            # a fused element has no mailbox of its own: pending work for
+            # the whole segment sits in the head's mailbox (or a source
+            # head's internal queue), so stall detection watches that
             box = el._mailbox
+            if box is None:
+                seg = self._seg_of.get(el.name)
+                head = seg.chain[0] if seg else el
+                box = head._mailbox or getattr(head, "_q", None)
             qsize = box.qsize if hasattr(box, "qsize") else (lambda: 0)
             self._watches[el.name] = self._watchdog.register(
                 el.name,
@@ -865,24 +1088,38 @@ class Pipeline:
         finally:
             self.stop()
 
-    # -- worker loops -------------------------------------------------------
+    # -- worker runtime ------------------------------------------------------
+    # One worker thread per SEGMENT (a maximal fusable linear chain).  The
+    # head pulls items (source generator or mailbox); every downstream
+    # element in the segment processes inline on the same streaming thread
+    # via _dispatch — no intermediate mailbox, no lock/condvar handoff, no
+    # per-frame wakeup.  Items leaving the segment go through _push /
+    # _push_outs (block handoff: one queue operation per run of outputs).
+
+    def _fail(self, el: Element, e: BaseException) -> bool:
+        """Record a fatal element failure (≙ GstBus error posting) and tear
+        the pipeline down; returns False so dispatch chains unwind.  Must
+        be called from an ``except`` context (log.exception)."""
+        self.log.exception("element %s failed", el.name)
+        h = self.health_map.get(el.name)
+        if h is not None:
+            h.state = "failed"
+            h.last_error = repr(e)
+        self.errors.append(e)
+        self.post(BusMessage("error", el.name, e))
+        self._stop_flag.set()
+        self._sinks_done.set()  # unblock wait()
+        return False
+
     def _guard(self, el: Element, fn, *args):
         try:
             return fn(*args)
         except BaseException as e:  # noqa: BLE001 — worker boundary
-            self.log.exception("element %s failed", el.name)
-            h = self.health_map.get(el.name)
-            if h is not None:
-                h.state = "failed"
-                h.last_error = repr(e)
-            self.errors.append(e)
-            self.post(BusMessage("error", el.name, e))
-            self._stop_flag.set()
-            self._sinks_done.set()  # unblock wait()
+            self._fail(el, e)
             return None
 
     def _push(self, el: Element, src_pad: int, item) -> bool:
-        """Push downstream with backpressure; False if stopping.
+        """Push one item downstream with backpressure; False if stopping.
 
         Frames bound for a leaky queue are dropped instead of blocking
         (``upstream``: the incoming frame; ``downstream``: the oldest
@@ -905,7 +1142,278 @@ class Pipeline:
                 return False
         return True
 
-    def _run_source(self, el: SourceElement) -> None:
+    def _put_many(self, dst: Element, items: list) -> bool:
+        """Deliver an ordered run of ``(pad, item)`` entries into ``dst``'s
+        mailbox, amortizing the lock/condvar cost over the run when the
+        mailbox supports bulk insertion (block handoff); falls back to the
+        per-item blocking path otherwise.  False when stopping."""
+        box = dst._mailbox
+        put_many = getattr(box, "put_many", None)
+        idx, n_items = 0, len(items)
+        while idx < n_items:
+            if put_many is not None:
+                n = put_many(items[idx:] if idx else items, timeout=0.1)
+                idx += n
+                if idx >= n_items:
+                    return True
+                if n > 0:
+                    continue  # partial progress: retry the remainder
+            # blocked (or no bulk support): bounded-wait single put so the
+            # stop flag stays responsive and events are never dropped
+            entry = items[idx]
+            while not self._stop_flag.is_set():
+                try:
+                    box.put(entry, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            else:
+                return False
+            idx += 1
+        return True
+
+    def _push_outs(self, el: Element, outs) -> bool:
+        """Deliver a call's outputs through mailboxes.  Consecutive items
+        bound for the same destination travel as ONE queue operation, so
+        the lock/wakeup cost amortizes over the run (a micro-batching
+        filter emitting N per-frame outputs pays ~1 handoff, not N)."""
+        if not outs:
+            return True
+        if len(outs) == 1:
+            sp, out = outs[0]
+            return self._push(el, sp, out)
+        runs: list = []  # [(dst, [(pad, item), ...])], order kept per dst
+        index: Dict[str, int] = {}
+        for sp, out in outs:
+            for dst, sink_pad in el.srcpads[sp].links:
+                k = index.get(dst.name)
+                if k is None:
+                    index[dst.name] = len(runs)
+                    runs.append((dst, [(sink_pad, out)]))
+                else:
+                    runs[k][1].append((sink_pad, out))
+        for dst, items in runs:
+            if not self._put_many(dst, items):
+                return False
+        return True
+
+    def _route_one(self, seg: _Seg, st: _ElemState, sp: int, item) -> bool:
+        """Route one output item: inline into the fused downstream element
+        when the link stays inside the segment, else out through its
+        mailbox.  False = the worker must exit."""
+        nxt = st.next_state
+        if nxt is not None:
+            if sp == st.out_pad:
+                return self._dispatch(seg, nxt, st.next_pad, item)
+            return True  # unlinked src pad: dropped (parity with _push)
+        return self._push(st.el, sp, item)
+
+    def _route_outs(self, seg: _Seg, st: _ElemState, outs) -> bool:
+        """Route a call's outputs (list, tuple, or lazy iterable).  Lists
+        are consumed destructively so frame carcasses can return to the
+        pool the moment downstream is done with them; lazy iterables (the
+        query client's stream mode) are forwarded as they are produced."""
+        nxt = st.next_state
+        if nxt is None:
+            if isinstance(outs, (list, tuple)):
+                return self._push_outs(st.el, outs)
+            for sp, out in outs:  # lazy stream: emit answers as they land
+                if not self._push(st.el, sp, out):
+                    return False
+            return True
+        out_pad, next_pad = st.out_pad, st.next_pad
+        if isinstance(outs, list):
+            for k in range(len(outs)):
+                sp, out = outs[k]
+                outs[k] = None  # drop the list's ref so recycle can reclaim
+                if sp == out_pad:
+                    if not self._dispatch(seg, nxt, next_pad, out):
+                        return False
+                if isinstance(out, TensorFrame):
+                    FRAME_POOL.recycle(out)
+            return True
+        for sp, out in outs:
+            if sp == out_pad:
+                if not self._dispatch(seg, nxt, next_pad, out):
+                    return False
+            if isinstance(out, TensorFrame):
+                FRAME_POOL.recycle(out)
+        return True
+
+    def _fast_path(self, el: Element, watch) -> bool:
+        """True when the full _supervised wrapper would change nothing for
+        this call — no watchdog heartbeat to ping, no fault site armed, no
+        pending interrupt, fail-stop error policy and warn stall policy —
+        so the dispatch loop may call the handler directly (errors still
+        reach the worker boundary exactly as _supervised's re-raise
+        would).  Saves the per-frame closure allocations and the
+        try/finally machinery on the hot path."""
+        return (
+            watch is None
+            and not FAULTS.is_armed()
+            and not el._interrupted.is_set()
+            and el.props.get("error-policy", "fail-stop") == "fail-stop"
+            and el.props.get("stall-policy", "warn") == "warn"
+        )
+
+    def _finish_eos(self, seg: _Seg, st: _ElemState) -> bool:
+        """`st.el` consumed EOS on every connected pad: propagate it (or
+        terminate the stream when this element is a terminal).  Returns
+        False: the element — and, via the inline EOS cascade, everything
+        downstream of it in this segment — is done, so the worker
+        unwinds."""
+        el = st.el
+        st.finished = True
+        h = self.health_map.get(el.name)
+        if h is not None and h.state not in ("degraded", "failed"):
+            h.state = "finished"
+        if any(p.is_linked for p in el.srcpads):
+            for i in range(len(el.srcpads)):
+                self._route_one(seg, st, i, EOS())
+        else:
+            with self._sink_lock:
+                self._pending_sinks -= 1
+                if self._pending_sinks <= 0:
+                    self._sinks_done.set()
+            self.post(BusMessage("eos", el.name))
+        return False
+
+    def _dispatch(self, seg: _Seg, st: _ElemState, pad: int, item) -> bool:
+        """Process one in-band item on `st.el`, inline on the segment's
+        streaming thread, with full per-ELEMENT supervision (error-policy,
+        watchdog heartbeats, deadline expiry, tracing all attribute to the
+        element, not the thread).  Returns False when the worker must exit
+        (error recorded, stopping, or the stream finished)."""
+        el = st.el
+        try:
+            if isinstance(item, TensorFrame):
+                return self._dispatch_frame(seg, st, pad, item)
+            if isinstance(item, CapsEvent):
+                el.set_sink_spec(pad, item.spec)
+                st.caps_pads.add(pad)
+                if st.caps_pads >= st.connected:
+                    for i in range(len(el.srcpads)):
+                        if not self._route_one(
+                                seg, st, i, CapsEvent(el.derive_spec(i))):
+                            return False
+                return True
+            if isinstance(item, EOS):
+                st.eos_pads.add(pad)
+                outs = (
+                    el.handle_eos(pad) if hasattr(el, "handle_eos") else None
+                )
+                if outs and not self._route_outs(seg, st, list(outs)):
+                    return False
+                if st.eos_pads >= st.connected:
+                    return self._finish_eos(seg, st)
+                return True
+            if isinstance(item, Flush):
+                # drop queued FRAMES only (head mailboxes; fused links
+                # hold nothing in flight); events behind the flush must
+                # survive in order
+                box = el._mailbox
+                if box is not None:
+                    kept = []
+                    try:
+                        while True:
+                            p2, nxt = box.get_nowait()
+                            if not isinstance(nxt, TensorFrame):
+                                kept.append((p2, nxt))
+                    except queue.Empty:
+                        pass
+                    for entry in kept:
+                        while not self._stop_flag.is_set():
+                            try:
+                                box.put(entry, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                for sp, ev in el.handle_event(pad, item) or []:
+                    self._route_one(seg, st, sp, ev)
+                return True
+            for sp, ev in el.handle_event(pad, item) or []:  # custom events
+                if not self._route_one(seg, st, sp, ev):
+                    return False
+            return True
+        except BaseException as e:  # noqa: BLE001 — worker boundary
+            return self._fail(el, e)
+
+    def _dispatch_frame(
+        self, seg: _Seg, st: _ElemState, pad: int, frame
+    ) -> bool:
+        """Run one frame (or non-aware block, split per-frame) through
+        `st.el` and route the outputs.  Caller owns `frame`'s carcass."""
+        el = st.el
+        tracer = self.tracer
+        if isinstance(frame, BatchFrame) and not el.BATCH_AWARE:
+            # block safety net: per-frame elements get logical frames,
+            # never a surprise batch axis; each is supervised INDIVIDUALLY
+            # (a batch-call-then-replay would re-run the already-processed
+            # prefix on a stateful element)
+            t_in = time.perf_counter() if tracer is not None else 0.0
+            nlog = frame.batch_size
+            nbytes = frame_nbytes(frame) if tracer is not None else 0
+            src_ts = (
+                frame.meta.get(META_SRC_TS) if tracer is not None else None
+            )
+            lfs = self._expire_late(el, frame.split())
+            for k in range(len(lfs)):
+                lf = lfs[k]
+                lfs[k] = None  # release the list's ref for the pool
+                if self._fast_path(el, st.watch):
+                    outs = el.handle_frame(pad, lf) or []
+                else:
+                    outs = self._supervised(
+                        el,
+                        lambda lf=lf, pad=pad: el.handle_frame(pad, lf) or [],
+                        lf,
+                    )
+                    if outs is self._SUPERVISED_STOPPING:
+                        return False
+                if not self._route_outs(seg, st, outs):
+                    return False
+                FRAME_POOL.recycle(lf)
+            if tracer is not None:
+                tracer.frame_out(
+                    el.name, t_in, time.perf_counter(), nlog, nbytes, src_ts,
+                )
+            return True
+        if not self._expire_late(el, (frame,)):
+            return True  # deadline passed: accounted drop (caller recycles)
+        t_in = time.perf_counter() if tracer is not None else 0.0
+        if self._fast_path(el, st.watch):
+            outs = el.handle_frame(pad, frame) or []
+        else:
+            outs = self._supervised(
+                el,
+                lambda frame=frame, pad=pad: el.handle_frame(pad, frame)
+                or [],
+                frame,
+            )
+            if outs is self._SUPERVISED_STOPPING:
+                return False
+        if tracer is not None:
+            tracer.frame_out(
+                el.name, t_in, time.perf_counter(),
+                getattr(frame, "batch_size", 1),
+                frame_nbytes(frame),
+                frame.meta.get(META_SRC_TS),
+            )
+        return self._route_outs(seg, st, outs)
+
+    def _run_segment(self, seg: _Seg) -> None:
+        for st in seg.states.values():
+            st.watch = self._watches.get(st.el.name)
+        head = seg.chain[0]
+        if isinstance(head, SourceElement):
+            self._run_source(seg)
+        else:
+            self._guard(head, self._run_chain_head, seg)
+
+    def _run_source(self, seg: _Seg) -> None:
+        el = seg.chain[0]
+        st = seg.states[el.name]
+
         def body():
             # deadline QoS stamping (deadline-s prop): every emitted frame
             # carries a latency budget downstream elements honor.  The pts
@@ -915,15 +1423,19 @@ class Pipeline:
             pts_anchored = el.props.get("deadline-anchor") == "pts"
             anchor = None
             for i in range(len(el.srcpads)):
-                spec = el.output_spec() if len(el.srcpads) == 1 else el.derive_spec(i)
-                self._push(el, i, CapsEvent(spec))
+                spec = (
+                    el.output_spec() if len(el.srcpads) == 1
+                    else el.derive_spec(i)
+                )
+                if not self._route_one(seg, st, i, CapsEvent(spec)):
+                    return
             # liveness on sources: the busy window wraps each next() on
             # the frames() generator (and the per-frame fault site), so
             # frame-deadline bounds the gap between productions (a
             # stalled camera/publisher) and stall-timeout catches a
-            # producer hung mid-pull.  Downstream pushes stay OUTSIDE
-            # the window — blocking on backpressure is healthy, not a
-            # stall.
+            # producer hung mid-pull.  Downstream routing stays OUTSIDE
+            # the window — blocking on backpressure (or a fused
+            # downstream element's work) is healthy, not a stall.
             wd, watch = self._watchdog, self._watches.get(el.name)
             frames_it = iter(el.frames())
             while True:
@@ -952,19 +1464,25 @@ class Pipeline:
                 if isinstance(frame, Event):
                     outs = el.handle_event(0, frame) or []
                     for sp, ev in outs:
-                        self._push(el, sp, ev)
+                        if not self._route_one(seg, st, sp, ev):
+                            return
                     continue
                 if budget > 0:
-                    if pts_anchored and anchor is None and frame.pts is not None:
+                    if (pts_anchored and anchor is None
+                            and frame.pts is not None):
                         anchor = time.monotonic() - frame.pts
                     stamp_deadline(frame, budget,
                                    anchor=anchor if pts_anchored else None)
                 if self.tracer is not None:
                     self.tracer.stamp_source(frame)
-                if not self._push(el, 0, frame):
+                if not self._route_one(seg, st, 0, frame):
                     return
+                FRAME_POOL.recycle(frame)
             for i in range(len(el.srcpads)):
-                self._push(el, i, EOS())
+                # EOS routing result intentionally unchecked: a fused
+                # downstream finishing returns False (normal unwind), and
+                # an external push fails only when already stopping
+                self._route_one(seg, st, i, EOS())
             h = self.health_map.get(el.name)
             if h is not None and h.state == "running":
                 h.state = "finished"
@@ -975,7 +1493,9 @@ class Pipeline:
             # state — fresh CapsEvents re-negotiate downstream; frames
             # emitted before the crash are NOT replayed.  `skip` cannot
             # resume a broken generator mid-frame, so sources treat it
-            # as fail-stop.
+            # as fail-stop.  Errors raised by FUSED DOWNSTREAM elements
+            # never reach here: _dispatch handles them against their own
+            # element and unwinds via a False return.
             while True:
                 try:
                     return body()
@@ -1011,251 +1531,160 @@ class Pipeline:
 
         self._guard(el, supervised_body)
 
-    def _run_element(self, el: Element) -> None:
-        connected = {
-            pad
-            for other in self.elements.values()
-            for sp in other.srcpads
-            for dst, pad in sp.links
-            if dst is el
-        } or {0}
-        eos_pads: set = set()
-        caps_pads: set = set()
-
-        def finish_eos():
-            h = self.health_map.get(el.name)
-            if h is not None and h.state not in ("degraded", "failed"):
-                h.state = "finished"
-            if any(p.is_linked for p in el.srcpads):
-                for i in range(len(el.srcpads)):
-                    self._push(el, i, EOS())
+    def _run_chain_head(self, seg: _Seg) -> None:
+        el = seg.chain[0]
+        st = seg.states[el.name]
+        box = el._mailbox
+        # hot-loop constants, latched at start() like the mailbox itself
+        # (part of the allocation diet: no per-frame getattr/hasattr)
+        get_many = getattr(box, "get_many", None)
+        has_qsize = hasattr(box, "qsize")
+        idle = getattr(el, "handle_idle", None)
+        # fused tails with deferred output: today unreachable in practice
+        # (parking needs preferred_batch>1, which blocks fusion), but any
+        # future element deferring output inside a fused chain must still
+        # get its idle flush when the head's input goes quiet
+        tail_idles = [
+            (seg.states[e.name], e.handle_idle)
+            for e in seg.chain[1:]
+            if hasattr(e, "handle_idle")
+        ]
+        want = getattr(el, "preferred_batch", 1)
+        batching = want > 1 and hasattr(el, "handle_frame_batch")
+        wait_s = getattr(el, "batch_wait_s", 0.0)
+        stop_flag = self._stop_flag
+        # items popped from the mailbox but not yet processed (bulk pops
+        # can pull events/other-pad items past a batch boundary)
+        stash: deque = deque()
+        while not stop_flag.is_set():
+            if stash:
+                pad, item = stash.popleft()
             else:
-                with self._sink_lock:
-                    self._pending_sinks -= 1
-                    if self._pending_sinks <= 0:
-                        self._sinks_done.set()
-                self.post(BusMessage("eos", el.name))
-
-        def body():
-            # items popped from the mailbox but not yet processed (bulk
-            # pops can pull events/other-pad items past a batch boundary)
-            stash: deque = deque()
-            while not self._stop_flag.is_set():
-                if stash:
-                    pad, item = stash.popleft()
-                else:
-                    try:
-                        pad, item = el._mailbox.get(timeout=0.1)
-                    except queue.Empty:
-                        # idle hook: elements holding deferred output (the
-                        # filter's dispatch window) release it when the
-                        # input goes quiet — a live stream's tail must not
-                        # wait for the next frame or EOS
-                        idle = getattr(el, "handle_idle", None)
-                        if idle is not None:
-                            for sp, out in idle() or []:
-                                if not self._push(el, sp, out):
-                                    return
-                        continue
-                if item is _STOP:
-                    return
-                tracer = self.tracer
-                if tracer is not None and hasattr(el._mailbox, "qsize"):
-                    try:
-                        tracer.queue_level(
-                            el.name, el._mailbox.qsize(),
-                            getattr(el._mailbox, "maxsize", 0),
-                        )
-                    except Exception:
-                        self.log.debug("tracer queue_level failed", exc_info=True)
-                if isinstance(item, TensorFrame):
-                    # micro-batching: batch-capable elements drain extra
-                    # queued frames and process them in one call (the TPU
-                    # dispatch-amortization lever; no reference analog).
-                    want = getattr(el, "preferred_batch", 1)
-                    if want > 1 and hasattr(el, "handle_frame_batch"):
-                        # optional bounded wait to FILL the batch (amortizes
-                        # dispatch/transfer latency; batch-timeout prop) —
-                        # 0 keeps the lossless drain-what's-queued behavior
-                        deadline = time.monotonic() + getattr(
-                            el, "batch_wait_s", 0.0
-                        )
-                        frames = [item]
-                        # LOGICAL frame count: a block-ingest BatchFrame
-                        # counts as its batch_size, so max-batch bounds the
-                        # invoke's batch axis, not the queue-item count
-                        nlog = getattr(item, "batch_size", 1)
-                        get_many = getattr(el._mailbox, "get_many", None)
-                        while nlog < want:
-                            # consume stashed items first (a previous bulk
-                            # pop may have pulled qualifying frames); an
-                            # event at the stash head ends the batch IN
-                            # PLACE — never rotate it behind later items
-                            if stash:
-                                p2, nxt = stash[0]
-                                if isinstance(nxt, TensorFrame) and p2 == pad:
-                                    frames.append(stash.popleft()[1])
-                                    nlog += getattr(nxt, "batch_size", 1)
-                                    continue
-                                break
-                            try:
-                                wait = deadline - time.monotonic()
-                                if get_many is not None:
-                                    chunk = get_many(
-                                        want - nlog,
-                                        timeout=max(0.0, wait),
-                                    )
-                                elif wait > 0:
-                                    chunk = [el._mailbox.get(timeout=wait)]
-                                else:
-                                    chunk = [el._mailbox.get_nowait()]
-                            except queue.Empty:
-                                break
-                            boundary = False
-                            for p2, nxt in chunk:
-                                if (not boundary
-                                        and isinstance(nxt, TensorFrame)
-                                        and p2 == pad
-                                        and nlog < want):
-                                    # nlog<want re-checked per item: blocks
-                                    # count as batch_size, so a bulk pop
-                                    # (item-granular) can overshoot the
-                                    # LOGICAL bound mid-chunk — the excess
-                                    # stashes for the next micro-batch
-                                    frames.append(nxt)
-                                    nlog += getattr(nxt, "batch_size", 1)
-                                else:
-                                    # event/other-pad item ends the batch;
-                                    # it and everything popped after it
-                                    # run afterwards, in order
-                                    boundary = True
-                                    stash.append((p2, nxt))
-                            if boundary:
-                                break
-                        if not el.BATCH_AWARE:
-                            # same safety net as the per-frame branch: the
-                            # block opt-in is BATCH_AWARE, not the mere
-                            # presence of handle_frame_batch — a future
-                            # batch-capable element that hasn't opted in
-                            # still gets logical frames only
-                            frames = [
-                                lf for f in frames for lf in (
-                                    f.split() if isinstance(f, BatchFrame)
-                                    else (f,)
-                                )
-                            ]
-                        frames = self._expire_late(el, frames)
-                        if not frames:
-                            continue  # whole micro-batch expired
-                        t_in = (
-                            time.perf_counter() if tracer is not None else 0.0
-                        )
-                        outs = self._supervised(
-                            el,
-                            lambda: el.handle_frame_batch(pad, frames) or [],
-                            frames,
-                            per_item=lambda f, pad=pad: (
-                                el.handle_frame_batch(pad, [f]) or []),
-                        )
-                        if outs is self._SUPERVISED_STOPPING:
+                try:
+                    pad, item = box.get(timeout=0.1)
+                except queue.Empty:
+                    # idle hook: elements holding deferred output (the
+                    # filter's dispatch window) release it when the
+                    # input goes quiet — a live stream's tail must not
+                    # wait for the next frame or EOS
+                    if idle is not None:
+                        outs = idle() or []
+                        if outs and not self._route_outs(seg, st, outs):
                             return
-                        if tracer is not None:
-                            tracer.frame_out(
-                                el.name, t_in, time.perf_counter(),
-                                sum(
-                                    getattr(f, "batch_size", 1)
-                                    for f in frames
-                                ),
-                                sum(frame_nbytes(f) for f in frames),
-                                frames[0].meta.get(META_SRC_TS),
+                    for t_st, t_idle in tail_idles:
+                        try:
+                            t_outs = t_idle() or []
+                            if t_outs and not self._route_outs(
+                                    seg, t_st, t_outs):
+                                return
+                        except BaseException as e:  # noqa: BLE001
+                            self._fail(t_st.el, e)
+                            return
+                    continue
+            if item is _STOP:
+                return
+            tracer = self.tracer
+            if tracer is not None and has_qsize:
+                try:
+                    tracer.queue_level(
+                        el.name, box.qsize(), getattr(box, "maxsize", 0),
+                    )
+                except Exception:
+                    self.log.debug("tracer queue_level failed", exc_info=True)
+            if batching and isinstance(item, TensorFrame):
+                # micro-batching: batch-capable elements drain extra
+                # queued frames and process them in one call (the TPU
+                # dispatch-amortization lever; no reference analog).
+                # batch-timeout > 0 waits to FILL the batch; 0 keeps the
+                # lossless drain-what's-queued behavior
+                deadline = time.monotonic() + wait_s
+                frames = [item]
+                # LOGICAL frame count: a block-ingest BatchFrame counts as
+                # its batch_size, so max-batch bounds the invoke's batch
+                # axis, not the queue-item count
+                nlog = getattr(item, "batch_size", 1)
+                while nlog < want:
+                    # consume stashed items first (a previous bulk pop may
+                    # have pulled qualifying frames); an event at the
+                    # stash head ends the batch IN PLACE — never rotate
+                    # it behind later items
+                    if stash:
+                        p2, nxt = stash[0]
+                        if isinstance(nxt, TensorFrame) and p2 == pad:
+                            frames.append(stash.popleft()[1])
+                            nlog += getattr(nxt, "batch_size", 1)
+                            continue
+                        break
+                    try:
+                        wait = deadline - time.monotonic()
+                        if get_many is not None:
+                            chunk = get_many(
+                                want - nlog, timeout=max(0.0, wait),
                             )
-                    else:
-                        t_in = (
-                            time.perf_counter() if tracer is not None else 0.0
-                        )
-                        if (isinstance(item, BatchFrame)
-                                and not el.BATCH_AWARE):
-                            # block safety net: per-frame elements (if/
-                            # crop/transform/wire sinks/...) get logical
-                            # frames, never a surprise batch axis —
-                            # semantics first, blocks are an opt-in
-                            # optimization (BATCH_AWARE).  Each logical
-                            # frame is supervised INDIVIDUALLY: a batch-
-                            # call-then-replay would re-run the already-
-                            # processed prefix on a stateful element
-                            outs = []
-                            for lf in self._expire_late(el, item.split()):
-                                res = self._supervised(
-                                    el,
-                                    lambda lf=lf, pad=pad:
-                                    el.handle_frame(pad, lf) or [],
-                                    lf,
-                                )
-                                if res is self._SUPERVISED_STOPPING:
-                                    return
-                                outs.extend(res)
+                        elif wait > 0:
+                            chunk = [box.get(timeout=wait)]
                         else:
-                            if not self._expire_late(el, [item]):
-                                continue  # deadline passed: accounted drop
-                            outs = self._supervised(
-                                el,
-                                lambda item=item, pad=pad:
-                                el.handle_frame(pad, item) or [],
-                                item,
-                            )
-                            if outs is self._SUPERVISED_STOPPING:
-                                return
-                        if tracer is not None:
-                            tracer.frame_out(
-                                el.name, t_in, time.perf_counter(),
-                                getattr(item, "batch_size", 1),
-                                frame_nbytes(item),
-                                item.meta.get(META_SRC_TS),
-                            )
-                    for sp, out in outs:
-                        if not self._push(el, sp, out):
-                            return
-                elif isinstance(item, CapsEvent):
-                    el.set_sink_spec(pad, item.spec)
-                    caps_pads.add(pad)
-                    if caps_pads >= connected:
-                        for i in range(len(el.srcpads)):
-                            if not self._push(el, i, CapsEvent(el.derive_spec(i))):
-                                return
-                elif isinstance(item, EOS):
-                    eos_pads.add(pad)
-                    outs = el.handle_eos(pad) if hasattr(el, "handle_eos") else None
-                    for sp, out in outs or []:
-                        if not self._push(el, sp, out):
-                            return
-                    if eos_pads >= connected:
-                        finish_eos()
-                        return
-                elif isinstance(item, Flush):
-                    # drop queued FRAMES only; events (EOS/caps/_STOP) behind
-                    # the flush must survive in order
-                    kept = []
-                    try:
-                        while True:
-                            p2, nxt = el._mailbox.get_nowait()
-                            if not isinstance(nxt, TensorFrame):
-                                kept.append((p2, nxt))
+                            chunk = [box.get_nowait()]
                     except queue.Empty:
-                        pass
-                    for entry in kept:
-                        # upstream producers may refill the box; retry with
-                        # bounded waits (events must survive, same as _push)
-                        while not self._stop_flag.is_set():
-                            try:
-                                el._mailbox.put(entry, timeout=0.1)
-                                break
-                            except queue.Full:
-                                continue
-                    for sp, ev in el.handle_event(pad, item) or []:
-                        self._push(el, sp, ev)
-                else:  # custom events
-                    for sp, ev in el.handle_event(pad, item) or []:
-                        if not self._push(el, sp, ev):
-                            return
-
-        self._guard(el, body)
+                        break
+                    boundary = False
+                    for p2, nxt in chunk:
+                        if (not boundary
+                                and isinstance(nxt, TensorFrame)
+                                and p2 == pad
+                                and nlog < want):
+                            # nlog<want re-checked per item: blocks count
+                            # as batch_size, so a bulk pop (item-granular)
+                            # can overshoot the LOGICAL bound mid-chunk —
+                            # the excess stashes for the next micro-batch
+                            frames.append(nxt)
+                            nlog += getattr(nxt, "batch_size", 1)
+                        else:
+                            # event/other-pad item ends the batch; it and
+                            # everything popped after it run after, in order
+                            boundary = True
+                            stash.append((p2, nxt))
+                    if boundary:
+                        break
+                if not el.BATCH_AWARE:
+                    # same safety net as the per-frame branch: the block
+                    # opt-in is BATCH_AWARE, not the mere presence of
+                    # handle_frame_batch
+                    frames = [
+                        lf for f in frames for lf in (
+                            f.split() if isinstance(f, BatchFrame)
+                            else (f,)
+                        )
+                    ]
+                frames = self._expire_late(el, frames)
+                if not frames:
+                    continue  # whole micro-batch expired
+                t_in = time.perf_counter() if tracer is not None else 0.0
+                outs = self._supervised(
+                    el,
+                    lambda frames=frames, pad=pad:
+                    el.handle_frame_batch(pad, frames) or [],
+                    frames,
+                    per_item=lambda f, pad=pad: (
+                        el.handle_frame_batch(pad, [f]) or []),
+                )
+                if outs is self._SUPERVISED_STOPPING:
+                    return
+                if tracer is not None:
+                    tracer.frame_out(
+                        el.name, t_in, time.perf_counter(),
+                        sum(getattr(f, "batch_size", 1) for f in frames),
+                        sum(frame_nbytes(f) for f in frames),
+                        frames[0].meta.get(META_SRC_TS),
+                    )
+                if not self._route_outs(seg, st, outs):
+                    return
+            else:
+                if not self._dispatch(seg, st, pad, item):
+                    return
+                if isinstance(item, TensorFrame):
+                    # the head owns the popped item's carcass once the
+                    # dispatch chain is done with it
+                    FRAME_POOL.recycle(item)
+                if st.finished:
+                    return

@@ -273,6 +273,9 @@ class TestMainIntegration:
         )
         for k in ("BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE"):
             monkeypatch.delenv(k, raising=False)
+        # the banked row predates the fuse axis (= unfused seed dataplane);
+        # only an unfused run may be answered with it
+        monkeypatch.setenv("BENCH_FUSE", "0")
         bench.main()
         out = json.loads(capsys.readouterr().out.strip())
         assert out["value"] == 1821.1
@@ -294,8 +297,31 @@ class TestMainIntegration:
             "BENCH_PLATFORM", "BENCH_NO_STALE",
         ):
             monkeypatch.delenv(k, raising=False)
+        # pre-axis banked row = unfused seed dataplane; match it
+        monkeypatch.setenv("BENCH_FUSE", "0")
         bench.main()
         out = json.loads(capsys.readouterr().out.strip())
         assert out["value"] == 1821.1
         assert out["stale"] is True
         assert "down" in out["live_error"]
+
+    def test_fuse_axis_separates_evidence(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """A row banked from the unfused seed dataplane must NEVER stand
+        in for a fused run (the fuse axis is part of the signature):
+        serving pre-fusion fps under a fused config would mislabel the
+        dataplane that produced the number."""
+        bench.bank_row(_row())  # no fuse key -> then-implicit fuse=0
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: ("down", "")
+        )
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        for k in (
+            "BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE", "BENCH_FUSE",
+        ):
+            monkeypatch.delenv(k, raising=False)  # default run: fuse=1
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None  # no mislabeled stale fallback
+        assert out.get("stale") is not True

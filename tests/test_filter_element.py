@@ -349,3 +349,54 @@ class TestDispatchDepth:
             assert not el._inflight
         finally:
             el.stop()
+
+
+class TestStackJitCacheLRU:
+    """The device-stack jit cache is a bounded LRU: flexible-shape streams
+    must not grow it without limit (each entry pins a compiled XLA
+    program), and an evicted key simply retraces on next use."""
+
+    def test_evicts_and_retraces(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.elements import filter as filter_mod
+
+        monkeypatch.setattr(filter_mod, "_STACK_JIT_MAX", 4)
+        monkeypatch.setattr(filter_mod, "_stack_jit_cache", type(
+            filter_mod._stack_jit_cache
+        )())
+        shapes = [(1,), (2,), (3,), (4,), (5,), (6,)]
+        for s in shapes:
+            arrs = [jnp.zeros(s), jnp.ones(s)]
+            out = np.asarray(filter_mod._stack_tensors(arrs))
+            np.testing.assert_array_equal(
+                out, np.stack([np.zeros(s), np.ones(s)])
+            )
+        cache = filter_mod._stack_jit_cache
+        assert len(cache) == 4  # bounded: 6 shapes, cap 4
+        # the two oldest shapes were evicted
+        cached_shapes = {k[1] for k in cache}
+        assert (1,) not in cached_shapes and (2,) not in cached_shapes
+        # evict-and-retrace: the evicted shape works again (recompiles)
+        arrs = [jnp.full((1,), 3.0), jnp.full((1,), 4.0)]
+        out = np.asarray(filter_mod._stack_tensors(arrs))
+        np.testing.assert_array_equal(out, np.array([[3.0], [4.0]]))
+        assert (1,) in {k[1] for k in cache}
+        assert len(cache) == 4
+
+    def test_hit_refreshes_recency(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.elements import filter as filter_mod
+
+        monkeypatch.setattr(filter_mod, "_STACK_JIT_MAX", 2)
+        monkeypatch.setattr(filter_mod, "_stack_jit_cache", type(
+            filter_mod._stack_jit_cache
+        )())
+        for s in [(1,), (2,)]:
+            filter_mod._stack_tensors([jnp.zeros(s), jnp.zeros(s)])
+        # touch (1,) so (2,) becomes the LRU victim
+        filter_mod._stack_tensors([jnp.zeros((1,)), jnp.zeros((1,))])
+        filter_mod._stack_tensors([jnp.zeros((3,)), jnp.zeros((3,))])
+        cached_shapes = {k[1] for k in filter_mod._stack_jit_cache}
+        assert cached_shapes == {(1,), (3,)}

@@ -1,0 +1,365 @@
+"""Fused-vs-unfused dataplane parity.
+
+The streaming-thread fusion pass (pipeline/pipeline.py _compute_segments)
+elides mailboxes and threads, but every PR-1/PR-2 contract must survive
+unchanged: identical outputs, identical bus traffic, and EXACT health()
+accounting (restarts, dead-letters, deadline_drops, qos-dropped) for the
+policy truth tables run under chain fusion.  Each test here runs the same
+pipeline twice — fuse=True and fuse=False — and byte-compares what the
+application can observe.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.liveness import DEADLINE_META
+from nnstreamer_tpu.core.resilience import FAULTS
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.elements.basic import AppSrc, TensorSink
+from nnstreamer_tpu.pipeline import Pipeline, TransformElement, parse_pipeline
+from nnstreamer_tpu.pipeline.element import make_element
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class Pass(TransformElement):
+    """Counting identity used as the supervision target."""
+
+    FACTORY_NAME = "pass"
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.starts = 0
+
+    def start(self):
+        self.starts += 1
+
+    def transform(self, frame):
+        return frame
+
+
+def _health_sig(pipe, name):
+    h = pipe.health()[name]
+    return {
+        k: h[k]
+        for k in ("state", "restarts", "dead_letters", "deadline_drops")
+    }
+
+
+def _bus_sig(messages):
+    """Comparable bus fingerprint: (kind, source, policy-ish payload)."""
+    out = []
+    for m in messages:
+        data = m.data if isinstance(m.data, dict) else {}
+        out.append((
+            m.kind, m.source,
+            data.get("policy"), data.get("dropped"), data.get("restart"),
+            data.get("liveness"),
+        ))
+    return out
+
+
+def _run_policy(fuse, policy, n=9, site_kw=None, el_props=None,
+                expect_error=None):
+    pipe = Pipeline("par", fuse=fuse)
+    src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+    mid.set_property("error-policy", policy)
+    for k, v in (el_props or {}).items():
+        mid.set_property(k, v)
+    pipe.chain(src, mid, sink)
+    messages = []
+    pipe.add_bus_watcher(
+        lambda m: messages.append(m) if m.kind in ("warning", "eos") else None
+    )
+    if site_kw:
+        FAULTS.arm("element.mid.handle_frame", **site_kw)
+    pipe.start()
+    for i in range(n):
+        src.push(np.float32([i]))
+    src.end_of_stream()
+    if expect_error is None:
+        pipe.wait(timeout=30)
+    else:
+        with pytest.raises(expect_error):
+            pipe.wait(timeout=30)
+    vals = [float(f.tensors[0][0]) for f in sink.frames]
+    sig = (_health_sig(pipe, "mid"), _bus_sig(messages), vals)
+    pipe.stop()
+    FAULTS.reset()
+    return sig
+
+
+class TestPolicyTruthTableParity:
+    """The PR-1 error-policy truth table, fused vs unfused: outputs, bus
+    warnings, and health counters must be identical."""
+
+    def test_skip_accounting_identical(self):
+        fused = _run_policy(
+            True, "skip", site_kw=dict(every=3, exc=ConnectionResetError))
+        unfused = _run_policy(
+            False, "skip", site_kw=dict(every=3, exc=ConnectionResetError))
+        assert fused == unfused
+        assert fused[0]["dead_letters"] == 3 and len(fused[2]) == 6
+
+    def test_restart_accounting_identical(self):
+        kw = dict(every=4, times=2, exc=ConnectionResetError)
+        props = {"restart-backoff": 0.0}
+        fused = _run_policy(True, "restart", site_kw=kw, el_props=props)
+        unfused = _run_policy(False, "restart", site_kw=kw, el_props=props)
+        assert fused == unfused
+        # zero frame loss, in order, and the restarts were really taken
+        assert fused[2] == [float(i) for i in range(9)]
+        assert fused[0]["restarts"] == 2
+
+    def test_fail_stop_identical(self):
+        kw = dict(after=2, exc=ConnectionResetError)  # poison = frame 2
+        fused = _run_policy(
+            True, "fail-stop", site_kw=kw, expect_error=ConnectionResetError)
+        unfused = _run_policy(
+            False, "fail-stop", site_kw=kw,
+            expect_error=ConnectionResetError)
+        assert fused[0] == unfused[0]  # health: state=failed, no drops
+        assert fused[0]["state"] == "failed"
+        # the fused dataplane is fully deterministic: frames before the
+        # poison are delivered end-to-end before the teardown.  The
+        # unfused plane can only promise a prefix — teardown may catch
+        # already-processed frames still sitting in the sink's mailbox
+        # (that in-flight loss window is exactly what fusion removes).
+        assert fused[2] == [0.0, 1.0]
+        assert fused[2][: len(unfused[2])] == unfused[2]
+
+    def test_fatal_error_dead_letters_not_restarts(self):
+        # fatal classification (bad input) must dead-letter under restart
+        # policy in BOTH dataplanes, preserving the restart budget
+        kw = dict(every=3, times=1, exc=ValueError)
+        props = {"restart-backoff": 0.0}
+        fused = _run_policy(True, "restart", site_kw=kw, el_props=props)
+        unfused = _run_policy(False, "restart", site_kw=kw, el_props=props)
+        assert fused == unfused
+        assert fused[0]["restarts"] == 0 and fused[0]["dead_letters"] == 1
+
+
+class TestDeadlineParity:
+    """PR-2 deadline QoS: exact deadline_drops accounting under fusion."""
+
+    def _run(self, fuse):
+        pipe = Pipeline("dl", fuse=fuse)
+        src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+        pipe.chain(src, mid, sink)
+        pipe.start()
+        # deterministic expiry: stamp absolute deadlines directly — 3 of 6
+        # frames are already expired when pushed, so `mid` must drop
+        # exactly those regardless of scheduling
+        for i in range(6):
+            f = TensorFrame([np.float32([i])])
+            if i % 2:
+                f.meta[DEADLINE_META] = time.monotonic() - 1.0
+            else:
+                f.meta[DEADLINE_META] = time.monotonic() + 60.0
+            src.push(f)
+        src.end_of_stream()
+        pipe.wait(timeout=20)
+        vals = [float(f.tensors[0][0]) for f in sink.frames]
+        sig = (_health_sig(pipe, "mid"), vals)
+        pipe.stop()
+        return sig
+
+    def test_deadline_drops_identical(self):
+        fused, unfused = self._run(True), self._run(False)
+        assert fused == unfused
+        assert fused[0]["deadline_drops"] == 3
+        assert fused[1] == [0.0, 2.0, 4.0]
+
+    def test_late_policy_deliver_identical(self):
+        def run(fuse):
+            pipe = Pipeline("dl2", fuse=fuse)
+            src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+            # every element on the path must opt in: each one runs its
+            # own expiry check (the sink included)
+            mid.set_property("late-policy", "deliver")
+            sink.set_property("late-policy", "deliver")
+            pipe.chain(src, mid, sink)
+            pipe.start()
+            f = TensorFrame([np.float32([7.0])])
+            f.meta[DEADLINE_META] = time.monotonic() - 1.0
+            src.push(f)
+            src.end_of_stream()
+            pipe.wait(timeout=20)
+            sig = (_health_sig(pipe, "mid"), len(sink.frames))
+            pipe.stop()
+            return sig
+
+        fused, unfused = run(True), run(False)
+        assert fused == unfused
+        assert fused[0]["deadline_drops"] == 0 and fused[1] == 1
+
+
+class TestQosFeedbackParity:
+    """Deadline misses throttle upstream tensor_rate (qos-dropped) the
+    same way in both dataplanes.  Pushes are serialized (one frame fully
+    drains before the next enters) so the feedback ordering — racy in a
+    free-running pipeline — is deterministic in BOTH modes."""
+
+    def _run(self, fuse):
+        pipe = Pipeline("qos", fuse=fuse)
+        src = AppSrc("src")
+        rate = make_element("tensor_rate", name="rate")
+        # rate must pass expired frames THROUGH (late-policy=deliver) so
+        # the deadline drop happens downstream at `mid` — that drop's
+        # feedback is what throttles rate (shedding earlier, where it's
+        # cheapest, is the whole point of the QoS loop)
+        rate.set_property("late-policy", "deliver")
+        mid, sink = Pass("mid"), TensorSink("out")
+        pipe.chain(src, rate, mid, sink)
+        pipe.start()
+        delivered = {"n": 0}
+        sink.connect_new_data(
+            lambda f: delivered.__setitem__("n", delivered["n"] + 1))
+
+        def push_and_drain(frame, expect_delivery):
+            before = delivered["n"]
+            drops_before = pipe.health()["mid"]["deadline_drops"]
+            rate_in = rate.in_frames
+            src.push(frame)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if expect_delivery and delivered["n"] > before:
+                    return
+                if not expect_delivery and (
+                        pipe.health()["mid"]["deadline_drops"] > drops_before
+                        or rate.in_frames > rate_in and rate.qos_dropped):
+                    # dropped at mid (deadline) or shed at rate (QoS)
+                    return
+                time.sleep(0.005)
+            raise AssertionError("frame neither delivered nor dropped")
+
+        # frame 0: healthy, pts=0.0
+        f0 = TensorFrame([np.float32([0])], pts=0.0)
+        f0.meta[DEADLINE_META] = time.monotonic() + 60.0
+        push_and_drain(f0, True)
+        # frame 1: pts=1.0, expired 0.5s ago -> mid drops it, feedback
+        # tells rate to shed up to pts 1.0 + lateness
+        f1 = TensorFrame([np.float32([1])], pts=1.0)
+        f1.meta[DEADLINE_META] = time.monotonic() - 0.5
+        push_and_drain(f1, False)
+        # frame 2: pts=1.2, inside the shed window -> rate qos-drops it
+        f2 = TensorFrame([np.float32([2])], pts=1.2)
+        f2.meta[DEADLINE_META] = time.monotonic() + 60.0
+        push_and_drain(f2, False)
+        # frame 3: pts far beyond the window -> flows
+        f3 = TensorFrame([np.float32([3])], pts=99.0)
+        f3.meta[DEADLINE_META] = time.monotonic() + 60.0
+        push_and_drain(f3, True)
+        src.end_of_stream()
+        pipe.wait(timeout=20)
+        sig = (
+            _health_sig(pipe, "mid"),
+            rate.qos_dropped,
+            [float(f.tensors[0][0]) for f in sink.frames],
+        )
+        pipe.stop()
+        return sig
+
+    def test_qos_dropped_identical(self):
+        fused, unfused = self._run(True), self._run(False)
+        assert fused == unfused
+        assert fused[0]["deadline_drops"] == 1
+        assert fused[1] == 1  # exactly one frame shed at the throttle
+        assert fused[2] == [0.0, 3.0]
+
+
+class TestWatchdogParity:
+    """PR-2 stall watchdog under fusion: a hang inside a fused element is
+    detected, cooperatively interrupted, and restarted with zero loss —
+    same counters as the unfused run."""
+
+    def _run(self, fuse):
+        FAULTS.arm("element.mid.handle_frame", every=3, times=1, hang=True)
+        pipe = Pipeline("wd", fuse=fuse)
+        src, mid, sink = AppSrc("src"), Pass("mid"), TensorSink("out")
+        mid.set_property("frame-deadline", 0.12)
+        mid.set_property("stall-policy", "restart")
+        mid.set_property("restart-backoff", 0.01)
+        pipe.chain(src, mid, sink)
+        pipe.start()
+        n = 8
+        for i in range(n):
+            src.push(np.float32([i]))
+        src.end_of_stream()
+        pipe.wait(timeout=30)
+        h = pipe.health()["mid"]
+        sig = (
+            {k: h[k] for k in ("state", "restarts", "overruns")},
+            [float(f.tensors[0][0]) for f in sink.frames],
+        )
+        pipe.stop()
+        FAULTS.reset()
+        return sig
+
+    def test_hang_restart_zero_loss_identical(self):
+        fused, unfused = self._run(True), self._run(False)
+        assert fused == unfused
+        assert fused[0] == {"state": "finished", "restarts": 1, "overruns": 1}
+        assert fused[1] == [float(i) for i in range(8)]
+
+
+class TestSegmentation:
+    """The fusion pass itself: boundary rules produce the expected thread
+    partition."""
+
+    @staticmethod
+    def _segs(pipe):
+        pipe.start()
+        try:
+            return [
+                [e.name for e in seg.chain] for seg in pipe._segments
+            ]
+        finally:
+            pipe.stop()
+
+    def test_linear_chain_one_thread(self):
+        pipe = parse_pipeline(
+            "videotestsrc name=a num-buffers=1 ! identity name=b ! "
+            "identity name=c ! tensor_sink name=d")
+        assert self._segs(pipe) == [["a", "b", "c", "d"]]
+
+    def test_queue_is_a_boundary(self):
+        pipe = parse_pipeline(
+            "videotestsrc name=a num-buffers=1 ! identity name=b ! "
+            "queue name=q ! tensor_sink name=d")
+        assert self._segs(pipe) == [["a", "b"], ["q", "d"]]
+
+    def test_tee_branches_keep_threads(self):
+        pipe = parse_pipeline(
+            "videotestsrc name=a num-buffers=1 ! tee name=t "
+            "t. ! tensor_sink name=x  t. ! tensor_sink name=y")
+        segs = self._segs(pipe)
+        assert ["a", "t"] in segs and ["x"] in segs and ["y"] in segs
+
+    def test_micro_batcher_keeps_boundaries(self):
+        # a preferred_batch>1 element must keep its mailbox (to drain
+        # batches) and its downstream boundary (to overlap invoke/decode)
+        from nnstreamer_tpu.backends.jax_xla import register_jax_model
+
+        def fn(params, xs):
+            return [xs[0]]
+
+        register_jax_model("parity_id", fn, {})
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=jax-xla "
+            "model=parity_id max-batch=4 ! tensor_sink name=out")
+        segs = self._segs(pipe)
+        assert ["f"] in segs  # the batcher is alone on its thread
+
+    def test_fuse_false_gives_seed_partition(self):
+        pipe = parse_pipeline(
+            "videotestsrc name=a num-buffers=1 ! identity name=b ! "
+            "tensor_sink name=c", fuse=False)
+        assert sorted(self._segs(pipe)) == [["a"], ["b"], ["c"]]

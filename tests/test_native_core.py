@@ -192,9 +192,12 @@ class TestPipelineUsesNative:
     def test_pipeline_runs_on_native_mailboxes(self):
         from nnstreamer_tpu.pipeline import parse_pipeline
 
+        # fuse=False: this test asserts the MAILBOX implementation, and
+        # fused chains elide intermediate mailboxes entirely
         pipe = parse_pipeline(
             "appsrc name=src ! tensor_transform mode=arithmetic "
-            "option=mul:2 ! tensor_sink name=out"
+            "option=mul:2 ! tensor_sink name=out",
+            fuse=False,
         )
         pipe.start()
         mb = pipe["out"]._mailbox

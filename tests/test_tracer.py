@@ -8,11 +8,15 @@ from nnstreamer_tpu.pipeline import parse_pipeline
 
 
 def _run_traced(n_frames=32, detail=False):
+    # fuse=False: queue-level tracing samples mailboxes, which only exist
+    # at thread boundaries — the unfused dataplane gives every element one
+    # (fused chains have no intermediate queues to sample, by design)
     pipe = parse_pipeline(
         "appsrc name=src ! "
         "tensor_transform mode=arithmetic option=add:1.0 ! "
         "tensor_sink name=out max-stored=64",
         name="traced",
+        fuse=False,
     )
     tracer = pipe.enable_tracing(detail=detail)
     pipe.start()

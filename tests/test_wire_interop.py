@@ -395,3 +395,54 @@ class TestPipelinesOverProtobufIdl:
         frames = rx["out"].frames
         rx.stop()
         assert frames == []
+
+
+class TestDecodeAliasingContract:
+    """decode_frame tensors are zero-copy views over the receive buffer.
+    The writability contract is explicit: views are READ-ONLY, so an
+    in-place downstream transform can never silently corrupt a pooled or
+    reused receive buffer — it must copy first (numpy raises on writes)."""
+
+    def _frame_bytes(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        return arr, bytes(wire.encode_frame(TensorFrame([arr], pts=0.5)))
+
+    def test_views_are_read_only_even_over_writable_buffers(self):
+        arr, buf = self._frame_bytes()
+        # a pooled/reused receive buffer is WRITABLE (bytearray); the
+        # decoded views must still refuse writes
+        pooled = bytearray(buf)
+        out = wire.decode_frame(pooled)
+        assert not out.tensors[0].flags.writeable
+        with pytest.raises(ValueError):
+            out.tensors[0][0, 0] = 99.0
+        np.testing.assert_array_equal(out.tensors[0], arr)
+
+    def test_view_aliases_buffer_not_copy(self):
+        arr, buf = self._frame_bytes()
+        pooled = bytearray(buf)
+        out = wire.decode_frame(pooled)
+        # zero-copy: the tensor's memory IS the receive buffer
+        assert np.shares_memory(
+            out.tensors[0], np.frombuffer(pooled, np.uint8)
+        )
+
+    def test_downstream_transform_leaves_buffer_intact(self):
+        # an arithmetic transform downstream of a decoded frame works
+        # (out-of-place) and the receive buffer is bit-identical after
+        arr, buf = self._frame_bytes()
+        pooled = bytearray(buf)
+        before = bytes(pooled)
+        decoded = wire.decode_frame(pooled)
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_transform mode=arithmetic "
+            "option=mul:2 ! tensor_sink name=out")
+        pipe.start()
+        pipe["src"].push(decoded)
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        frames = pipe["out"].frames
+        assert len(frames) == 1
+        np.testing.assert_array_equal(frames[0].tensors[0], arr * 2)
+        assert bytes(pooled) == before  # receive buffer never mutated
