@@ -85,12 +85,16 @@ def is_remote_application_error(err: BaseException) -> bool:
 
         if isinstance(err, grpc.RpcError):
             code = getattr(err, "code", lambda: None)()
-            # a status the server DECIDED to send ≠ a dead server
+            # a status the server DECIDED to send ≠ a dead server.
+            # DATA_LOSS is the exception among decided statuses: a
+            # corrupt exchange IS ill-health of the link/remote —
+            # sustained corruption must be able to trip breakers.
             return code not in (
                 None,
                 grpc.StatusCode.UNAVAILABLE,
                 grpc.StatusCode.DEADLINE_EXCEEDED,
                 grpc.StatusCode.CANCELLED,
+                grpc.StatusCode.DATA_LOSS,
             )
     except ImportError:  # pragma: no cover — grpc is a baked-in dep
         pass
@@ -399,6 +403,7 @@ class _FaultPlan:
         callback: Optional[Callable[[int], Optional[BaseException]]] = None,
         delay: float = 0.0,
         hang: bool = False,
+        corrupt: Optional[str] = None,
     ):
         self.exc = exc if exc is not None else TransientError("injected fault")
         self.rate = float(rate)
@@ -412,6 +417,12 @@ class _FaultPlan:
         # deterministic stand-in for an element that silently wedges
         self.delay = float(delay)
         self.hang = bool(hang)
+        # corruption faults: 'bitflip' | 'truncate' — consumed by
+        # FaultInjector.mangle() at wire sites (check() ignores these
+        # plans; the fault is a data mutation, not an exception)
+        if corrupt not in (None, "bitflip", "truncate"):
+            raise ValueError(f"corrupt={corrupt!r} (want bitflip|truncate)")
+        self.corrupt = corrupt
         self._rng = random.Random(seed)
         self.calls = 0
         self.fired = 0
@@ -438,6 +449,8 @@ class _FaultPlan:
         if not hit:
             return None
         self.fired += 1
+        if self.corrupt:
+            return ("corrupt", self.corrupt)
         if self.hang:
             return ("hang", None)
         if self.delay > 0:
@@ -490,6 +503,7 @@ class FaultInjector:
         callback: Optional[Callable[[int], Optional[BaseException]]] = None,
         delay: float = 0.0,
         hang: bool = False,
+        corrupt: Optional[str] = None,
     ) -> None:
         """Arm `site`.  ``exc`` may be an exception instance or class;
         ``rate`` is the per-invocation fault probability (1.0 = always),
@@ -500,12 +514,19 @@ class FaultInjector:
         error (the call then proceeds); ``hang=True`` blocks the caller
         until cooperatively interrupted — the site's ``interrupt``
         callable, the element's interrupt flag, or ``reset()`` — then
-        raises :class:`~..core.liveness.StallError`."""
+        raises :class:`~..core.liveness.StallError`.
+
+        ``corrupt="bitflip"|"truncate"`` injects deterministic seeded
+        WIRE CORRUPTION instead of an exception: instrumented transports
+        route their encoded bytes through :meth:`mangle`, which flips
+        one seeded bit / truncates at a seeded offset whenever the plan
+        fires (``check()`` ignores corrupt plans — the fault is a data
+        mutation, not a raise)."""
         with self._lock:
             self._plans[site] = _FaultPlan(
                 exc=exc, rate=rate, times=times, after=after,
                 every=every, seed=seed, callback=callback,
-                delay=delay, hang=hang,
+                delay=delay, hang=hang, corrupt=corrupt,
             )
             self._armed = True
             self._release.clear()
@@ -540,8 +561,8 @@ class FaultInjector:
             return
         with self._lock:
             plan = self._plans.get(site)
-            if plan is None:
-                return
+            if plan is None or plan.corrupt is not None:
+                return  # corrupt plans fire via mangle(), not check()
             action = plan.decide()
         if action is None:
             return
@@ -567,6 +588,53 @@ class FaultInjector:
         from .liveness import StallError
 
         raise StallError(f"injected hang at {site} interrupted")
+
+    def mangle(self, site: str, data):
+        """Deterministic wire corruption: when `site` is armed with a
+        ``corrupt=`` plan and the plan fires, return a mutated COPY of
+        ``data`` (one seeded bit flipped, or the buffer truncated at a
+        seeded offset); otherwise return ``data`` unchanged.
+
+        Instrumented transports call this on their ENCODED bytes, after
+        checksums are computed — simulating corruption on the wire, so
+        the receiver's integrity verification is what must catch it.
+        Sites guard the call with :meth:`is_armed` to keep the un-armed
+        hot path free."""
+        if not self._armed:
+            return data
+        with self._lock:
+            plan = self._plans.get(site)
+            if plan is None or plan.corrupt is None:
+                return data
+            action = plan.decide()
+            if action is None:
+                return data
+            kind = action[1]
+            buf = bytearray(bytes(data))
+            if not buf:
+                return data
+            if kind == "truncate":
+                cut = plan._rng.randrange(len(buf))
+                log.debug("corruption fault at %s: truncated %d -> %d bytes",
+                          site, len(buf), cut)
+                return bytes(buf[:cut])
+            pos = plan._rng.randrange(len(buf) * 8)
+            buf[pos // 8] ^= 1 << (pos % 8)
+            log.debug("corruption fault at %s: bit %d flipped", site, pos)
+            return bytes(buf)
+
+    def mangle_parts(self, site: str, parts: List) -> List:
+        """:meth:`mangle` over a vectored parts list: the join (a copy)
+        happens only when `site` actually holds a corrupt plan, so
+        gather-send hot paths never pay it un-armed."""
+        if not self._armed:
+            return parts
+        with self._lock:
+            plan = self._plans.get(site)
+            armed = plan is not None and plan.corrupt is not None
+        if not armed:
+            return parts
+        return [self.mangle(site, b"".join(bytes(p) for p in parts))]
 
     def stats(self, site: str) -> Dict[str, int]:
         """{calls, fired} counters for an armed (or just-disarmed) site;

@@ -416,24 +416,48 @@ def pack_flex_header(spec: TensorSpec) -> bytes:
     return head + dims + name
 
 
+class FlexHeaderTruncated(ValueError):
+    """Flex header declared more bytes than the buffer holds.
+
+    Distinguishable from semantic corruption (bad magic, unknown dtype,
+    absurd rank) so the wire layer can map the two onto its typed
+    ``WireTruncationError`` / ``WireCorruptionError`` split."""
+
+
 def unpack_flex_header(buf: bytes) -> Tuple[TensorSpec, int]:
-    """Parse a flex header; returns (spec, header_size)."""
+    """Parse a flex header; returns (spec, header_size).
+
+    Hostile-input contract: every declared size (rank, dtype-name
+    length, dims) is validated against limits and the buffer BEFORE any
+    use, so a corrupted header raises :class:`ValueError` (or
+    :class:`FlexHeaderTruncated`) — never a raw ``struct.error`` and
+    never an oversized allocation."""
     try:
         magic, version, nlen, rank, _ = _FLEX_FIXED.unpack_from(buf, 0)
-        if magic != _FLEX_MAGIC:
-            raise ValueError("bad flexible-tensor header magic")
-        if version != _FLEX_VERSION:
-            raise ValueError(f"unsupported flex header version {version}")
-        off = _FLEX_FIXED.size
+    except struct.error:
+        raise FlexHeaderTruncated(
+            f"truncated flexible-tensor header: {len(buf)} byte(s), "
+            f"need {_FLEX_FIXED.size}"
+        ) from None
+    if magic != _FLEX_MAGIC:
+        raise ValueError("bad flexible-tensor header magic")
+    if version != _FLEX_VERSION:
+        raise ValueError(f"unsupported flex header version {version}")
+    if rank > RANK_LIMIT:
+        raise ValueError(f"flex header rank {rank} exceeds limit {RANK_LIMIT}")
+    off = _FLEX_FIXED.size
+    try:
         dims = struct.unpack_from(f"<{rank}i", buf, off) if rank else ()
-        off += 4 * rank
-        name = bytes(buf[off : off + nlen])  # bytes() so memoryviews work
-        if len(name) != nlen:
-            raise ValueError("truncated flexible-tensor header: dtype name")
-        dtype = dtype_from_name(name.decode())
-        off += nlen
-    except struct.error as e:
-        raise ValueError(f"truncated flexible-tensor header: {e}") from None
+    except struct.error:
+        raise FlexHeaderTruncated(
+            "truncated flexible-tensor header: dims"
+        ) from None
+    off += 4 * rank
+    name = bytes(buf[off : off + nlen])  # bytes() so memoryviews work
+    if len(name) != nlen:
+        raise FlexHeaderTruncated("truncated flexible-tensor header: dtype name")
+    dtype = dtype_from_name(name.decode())  # UnicodeDecodeError ⊂ ValueError
+    off += nlen
     return TensorSpec(tuple(dims), dtype), off
 
 

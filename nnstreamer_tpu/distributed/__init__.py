@@ -4,7 +4,13 @@ Reference analog: tensor_query_*, edgesrc/edgesink, gst/mqtt, grpc elements
 (SURVEY §2.3) over nnstreamer-edge; here one gRPC data plane.
 """
 
-from .wire import WireError, decode_frame, encode_frame  # noqa: F401
+from .wire import (  # noqa: F401
+    WireCorruptionError,
+    WireError,
+    WireTruncationError,
+    decode_frame,
+    encode_frame,
+)
 from .service import (  # noqa: F401
     EdgeBroker,
     EdgePublisher,

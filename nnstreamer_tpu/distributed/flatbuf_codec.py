@@ -21,11 +21,13 @@ flatbuf path drops GstBuffer metadata.  Dimensions ride innermost-first
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from ..core.buffer import TensorFrame
 from ..core.types import RANK_LIMIT as _REPO_RANK_LIMIT
-from .wire import WireError
+from .wire import WireCorruptionError, WireError
 
 _RANK_LIMIT = 16  # NNS_TENSOR_RANK_LIMIT (tensor_typedef.h:34)
 
@@ -112,7 +114,10 @@ def _framerate_of(frame: TensorFrame):
     return 0, 1
 
 
-def decode_frame(buf: bytes) -> TensorFrame:
+def decode_frame(buf: bytes, verify: bool = True) -> TensorFrame:
+    """``verify`` is accepted for codec-API parity; the reference fbs
+    schema carries no checksum field (structural validation only)."""
+    del verify
     import flatbuffers
     from flatbuffers import number_types as NT
 
@@ -160,7 +165,9 @@ def decode_frame(buf: bytes) -> TensorFrame:
                     tt.GetVectorAsNumpy(NT.Uint8Flags, po)
                     if po else np.zeros(0, np.uint8)
                 )
-                expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                # math.prod: exact python ints — np.prod wraps at int64,
+                # letting a hostile dim vector alias a small payload
+                expect = math.prod(shape) * dtype.itemsize
                 if payload.nbytes != expect:
                     raise WireError(
                         f"tensor payload {payload.nbytes}B != "
@@ -191,5 +198,5 @@ def decode_frame(buf: bytes) -> TensorFrame:
     except WireError:
         raise
     except Exception as e:  # runtime raises assorted struct/index errors
-        raise WireError(f"malformed flatbuffers frame: {e}") from None
+        raise WireCorruptionError(f"malformed flatbuffers frame: {e}") from None
     return TensorFrame(tensors, meta=meta)

@@ -18,8 +18,9 @@ import math
 
 import numpy as np
 
+from ..core.types import RANK_LIMIT, TENSOR_COUNT_LIMIT
 from ..core.buffer import TensorFrame
-from .wire import WireError, _clean_meta
+from .wire import WireCorruptionError, WireError, _clean_meta
 
 _TO_PB = {
     "int32": 0, "uint32": 1, "int16": 2, "uint16": 3, "int8": 4,
@@ -70,26 +71,47 @@ def encode_frame(frame: TensorFrame) -> bytes:
     return msg.SerializeToString()
 
 
-def decode_frame(buf: bytes) -> TensorFrame:
+def decode_frame(buf: bytes, verify: bool = True) -> TensorFrame:
+    """``verify`` is accepted for codec-API parity (the flex codec checks
+    its v2 CRC there); the protobuf schema carries no checksum field, so
+    integrity here is structural validation only."""
+    del verify
     pb = _pb2()
     msg = pb.TensorFrame()
     try:
         msg.ParseFromString(bytes(buf))
     except Exception as e:
-        raise WireError(f"malformed protobuf frame: {e}") from None
+        raise WireCorruptionError(f"malformed protobuf frame: {e}") from None
+    if len(msg.tensor) > TENSOR_COUNT_LIMIT:
+        raise WireCorruptionError(
+            f"tensor count {len(msg.tensor)} exceeds limit {TENSOR_COUNT_LIMIT}"
+        )
     tensors = []
     for t in msg.tensor:
         if t.type not in _FROM_PB:
-            raise WireError(f"unknown tensor type id {t.type}")
+            raise WireCorruptionError(f"unknown tensor type id {t.type}")
         dtype = _np_dtype(_FROM_PB[t.type])
+        if len(t.dimension) > RANK_LIMIT:
+            raise WireCorruptionError(
+                f"rank {len(t.dimension)} exceeds limit {RANK_LIMIT}"
+            )
         shape = tuple(int(d) for d in t.dimension)
-        expect = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        if any(d < 0 for d in shape):
+            raise WireCorruptionError(f"negative dimension in {shape}")
+        # math.prod: exact python ints — np.prod silently wraps at int64,
+        # which would let a hostile shape alias a small payload
+        expect = math.prod(shape) * dtype.itemsize if shape else dtype.itemsize
         if len(t.data) != expect:
             raise WireError(
                 f"tensor payload {len(t.data)}B != shape {shape} x {dtype}"
             )
         tensors.append(np.frombuffer(t.data, dtype=dtype).reshape(shape))
-    meta = json.loads(msg.meta_json) if msg.meta_json else {}
+    try:
+        meta = json.loads(msg.meta_json) if msg.meta_json else {}
+    except ValueError as e:
+        raise WireCorruptionError(f"malformed frame meta: {e}") from None
+    if not isinstance(meta, dict):
+        raise WireCorruptionError("frame meta is not a JSON object")
     frame = TensorFrame(
         tensors, pts=None if math.isnan(msg.pts) else msg.pts, meta=meta
     )

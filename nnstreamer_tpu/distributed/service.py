@@ -39,6 +39,8 @@ from ..core.liveness import AdmissionController, ServerBusyError, stamp_deadline
 from ..core.log import get_logger
 from ..core.types import StreamSpec
 from .wire import (
+    WireCorruptionError,
+    WireError,
     decode_frame,
     decode_frames,
     encode_frame,
@@ -89,6 +91,14 @@ class QueryServerCore:
         self.admission = AdmissionController(0)
         self.busy_retry_after = 0.05
         self.expired_drops = 0  # requests expired before ingest
+        # data-plane integrity (Documentation/wire-protocol.md): both
+        # transports verify request checksums at decode and refuse
+        # corrupt requests ('C' on raw TCP / DATA_LOSS on gRPC) without
+        # dying; the serversrc's verify-checksum / wire-version props
+        # rebuild these before start()/start_tcp()
+        self.verify_checksum = True
+        self.wire_version = 2
+        self.corrupt_requests = 0  # corrupt requests refused, all transports
 
     # -- transport-agnostic handlers ----------------------------------------
     def check_caps(self, client_caps: str) -> str:
@@ -198,7 +208,18 @@ class QueryServerCore:
         # per-RPC transport cost); the server pipeline still sees N
         # ordinary frames, answers are collected back in stream order
         batched = is_batch_payload(request)
-        frames = decode_frames(request) if batched else [decode_frame(request)]
+        try:
+            frames = (decode_frames(request, verify=self.verify_checksum)
+                      if batched
+                      else [decode_frame(request,
+                                         verify=self.verify_checksum)])
+        except WireError as e:
+            # corrupt/malformed request: refused before any execution —
+            # DATA_LOSS ≙ the raw-TCP 'C' reply (the client transport
+            # maps it back to WireCorruptionError, resend-safe)
+            self.corrupt_requests += 1
+            log.warning("corrupt request refused (DATA_LOSS): %s", e)
+            context.abort(grpc.StatusCode.DATA_LOSS, f"corrupt request: {e}")
         try:
             answers = self.process(
                 frames, float(context.time_remaining() or 30.0))
@@ -213,8 +234,8 @@ class QueryServerCore:
         except TimeoutError as e:
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         if batched:
-            return encode_frames(answers)
-        return encode_frame(answers[0])
+            return encode_frames(answers, version=self.wire_version)
+        return encode_frame(answers[0], version=self.wire_version)
 
     def _invoke_stream(self, request: bytes, context):
         """Server-streaming invoke: ONE request frame in, answer frames
@@ -226,7 +247,12 @@ class QueryServerCore:
         Non-streaming server graphs work too: a plain 1:1 pipeline's
         single answer has no ``final`` meta, so exactly one message is
         streamed and the stream closes via the sentinel check below."""
-        frame = decode_frame(request)
+        try:
+            frame = decode_frame(request, verify=self.verify_checksum)
+        except WireError as e:
+            self.corrupt_requests += 1
+            log.warning("corrupt stream request refused (DATA_LOSS): %s", e)
+            context.abort(grpc.StatusCode.DATA_LOSS, f"corrupt request: {e}")
         if not self.admission.try_admit():
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -251,7 +277,7 @@ class QueryServerCore:
                             "server pipeline produced no (further) answer "
                             "in time",
                         )
-                    yield encode_frame(ans)
+                    yield encode_frame(ans, version=self.wire_version)
                     # a non-streaming graph emits exactly one answer with
                     # no "final" key -> treat absent as final.  A
                     # multi-answer graph MUST stamp meta["final"] (False
@@ -326,6 +352,7 @@ class QueryServerCore:
             "admission_high": snap["high"],
             "admission_low": snap["low"],
             "ingress_depth": self.ingress.qsize(),
+            "corrupt_requests": self.corrupt_requests,
         }
 
     # -- lifecycle ----------------------------------------------------------
@@ -366,7 +393,11 @@ class QueryServerCore:
             return
         from .tcp_query import TcpQueryServer
 
-        self._tcp = TcpQueryServer(self, port=self.port)
+        self._tcp = TcpQueryServer(
+            self, port=self.port,
+            wire_version=self.wire_version,
+            verify_checksum=self.verify_checksum,
+        )
         self._tcp.start()
         self.port = self._tcp.port
 
@@ -415,9 +446,11 @@ def release_query_server(server_id: int) -> None:
 class QueryConnection:
     """Client side of /nns.Query (≙ nns_edge client handle)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 verify_checksum: bool = True):
         self.addr = f"{host}:{port}"
         self.timeout = timeout
+        self._verify = bool(verify_checksum)
         self._channel = grpc.insecure_channel(
             self.addr,
             options=[("grpc.max_receive_message_length", 512 * 1024 * 1024),
@@ -436,10 +469,17 @@ class QueryConnection:
 
     @staticmethod
     def _map_busy(err: grpc.RpcError) -> None:
-        """Translate the server's RESOURCE_EXHAUSTED admission refusal
-        into :class:`ServerBusyError` (≙ the raw-TCP BUSY reply) so both
-        transports surface backpressure identically."""
+        """Translate server status codes both transports share onto one
+        client-side vocabulary: RESOURCE_EXHAUSTED (admission refusal)
+        -> :class:`ServerBusyError` (≙ the raw-TCP BUSY reply), and
+        DATA_LOSS (corrupt request refused before execution) ->
+        :class:`WireCorruptionError` (≙ the raw-TCP 'C' reply,
+        resend-safe)."""
         code = getattr(err, "code", lambda: None)()
+        if code == grpc.StatusCode.DATA_LOSS:
+            raise WireCorruptionError(
+                str(getattr(err, "details", lambda: "")() or "corrupt request")
+            ) from err
         if code != grpc.StatusCode.RESOURCE_EXHAUSTED:
             return
         retry_after = 0.05
@@ -463,7 +503,7 @@ class QueryConnection:
         except grpc.RpcError as e:
             self._map_busy(e)
             raise
-        return decode_frame(data)
+        return decode_frame(data, verify=self._verify)
 
     def invoke_stream(self, frame: TensorFrame,
                       timeout: Optional[float] = None):
@@ -474,7 +514,7 @@ class QueryConnection:
             for data in self._invoke_stream_rpc(
                 encode_frame(frame), timeout=timeout or self.timeout
             ):
-                yield decode_frame(data)
+                yield decode_frame(data, verify=self._verify)
         except grpc.RpcError as e:
             self._map_busy(e)
             raise
@@ -489,7 +529,7 @@ class QueryConnection:
         except grpc.RpcError as e:
             self._map_busy(e)
             raise
-        return decode_frames(data)
+        return decode_frames(data, verify=self._verify)
 
     def close(self) -> None:
         self._channel.close()
@@ -625,8 +665,13 @@ class EdgePublisher:
 class EdgeSubscriber:
     """Client holding a Subscribe stream; yields TensorFrames."""
 
-    def __init__(self, host: str, port: int, topic: str):
+    def __init__(self, host: str, port: int, topic: str,
+                 verify_checksum: bool = True):
         self.topic = topic
+        self._verify = bool(verify_checksum)
+        #: frames dropped because they failed decode/integrity checks —
+        #: one bad transmission must degrade to a gap, not end the stream
+        self.corrupt_dropped = 0
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         self._subscribe = self._channel.unary_stream(
             "/nns.Edge/Subscribe", request_serializer=_ident, response_deserializer=_ident
@@ -636,7 +681,11 @@ class EdgeSubscriber:
     def frames(self):
         self._stream = self._subscribe(self.topic.encode())
         for data in self._stream:
-            yield decode_frame(data)
+            try:
+                yield decode_frame(data, verify=self._verify)
+            except WireError as e:
+                self.corrupt_dropped += 1
+                log.warning("undecodable edge frame dropped: %s", e)
 
     def close(self) -> None:
         if self._stream is not None:

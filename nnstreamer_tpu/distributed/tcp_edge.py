@@ -103,6 +103,11 @@ class TcpEdgeServer:
     def publish(self, topic: str, payload: bytes) -> int:
         """Send to every live subscriber of `topic`; returns how many
         received it (dead/wedged ones are dropped on the way)."""
+        if FAULTS.is_armed():
+            # corrupt= faults mutate the encoded payload post-checksum
+            # (the length prefix stays honest so framing survives; the
+            # subscriber's verify-on-decode is what must catch it)
+            payload = FAULTS.mangle("tcp_edge.publish", payload)
         header = _LEN.pack(len(payload))
         with self._lock:
             targets = list(self._subs.get(topic, ()))

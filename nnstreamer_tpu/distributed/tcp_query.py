@@ -22,9 +22,23 @@ Design for copy-freedom on the hot path:
   provides the concurrency; this pool provides the sockets).
 
 Socket protocol (little-endian):
-  1-byte type | u64 body_len | f64 deadline_s | body
+  v1 framing: 1-byte type | u64 body_len | f64 deadline_s | body
+  v2 framing: 1-byte type | u64 body_len | f64 deadline_s | u32 crc | body
+              (crc = CRC-32 over the header with the crc field zeroed,
+              then the body — message-level integrity, on top of the
+              per-frame NNSQ v2 checksums inside 'Q' bodies)
   'H' handshake: body = caps utf-8; reply 'H' caps or 'E' error utf-8
-  'Q' query:     body = NNSQ frame or NNSB batch; reply 'Q' or 'E'
+  'Q' query:     body = NNSQ frame or NNSB/NNSC batch; reply 'Q' or 'E'
+  'V' version:   body = ascii max version the sender speaks.  A v2
+                 server replies 'V' with the AGREED version
+                 (min of both maxes) and switches THAT connection to it
+                 for all subsequent messages; a v1 peer answers 'E'
+                 unknown-message-type, so the client stays on v1 —
+                 zero-config interop both ways.
+  'C' corrupt:   the request failed integrity verification (checksum
+                 mismatch / malformed envelope).  The request provably
+                 never executed, so clients treat it as a resend-safe
+                 transient; the server connection stays alive.
 ``deadline_s`` carries the client's remaining timeout so the server-side
 pipeline wait honors it (the gRPC transport gets the same via
 ``context.time_remaining()``); 0 on replies.
@@ -36,14 +50,19 @@ import socket
 import struct
 import threading
 import time
-from typing import List, Optional, Tuple
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 from ..core.buffer import TensorFrame
 from ..core.liveness import ServerBusyError
 from ..core.log import get_logger
 from ..core.resilience import FAULTS, RemoteApplicationError
 from .wire import (
+    V1,
+    V2,
+    WireCorruptionError,
     WireError,
+    WireTruncationError,
     decode_frame,
     decode_frames,
     encode_frame_parts,
@@ -54,7 +73,11 @@ from .wire import (
 
 log = get_logger("tcp_query")
 
-_HDR = struct.Struct("<BQd")
+#: highest message framing / envelope version this build speaks
+WIRE_VERSION = V2
+
+_HDR = struct.Struct("<BQd")     # v1 framing
+_HDR2 = struct.Struct("<BQdI")   # v2: + u32 crc (header w/ crc zeroed + body)
 _T_HANDSHAKE = ord("H")
 _T_QUERY = ord("Q")
 _T_ERROR = ord("E")
@@ -67,6 +90,11 @@ _T_BUSY = ord("B")
 # breakers/cooldowns count it — the same classification this condition
 # gets over gRPC (DEADLINE_EXCEEDED).
 _T_TIMEOUT = ord("T")
+# wire-version negotiation (see module docstring)
+_T_VERSION = ord("V")
+# integrity: the request failed checksum/envelope verification before any
+# execution — resend-safe; body = error text
+_T_CORRUPT = ord("C")
 
 # liveness bound for the server reader: a peer that begins a message and
 # then stalls (no bytes) this long is dropped instead of wedging the
@@ -114,18 +142,94 @@ def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     return memoryview(buf)
 
 
+def _hdr_struct(version: int) -> struct.Struct:
+    return _HDR2 if version >= V2 else _HDR
+
+
+def _msg_crc(mtype: int, blen: int, deadline_s: float, parts: List) -> int:
+    """v2 message checksum: header with the crc field zeroed, then every
+    body part — one streaming pass, no copies."""
+    crc = zlib.crc32(_HDR2.pack(mtype, blen, deadline_s, 0))
+    for p in parts:
+        crc = zlib.crc32(memoryview(p), crc)
+    return crc
+
+
 def _send_msg(sock: socket.socket, mtype: int, parts: List,
-              deadline_s: float = 0.0) -> None:
-    _sendmsg_all(
-        sock, [_HDR.pack(mtype, parts_nbytes(parts), deadline_s)] + parts)
+              deadline_s: float = 0.0, version: int = V1) -> None:
+    n = parts_nbytes(parts)
+    if version >= V2:
+        head = _HDR2.pack(mtype, n, deadline_s,
+                          _msg_crc(mtype, n, deadline_s, parts))
+    else:
+        head = _HDR.pack(mtype, n, deadline_s)
+    _sendmsg_all(sock, [head] + parts)
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[int, memoryview, float]:
-    head = _recv_exact(sock, _HDR.size)
-    mtype, blen, deadline_s = _HDR.unpack(head)
+def _parse_head(head, version: int) -> Tuple[int, int, float, Optional[int]]:
+    """Unpack + bounds-check one message header (both framings); the
+    declared body length is validated BEFORE any allocation."""
+    if version >= V2:
+        mtype, blen, deadline_s, crc = _HDR2.unpack(head)
+    else:
+        mtype, blen, deadline_s = _HDR.unpack(head)
+        crc = None
     if blen > _MAX_BODY:
-        raise WireError(f"declared body length {blen} exceeds {_MAX_BODY}")
-    return mtype, _recv_exact(sock, blen), deadline_s
+        raise WireCorruptionError(
+            f"declared body length {blen} exceeds {_MAX_BODY}")
+    return mtype, blen, deadline_s, crc
+
+
+def _verify_msg(mtype: int, blen: int, deadline_s: float,
+                crc: Optional[int], body) -> None:
+    if crc is None:
+        return
+    actual = _msg_crc(mtype, blen, deadline_s, [body])
+    if actual != crc:
+        raise WireCorruptionError(
+            f"message checksum mismatch (crc32 {actual:#010x} != "
+            f"declared {crc:#010x})"
+        )
+
+
+def encode_msg(mtype: int, body: bytes, deadline_s: float = 0.0,
+               version: int = V1) -> bytes:
+    """One complete message as bytes (tests + tools/fuzz_wire.py)."""
+    n = len(body)
+    if version >= V2:
+        return _HDR2.pack(mtype, n, deadline_s,
+                          _msg_crc(mtype, n, deadline_s, [body])) + body
+    return _HDR.pack(mtype, n, deadline_s) + body
+
+
+def parse_msg(data, version: int = V1,
+              verify: bool = True) -> Tuple[int, memoryview, float]:
+    """Pure-bytes inverse of :func:`encode_msg`: parse ONE complete
+    message from a byte string with the same typed-error bounds contract
+    as the socket readers (the fuzz harness drives this directly)."""
+    mv = memoryview(data)
+    hs = _hdr_struct(version)
+    if len(mv) < hs.size:
+        raise WireTruncationError(
+            f"truncated message header: {len(mv)}/{hs.size} bytes")
+    mtype, blen, deadline_s, crc = _parse_head(bytes(mv[:hs.size]), version)
+    body = mv[hs.size:]
+    if len(body) != blen:
+        raise WireTruncationError(
+            f"message body {len(body)}B != declared {blen}B")
+    if verify:
+        _verify_msg(mtype, blen, deadline_s, crc, body)
+    return mtype, body, deadline_s
+
+
+def _recv_msg(sock: socket.socket, version: int = V1,
+              verify: bool = True) -> Tuple[int, memoryview, float]:
+    head = _recv_exact(sock, _hdr_struct(version).size)
+    mtype, blen, deadline_s, crc = _parse_head(head, version)
+    body = _recv_exact(sock, blen)
+    if verify:
+        _verify_msg(mtype, blen, deadline_s, crc, body)
+    return mtype, body, deadline_s
 
 
 def _recv_exact_bounded(sock: socket.socket, n: int, stop: threading.Event,
@@ -160,16 +264,19 @@ def _recv_exact_bounded(sock: socket.socket, n: int, stop: threading.Event,
     return memoryview(buf)
 
 
-def _recv_msg_bounded(sock: socket.socket,
-                      stop: threading.Event) -> Tuple[int, memoryview, float]:
+def _recv_msg_bounded(sock: socket.socket, stop: threading.Event,
+                      version: int = V1,
+                      verify: bool = True) -> Tuple[int, memoryview, float]:
     """Server-side ``_recv_msg`` with liveness bounds: blocks
     indefinitely only BETWEEN messages (polling `stop`); within one it
     inherits the mid-message stall bound."""
-    head = _recv_exact_bounded(sock, _HDR.size, stop, idle_ok=True)
-    mtype, blen, deadline_s = _HDR.unpack(head)
-    if blen > _MAX_BODY:
-        raise WireError(f"declared body length {blen} exceeds {_MAX_BODY}")
-    return mtype, _recv_exact_bounded(sock, blen, stop), deadline_s
+    head = _recv_exact_bounded(
+        sock, _hdr_struct(version).size, stop, idle_ok=True)
+    mtype, blen, deadline_s, crc = _parse_head(head, version)
+    body = _recv_exact_bounded(sock, blen, stop)
+    if verify:
+        _verify_msg(mtype, blen, deadline_s, crc, body)
+    return mtype, body, deadline_s
 
 
 class TcpQueryConnection:
@@ -181,7 +288,8 @@ class TcpQueryConnection:
     """
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 nconns: int = 4):
+                 nconns: int = 4, wire_version: int = WIRE_VERSION,
+                 verify_checksum: bool = True):
         self.addr = f"{host}:{port}"
         self._host, self._port = host, port
         self._timeout = timeout
@@ -191,12 +299,50 @@ class TcpQueryConnection:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
+        # integrity / negotiation state: every fresh socket that may
+        # speak v2 sends a 'V' probe at dial time; a v1 peer's 'E' reply
+        # latches _peer_v1 so later dials skip the probe round trip.
+        # _sock_ver maps each pooled socket to ITS negotiated framing
+        # (single dict ops — GIL-atomic, no extra lock needed).
+        self._wire_version = V2 if int(wire_version) >= V2 else V1
+        self._verify = bool(verify_checksum)
+        self._peer_v1 = self._wire_version == V1
+        self._sock_ver: Dict[socket.socket, int] = {}
 
     # -- socket pool --------------------------------------------------------
+    def _negotiate(self, sock: socket.socket) -> int:
+        """Upgrade one fresh socket to v2 framing: 'V' probe sent in v1
+        framing.  A v2 server replies 'V' and switches that connection;
+        a v1 peer replies 'E' unknown-message-type — stay on v1."""
+        _send_msg(sock, _T_VERSION, [str(WIRE_VERSION).encode()], version=V1)
+        rtype, body, _ = _recv_msg(sock, version=V1)
+        if rtype != _T_VERSION:
+            return V1
+        try:
+            peer = int(bytes(body) or b"1")
+        except ValueError:
+            return V1
+        return V2 if peer >= V2 else V1
+
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(
             (self._host, self._port), timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        ver = V1
+        if self._wire_version >= V2 and not self._peer_v1:
+            try:
+                ver = self._negotiate(sock)
+            except (ConnectionError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            if ver == V1:
+                # benign race between concurrent dialers: worst case a
+                # few extra probes before everyone learns the peer is v1
+                self._peer_v1 = True
+        self._sock_ver[sock] = ver
         return sock
 
     def _checkout(self, timeout: float,
@@ -217,6 +363,7 @@ class TcpQueryConnection:
                     while self._free:
                         stale = self._free.pop()
                         self._live -= 1
+                        self._sock_ver.pop(stale, None)
                         try:
                             stale.close()
                         except OSError:
@@ -241,6 +388,7 @@ class TcpQueryConnection:
         with self._cv:
             if broken or self._closed:
                 self._live -= 1
+                self._sock_ver.pop(sock, None)
                 try:
                     sock.close()
                 except OSError:
@@ -249,9 +397,11 @@ class TcpQueryConnection:
                 self._free.append(sock)
             self._cv.notify()
 
-    def _roundtrip(self, mtype: int, parts: List,
+    def _roundtrip(self, mtype: int, make_parts,
                    timeout: Optional[float]) -> Tuple[int, memoryview]:
-        """One request/response exchange.
+        """One request/response exchange.  ``make_parts(version)`` builds
+        the body parts for the framing the checked-out socket negotiated
+        (a v1 peer must receive v1-encoded frames).
 
         Failure contract (audited — see Documentation/resilience.md):
         a socket that raised during send OR recv is closed and evicted
@@ -266,15 +416,30 @@ class TcpQueryConnection:
         timeout = self._timeout if timeout is None else timeout
         for attempt in (0, 1):
             sock, reused = self._checkout(timeout, fresh=(attempt == 1))
+            ver = self._sock_ver.get(sock, V1)
             broken = True
             sent = False
             try:
                 sock.settimeout(timeout)
                 FAULTS.check("tcp_query.send")
-                _send_msg(sock, mtype, parts, deadline_s=timeout)
+                send_parts = make_parts(ver)
+                if FAULTS.is_armed():
+                    # corrupt= faults mutate the encoded request AFTER its
+                    # checksums were computed (wire-corruption simulation:
+                    # the server's verify-on-decode must catch it)
+                    send_parts = FAULTS.mangle_parts(
+                        "tcp_query.send", send_parts)
+                _send_msg(sock, mtype, send_parts,
+                          deadline_s=timeout, version=ver)
                 sent = True
                 FAULTS.check("tcp_query.recv")
-                rtype, body, _ = _recv_msg(sock)
+                rtype, body, _ = _recv_msg(sock, version=ver,
+                                           verify=self._verify)
+                if FAULTS.is_armed():
+                    # reply-path corruption lands AFTER the message-level
+                    # check — the frame-level checksum inside the body is
+                    # what must catch it at decode
+                    body = FAULTS.mangle("tcp_query.recv", body)
                 broken = False
                 return rtype, body
             except (ConnectionError, OSError) as e:
@@ -292,6 +457,11 @@ class TcpQueryConnection:
     # -- public API ---------------------------------------------------------
     @staticmethod
     def _check_reply(rtype: int, body: memoryview) -> None:
+        if rtype == _T_CORRUPT:
+            # the server refused a request that failed integrity checks:
+            # provably never executed, so resend-safe — the query client
+            # retries it on its corrupt-retries budget and counts it
+            raise WireCorruptionError(bytes(body).decode())
         if rtype == _T_BUSY:
             # admission shed: provably never executed, safe to re-send
             try:
@@ -310,28 +480,34 @@ class TcpQueryConnection:
             raise RemoteApplicationError(bytes(body).decode())
 
     def handshake(self, caps: str) -> str:
-        rtype, body = self._roundtrip(_T_HANDSHAKE, [caps.encode()], None)
+        rtype, body = self._roundtrip(
+            _T_HANDSHAKE, lambda ver: [caps.encode()], None)
         self._check_reply(rtype, body)
         return bytes(body).decode()
 
     def invoke(self, frame: TensorFrame,
                timeout: Optional[float] = None) -> TensorFrame:
         rtype, body = self._roundtrip(
-            _T_QUERY, encode_frame_parts(frame), timeout)
+            _T_QUERY,
+            lambda ver: encode_frame_parts(frame, version=ver),
+            timeout)
         self._check_reply(rtype, body)
-        return decode_frame(body)
+        return decode_frame(body, verify=self._verify)
 
     def invoke_batch(self, frames: List[TensorFrame],
                      timeout: Optional[float] = None) -> List[TensorFrame]:
         rtype, body = self._roundtrip(
-            _T_QUERY, encode_frames_parts(frames), timeout)
+            _T_QUERY,
+            lambda ver: encode_frames_parts(frames, version=ver),
+            timeout)
         self._check_reply(rtype, body)
-        return decode_frames(body)
+        return decode_frames(body, verify=self._verify)
 
     def close(self) -> None:
         with self._cv:
             self._closed = True
             socks, self._free = self._free, []
+            self._sock_ver.clear()
             self._cv.notify_all()
         for s in socks:
             try:
@@ -345,7 +521,9 @@ class TcpQueryServer:
     funnelling into the shared :class:`.service.QueryServerCore` (same
     ingress queue / pending table / caps logic as the gRPC transport)."""
 
-    def __init__(self, core, host: str = "", port: int = 0):
+    def __init__(self, core, host: str = "", port: int = 0,
+                 wire_version: int = WIRE_VERSION,
+                 verify_checksum: bool = True):
         self._core = core
         self._host = host or "0.0.0.0"
         self.port = port
@@ -355,6 +533,19 @@ class TcpQueryServer:
         self._conns: List[socket.socket] = []
         self._conns_lock = threading.Lock()
         self._stop = threading.Event()
+        # wire_version=1 pins LEGACY behavior (pre-checksum framing, 'V'
+        # probes answered 'E') — the stand-in for a v1 peer in interop
+        # tests and the rollback knob in mixed fleets
+        self._wire_version = V2 if int(wire_version) >= V2 else V1
+        self._verify = bool(verify_checksum)
+        #: corrupt requests answered with 'C' (the server stayed alive)
+        self.corruption_detected = 0
+
+    def _note_corrupt(self, err: WireError) -> None:
+        self.corruption_detected += 1
+        if hasattr(self._core, "corrupt_requests"):
+            self._core.corrupt_requests += 1
+        log.warning("corrupt request refused ('C' reply): %s", err)
 
     def start(self) -> None:
         if self._listener is not None:
@@ -424,42 +615,91 @@ class TcpQueryServer:
             t.start()
             self._conn_threads.append(t)
 
-    def _reply(self, conn: socket.socket, mtype: int, parts: List) -> None:
+    def _reply(self, conn: socket.socket, mtype: int, parts: List,
+               version: int = V1) -> None:
         """Send one reply under the send timeout, then restore the short
         recv-poll timeout (settimeout governs BOTH directions)."""
         conn.settimeout(_SEND_TIMEOUT_S)
         try:
-            _send_msg(conn, mtype, parts)
+            _send_msg(conn, mtype, parts, version=version)
         finally:
             conn.settimeout(0.5)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # every connection starts in v1 framing; a 'V' message upgrades
+        # it (and the frames inside replies) for the rest of its life
+        conn_ver = V1
         try:
             while not self._stop.is_set():
                 try:
                     mtype, body, deadline_s = _recv_msg_bounded(
-                        conn, self._stop)
+                        conn, self._stop, version=conn_ver,
+                        verify=self._verify)
+                except WireCorruptionError as e:
+                    # message-level corruption: the declared length was
+                    # honored so WE survived, but a corrupted header may
+                    # have desynced the stream — tell the peer ('C',
+                    # resend-safe) and drop this connection only
+                    self._note_corrupt(e)
+                    try:
+                        self._reply(conn, _T_CORRUPT, [str(e).encode()],
+                                    conn_ver)
+                    except OSError:
+                        pass
+                    return
                 except WireError as e:
                     # unparseable/oversized header: tell the peer and drop
                     # the connection (framing is lost at this point)
                     try:
-                        self._reply(conn, _T_ERROR, [str(e).encode()])
+                        self._reply(conn, _T_ERROR, [str(e).encode()],
+                                    conn_ver)
                     except OSError:
                         pass
                     return
                 except (ConnectionError, OSError):
                     return
                 try:
-                    if mtype == _T_HANDSHAKE:
+                    if mtype == _T_VERSION and self._wire_version >= V2:
+                        # negotiate: answer in the CURRENT framing, then
+                        # upgrade to min(peer max, our max) — a peer that
+                        # advertises only v1 stays on v1 framing (a
+                        # v1-pinned SERVER falls through to
+                        # unknown-message-type below, exactly like a
+                        # true legacy peer)
+                        try:
+                            peer = int(bytes(body) or b"1")
+                        except ValueError:
+                            peer = V1
+                        agreed = V2 if peer >= V2 else V1
+                        self._reply(
+                            conn, _T_VERSION,
+                            [str(agreed).encode()], conn_ver)
+                        conn_ver = agreed
+                    elif mtype == _T_HANDSHAKE:
                         try:
                             caps = self._core.check_caps(bytes(body).decode())
-                            self._reply(conn, _T_HANDSHAKE, [caps.encode()])
+                            self._reply(conn, _T_HANDSHAKE, [caps.encode()],
+                                        conn_ver)
                         except ValueError as e:
-                            self._reply(conn, _T_ERROR, [str(e).encode()])
+                            self._reply(conn, _T_ERROR, [str(e).encode()],
+                                        conn_ver)
                     elif mtype == _T_QUERY:
                         batched = is_batch_payload(body)
-                        frames = (decode_frames(body) if batched
-                                  else [decode_frame(body)])
+                        try:
+                            frames = (
+                                decode_frames(body, verify=self._verify)
+                                if batched
+                                else [decode_frame(body, verify=self._verify)]
+                            )
+                        except WireError as e:
+                            # frame-level corruption/truncation: the
+                            # request never executed — answer 'C' and KEEP
+                            # SERVING (framing is intact; hostile or
+                            # corrupted payloads must not kill the reader)
+                            self._note_corrupt(e)
+                            self._reply(conn, _T_CORRUPT, [str(e).encode()],
+                                        conn_ver)
+                            continue
                         try:
                             answers = self._core.process(
                                 frames,
@@ -469,21 +709,28 @@ class TcpQueryServer:
                             # socket.timeout from the reply sends below is
                             # the same class and must stay an OSError-path
                             # connection drop, not a 'T' reply
-                            self._reply(conn, _T_TIMEOUT, [str(e).encode()])
+                            self._reply(conn, _T_TIMEOUT, [str(e).encode()],
+                                        conn_ver)
                             continue
-                        parts = (encode_frames_parts(answers) if batched
-                                 else encode_frame_parts(answers[0]))
-                        self._reply(conn, _T_QUERY, parts)
+                        parts = (
+                            encode_frames_parts(answers, version=conn_ver)
+                            if batched
+                            else encode_frame_parts(answers[0],
+                                                    version=conn_ver)
+                        )
+                        self._reply(conn, _T_QUERY, parts, conn_ver)
                     else:
                         self._reply(
                             conn, _T_ERROR,
-                            [f"unknown message type {mtype}".encode()])
+                            [f"unknown message type {mtype}".encode()],
+                            conn_ver)
                 except ServerBusyError as e:
                     # admission shed: the cheapest possible reply — the
                     # request never touched the pipeline
                     try:
                         self._reply(conn, _T_BUSY,
-                                    [f"{e.retry_after:.6f}".encode()])
+                                    [f"{e.retry_after:.6f}".encode()],
+                                    conn_ver)
                     except OSError:
                         return
                 except OSError:
@@ -493,7 +740,8 @@ class TcpQueryServer:
                     # malformed frame) becomes a protocol error reply; the
                     # connection and its socket survive
                     try:
-                        self._reply(conn, _T_ERROR, [str(e).encode()])
+                        self._reply(conn, _T_ERROR, [str(e).encode()],
+                                    conn_ver)
                     except OSError:
                         return
         finally:

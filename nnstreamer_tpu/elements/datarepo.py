@@ -63,6 +63,40 @@ def _fmt_sample_path(location: str, idx: int) -> str:
         ) from None
 
 
+def _tmp_sibling(path: str) -> str:
+    """Temp name in the SAME directory (rename must not cross devices),
+    dot-prefixed so printf-pattern scans and shell globs skip it, with
+    the real name kept as the SUFFIX so extension-sniffing writers (PIL
+    picks the container from the extension) still work."""
+    d, base = os.path.split(path)
+    return os.path.join(d, f".tmp-{os.getpid()}-{base}")
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_replace(tmp: str, path: str) -> None:
+    """fsync + rename: after this returns, `path` is either the old file
+    or the COMPLETE new one — a writer killed at any instant can never
+    leave a half-written file under the final name."""
+    _fsync_path(tmp)
+    os.replace(tmp, path)
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = _tmp_sibling(path)
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 @element("datareposink")
 class DataRepoSink(SinkElement):
     PROPERTIES = {
@@ -74,6 +108,7 @@ class DataRepoSink(SinkElement):
     def __init__(self, name=None):
         super().__init__(name)
         self._file = None
+        self._tmp_location: Optional[str] = None
         self._count = 0
         self._specs: Optional[List[TensorSpec]] = None
         self._sample_size = 0
@@ -83,9 +118,16 @@ class DataRepoSink(SinkElement):
         if not self.props["location"] or not self.props["json"]:
             raise ElementError(f"{self.name}: datareposink needs location= and json=")
         self._image_mode = _is_image_pattern(self.props["location"])
-        self._file = (
-            None if self._image_mode else open(self.props["location"], "wb")
-        )
+        if self._image_mode:
+            self._file = None
+            self._tmp_location = None
+        else:
+            # crash-atomic repo: stream into a temp sibling; stop()
+            # fsyncs and renames data THEN meta over the final names, so
+            # a killed writer leaves the previous repo untouched and
+            # never a half-written sample under the real location
+            self._tmp_location = _tmp_sibling(self.props["location"])
+            self._file = open(self._tmp_location, "wb")
         self._count = 0
         self._specs = None  # re-derive the schema from the new run's frame 0
         self._sample_size = 0
@@ -127,10 +169,13 @@ class DataRepoSink(SinkElement):
             self._check_schema(arrays)
             from ..media.image import write_image
 
-            write_image(
-                _fmt_sample_path(self.props["location"], self._count),
-                arrays[0],
-            )
+            # per-sample crash atomicity: temp write + fsync + rename —
+            # a kill mid-encode leaves a dot-tmp orphan, never a
+            # half-encoded image under a sample name the src would read
+            path = _fmt_sample_path(self.props["location"], self._count)
+            tmp = _tmp_sibling(path)
+            write_image(tmp, arrays[0])
+            _atomic_replace(tmp, path)
             self._count += 1
             return
         self._check_schema(arrays)
@@ -147,21 +192,26 @@ class DataRepoSink(SinkElement):
                 "tensors": [s.to_string() for s in (self._specs or [])],
                 "total_samples": self._count,
             }
-            with open(self.props["json"], "w") as f:
-                json.dump(meta, f)
+            _atomic_write_json(self.props["json"], meta)
             return
         if self._file is None:
             return
+        # publish order matters: data first, meta last — a crash between
+        # the two renames leaves old-meta + new-data, and the src's
+        # size check (not a decode error deep into an epoch) reports it
+        self._file.flush()
+        os.fsync(self._file.fileno())
         self._file.close()
         self._file = None
+        os.replace(self._tmp_location, self.props["location"])
+        self._tmp_location = None
         meta = {
             "format": "static",
             "tensors": [s.to_string() for s in (self._specs or [])],
             "total_samples": self._count,
             "sample_size": self._sample_size,
         }
-        with open(self.props["json"], "w") as f:
-            json.dump(meta, f)
+        _atomic_write_json(self.props["json"], meta)
 
 
 @element("datareposrc")
@@ -184,6 +234,7 @@ class DataRepoSrc(SourceElement):
         self._total = 0
         self._sample_size = 0
         self._image_mode = False
+        self._truncated_samples = 0  # meta-claimed samples the file lacks
 
     def start(self):
         if not self.props["location"] or not self.props["json"]:
@@ -229,12 +280,37 @@ class DataRepoSrc(SourceElement):
             self._sample_size = 0
             return
         self._sample_size = int(meta["sample_size"])
+        self._truncated_samples = 0
         size = os.path.getsize(self.props["location"])
-        if size < self._total * self._sample_size:
-            raise ElementError(
-                f"{self.name}: data file smaller than meta claims "
-                f"({size} < {self._total}×{self._sample_size})"
+        need = self._total * self._sample_size
+        if size < need:
+            # a killed writer (or interrupted copy) can leave a repo
+            # whose file ends mid-sample.  Detect it HERE and serve the
+            # complete prefix with a loud report — not a numpy/short-read
+            # crash hours into a shuffled training run, and not a silent
+            # epoch of garbage.  Zero complete samples is still fatal.
+            complete = size // self._sample_size if self._sample_size else 0
+            if complete <= 0:
+                raise ElementError(
+                    f"{self.name}: data file smaller than meta claims "
+                    f"({size} < {self._total}×{self._sample_size}) and "
+                    "holds no complete sample"
+                )
+            trailing = size - complete * self._sample_size
+            self._truncated_samples = self._total - complete
+            self.log.warning(
+                "%s: data file truncated (killed writer?): meta claims %d "
+                "samples (%d B) but the file holds %d B — serving the %d "
+                "complete sample(s)%s",
+                self.name, self._total, need, size, complete,
+                f"; {trailing} trailing byte(s) of a partial sample "
+                "ignored" if trailing else "",
             )
+            self._total = complete
+
+    def health_info(self) -> dict:
+        """Repo-integrity accounting merged into ``Pipeline.health()``."""
+        return {"truncated_samples": self._truncated_samples}
 
     def _sequence(self) -> Optional[List[int]]:
         text = self.props["tensors-sequence"]

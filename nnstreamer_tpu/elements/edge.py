@@ -139,16 +139,21 @@ class _TcpFrameSubscriber:
     """Adapts TcpEdgeSubscriber (raw payloads) to the EdgeSubscriber
     surface edgesrc consumes (frames() iterator + close())."""
 
-    def __init__(self, sub):
+    def __init__(self, sub, verify_checksum: bool = True):
         self._sub = sub
+        self._verify = verify_checksum
+        #: frames dropped on failed decode/integrity checks — a corrupt
+        #: transmission degrades to a gap, never ends the stream
+        self.corrupt_dropped = 0
 
     def frames(self):
         from ..distributed.wire import WireError, decode_frame
 
         for payload in self._sub.payloads():
             try:
-                yield decode_frame(payload)
+                yield decode_frame(payload, verify=self._verify)
             except WireError as e:
+                self.corrupt_dropped += 1
                 log = get_logger("edgesrc")
                 log.warning("undecodable tcp edge frame dropped: %s", e)
 
@@ -187,6 +192,10 @@ class EdgeSrc(SourceElement):
         "reconnect-backoff": Property(
             float, 0.2, "base seconds between re-dials (doubles per "
             "attempt, capped at 2s)"),
+        "verify-checksum": Property(
+            bool, True, "verify wire integrity checksums on received "
+            "frames (v2 envelopes); corrupt frames are dropped and "
+            "counted in health()"),
     }
 
     def __init__(self, name=None):
@@ -194,6 +203,8 @@ class EdgeSrc(SourceElement):
         self._sub: Optional[EdgeSubscriber] = None
         self._targets: list = []
         self._next_target = 0
+        # corrupt-drop counts from subscribers retired by reconnects
+        self._corrupt_base = 0
 
     def _discover(self) -> tuple:
         """Hybrid control plane: read the retained announce from MQTT
@@ -225,12 +236,13 @@ class EdgeSrc(SourceElement):
         return parse_host_list(raw, self.name, "dest-hosts")
 
     def _dial(self, host: str, port: int, probe: bool = False):
+        verify = bool(self.props["verify-checksum"])
         if self.props["connect-type"] == "tcp":
             from ..distributed.tcp_edge import TcpEdgeSubscriber
 
             return _TcpFrameSubscriber(TcpEdgeSubscriber(
                 host, port, self.props["topic"],
-            ))
+            ), verify_checksum=verify)
         if probe or len(self._targets) > 1:
             # gRPC channels connect lazily and never fail at dial time,
             # which would make dest-hosts failover (and the reconnect
@@ -243,7 +255,8 @@ class EdgeSrc(SourceElement):
             if not probe_endpoint(host, port):
                 raise ConnectionError(
                     f"edge endpoint {host}:{port} not accepting")
-        return EdgeSubscriber(host, port, self.props["topic"])
+        return EdgeSubscriber(host, port, self.props["topic"],
+                              verify_checksum=verify)
 
     def _connect_any(self, probe: bool = False):
         """Dial the target list starting at the rotation cursor; first
@@ -276,6 +289,13 @@ class EdgeSrc(SourceElement):
     def output_spec(self) -> StreamSpec:
         text = self.props["caps"]
         return StreamSpec.from_string(text) if text else ANY
+
+    def health_info(self) -> dict:
+        """Integrity accounting merged into ``Pipeline.health()``."""
+        return {
+            "corrupt_dropped": self._corrupt_base
+            + getattr(self._sub, "corrupt_dropped", 0)
+        }
 
     def _stopping(self) -> bool:
         return (
@@ -347,6 +367,9 @@ class EdgeSrc(SourceElement):
                 try:
                     old, self._sub = self._sub, None
                     if old is not None:
+                        # carry the retired subscriber's integrity count
+                        self._corrupt_base += getattr(
+                            old, "corrupt_dropped", 0)
                         old.close()
                     if self.props["connect-type"] == "hybrid":
                         # the publisher may have come back on a NEW

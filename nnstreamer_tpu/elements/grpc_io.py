@@ -201,6 +201,10 @@ class GrpcSrc(SourceElement):
         "idl": Property(str, "flex", "wire IDL: flex | protobuf | flatbuf (interop)"),
         "num-buffers": Property(int, -1, "EOS after N frames (-1 = forever)"),
         "timeout": Property(int, 10000, "ms without a frame before EOS"),
+        "verify-checksum": Property(
+            bool, True, "verify wire integrity checksums on received "
+            "frames (flex v2 envelopes); corrupt frames are dropped and "
+            "counted in health()"),
     }
 
     def __init__(self, name=None):
@@ -210,6 +214,7 @@ class GrpcSrc(SourceElement):
         self.bound_port: Optional[int] = None
         self._reader_stop = threading.Event()
         self._decode_payload = wire.decode_frame
+        self._corrupt_dropped = 0
 
     def output_spec(self) -> StreamSpec:
         return ANY
@@ -300,9 +305,15 @@ class GrpcSrc(SourceElement):
                 n += 1
                 yield frame
 
+    def health_info(self) -> dict:
+        """Integrity accounting merged into ``Pipeline.health()``."""
+        return {"corrupt_dropped": self._corrupt_dropped}
+
     def _decode(self, payload: bytes) -> Optional[TensorFrame]:
         try:
-            return self._decode_payload(payload)
+            return self._decode_payload(
+                payload, verify=self.props["verify-checksum"])
         except wire.WireError as e:
+            self._corrupt_dropped += 1
             self.log.warning("undecodable grpc frame dropped: %s", e)
             return None

@@ -26,6 +26,7 @@ import time
 from typing import Iterator, Optional
 
 from ..core.buffer import TensorFrame
+from ..core.resilience import FAULTS
 from ..core.types import ANY, StreamSpec
 from ..distributed import wire
 from ..distributed.mqtt import MqttClient
@@ -158,6 +159,11 @@ class MqttSink(SinkElement):
         payload = _HDR.pack(_MAGIC, self._base_epoch, time.time()) + (
             self._encode(frame)
         )
+        if FAULTS.is_armed():
+            # corrupt= faults mutate the encoded message post-checksum
+            # (wire-corruption simulation: the subscriber's
+            # verify-on-decode must catch and drop it)
+            payload = FAULTS.mangle("mqtt.publish", payload)
         cap = self.props["max-buffer-size"]
         if cap and len(payload) > cap:
             self.log.warning(
@@ -201,6 +207,10 @@ class MqttSrc(SourceElement):
             "live source semantics (a broker feed is always live; false is "
             "accepted for reference parity and ignored)"
         ),
+        "verify-checksum": Property(
+            bool, True, "verify wire integrity checksums on received "
+            "frames (v2 envelopes); corrupt messages are dropped and "
+            "counted in health()"),
     }
 
     def __init__(self, name=None):
@@ -210,6 +220,7 @@ class MqttSrc(SourceElement):
         self._q: "_queue.Queue[bytes]" = _queue.Queue(64)
         self._base_epoch = 0.0
         self._stopping = threading.Event()
+        self._corrupt_dropped = 0
 
     def output_spec(self) -> StreamSpec:
         return ANY
@@ -248,6 +259,10 @@ class MqttSrc(SourceElement):
         except _queue.Full:
             self.log.warning("receive queue full; dropping message")
 
+    def health_info(self) -> dict:
+        """Integrity accounting merged into ``Pipeline.health()``."""
+        return {"corrupt_dropped": self._corrupt_dropped}
+
     def frames(self) -> Iterator[TensorFrame]:
         limit = self.props["num-buffers"]
         timeout_s = self.props["sub-timeout"] / 1000.0
@@ -269,16 +284,21 @@ class MqttSrc(SourceElement):
                 except _queue.Empty:
                     continue
             if len(payload) < _HDR.size:
+                self._corrupt_dropped += 1
                 self.log.warning("short MQTT message dropped")
                 continue
             magic, base_epoch, sent_epoch = _HDR.unpack_from(payload, 0)
             if magic != _MAGIC:
+                self._corrupt_dropped += 1
                 self.log.warning("bad MQTT message magic; dropped")
                 continue
             try:
-                frame = self._decode_payload(payload[_HDR.size:])
+                frame = self._decode_payload(
+                    payload[_HDR.size:],
+                    verify=self.props["verify-checksum"])
             except wire.WireError as e:
-                self.log.warning("undecodable MQTT frame: %s", e)
+                self._corrupt_dropped += 1
+                self.log.warning("undecodable MQTT frame dropped: %s", e)
                 continue
             # cross-device timestamp rebasing (reference sync doc): shift the
             # sender's stream clock into ours via the epoch difference.

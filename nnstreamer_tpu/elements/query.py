@@ -37,6 +37,7 @@ from ..core.resilience import (
     RetryPolicy,
     is_remote_application_error,
 )
+from ..distributed.wire import WireError
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
     QueryConnection,
@@ -98,6 +99,17 @@ class TensorQueryServerSrc(SourceElement):
         "retry-after": Property(
             float, 0.05, "seconds suggested to BUSY-shed clients before "
             "they retry"),
+        # data-plane integrity (Documentation/wire-protocol.md): corrupt
+        # requests are refused at the door ('C' / DATA_LOSS) without the
+        # server dying; off = serve whatever decodes (debug only)
+        "verify-checksum": Property(
+            bool, True, "verify wire integrity checksums on received "
+            "requests (v2 envelopes); corrupt requests are refused with "
+            "a resend-safe reply and counted in health()"),
+        "wire-version": Property(
+            int, 2, "max wire version this server speaks: 2 = "
+            "checksummed envelopes + per-connection negotiation with v1 "
+            "clients; 1 = pin legacy checksum-free framing"),
     }
 
     def __init__(self, name=None):
@@ -118,6 +130,10 @@ class TensorQueryServerSrc(SourceElement):
         except ValueError as e:
             raise ElementError(f"{self.name}: {e}") from None
         self._core.busy_retry_after = float(self.props["retry-after"])
+        self._core.verify_checksum = bool(self.props["verify-checksum"])
+        # clamp to a version the codecs speak: the gRPC reply path hands
+        # this straight to encode_frame, which refuses unknown versions
+        self._core.wire_version = 2 if int(self.props["wire-version"]) >= 2 else 1
         ct = self.props["connect-type"]
         if ct == "tcp":
             self._core.start_tcp()
@@ -308,6 +324,24 @@ class TensorQueryClient(Element):
             int, 3, "extra paced re-sends when the server sheds with "
             "BUSY (separate budget from retries; 0 = treat BUSY like "
             "any other failure)"),
+        # data-plane integrity (Documentation/wire-protocol.md): a
+        # detected-corrupt exchange is resend-safe — a corrupt REQUEST
+        # was refused before execution ('C'/DATA_LOSS), and a corrupt
+        # REPLY means the answer was lost in transit, so re-asking
+        # cannot double-apply it any harder than the server already did
+        "corrupt-retries": Property(
+            int, 2, "extra paced re-sends when an exchange fails "
+            "integrity verification (own budget like busy-retries; "
+            "corruption DOES count against the remote's breaker — "
+            "sustained corruption trips it, one blip never does)"),
+        "verify-checksum": Property(
+            bool, True, "verify wire integrity checksums on replies (v2 "
+            "envelopes); detected corruption is retried per "
+            "corrupt-retries and counted in health()"),
+        "wire-version": Property(
+            int, 2, "max wire version to negotiate (tcp transport): 2 = "
+            "checksummed envelopes with automatic per-connection "
+            "fallback to v1 peers; 1 = force legacy framing"),
         # resilience knobs (core/resilience.py; Documentation/resilience.md)
         "retry-backoff": Property(
             float, 0.05,
@@ -374,6 +408,12 @@ class TensorQueryClient(Element):
         self._evicted_breaker_trips = 0  # trips of breakers evicted on swaps
         self._busy_replies = 0  # BUSY sheds seen (admission backpressure)
         self._deadline_expired = 0  # requests abandoned: budget ran out
+        # data-plane integrity accounting (all under _breakers_lock —
+        # pool workers race them): exact delivered/retried/corruption
+        # numbers are the acceptance contract of the corruption chaos e2e
+        self._corruption_detected = 0  # corrupt exchanges (request or reply)
+        self._delivered = 0  # logical frames answered by a server
+        self._retried = 0  # extra attempts dispatched (all causes)
         self._retry_policy = RetryPolicy()  # rebuilt from props in start()
 
     @property
@@ -493,6 +533,7 @@ class TensorQueryClient(Element):
 
     def _make_conns(self, targets: List[Tuple[str, int]]) -> list:
         ct = self.props["connect-type"]
+        verify = bool(self.props["verify-checksum"])
         if ct == "tcp":
             from ..distributed.tcp_query import TcpQueryConnection
 
@@ -500,10 +541,13 @@ class TensorQueryClient(Element):
                 TcpQueryConnection(
                     h, p, self.props["timeout"],
                     nconns=max(1, int(self.props["max-in-flight"])),
+                    wire_version=int(self.props["wire-version"]),
+                    verify_checksum=verify,
                 ) for h, p in targets
             ]
         return [
-            QueryConnection(h, p, self.props["timeout"])
+            QueryConnection(h, p, self.props["timeout"],
+                            verify_checksum=verify)
             for h, p in targets
         ]
 
@@ -616,6 +660,9 @@ class TensorQueryClient(Element):
             "degraded_frames": self._degraded,
             "busy_replies": self._busy_replies,
             "deadline_expired": self._deadline_expired,
+            "corruption_detected": self._corruption_detected,
+            "delivered": self._delivered,
+            "retried": self._retried,
             "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
         }
 
@@ -793,6 +840,18 @@ class TensorQueryClient(Element):
         with self._breakers_lock:  # pool workers race this counter
             self._busy_replies += 1
 
+    def _note_corruption(self) -> None:
+        with self._breakers_lock:
+            self._corruption_detected += 1
+
+    def _note_delivered(self, n: int) -> None:
+        with self._breakers_lock:
+            self._delivered += n
+
+    def _note_retried(self) -> None:
+        with self._breakers_lock:
+            self._retried += 1
+
     def _note_expired(self) -> TimeoutError:
         with self._breakers_lock:
             self._deadline_expired += 1
@@ -839,6 +898,7 @@ class TensorQueryClient(Element):
             raise RuntimeError(f"{self.name}: no connections (stopped?)")
         attempts = 1 + max(0, self.props["retries"])
         busy_budget = max(0, int(self.props["busy-retries"]))
+        corrupt_budget = max(0, int(self.props["corrupt-retries"]))
         timeout = self.props["timeout"]
         retry_policy = self._retry_policy
         order = self._healthy_order(ps, first)
@@ -847,6 +907,7 @@ class TensorQueryClient(Element):
         cursor = 0
         k = 0
         busy_used = 0
+        corrupt_used = 0
         expired_terminal = False
         while k < attempts:
             if self._stopped:
@@ -892,6 +953,8 @@ class TensorQueryClient(Element):
                 ps.down_until.pop(i, None)
                 if breaker is not None:
                     breaker.record_success()
+                self._note_delivered(
+                    len(frame) if isinstance(frame, list) else 1)
                 return result
             except ServerBusyError as e:
                 err = e
@@ -902,6 +965,7 @@ class TensorQueryClient(Element):
                     breaker.record_success()
                 if busy_used < busy_budget and not self._stopped:
                     busy_used += 1  # own budget: attempts stay intact
+                    self._note_retried()
                     delay = max(e.retry_after,
                                 retry_policy.delay_for(busy_used))
                     self.log.debug(
@@ -917,7 +981,36 @@ class TensorQueryClient(Element):
                 # would amplify the very overload BUSY exists to relieve
                 k += 1
                 if k < attempts and not self._stopped:
+                    self._note_retried()
                     delay = max(e.retry_after, retry_policy.delay_for(k))
+                    if delay > 0:
+                        time.sleep(delay)
+            except WireError as e:
+                # detected corruption — request refused ('C'/DATA_LOSS)
+                # or reply failed verification.  Resend-safe either way
+                # (see corrupt-retries prop doc), so it gets its own
+                # paced budget; unlike BUSY it IS a health signal: each
+                # event counts toward the breaker, so one flipped bit
+                # never trips it but a rotten link does.
+                err = e
+                self._note_corruption()
+                if breaker is not None:
+                    breaker.record_failure()
+                self.log.warning(
+                    "corrupt exchange with %s (attempt %d/%d): %s",
+                    conn.addr, k + 1, attempts, e,
+                )
+                if corrupt_used < corrupt_budget and not self._stopped:
+                    corrupt_used += 1  # own budget: attempts stay intact
+                    self._note_retried()
+                    delay = retry_policy.delay_for(corrupt_used)
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                k += 1
+                if k < attempts and not self._stopped:
+                    self._note_retried()
+                    delay = retry_policy.delay_for(k)
                     if delay > 0:
                         time.sleep(delay)
             except Exception as e:  # noqa: BLE001 — transport boundary
@@ -935,6 +1028,7 @@ class TensorQueryClient(Element):
                     # RetryPolicy backoff between failover attempts so a
                     # flapping link isn't hammered (capped exponential +
                     # seeded jitter)
+                    self._note_retried()
                     delay = retry_policy.delay_for(k)
                     if delay > 0:
                         time.sleep(delay)
@@ -949,7 +1043,10 @@ class TensorQueryClient(Element):
         safe_to_resend = (
             self.props["retries"] > 0
             or self._provably_unsent(err)
-            or isinstance(err, (CircuitOpenError, ServerBusyError))
+            # breaker-open / admission-shed never reached the pipeline;
+            # detected corruption is resend-safe by the integrity
+            # contract (corrupt-retries prop doc)
+            or isinstance(err, (CircuitOpenError, ServerBusyError, WireError))
         )
         if not rediscovered and self._rediscover(ps) and safe_to_resend:
             return self._invoke_failover(frame, first, rediscovered=True)
@@ -1090,6 +1187,7 @@ class TensorQueryClient(Element):
                     # reliably crashes mid-stream would otherwise clear
                     # its failure window every request and never trip
                     breaker.record_success()
+                self._note_delivered(1)
                 return
             except ServerBusyError as e:
                 # admission shed: only ever raised BEFORE the first
@@ -1114,6 +1212,10 @@ class TensorQueryClient(Element):
                         _time.sleep(delay)
                 continue
             except Exception as e:  # noqa: BLE001 — transport boundary
+                if isinstance(e, WireError):
+                    # corrupt exchange (request refused / answer chunk
+                    # failed verification): counted like the unary path
+                    self._note_corruption()
                 if started:
                     # mid-stream break: no safe replay — but it IS a
                     # health signal; without recording it, a server that
@@ -1142,8 +1244,10 @@ class TensorQueryClient(Element):
             safe = (
                 self.props["retries"] > 0
                 or self._provably_unsent(err)
-                # breaker-open / admission-shed: never reached the pipeline
-                or isinstance(err, (CircuitOpenError, ServerBusyError))
+                # breaker-open / admission-shed: never reached the
+                # pipeline; detected corruption is resend-safe
+                or isinstance(err, (CircuitOpenError, ServerBusyError,
+                                    WireError))
             )
             if self._rediscover(ps) and safe:
                 yield from self._stream_invoke(frame, rediscovered=True)

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Deterministic structure-aware fuzz harness for the wire decoders.
+
+Builds a corpus of VALID artifacts (NNSQ v1/v2 frames, NNSB/NNSC
+batches, tcp_query v1/v2 messages, protobuf and flatbuf codec frames),
+then mutates each one three ways:
+
+* **truncation at every field boundary** — header edges, meta end,
+  tensor-count, each flex header / payload-length / payload edge (plus a
+  seeded spread of arbitrary offsets);
+* **seeded bit flips** — single-bit corruption anywhere in the buffer;
+* **length/count-field mutation** — every size-carrying field is
+  overwritten with adversarial values (0, 1, all-ones, buffer-length,
+  buffer-length+1, 2^31, 2^63, ...), the classic hostile-input shape.
+
+Every mutant is decoded under three assertions, the acceptance contract
+of the data-plane integrity layer (ISSUE 4 / Documentation/
+wire-protocol.md):
+
+1. **no crash** — the decoder either returns a frame or raises a typed
+   ``WireError`` subclass; any other exception is a failure;
+2. **no hang** — each decode must finish inside a wall-clock budget;
+3. **no over-allocation** — tracemalloc peak per decode stays far below
+   ``wire.MAX_BODY`` (a hostile length field must be rejected BEFORE the
+   allocation it describes).
+
+Fully deterministic: one ``--seed`` pins the corpus, every mutation
+position, and every adversarial value, so a failure reproduces exactly.
+Run standalone (exit 0 clean / 1 failures) or in-process from tier-1
+(``tests/test_wire_integrity.py`` runs the fixed-seed smoke alongside
+the check_no_bare_except / check_blocking_timeouts gates).
+
+Usage:
+  python tools/fuzz_wire.py [--seed 7] [--iterations 12000] [-q]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+import tracemalloc
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nnstreamer_tpu.core.buffer import TensorFrame  # noqa: E402
+from nnstreamer_tpu.distributed import tcp_query, wire  # noqa: E402
+
+# per-decode budgets (generous: a clean decode is microseconds)
+TIME_BUDGET_S = 2.0
+ALLOC_BUDGET = wire.MAX_BODY  # tracemalloc peak cap per decode
+
+# adversarial replacement values for size/count fields, masked to width
+EVIL = (0, 1, 2, 0x7F, 0xFF, 0xFFFF, 0x10000, 0x7FFFFFFF, 0xFFFFFFFF,
+        2**33, 2**63 - 1, 2**64 - 1)
+
+
+def _corpus_frames(rng: random.Random):
+    """Valid TensorFrames spanning dtypes, ranks, meta shapes."""
+    r = np.random.default_rng(rng.randrange(2**31))
+    return [
+        TensorFrame([np.arange(12, dtype=np.float32).reshape(3, 4)],
+                    pts=1.25, meta={"k": "v", "n": 3}),
+        TensorFrame([r.integers(0, 255, (2, 3, 4)).astype(np.uint8),
+                     r.standard_normal((5,)).astype(np.float64)],
+                    meta={"nested": {"a": [1, 2]}}),
+        TensorFrame([np.int64([7])]),
+        TensorFrame([np.float16(r.standard_normal((1, 1, 2)))],
+                    pts=0.0, meta={}),
+        TensorFrame([], meta={"empty": True}),
+    ]
+
+
+def _walk_frame_boundaries(buf: bytes) -> list:
+    """Field-boundary offsets of a VALID NNSQ frame, derived by walking
+    the known-good layout (independent of the decoder under test)."""
+    import struct
+
+    offs = [0, 4, 6, 14, 22]  # magic, ver, seq, pts ends
+    ver = struct.unpack_from("<H", buf, 4)[0]
+    head = 30 if ver == 2 else 26
+    meta_len = struct.unpack_from("<I", buf, 22)[0]
+    offs += [head, head + meta_len, head + meta_len + 2]
+    off = head + meta_len
+    (nt,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    for _ in range(nt):
+        fixed = struct.unpack_from("<IIBBH", buf, off)
+        nlen, rank = fixed[2], fixed[3]
+        off += 12 + 4 * rank + nlen
+        offs.append(off)  # end of flex header
+        (plen,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        offs.append(off)  # end of payload-length field
+        off += plen
+        offs.append(off)  # end of payload
+    return sorted({o for o in offs if 0 <= o <= len(buf)})
+
+
+def _len_field_offsets(buf: bytes) -> list:
+    """(offset, width) of every size/count-carrying field in a valid
+    NNSQ frame — the targets of the length-mutation pass."""
+    import struct
+
+    ver = struct.unpack_from("<H", buf, 4)[0]
+    head = 30 if ver == 2 else 26
+    meta_len = struct.unpack_from("<I", buf, 22)[0]
+    fields = [(22, 4)]  # meta_len
+    off = head + meta_len
+    fields.append((off, 2))  # ntensors
+    (nt,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    for _ in range(nt):
+        fields.append((off + 8, 1))   # flex nlen (u8)
+        fields.append((off + 9, 1))   # flex rank (u8)
+        fixed = struct.unpack_from("<IIBBH", buf, off)
+        nlen, rank = fixed[2], fixed[3]
+        off += 12 + 4 * rank + nlen
+        fields.append((off, 8))  # payload_len
+        (plen,) = struct.unpack_from("<Q", buf, off)
+        off += 8 + plen
+    return fields
+
+
+class Runner:
+    def __init__(self, quiet: bool = False):
+        self.cases = 0
+        self.wire_errors = 0
+        self.clean = 0
+        self.failures = []
+        self.quiet = quiet
+        self.max_elapsed = 0.0
+        self.max_alloc = 0
+
+    def run(self, label: str, decode, buf) -> None:
+        self.cases += 1
+        tracemalloc.reset_peak()
+        t0 = time.perf_counter()
+        try:
+            decode(buf)
+            self.clean += 1
+        except wire.WireError:
+            self.wire_errors += 1  # typed refusal: the contract
+        except Exception as e:  # noqa: BLE001 — the harness records it
+            self.failures.append(
+                (label, f"{type(e).__name__}: {e}", bytes(buf)[:64].hex()))
+        elapsed = time.perf_counter() - t0
+        _, peak = tracemalloc.get_traced_memory()
+        self.max_elapsed = max(self.max_elapsed, elapsed)
+        self.max_alloc = max(self.max_alloc, peak)
+        if elapsed > TIME_BUDGET_S:
+            self.failures.append(
+                (label, f"hang: decode took {elapsed:.2f}s", ""))
+        if peak > ALLOC_BUDGET:
+            self.failures.append(
+                (label, f"over-allocation: {peak} B > {ALLOC_BUDGET}", ""))
+
+
+def _mutants(rng: random.Random, buf: bytes, boundaries, len_fields,
+             n_random: int):
+    """Yield (tag, mutated_buffer) — deterministic given rng state."""
+    for b in boundaries:
+        yield f"trunc@{b}", buf[:b]
+    for off, width in len_fields:
+        for v in EVIL:
+            mut = bytearray(buf)
+            mut[off : off + width] = int(v & (2 ** (8 * width) - 1)).to_bytes(
+                width, "little")
+            yield f"len@{off}={v}", bytes(mut)
+    for _ in range(n_random):
+        mut = bytearray(buf)
+        if rng.random() < 0.5 and len(mut) > 0:
+            pos = rng.randrange(len(mut) * 8)
+            mut[pos // 8] ^= 1 << (pos % 8)
+            yield f"bitflip@{pos}", bytes(mut)
+        else:
+            yield f"rtrunc@{rng.randrange(len(mut) + 1)}", bytes(
+                mut[: rng.randrange(len(mut) + 1)])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--iterations", type=int, default=12000,
+                    help="minimum total mutated cases (default 12000)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    runner = Runner(quiet=args.quiet)
+    frames = _corpus_frames(rng)
+
+    # (label, decode, valid bytes, structure-aware?) corpus
+    corpus = []
+    for v in (1, 2):
+        for i, f in enumerate(frames):
+            corpus.append((f"frame-v{v}-{i}", wire.decode_frame,
+                           wire.encode_frame(f, version=v), True))
+        corpus.append((f"batch-v{v}", wire.decode_frames,
+                       wire.encode_frames(frames[:3], version=v), False))
+        body = wire.encode_frame(frames[0], version=v)
+        corpus.append((
+            f"tcpmsg-v{v}",
+            lambda d, v=v: tcp_query.parse_msg(d, version=v),
+            tcp_query.encode_msg(ord("Q"), body, deadline_s=2.5, version=v),
+            False,
+        ))
+    from nnstreamer_tpu.distributed import protobuf_codec
+
+    for i, f in enumerate(frames[:3]):
+        corpus.append((f"protobuf-{i}", protobuf_codec.decode_frame,
+                       protobuf_codec.encode_frame(f), False))
+    try:
+        from nnstreamer_tpu.distributed import flatbuf_codec
+
+        fbs_ok = [f for f in frames[:2] if f.tensors]
+        for i, f in enumerate(fbs_ok):
+            corpus.append((f"flatbuf-{i}", flatbuf_codec.decode_frame,
+                           flatbuf_codec.encode_frame(f), False))
+    except ImportError:  # flatbuffers runtime absent: skip that codec
+        pass
+
+    # deterministic structure-aware pass, then seeded random fill to
+    # reach the requested case count
+    structured = 0
+    plans = []
+    for label, decode, buf, aware in corpus:
+        boundaries = _walk_frame_boundaries(buf) if aware else sorted(
+            {0, 1, 4, len(buf) // 2, max(0, len(buf) - 1), len(buf)})
+        len_fields = _len_field_offsets(buf) if aware else []
+        plans.append((label, decode, buf, boundaries, len_fields))
+        structured += len(boundaries) + len(len_fields) * len(EVIL)
+    n_random = max(0, args.iterations - structured)
+    per_item = n_random // len(plans) + 1
+
+    tracemalloc.start()
+    try:
+        for label, decode, buf, boundaries, len_fields in plans:
+            # the pristine buffer must still decode cleanly
+            runner.run(f"{label}/valid", decode, buf)
+            for tag, mut in _mutants(rng, buf, boundaries, len_fields,
+                                     per_item):
+                runner.run(f"{label}/{tag}", decode, mut)
+    finally:
+        tracemalloc.stop()
+
+    if not args.quiet:
+        print(
+            f"fuzz_wire: {runner.cases} cases (seed {args.seed}) — "
+            f"{runner.clean} clean decodes, {runner.wire_errors} typed "
+            f"WireErrors, {len(runner.failures)} failures; "
+            f"max decode {runner.max_elapsed * 1e3:.1f} ms, "
+            f"max alloc {runner.max_alloc} B"
+        )
+    for label, msg, prefix in runner.failures[:20]:
+        print(f"FAIL {label}: {msg}  buf[:64]={prefix}", file=sys.stderr)
+    if runner.cases < args.iterations:
+        print(f"FAIL: only {runner.cases} cases generated "
+              f"(< {args.iterations})", file=sys.stderr)
+        return 1
+    return 1 if runner.failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
