@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import grpc
 
 from ..core.buffer import BatchFrame, TensorFrame
+from ..core.lifecycle import ServerGoawayError
 from ..core.liveness import AdmissionController, ServerBusyError, stamp_deadline
 from ..core.log import get_logger
 from ..core.types import StreamSpec
@@ -99,6 +100,13 @@ class QueryServerCore:
         self.verify_checksum = True
         self.wire_version = 2
         self.corrupt_requests = 0  # corrupt requests refused, all transports
+        # rolling restart (core/lifecycle.py): a draining server refuses
+        # NEW requests with GOAWAY ('G' on raw TCP / UNAVAILABLE+goaway
+        # detail on gRPC) — an immediate, resend-safe failover signal
+        # that never trips client breakers — while in-flight requests
+        # finish normally; then the serversrc closes the listeners
+        self.draining = False
+        self.goaway_sent = 0  # requests refused with GOAWAY
 
     # -- transport-agnostic handlers ----------------------------------------
     def check_caps(self, client_caps: str) -> str:
@@ -157,6 +165,11 @@ class QueryServerCore:
         wire, instants don't), so server pipeline elements can expire
         late work BEFORE the invoke instead of burning chip time on an
         answer the client has already abandoned."""
+        if self.draining:
+            # checked BEFORE admission: the refusal must be O(1) and the
+            # request provably never executed (resend-safe failover)
+            self.goaway_sent += 1
+            raise ServerGoawayError()
         if not self.admission.try_admit():
             raise ServerBusyError(retry_after=self.busy_retry_after)
         try:
@@ -223,6 +236,11 @@ class QueryServerCore:
         try:
             answers = self.process(
                 frames, float(context.time_remaining() or 30.0))
+        except ServerGoawayError as e:
+            # UNAVAILABLE + goaway detail ≙ the raw-TCP 'G' reply; the
+            # client transport maps it back to ServerGoawayError —
+            # immediate resend-safe failover, never a breaker event
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"goaway: {e}")
         except ServerBusyError as e:
             # RESOURCE_EXHAUSTED ≙ the raw-TCP BUSY reply; the client
             # transport maps it back to ServerBusyError (backpressure,
@@ -253,6 +271,10 @@ class QueryServerCore:
             self.corrupt_requests += 1
             log.warning("corrupt stream request refused (DATA_LOSS): %s", e)
             context.abort(grpc.StatusCode.DATA_LOSS, f"corrupt request: {e}")
+        if self.draining:
+            self.goaway_sent += 1
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "goaway: server draining")
         if not self.admission.try_admit():
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
@@ -353,7 +375,38 @@ class QueryServerCore:
             "admission_low": snap["low"],
             "ingress_depth": self.ingress.qsize(),
             "corrupt_requests": self.corrupt_requests,
+            "draining": self.draining,
+            "goaway_sent": self.goaway_sent,
         }
+
+    # -- rolling restart (core/lifecycle.py) --------------------------------
+    def begin_drain(self) -> None:
+        """Enter the draining state: every transport starts refusing NEW
+        requests with GOAWAY; requests already admitted finish
+        normally."""
+        if not self.draining:
+            self.draining = True
+            log.info("query server :%d draining (GOAWAY to new requests)",
+                     self.port)
+
+    @property
+    def drain_complete(self) -> bool:
+        """True once no admitted request is still in flight and nothing
+        remains queued for the server pipeline."""
+        return self.admission.inflight == 0 and self.ingress.empty()
+
+    def close_listeners(self) -> None:
+        """Stop accepting entirely (listeners closed) without cutting
+        in-flight replies: the raw-TCP path keeps connection readers
+        serving until the last reply is out, and the gRPC stop() grace
+        gives an RPC that outlived ``drain-deadline`` the same courtesy
+        (new RPCs are refused immediately either way; stop() returns
+        without blocking)."""
+        if self._server is not None:
+            self._server.stop(grace=30.0)
+            self._server = None
+        if self._tcp is not None:
+            self._tcp.close_listener()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -388,8 +441,11 @@ class QueryServerCore:
 
     def start_tcp(self) -> None:
         """Serve over the raw-TCP zero-copy transport instead of gRPC
-        (connect-type=tcp; ≙ the reference's nns-edge TCP default)."""
+        (connect-type=tcp; ≙ the reference's nns-edge TCP default).
+        Re-entrant: a listener closed by a drain re-opens on the same
+        port (rolling restart of the serversrc element)."""
         if self._tcp is not None:
+            self._tcp.start()  # no-op when the listener is already live
             return
         from .tcp_query import TcpQueryServer
 
@@ -471,15 +527,28 @@ class QueryConnection:
     def _map_busy(err: grpc.RpcError) -> None:
         """Translate server status codes both transports share onto one
         client-side vocabulary: RESOURCE_EXHAUSTED (admission refusal)
-        -> :class:`ServerBusyError` (≙ the raw-TCP BUSY reply), and
+        -> :class:`ServerBusyError` (≙ the raw-TCP BUSY reply),
         DATA_LOSS (corrupt request refused before execution) ->
         :class:`WireCorruptionError` (≙ the raw-TCP 'C' reply,
-        resend-safe)."""
+        resend-safe), and UNAVAILABLE carrying the goaway detail (the
+        server DECIDED to refuse — it is draining) ->
+        :class:`ServerGoawayError` (≙ the raw-TCP 'G' reply; a bare
+        UNAVAILABLE stays a transport fault and keeps counting against
+        the remote's health)."""
         code = getattr(err, "code", lambda: None)()
         if code == grpc.StatusCode.DATA_LOSS:
             raise WireCorruptionError(
                 str(getattr(err, "details", lambda: "")() or "corrupt request")
             ) from err
+        if code == grpc.StatusCode.UNAVAILABLE:
+            detail = str(getattr(err, "details", lambda: "")() or "")
+            # exact-prefix match on OUR server's reply format: gRPC's own
+            # transport errors can mention "GOAWAY" mid-detail (HTTP/2
+            # GOAWAY frame on abrupt termination) and those are real
+            # faults — they must keep counting against the remote
+            if detail.startswith("goaway"):
+                raise ServerGoawayError(detail) from err
+            return
         if code != grpc.StatusCode.RESOURCE_EXHAUSTED:
             return
         retry_after = 0.05

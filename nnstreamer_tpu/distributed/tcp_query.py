@@ -39,6 +39,10 @@ Socket protocol (little-endian):
                  mismatch / malformed envelope).  The request provably
                  never executed, so clients treat it as a resend-safe
                  transient; the server connection stays alive.
+  'G' goaway:    the server is DRAINING (rolling restart) and refused the
+                 request before ingest; body = error text.  Provably
+                 never executed — clients fail over to another host
+                 immediately (no pacing, no breaker event).
 ``deadline_s`` carries the client's remaining timeout so the server-side
 pipeline wait honors it (the gRPC transport gets the same via
 ``context.time_remaining()``); 0 on replies.
@@ -54,6 +58,7 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..core.buffer import TensorFrame
+from ..core.lifecycle import ServerGoawayError
 from ..core.liveness import ServerBusyError
 from ..core.log import get_logger
 from ..core.resilience import FAULTS, RemoteApplicationError
@@ -92,6 +97,11 @@ _T_BUSY = ord("B")
 _T_TIMEOUT = ord("T")
 # wire-version negotiation (see module docstring)
 _T_VERSION = ord("V")
+# rolling restart: the server is DRAINING and refused the request before
+# ingest (core/lifecycle.py).  Provably never executed -> immediate
+# resend-safe failover; unlike 'B' there is no pacing to honor and the
+# reply is health (never a breaker event): the host is leaving, not sick.
+_T_GOAWAY = ord("G")
 # integrity: the request failed checksum/envelope verification before any
 # execution — resend-safe; body = error text
 _T_CORRUPT = ord("C")
@@ -457,6 +467,12 @@ class TcpQueryConnection:
     # -- public API ---------------------------------------------------------
     @staticmethod
     def _check_reply(rtype: int, body: memoryview) -> None:
+        if rtype == _T_GOAWAY:
+            # the server is draining (rolling restart): the request
+            # provably never executed — the client fails over to another
+            # host immediately, with no pacing and no breaker event
+            raise ServerGoawayError(bytes(body).decode() or
+                                    "server draining (goaway)")
         if rtype == _T_CORRUPT:
             # the server refused a request that failed integrity checks:
             # provably never executed, so resend-safe — the query client
@@ -562,6 +578,24 @@ class TcpQueryServer:
             target=self._accept_loop, name="tcpq-accept", daemon=True)
         self._accept_thread.start()
         log.info("tcp query server on :%d", self.port)
+
+    def close_listener(self) -> None:
+        """Rolling-restart drain: stop ACCEPTING (listener closed, accept
+        thread joined) while existing connection readers keep serving —
+        a drained server must never cut a final in-flight reply mid-send.
+        ``start()`` re-binds the same port afterwards."""
+        ls = self._listener
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2)
+            self._accept_thread = None
+        self._listener = None
+        log.info("tcp query server :%d stopped accepting (drained)",
+                 self.port)
 
     def stop(self) -> None:
         self._stop.set()
@@ -724,6 +758,15 @@ class TcpQueryServer:
                             conn, _T_ERROR,
                             [f"unknown message type {mtype}".encode()],
                             conn_ver)
+                except ServerGoawayError as e:
+                    # rolling restart: draining — refuse before ingest;
+                    # the connection stays alive so in-flight replies on
+                    # it still complete
+                    try:
+                        self._reply(conn, _T_GOAWAY, [str(e).encode()],
+                                    conn_ver)
+                    except OSError:
+                        return
                 except ServerBusyError as e:
                     # admission shed: the cheapest possible reply — the
                     # request never touched the pipeline

@@ -64,6 +64,16 @@ class AppSrc(SourceElement):
         self._q = self._make_queue(self.PROPERTIES["max-buffers"].default)
         self._spec: StreamSpec = ANY
         self._count = 0
+        # logical frames pushed/popped — two single-writer counters (app
+        # thread / streaming thread), no lock: pending_frames() drives
+        # graceful-drain flushing and exact dropped accounting
+        self._pushed_logical = 0
+        self._popped_logical = 0
+
+    def pending_frames(self) -> int:
+        """Logical frames pushed but not yet pulled into the stream
+        (drain flushes these; an immediate stop abandons them)."""
+        return max(0, self._pushed_logical - self._popped_logical)
 
     def start(self):
         # honor max-buffers: a full queue blocks push() — backpressure
@@ -97,6 +107,9 @@ class AppSrc(SourceElement):
             if fr:
                 frame.pts = self._count * _frame_interval(fr)
         self._count += 1
+        # a pushed frame may itself be a BatchFrame (N logical frames):
+        # count what the pop side will count or pending_frames() skews
+        self._pushed_logical += getattr(frame, "batch_size", 1)
         self._q.put(frame)
 
     def push_block(
@@ -146,6 +159,7 @@ class AppSrc(SourceElement):
             frames_info=[(p, None, {}) for p in pts],
         )
         self._count += n
+        self._pushed_logical += n
         self._q.put(frame)
 
     def push_event(self, event) -> None:
@@ -166,13 +180,25 @@ class AppSrc(SourceElement):
                 else:
                     items = [self._q.get(timeout=0.1)]
             except _queue.Empty:
-                # stay responsive to pipeline stop while idle
-                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                # stay responsive to pipeline stop/drain while idle
+                from ..core.lifecycle import pipeline_quiescing
+
+                p = self._pipeline
+                if p is not None and p._stop_flag.is_set():
+                    return
+                # graceful drain must flush frames already pushed: a
+                # push can land between the Empty above and the flag
+                # check, so only end the stream once pending_frames()
+                # confirms nothing is held (push() bumps the counter
+                # BEFORE enqueuing, making this re-check sufficient)
+                if pipeline_quiescing(self) and self.pending_frames() <= 0:
                     return
                 continue
             for item in items:
                 if item is None:
                     return
+                if isinstance(item, TensorFrame):
+                    self._popped_logical += getattr(item, "batch_size", 1)
                 yield item
 
 

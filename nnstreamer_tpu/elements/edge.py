@@ -298,10 +298,9 @@ class EdgeSrc(SourceElement):
         }
 
     def _stopping(self) -> bool:
-        return (
-            self._pipeline is not None
-            and self._pipeline._stop_flag.is_set()
-        )
+        from ..core.lifecycle import pipeline_quiescing
+
+        return pipeline_quiescing(self)
 
     def _backoff_wait(self, delay: float) -> bool:
         """Sleep `delay` seconds; True if the pipeline stopped meanwhile."""

@@ -32,6 +32,7 @@ from ..backends.base import FilterBackend, find_backend, parse_accelerator
 from ..core import config as nns_config
 from ..core import registry
 from ..core.buffer import FRAME_POOL, BatchFrame, CustomEvent, Flush, TensorFrame
+from ..core.lifecycle import HotSwapCoordinator, SwapTicket
 from ..core.model_uri import resolve_model_uri
 from ..core.resilience import FAULTS
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
@@ -253,6 +254,25 @@ class TensorFilter(TransformElement):
         "throughput": Property(int, 0, "1 = enable throughput measurement"),
         "latency-report": Property(int, 0, "1 = post latency bus messages"),
         "is-updatable": Property(bool, False, "allow hot model reload"),
+        # zero-downtime model rollout (core/lifecycle.py): reloads stage
+        # the new model on a SECOND backend instance off the hot path
+        # (open + schema validation + JIT warmup), swap at a frame
+        # boundary, and roll back on a post-swap error burst
+        "staged-reload": Property(
+            bool, True,
+            "hot reloads stage+validate+warm the new model on a second "
+            "backend instance and swap at a frame boundary (false = "
+            "legacy inline backend.reload(), still guarded: a failed "
+            "reload keeps the old model serving)"),
+        "observation-window": Property(
+            float, 5.0,
+            "seconds after a hot swap during which invoke errors are "
+            "served by the retained old model and an error burst rolls "
+            "the swap back"),
+        "rollback-error-burst": Property(
+            int, 3,
+            "invoke errors within observation-window that auto-roll-back "
+            "a hot swap to the previous model"),
         "shared-tensor-filter-key": Property(str, "", "share one backend instance"),
         "invoke-dynamic": Property(bool, False, "output schema varies per buffer"),
         "max-batch": Property(int, 1, "micro-batch up to N queued frames into one invoke"),
@@ -317,6 +337,9 @@ class TensorFilter(TransformElement):
         # in-flight micro-batches: (device outputs, source frames) awaiting
         # materialization (the depth-N dispatch window, VERDICT r3 #2)
         self._inflight: deque = deque()
+        # hot-swap coordinator (core/lifecycle.py), created on the first
+        # reload request; None keeps the per-call check to one attr read
+        self._swapper: Optional[HotSwapCoordinator] = None
 
     @property
     def batch_through_active(self) -> bool:
@@ -462,6 +485,23 @@ class TensorFilter(TransformElement):
                         "layout on TPU — this prop is declarative"
                     )
 
+    def _make_backend(self, model: Optional[str]) -> FilterBackend:
+        """Open ONE backend instance for ``model`` with this element's
+        props.  Used at start() and by the hot-swap staging thread (which
+        builds a second instance without touching the serving one)."""
+        be = self._backend_cls()
+        info = be.framework_info()
+        if model is None and not info.run_without_model:
+            raise ElementError(
+                f"{self.name}: framework {self._framework!r} requires a model")
+        if model and info.verify_model_path and not os.path.exists(model):
+            raise ElementError(f"{self.name}: model file not found: {model}")
+        props = dict(self.props)
+        enabled, wishes = parse_accelerator(self.props["accelerator"])
+        props["accelerators"] = wishes if enabled else ["cpu"]
+        be.open(model, props)
+        return be
+
     def start(self) -> None:
         self._apply_config_file()
         self._check_layouts()
@@ -503,26 +543,17 @@ class TensorFilter(TransformElement):
             backend_cls = find_backend(fw)
         except KeyError:
             raise ElementError(f"{self.name}: unknown framework {fw!r}") from None
-
-        def make() -> FilterBackend:
-            be = backend_cls()
-            info = be.framework_info()
-            if model is None and not info.run_without_model:
-                raise ElementError(f"{self.name}: framework {fw!r} requires a model")
-            if model and info.verify_model_path and not os.path.exists(model):
-                raise ElementError(f"{self.name}: model file not found: {model}")
-            props = dict(self.props)
-            enabled, wishes = parse_accelerator(self.props["accelerator"])
-            props["accelerators"] = wishes if enabled else ["cpu"]
-            be.open(model, props)
-            return be
+        # latched for hot model swaps: a reload keeps the framework
+        # resolved at start (≙ the reference RELOAD_MODEL contract)
+        self._backend_cls, self._framework = backend_cls, fw
 
         key = self.props["shared-tensor-filter-key"]
         if key:
-            self.backend = _shared_acquire(key, make)
+            self.backend = _shared_acquire(
+                key, lambda: self._make_backend(model))
             self._owns_backend = False
         else:
-            self.backend = make()
+            self.backend = self._make_backend(model)
             self._owns_backend = True
         self._model_in, self._model_out = self.backend.get_model_info()
         in_override = self._manual_spec("input")
@@ -580,6 +611,10 @@ class TensorFilter(TransformElement):
 
     def stop(self) -> None:
         self._inflight.clear()
+        if self._swapper is not None:
+            # staged / retired / rolled-back backends; the coordinator
+            # (and its lifetime swap counters) survives restarts
+            self._swapper.close()
         if getattr(self, "_tracing", False):
             from ..core.profiler import trace_stop
 
@@ -592,6 +627,242 @@ class TensorFilter(TransformElement):
         if should_close and (self._owns_backend or key):
             self.backend.close()
         self.backend = None
+
+    # -- zero-downtime model rollout (core/lifecycle.py) ---------------------
+    def _ensure_swapper(self) -> HotSwapCoordinator:
+        if self._swapper is None:
+            self._swapper = HotSwapCoordinator(
+                self.name,
+                # "" = modelless backend (custom fns): open(None, ...)
+                build=lambda m: self._make_backend(m or None),
+                validate=self._validate_staged,
+                warmup=self._warmup_staged,
+            )
+        return self._swapper
+
+    def request_reload(self, model: str = "") -> SwapTicket:
+        """Validated hot model swap (``Pipeline.reload_model`` and the
+        RELOAD_MODEL event land here): stage the new model on a second
+        backend instance in a background thread — open, schema check
+        against the negotiated specs, JIT warmup on a zero probe frame —
+        then swap atomically at the next frame boundary.  Any staging
+        failure keeps the old model serving and counts ``swap_failures``
+        (never the supervisor's restart budget)."""
+        if not self.props["is-updatable"]:
+            raise ElementError(
+                f"{self.name}: model reload requires is-updatable=true")
+        if self.backend is None:
+            raise ElementError(f"{self.name}: not started")
+        model = model or self.props["model"]
+        model = resolve_model_uri(model) if model else ""
+        sw = self._ensure_swapper()
+        if not self._owns_backend or not self.props["staged-reload"]:
+            # a shared backend is visible to every filter on the key, so a
+            # per-element pointer swap cannot replace it — guarded legacy
+            # inline reload (double-buffered inside backends that support
+            # it, e.g. jax-xla)
+            return self._inline_reload(model)
+        return sw.request(
+            model,
+            observation_window=float(self.props["observation-window"]),
+            error_burst=int(self.props["rollback-error-burst"]),
+        )
+
+    def _inline_reload(self, model: str) -> SwapTicket:
+        """Legacy in-place ``backend.reload()`` with the keep-serving
+        guarantee: a failed reload logs, counts ``swap_failures``, and
+        leaves the old model serving — it must never escape into the
+        supervision machinery and kill/restart the element."""
+        sw = self._ensure_swapper()
+        try:
+            FAULTS.check("filter.reload.load")
+            self.backend.reload(model)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — reload boundary
+            self.log.error(
+                "model reload from %r failed (old model keeps serving): %s",
+                model, e,
+            )
+            return sw.note_inline_failure(e)
+        self.props["model"] = model
+        self.log.info("model reloaded from %s", model)
+        return sw.note_inline_swap(model)
+
+    def _validate_staged(self, be: FilterBackend):
+        """Staging-thread schema validation: the new model must accept
+        the pipeline's negotiated input stream and keep producing the
+        negotiated output schema (downstream never renegotiates during a
+        hot swap).  Returns the raw model info the element adopts at
+        swap time."""
+        raw_in, raw_out = be.get_model_info()
+        new_in = self._as_stream_spec(raw_in)
+        new_out = self._as_stream_spec(raw_out)
+        negotiated = self.sink_specs.get(0)
+        if (negotiated is not None and negotiated.tensors
+                and new_in is not None):
+            got = self._input_for_backend(negotiated)
+            if not new_in.is_compatible(got):
+                raise ElementError(
+                    f"{self.name}: staged model input "
+                    f"{new_in.to_string()} does not accept the negotiated "
+                    f"stream {got.to_string()}"
+                )
+        if (new_out is None and negotiated is not None
+                and negotiated.tensors):
+            try:
+                new_out = self._as_stream_spec(
+                    be.set_input_info(self._input_for_backend(negotiated)))
+            except NotImplementedError:
+                new_out = None
+        cur_out = self.srcpads[0].spec if self.srcpads else None
+        if (new_out is not None and cur_out is not None
+                and getattr(cur_out, "tensors", None)
+                and not self.props["invoke-dynamic"]
+                and not self._out_comb
+                and not cur_out.is_compatible(new_out)):
+            raise ElementError(
+                f"{self.name}: staged model output {new_out.to_string()} "
+                f"does not match the negotiated downstream schema "
+                f"{cur_out.to_string()}"
+            )
+        return raw_in, raw_out
+
+    def _probe_inputs(self, model_in=None) -> Optional[List[Any]]:
+        """A zero frame matching the model's (or negotiated) input
+        schema, flexible dims resolved to 1; None when no static schema
+        exists to probe.  ``model_in`` overrides the serving model's raw
+        input info (the staging path probes the NEW model's schema)."""
+        spec = self._as_stream_spec(
+            self._model_in if model_in is None else model_in)
+        if spec is None and model_in is None:
+            negotiated = self.sink_specs.get(0)
+            if negotiated is not None and negotiated.tensors:
+                spec = self._input_for_backend(negotiated)
+        if spec is None or not spec.tensors:
+            return None
+        probes = []
+        for t in spec.tensors:
+            shape = tuple(1 if d in (None, 0) else int(d) for d in t.shape)
+            probes.append(np.zeros(shape, dtype=t.dtype))
+        return probes
+
+    def _warmup_staged(self, be: FilterBackend) -> None:
+        """Staging-thread JIT warmup: one probe invoke (and a batched one
+        when the hot path micro-batches) so a swap never forces a fresh
+        XLA trace on the serving thread — on TPU that compile is
+        multi-second, which would stall the stream."""
+        probes = self._probe_inputs()
+        if probes is None:
+            probes = self._probe_inputs(model_in=be.get_model_info()[0])
+            if probes is None:
+                return  # nothing static to probe (dynamic/custom schema)
+        be.invoke(list(probes))
+        if be.supports_batch and self.preferred_batch > 1:
+            be.invoke_batch([p[None] for p in probes])
+
+    def _swap_tick(self) -> List[Tuple[int, TensorFrame]]:
+        """Frame-boundary lifecycle work: apply a staged swap, commit an
+        expired observation window, and reap retired backends — all
+        strictly AFTER draining the in-flight dispatch window, so a
+        retiring backend outlives its last in-flight frame.  Returns the
+        drained results (the caller emits them ahead of new output)."""
+        sw = self._swapper
+        if sw is None or not sw.has_boundary_work:
+            return []
+        drained = self._drain_inflight()
+        staged = sw.take_staged()
+        if staged is not None:
+            be, model, raw_in, raw_out, ticket = staged
+            old_blob = (
+                self.backend, self._model_in, self._model_out,
+                self.props["model"],
+            )
+            self.backend = be
+            if raw_in is not None:
+                self._model_in = raw_in
+            if raw_out is not None:
+                self._model_out = raw_out
+            self.props["model"] = model
+            sw.activated(old_blob, ticket)
+            self.log.info(
+                "hot-swapped to model %r (version %d); observing for "
+                "%.1fs", model, sw.model_version,
+                float(self.props["observation-window"]),
+            )
+        if sw.observing:
+            sw.note_ok()  # commits once the observation window elapsed
+        sw.reap()
+        return drained
+
+    def _backend_invoke(self, inputs: List[Any]) -> List[Any]:
+        sw = self._swapper
+        if sw is None or not sw.observing:
+            return self.backend.timed_invoke(inputs)
+        return self._observed_invoke(False, inputs)
+
+    def _backend_invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        sw = self._swapper
+        if sw is None or not sw.observing:
+            return self.backend.timed_invoke_batch(inputs)
+        return self._observed_invoke(True, inputs)
+
+    def _observed_invoke(self, batched: bool, inputs: List[Any]) -> List[Any]:
+        """Invoke inside the post-swap observation window: an error is
+        served by the RETAINED old model (zero frame loss) and counted;
+        a burst rolls the swap back entirely.  Neither path ever reaches
+        the supervisor's error-policy/restart machinery."""
+        sw = self._swapper
+        try:
+            if FAULTS.is_armed():
+                FAULTS.check("filter.reload.post",
+                             interrupt=lambda: self.interrupted)
+            out = (
+                self.backend.timed_invoke_batch(inputs) if batched
+                else self.backend.timed_invoke(inputs)
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — observation boundary
+            verdict = sw.note_error(e)
+            if verdict is None:
+                raise
+            (old_be, old_in, old_out, old_model), rolled_back = verdict
+            if rolled_back:
+                failed = self.backend
+                self.backend = old_be
+                self._model_in, self._model_out = old_in, old_out
+                self.props["model"] = old_model
+                sw.discard(failed)
+            # the frame is retried on the old backend either way — a
+            # bad rollout must not cost a single frame
+            return (
+                old_be.timed_invoke_batch(inputs) if batched
+                else old_be.timed_invoke(inputs)
+            )
+        sw.note_ok()
+        return out
+
+    def pending_frames(self) -> int:
+        """Logical frames parked in the in-flight dispatch window
+        (drain/stop accounting, Pipeline.drain)."""
+        return sum(
+            sum(getattr(f, "batch_size", 1) for f in frames)
+            for _, frames in list(self._inflight)
+        )
+
+    def health_info(self) -> Dict[str, Any]:
+        """Model-rollout counters merged into ``Pipeline.health()``."""
+        info: Dict[str, Any] = {
+            "model": self.props["model"],
+            "model_version": 0,
+            "swaps": 0,
+            "swap_failures": 0,
+            "rollbacks": 0,
+        }
+        if self._swapper is not None:
+            info.update(self._swapper.snapshot())
+        return info
 
     # -- negotiation --------------------------------------------------------
     def _input_for_backend(self, spec: StreamSpec) -> StreamSpec:
@@ -680,6 +951,11 @@ class TensorFilter(TransformElement):
 
     def transform(self, frame: TensorFrame) -> TensorFrame:
         assert self.backend is not None, f"{self.name} not started"
+        sw = self._swapper
+        if sw is not None and sw.has_boundary_work and not self._inflight:
+            # per-frame path never parks batches, so the tick's drained
+            # results are always empty here
+            self._swap_tick()
         comb = self._in_comb
         inputs = [frame.tensors[i] for _, i in comb] if comb else list(frame.tensors)
         import time
@@ -693,17 +969,30 @@ class TensorFilter(TransformElement):
             # part of one frame's shape (and a mesh backend would
             # REPLICATE instead of shard).  invoke_batch's per-frame
             # fallback covers batchless backends.
-            outputs = self.backend.timed_invoke_batch(inputs)
+            outputs = self._backend_invoke_batch(inputs)
             self._record_stats(time.perf_counter() - t0, frame.batch_size)
         else:
-            outputs = self.backend.timed_invoke(inputs)
+            outputs = self._backend_invoke(inputs)
             self._record_stats(time.perf_counter() - t0, 1)
         return frame.with_tensors(self._compose_outputs(frame.tensors, outputs))
 
     def handle_frame_batch(
         self, pad: int, frames: List[TensorFrame]
     ) -> List[Tuple[int, TensorFrame]]:
-        """Micro-batched path: scheduler hands N frames; one invoke_batch."""
+        """Micro-batched path: scheduler hands N frames; one invoke_batch.
+        A pending hot swap applies here first — a frame boundary with the
+        in-flight window drained (the drained results are emitted ahead
+        of this batch's, preserving stream order)."""
+        sw = self._swapper
+        if sw is not None and sw.has_boundary_work:
+            pre = self._swap_tick()
+            if pre:
+                return pre + list(self._handle_batch(pad, frames) or [])
+        return self._handle_batch(pad, frames)
+
+    def _handle_batch(
+        self, pad: int, frames: List[TensorFrame]
+    ) -> List[Tuple[int, TensorFrame]]:
         assert self.backend is not None
         if any(isinstance(f, BatchFrame) for f in frames):
             # block ingest (≙ converter frames-per-tensor batching,
@@ -739,7 +1028,7 @@ class TensorFilter(TransformElement):
 
         FAULTS.check("filter.invoke", interrupt=lambda: self.interrupted)
         t0 = time.perf_counter()
-        out_b = self.backend.timed_invoke_batch(batched)
+        out_b = self._backend_invoke_batch(batched)
         self._record_stats(time.perf_counter() - t0, nlogical)
         if self.batch_through_active:
             infos = _logical_infos(frames)
@@ -874,13 +1163,19 @@ class TensorFilter(TransformElement):
 
     def handle_eos(self, pad: int) -> List[Tuple[int, TensorFrame]]:
         """Drain the in-flight window before EOS propagates."""
-        return self._drain_inflight()
+        outs = self._drain_inflight()
+        outs.extend(self._swap_tick())
+        return outs
 
     def handle_idle(self) -> List[Tuple[int, TensorFrame]]:
         """Scheduler idle hook: the input went quiet, so overlap has
         nothing left to win — release the parked batches instead of
-        withholding a live stream's tail until the next frame/EOS."""
-        return self._drain_inflight()
+        withholding a live stream's tail until the next frame/EOS.  Also
+        a natural frame boundary: a staged swap on an idle stream lands
+        here instead of waiting for the next frame."""
+        outs = self._drain_inflight()
+        outs.extend(self._swap_tick())
+        return outs
 
     # -- events -------------------------------------------------------------
     def handle_event(self, pad, ev):
@@ -894,12 +1189,31 @@ class TensorFilter(TransformElement):
         drained = self._drain_inflight()
         if isinstance(ev, CustomEvent) and ev.name == "reload-model":
             # ≙ RELOAD_MODEL framework event (tested by
-            # tests/nnstreamer_filter_reload in the reference)
+            # tests/nnstreamer_filter_reload in the reference), routed
+            # through the staged swap path (core/lifecycle.py).  A failed
+            # reload must NEVER escape into the supervision machinery —
+            # it logs, counts swap_failures, and the old model keeps
+            # serving.
             if not self.props["is-updatable"]:
                 self.log.warning("reload requested but is-updatable=false")
             elif self.backend is not None:
-                self.backend.reload(ev.data.get("model", self.props["model"]))
-                self.log.info("model reloaded from %s", ev.data.get("model"))
+                try:
+                    ticket = self.request_reload(ev.data.get("model") or "")
+                    if ticket.state == "refused":
+                        # not a swap_failure (nothing was tried), but the
+                        # operator's update was NOT applied — say so
+                        self.log.warning(
+                            "reload-model event refused (old model keeps "
+                            "serving): %s", ticket.error,
+                        )
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — reload boundary
+                    self._ensure_swapper().note_inline_failure(e)
+                    self.log.error(
+                        "reload-model event failed (old model keeps "
+                        "serving): %s", e,
+                    )
             return drained  # event swallowed; parked frames still flow
         return drained + list(super().handle_event(pad, ev) or [])
 

@@ -295,11 +295,23 @@ class GrpcSrc(SourceElement):
                 target=_reader, name=f"{self.name}-pull", daemon=True
             ).start()
         while limit < 0 or n < limit:
-            try:
-                payload = inbox.get(timeout=timeout_s)
-            except _queue.Empty:
-                self.log.info("grpc src timeout; ending stream")
-                return
+            # bounded wait slices: stop/drain must end the stream without
+            # holding the worker for the whole sub-timeout
+            deadline = time.monotonic() + timeout_s
+            payload = None
+            while payload is None:
+                from ..core.lifecycle import pipeline_quiescing
+
+                if pipeline_quiescing(self):
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.log.info("grpc src timeout; ending stream")
+                    return
+                try:
+                    payload = inbox.get(timeout=min(0.25, remaining))
+                except _queue.Empty:
+                    continue
             frame = self._decode(payload)
             if frame is not None:
                 n += 1

@@ -273,7 +273,9 @@ class MqttSrc(SourceElement):
             deadline = time.monotonic() + timeout_s
             payload = None
             while payload is None:
-                if self._stopping.is_set():
+                from ..core.lifecycle import pipeline_quiescing
+
+                if self._stopping.is_set() or pipeline_quiescing(self):
                     return
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

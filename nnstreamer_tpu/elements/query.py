@@ -25,6 +25,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Deque, Iterator, List, Optional, Tuple
 
 from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
+from ..core.lifecycle import ServerGoawayError
 from ..core.liveness import (
     DEADLINE_META,
     AdmissionController,
@@ -62,6 +63,11 @@ class TensorQueryServerSrc(SourceElement):
     #: processing overlap — fusing them would serialize the two and make
     #: max-inflight unreachable
     FUSE_DOWNSTREAM = False
+    #: pipeline drain (core/lifecycle.py): this source runs its own
+    #: serving -> draining -> stopped state machine inside frames() —
+    #: the scheduler must NOT cut the pull loop at the drain flag, or
+    #: requests admitted before the drain would never reach the pipeline
+    OWNS_DRAIN = True
 
     PROPERTIES = {
         "port": Property(int, 0, "listen port (0 = ephemeral)"),
@@ -110,15 +116,37 @@ class TensorQueryServerSrc(SourceElement):
             int, 2, "max wire version this server speaks: 2 = "
             "checksummed envelopes + per-connection negotiation with v1 "
             "clients; 1 = pin legacy checksum-free framing"),
+        # rolling restart (core/lifecycle.py): serving -> draining ->
+        # stopped.  Draining refuses NEW requests with GOAWAY ('G' raw
+        # TCP / UNAVAILABLE+goaway gRPC — immediate resend-safe client
+        # failover, never a breaker trip), finishes in-flight work, then
+        # closes the listeners and ends the server pipeline's stream.
+        "drain-deadline": Property(
+            float, 10.0, "max seconds a drain waits for in-flight "
+            "requests to finish before closing the listeners anyway"),
     }
 
     def __init__(self, name=None):
         super().__init__(name)
         self._core = None
         self._announcement = None
+        self._drain_requested = threading.Event()
+        self._lc_state = "serving"  # serving | draining | stopped
+
+    def request_drain(self) -> None:
+        """Begin the rolling-restart drain of THIS server: GOAWAY to new
+        requests, finish in-flight ones (bounded by ``drain-deadline``),
+        close listeners, end the stream.  ``Pipeline.drain()`` triggers
+        the same path for the whole server pipeline."""
+        self._drain_requested.set()
 
     def start(self):
+        self._drain_requested.clear()
+        self._lc_state = "serving"
         self._core = get_query_server(self.props["id"], self.props["port"])
+        # a restart after a drain must serve again (re-opens listeners
+        # below; the registry core survives while the sink holds a ref)
+        self._core.draining = False
         if self.props["caps"]:
             self._core.caps = self.props["caps"]
         self._core.block_ingress = bool(self.props["block-ingress"])
@@ -195,17 +223,56 @@ class TensorQueryServerSrc(SourceElement):
 
     def health_info(self) -> dict:
         """Admission/load-shed counters merged into Pipeline.health()."""
-        if self._core is None:
-            return {}
-        return self._core.liveness_snapshot()
+        info = {"lifecycle": self._lc_state}
+        if self._core is not None:
+            info.update(self._core.liveness_snapshot())
+        return info
 
     def frames(self) -> Iterator[TensorFrame]:
+        """Request pump with the rolling-restart state machine:
+
+        ``serving``: pull admitted requests off the ingress queue.
+        ``draining`` (entered via :meth:`request_drain` or a pipeline
+        ``drain()``): the core refuses NEW requests with GOAWAY while
+        frames already admitted keep flowing through the server
+        pipeline; once nothing is in flight (or ``drain-deadline``
+        expires) the listeners close and the stream ends — EOS then
+        flushes the server pipeline through the serversink.
+        ``stopped``: listeners closed; the generator has returned."""
+        import time as _time
+
+        core = self._core
+        drain_deadline = None
         while True:
+            p = self._pipeline
+            if self._lc_state == "serving" and (
+                    self._drain_requested.is_set()
+                    or (p is not None and p.draining)):
+                self._lc_state = "draining"
+                core.begin_drain()
+                drain_deadline = _time.monotonic() + max(
+                    0.0, float(self.props["drain-deadline"]))
             try:
-                client_id, frame = self._core.ingress.get(timeout=0.1)
+                client_id, frame = core.ingress.get(timeout=0.05)
             except _queue.Empty:
-                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                if p is not None and p._stop_flag.is_set():
                     return
+                if self._lc_state == "draining":
+                    done = core.drain_complete
+                    if done or _time.monotonic() >= drain_deadline:
+                        if not done:
+                            self.log.warning(
+                                "drain-deadline expired with %d request(s) "
+                                "still in flight; closing listeners",
+                                core.admission.inflight,
+                            )
+                        core.close_listeners()
+                        self._lc_state = "stopped"
+                        self.log.info(
+                            "query server drained and stopped accepting "
+                            "(goaway_sent=%d)", core.goaway_sent,
+                        )
+                        return
                 continue
             # client_id meta was attached by the Invoke handler; just emit
             yield frame
@@ -407,6 +474,7 @@ class TensorQueryClient(Element):
         self._degraded = 0  # frames answered by degrade= instead of a server
         self._evicted_breaker_trips = 0  # trips of breakers evicted on swaps
         self._busy_replies = 0  # BUSY sheds seen (admission backpressure)
+        self._goaway_replies = 0  # GOAWAY refusals (rolling restarts)
         self._deadline_expired = 0  # requests abandoned: budget ran out
         # data-plane integrity accounting (all under _breakers_lock —
         # pool workers race them): exact delivered/retried/corruption
@@ -659,6 +727,7 @@ class TensorQueryClient(Element):
             "breaker_trips_evicted": self._evicted_breaker_trips,
             "degraded_frames": self._degraded,
             "busy_replies": self._busy_replies,
+            "goaway_replies": self._goaway_replies,
             "deadline_expired": self._deadline_expired,
             "corruption_detected": self._corruption_detected,
             "delivered": self._delivered,
@@ -840,6 +909,10 @@ class TensorQueryClient(Element):
         with self._breakers_lock:  # pool workers race this counter
             self._busy_replies += 1
 
+    def _note_goaway(self) -> None:
+        with self._breakers_lock:
+            self._goaway_replies += 1
+
     def _note_corruption(self) -> None:
         with self._breakers_lock:
             self._corruption_detected += 1
@@ -908,6 +981,7 @@ class TensorQueryClient(Element):
         k = 0
         busy_used = 0
         corrupt_used = 0
+        goaway_used = 0
         expired_terminal = False
         while k < attempts:
             if self._stopped:
@@ -956,6 +1030,38 @@ class TensorQueryClient(Element):
                 self._note_delivered(
                     len(frame) if isinstance(frame, list) else 1)
                 return result
+            except ServerGoawayError as e:
+                # rolling restart: the host is draining.  The request
+                # provably never executed (refused before ingest), the
+                # reply is health (record_success — a planned restart
+                # must never trip a breaker), and the failover is
+                # IMMEDIATE: no pacing is owed to a host that asked us
+                # to leave.  One free rotation per remote, then GOAWAYs
+                # consume attempts (every host draining at once must not
+                # spin).
+                err = e
+                self._note_goaway()
+                if breaker is not None:
+                    breaker.record_success()
+                # deprioritize the draining host for subsequent frames
+                # (healthy-first ordering; it still gets re-tried once
+                # the cooldown lapses — i.e. after its restart)
+                ps.down_until[i] = time.monotonic() + min(
+                    float(timeout), 5.0)
+                self.log.debug(
+                    "server %s is draining (goaway); failing over",
+                    conn.addr,
+                )
+                if goaway_used < len(order) and not self._stopped:
+                    goaway_used += 1
+                    self._note_retried()
+                    continue  # immediate, unpaced failover
+                k += 1
+                if k < attempts and not self._stopped:
+                    self._note_retried()
+                    delay = retry_policy.delay_for(k)
+                    if delay > 0:
+                        time.sleep(delay)
             except ServerBusyError as e:
                 err = e
                 self._note_busy()
@@ -1043,10 +1149,11 @@ class TensorQueryClient(Element):
         safe_to_resend = (
             self.props["retries"] > 0
             or self._provably_unsent(err)
-            # breaker-open / admission-shed never reached the pipeline;
-            # detected corruption is resend-safe by the integrity
-            # contract (corrupt-retries prop doc)
-            or isinstance(err, (CircuitOpenError, ServerBusyError, WireError))
+            # breaker-open / admission-shed / goaway never reached the
+            # pipeline; detected corruption is resend-safe by the
+            # integrity contract (corrupt-retries prop doc)
+            or isinstance(err, (CircuitOpenError, ServerBusyError,
+                                ServerGoawayError, WireError))
         )
         if not rediscovered and self._rediscover(ps) and safe_to_resend:
             return self._invoke_failover(frame, first, rediscovered=True)
@@ -1147,6 +1254,7 @@ class TensorQueryClient(Element):
         tried = 0
         busy_budget = max(0, int(self.props["busy-retries"]))
         busy_used = 0
+        goaway_used = 0
         expired_terminal = False
         deadline_ts = frame.meta.get(DEADLINE_META)
         cursor = 0
@@ -1189,6 +1297,29 @@ class TensorQueryClient(Element):
                     breaker.record_success()
                 self._note_delivered(1)
                 return
+            except ServerGoawayError as e:
+                # rolling restart: only ever raised BEFORE the first
+                # answer (refused pre-ingest) — immediate unpaced
+                # failover, breaker-immune, one refunded attempt per
+                # remote (all-hosts-draining must not spin)
+                err = e
+                self._note_goaway()
+                if breaker is not None:
+                    breaker.record_success()
+                ps.down_until[i] = _time.monotonic() + min(
+                    float(timeout), 5.0)
+                if goaway_used < len(order) and not self._stopped:
+                    goaway_used += 1
+                    tried -= 1
+                elif tried < attempts and not self._stopped:
+                    # free-rotation budget exhausted (every host draining
+                    # at once): consumed attempts stay PACED like the
+                    # unary path — never burn the whole budget in a
+                    # microsecond spin
+                    delay = self._retry_policy.delay_for(tried)
+                    if delay > 0:
+                        _time.sleep(delay)
+                continue
             except ServerBusyError as e:
                 # admission shed: only ever raised BEFORE the first
                 # answer; backpressure, never a breaker/health event
@@ -1244,10 +1375,10 @@ class TensorQueryClient(Element):
             safe = (
                 self.props["retries"] > 0
                 or self._provably_unsent(err)
-                # breaker-open / admission-shed: never reached the
-                # pipeline; detected corruption is resend-safe
+                # breaker-open / admission-shed / goaway: never reached
+                # the pipeline; detected corruption is resend-safe
                 or isinstance(err, (CircuitOpenError, ServerBusyError,
-                                    WireError))
+                                    ServerGoawayError, WireError))
             )
             if self._rediscover(ps) and safe:
                 yield from self._stream_invoke(frame, rediscovered=True)
@@ -1306,10 +1437,20 @@ class TensorQueryClient(Element):
                 return frame_or_batch
             return [] if isinstance(frame_or_batch, list) else None
 
+    def pending_frames(self) -> int:
+        """Logical frames whose answers have not been emitted yet
+        (drain/stop accounting, ``Pipeline._count_abandoned``)."""
+        return sum(
+            getattr(f, "_nns_logical", 1) for f in list(self._inflight)
+        )
+
     def _dispatch(self, frame_or_batch):
         first = self._rr % max(1, len(self._pstate.conns))
         self._rr += 1
         fut = self._pool.submit(self._invoke_or_degrade, frame_or_batch, first)
+        fut._nns_logical = (
+            len(frame_or_batch) if isinstance(frame_or_batch, list) else 1
+        )
         fut.add_done_callback(self._notify_done)
         self._inflight.append(fut)
         # backpressure: block on the oldest request once the in-flight window

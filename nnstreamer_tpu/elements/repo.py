@@ -77,7 +77,9 @@ class TensorRepoSrc(SourceElement):
             try:
                 item = slot.q.get(timeout=0.1)
             except _queue.Empty:
-                if self._pipeline is not None and self._pipeline._stop_flag.is_set():
+                from ..core.lifecycle import pipeline_quiescing
+
+                if pipeline_quiescing(self):
                     return
                 if slot.eos.is_set():
                     return

@@ -200,6 +200,7 @@ class _ElemState:
     __slots__ = (
         "el", "connected", "eos_pads", "caps_pads", "finished",
         "next_state", "next_pad", "out_pad", "watch",
+        "terminal", "delivered", "in_call",
     )
 
     def __init__(self, el: Element):
@@ -208,6 +209,15 @@ class _ElemState:
         self.eos_pads: set = set()
         self.caps_pads: set = set()
         self.finished = False
+        # drain accounting: terminal elements (stream endpoints) count
+        # the logical frames they consume — one int add per frame, only
+        # at endpoints, single-writer per streaming thread (summed by
+        # Pipeline.delivered_frames)
+        self.terminal = False
+        self.delivered = 0
+        # logical frames consumed from a queue but not yet fully routed
+        # (exact dropped accounting for a halt that lands mid-call)
+        self.in_call = 0
         # in-segment routing: the fused downstream element (None = outputs
         # leave through mailboxes), the src pad carrying that link, and the
         # downstream sink pad it lands on
@@ -225,11 +235,15 @@ class _Seg:
     GStreamer semantics: elements share a streaming thread unless an
     explicit ``queue`` boundary is inserted."""
 
-    __slots__ = ("chain", "states")
+    __slots__ = ("chain", "states", "stash")
 
     def __init__(self, chain: List[Element]):
         self.chain = chain
         self.states: Dict[str, _ElemState] = {}
+        # items popped from the head mailbox but not yet processed (bulk
+        # pops past a batch boundary); lives on the segment so halt-time
+        # accounting (_count_abandoned) can see it
+        self.stash: deque = deque()
 
 
 def _env_fuse() -> bool:
@@ -252,6 +266,10 @@ class Pipeline:
         self.default_queue_size = default_queue_size
         self._threads: List[threading.Thread] = []
         self._stop_flag = threading.Event()
+        # graceful drain (core/lifecycle.py "Zero-downtime operations"):
+        # set by drain() — sources stop producing and flush EOS so every
+        # in-flight frame reaches the sinks before teardown
+        self._drain_flag = threading.Event()
         self._started = False
         self.errors: List[BaseException] = []
         self._bus: "queue.Queue[BusMessage]" = queue.Queue()
@@ -516,6 +534,9 @@ class Pipeline:
                     for d, pad in sp.links
                     if d is e
                 } or {0}
+                st.terminal = not isinstance(e, SourceElement) and not any(
+                    p.is_linked for p in e.srcpads
+                )
                 seg.states[e.name] = st
                 self._seg_of[e.name] = seg
             # in-segment routing links
@@ -609,6 +630,7 @@ class Pipeline:
             for el in self.elements.values()
         }
         self._stop_flag.clear()
+        self._drain_flag.clear()
         # upstream adjacency for deadline-QoS feedback (a downstream
         # deadline drop throttles every upstream tensor_rate, ≙ the
         # reference's QoS events travelling upstream)
@@ -722,21 +744,42 @@ class Pipeline:
             self.log.exception("native mailbox unavailable; using queue.Queue")
         return queue.Queue(maxsize=size)
 
-    def stop(self) -> None:
+    def _halt_workers(self) -> None:
+        """Immediate worker shutdown: stop flag + mailbox sentinels +
+        join.  Frames still queued are abandoned (count them with
+        ``_count_abandoned`` before element state is torn down)."""
         self._stop_flag.set()
+        self._halt_discarded = 0
         for el in self.elements.values():
             if el._mailbox is not None:
                 try:
                     el._mailbox.put_nowait((0, _STOP))
                 except queue.Full:
-                    # drain one slot so the sentinel fits
+                    # drain one slot so the sentinel fits — the evicted
+                    # frame is abandoned too, so count it for
+                    # _count_abandoned's exact-dropped contract
                     try:
-                        el._mailbox.get_nowait()
+                        _, item = el._mailbox.get_nowait()
+                        if isinstance(item, TensorFrame):
+                            self._halt_discarded += getattr(
+                                item, "batch_size", 1)
                         el._mailbox.put_nowait((0, _STOP))
                     except (queue.Empty, queue.Full):
                         pass
         for t in self._threads:
             t.join(timeout=5.0)
+
+    def stop(self, drain: bool = False,
+             drain_timeout: Optional[float] = None) -> None:
+        """Tear the pipeline down.  ``drain=True`` first flushes every
+        in-flight frame to the sinks via :meth:`drain` (bounded by
+        ``drain_timeout``) — planned shutdowns lose nothing; the default
+        remains the immediate teardown (queued frames are abandoned)."""
+        if drain and self._started and not self._stop_flag.is_set():
+            self.drain(drain_timeout)
+            if not self._started:
+                return  # an expired drain already tore the pipeline down
+        self._halt_workers()
         if self._wd_thread is not None:
             if self._wd_thread.is_alive():
                 self._wd_thread.join(timeout=2.0)
@@ -772,6 +815,112 @@ class Pipeline:
                 # an error that raced the timeout is the truer cause
                 raise self.errors[0]
             raise TimeoutError(f"pipeline {self.name!r} did not finish in {timeout}s")
+
+    # -- zero-downtime operations (core/lifecycle.py) ------------------------
+    @property
+    def draining(self) -> bool:
+        """True between ``drain()`` and completion/teardown — sources
+        (including ones blocking inside ``frames()``, via
+        ``lifecycle.pipeline_quiescing``) stop producing and flush EOS."""
+        return self._drain_flag.is_set()
+
+    def delivered_frames(self) -> int:
+        """Logical frames consumed by terminal elements since start()
+        (single-writer per-streaming-thread counters, summed here)."""
+        return sum(
+            st.delivered
+            for seg in self._segments
+            for st in seg.states.values()
+        )
+
+    def _count_abandoned(self) -> int:
+        """Exact count of logical frames abandoned by an immediate halt:
+        everything still queued in mailboxes plus whatever elements
+        report as parked in-flight (``pending_frames`` hook, e.g. the
+        filter's dispatch window).  Call after ``_halt_workers`` and
+        before element ``stop()`` clears that state."""
+        n = getattr(self, "_halt_discarded", 0)
+        for el in self.elements.values():
+            box = el._mailbox
+            if box is not None:
+                try:
+                    while True:
+                        _, item = box.get_nowait()
+                        if isinstance(item, TensorFrame):
+                            n += getattr(item, "batch_size", 1)
+                except queue.Empty:
+                    pass
+            pending = getattr(el, "pending_frames", None)
+            if pending is not None:
+                try:
+                    n += int(pending() or 0)
+                except Exception:
+                    self.log.exception(
+                        "pending_frames failed for %s", el.name)
+        for seg in self._segments:
+            for st in seg.states.values():
+                n += st.in_call  # halted mid-call: the frame never left
+            for _, item in seg.stash:
+                if isinstance(item, TensorFrame):
+                    n += getattr(item, "batch_size", 1)
+        return n
+
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful drain: quiesce every source, flush all in-flight
+        frames through to the sinks via the existing EOS machinery, and
+        return exact accounting::
+
+            {"drained": <frames delivered to terminal elements since the
+                         drain began>,
+             "dropped": <frames abandoned because the deadline expired —
+                         the pipeline is torn down in that case>,
+             "elapsed": <seconds>}
+
+        Semantics are identical fused and unfused (the counters live at
+        the terminal dispatch, which both modes share).  A completed
+        drain leaves the pipeline stopped-at-EOS but not torn down —
+        call ``stop()`` (or use ``stop(drain=True)``) to release
+        resources."""
+        t0 = time.monotonic()
+        if not self._started:
+            return {"drained": 0, "dropped": 0, "elapsed": 0.0}
+        base = self.delivered_frames()
+        self.log.info(
+            "draining pipeline%s",
+            f" (deadline {timeout}s)" if timeout else "",
+        )
+        self._drain_flag.set()
+        finished = self._sinks_done.wait(timeout)
+        dropped = 0
+        if not finished:
+            # deadline expired: halt NOW and account every frame that
+            # did not make it out
+            self._halt_workers()
+            dropped = self._count_abandoned()
+        drained = self.delivered_frames() - base
+        elapsed = time.monotonic() - t0
+        self.post(BusMessage("element", self.name, {
+            "drain": {
+                "drained": drained, "dropped": dropped,
+                "elapsed": elapsed, "completed": finished,
+            },
+        }))
+        if not finished:
+            self.stop()  # finish the teardown (workers already joined)
+        return {"drained": drained, "dropped": dropped, "elapsed": elapsed}
+
+    def reload_model(self, element, model: str = ""):
+        """Zero-downtime model rollout: stage, validate, and JIT-warm
+        ``model`` on a second backend instance off the hot path, then
+        hot-swap the named ``tensor_filter`` at a frame boundary (see
+        ``core/lifecycle.py``; swap/rollback counters surface in
+        :meth:`health`).  Returns the :class:`~..core.lifecycle.SwapTicket`."""
+        el = self.elements[element] if isinstance(element, str) else element
+        request = getattr(el, "request_reload", None)
+        if request is None:
+            raise ElementError(
+                f"{el.name} does not support hot model reload")
+        return request(model)
 
     # -- supervision ---------------------------------------------------------
     def health(self) -> Dict[str, Dict[str, Any]]:
@@ -1142,11 +1291,14 @@ class Pipeline:
                 return False
         return True
 
-    def _put_many(self, dst: Element, items: list) -> bool:
+    def _put_many(self, dst: Element, items: list) -> int:
         """Deliver an ordered run of ``(pad, item)`` entries into ``dst``'s
         mailbox, amortizing the lock/condvar cost over the run when the
         mailbox supports bulk insertion (block handoff); falls back to the
-        per-item blocking path otherwise.  False when stopping."""
+        per-item blocking path otherwise.  Returns the number of entries
+        delivered — short of ``len(items)`` only when stopping (the halt
+        accounting needs the exact split: delivered entries are counted
+        in the mailbox sweep, the rest stay on the emitter)."""
         box = dst._mailbox
         put_many = getattr(box, "put_many", None)
         idx, n_items = 0, len(items)
@@ -1155,7 +1307,7 @@ class Pipeline:
                 n = put_many(items[idx:] if idx else items, timeout=0.1)
                 idx += n
                 if idx >= n_items:
-                    return True
+                    return idx
                 if n > 0:
                     continue  # partial progress: retry the remainder
             # blocked (or no bulk support): bounded-wait single put so the
@@ -1168,20 +1320,32 @@ class Pipeline:
                 except queue.Full:
                     continue
             else:
-                return False
+                return idx
             idx += 1
-        return True
+        return idx
 
-    def _push_outs(self, el: Element, outs) -> bool:
+    def _push_outs(self, el: Element, outs, st: "_ElemState" = None) -> bool:
         """Deliver a call's outputs through mailboxes.  Consecutive items
         bound for the same destination travel as ONE queue operation, so
         the lock/wakeup cost amortizes over the run (a micro-batching
-        filter emitting N per-frame outputs pays ~1 handoff, not N)."""
+        filter emitting N per-frame outputs pays ~1 handoff, not N).
+
+        With ``st``, ``st.in_call`` is decremented as frames land in a
+        mailbox (where the halt-time sweep takes over counting them) —
+        per delivered entry on the common single-destination shape, in
+        one step on full success for fan-outs (a frame delivered to one
+        of two branches has no exact owner; the all-or-nothing fallback
+        at worst overcounts that stop-race edge)."""
         if not outs:
             return True
         if len(outs) == 1:
             sp, out = outs[0]
-            return self._push(el, sp, out)
+            if not self._push(el, sp, out):
+                return False
+            if st is not None and isinstance(out, TensorFrame):
+                st.in_call = max(
+                    0, st.in_call - getattr(out, "batch_size", 1))
+            return True
         runs: list = []  # [(dst, [(pad, item), ...])], order kept per dst
         index: Dict[str, int] = {}
         for sp, out in outs:
@@ -1192,9 +1356,18 @@ class Pipeline:
                     runs.append((dst, [(sink_pad, out)]))
                 else:
                     runs[k][1].append((sink_pad, out))
+        track_each = st is not None and len(runs) == 1
         for dst, items in runs:
-            if not self._put_many(dst, items):
+            n = self._put_many(dst, items)
+            if track_each:
+                for _, item in items[:n]:
+                    if isinstance(item, TensorFrame):
+                        st.in_call = max(
+                            0, st.in_call - getattr(item, "batch_size", 1))
+            if n < len(items):
                 return False
+        if st is not None and not track_each:
+            st.in_call = max(0, st.in_call - self._outs_logical(outs))
         return True
 
     def _route_one(self, seg: _Seg, st: _ElemState, sp: int, item) -> bool:
@@ -1208,24 +1381,52 @@ class Pipeline:
             return True  # unlinked src pad: dropped (parity with _push)
         return self._push(st.el, sp, item)
 
+    @staticmethod
+    def _outs_logical(outs) -> int:
+        """Logical frames in a materialized outs list/tuple (0 for lazy
+        iterables, which produce frames on demand).  Drain accounting:
+        once a handler returns, its INPUT frames are gone (emitted as
+        these outs, parked behind a ``pending_frames`` hook, or consumed)
+        — ``st.in_call`` transfers to this count so a halt mid-route
+        never double-counts parked frames yet still sees unrouted
+        outputs."""
+        if not isinstance(outs, (list, tuple)):
+            return 0
+        n = 0
+        for _, out in outs:
+            if isinstance(out, TensorFrame):
+                n += getattr(out, "batch_size", 1)
+        return n
+
     def _route_outs(self, seg: _Seg, st: _ElemState, outs) -> bool:
         """Route a call's outputs (list, tuple, or lazy iterable).  Lists
         are consumed destructively so frame carcasses can return to the
         pool the moment downstream is done with them; lazy iterables (the
-        query client's stream mode) are forwarded as they are produced."""
+        query client's stream mode) are forwarded as they are produced.
+        ``st.in_call`` is decremented as each frame is handed off
+        (mailbox put or inline dispatch — where the downstream element's
+        own accounting takes over), keeping halt-time abandoned counts
+        exact."""
         nxt = st.next_state
         if nxt is None:
             if isinstance(outs, (list, tuple)):
-                return self._push_outs(st.el, outs)
+                return self._push_outs(st.el, outs, st)
             for sp, out in outs:  # lazy stream: emit answers as they land
                 if not self._push(st.el, sp, out):
                     return False
             return True
         out_pad, next_pad = st.out_pad, st.next_pad
-        if isinstance(outs, list):
+        if isinstance(outs, (list, tuple)):
+            is_list = isinstance(outs, list)
             for k in range(len(outs)):
                 sp, out = outs[k]
-                outs[k] = None  # drop the list's ref so recycle can reclaim
+                if is_list:
+                    outs[k] = None  # drop the ref so recycle can reclaim
+                if isinstance(out, TensorFrame):
+                    # handed off: the fused downstream call (or its drop
+                    # on an unlinked pad) owns the frame from here
+                    st.in_call = max(
+                        0, st.in_call - getattr(out, "batch_size", 1))
                 if sp == out_pad:
                     if not self._dispatch(seg, nxt, next_pad, out):
                         return False
@@ -1357,6 +1558,7 @@ class Pipeline:
                 frame.meta.get(META_SRC_TS) if tracer is not None else None
             )
             lfs = self._expire_late(el, frame.split())
+            st.in_call = len(lfs)
             for k in range(len(lfs)):
                 lf = lfs[k]
                 lfs[k] = None  # release the list's ref for the pool
@@ -1370,8 +1572,15 @@ class Pipeline:
                     )
                     if outs is self._SUPERVISED_STOPPING:
                         return False
+                if st.terminal:
+                    st.delivered += 1
+                # this input frame is consumed: what remains at risk is
+                # the unprocessed tail plus this call's unrouted outputs
+                remaining = len(lfs) - k - 1
+                st.in_call = remaining + self._outs_logical(outs)
                 if not self._route_outs(seg, st, outs):
                     return False
+                st.in_call = remaining
                 FRAME_POOL.recycle(lf)
             if tracer is not None:
                 tracer.frame_out(
@@ -1380,6 +1589,7 @@ class Pipeline:
             return True
         if not self._expire_late(el, (frame,)):
             return True  # deadline passed: accounted drop (caller recycles)
+        st.in_call = getattr(frame, "batch_size", 1)
         t_in = time.perf_counter() if tracer is not None else 0.0
         if self._fast_path(el, st.watch):
             outs = el.handle_frame(pad, frame) or []
@@ -1392,6 +1602,8 @@ class Pipeline:
             )
             if outs is self._SUPERVISED_STOPPING:
                 return False
+        if st.terminal:
+            st.delivered += getattr(frame, "batch_size", 1)
         if tracer is not None:
             tracer.frame_out(
                 el.name, t_in, time.perf_counter(),
@@ -1399,6 +1611,10 @@ class Pipeline:
                 frame_nbytes(frame),
                 frame.meta.get(META_SRC_TS),
             )
+        # input consumed (emitted / parked behind pending_frames /
+        # delivered): transfer in_call to the unrouted outputs, which
+        # _route_outs decrements as each is handed off
+        st.in_call = self._outs_logical(outs)
         return self._route_outs(seg, st, outs)
 
     def _run_segment(self, seg: _Seg) -> None:
@@ -1438,7 +1654,22 @@ class Pipeline:
             # downstream element's work) is healthy, not a stall.
             wd, watch = self._watchdog, self._watches.get(el.name)
             frames_it = iter(el.frames())
+            owns_drain = getattr(el, "OWNS_DRAIN", False)
+            src_pending = getattr(el, "pending_frames", None)
             while True:
+                if self._drain_flag.is_set() and not owns_drain and (
+                        src_pending is None or src_pending() <= 0):
+                    # graceful drain: stop pulling and fall through to
+                    # the EOS routing below, flushing everything already
+                    # in flight through to the sinks.  A source holding
+                    # buffered input (appsrc) reports it via
+                    # pending_frames and keeps getting pulled until that
+                    # is flushed too; sources that wait INSIDE frames()
+                    # additionally poll lifecycle.pipeline_quiescing;
+                    # sources with their own drain state machine
+                    # (serversrc) opt out via OWNS_DRAIN and end their
+                    # stream themselves.
+                    break
                 if el._interrupted.is_set():
                     # stale interrupt from an escalation whose pull
                     # completed anyway: consume it (see _supervised)
@@ -1554,8 +1785,10 @@ class Pipeline:
         wait_s = getattr(el, "batch_wait_s", 0.0)
         stop_flag = self._stop_flag
         # items popped from the mailbox but not yet processed (bulk pops
-        # can pull events/other-pad items past a batch boundary)
-        stash: deque = deque()
+        # can pull events/other-pad items past a batch boundary); lives
+        # on the segment so halt-time accounting can count it
+        stash = seg.stash
+        stash.clear()
         while not stop_flag.is_set():
             if stash:
                 pad, item = stash.popleft()
@@ -1659,6 +1892,8 @@ class Pipeline:
                 frames = self._expire_late(el, frames)
                 if not frames:
                     continue  # whole micro-batch expired
+                st.in_call = sum(
+                    getattr(f, "batch_size", 1) for f in frames)
                 t_in = time.perf_counter() if tracer is not None else 0.0
                 outs = self._supervised(
                     el,
@@ -1670,6 +1905,9 @@ class Pipeline:
                 )
                 if outs is self._SUPERVISED_STOPPING:
                     return
+                if st.terminal:
+                    st.delivered += sum(
+                        getattr(f, "batch_size", 1) for f in frames)
                 if tracer is not None:
                     tracer.frame_out(
                         el.name, t_in, time.perf_counter(),
@@ -1677,8 +1915,14 @@ class Pipeline:
                         sum(frame_nbytes(f) for f in frames),
                         frames[0].meta.get(META_SRC_TS),
                     )
+                # inputs consumed (emitted / parked behind the element's
+                # pending_frames hook / delivered): in_call transfers to
+                # the unrouted outputs so a halt mid-route never
+                # double-counts the filter's parked dispatch window
+                st.in_call = self._outs_logical(outs)
                 if not self._route_outs(seg, st, outs):
                     return
+                st.in_call = 0
             else:
                 if not self._dispatch(seg, st, pad, item):
                     return

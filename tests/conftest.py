@@ -24,6 +24,9 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -31,3 +34,69 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# Leak guard (zero-downtime operations contract): drain/swap/rolling-restart
+# must not strand worker threads or sockets.  The lifecycle/e2e test modules
+# autouse this module-scoped fixture, so the check runs inside tier-1
+# alongside the lint gates.
+# ---------------------------------------------------------------------------
+#: thread-name prefixes outside our control (library pools, pytest
+#: internals).  Framework threads are all explicitly named (segment
+#: workers by element, "-watchdog", "tcpq-*", "-model-stage", pumps), so
+#: anonymous "Thread-N" / executor workers are not our leak signal.
+_LEAK_IGNORE = (
+    "MainThread", "Thread-", "ThreadPool", "Dummy", "asyncio",
+    "pydevd", "raylet",
+)
+
+
+def _live_framework_threads() -> set:
+    return {
+        t.name for t in threading.enumerate()
+        if t.is_alive() and not t.name.startswith(_LEAK_IGNORE)
+    }
+
+
+def _socket_fd_count() -> int:
+    """Open socket fds of this process (-1 = unsupported platform)."""
+    fd_dir = "/proc/self/fd"
+    try:
+        fds = os.listdir(fd_dir)
+    except OSError:
+        return -1
+    n = 0
+    for fd in fds:
+        try:
+            if os.readlink(os.path.join(fd_dir, fd)).startswith("socket:"):
+                n += 1
+        except OSError:
+            continue
+    return n
+
+
+@pytest.fixture(scope="module")
+def module_leak_check():
+    """Assert the module left no framework threads and no net-new socket
+    fds behind (bounded convergence wait — teardown is asynchronous)."""
+    threads_before = _live_framework_threads()
+    sockets_before = _socket_fd_count()
+    yield
+    deadline = time.monotonic() + 8.0
+    leaked_threads: set = set()
+    sockets_now = sockets_before
+    while time.monotonic() < deadline:
+        leaked_threads = _live_framework_threads() - threads_before
+        sockets_now = _socket_fd_count()
+        if not leaked_threads and (
+                sockets_before < 0 or sockets_now <= sockets_before):
+            break
+        time.sleep(0.05)
+    assert not leaked_threads, (
+        f"leaked framework threads after module: {sorted(leaked_threads)}"
+    )
+    if sockets_before >= 0:
+        assert sockets_now <= sockets_before, (
+            f"leaked sockets after module: {sockets_before} -> {sockets_now}"
+        )

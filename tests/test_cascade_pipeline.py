@@ -11,8 +11,14 @@ story (SURVEY §2.3 "model parallelism (composition)").
 """
 
 import numpy as np
+import pytest
 
 from nnstreamer_tpu.pipeline import parse_pipeline
+
+# tier-1 budget: the two-model cascade costs ~60s of XLA compile; every
+# mechanism it composes (tee, region/crop, python3 filter, decoders) has
+# its own fast test — the capstone composition runs in the slow tier
+pytestmark = pytest.mark.slow
 
 RESIZE_SCRIPT = """
 import numpy as np

@@ -124,11 +124,23 @@ class TestChaosSoak:
                     while (len(rx["out"].frames) < reload_at
                            and time.time() < deadline):
                         time.sleep(0.05)
-                    # the reload event and subsequent frames ride ONE
-                    # ordered queue — no settling sleep needed
                     tx["src"].push_event(
                         CustomEvent("reload-model", {"model": "chaos_m2"})
                     )
+                    # the reload now STAGES chaos_m2 on a second backend
+                    # (validate + JIT warmup off the hot path) and the
+                    # swap lands at the next frame boundary — barrier on
+                    # staging completing, so frame 40's invoke applies
+                    # the swap first and the value contract stays exact
+                    def _staged():
+                        h = tx.health()["f"]
+                        return (h.get("swap_state") == "staged"
+                                or h["swaps"] >= 1)
+
+                    deadline = time.time() + 15
+                    while not _staged() and time.time() < deadline:
+                        time.sleep(0.05)
+                    assert _staged(), tx.health()["f"]
                 tx["src"].push(np.full((4,), float(i), np.float32),
                                pts=float(i))
                 time.sleep(0.02)  # ~50 fps sustained

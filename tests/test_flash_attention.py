@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import _env_capabilities
+
 from nnstreamer_tpu.ops.flash_attention import flash_attention
 from nnstreamer_tpu.parallel.ring_attention import reference_attention
 
@@ -138,6 +140,11 @@ class TestFlashAttentionLse:
         )
 
 
+@pytest.mark.skipif(
+    not _env_capabilities.spmd_stack_ok(),
+    reason="jax lacks the shard_map feature set (check_vma/pvary/pallas "
+    "replication rule) the mesh ring composition needs",
+)
 class TestRingFlash:
     """ring_attention(use_flash=True): the Pallas kernel as the per-hop
     block primitive, exact across the sp ring (long-context composition)."""

@@ -6,6 +6,8 @@ Also pins hot reload between two weight files (≙ RELOAD_MODEL /
 is-updatable, double-buffered reload in the reference's tflite
 subplugin)."""
 
+import time
+
 import numpy as np
 
 from nnstreamer_tpu.core.buffer import CustomEvent
@@ -99,8 +101,22 @@ def test_hot_reload_swaps_weights(tmp_path, rng):
     )
     pipe.start()
     pipe["src"].push(x)
-    # reload event travels the stream like the reference's RELOAD_MODEL
+    # reload event travels the stream like the reference's RELOAD_MODEL;
+    # it now STAGES the new weights on a second backend instance
+    # (validate + JIT warmup off the hot path) and swaps at a frame
+    # boundary — barrier on the swap landing before the second frame
     pipe["src"].push_event(CustomEvent("reload-model", {"model": p2}))
+
+    def _staged():
+        h = pipe.health()["f"]
+        return h.get("swap_state") == "staged" or h["swaps"] >= 1
+
+    deadline = time.time() + 60
+    while not _staged() and time.time() < deadline:
+        time.sleep(0.05)
+    assert _staged(), pipe.health()["f"]
+    # the staged swap lands at the next frame boundary — i.e. before
+    # this frame's invoke, so it is served by the new weights
     pipe["src"].push(x)
     pipe["src"].end_of_stream()
     pipe.wait(timeout=60)

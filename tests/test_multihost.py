@@ -13,6 +13,16 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+import _env_capabilities
+
+pytestmark = pytest.mark.skipif(
+    not _env_capabilities.multihost_cpu_ok(),
+    reason="jax lacks jax_num_cpu_devices (per-process virtual CPU "
+    "devices) needed to build the localhost multi-process mesh",
+)
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "_multihost_worker.py")
 

@@ -66,6 +66,8 @@ class TestBatchedNMS:
         # candidates (random weights produce heavy overlap)
         assert (pred[:, 4] > 0).sum() < pred.shape[0]
 
+    @pytest.mark.slow  # tier-1 budget: ~43s compile; the in-graph NMS
+    # test keeps the fused-preprocess assertions in the fast run
     def test_mobilenet_pallas_preprocess_numerics(self):
         from nnstreamer_tpu.models import build
 

@@ -8,6 +8,15 @@ unsharded reference_loss over the same param pytree.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+import _env_capabilities
+
+pytestmark = pytest.mark.skipif(
+    not _env_capabilities.spmd_stack_ok(),
+    reason="jax lacks the shard_map feature set (check_vma/pvary) the "
+    "5-axis manual-SPMD transformer needs",
+)
 
 from nnstreamer_tpu.parallel.mesh import make_mesh
 from nnstreamer_tpu.parallel.pipeline_transformer import (

@@ -11,6 +11,8 @@ import time
 import numpy as np
 import pytest
 
+import _env_capabilities
+
 from nnstreamer_tpu.backends.custom_easy import (
     register_custom_easy,
     unregister_custom_easy,
@@ -33,6 +35,11 @@ def _run(pipeline_text, frames, name="pp"):
     return got
 
 
+@pytest.mark.skipif(
+    not _env_capabilities.has_reference_tree(),
+    reason="prop-parity audit needs the reference checkout at "
+    + _env_capabilities.REFERENCE_TREE,
+)
 def test_no_unannotated_reference_prop_gaps():
     """tools/prop_parity.py --check: every reference element property is
     either present, renamed, or has a curated covered-by annotation."""

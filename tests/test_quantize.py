@@ -75,6 +75,9 @@ def test_mobilenet_quantized_runs(rng):
     assert np.all(np.isfinite(np.asarray(out)))
 
 
+@pytest.mark.slow  # tier-1 budget: the mobilenet variants keep this
+# family's assertions in the fast run; the heavier SSD/YOLO compiles
+# run in the slow tier
 def test_ssd_quantized_shares_weights_and_tracks_float(rng):
     """int8 SSD backbone: same param tree as the float build (heads stay
     float32), finite outputs, box/score signal correlated with float."""
@@ -98,6 +101,9 @@ def test_ssd_quantized_shares_weights_and_tracks_float(rng):
     assert corr > 0.8, corr
 
 
+@pytest.mark.slow  # tier-1 budget: the mobilenet variants keep this
+# family's assertions in the fast run; the heavier SSD/YOLO compiles
+# run in the slow tier
 def test_yolov5_quantized_shares_weights_and_tracks_float(rng):
     """int8 yolov5 backbone/neck at a tiny size: weight-shared with the
     float build, finite head outputs, correlated predictions."""
