@@ -23,6 +23,15 @@ Env knobs:
   BENCH_FRAMES    measured frames (default 4096)
   BENCH_DTYPE     model dtype (default bfloat16)
   BENCH_HOST      1 = frames sourced from host memory (includes transfer)
+  BENCH_HOST_CAP  per-row seconds cap for input=host rows (default 180);
+                  an over-cap row is emitted labeled timed_out instead of
+                  eating the whole bench budget (never banked)
+  BENCH_INGEST_LANE  auto|on|off (default auto) — the filter's
+                  double-buffered host->device staging lane; a signature
+                  axis (pre-lane banked rows read as ingest_lane=off)
+  BENCH_PROXY     1 (default) = on probe failure, attach labeled
+                  proxy:true CPU micro-measures for the async-feed axes
+                  (cpu_proxy field) alongside the banked/stale row
   BENCH_RAW       1 = also measure the bare jitted model at the same
                   batch (adds raw_fps / pipeline_vs_raw to the row — the
                   framework-overhead contract: pipeline >= 0.9x raw)
@@ -61,13 +70,14 @@ ROWS_PATH = os.path.join(_HERE, "BENCH_ROWS.json")
 _SIG_KEYS = (
     "metric", "model", "batch", "dtype", "quantize", "dispatch_depth",
     "ingest", "sink_split", "input", "platform", "batch_timeout_ms",
-    "fuse",
+    "fuse", "ingest_lane",
 )
 # rows captured before an axis existed carry its then-implicit value
 # (fuse=0: pre-fusion rows measured the unfused seed dataplane, so they
-# can never stand in for a fused run)
+# can never stand in for a fused run; ingest_lane=off: pre-lane rows
+# measured serialized host->device staging)
 _SIG_DEFAULTS = {"ingest": "frame", "sink_split": True,
-                 "batch_timeout_ms": 20, "fuse": 0}
+                 "batch_timeout_ms": 20, "fuse": 0, "ingest_lane": "off"}
 
 
 def _sig(row: dict, exclude: tuple = ()) -> str:
@@ -102,10 +112,13 @@ def _normalize_cache(cache: dict) -> dict:
 
 def _bankable(row: dict) -> bool:
     """One predicate for both sides of the evidence cache: what bank_row
-    stores is exactly what lookup_banked may return."""
+    stores is exactly what lookup_banked may return.  ``timed_out`` rows
+    (host rows that hit their per-row cap) are partial evidence — emitted
+    and labeled, but never banked as a stand-in for a completed run."""
     return (
         isinstance(row, dict) and row.get("value") is not None
-        and not row.get("stale") and row.get("platform") != "cpu"
+        and not row.get("stale") and not row.get("timed_out")
+        and row.get("platform") != "cpu"
     )
 
 
@@ -260,13 +273,138 @@ def emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
-def emit_failure(metric: str, unit: str, meta: dict, err: str) -> None:
+def measure_ingest_overlap(nb: int = 14, h2d_s: float = 0.004,
+                           comp_s: float = 0.004) -> "tuple[float, float]":
+    """(t_serial, t_lane) for the host-ingest structure on equal sleep
+    costs: serialized stack+transfer-then-compute vs the double-buffered
+    staging lane (transfer overlaps the previous batch's compute).
+    Shared by the cpu_proxy evidence and the `pytest -m perf` overlap
+    floor, so the two published ratios measure the SAME harness."""
+    import numpy as np
+
+    from nnstreamer_tpu.core.feed import HostStagingLane
+
+    frames = [[np.zeros((256,), np.float32)] for _ in range(8)]
+
+    def to_dev(arrs):
+        time.sleep(h2d_s)
+        return [np.array(a) for a in arrs]
+
+    t0 = time.perf_counter()
+    for _ in range(nb):  # serialized: stack+transfer then compute
+        to_dev([np.stack([f[0] for f in frames])])
+        time.sleep(comp_s)
+    t_serial = time.perf_counter() - t0
+
+    lane = HostStagingLane(to_dev, name="overlap")
+    try:
+        t0 = time.perf_counter()
+        prev = None
+        for _ in range(nb):  # double-buffered: transfer overlaps compute
+            job = lane.submit(frames)
+            if prev is not None:
+                prev.result()
+                time.sleep(comp_s)
+            prev = job
+        prev.result()
+        time.sleep(comp_s)
+        t_lane = time.perf_counter() - t0
+    finally:
+        lane.close()
+    return t_serial, t_lane
+
+
+def cpu_proxy_measures(budget_s: float = 8.0) -> dict:
+    """Fresh, explicitly-labeled CPU-proxy evidence for the async-feed
+    axes, measured in-process in a few seconds (no accelerator, no jit):
+    used when the chip probe fails so a perf PR still lands with live
+    numbers for THIS code instead of only banked chip rows.
+
+    * ``dispatch_overlap`` — async-window pipeline throughput over the
+      fake device's own serial service rate (1.0 = the dispatch window
+      hides all framework cost; the pre-async design was bounded by
+      serial block-on-oldest, i.e. service + transfer + dispatch).
+    * ``dispatch_thread_blocking_syncs`` — times the dispatch thread
+      blocked inside a device_get-style sync (must be 0: the reaper
+      thread owns those waits).
+    * ``ingest_overlap_speedup`` — double-buffered staging lane vs
+      serialized stack+transfer+compute on the same costs.
+    * ``device_pool_reuse_rate`` — staging-buffer reuse across the run.
+    """
+    import numpy as np
+
+    from nnstreamer_tpu.core.buffer import DEVICE_POOL
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    proxy: dict = {"proxy": True, "platform": "cpu",
+                   "captured_at": _utc_iso()}
+    t_start = time.time()
+    # pool counters are process-global: snapshot so the reported reuse
+    # rate is THIS measurement's, not the process's lifetime history
+    pool_reused0, pool_alloc0 = DEVICE_POOL.reused, DEVICE_POOL.allocated
+
+    # -- dispatch window overlap (async-sim: compute 4ms single-server,
+    #    transfer 3ms on the syncing thread, dispatch 1ms) --------------
+    compute_ms, transfer_ms, dispatch_ms, mb, nbatches = 4.0, 3.0, 1.0, 8, 24
+    pipe = parse_pipeline(
+        "appsrc name=src max-buffers=512 ! tensor_filter name=f "
+        "framework=async-sim "
+        f"custom=compute_ms:{compute_ms},transfer_ms:{transfer_ms},"
+        f"dispatch_ms:{dispatch_ms} "
+        f"max-batch={mb} dispatch-depth=8 ! tensor_sink name=out "
+        "max-stored=1",
+        name="proxy",
+    )
+    pipe.start()
+    done = {"n": 0}
+    pipe["out"].connect_new_data(
+        lambda f: done.__setitem__("n", done["n"] + 1))
+    n = mb * nbatches
+    arr = np.zeros((64,), np.float32)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pipe["src"].push(arr)
+    cap = max(5.0, budget_s - (time.time() - t_start))
+    while done["n"] < n and time.perf_counter() - t0 < cap:
+        time.sleep(0.002)
+    elapsed = time.perf_counter() - t0
+    be = pipe["f"].backend
+    blocked = [
+        t for t in be.blocking_syncs if not t.endswith("-reaper")
+    ]
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=15)
+    pipe.stop()
+    # device service rate = 1000/compute_ms batches/s (single server);
+    # 1.0 means the window hid every framework cost behind compute
+    pipeline_rate = (done["n"] / mb) / elapsed if elapsed else 0.0
+    proxy["dispatch_overlap"] = round(
+        pipeline_rate / (1000.0 / compute_ms), 3)
+    proxy["dispatch_thread_blocking_syncs"] = len(blocked)
+
+    # -- host-ingest overlap: staged lane vs serialized ------------------
+    t_serial, t_lane = measure_ingest_overlap()
+    proxy["ingest_overlap_speedup"] = round(t_serial / t_lane, 2)
+    reused = DEVICE_POOL.reused - pool_reused0
+    allocated = DEVICE_POOL.allocated - pool_alloc0
+    pool_total = reused + allocated
+    proxy["device_pool_reuse_rate"] = round(
+        reused / pool_total, 3) if pool_total else None
+    proxy["elapsed_s"] = round(time.time() - t_start, 1)
+    return proxy
+
+
+def emit_failure(metric: str, unit: str, meta: dict, err: str,
+                 extra: dict = None) -> None:
     """Emit the failure row — but never a bare null when banked evidence
     for the exact same configuration exists on disk.  The stale row keeps
     the banked value/latency fields and adds `stale`/`stale_since`/
     `stale_source`/`live_error` so the driver artifact records both the
     evidence and the fact that this window's live attempt failed.
-    BENCH_NO_STALE=1 restores the bare-null behavior (debug)."""
+    ``extra`` fields (e.g. the labeled `cpu_proxy` measures) ride on the
+    emitted row either way.  BENCH_NO_STALE=1 restores the bare-null
+    behavior (debug)."""
+    extra = extra or {}
     no_stale = os.environ.get("BENCH_NO_STALE", "").lower() in (
         "1", "true", "yes",
     )
@@ -281,12 +419,12 @@ def emit_failure(metric: str, unit: str, meta: dict, err: str) -> None:
             # fills fields the banked row lacks
             emit({
                 **meta, **row, "stale": True, "stale_since": since,
-                "stale_source": source, "live_error": err,
+                "stale_source": source, "live_error": err, **extra,
             })
             return
     emit({
         "metric": metric, "value": None, "unit": unit,
-        "vs_baseline": None, "error": err, **meta,
+        "vs_baseline": None, "error": err, **meta, **extra,
     })
 
 
@@ -351,7 +489,8 @@ def quant_applied(which: str) -> bool:
 
 
 def measure_raw_fps(fn, params, pool, batch: int, n_frames: int,
-                    host_input: bool = False, cap_s: float = 20.0) -> float:
+                    host_input: bool = False, cap_s: float = 20.0,
+                    out_meta: dict = None) -> float:
     """Bare jitted-model throughput at `batch` — the ceiling the pipeline
     is judged against (shared by bench.py BENCH_RAW and
     tools/bench_overhead.py so the two published ratios can't diverge).
@@ -360,7 +499,10 @@ def measure_raw_fps(fn, params, pool, batch: int, n_frames: int,
     dispatch must be allowed to pipeline (that's the ceiling) but never
     to queue minutes of executions and their output buffers.  With
     ``host_input`` the per-iteration host->device put is INSIDE the timed
-    loop, matching what a BENCH_HOST pipeline pays."""
+    loop, matching what a BENCH_HOST pipeline pays — a slow link makes
+    this loop deadline-risky, so ``cap_s`` is a hard per-row cap and
+    ``out_meta`` (when given) records ``timed_out``/completed iterations
+    instead of letting the row eat the whole bench budget."""
     import jax
     import numpy as np
 
@@ -374,6 +516,7 @@ def measure_raw_fps(fn, params, pool, batch: int, n_frames: int,
     t0 = time.perf_counter()
     out = None
     done = 0
+    capped = False
     for i in range(n_iters):
         x = jax.device_put(host_batch) if host_input else stacked
         out = jit_fn(x)
@@ -381,8 +524,13 @@ def measure_raw_fps(fn, params, pool, batch: int, n_frames: int,
         if done % 8 == 0:
             jax.block_until_ready(out)
         if time.perf_counter() - t0 > cap_s:
+            capped = done < n_iters
             break
     jax.block_until_ready(out)
+    if out_meta is not None:
+        out_meta["timed_out"] = capped
+        out_meta["iters_done"] = done
+        out_meta["iters_wanted"] = n_iters
     return done * batch / (time.perf_counter() - t0)
 
 
@@ -486,6 +634,15 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
             f"({n_frames} < {batch})"
         )
 
+    # host rows additionally get a PER-ROW cap: frames crossing the
+    # host->device link make every phase link-speed-bound, and a wedged
+    # or slow tunnel must produce a labeled `timed_out` row instead of
+    # eating the entire bench budget (the r05 input=host failure mode:
+    # the row blew the full 480s deadline and reported nothing)
+    if host_frames:
+        host_cap = float(os.environ.get("BENCH_HOST_CAP", "180"))
+        deadline_ts = min(deadline_ts, time.time() + host_cap)
+
     from nnstreamer_tpu.backends.jax_xla import register_jax_model
     from nnstreamer_tpu.models import build
     from nnstreamer_tpu.pipeline import parse_pipeline
@@ -582,7 +739,8 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         "tensor_filter name=f framework=jax-xla model=bench_model "
         f"max-batch={batch} batch-timeout={batch_timeout_ms} "
         "latency=1 throughput=1 "
-        f"dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} ! "
+        f"dispatch-depth={os.environ.get('BENCH_DEPTH', '4')} "
+        f"ingest-lane={os.environ.get('BENCH_INGEST_LANE', 'auto')} ! "
         + decoder
         + "tensor_sink name=out max-stored=1"
         + ("" if sink_split else " split-batches=false"),
@@ -642,6 +800,18 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         time.sleep(0.01)
     if done["n"] < batch * 2:
         pipe.stop()
+        if host_frames:
+            # deadline-safe host row: the link couldn't even finish
+            # warmup inside the per-row cap — report that, labeled,
+            # instead of dying rc!=0 with the budget burned
+            return {
+                "metric": metric, "value": None, "unit": "fps",
+                "vs_baseline": None, "timed_out": True,
+                "error": (
+                    f"host ingest warmup incomplete: {done['n']}/"
+                    f"{batch * 2} frames in {warmup_cap:.0f}s"
+                ),
+            }
         raise RuntimeError(
             f"warmup incomplete: {done['n']}/{batch * 2} frames in "
             f"{warmup_cap:.0f}s"
@@ -669,6 +839,10 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         time.sleep(0.005)
     dt = time.perf_counter() - t0
     fps = done["n"] / dt
+    # a host row that ran out of its per-row cap mid-measure still
+    # reports the throughput it sustained, labeled — partial evidence
+    # beats a dead 480s window
+    row_timed_out = host_frames and done["n"] < n_frames
 
     # BASELINE.md tracks p50 per-frame latency alongside fps for the
     # detector/pose rows.  Two instruments: the filter's latency prop
@@ -679,7 +853,7 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     dispatch_latency_us = round(pipe["f"].latency_us, 1)
     lat_samples = []
     lat_deadline = time.time() + max(5.0, deadline_ts - time.time() - 10.0)
-    for i in range(13):
+    for i in range(0 if row_timed_out else 13):
         if time.time() > lat_deadline:
             break
         c0 = done["n"]
@@ -697,6 +871,10 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
     pipe.stop()
 
     extra = {"dispatch_latency_us": dispatch_latency_us}
+    if row_timed_out:
+        extra["timed_out"] = True
+        extra["frames_done"] = done["n"]
+        extra["frames_wanted"] = n_frames
     if lat_samples:
         import numpy as _np
 
@@ -730,14 +908,18 @@ def pipeline_row(which: str, batch: int, n_frames: int, dtype: str,
         # bare-model reference in the SAME window/process: the r2 verdict
         # contract is pipeline >= 0.9x raw — measure both or the ratio
         # claim is unfalsifiable
+        raw_meta = {}
         raw_fps = measure_raw_fps(
             fn, params, pool, batch,
             n_frames=min(n_frames, 4096),
             host_input=host_frames,
             cap_s=min(20.0, max(10.0, deadline_ts - time.time() - 10.0)),
+            out_meta=raw_meta,
         )
         extra["raw_fps"] = round(raw_fps, 1)
         extra["pipeline_vs_raw"] = round(fps / raw_fps, 3)
+        if raw_meta.get("timed_out"):
+            extra["raw_timed_out"] = True
 
     # the >=1000 fps/chip north-star target applies to the MobileNet
     # headline row only; the other BASELINE.md rows are "tracked" (no
@@ -839,6 +1021,21 @@ def run_child(deadline_s: float) -> tuple:
     )
 
 
+def _try_cpu_proxy() -> dict:
+    """Labeled CPU-proxy evidence attached to a failure row (the stale
+    TPU evidence stays banked, never overwritten — these measures are
+    live numbers for THIS code).  BENCH_PROXY=0 disables; failures
+    degrade to no extra fields rather than masking the real error."""
+    if os.environ.get("BENCH_PROXY", "1").lower() in ("0", "false", "no"):
+        return {}
+    try:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never probe again
+        return {"cpu_proxy": cpu_proxy_measures()}
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        sys.stderr.write(f"[bench] cpu proxy failed: {e}\n")
+        return {}
+
+
 def main() -> None:
     which = os.environ.get("BENCH_MODEL", "mobilenet")
     if which not in METRICS:
@@ -873,6 +1070,7 @@ def main() -> None:
             "BENCH_BATCH_TIMEOUT", BATCH_TIMEOUT_DEFAULT_MS
         )),
         "fuse": 1 if bench_fuse() else 0,
+        "ingest_lane": os.environ.get("BENCH_INGEST_LANE", "auto"),
         "input": "host" if host_frames else "device",
         "platform": "cpu" if force_cpu else os.environ.get(
             "JAX_PLATFORMS", "default"
@@ -891,6 +1089,7 @@ def main() -> None:
             emit_failure(
                 metric, unit, meta,
                 f"accelerator backend unavailable: {err}",
+                extra=_try_cpu_proxy(),
             )
             return
         if probed_platform:
@@ -934,7 +1133,7 @@ def main() -> None:
             })
             return
         err = f"{err}; re-probe: {recheck_err}"
-    emit_failure(metric, unit, meta, err)
+    emit_failure(metric, unit, meta, err, extra=_try_cpu_proxy())
 
 
 if __name__ == "__main__":

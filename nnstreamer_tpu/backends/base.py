@@ -78,12 +78,27 @@ class InvokeStats:
 
     total_invoke_num: int = 0
     total_invoke_latency_s: float = 0.0
+    # async-feed counters: invokes routed through the donated entry point
+    # (caller guaranteed input privacy) and invokes where buffer donation
+    # was actually applied to the compiled call (platform-dependent)
+    donated_calls: int = 0
+    donated_applied: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record(self, dt: float) -> None:
         with self._lock:
             self.total_invoke_num += 1
             self.total_invoke_latency_s += dt
+
+    # donated-path counters under the same lock as the rest — a shared
+    # backend's stats are written from several dispatch threads
+    def record_donated(self) -> None:
+        with self._lock:
+            self.donated_calls += 1
+
+    def record_donation_applied(self) -> None:
+        with self._lock:
+            self.donated_applied += 1
 
     @property
     def avg_latency_s(self) -> float:
@@ -103,6 +118,12 @@ class FilterBackend:
     """
 
     NAME = "base"
+
+    #: True when :meth:`to_device` performs a real host->device placement
+    #: (a COPY off the staging buffer) — the filter's host-ingest staging
+    #: lane only engages then.  Host-resident backends keep the default:
+    #: their "device arrays" would alias the reusable staging memory.
+    SUPPORTS_STAGING = False
 
     def __init__(self):
         self.stats = InvokeStats()
@@ -159,6 +180,27 @@ class FilterBackend:
             outs.append(self.invoke([a[b] for a in inputs]))
         return [np.stack([o[i] for o in outs]) for i in range(len(outs[0]))]
 
+    def invoke_batch_donated(self, inputs: List[Any]) -> List[Any]:
+        """Run a micro-batch whose input arrays are PRIVATE to the caller
+        and may be consumed by the backend (XLA buffer donation: the
+        executable reuses the inputs' device memory for outputs — zero
+        per-batch device allocations in steady state).  The filter routes
+        here only for batches it freshly stacked/staged itself; anything
+        that might still be referenced upstream (pre-batched blocks, tee
+        fan-out payloads) goes through :meth:`invoke_batch`.  Default:
+        plain invoke_batch (donation is an optimization, not a semantic)."""
+        return self.invoke_batch(inputs)
+
+    def to_device(self, arrays: List[Any]) -> List[Any]:
+        """Place host-staged arrays onto this backend's device — the hook
+        the filter's host-ingest staging lane calls from the LANE thread.
+        Contract (when :attr:`SUPPORTS_STAGING` is True): return only
+        after the contents of ``arrays`` are fully copied/staged, because
+        the caller reuses those buffers immediately.  The default is the
+        identity (host backends consume host arrays directly) and is why
+        the base class keeps ``SUPPORTS_STAGING = False``."""
+        return list(arrays)
+
     @property
     def supports_batch(self) -> bool:
         """True if invoke_batch is native (not the per-frame fallback)."""
@@ -178,6 +220,13 @@ class FilterBackend:
     def timed_invoke_batch(self, inputs: List[Any]) -> List[Any]:
         t0 = time.perf_counter()
         out = self.invoke_batch(inputs)
+        self.stats.record(time.perf_counter() - t0)
+        return out
+
+    def timed_invoke_batch_donated(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.perf_counter()
+        out = self.invoke_batch_donated(inputs)
+        self.stats.record_donated()
         self.stats.record(time.perf_counter() - t0)
         return out
 

@@ -8,6 +8,9 @@ so element behavior is testable without any NN framework.
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -107,5 +110,202 @@ class FrameCounter(FilterBackend):
         return [np.asarray([self._n], np.int64)]
 
 
-for _cls in (Passthrough, Scaler, Average, FrameCounter):
+class FakeDeviceArray:
+    """A numpy value masquerading as an ASYNC device buffer.
+
+    Models the accelerator contract the async feed is built against:
+    ``is_ready()`` reflects device-side completion, ``copy_to_host_async``
+    is a prefetch *hint* (over the dev tunnel it buys nothing — matching
+    the worst case), and ``__array__`` (materialization) blocks until
+    completion and then pays the transfer cost ON THE CALLING THREAD.
+    Every pre-completion blocking sync is recorded with the calling
+    thread's name, so tests can pin "the dispatch thread never sat inside
+    device_get" structurally instead of by timing.
+    """
+
+    __slots__ = ("_value", "_done", "_transfer_s", "_sim", "_host")
+
+    def __init__(self, value: np.ndarray, done: threading.Event,
+                 transfer_s: float, sim: "AsyncSim"):
+        self._value = value
+        self._done = done
+        self._transfer_s = transfer_s
+        self._sim = sim
+        self._host: Optional[np.ndarray] = None  # transfer paid once
+
+    @property
+    def shape(self):
+        return self._value.shape
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def is_ready(self) -> bool:
+        return self._done.is_set()
+
+    def copy_to_host_async(self) -> None:
+        self._sim.copy_hints += 1  # hint only; no overlap (tunnel-real)
+
+    def _materialize(self) -> np.ndarray:
+        if self._host is None:
+            if not self._done.is_set():
+                self._sim.note_blocking_sync()
+                self._done.wait()
+            if self._transfer_s > 0:
+                time.sleep(self._transfer_s)  # transfer occupies the caller
+            self._host = self._value
+        return self._host
+
+    def __array__(self, dtype=None, copy=None):
+        host = self._materialize()
+        return host if dtype is None else host.astype(dtype, copy=False)
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    def __len__(self) -> int:
+        return len(self._value)
+
+
+class AsyncSim(FilterBackend):
+    """Deterministic async-device simulator: affine ``y = 2x + 1`` with a
+    single-server device worker (one batch in service at a time) and
+    tunable costs, for CPU-proxy evidence of the async feed's structure.
+
+    Custom props (milliseconds unless noted):
+
+    * ``compute_ms``  — device service time per batch (single server).
+    * ``transfer_ms`` — device->host materialization cost paid on the
+      SYNCING thread (the ``device_get`` analog).
+    * ``dispatch_ms`` — invoke-dispatch cost paid on the dispatch thread
+      (the stack-jit + XLA-dispatch analog).
+    * ``h2d_ms``      — ``to_device`` cost paid on the staging-lane thread.
+    * ``manual``      — "1": batches complete only via :meth:`release_one`
+      / :meth:`release_all` (deterministic window unit tests).
+    """
+
+    NAME = "async-sim"
+    SUPPORTS_STAGING = True  # to_device really copies off the staging buf
+
+    def __init__(self):
+        super().__init__()
+        self._pending: "deque[threading.Event]" = deque()
+        self._cv = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        # census (inspected by tests; written under locks / GIL-atomic)
+        self.blocking_syncs: List[str] = []
+        self.copy_hints = 0
+        self.dispatched = 0
+        self.busy_s = 0.0  # actual device-service wall time (not nominal)
+
+    # -- knobs ---------------------------------------------------------------
+    def _ms(self, key: str, default: float = 0.0) -> float:
+        return float(self.custom_props.get(key, default)) / 1000.0
+
+    @property
+    def manual(self) -> bool:
+        return self.custom_props.get("manual", "") in ("1", "true")
+
+    def note_blocking_sync(self) -> None:
+        self.blocking_syncs.append(threading.current_thread().name)
+
+    # -- framework info -------------------------------------------------------
+    def framework_info(self):
+        info = super().framework_info()
+        info.run_without_model = True
+        return info
+
+    def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
+        return in_spec
+
+    # -- device worker --------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self.manual:
+            return
+        if self._worker is None or not self._worker.is_alive():
+            self._closed = False
+            self._worker = threading.Thread(
+                target=self._serve, name="async-sim-device", daemon=True)
+            self._worker.start()
+
+    def _serve(self) -> None:
+        service = self._ms("compute_ms")
+        while True:
+            with self._cv:
+                while not self._pending:
+                    if self._closed:
+                        return
+                    self._cv.wait()
+                ev = self._pending.popleft()
+            if service > 0:
+                t0 = time.perf_counter()
+                time.sleep(service)  # single server: batches serialize
+                # sleep() overshoots by timer granularity: record the
+                # ACTUAL service time so overlap ratios divide by what
+                # the device really spent, not the nominal knob
+                self.busy_s += time.perf_counter() - t0
+            ev.set()
+
+    def release_one(self) -> bool:
+        """manual mode: complete the oldest in-service batch."""
+        with self._cv:
+            if not self._pending:
+                return False
+            self._pending.popleft().set()
+            return True
+
+    def release_all(self) -> int:
+        n = 0
+        while self.release_one():
+            n += 1
+        return n
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            for ev in self._pending:
+                ev.set()  # never strand a parked batch at teardown
+            self._pending.clear()
+            self._cv.notify_all()
+            worker, self._worker = self._worker, None
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=2.0)
+
+    # -- execution ------------------------------------------------------------
+    def to_device(self, arrays: List[Any]) -> List[Any]:
+        h2d = self._ms("h2d_ms")
+        if h2d > 0:
+            time.sleep(h2d)  # transfer occupies the lane thread
+        # a real placement COPIES off the staging buffer (the lane's
+        # buffer-reuse contract relies on it)
+        return [np.array(a, copy=True) for a in arrays]
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        return [np.asarray(a) * 2 + 1 for a in inputs]
+
+    def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        dispatch = self._ms("dispatch_ms")
+        if dispatch > 0:
+            time.sleep(dispatch)  # dispatch cost on the calling thread
+        self.dispatched += 1
+        done = threading.Event()
+        outs = [
+            FakeDeviceArray(
+                np.asarray(a) * 2 + 1, done, self._ms("transfer_ms"), self)
+            for a in inputs
+        ]
+        with self._cv:
+            self._pending.append(done)
+            self._cv.notify_all()
+        self._ensure_worker()
+        return outs
+
+
+for _cls in (Passthrough, Scaler, Average, FrameCounter, AsyncSim):
     register_backend(_cls)

@@ -191,6 +191,10 @@ def pick_device(wishes):
 class JaxXla(FilterBackend):
     NAME = "jax-xla"
 
+    #: host-staged batches are really copied to device (device_put), so
+    #: the filter's staging lane may reuse its host buffers after emission
+    SUPPORTS_STAGING = True
+
     def __init__(self):
         super().__init__()
         self._fn: Optional[Callable] = None
@@ -492,12 +496,33 @@ class JaxXla(FilterBackend):
         return spec
 
     # -- compilation --------------------------------------------------------
-    def _compiled(self, key: Tuple):
-        fn = self._jit_cache.get(key)
+    def _donation_forced(self) -> Optional[bool]:
+        """The legacy custom prop "donate:true|false" pins donation for
+        EVERY invoke (the caller takes responsibility for input privacy);
+        None = decide per call path."""
+        forced = self.custom_props.get("donate", "").lower()
+        if forced in ("1", "true"):
+            return True
+        if forced in ("0", "false"):
+            return False
+        return None
+
+    def _donation_ok(self) -> bool:
+        """Donation for a caller-private batch (invoke_batch_donated):
+        on by default except on CPU, where XLA ignores donation and warns
+        per compile — custom prop donate: overrides either way."""
+        forced = self._donation_forced()
+        if forced is not None:
+            return forced
+        return self._device is not None and self._device.platform != "cpu"
+
+    def _compiled(self, key: Tuple, donate: bool = False):
+        cache_key = (donate,) + key
+        fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
         with self._cache_lock:
-            fn = self._jit_cache.get(key)
+            fn = self._jit_cache.get(cache_key)
             if fn is None:
                 import jax
 
@@ -507,14 +532,15 @@ class JaxXla(FilterBackend):
                     outs = self._normalize_out(model(params, list(xs)))
                     return tuple(self._apply_posts(outs))
 
-                # donation (custom prop "donate:true"): XLA reuses input HBM
-                # for outputs.  Opt-in because upstream may still hold the
-                # arrays (tee fan-out shares payloads).
-                donate = ()
-                if self.custom_props.get("donate", "").lower() in ("1", "true"):
-                    donate = tuple(range(1, 1 + key[0]))
-                fn = jax.jit(call, donate_argnums=donate)
-                self._jit_cache[key] = fn
+                # donation: XLA reuses the input arrays' HBM for outputs
+                # (zero per-batch device allocations in steady state).
+                # Only ever set for inputs the CALLER declared private —
+                # the filter's freshly stacked/staged batches — or when
+                # the "donate:true" custom prop pins it; upstream-shared
+                # arrays (tee fan-out, pre-batched blocks) never donate.
+                donate_nums = tuple(range(1, 1 + key[0])) if donate else ()
+                fn = jax.jit(call, donate_argnums=donate_nums)
+                self._jit_cache[cache_key] = fn
         return fn
 
     def _put(self, a, sharding=None) -> Any:
@@ -541,10 +567,51 @@ class JaxXla(FilterBackend):
             # single frame has no batch dim to scatter: replicate on a mesh
             xs = [self._put(a, self._replicated) for a in inputs]
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-            out = self._compiled(key)(self._params, *xs)
+            out = self._compiled(
+                key, donate=bool(self._donation_forced())
+            )(self._params, *xs)
         return list(out)
 
+    def to_device(self, arrays: List[Any]) -> List[Any]:
+        """Staging-lane hook: place host-staged batches on this filter's
+        device.  Runs ON THE LANE THREAD, so the ``block_until_ready``
+        below IS the overlapped transfer — it orders the copy strictly
+        before return, which is the lane's buffer-reuse contract (the
+        staging buffers go back to the pool the moment this returns).
+        On a mesh the scatter stays inside invoke_batch (host-pad +
+        per-shard placement), so a private host copy satisfies the
+        contract while the stack cost still overlaps compute."""
+        import jax
+
+        if self._batch_sharding is not None:
+            return [np.array(a) for a in arrays]
+        if self._device is None or self._device.platform == "cpu":
+            # XLA's CPU client ZERO-COPIES suitably-aligned host arrays:
+            # device_put returns a jax.Array that ALIASES the staging
+            # buffer, and the lane overwrites that buffer with the next
+            # batch the moment this returns.  Hand jax a private copy —
+            # the memcpy is this platform's "transfer", still paid on
+            # the lane thread, and jax owns the copy outright.
+            arrays = [np.array(a) for a in arrays]
+        out = [jax.device_put(a, self._device) for a in arrays]
+        jax.block_until_ready(out)
+        return out
+
+    def invoke_batch_donated(self, inputs: List[Any]) -> List[Any]:
+        """Caller-private micro-batch: donate the input buffers to the
+        executable so XLA reuses their HBM for outputs — zero per-batch
+        device allocations in steady state (skipped on CPU, where XLA
+        ignores donation and would warn per compile)."""
+        donate = self._donation_ok()
+        if donate:
+            self.stats.record_donation_applied()
+        return self._invoke_batch_impl(inputs, donate)
+
     def invoke_batch(self, inputs: List[Any]) -> List[Any]:
+        return self._invoke_batch_impl(
+            inputs, bool(self._donation_forced()))
+
+    def _invoke_batch_impl(self, inputs: List[Any], donate: bool) -> List[Any]:
         """One XLA call for the whole micro-batch, bucket-padded so each
         bucket size compiles exactly once (and, on a mesh, stays divisible
         by the dp axis so the scatter is even)."""
@@ -580,7 +647,7 @@ class JaxXla(FilterBackend):
                     arr = self._put(arr, self._batch_sharding)
                 xs.append(arr)
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-            out = self._compiled(key)(self._params, *xs)
+            out = self._compiled(key, donate=donate)(self._params, *xs)
         if bucket != n:
             out = [o[:n] for o in out]
         return list(out)

@@ -1783,6 +1783,11 @@ class Pipeline:
         want = getattr(el, "preferred_batch", 1)
         batching = want > 1 and hasattr(el, "handle_frame_batch")
         wait_s = getattr(el, "batch_wait_s", 0.0)
+        # async device feed: an element holding parked in-flight work
+        # (the filter's completion window / staged ingest batch) gets a
+        # short mailbox poll so completed batches emit promptly instead
+        # of aging up to the full idle period at a live stream's tail
+        pending = getattr(el, "pending_frames", None)
         stop_flag = self._stop_flag
         # items popped from the mailbox but not yet processed (bulk pops
         # can pull events/other-pad items past a batch boundary); lives
@@ -1794,7 +1799,21 @@ class Pipeline:
                 pad, item = stash.popleft()
             else:
                 try:
-                    pad, item = box.get(timeout=0.1)
+                    try:
+                        # hot path: items queued — no pending_frames()
+                        # probe, no lock, no timeout bookkeeping
+                        pad, item = box.get_nowait()
+                    except queue.Empty:
+                        poll = 0.1
+                        if pending is not None:
+                            try:
+                                if pending() > 0:
+                                    poll = 0.02
+                            except Exception:
+                                self.log.exception(
+                                    "pending_frames failed for %s", el.name)
+                                pending = None
+                        pad, item = box.get(timeout=poll)
                 except queue.Empty:
                     # idle hook: elements holding deferred output (the
                     # filter's dispatch window) release it when the

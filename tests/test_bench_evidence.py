@@ -61,6 +61,14 @@ class TestBankAndLookup:
         got, _, _ = bench.lookup_banked(HEADLINE_META, METRIC)
         assert got is None
 
+    def test_timed_out_rows_not_banked(self, cache_paths):
+        # a host row that hit its per-row cap is partial evidence —
+        # emitted and labeled, but never a stand-in for a completed run
+        bench.bank_row(_row(timed_out=True, input="host"))
+        got, _, _ = bench.lookup_banked(
+            {**HEADLINE_META, "input": "host"}, METRIC)
+        assert got is None
+
     def test_config_mismatch_never_matches(self, cache_paths):
         bench.bank_row(_row())
         for key, val in [
@@ -273,9 +281,12 @@ class TestMainIntegration:
         )
         for k in ("BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE"):
             monkeypatch.delenv(k, raising=False)
-        # the banked row predates the fuse axis (= unfused seed dataplane);
-        # only an unfused run may be answered with it
+        # the banked row predates the fuse/ingest_lane axes (= unfused,
+        # serialized-staging seed dataplane); only a matching run may be
+        # answered with it
         monkeypatch.setenv("BENCH_FUSE", "0")
+        monkeypatch.setenv("BENCH_INGEST_LANE", "off")
+        monkeypatch.setenv("BENCH_PROXY", "0")  # keep the test hermetic
         bench.main()
         out = json.loads(capsys.readouterr().out.strip())
         assert out["value"] == 1821.1
@@ -297,8 +308,11 @@ class TestMainIntegration:
             "BENCH_PLATFORM", "BENCH_NO_STALE",
         ):
             monkeypatch.delenv(k, raising=False)
-        # pre-axis banked row = unfused seed dataplane; match it
+        # pre-axis banked row = unfused, serialized-staging seed
+        # dataplane; match both axes
         monkeypatch.setenv("BENCH_FUSE", "0")
+        monkeypatch.setenv("BENCH_INGEST_LANE", "off")
+        monkeypatch.setenv("BENCH_PROXY", "0")
         bench.main()
         out = json.loads(capsys.readouterr().out.strip())
         assert out["value"] == 1821.1
@@ -325,3 +339,30 @@ class TestMainIntegration:
         out = json.loads(capsys.readouterr().out.strip())
         assert out["value"] is None  # no mislabeled stale fallback
         assert out.get("stale") is not True
+
+    def test_ingest_lane_axis_separates_evidence(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """A row banked before the staging lane existed (then-implicit
+        ingest_lane=off, serialized host->device staging) must never
+        stand in for a lane-enabled run — and the failure row carries
+        live, labeled `cpu_proxy` evidence for THIS code instead."""
+        bench.bank_row(_row())  # no ingest_lane key -> implicit off
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: ("down", "")
+        )
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        for k in (
+            "BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE",
+            "BENCH_INGEST_LANE", "BENCH_PROXY",
+        ):
+            monkeypatch.delenv(k, raising=False)  # default run: lane auto
+        monkeypatch.setenv("BENCH_FUSE", "0")  # isolate the lane axis
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None  # no mislabeled stale fallback
+        assert out.get("stale") is not True
+        proxy = out["cpu_proxy"]  # BENCH_PROXY default: attached
+        assert proxy["proxy"] is True and proxy["platform"] == "cpu"
+        assert proxy["dispatch_thread_blocking_syncs"] == 0
+        assert proxy["ingest_overlap_speedup"] is not None

@@ -1,0 +1,196 @@
+"""Unit tests for core/feed.py — the completion-driven dispatch window
+and the host->device staging lane, exercised directly (no element, no
+pipeline) so the threading contracts are pinned at the primitive level:
+FIFO completion, error placement, Flush/close semantics, buffer-pool
+cycling, and job abandonment.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import DeviceBufferPool
+from nnstreamer_tpu.core.feed import CompletionWindow, HostStagingLane
+
+
+class GateMaterializer:
+    """materialize() blocks until the test releases that entry; entries
+    release in any order the test chooses (the window must still emit
+    FIFO).  A payload of Exception type raises instead."""
+
+    def __init__(self):
+        self.events = {}
+        self.lock = threading.Lock()
+
+    def release(self, token):
+        with self.lock:
+            ev = self.events.setdefault(token, threading.Event())
+        ev.set()
+
+    def __call__(self, out_b):
+        token = out_b[0]
+        with self.lock:
+            ev = self.events.setdefault(token, threading.Event())
+        ev.wait(timeout=10)
+        if isinstance(token, type) and issubclass(token, BaseException):
+            raise token("materialization failed")
+        return [np.float32([token])]
+
+
+class TestCompletionWindow:
+    def test_pop_ready_is_fifo_and_nonblocking(self):
+        gate = GateMaterializer()
+        win = CompletionWindow("t", materialize=gate)
+        try:
+            for i in range(3):
+                win.park([i], payload=i)
+            assert win.pop_ready() == []  # nothing completed: no block
+            gate.release(1)  # out-of-order completion...
+            time.sleep(0.05)
+            assert win.pop_ready() == []  # ...must NOT emit 1 before 0
+            gate.release(0)
+            deadline = time.monotonic() + 5
+            got = []
+            while len(got) < 2 and time.monotonic() < deadline:
+                got += win.pop_ready()
+            assert [p for _, p in got] == [0, 1]  # FIFO restored
+            assert [float(m[0][0]) for m, _ in got] == [0.0, 1.0]
+            gate.release(2)
+            assert win.wait_oldest(timeout=5)
+            assert [p for _, p in win.pop_ready()] == [2]
+        finally:
+            win.close()
+
+    def test_error_entry_raises_after_good_prefix(self):
+        gate = GateMaterializer()
+        win = CompletionWindow("t", materialize=gate)
+        try:
+            win.park([7], payload="ok")
+            win.park([RuntimeError], payload="bad")
+            gate.release(7)
+            gate.release(RuntimeError)
+            deadline = time.monotonic() + 5
+            while win.reaped < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # first call hands out the completed prefix...
+            assert [p for _, p in win.pop_ready()] == ["ok"]
+            # ...the NEXT call raises the parked error (dispatch thread)
+            with pytest.raises(RuntimeError, match="materialization"):
+                win.pop_ready()
+            assert len(win) == 0  # the errored entry was consumed
+        finally:
+            win.close()
+
+    def test_clear_discards_and_reaper_survives(self):
+        gate = GateMaterializer()
+        win = CompletionWindow("t", materialize=gate)
+        try:
+            win.park([0], payload="a")
+            win.park([1], payload="b")
+            assert win.clear() == ["a", "b"]
+            assert len(win) == 0
+            gate.release(0)  # reaper mid-sync finishes harmlessly
+            gate.release(1)
+            win.park([2], payload="c")  # window still usable
+            gate.release(2)
+            assert win.wait_oldest(timeout=5)
+            assert [p for _, p in win.pop_ready()] == ["c"]
+        finally:
+            win.close()
+
+    def test_close_stops_reaper_and_park_reopens(self):
+        win = CompletionWindow("t", materialize=lambda o: [np.float32(o)])
+        win.park([1.0], payload="x")
+        deadline = time.monotonic() + 5
+        while not win.oldest_ready() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        reaper = win._reaper
+        win.close()
+        assert reaper is not None and not reaper.is_alive()
+        win.park([2.0], payload="y")  # transparent reopen
+        assert win.wait_oldest(timeout=5)
+        assert [p for _, p in win.pop_ready()] == ["y"]
+        win.close()
+
+    def test_wait_oldest_counts_backpressure(self):
+        gate = GateMaterializer()
+        win = CompletionWindow("t", materialize=gate)
+        try:
+            win.park([0], payload="a")
+            assert not win.wait_oldest(timeout=0.05)  # bounded, times out
+            assert win.dispatch_waits == 1
+            gate.release(0)
+            assert win.wait_oldest(timeout=5)
+        finally:
+            win.close()
+
+
+class TestHostStagingLane:
+    def test_stacks_and_places_through_pool(self):
+        pool = DeviceBufferPool(max_per_key=4)
+        seen = []
+
+        def to_dev(arrs):
+            seen.append([a.copy() for a in arrs])
+            return [np.array(a) for a in arrs]
+
+        lane = HostStagingLane(to_dev, pool=pool, name="t")
+        try:
+            frames = [
+                [np.full((2,), i, np.float32)] for i in range(4)
+            ]
+            dev = lane.submit(frames).result()
+            assert len(dev) == 1 and dev[0].shape == (4, 2)
+            np.testing.assert_array_equal(
+                dev[0], np.repeat([[0.0], [1.0], [2.0], [3.0]], 2, axis=1))
+            # second batch reuses the released staging buffer
+            lane.submit(frames).result()
+            assert pool.reused >= 1 and pool.allocated <= 2
+        finally:
+            lane.close()
+
+    def test_discard_drops_device_refs(self):
+        lane = HostStagingLane(
+            lambda arrs: [np.array(a) for a in arrs], name="t")
+        try:
+            job = lane.submit([[np.zeros((2,), np.float32)]])
+            job.discard()
+            assert job.wait(timeout=5)
+            assert job._dev is None  # refs dropped even though staged
+        finally:
+            lane.close()
+
+    def test_staging_error_reaches_collector(self):
+        def bad(arrs):
+            raise ValueError("no device")
+
+        lane = HostStagingLane(bad, name="t")
+        try:
+            job = lane.submit([[np.zeros((2,), np.float32)]])
+            assert job.wait(timeout=5)
+            with pytest.raises(ValueError, match="no device"):
+                job.result()
+        finally:
+            lane.close()
+
+    def test_close_abandons_queued_jobs_loudly(self):
+        release = threading.Event()
+
+        def slow(arrs):
+            release.wait(timeout=10)
+            return [np.array(a) for a in arrs]
+
+        lane = HostStagingLane(slow, name="t")
+        first = lane.submit([[np.zeros((2,), np.float32)]])
+        queued = lane.submit([[np.zeros((2,), np.float32)]])
+        # the worker is held inside to_device (release unset), so `queued`
+        # is still in the lane's queue when close() runs: it must resolve
+        # with an error — never strand a waiter
+        lane.close()
+        assert queued.wait(timeout=5)
+        with pytest.raises(RuntimeError, match="closed"):
+            queued.result()
+        release.set()  # let the in-service job finish into its handle
+        assert first.wait(timeout=5)

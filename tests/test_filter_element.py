@@ -269,17 +269,23 @@ class TestDispatchDepth:
             2.0 * i + 1.0 for i in range(20)]
 
     def test_window_bookkeeping_unit(self):
-        """Direct element-level check that parking happens (no pipeline)."""
+        """Direct element-level check of the completion-driven window (no
+        pipeline): parking never blocks, emission is FIFO and strictly
+        completion-gated (manual-completion fake device), EOS drains,
+        Flush discards.  ingest-lane off: this pins the WINDOW alone."""
         from nnstreamer_tpu.elements.filter import TensorFilter
 
         el = TensorFilter("f")
-        el.set_property("framework", "jax-xla")
-        el.set_property("model", "ddepth_affine")
+        el.set_property("framework", "async-sim")
+        el.set_property("custom", "manual:1")
+        el.set_property("ingest-lane", "off")
         el.set_property("max-batch", 4)
         el.set_property("dispatch-depth", 3)
         el.start()
         try:
             from nnstreamer_tpu.core.buffer import TensorFrame
+
+            be = el.backend
 
             def batch(i0):
                 return [TensorFrame((np.float32([i]),)) for i in range(i0, i0 + 4)]
@@ -288,17 +294,25 @@ class TestDispatchDepth:
             assert out1 == [] and len(el._inflight) == 1
             out2 = el.handle_frame_batch(0, batch(4))
             assert out2 == [] and len(el._inflight) == 2
-            out3 = el.handle_frame_batch(0, batch(8))  # window full: emits oldest
-            assert len(out3) == 4 and len(el._inflight) == 2
+            assert el.pending_frames() == 8
+            # nothing completed yet: batch 0 must NOT have been emitted
+            # (the old design would block on it here); complete it and
+            # the full-window park releases exactly it, in order
+            be.release_one()
+            out3 = el.handle_frame_batch(0, batch(8))
             assert [float(f.tensors[0][0]) for _, f in out3] == [1.0, 3.0, 5.0, 7.0]
+            assert len(el._inflight) == 2
+            be.release_all()
             drained = el.handle_eos(0)
-            assert len(drained) == 8 and not el._inflight
+            assert len(drained) == 8 and not len(el._inflight)
+            assert [float(f.tensors[0][0]) for _, f in drained] == [
+                2.0 * i + 1.0 for i in range(4, 12)]
             # flush discards parked frames
             el.handle_frame_batch(0, batch(12))
             assert len(el._inflight) == 1
             from nnstreamer_tpu.core.buffer import Flush
             el.handle_event(0, Flush())
-            assert not el._inflight
+            assert not len(el._inflight) and el.pending_frames() == 0
         finally:
             el.stop()
 
@@ -347,6 +361,186 @@ class TestDispatchDepth:
             kinds = [type(o).__name__ for _, o in outs]
             assert kinds[:4] == ["TensorFrame"] * 4
             assert not el._inflight
+        finally:
+            el.stop()
+
+    def test_sync_degrade_latches_capability_once(self, caplog):
+        """Host-resident outputs (no copy_to_host_async) degrade a
+        depth>1 request to the synchronous path: latched ONCE per
+        backend instance — one log line, no per-batch hasattr re-probe —
+        and emission is immediate (nothing ever parks)."""
+        import logging
+
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "scaler")
+        el.set_property("custom", "factor:2")
+        el.set_property("max-batch", 4)
+        el.set_property("dispatch-depth", 4)
+        el.start()
+        try:
+            assert el._win_async is None  # not probed until first batch
+            with caplog.at_level(logging.INFO):
+                for k in range(3):
+                    outs = el.handle_frame_batch(0, [
+                        TensorFrame((np.float32([i]),))
+                        for i in range(4 * k, 4 * k + 4)
+                    ])
+                    # synchronous: every batch emits immediately
+                    assert len(outs) == 4 and not len(el._inflight)
+            assert el._win_async is False  # latched, not re-probed
+            degrade_logs = [
+                r for r in caplog.records
+                if "degrades to the synchronous path" in r.message
+            ]
+            assert len(degrade_logs) == 1  # logged once, not per batch
+        finally:
+            el.stop()
+
+    def test_private_batches_route_through_donated_entry(self):
+        """Batches the filter stacked itself are private: they go
+        through the backend's donated entry point (donated_calls
+        counts); a pre-batched BatchFrame — upstream may retain it —
+        must NOT (donation would destroy a shared buffer)."""
+        from nnstreamer_tpu.core.buffer import BatchFrame, TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "scaler")
+        el.set_property("custom", "factor:2")
+        el.set_property("max-batch", 4)
+        el.start()
+        try:
+            el.handle_frame_batch(0, [
+                TensorFrame((np.float32([i]),)) for i in range(4)])
+            assert el.backend.stats.donated_calls == 1
+            block = BatchFrame(
+                tensors=[np.arange(4, dtype=np.float32)[:, None]],
+                frames_info=[(None, None, {}) for _ in range(4)],
+            )
+            el.handle_frame_batch(0, [block])
+            assert el.backend.stats.donated_calls == 1  # unchanged
+        finally:
+            el.stop()
+
+
+class TestIngestLane:
+    """The double-buffered host->device staging lane (core/feed.py
+    HostStagingLane) wired through the element."""
+
+    def test_lane_defers_dispatch_by_one_batch_fifo(self):
+        """ingest-lane=on: batch k is dispatched when k+1 is submitted
+        (the double buffer), EOS flushes the last staged batch — FIFO
+        values exact."""
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "async-sim")
+        el.set_property("ingest-lane", "on")
+        el.set_property("max-batch", 4)
+        el.set_property("dispatch-depth", 1)
+        el.start()
+        try:
+            assert el._lane is not None
+
+            def batch(i0):
+                return [
+                    TensorFrame((np.float32([i]),))
+                    for i in range(i0, i0 + 4)
+                ]
+
+            out1 = el.handle_frame_batch(0, batch(0))
+            assert out1 == []  # staged, not yet dispatched
+            assert el.pending_frames() == 4
+            out2 = el.handle_frame_batch(0, batch(4))  # dispatches batch 0
+            assert [float(f.tensors[0][0]) for _, f in out2] == [
+                1.0, 3.0, 5.0, 7.0]
+            drained = el.handle_eos(0)  # flushes the staged batch 1
+            assert [float(f.tensors[0][0]) for _, f in drained] == [
+                2.0 * i + 1.0 for i in range(4, 8)]
+            assert el.pending_frames() == 0
+        finally:
+            el.stop()
+
+    def test_lane_flush_discards_staged_batch(self):
+        from nnstreamer_tpu.core.buffer import Flush, TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "async-sim")
+        el.set_property("ingest-lane", "on")
+        el.set_property("max-batch", 4)
+        el.start()
+        try:
+            el.handle_frame_batch(0, [
+                TensorFrame((np.float32([i]),)) for i in range(4)])
+            assert el.pending_frames() == 4
+            el.handle_event(0, Flush())
+            assert el.pending_frames() == 0
+            assert el.handle_eos(0) == []  # staged batch really gone
+        finally:
+            el.stop()
+
+    def test_lane_refused_for_replay_policies(self):
+        """The one-batch deferral would misattribute a failed batch's
+        frames under skip/restart supervision: ingest-lane=on refuses at
+        start(), auto silently keeps the lane off."""
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.pipeline.element import ElementError
+
+        el = TensorFilter("f")
+        el.set_property("framework", "async-sim")
+        el.set_property("ingest-lane", "on")
+        el.set_property("max-batch", 4)
+        el.set_property("error-policy", "skip")
+        with pytest.raises(ElementError, match="ingest-lane=on"):
+            el.start()
+        el2 = TensorFilter("f2")
+        el2.set_property("framework", "async-sim")
+        el2.set_property("ingest-lane", "auto")
+        el2.set_property("max-batch", 4)
+        el2.set_property("error-policy", "skip")
+        el2.start()
+        try:
+            assert el2._lane is None
+        finally:
+            el2.stop()
+
+    def test_lane_on_requires_staging_capable_backend(self):
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.pipeline.element import ElementError
+
+        el = TensorFilter("f")
+        el.set_property("framework", "scaler")
+        el.set_property("custom", "factor:2")
+        el.set_property("ingest-lane", "on")
+        el.set_property("max-batch", 4)
+        with pytest.raises(ElementError, match="staged"):
+            el.start()
+
+    def test_lane_staging_error_attributed_on_dispatch(self):
+        """A staging failure (bad frame shapes) surfaces on the dispatch
+        thread as an ordinary element error when the batch is
+        collected — not silently swallowed on the lane thread."""
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        el = TensorFilter("f")
+        el.set_property("framework", "async-sim")
+        el.set_property("ingest-lane", "on")
+        el.set_property("max-batch", 4)
+        el.start()
+        try:
+            # ragged shapes cannot stack into one staging buffer
+            el.handle_frame_batch(0, [
+                TensorFrame((np.zeros((2,), np.float32),)),
+                TensorFrame((np.zeros((3,), np.float32),)),
+            ])
+            with pytest.raises(Exception):
+                el.handle_eos(0)
         finally:
             el.stop()
 

@@ -9,6 +9,7 @@ pipeline twice — fuse=True and fuse=False — and byte-compares what the
 application can observe.
 """
 
+import threading
 import time
 
 import numpy as np
@@ -19,7 +20,7 @@ from nnstreamer_tpu.core.resilience import FAULTS
 from nnstreamer_tpu.core.buffer import TensorFrame
 from nnstreamer_tpu.elements.basic import AppSrc, TensorSink
 from nnstreamer_tpu.pipeline import Pipeline, TransformElement, parse_pipeline
-from nnstreamer_tpu.pipeline.element import make_element
+from nnstreamer_tpu.pipeline.element import SinkElement, element, make_element
 
 
 @pytest.fixture(autouse=True)
@@ -363,3 +364,197 @@ class TestSegmentation:
             "videotestsrc name=a num-buffers=1 ! identity name=b ! "
             "tensor_sink name=c", fuse=False)
         assert sorted(self._segs(pipe)) == [["a"], ["b"], ["c"]]
+
+
+# ---------------------------------------------------------------------------
+# Async device feed (completion-driven dispatch window, core/feed.py):
+# every supervision contract over the DEEPER window, fused vs unfused.
+# ---------------------------------------------------------------------------
+@element("fp_gate_sink")
+class FpGateSink(SinkElement):
+    """Renders only as many frames as the test releases (deterministic
+    in-flight population for exact drain/stop accounting); an interrupted
+    wait raises so the frame counts as NOT delivered."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.sema = threading.Semaphore(0)
+        self.got: list = []
+
+    def render(self, frame):
+        while not self.sema.acquire(timeout=0.02):
+            if self.interrupted:
+                raise RuntimeError("gate interrupted before delivery")
+        self.got.append(float(np.asarray(frame.tensors[0]).ravel()[0]))
+
+
+def _window_pipe(fuse, depth, custom="compute_ms:3,transfer_ms:1",
+                 sink="tensor_sink name=out", name="awin"):
+    return parse_pipeline(
+        "appsrc name=src max-buffers=256 ! "
+        "tensor_filter name=f framework=async-sim "
+        f"custom={custom} max-batch=4 dispatch-depth={depth} "
+        f"ingest-lane=off ! {sink}",
+        fuse=fuse, name=name,
+    )
+
+
+def _sink_bytes(pipe):
+    """Byte-exact emission fingerprint, in delivery order."""
+    return [
+        np.ascontiguousarray(np.asarray(f.tensors[0])).tobytes()
+        for f in pipe["out"].frames
+    ]
+
+
+class TestAsyncWindowParity:
+    """The completion-driven dispatch window (PR-6): FIFO emission order
+    byte-identical fused vs unfused at depths {1, 4, 8}, with the
+    dispatch thread never blocking inside a device_get-style sync for
+    depth > 1 (the reaper thread owns every pre-completion wait)."""
+
+    def _run_fifo(self, fuse, depth, n=24):
+        pipe = _window_pipe(fuse, depth)
+        pipe.start()
+        for i in range(n):
+            pipe["src"].push(np.float32([i]))
+        pipe["src"].end_of_stream()
+        be = pipe["f"].backend
+        pipe.wait(timeout=30)
+        sig = (_sink_bytes(pipe), _health_sig(pipe, "f"))
+        foreign = [
+            t for t in be.blocking_syncs if not t.endswith("-reaper")
+        ]
+        pipe.stop()
+        return sig, foreign
+
+    @pytest.mark.parametrize("depth", [1, 4, 8])
+    def test_fifo_emission_byte_identical(self, depth):
+        fused, f_foreign = self._run_fifo(True, depth)
+        unfused, u_foreign = self._run_fifo(False, depth)
+        assert fused == unfused
+        want = [
+            np.float32([2.0 * i + 1.0]).tobytes() for i in range(24)
+        ]
+        assert fused[0] == want  # strict FIFO, byte-exact values
+        if depth > 1:
+            # the async window's structural claim: every pre-completion
+            # device sync happened on the window's reaper thread
+            assert f_foreign == [] and u_foreign == []
+
+    def test_deadline_drops_identical_over_window(self):
+        """PR-2 deadline QoS over the parked window: already-expired
+        frames are dropped pre-dispatch with exact accounting, live
+        frames flow FIFO — identical fused and unfused."""
+        def run(fuse):
+            pipe = _window_pipe(fuse, 8)
+            pipe.start()
+            for i in range(6):
+                f = TensorFrame([np.float32([i])])
+                f.meta[DEADLINE_META] = (
+                    time.monotonic() + (60.0 if i % 2 == 0 else -1.0))
+                pipe["src"].push(f)
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            sig = (_sink_bytes(pipe), _health_sig(pipe, "f"))
+            pipe.stop()
+            return sig
+
+        fused, unfused = run(True), run(False)
+        assert fused == unfused
+        assert fused[1]["deadline_drops"] == 3
+        assert fused[0] == [
+            np.float32([2.0 * i + 1.0]).tobytes() for i in (0, 2, 4)
+        ]
+
+    @pytest.mark.parametrize("fuse", [True, False])
+    def test_drain_flushes_deep_window_zero_loss(self, fuse):
+        """Pipeline.drain() over a depth-8 window with slow compute:
+        every parked batch lands at the sink in order, zero dropped."""
+        pipe = _window_pipe(fuse, 8, custom="compute_ms:15,transfer_ms:2")
+        pipe.start()
+        for i in range(16):
+            pipe["src"].push(np.float32([i]))
+        r = pipe.drain(timeout=20)
+        assert r["dropped"] == 0
+        assert pipe.delivered_frames() == 16
+        assert _sink_bytes(pipe) == [
+            np.float32([2.0 * i + 1.0]).tobytes() for i in range(16)
+        ]
+        pipe.stop()
+
+    @pytest.mark.parametrize("fuse", [True, False])
+    def test_drain_deadline_exact_dropped_over_window(self, fuse):
+        """An expired drain accounts every undelivered frame exactly —
+        whether it sat in a mailbox, the parked window, or mid-call —
+        over the async feed: 12 pushed = 4 delivered + 8 dropped."""
+        pipe = _window_pipe(
+            fuse, 8, custom="compute_ms:2,transfer_ms:1",
+            sink="fp_gate_sink name=out")
+        pipe.start()
+        pipe["out"].sema.release(4)
+        for i in range(12):
+            pipe["src"].push(np.float32([i]))
+        deadline = time.monotonic() + 10
+        while len(pipe["out"].got) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(pipe["out"].got) == 4
+        r = pipe.drain(timeout=0.6)
+        assert r["dropped"] == 8  # exact: 12 pushed - 4 delivered
+        assert pipe["out"].got == [2.0 * i + 1.0 for i in range(4)]
+        pipe.stop()
+
+    def test_hot_swap_at_window_boundary_identical(self):
+        """PR-5 hot swap over the deeper window: the swap applies at a
+        frame boundary strictly after the in-flight window drains — every
+        pre-swap frame is served by the old model, every post-swap frame
+        by the new one, byte-identical fused vs unfused."""
+        from nnstreamer_tpu.backends.jax_xla import (
+            register_jax_model, unregister_jax_model)
+
+        register_jax_model("fp_m1", lambda p, xs: [xs[0] * 2.0], None)
+        register_jax_model("fp_m2", lambda p, xs: [xs[0] * 3.0], None)
+
+        def run(fuse):
+            pipe = parse_pipeline(
+                "appsrc name=src max-buffers=256 ! "
+                "tensor_filter name=f framework=jax-xla model=fp_m1 "
+                "is-updatable=true max-batch=4 dispatch-depth=8 "
+                "ingest-lane=off ! tensor_sink name=out",
+                fuse=fuse, name="swapwin",
+            )
+            pipe.start()
+            for i in range(8):
+                pipe["src"].push(np.float32([i]))
+            deadline = time.monotonic() + 15
+            while (len(pipe["out"].frames) < 8
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert len(pipe["out"].frames) == 8  # old model served all
+            ticket = pipe.reload_model("f", "fp_m2")
+            assert ticket.wait_applied(timeout=15)
+            for i in range(8, 16):
+                pipe["src"].push(np.float32([i]))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            h = pipe["f"].health_info()
+            sig = (
+                _sink_bytes(pipe),
+                {k: h[k] for k in ("swaps", "rollbacks", "model_version")},
+            )
+            pipe.stop()
+            return sig
+
+        try:
+            fused, unfused = run(True), run(False)
+        finally:
+            unregister_jax_model("fp_m1")
+            unregister_jax_model("fp_m2")
+        assert fused == unfused
+        want = [
+            np.float32([2.0 * i]).tobytes() for i in range(8)
+        ] + [
+            np.float32([3.0 * i]).tobytes() for i in range(8, 16)
+        ]
+        assert fused[0] == want
+        assert fused[1] == {"swaps": 1, "rollbacks": 0, "model_version": 1}

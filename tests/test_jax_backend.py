@@ -78,6 +78,54 @@ class TestJaxXlaBackend:
         np.testing.assert_allclose(np.asarray(out[0]), [9.0])
         be.close()
 
+    def test_donated_entry_skips_donation_on_cpu(self, affine_model):
+        """invoke_batch_donated on CPU: XLA ignores donation (and warns
+        per compile), so the donated entry point must not request it —
+        donated_calls counts the routing, donated_applied stays 0, and
+        results are identical to the plain path."""
+        be = find_backend("jax-xla")()
+        be.open("affine", {})
+        x = np.ones((4, 3), np.float32)
+        out = be.timed_invoke_batch_donated([x.copy()])
+        np.testing.assert_allclose(np.asarray(out[0]), 3.0)
+        assert be.stats.donated_calls == 1
+        assert be.stats.donated_applied == 0  # CPU: donation skipped
+        # same executable as the plain path (no donated compile forked)
+        be.invoke_batch([x.copy()])
+        assert len(be._jit_cache) == 1
+        be.close()
+
+    def test_donate_custom_prop_forces_donation(self, affine_model):
+        """custom=donate:true pins donation even on CPU (the legacy
+        opt-in: the caller takes responsibility for input privacy) —
+        the compiled call carries donate_argnums and results stay
+        correct (XLA on CPU ignores the alias request, warning only)."""
+        be = find_backend("jax-xla")()
+        be.open("affine", {"custom": "donate:true"})
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        out = be.timed_invoke_batch_donated([x.copy()])
+        np.testing.assert_allclose(np.asarray(out[0]), x * 2.0 + 1.0)
+        assert be.stats.donated_applied == 1
+        # the donated variant compiled under its own cache key
+        assert any(key[0] is True for key in be._jit_cache)
+        be.close()
+
+    def test_to_device_never_aliases_staging_buffer(self, affine_model):
+        """The staging lane's buffer-reuse contract: to_device must have
+        fully copied OFF the host array before returning.  XLA's CPU
+        client zero-copies aligned numpy buffers in device_put, so a
+        naive placement would hand back a jax.Array aliasing the pooled
+        staging buffer — mutating the buffer afterwards (exactly what
+        the lane does for the next batch) must not change the staged
+        values."""
+        be = find_backend("jax-xla")()
+        be.open("affine", {})
+        buf = np.ones((4, 3), np.float32)
+        dev = be.to_device([buf])
+        buf[:] = 777.0  # the lane reuses the staging buffer immediately
+        np.testing.assert_allclose(np.asarray(dev[0]), 1.0)
+        be.close()
+
     def test_hot_reload_swaps_params(self, affine_model):
         params2 = {"w": jnp.float32(10.0), "b": jnp.float32(0.0)}
         register_jax_model("affine2", lambda p, xs: [xs[0] * p["w"] + p["b"]], params2)

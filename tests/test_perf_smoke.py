@@ -147,3 +147,172 @@ def test_block_handoff_single_queue_op():
         [(0, TensorFrame([np.zeros(2)])) for _ in range(10)], timeout=0.0
     )
     assert n == 10 and box.qsize() == 8
+
+
+# ---------------------------------------------------------------------------
+# Async device feed gates (PR-6): the pipeline-vs-raw gap can only shrink
+# between chip windows — CPU-proxy floors for the window, the donated
+# buffer ring, and the staging lane (ROADMAP item 5, first slice).
+# ---------------------------------------------------------------------------
+def test_dispatch_window_nonblocking_tracks_backend():
+    """Acceptance gate: at dispatch-depth 8 over a slow single-server
+    fake device, pipeline throughput tracks BACKEND throughput within
+    10% — the device is busy >= 90% of wall time because stacking,
+    dispatch, and the device->host sync all hide behind compute (the
+    pre-async design was bounded by serial block-on-oldest: compute +
+    transfer + dispatch per batch, ~55% busy at these costs).  And the
+    structural claim behind the number: the dispatch thread is NEVER
+    observed inside a device_get-style blocking sync — the window's
+    reaper thread owns every pre-completion wait."""
+    from nnstreamer_tpu.pipeline import parse_pipeline as parse
+
+    compute_ms, mb, nbatches = 8.0, 8, 60
+    pipe = parse(
+        "appsrc name=src max-buffers=512 ! tensor_filter name=f "
+        "framework=async-sim "
+        f"custom=compute_ms:{compute_ms},transfer_ms:4,dispatch_ms:1 "
+        f"max-batch={mb} dispatch-depth=8 ingest-lane=off ! "
+        "tensor_sink name=out max-stored=1",
+        name="awperf",
+    )
+    pipe.start()
+    done = {"n": 0}
+    pipe["out"].connect_new_data(
+        lambda f: done.__setitem__("n", done["n"] + 1))
+    be = pipe["f"].backend
+    arr = np.zeros((64,), np.float32)
+    for _ in range(mb * 4):  # warmup: fill the window, settle batching
+        pipe["src"].push(arr)
+    t_w = time.time()
+    while done["n"] < mb * 4 and time.time() - t_w < 30:
+        time.sleep(0.005)
+    assert done["n"] >= mb * 4, "warmup stalled"
+    done["n"] = 0
+    b0 = be.busy_s
+    n = mb * nbatches
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pipe["src"].push(arr)
+    while done["n"] < n and time.perf_counter() - t0 < 60:
+        time.sleep(0.002)
+    elapsed = time.perf_counter() - t0
+    busy_s = be.busy_s - b0
+    foreign_syncs = [
+        t for t in be.blocking_syncs if not t.endswith("-reaper")
+    ]
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=30)
+    pipe.stop()
+    assert done["n"] == n, "frames lost in the async window"
+    # device-busy fraction: the single server's ACTUAL service seconds
+    # over wall time; overlap means wall time barely exceeds service.
+    # Steady state measures >= 0.95; the serial block-on-oldest design
+    # measures compute/(compute+transfer+dispatch) ~= 0.62 at these
+    # costs — 0.85 keeps CI-scheduling headroom while separating the
+    # two structures by a wide margin.
+    busy = busy_s / elapsed
+    assert busy >= 0.85, (
+        f"dispatch window no longer hides framework cost: device busy "
+        f"{busy:.2f} < 0.85 ({busy_s * 1000:.0f}ms service in "
+        f"{elapsed * 1000:.0f}ms wall)"
+    )
+    assert foreign_syncs == [], (
+        f"dispatch thread blocked in device_get: {foreign_syncs}"
+    )
+
+
+def test_host_ingest_overlap_speedup():
+    """Acceptance gate: the double-buffered staging lane beats serialized
+    stack+transfer+compute by >= 1.3x on equal costs (measured ~1.8x at
+    4ms/4ms; the lane hides the whole transfer behind compute).  Runs
+    the SAME harness bench.py publishes as `ingest_overlap_speedup` in
+    its cpu_proxy evidence — the gate and the evidence cannot drift."""
+    import importlib.util
+    import os
+
+    bench_path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_for_perf", bench_path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    t_serial, t_lane = bench.measure_ingest_overlap(nb=16)
+    speedup = t_serial / t_lane
+    assert speedup >= 1.3, (
+        f"staging lane overlap regressed: {speedup:.2f}x < 1.3x "
+        f"(serial {t_serial * 1000:.0f}ms vs lane {t_lane * 1000:.0f}ms)"
+    )
+
+
+def test_device_buffer_pool_reuse_rate():
+    """Acceptance gate: steady-state staging performs zero per-batch
+    buffer allocations — the lane's double-buffered ring settles on <= 3
+    buffers per (shape, dtype) and every later batch reuses one
+    (reuse rate >= 0.8 over 20 batches)."""
+    from nnstreamer_tpu.core.buffer import DeviceBufferPool
+    from nnstreamer_tpu.core.feed import HostStagingLane
+
+    pool = DeviceBufferPool(max_per_key=8)
+    lane = HostStagingLane(
+        lambda arrs: [np.array(a) for a in arrs], pool=pool, name="pool")
+    frames = [[np.zeros((128,), np.float32)] for _ in range(8)]
+    try:
+        prev = None
+        for _ in range(20):
+            job = lane.submit(frames)
+            if prev is not None:
+                prev.result()
+            prev = job
+        prev.result()
+    finally:
+        lane.close()
+    assert pool.allocated <= 3, (
+        f"staging ring allocates per batch: {pool.allocated} allocations"
+    )
+    assert pool.reuse_rate >= 0.8, (
+        f"staging-buffer reuse regressed: {pool.reuse_rate:.2f} < 0.8 "
+        f"({pool.reused} reused / {pool.allocated} allocated)"
+    )
+
+
+def test_ingest_lane_end_to_end_zero_alloc_steady_state():
+    """The lane wired through the element: a host-ingest pipeline with
+    ingest-lane=on stages every micro-batch through the pool (global
+    DEVICE_POOL counters grow, reuse dominates) and loses nothing."""
+    from nnstreamer_tpu.core.buffer import DEVICE_POOL
+    from nnstreamer_tpu.pipeline import parse_pipeline as parse
+
+    pipe = parse(
+        "appsrc name=src max-buffers=512 ! tensor_filter name=f "
+        "framework=async-sim custom=compute_ms:3 max-batch=8 "
+        "dispatch-depth=4 ingest-lane=on ! tensor_sink name=out",
+        name="laneperf",
+    )
+    pipe.start()
+    reused0, alloc0 = DEVICE_POOL.reused, DEVICE_POOL.allocated
+    n = 8 * 16
+    for i in range(n):
+        pipe["src"].push(np.float32([i]))
+    pipe["src"].end_of_stream()
+    lane = pipe["f"]._lane
+    pipe.wait(timeout=30)
+    staged = lane.staged
+    pipe.stop()
+    outs = [float(f.tensors[0][0]) for f in pipe["out"].frames]
+    assert outs == [2.0 * i + 1.0 for i in range(n)]  # FIFO, zero loss
+    assert staged >= 8  # the lane really carried the ingest
+    reused = DEVICE_POOL.reused - reused0
+    allocated = DEVICE_POOL.allocated - alloc0
+    # every staged batch acquired its buffer from the pool (one tensor
+    # per frame here, so acquires == staged); ragged scheduler batching
+    # mints a few distinct (n, 1) shape keys, each allowed its small
+    # double-buffer ring — a pool bypass (acquires == 0) or a broken
+    # release (allocated == staged) both fail loudly
+    assert reused + allocated == staged, (
+        f"pool bypass on the lane path: {reused} reused + "
+        f"{allocated} allocated != {staged} staged batches"
+    )
+    assert allocated <= 10, (
+        f"staging ring allocates per batch: {allocated} allocations "
+        f"over {staged} staged batches"
+    )
