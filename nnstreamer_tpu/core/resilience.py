@@ -258,12 +258,17 @@ class CircuitBreaker:
         half_open_max: int = 1,
         clock: Callable[[], float] = time.monotonic,
         name: str = "",
+        on_trip: Optional[Callable[["CircuitBreaker"], None]] = None,
     ):
         self.failure_threshold = max(1, int(failure_threshold))
         self.window_s = float(window_s)
         self.reset_timeout_s = float(reset_timeout_s)
         self.half_open_max = max(1, int(half_open_max))
         self.name = name
+        # observability hook: fired (outside the lock) each time the
+        # breaker transitions to OPEN — the query client routes it into
+        # the pipeline's flight recorder (Documentation/observability.md)
+        self._on_trip = on_trip
         self._clock = clock
         self._lock = threading.Lock()
         self._failures: List[float] = []
@@ -333,6 +338,7 @@ class CircuitBreaker:
             self._probes = 0
 
     def record_failure(self) -> None:
+        tripped = False
         with self._lock:
             now = self._clock()
             st = self._peek_state()
@@ -347,22 +353,29 @@ class CircuitBreaker:
                 self._opened_at = now
                 self._probes = 0
                 self._trips += 1
+                tripped = True
                 log.warning("breaker %s: re-opened (probe failed)", self.name)
-                return
-            self._failures.append(now)
-            cutoff = now - self.window_s
-            self._failures = [t for t in self._failures if t >= cutoff]
-            if (
-                st == self.CLOSED
-                and len(self._failures) >= self.failure_threshold
-            ):
-                self._state = self.OPEN
-                self._opened_at = now
-                self._trips += 1
-                log.warning(
-                    "breaker %s: OPEN (%d failures in %.1fs)",
-                    self.name, len(self._failures), self.window_s,
-                )
+            else:
+                self._failures.append(now)
+                cutoff = now - self.window_s
+                self._failures = [t for t in self._failures if t >= cutoff]
+                if (
+                    st == self.CLOSED
+                    and len(self._failures) >= self.failure_threshold
+                ):
+                    self._state = self.OPEN
+                    self._opened_at = now
+                    self._trips += 1
+                    tripped = True
+                    log.warning(
+                        "breaker %s: OPEN (%d failures in %.1fs)",
+                        self.name, len(self._failures), self.window_s,
+                    )
+        if tripped and self._on_trip is not None:
+            try:
+                self._on_trip(self)
+            except Exception:  # observer bugs must never break the breaker
+                log.exception("breaker %s: on_trip hook failed", self.name)
 
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         if not self.allow():

@@ -1,0 +1,941 @@
+"""Unified fleet telemetry: metrics registry, Prometheus exposition,
+wire-propagated trace spans, and the stall flight recorder.
+
+The reference delegates pipeline observability to ecosystem tracers
+(GstShark proctime/interlatency — reproduced locally in ``core/tracer.py``)
+plus per-filter latency/throughput props; every signal was trapped
+in-process behind ``health()`` dicts and tracer rings.  This module gives
+each of those signals a STABLE dotted name (``nns.filter.invoke_latency``,
+``nns.feed.window_occupancy``, ``nns.query.inflight``, ...) in one
+process-wide registry, exposes the registry as Prometheus text
+(``Pipeline.serve_metrics(port)`` / ``NNS_METRICS_PORT``), and adds the
+two cross-process pieces local tracing cannot provide:
+
+* **Trace spans over the query wire** — per-request ``trace_id`` plus
+  server-side duration stamps ride the frame meta (both transports, v1
+  and v2 envelopes: meta is JSON either way, so v1 peers interoperate),
+  letting one frame's end-to-end latency decompose into client-queue /
+  wire / server-queue / device-dispatch / device-compute segments.
+  Host-local timestamps never cross the wire: any meta key starting with
+  :data:`TL_PREFIX` is stripped at encode (``wire._clean_meta``); only
+  *durations* travel (``SRV_SPAN_META``).
+* **Flight recorder** — a bounded ring of recent per-frame span events,
+  dumped (rate-limited, to log + a JSON file) on watchdog stall,
+  dead-letter, swap rollback, or breaker trip, so "where did the time
+  go" is answerable without a repro.
+
+Cost contract: the disabled path stays one branch per frame (the
+scheduler's existing ``tracer is not None`` test — the recorder rides the
+tracer); registry collection happens only at scrape/snapshot time.
+
+Naming contract: every registry name is declared in :data:`METRICS`
+(``tools/check_health_schema.py`` lints the catalog against the docs and
+a snapshot file, so a rename can never be silent).  Numeric
+``health_info()`` keys without an explicit mapping are exported as
+``nns.health.<key>`` — the same lint covers those keys at their source.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from .log import get_logger
+
+log = get_logger("telemetry")
+
+# ---------------------------------------------------------------------------
+# Trace-context meta keys
+# ---------------------------------------------------------------------------
+#: meta keys with this prefix are HOST-LOCAL (monotonic-clock stamps,
+#: in-process handles) and are stripped by ``wire._clean_meta`` before any
+#: frame is encoded — instants never cross the wire, only durations do
+TL_PREFIX = "_nns_tl_"
+#: per-request trace id (string); crosses the wire and is echoed back in
+#: answers so client, server, and flight-recorder views correlate
+TRACE_ID_META = "_nns_trace_id"
+#: server receive stamp (perf_counter, host-local; stamped at admission)
+TL_RX_META = "_nns_tl_rx"
+#: filter invoke stamps: (dispatch_s, compute_s) durations, host-local
+#: until ``QueryServerCore.process`` folds them into ``SRV_SPAN_META``
+TL_INVOKE_META = "_nns_tl_invoke"
+#: client enqueue stamp (perf_counter at the query client's doorstep)
+TL_ENQ_META = "_nns_tl_enq"
+#: the client-local end-to-end decomposition attached to answer frames:
+#: {"client_queue","wire","server_queue","device_dispatch",
+#:  "device_compute","total"} — seconds, summing exactly to "total"
+SPAN_META = "_nns_tl_span"
+#: server-side duration dict {"queue","dispatch","compute","total"}
+#: (seconds) — crosses the wire in answer meta (JSON-safe, v1-compatible;
+#: peers that predate it simply never stamp it and the client reports the
+#: whole round trip as wire time)
+SRV_SPAN_META = "_nns_srv_span"
+
+_trace_seq = itertools.count(1)
+_TRACE_PREFIX = f"{os.getpid():x}"
+
+
+def new_trace_id() -> str:
+    """Cheap per-request trace id, unique within a fleet window."""
+    return f"{_TRACE_PREFIX}-{next(_trace_seq)}"
+
+
+# ---------------------------------------------------------------------------
+# Stable metric-name catalog
+# ---------------------------------------------------------------------------
+#: every registry name, with kind + one-line help.  PURE LITERAL: the
+#: ``tools/check_health_schema.py`` lint parses this dict statically.
+METRICS: Dict[str, Tuple[str, str]] = {
+    # per-element dataplane (PipelineTracer-fed)
+    "nns.element.frames": ("counter", "logical frames out of the element"),
+    "nns.element.calls": ("counter", "handler calls (micro-batches count once)"),
+    "nns.element.proctime_us": ("gauge", "mean handler wall time, us"),
+    "nns.element.proctime_p99_us": ("gauge", "p99 handler wall time, us"),
+    "nns.element.fps": ("gauge", "logical frames/sec out of the element"),
+    "nns.element.interlatency_ms": ("gauge", "mean source-to-here latency, ms"),
+    "nns.element.queue_depth": ("gauge", "mean mailbox depth at dequeue"),
+    "nns.element.queue_capacity": ("gauge", "mailbox capacity"),
+    "nns.element.bitrate_mbps": ("gauge", "payload megabits/sec through the element"),
+    # supervision counters (Pipeline.health)
+    "nns.element.restarts": ("counter", "lifetime supervisor restarts"),
+    "nns.element.restarts_window": ("gauge", "restarts within the current restart-window"),
+    "nns.element.dead_letters": ("counter", "frames dropped under error-policy=skip"),
+    "nns.element.dead_letter_depth": ("gauge", "retained dead-letter frames"),
+    "nns.element.deadline_drops": ("counter", "frames expired before processing"),
+    "nns.element.stalls": ("counter", "watchdog stall episodes"),
+    "nns.element.overruns": ("counter", "watchdog frame-deadline overruns"),
+    # lifecycle states (numeric codes; see observability.md for the map)
+    "nns.lifecycle.state": ("gauge", "element supervision state code"),
+    "nns.lifecycle.server_state": ("gauge", "query-server serving/draining/stopped code"),
+    "nns.lifecycle.swap_state": ("gauge", "hot-swap coordinator state code"),
+    "nns.lifecycle.draining": ("gauge", "1 while the query server refuses with GOAWAY"),
+    "nns.pipeline.delivered": ("counter", "logical frames consumed by terminal elements"),
+    "nns.pipeline.errors": ("gauge", "recorded fatal element errors"),
+    # tensor_filter + async device feed (core/feed.py)
+    "nns.filter.invokes": ("counter", "backend invoke calls"),
+    "nns.filter.invoked_frames": ("counter", "logical frames through the backend"),
+    "nns.filter.invoke_latency": ("gauge", "mean per-frame invoke latency, seconds (latency=1)"),
+    "nns.filter.model_version": ("gauge", "hot-swap model version"),
+    "nns.filter.swaps": ("counter", "committed hot model swaps"),
+    "nns.filter.swap_failures": ("counter", "staging/inline reload failures"),
+    "nns.filter.rollbacks": ("counter", "observation-window rollbacks"),
+    "nns.feed.window_occupancy": ("gauge", "micro-batches parked in the dispatch window"),
+    "nns.feed.window_reaped": ("counter", "batches materialized by the window reaper"),
+    "nns.feed.dispatch_waits": ("counter", "full-window backpressure waits"),
+    "nns.feed.lane_pending": ("gauge", "staging jobs queued on the ingest lane"),
+    "nns.feed.lane_staged": ("counter", "micro-batches staged by the ingest lane"),
+    # tensor_query server (admission / wire integrity / rolling restart)
+    "nns.query.inflight": ("gauge", "requests admitted and not yet answered"),
+    "nns.query.admitted": ("counter", "requests admitted"),
+    "nns.query.load_shed": ("counter", "requests refused with BUSY"),
+    "nns.query.shedding": ("gauge", "1 while admission hysteresis refuses work"),
+    "nns.query.admission_high": ("gauge", "admission high watermark"),
+    "nns.query.admission_low": ("gauge", "admission low watermark"),
+    "nns.query.ingress_depth": ("gauge", "frames queued for the server pipeline"),
+    "nns.query.corrupt_requests": ("counter", "corrupt requests refused"),
+    "nns.query.goaway_sent": ("counter", "requests refused with GOAWAY"),
+    # tensor_query client (failover / integrity / degrade / spans)
+    "nns.query.client_inflight": ("gauge", "client requests dispatched and unanswered"),
+    "nns.query.delivered": ("counter", "logical frames answered by a server"),
+    "nns.query.retried": ("counter", "extra attempts dispatched, all causes"),
+    "nns.query.busy_replies": ("counter", "BUSY sheds seen"),
+    "nns.query.goaway_replies": ("counter", "GOAWAY refusals seen"),
+    "nns.query.deadline_expired": ("counter", "requests abandoned: budget ran out"),
+    "nns.query.corruption_detected": ("counter", "corrupt exchanges detected"),
+    "nns.query.degraded_frames": ("counter", "frames answered by degrade= instead of a server"),
+    "nns.query.breaker_trips_evicted": ("counter", "trips of breakers evicted on pool swaps"),
+    "nns.query.breaker_open": ("gauge", "1 while the remote's breaker is open"),
+    "nns.query.breaker_trips": ("counter", "lifetime breaker trips for the remote"),
+    "nns.query.breaker_failures": ("gauge", "failures in the breaker's rolling window"),
+    "nns.query.rtt_seconds": ("histogram", "client-observed round-trip time"),
+    # per-remote span aggregation (the item-3 load signal)
+    "nns.query.remote_requests": ("counter", "requests answered by the remote"),
+    "nns.query.remote_e2e_ms": ("gauge", "EWMA end-to-end latency via the remote"),
+    "nns.query.remote_rtt_ms": ("gauge", "EWMA wire round-trip via the remote"),
+    "nns.query.remote_wire_ms": ("gauge", "EWMA wire-only segment via the remote"),
+    "nns.query.remote_server_ms": ("gauge", "EWMA server-side time via the remote"),
+    "nns.query.remote_client_queue_ms": ("gauge", "EWMA client-queue segment"),
+    # sources/sinks, wire integrity, datarepo
+    "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
+    "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
+    "nns.wire.corrupt_dropped": ("counter", "undecodable pub/sub frames dropped"),
+    "nns.datarepo.truncated_samples": ("counter", "samples lost to a truncated repo"),
+    # pools (process-wide; core/buffer.py)
+    "nns.pool.frame_reused": ("counter", "frame carcasses reused"),
+    "nns.pool.frame_recycled": ("counter", "frame carcasses recycled"),
+    "nns.pool.device_allocated": ("counter", "staging buffers freshly allocated"),
+    "nns.pool.device_reused": ("counter", "staging buffers reused"),
+    "nns.pool.device_reuse_rate": ("gauge", "staging-buffer reuse fraction"),
+    # flight recorder
+    "nns.flight.dumps": ("counter", "flight-recorder incident dumps written"),
+}
+
+#: numeric state -> code maps (documented in Documentation/observability.md)
+STATE_CODES = {
+    "idle": 0, "running": 1, "restarting": 2, "degraded": 3,
+    "failed": 4, "finished": 5, "stalled": 6,
+}
+SERVER_STATE_CODES = {"stopped": 0, "serving": 1, "draining": 2}
+SWAP_STATE_CODES = {"idle": 0, "staging": 1, "staged": 2, "observing": 3}
+
+#: ``health_info()`` keys with an explicit stable metric name; numeric
+#: keys absent here export as ``nns.health.<key>`` (gauge)
+HEALTH_KEY_METRICS: Dict[str, str] = {
+    "restarts": "nns.element.restarts",
+    "restarts_window": "nns.element.restarts_window",
+    "dead_letters": "nns.element.dead_letters",
+    "dead_letter_depth": "nns.element.dead_letter_depth",
+    "deadline_drops": "nns.element.deadline_drops",
+    "stalls": "nns.element.stalls",
+    "overruns": "nns.element.overruns",
+    "model_version": "nns.filter.model_version",
+    "swaps": "nns.filter.swaps",
+    "swap_failures": "nns.filter.swap_failures",
+    "rollbacks": "nns.filter.rollbacks",
+    "inflight": "nns.query.inflight",
+    "admitted": "nns.query.admitted",
+    "load_shed": "nns.query.load_shed",
+    "shedding": "nns.query.shedding",
+    "admission_high": "nns.query.admission_high",
+    "admission_low": "nns.query.admission_low",
+    "ingress_depth": "nns.query.ingress_depth",
+    "corrupt_requests": "nns.query.corrupt_requests",
+    "goaway_sent": "nns.query.goaway_sent",
+    "draining": "nns.lifecycle.draining",
+    "delivered": "nns.query.delivered",
+    "retried": "nns.query.retried",
+    "busy_replies": "nns.query.busy_replies",
+    "goaway_replies": "nns.query.goaway_replies",
+    "deadline_expired": "nns.query.deadline_expired",
+    "corruption_detected": "nns.query.corruption_detected",
+    "degraded_frames": "nns.query.degraded_frames",
+    "breaker_trips_evicted": "nns.query.breaker_trips_evicted",
+    "corrupt_dropped": "nns.wire.corrupt_dropped",
+    "truncated_samples": "nns.datarepo.truncated_samples",
+    "pending_frames": "nns.source.pending",
+    "rendered_frames": "nns.sink.rendered",
+}
+
+#: non-numeric / structured health keys handled specially (or skipped) by
+#: the collector — never auto-exported
+HEALTH_KEYS_SPECIAL = (
+    "state", "policy", "last_error", "model", "servers", "breakers",
+    "remotes", "lifecycle", "swap_state", "swap_last_error",
+)
+
+
+def metric_kind(name: str) -> str:
+    if name in METRICS:
+        return METRICS[name][0]
+    return "gauge"  # nns.health.<key> fallbacks
+
+
+# ---------------------------------------------------------------------------
+# Instruments
+# ---------------------------------------------------------------------------
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter (thread-safe)."""
+
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self) -> List["Sample"]:
+        return [Sample(self.name, self.labels, self._value, "counter")]
+
+
+class Gauge:
+    """Point-in-time value; ``set_fn`` makes it poll-at-scrape (zero
+    hot-path cost — the callback runs only when someone reads)."""
+
+    __slots__ = ("name", "labels", "_value", "_fn")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # scrape must never die on a gauge callback
+                log.exception("gauge callback failed for %s", self.name)
+                return 0.0
+        return self._value
+
+    def samples(self) -> List["Sample"]:
+        return [Sample(self.name, self.labels, self.value, "gauge")]
+
+
+#: default histogram buckets: request-latency shaped, seconds
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus classic histogram semantics)."""
+
+    __slots__ = ("name", "labels", "buckets", "_counts", "_sum", "_count",
+                 "_lock")
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.labels = dict(labels or {})
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def samples(self) -> List["Sample"]:
+        out: List[Sample] = []
+        with self._lock:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                out.append(Sample(
+                    f"{self.name}_bucket", {**self.labels, "le": repr(b)},
+                    cum, "counter",
+                ))
+            cum += self._counts[-1]
+            out.append(Sample(
+                f"{self.name}_bucket", {**self.labels, "le": "+Inf"},
+                cum, "counter",
+            ))
+            out.append(Sample(
+                f"{self.name}_sum", self.labels, self._sum, "counter"))
+            out.append(Sample(
+                f"{self.name}_count", self.labels, self._count, "counter"))
+        return out
+
+
+@dataclass
+class Sample:
+    """One exported measurement."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    value: float = 0.0
+    kind: str = "gauge"
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+class MetricsRegistry:
+    """Process-wide instrument table + scrape-time collectors.
+
+    Instruments are keyed by (name, labelset) and must use catalogued
+    names (:data:`METRICS`) — the stable-naming contract the
+    ``check_health_schema`` lint enforces.  Collectors are callables
+    returning an iterable of :class:`Sample`; pipelines register one on
+    ``start()`` and unregister on ``stop()``, so all per-frame cost lives
+    at scrape time, not on the hot path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple[str, Tuple], Any] = {}
+        self._collectors: List[Callable[[], Iterable[Sample]]] = []
+
+    def _get(self, cls, name: str, labels: Optional[Dict[str, str]],
+             **kw) -> Any:
+        if name not in METRICS and not name.startswith("nns.health."):
+            raise ValueError(
+                f"metric name {name!r} is not in the telemetry.METRICS "
+                "catalog (stable-naming contract; add it there and to "
+                "Documentation/observability.md)"
+            )
+        key = (name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = cls(name, labels, **kw)
+                self._instruments[key] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}")
+            return inst
+
+    def counter(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, labels: Optional[Dict[str, str]] = None,
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def remove_labeled(self, **labels) -> int:
+        """Drop every instrument whose labels include all of ``labels``
+        (a stopping pipeline evicts its instruments so restarts and tests
+        do not accumulate stale series).  Returns the count removed."""
+        want = set(_label_key(labels))
+        with self._lock:
+            doomed = [
+                k for k in self._instruments if want <= set(k[1])
+            ]
+            for k in doomed:
+                del self._instruments[k]
+        return len(doomed)
+
+    def collect_labeled(self, **labels) -> List[Sample]:
+        """Samples of every INSTRUMENT whose labels include ``labels``
+        (pipeline snapshots merge their own instruments this way)."""
+        want = set(_label_key(labels))
+        with self._lock:
+            instruments = [
+                inst for (name, lk), inst in self._instruments.items()
+                if want <= set(lk)
+            ]
+        out: List[Sample] = []
+        for inst in instruments:
+            out.extend(inst.samples())
+        return out
+
+    def register_collector(self, fn: Callable[[], Iterable[Sample]]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(self, fn: Callable[[], Iterable[Sample]]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self) -> List[Sample]:
+        with self._lock:
+            instruments = list(self._instruments.values())
+            collectors = list(self._collectors)
+        out: List[Sample] = []
+        for inst in instruments:
+            out.extend(inst.samples())
+        for fn in collectors:
+            try:
+                out.extend(fn())
+            except Exception:  # a scrape must survive any collector bug
+                log.exception("telemetry collector failed: %r", fn)
+        return out
+
+    # -- rendering ----------------------------------------------------------
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    @staticmethod
+    def _prom_labels(labels: Dict[str, str]) -> str:
+        if not labels:
+            return ""
+        parts = []
+        for k, v in sorted(labels.items()):
+            v = str(v).replace("\\", r"\\").replace('"', r"\"").replace(
+                "\n", r"\n")
+            parts.append(f'{MetricsRegistry._prom_name(str(k))}="{v}"')
+        return "{" + ",".join(parts) + "}"
+
+    def render_prometheus(self) -> str:
+        """The registry as Prometheus text exposition format 0.0.4."""
+        by_name: Dict[str, List[Sample]] = {}
+        for s in self.collect():
+            by_name.setdefault(s.name, []).append(s)
+        lines: List[str] = []
+        typed: set = set()
+        for name in sorted(by_name):
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in METRICS:
+                    base = name[: -len(suffix)]
+            pname = self._prom_name(name)
+            pbase = self._prom_name(base)
+            if pbase not in typed:
+                typed.add(pbase)
+                kind, help_ = METRICS.get(
+                    base, ("gauge", "ad-hoc health gauge"))
+                lines.append(f"# HELP {pbase} {help_}")
+                lines.append(f"# TYPE {pbase} {kind}")
+            for s in by_name[name]:
+                v = float(s.value)
+                value = repr(int(v)) if v == int(v) else repr(v)
+                lines.append(f"{pname}{self._prom_labels(s.labels)} {value}")
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide default registry every pipeline registers into
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-label claims
+# ---------------------------------------------------------------------------
+# Pipeline names default to "pipeline" (both Pipeline() and
+# parse_pipeline()), so the ``pipeline=`` label CANNOT be the bare name:
+# two concurrent defaults would alias each other's series, and one
+# pipeline's stop() (remove_labeled) would evict the other's live
+# instruments.  Labels are claimed per live pipeline — the first claim
+# of a name gets it verbatim, concurrent claims get "name#2", "name#3"…
+_label_lock = threading.Lock()
+_active_labels: set = set()
+
+
+def claim_pipeline_label(name: str) -> str:
+    """A pipeline= label value unique among LIVE pipelines."""
+    with _label_lock:
+        label, i = name, 1
+        while label in _active_labels:
+            i += 1
+            label = f"{name}#{i}"
+        _active_labels.add(label)
+        return label
+
+
+def release_pipeline_label(label: str) -> None:
+    with _label_lock:
+        _active_labels.discard(label)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition server
+# ---------------------------------------------------------------------------
+_live_servers_lock = threading.Lock()
+_live_servers: List["MetricsServer"] = []
+
+
+def live_server_count() -> int:
+    """Open exposition servers (conftest leak-check hook)."""
+    with _live_servers_lock:
+        return len(_live_servers)
+
+
+class MetricsServer:
+    """Tiny HTTP exposition endpoint serving ``/metrics`` as Prometheus
+    text.  One listener socket + one serve thread (named
+    ``<owner>-metrics`` so the test-suite leak census sees it); closed
+    listeners release their fd synchronously in :meth:`close`."""
+
+    def __init__(self, registry: MetricsRegistry = None, port: int = 0,
+                 host: str = "127.0.0.1", name: str = "nns"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry if registry is not None else REGISTRY
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = reg.render_prometheus().encode()
+                except Exception as e:  # noqa: BLE001 — scrape boundary
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet scrapes
+                log.debug("metrics http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name=f"{name}-metrics", daemon=True,
+        )
+        self._thread.start()
+        with _live_servers_lock:
+            _live_servers.append(self)
+        log.info("metrics exposition on http://%s:%d/metrics", host, self.port)
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()  # listener fd released HERE, synchronously
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with _live_servers_lock:
+            if self in _live_servers:
+                _live_servers.remove(self)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot view (pollable; bench rows attach this)
+# ---------------------------------------------------------------------------
+class TelemetrySnapshot:
+    """Immutable sample list with lookup helpers."""
+
+    def __init__(self, samples: List[Sample]):
+        self.samples = list(samples)
+
+    def get(self, name: str, default: float = None, **labels):
+        want = set(labels.items())
+        for s in self.samples:
+            if s.name == name and want <= set(s.labels.items()):
+                return s.value
+        return default
+
+    def sum(self, name: str, **labels) -> float:
+        want = set(labels.items())
+        return sum(
+            s.value for s in self.samples
+            if s.name == name and want <= set(s.labels.items())
+        )
+
+    def names(self) -> set:
+        return {s.name for s in self.samples}
+
+    def counters(self) -> Dict[Tuple[str, Tuple], float]:
+        """{(name, labelset): value} for counter-kind samples only — the
+        deterministic subset the fused/unfused parity test pins."""
+        return {
+            (s.name, _label_key(s.labels)): s.value
+            for s in self.samples if s.kind == "counter"
+        }
+
+    def flat(self) -> Dict[str, float]:
+        """{name: value} — counters summed across labelsets, gauges
+        maxed; the compact labeled dump bench rows carry."""
+        out: Dict[str, float] = {}
+        for s in self.samples:
+            if s.kind == "counter":
+                out[s.name] = out.get(s.name, 0.0) + float(s.value)
+            else:
+                out[s.name] = max(out.get(s.name, float("-inf")),
+                                  float(s.value))
+        return {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in out.items()
+        }
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+class FlightRecorder:
+    """Bounded ring of recent per-frame span events + incident dumps.
+
+    Fed by :class:`~.tracer.PipelineTracer` (the recorder rides the
+    tracer's existing one-branch-per-frame hook): ``begin`` marks a frame
+    entering an element (open span — this is what identifies a frame
+    STUCK inside a hung element), ``end`` appends the completed span to
+    the ring.  ``dump`` writes the assembled per-trace timelines to log +
+    a JSON file, rate-limited so an incident storm cannot turn the
+    recorder into its own outage."""
+
+    def __init__(self, capacity: int = 4096, dump_dir: Optional[str] = None,
+                 min_dump_interval_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._ring: deque = deque(maxlen=max(16, capacity))
+        self._open: Dict[str, Tuple[Any, float]] = {}
+        self._dump_dir = dump_dir
+        self._min_interval = float(min_dump_interval_s)
+        self._clock = clock
+        self._last_dump_ts = float("-inf")
+        self._dump_lock = threading.Lock()
+        self.dumps = 0
+        self.suppressed = 0
+
+    # -- hot path (enabled only; worker threads) ----------------------------
+    def begin(self, element: str, frame) -> None:
+        meta = getattr(frame, "meta", None)
+        tid = meta.get(TRACE_ID_META) if meta is not None else None
+        self._open[element] = (tid, time.perf_counter())
+
+    def end(self, element: str, frame, t_in: float, t_out: float,
+            nframes: int) -> None:
+        meta = getattr(frame, "meta", None)
+        tid = meta.get(TRACE_ID_META) if meta is not None else None
+        self._open.pop(element, None)
+        # deque append is GIL-atomic; full ring evicts oldest
+        self._ring.append((tid, element, t_in, t_out, nframes))
+
+    # -- assembly -----------------------------------------------------------
+    @staticmethod
+    def _snap(dq: deque) -> list:
+        for _ in range(4):  # concurrent appends can break list(deque)
+            try:
+                return list(dq)
+            except RuntimeError:
+                continue
+        return []
+
+    def timelines(self) -> Dict[Any, List[Dict[str, Any]]]:
+        """Per-trace span lists, oldest span first; open spans (entered,
+        never left — the stalled frame) are flagged ``open: true``."""
+        out: Dict[Any, List[Dict[str, Any]]] = {}
+        for tid, element, t_in, t_out, nframes in self._snap(self._ring):
+            out.setdefault(tid, []).append({
+                "element": element, "t_in": t_in, "t_out": t_out,
+                "dur_ms": round((t_out - t_in) * 1e3, 3),
+                "frames": nframes,
+            })
+        for element, (tid, t_in) in list(self._open.items()):
+            out.setdefault(tid, []).append({
+                "element": element, "t_in": t_in, "open": True,
+                "stuck_for_ms": round(
+                    (time.perf_counter() - t_in) * 1e3, 3),
+            })
+        return out
+
+    def dump(self, reason: str, source: str, detail: Any = None,
+             logger=None) -> Optional[str]:
+        """Write the current timelines to a JSON file (+ a log summary).
+        Rate-limited; returns the file path or None when suppressed or
+        nothing was recorded."""
+        with self._dump_lock:
+            now = self._clock()
+            if now - self._last_dump_ts < self._min_interval:
+                self.suppressed += 1
+                return None
+            self._last_dump_ts = now
+        timelines = self.timelines()
+        payload = {
+            "reason": reason,
+            "source": source,
+            "detail": repr(detail) if detail is not None else None,
+            "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "traces": [
+                {"trace_id": tid, "spans": spans}
+                for tid, spans in timelines.items()
+            ],
+        }
+        import tempfile
+
+        dump_dir = (
+            self._dump_dir
+            or os.environ.get("NNS_FLIGHT_DIR")
+            or tempfile.gettempdir()
+        )
+        path = os.path.join(
+            dump_dir,
+            f"nns_flight_{source}_{reason}_{int(time.time() * 1000)}.json",
+        )
+        lg = logger or log
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1)
+        except OSError as e:
+            lg.warning("flight-recorder dump failed: %s", e)
+            return None
+        self.dumps += 1
+        try:
+            REGISTRY.counter("nns.flight.dumps").inc()
+        except Exception:  # allow-silent: accounting only
+            pass
+        open_spans = [
+            s for spans in timelines.values() for s in spans
+            if s.get("open")
+        ]
+        lg.warning(
+            "flight recorder: %s at %s -> %s (%d trace(s), %d open "
+            "span(s)%s)", reason, source, path, len(timelines),
+            len(open_spans),
+            "".join(
+                f"; STUCK {s['element']} {s['stuck_for_ms']:.0f}ms"
+                for s in open_spans[:3]
+            ),
+        )
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Pipeline collector (scrape-time; called via REGISTRY collectors)
+# ---------------------------------------------------------------------------
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    return None
+
+
+def collect_pipeline(pipe) -> List[Sample]:
+    """Every signal source of one pipeline as labeled samples: element
+    ``health_info()`` counters, :class:`PipelineTracer` per-element
+    stats, the filter's CompletionWindow / HostStagingLane gauges, query
+    breaker / admission / lifecycle states, and the process-wide
+    FramePool / DeviceBufferPool counters.  Runs only at scrape/snapshot
+    time — the frame hot path is untouched."""
+    base = {"pipeline": pipe.telemetry_label}
+    out: List[Sample] = []
+    out.append(Sample("nns.pipeline.delivered", dict(base),
+                      pipe.delivered_frames(), "counter"))
+    out.append(Sample("nns.pipeline.errors", dict(base),
+                      len(pipe.errors), "gauge"))
+    # -- health() -----------------------------------------------------------
+    for el_name, entry in pipe.health().items():
+        labels = {**base, "element": el_name}
+        for key, val in entry.items():
+            if key == "state":
+                out.append(Sample(
+                    "nns.lifecycle.state", dict(labels),
+                    STATE_CODES.get(val, -1), "gauge"))
+                continue
+            if key == "lifecycle":
+                out.append(Sample(
+                    "nns.lifecycle.server_state", dict(labels),
+                    SERVER_STATE_CODES.get(val, -1), "gauge"))
+                continue
+            if key == "swap_state":
+                out.append(Sample(
+                    "nns.lifecycle.swap_state", dict(labels),
+                    SWAP_STATE_CODES.get(val, -1), "gauge"))
+                continue
+            if key == "breakers" and isinstance(val, dict):
+                for remote, snap in val.items():
+                    rl = {**labels, "remote": remote}
+                    out.append(Sample(
+                        "nns.query.breaker_open", dict(rl),
+                        1.0 if snap.get("state") == "open" else 0.0,
+                        "gauge"))
+                    out.append(Sample(
+                        "nns.query.breaker_trips", dict(rl),
+                        snap.get("trips", 0), "counter"))
+                    out.append(Sample(
+                        "nns.query.breaker_failures", dict(rl),
+                        snap.get("recent_failures", 0), "gauge"))
+                continue
+            if key == "remotes" and isinstance(val, dict):
+                for remote, agg in val.items():
+                    rl = {**labels, "remote": remote}
+                    for akey, aval in agg.items():
+                        n = _num(aval)
+                        if n is None:
+                            continue
+                        mname = f"nns.query.remote_{akey}"
+                        if mname in METRICS:
+                            out.append(Sample(
+                                mname, dict(rl), n, metric_kind(mname)))
+                continue
+            if key in HEALTH_KEYS_SPECIAL:
+                continue
+            n = _num(val)
+            if n is None:
+                continue
+            mname = HEALTH_KEY_METRICS.get(key, f"nns.health.{key}")
+            out.append(Sample(mname, dict(labels), n, metric_kind(mname)))
+    # -- tracer per-element stats ------------------------------------------
+    tracer = pipe.tracer
+    if tracer is not None:
+        for el_name, r in tracer.report().items():
+            labels = {**base, "element": el_name}
+            pairs = (
+                ("nns.element.frames", r["frames"]),
+                ("nns.element.calls", r["calls"]),
+                ("nns.element.proctime_us", r["proctime_us_avg"]),
+                ("nns.element.proctime_p99_us", r["proctime_us_p99"]),
+                ("nns.element.fps", r["framerate_fps"]),
+                ("nns.element.interlatency_ms", r["interlatency_ms_avg"]),
+                ("nns.element.queue_depth", r["queuelevel_avg"]),
+                ("nns.element.queue_capacity", r["queue_capacity"]),
+                ("nns.element.bitrate_mbps", r["bitrate_mbps"]),
+            )
+            for mname, v in pairs:
+                if v is None:
+                    continue
+                out.append(Sample(mname, dict(labels), float(v),
+                                  metric_kind(mname)))
+    # -- element-specific gauges (filter window/lane, client inflight) ------
+    for el_name, el in pipe.elements.items():
+        info = getattr(el, "metrics_info", None)
+        if info is None:
+            continue
+        labels = {**base, "element": el_name}
+        try:
+            rows = info() or ()
+        except Exception:  # scrape must survive element bugs
+            log.exception("metrics_info failed for %s", el_name)
+            continue
+        for row in rows:
+            if len(row) == 2:
+                mname, v = row
+                extra = None
+            else:
+                mname, v, extra = row
+            n = _num(v)
+            if n is None:
+                continue
+            lb = dict(labels)
+            if extra:
+                lb.update(extra)
+            out.append(Sample(mname, lb, n, metric_kind(mname)))
+    # -- process-wide pools (labeled by pipeline for scrape context) --------
+    from .buffer import DEVICE_POOL, FRAME_POOL
+
+    out.append(Sample("nns.pool.frame_reused", dict(base),
+                      FRAME_POOL.reused, "counter"))
+    out.append(Sample("nns.pool.frame_recycled", dict(base),
+                      FRAME_POOL.recycled, "counter"))
+    out.append(Sample("nns.pool.device_allocated", dict(base),
+                      DEVICE_POOL.allocated, "counter"))
+    out.append(Sample("nns.pool.device_reused", dict(base),
+                      DEVICE_POOL.reused, "counter"))
+    out.append(Sample("nns.pool.device_reuse_rate", dict(base),
+                      DEVICE_POOL.reuse_rate, "gauge"))
+    return out

@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .telemetry import TRACE_ID_META, new_trace_id
+
 META_SRC_TS = "_nns_trace_src_ts"  # wall stamp set when a frame leaves a source
 
 
@@ -65,9 +67,14 @@ class _ElementStats:
 class PipelineTracer:
     """Attach via ``Pipeline(..., tracer=PipelineTracer())`` or
     ``pipeline.enable_tracing()``; read ``report()`` any time (thread-safe,
-    including while the pipeline runs)."""
+    including while the pipeline runs).
 
-    def __init__(self, detail: bool = False) -> None:
+    A :class:`~.telemetry.FlightRecorder` may ride along (``recorder``
+    attr, set by ``Pipeline.enable_flight_recorder``): the scheduler's
+    single ``tracer is not None`` branch then also feeds the incident
+    ring — the disabled path still costs exactly one branch per frame."""
+
+    def __init__(self, detail: bool = False, recorder=None) -> None:
         self._stats: Dict[str, _ElementStats] = {}
         self._lock = threading.Lock()
         self.t_started = time.perf_counter()
@@ -77,11 +84,25 @@ class PipelineTracer:
         # export_chrome_trace renders a real timeline, not just aggregates
         self._detail = detail
         self._spans: deque = deque(maxlen=200_000)
+        # optional flight recorder (core/telemetry.py)
+        self.recorder = recorder
 
     # -- hot-path hooks (called from element worker threads) ---------------
     def stamp_source(self, frame) -> None:
-        """Stamp a frame leaving a source element (interlatency origin)."""
+        """Stamp a frame leaving a source element (interlatency origin);
+        with a flight recorder attached, also mint the frame's trace id
+        (it propagates through meta copies — and across the query wire,
+        see core/telemetry.py)."""
         frame.meta.setdefault(META_SRC_TS, time.perf_counter())
+        if self.recorder is not None:
+            frame.meta.setdefault(TRACE_ID_META, new_trace_id())
+
+    def frame_begin(self, name: str, frame) -> None:
+        """Mark a frame ENTERING an element's handler.  Only meaningful
+        with a flight recorder attached (a frame stuck inside a hung
+        element is identified by its open span); otherwise a no-op."""
+        if self.recorder is not None:
+            self.recorder.begin(name, frame)
 
     def queue_level(self, name: str, depth: int, cap: int) -> None:
         st = self._get(name)
@@ -94,9 +115,12 @@ class PipelineTracer:
     def frame_out(
         self, name: str, t_in: float, t_out: float,
         nframes: int, nbytes: int, src_ts: Optional[float],
+        frame=None,
     ) -> None:
         if self._detail:
             self._spans.append((name, t_in, t_out, nframes))
+        if self.recorder is not None:
+            self.recorder.end(name, frame, t_in, t_out, nframes)
         st = self._get(name)
         st.calls += 1
         st.frames += nframes

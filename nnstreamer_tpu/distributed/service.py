@@ -38,6 +38,7 @@ from ..core.buffer import BatchFrame, TensorFrame
 from ..core.lifecycle import ServerGoawayError
 from ..core.liveness import AdmissionController, ServerBusyError, stamp_deadline
 from ..core.log import get_logger
+from ..core.telemetry import SRV_SPAN_META, TL_INVOKE_META, TL_RX_META
 from ..core.types import StreamSpec
 from .wire import (
     WireCorruptionError,
@@ -174,8 +175,13 @@ class QueryServerCore:
             raise ServerBusyError(retry_after=self.busy_retry_after)
         try:
             budget = min(timeout, 300.0)
+            # trace spans (core/telemetry.py): stamp the receive instant
+            # (host-local, stripped at encode) so the answer can carry a
+            # server-side DURATION decomposition back to the client
+            rx = time.perf_counter()
             for frame in frames:
                 stamp_deadline(frame, budget)
+                frame.meta[TL_RX_META] = rx
             with self._pending_client(frames, qsize=len(frames)) as answer_q:
                 answers = []
                 deadline = time.monotonic() + budget
@@ -190,9 +196,38 @@ class QueryServerCore:
                         raise TimeoutError(
                             "server pipeline produced no answer in time"
                         ) from None
+                self._stamp_server_spans(answers)
                 return answers
         finally:
             self.admission.release()
+
+    @staticmethod
+    def _stamp_server_spans(answers: List[TensorFrame]) -> None:
+        """Fold the host-local stamps riding each answer's meta into the
+        wire-safe duration dict ``SRV_SPAN_META`` ({"queue", "dispatch",
+        "compute", "total"}, seconds — summing exactly to "total" so the
+        client's end-to-end decomposition is additive).  Answers that
+        never saw the stamps (meta-dropping elements, legacy peers) are
+        left alone — the client then reports the whole round trip as
+        wire time."""
+        now = time.perf_counter()
+        for a in answers:
+            rx = a.meta.pop(TL_RX_META, None)
+            inv = a.meta.pop(TL_INVOKE_META, None)
+            if rx is None:
+                continue
+            total = max(0.0, now - rx)
+            dispatch, compute = (inv if inv else (0.0, 0.0))
+            # clamp into the measured window so queue (the remainder)
+            # can never go negative and the sum stays exact
+            compute = min(max(0.0, float(compute)), total)
+            dispatch = min(max(0.0, float(dispatch)), total - compute)
+            a.meta[SRV_SPAN_META] = {
+                "queue": total - dispatch - compute,
+                "dispatch": dispatch,
+                "compute": compute,
+                "total": total,
+            }
 
     def _ingress_items(self, frames: List[TensorFrame]) -> List[TensorFrame]:
         """block_ingress: a wire micro-batch becomes ONE BatchFrame so the

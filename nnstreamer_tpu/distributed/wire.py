@@ -59,6 +59,8 @@ import numpy as np
 
 from ..core.buffer import TensorFrame
 from ..core.liveness import DEADLINE_META
+from ..core.telemetry import TL_PREFIX as _TL_PREFIX
+from ..core.tracer import META_SRC_TS as _SRC_TS_META
 from ..core.types import (
     TENSOR_COUNT_LIMIT,
     FlexHeaderTruncated,
@@ -140,12 +142,15 @@ def get_codec(name: str):
 def _clean_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
     out = {}
     for k, v in meta.items():
-        if k == DEADLINE_META:
-            # deadline QoS (core/liveness.py): an absolute instant on
-            # THIS host's monotonic clock — meaningless to a peer.  The
-            # remaining BUDGET crosses the wire instead (tcp_query
-            # header deadline_s / gRPC time_remaining) and the receiver
-            # re-stamps on its own clock.
+        if k == DEADLINE_META or k == _SRC_TS_META or k.startswith(
+                _TL_PREFIX):
+            # host-local instants never cross the wire: the deadline
+            # stamp (core/liveness.py — the remaining BUDGET travels in
+            # the transport header instead), the tracer's interlatency
+            # origin stamp, and every trace-local telemetry key
+            # (core/telemetry.py TL_PREFIX — client enqueue / server rx
+            # stamps).  Only DURATIONS travel (SRV_SPAN_META), and the
+            # receiver re-stamps on its own clock.
             continue
         try:
             json.dumps(v)

@@ -75,6 +75,11 @@ class AppSrc(SourceElement):
         (drain flushes these; an immediate stop abandons them)."""
         return max(0, self._pushed_logical - self._popped_logical)
 
+    def health_info(self) -> dict:
+        """Ingest-buffer depth merged into ``Pipeline.health()`` (and the
+        telemetry registry as ``nns.source.pending``)."""
+        return {"pending_frames": self.pending_frames()}
+
     def start(self):
         # honor max-buffers: a full queue blocks push() — backpressure
         # reaches the producer (≙ appsrc max-buffers/block)
@@ -276,9 +281,17 @@ class TensorSink(SinkElement):
         self._callbacks: List[Callable[[TensorFrame], None]] = []
         self.eos_received = threading.Event()
         self._last_signal_ts = 0.0
+        # logical frames rendered (single-writer: the sink's streaming
+        # thread) — the terminal-delivery counter telemetry exports
+        self._rendered = 0
 
     def connect_new_data(self, cb: Callable[[TensorFrame], None]) -> None:
         self._callbacks.append(cb)
+
+    def health_info(self) -> dict:
+        """Delivery counter merged into ``Pipeline.health()`` (and the
+        telemetry registry as ``nns.sink.rendered``)."""
+        return {"rendered_frames": self._rendered}
 
     def render(self, frame: TensorFrame) -> None:
         if isinstance(frame, BatchFrame) and self.props["split-batches"]:
@@ -291,6 +304,7 @@ class TensorSink(SinkElement):
             return
         if self.props["to-host"]:
             frame = frame.to_host()
+        self._rendered += getattr(frame, "batch_size", 1)
         limit = self.props["max-stored"]
         self.frames.append(frame)
         if limit and len(self.frames) > limit:

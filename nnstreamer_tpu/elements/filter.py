@@ -37,6 +37,7 @@ from ..core.lifecycle import HotSwapCoordinator, SwapTicket
 from ..core.liveness import StallError
 from ..core.model_uri import resolve_model_uri
 from ..core.resilience import FAULTS
+from ..core.telemetry import TL_INVOKE_META, TL_RX_META
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import ElementError, Property, TransformElement, element
 
@@ -339,6 +340,12 @@ class TensorFilter(TransformElement):
         self._nframes = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        # telemetry (core/telemetry.py): always-on invoke counters (two
+        # int adds per invoke) + the handler-entry stamp the trace-span
+        # dispatch segment is derived from
+        self._invokes = 0
+        self._invoked_frames = 0
+        self._t_handler = 0.0
         # combination props parsed once at start (hot path stays parse-free)
         self._in_comb: Optional[List[Tuple[str, int]]] = None
         self._out_comb: Optional[List[Tuple[str, int]]] = None
@@ -917,6 +924,11 @@ class TensorFilter(TransformElement):
                 self._model_in, self._model_out = old_in, old_out
                 self.props["model"] = old_model
                 sw.discard(failed)
+                p = self._pipeline
+                if p is not None:
+                    # incident: a rollout that rolled back is exactly
+                    # when "where did the time go" gets asked
+                    p.incident("swap_rollback", self.name, e)
             # the frame is retried on the old backend either way — a
             # bad rollout must not cost a single frame
             return (
@@ -951,6 +963,53 @@ class TensorFilter(TransformElement):
         if self._swapper is not None:
             info.update(self._swapper.snapshot())
         return info
+
+    def metrics_info(self):
+        """Registry samples (core/telemetry.py, scrape time only): invoke
+        counters plus the async-feed gauges — the CompletionWindow
+        occupancy/reap counts and the HostStagingLane stats."""
+        win = self._inflight
+        lane = self._lane
+        return [
+            ("nns.filter.invokes", self._invokes),
+            ("nns.filter.invoked_frames", self._invoked_frames),
+            ("nns.filter.invoke_latency", self.latency_us * 1e-6),
+            ("nns.feed.window_occupancy", len(win)),
+            ("nns.feed.window_reaped", win.reaped),
+            ("nns.feed.dispatch_waits", win.dispatch_waits),
+            ("nns.feed.lane_pending",
+             lane.pending() if lane is not None else 0),
+            ("nns.feed.lane_staged",
+             lane.staged if lane is not None else 0),
+        ]
+
+    @staticmethod
+    def _stamp_invoke_spans(frames: Sequence[TensorFrame],
+                            dispatch_s: float, compute_s: float) -> None:
+        """Trace spans over the query wire: frames that carry the server
+        receive stamp (``TL_RX_META``, set by ``QueryServerCore.process``)
+        get this invoke's (dispatch, compute) durations attached, so the
+        answer's server-side decomposition can split device time out of
+        queue time.  One dict-containment probe per invoke when the
+        stream never crossed the wire."""
+        probe = frames[0]
+        m0 = (
+            probe.frames_info[0][2]
+            if isinstance(probe, BatchFrame) and probe.frames_info
+            else probe.meta
+        )
+        if TL_RX_META not in m0:
+            return
+        span = (max(0.0, dispatch_s), max(0.0, compute_s))
+        for f in frames:
+            if isinstance(f, BatchFrame):
+                for _, _, m in f.frames_info:
+                    if TL_RX_META in m:
+                        m[TL_INVOKE_META] = span
+                if TL_RX_META in f.meta:
+                    f.meta[TL_INVOKE_META] = span
+            elif TL_RX_META in f.meta:
+                f.meta[TL_INVOKE_META] = span
 
     # -- negotiation --------------------------------------------------------
     def _input_for_backend(self, spec: StreamSpec) -> StreamSpec:
@@ -1005,6 +1064,8 @@ class TensorFilter(TransformElement):
     def _record_stats(self, dt_s: float, nframes: int) -> None:
         import time
 
+        self._invokes += 1
+        self._invoked_frames += nframes
         if self.props["latency"]:
             self._latency_ring.append(dt_s * 1e6 / max(nframes, 1))
             if self.props["latency-report"] and self._pipeline is not None:
@@ -1058,10 +1119,13 @@ class TensorFilter(TransformElement):
             # REPLICATE instead of shard).  invoke_batch's per-frame
             # fallback covers batchless backends.
             outputs = self._backend_invoke_batch(inputs)
-            self._record_stats(time.perf_counter() - t0, frame.batch_size)
+            dt = time.perf_counter() - t0
+            self._record_stats(dt, frame.batch_size)
         else:
             outputs = self._backend_invoke(inputs)
-            self._record_stats(time.perf_counter() - t0, 1)
+            dt = time.perf_counter() - t0
+            self._record_stats(dt, 1)
+        self._stamp_invoke_spans((frame,), 0.0, dt)
         return frame.with_tensors(self._compose_outputs(frame.tensors, outputs))
 
     def handle_frame_batch(
@@ -1082,6 +1146,11 @@ class TensorFilter(TransformElement):
         self, pad: int, frames: List[TensorFrame]
     ) -> List[Tuple[int, TensorFrame]]:
         assert self.backend is not None
+        import time
+
+        # handler-entry stamp: the trace-span "device-dispatch" segment
+        # (stack/stage time before the backend call) is measured from here
+        self._t_handler = time.perf_counter()
         if any(isinstance(f, BatchFrame) for f in frames):
             # block ingest (≙ converter frames-per-tensor batching,
             # gsttensor_converter.c frames-per-tensor): the batch axis
@@ -1137,7 +1206,10 @@ class TensorFilter(TransformElement):
         FAULTS.check("filter.invoke", interrupt=lambda: self.interrupted)
         t0 = time.perf_counter()
         out_b = self._backend_invoke_batch(batched, private=private)
-        self._record_stats(time.perf_counter() - t0, nlogical)
+        dt = time.perf_counter() - t0
+        self._record_stats(dt, nlogical)
+        self._stamp_invoke_spans(
+            frames, t0 - self._t_handler if self._t_handler else 0.0, dt)
         if self.batch_through_active:
             infos = _logical_infos(frames)
             p, d, m = infos[0]

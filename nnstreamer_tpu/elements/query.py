@@ -38,6 +38,13 @@ from ..core.resilience import (
     RetryPolicy,
     is_remote_application_error,
 )
+from ..core.telemetry import (
+    SPAN_META,
+    SRV_SPAN_META,
+    TL_ENQ_META,
+    TRACE_ID_META,
+    new_trace_id,
+)
 from ..distributed.wire import WireError
 from ..core.types import ANY, StreamSpec
 from ..distributed.service import (
@@ -483,6 +490,11 @@ class TensorQueryClient(Element):
         self._delivered = 0  # logical frames answered by a server
         self._retried = 0  # extra attempts dispatched (all causes)
         self._retry_policy = RetryPolicy()  # rebuilt from props in start()
+        # trace spans (core/telemetry.py): per-remote EWMA segment
+        # aggregation — the live load signal fleet routing will consume
+        # (under _breakers_lock like the other worker-raced counters)
+        self._remote_spans: dict = {}
+        self._rtt_hist = None  # registry histogram, bound at start()
 
     @property
     def _conns(self) -> tuple:
@@ -597,6 +609,16 @@ class TensorQueryClient(Element):
         )
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.props["max-in-flight"])
+        )
+        from ..core.telemetry import REGISTRY
+
+        pname = (
+            self._pipeline.telemetry_label
+            if self._pipeline is not None else ""
+        )
+        self._rtt_hist = REGISTRY.histogram(
+            "nns.query.rtt_seconds",
+            labels={"pipeline": pname, "element": self.name},
         )
 
     def _make_conns(self, targets: List[Tuple[str, int]]) -> list:
@@ -713,17 +735,36 @@ class TensorQueryClient(Element):
                     window_s=max(1.0, float(self.props["timeout"]) * 4),
                     reset_timeout_s=float(self.props["breaker-reset"]),
                     name=f"{self.name}->{key}",
+                    on_trip=self._on_breaker_trip,
                 )
                 self._breakers[key] = b
             return b
 
+    def _on_breaker_trip(self, breaker: CircuitBreaker) -> None:
+        """A remote's breaker tripped open: dump the flight recorder
+        (rate-limited no-op without one) — the frames that burned the
+        failure window are exactly what the ring still holds."""
+        p = self._pipeline
+        if p is not None:
+            p.incident("breaker_trip", self.name, breaker.name)
+
     def health_info(self) -> dict:
         """Element-specific health merged into ``Pipeline.health()``:
-        per-remote breaker snapshots + degrade counters."""
+        per-remote breaker snapshots, degrade counters, and the
+        per-remote latency-segment aggregation (``remotes``) routing
+        will consume."""
         with self._breakers_lock:
             breakers = {k: b.snapshot() for k, b in self._breakers.items()}
+            remotes = {
+                k: {
+                    kk: (round(vv, 3) if isinstance(vv, float) else vv)
+                    for kk, vv in agg.items()
+                }
+                for k, agg in self._remote_spans.items()
+            }
         return {
             "breakers": breakers,
+            "remotes": remotes,
             "breaker_trips_evicted": self._evicted_breaker_trips,
             "degraded_frames": self._degraded,
             "busy_replies": self._busy_replies,
@@ -734,6 +775,79 @@ class TensorQueryClient(Element):
             "retried": self._retried,
             "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
         }
+
+    def metrics_info(self):
+        """Registry samples (core/telemetry.py, scrape time only)."""
+        return [("nns.query.client_inflight", len(self._inflight))]
+
+    _SPAN_EWMA = 0.2  # smoothing for the per-remote load signal
+
+    def _note_span(self, target: Tuple[str, int], req, ans,
+                   t_send: float, t_recv: float) -> None:
+        """Trace-span bookkeeping for one successful exchange: attach the
+        end-to-end decomposition to each answer's meta (``SPAN_META``)
+        and fold it into the per-remote EWMA aggregation.
+
+        Segments are additive BY CONSTRUCTION: the server ships a
+        duration dict whose queue+dispatch+compute equals its total, and
+        wire is defined as rtt minus that total — so client_queue + wire
+        + server_queue + device_dispatch + device_compute == total
+        exactly (clock jitter lands in the wire segment, where it
+        belongs).  Peers that never stamped server spans (v1/legacy)
+        degrade to wire == rtt."""
+        rtt = max(0.0, t_recv - t_send)
+        reqs = req if isinstance(req, list) else [req]
+        answers = ans if isinstance(ans, list) else [ans]
+        last = None
+        for i, a in enumerate(answers):
+            if a is None:
+                continue
+            src = reqs[i] if i < len(reqs) else reqs[-1]
+            srv = a.meta.get(SRV_SPAN_META) or {}
+            srv_total = min(float(srv.get("total", 0.0)), rtt)
+            dispatch = float(srv.get("dispatch", 0.0))
+            compute = float(srv.get("compute", 0.0))
+            queue = max(0.0, srv_total - dispatch - compute)
+            enq = src.meta.get(TL_ENQ_META)
+            cq = max(0.0, t_send - enq) if enq is not None else 0.0
+            span = {
+                "trace_id": src.meta.get(TRACE_ID_META),
+                "remote": f"{target[0]}:{target[1]}",
+                "client_queue": cq,
+                "wire": rtt - srv_total,
+                "server_queue": queue,
+                "device_dispatch": dispatch,
+                "device_compute": compute,
+                "total": cq + rtt,
+            }
+            a.meta[SPAN_META] = span
+            last = span
+        if last is None:
+            return
+        if self._rtt_hist is not None:
+            self._rtt_hist.observe(rtt)
+        addr = last["remote"]
+        alpha = self._SPAN_EWMA
+
+        def roll(old, new):
+            return new if old is None else old + alpha * (new - old)
+
+        with self._breakers_lock:
+            agg = self._remote_spans.setdefault(addr, {
+                "requests": 0, "e2e_ms": None, "rtt_ms": None,
+                "wire_ms": None, "server_ms": None,
+                "client_queue_ms": None,
+            })
+            agg["requests"] += 1
+            agg["e2e_ms"] = roll(agg["e2e_ms"], last["total"] * 1e3)
+            agg["rtt_ms"] = roll(agg["rtt_ms"], rtt * 1e3)
+            agg["wire_ms"] = roll(agg["wire_ms"], last["wire"] * 1e3)
+            agg["server_ms"] = roll(
+                agg["server_ms"],
+                (last["server_queue"] + last["device_dispatch"]
+                 + last["device_compute"]) * 1e3)
+            agg["client_queue_ms"] = roll(
+                agg["client_queue_ms"], last["client_queue"] * 1e3)
 
     def _healthy_order(self, ps: "_PoolState", first: int) -> List[int]:
         """Conn indices of ``ps`` starting at `first`, known-down ones
@@ -860,6 +974,12 @@ class TensorQueryClient(Element):
                 for key in [k for k in self._breakers if k not in keep]:
                     self._evicted_breaker_trips += (
                         self._breakers.pop(key).trip_count)
+                # span EWMAs for vanished endpoints go with them: frozen
+                # rows would keep exporting as "live" load signals (and
+                # grow the dict forever under pod-IP churn)
+                for key in [k for k in self._remote_spans
+                            if k not in keep]:
+                    del self._remote_spans[key]
         for c in retired:
             try:
                 c.close()
@@ -1020,15 +1140,18 @@ class TensorQueryClient(Element):
                 break
             conn = ps.conns[i]
             try:
+                t_send = time.perf_counter()
                 if isinstance(frame, list):
                     result = conn.invoke_batch(frame, req_timeout)
                 else:
                     result = conn.invoke(frame, req_timeout)
+                t_recv = time.perf_counter()
                 ps.down_until.pop(i, None)
                 if breaker is not None:
                     breaker.record_success()
                 self._note_delivered(
                     len(frame) if isinstance(frame, list) else 1)
+                self._note_span(ps.targets[i], frame, result, t_send, t_recv)
                 return result
             except ServerGoawayError as e:
                 # rolling restart: the host is draining.  The request
@@ -1200,6 +1323,18 @@ class TensorQueryClient(Element):
             for f in frames:
                 logical.extend(f.split() if isinstance(f, BatchFrame) else [f])
             frames = logical
+        # trace context (core/telemetry.py): trace_id crosses the wire
+        # (and is minted here when no upstream recorder stamped one); the
+        # enqueue instant stays host-local (TL_ prefix, stripped at
+        # encode) and anchors the client-queue span segment
+        import time as _time
+
+        now = _time.perf_counter()
+        for f in frames:
+            m = f.meta
+            if TRACE_ID_META not in m:
+                m[TRACE_ID_META] = new_trace_id()
+            m[TL_ENQ_META] = now
         if self.props["stream"]:
             # sequential per-request streams: chunk frames of request j
             # leave BEFORE request j+1 is sent (the scheduler pushes each

@@ -288,6 +288,17 @@ class Pipeline:
         self._qos_warn_ts: Dict[str, float] = {}  # per-element warn throttle
         # GstShark-analog tracing (core/tracer.py): None = zero-overhead off
         self.tracer = tracer
+        # fleet telemetry (core/telemetry.py): the registry collector is
+        # registered at start() and the exposition endpoint is opened by
+        # serve_metrics() / NNS_METRICS_PORT; the flight recorder rides
+        # the tracer so the disabled hot path stays one branch per frame
+        self._recorder = None
+        self._metrics_server = None
+        self._collector_registered = False
+        # registry label: claimed lazily (names default to "pipeline", so
+        # the label must be unique among LIVE pipelines or one stop()
+        # would evict a concurrent namesake's instruments)
+        self._telemetry_label: Optional[str] = None
         # streaming-thread fusion (GStreamer semantics): linear chains share
         # one worker unless a boundary (queue / batcher / branch) intervenes
         self._fuse = _env_fuse() if fuse is None else bool(fuse)
@@ -339,8 +350,132 @@ class Pipeline:
         """Attach a fresh PipelineTracer (before start()); returns it.
         ``detail=True`` also records per-call spans for
         ``export_chrome_trace``."""
-        self.tracer = PipelineTracer(detail=detail)
+        recorder = self.tracer.recorder if self.tracer is not None else None
+        self.tracer = PipelineTracer(detail=detail, recorder=recorder)
         return self.tracer
+
+    # -- fleet telemetry (core/telemetry.py) ---------------------------------
+    def enable_flight_recorder(self, capacity: int = 4096,
+                               dump_dir: Optional[str] = None,
+                               min_dump_interval_s: float = 5.0):
+        """Attach a flight recorder: a bounded ring of recent per-frame
+        span timelines, dumped automatically (rate-limited, to log + a
+        JSON file) on watchdog stall, dead-letter, swap rollback, or
+        breaker trip.  Rides the tracer (one is attached if absent), so
+        pipelines without it keep the one-branch-per-frame disabled
+        path.  Returns the recorder."""
+        from ..core.telemetry import FlightRecorder
+
+        if self.tracer is None:
+            self.enable_tracing()
+        self._recorder = FlightRecorder(
+            capacity=capacity, dump_dir=dump_dir,
+            min_dump_interval_s=min_dump_interval_s,
+        )
+        self.tracer.recorder = self._recorder
+        return self._recorder
+
+    @property
+    def flight_recorder(self):
+        return self._recorder
+
+    def incident(self, kind: str, source: str, detail: Any = None
+                 ) -> Optional[str]:
+        """Incident hook (watchdog stall / dead-letter / swap rollback /
+        breaker trip land here): dump the flight recorder, post the dump
+        path on the bus.  No-op without a recorder; rate-limited by the
+        recorder itself.  Returns the dump path, if one was written."""
+        rec = self._recorder
+        if rec is None:
+            return None
+        path = rec.dump(kind, source, detail, logger=self.log)
+        if path is not None:
+            self.post(BusMessage("warning", source, {
+                "incident": kind, "flight_dump": path,
+            }))
+        return path
+
+    @property
+    def telemetry_label(self) -> str:
+        """The ``pipeline=`` label this pipeline's registry series carry:
+        the name when it is unique among live pipelines, else
+        ``name#N`` (claimed lazily, released at stop())."""
+        if self._telemetry_label is None:
+            from ..core.telemetry import claim_pipeline_label
+
+            self._telemetry_label = claim_pipeline_label(self.name)
+        return self._telemetry_label
+
+    def metrics_snapshot(self):
+        """Pollable telemetry snapshot of THIS pipeline: every signal
+        source under its stable dotted name (see
+        Documentation/observability.md).  Cheap enough to poll."""
+        from ..core.telemetry import (
+            REGISTRY,
+            TelemetrySnapshot,
+            collect_pipeline,
+        )
+
+        return TelemetrySnapshot(
+            collect_pipeline(self)
+            + REGISTRY.collect_labeled(pipeline=self.telemetry_label)
+        )
+
+    def telemetry_summary(self) -> Dict[str, float]:
+        """Compact {metric_name: value} dump (counters summed across
+        elements, gauges maxed) — the labeled snapshot bench.py attaches
+        to each evidence row."""
+        return self.metrics_snapshot().flat()
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Open the Prometheus text exposition endpoint (process-wide
+        registry — every running pipeline's series, labeled).  Returns
+        the bound port; ``stop()`` shuts the endpoint down.  Also armed
+        by ``NNS_METRICS_PORT`` at start()."""
+        from ..core.telemetry import MetricsServer
+
+        if self._metrics_server is not None:
+            return self._metrics_server.port
+        self._metrics_server = MetricsServer(
+            port=port, host=host, name=self.name)
+        return self._metrics_server.port
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        srv = self._metrics_server
+        return srv.port if srv is not None else None
+
+    def _register_telemetry(self) -> None:
+        from ..core.telemetry import REGISTRY, collect_pipeline
+
+        if not self._collector_registered:
+            self._collector = lambda: collect_pipeline(self)
+            REGISTRY.register_collector(self._collector)
+            self._collector_registered = True
+        env_port = os.environ.get("NNS_METRICS_PORT", "")
+        if env_port and self._metrics_server is None:
+            try:
+                self.serve_metrics(int(env_port))
+            except (OSError, ValueError) as e:
+                # another pipeline already owns the port (its endpoint
+                # serves the shared registry, so nothing is lost)
+                self.log.info(
+                    "NNS_METRICS_PORT=%s not bound by this pipeline: %s",
+                    env_port, e)
+
+    def _unregister_telemetry(self) -> None:
+        from ..core.telemetry import REGISTRY, release_pipeline_label
+
+        if self._collector_registered:
+            REGISTRY.unregister_collector(self._collector)
+            self._collector_registered = False
+        if self._telemetry_label is not None:
+            REGISTRY.remove_labeled(pipeline=self._telemetry_label)
+            release_pipeline_label(self._telemetry_label)
+            self._telemetry_label = None
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
 
     # -- construction -------------------------------------------------------
     def add(self, *elements: Element) -> Element:
@@ -640,6 +775,7 @@ class Pipeline:
                 for dst, _ in pad.links:
                     self._upstream[dst.name].append(el)
         self._arm_watchdog()
+        self._register_telemetry()
         for el in self.elements.values():
             el._interrupted.clear()
         for seg in self._segments:
@@ -715,6 +851,11 @@ class Pipeline:
         self.post(BusMessage("warning", el.name, {
             "liveness": kind, "elapsed": elapsed, "policy": policy,
         }))
+        # first question after a stall is "where did the time go": dump
+        # the flight recorder (rate-limited no-op without one) while the
+        # stalled frame's open span is still in the ring
+        self.incident(f"watchdog_{kind}", el.name,
+                      {"elapsed": elapsed, "policy": policy})
         if policy == "warn":
             return
         el._interrupted.set()
@@ -793,6 +934,10 @@ class Pipeline:
                 el.stop()
             except Exception:
                 self.log.exception("stop() failed for %s", el.name)
+        # telemetry teardown AFTER element stop: a scrape racing the
+        # shutdown still sees consistent health; the exposition listener
+        # socket is closed synchronously here (leak-check contract)
+        self._unregister_telemetry()
         self._threads.clear()
         self._started = False
 
@@ -1035,6 +1180,7 @@ class Pipeline:
         self.post(BusMessage("warning", el.name, {
             "policy": "skip", "dropped": n, "error": err,
         }))
+        self.incident("dead_letter", el.name, err)
 
     def _restart_element(self, el: Element, err: BaseException) -> str:
         """restart policy: stop+start `el` with exponential backoff.
@@ -1557,6 +1703,8 @@ class Pipeline:
             src_ts = (
                 frame.meta.get(META_SRC_TS) if tracer is not None else None
             )
+            if tracer is not None:
+                tracer.frame_begin(el.name, frame)
             lfs = self._expire_late(el, frame.split())
             st.in_call = len(lfs)
             for k in range(len(lfs)):
@@ -1585,12 +1733,15 @@ class Pipeline:
             if tracer is not None:
                 tracer.frame_out(
                     el.name, t_in, time.perf_counter(), nlog, nbytes, src_ts,
+                    frame=frame,
                 )
             return True
         if not self._expire_late(el, (frame,)):
             return True  # deadline passed: accounted drop (caller recycles)
         st.in_call = getattr(frame, "batch_size", 1)
         t_in = time.perf_counter() if tracer is not None else 0.0
+        if tracer is not None:
+            tracer.frame_begin(el.name, frame)
         if self._fast_path(el, st.watch):
             outs = el.handle_frame(pad, frame) or []
         else:
@@ -1610,6 +1761,7 @@ class Pipeline:
                 getattr(frame, "batch_size", 1),
                 frame_nbytes(frame),
                 frame.meta.get(META_SRC_TS),
+                frame=frame,
             )
         # input consumed (emitted / parked behind pending_frames /
         # delivered): transfer in_call to the unrouted outputs, which
@@ -1914,6 +2066,8 @@ class Pipeline:
                 st.in_call = sum(
                     getattr(f, "batch_size", 1) for f in frames)
                 t_in = time.perf_counter() if tracer is not None else 0.0
+                if tracer is not None:
+                    tracer.frame_begin(el.name, frames[0])
                 outs = self._supervised(
                     el,
                     lambda frames=frames, pad=pad:
@@ -1933,6 +2087,7 @@ class Pipeline:
                         sum(getattr(f, "batch_size", 1) for f in frames),
                         sum(frame_nbytes(f) for f in frames),
                         frames[0].meta.get(META_SRC_TS),
+                        frame=frames[0],
                     )
                 # inputs consumed (emitted / parked behind the element's
                 # pending_frames hook / delivered): in_call transfers to

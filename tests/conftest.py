@@ -76,23 +76,48 @@ def _socket_fd_count() -> int:
     return n
 
 
+def _live_metrics_servers() -> int:
+    """Open telemetry exposition servers (each owns a listener socket +
+    a '<name>-metrics' thread).  Lazy import: modules that never touch
+    telemetry must not pay for it."""
+    mod = sys.modules.get("nnstreamer_tpu.core.telemetry")
+    if mod is None:
+        return 0
+    return mod.live_server_count()
+
+
 @pytest.fixture(scope="module")
 def module_leak_check():
-    """Assert the module left no framework threads and no net-new socket
-    fds behind (bounded convergence wait — teardown is asynchronous)."""
+    """Assert the module left no framework threads, no net-new socket
+    fds, and no open metrics-exposition server behind (bounded
+    convergence wait — teardown is asynchronous).
+
+    The metrics endpoint is covered twice: its serve thread is named
+    ``<owner>-metrics`` (visible to the thread census — never a
+    ``Thread-N`` the ignore list skips) and its listener socket counts
+    in the fd census; the explicit server count makes the failure
+    message say WHAT leaked instead of just 'a socket'."""
     threads_before = _live_framework_threads()
     sockets_before = _socket_fd_count()
+    metrics_before = _live_metrics_servers()
     yield
     deadline = time.monotonic() + 8.0
     leaked_threads: set = set()
     sockets_now = sockets_before
+    metrics_now = metrics_before
     while time.monotonic() < deadline:
         leaked_threads = _live_framework_threads() - threads_before
         sockets_now = _socket_fd_count()
-        if not leaked_threads and (
+        metrics_now = _live_metrics_servers()
+        if not leaked_threads and metrics_now <= metrics_before and (
                 sockets_before < 0 or sockets_now <= sockets_before):
             break
         time.sleep(0.05)
+    assert metrics_now <= metrics_before, (
+        f"leaked metrics exposition server(s) after module: "
+        f"{metrics_before} -> {metrics_now} (Pipeline.stop() must close "
+        "the endpoint)"
+    )
     assert not leaked_threads, (
         f"leaked framework threads after module: {sorted(leaked_threads)}"
     )

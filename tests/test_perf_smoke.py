@@ -65,6 +65,33 @@ def test_fusion_speedup_and_absolute_floor():
     assert fused >= 4000
 
 
+def test_telemetry_disabled_per_frame_overhead():
+    """PR-7 pin: with the telemetry layer present but DISABLED (the
+    default — no tracer, no flight recorder, no exposition endpoint),
+    per-frame cost stays the tracer's single `is not None` branch, so
+    the fused identity chain still clears the PR-3/PR-6 absolute floor.
+    Structural half of the pin: a started pipeline holds no tracer or
+    recorder object at all (registry collection is scrape-time only),
+    so that branch IS the telemetry integration's entire hot-path
+    footprint."""
+    from nnstreamer_tpu.core import telemetry
+
+    pipe = parse_pipeline(CHAIN, name="teloff", fuse=True)
+    pipe.start()
+    try:
+        assert pipe.tracer is None
+        assert pipe.flight_recorder is None
+        assert telemetry.live_server_count() == 0
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=10)
+    finally:
+        pipe.stop()
+    fps = _passthrough_fps(True)
+    assert fps >= 4000, (
+        f"telemetry-disabled dataplane regressed: {fps:.0f} fps < 4000"
+    )
+
+
 def test_hot_path_allocation_budget():
     """tracemalloc gate: the fused dispatch loop must not RETAIN
     allocations per frame in steady state (frame-pool regression, a
@@ -221,12 +248,7 @@ def test_dispatch_window_nonblocking_tracks_backend():
     )
 
 
-def test_host_ingest_overlap_speedup():
-    """Acceptance gate: the double-buffered staging lane beats serialized
-    stack+transfer+compute by >= 1.3x on equal costs (measured ~1.8x at
-    4ms/4ms; the lane hides the whole transfer behind compute).  Runs
-    the SAME harness bench.py publishes as `ingest_overlap_speedup` in
-    its cpu_proxy evidence — the gate and the evidence cannot drift."""
+def _load_bench():
     import importlib.util
     import os
 
@@ -235,6 +257,43 @@ def test_host_ingest_overlap_speedup():
     spec = importlib.util.spec_from_file_location("bench_for_perf", bench_path)
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+def test_pipeline_vs_raw_proxy_floor():
+    """ROADMAP items 1+5 gate: the full dataplane must deliver >= 60% of
+    the bare backend's throughput when both run the same async-sim
+    device costs with the same depth-8 window structure (measured
+    ~0.9-1.0x — the async feed hides framework cost behind compute; the
+    pre-async serial design measured ~0.6x).  SAME harness bench.py
+    publishes as `pipeline_vs_raw` in its cpu_proxy evidence, so the
+    gate and the evidence cannot drift — the PR-6 gains can only shrink
+    loudly."""
+    bench = _load_bench()
+    best = (0.0, 0.0, 0.0)
+    for _attempt in range(2):  # best-of-2: CI scheduling noise, not code
+        raw_fps, pipe_fps = bench.measure_pipeline_vs_raw(nbatches=24)
+        assert raw_fps > 0 and pipe_fps > 0
+        ratio = pipe_fps / raw_fps
+        if ratio > best[0]:
+            best = (ratio, raw_fps, pipe_fps)
+        if best[0] >= 0.6:
+            break
+    ratio, raw_fps, pipe_fps = best
+    assert ratio >= 0.6, (
+        f"pipeline_vs_raw proxy regressed: pipeline {pipe_fps:.0f} fps vs "
+        f"raw {raw_fps:.0f} fps ({ratio:.2f}x < 0.6x; steady state "
+        "measures ~0.75-0.9x)"
+    )
+
+
+def test_host_ingest_overlap_speedup():
+    """Acceptance gate: the double-buffered staging lane beats serialized
+    stack+transfer+compute by >= 1.3x on equal costs (measured ~1.8x at
+    4ms/4ms; the lane hides the whole transfer behind compute).  Runs
+    the SAME harness bench.py publishes as `ingest_overlap_speedup` in
+    its cpu_proxy evidence — the gate and the evidence cannot drift."""
+    bench = _load_bench()
 
     t_serial, t_lane = bench.measure_ingest_overlap(nb=16)
     speedup = t_serial / t_lane

@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Lint gate: health/telemetry schema stability.
+
+Every ``health_info()`` key and every registered metric name is part of
+the observability API — dashboards, the perf driver, and fleet routing
+consume them by name, so a silent rename is a breaking change nothing in
+the type system catches.  This lint (run from tier-1 alongside
+``check_no_bare_except`` / ``check_blocking_timeouts``) enforces three
+contracts, statically (AST only — no imports, no side effects):
+
+1. **Snapshot**: the union of health keys + metric names must equal
+   ``tools/health_schema.json``.  A deliberate schema change regenerates
+   it (``--write``) — the diff then shows up in review; an accidental
+   rename fails loudly.
+2. **Documented**: every name must appear backticked in
+   ``Documentation/*.md`` (the observability reference tables).
+3. **Catalogued**: every ``nns.*`` metric-name literal used by element
+   ``metrics_info()`` hooks or the telemetry collector must be declared
+   in ``telemetry.METRICS``, and every ``HEALTH_KEY_METRICS`` target
+   must resolve into the catalog.
+
+What is scanned: functions named ``health_info`` / ``liveness_snapshot``
+/ ``metrics_info`` anywhere in the package, plus the scoped set below
+(``Pipeline.health``, breaker/swap/admission ``snapshot``s, and the two
+span-schema builders) — string dict-literal keys and string subscript
+assignments inside them.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "nnstreamer_tpu")
+SNAPSHOT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "health_schema.json")
+DOC_DIRS = [os.path.join(ROOT, "Documentation")]
+DOC_FILES = [os.path.join(ROOT, "README.md")]
+
+#: function names scanned wherever they appear in the package
+SCAN_FUNCS = {"health_info", "liveness_snapshot", "metrics_info"}
+#: (relative path -> function names) scanned only there
+SCAN_SCOPED: Dict[str, Set[str]] = {
+    "pipeline/pipeline.py": {"health"},
+    "core/resilience.py": {"snapshot"},       # CircuitBreaker
+    "core/lifecycle.py": {"snapshot"},        # HotSwapCoordinator
+    "core/liveness.py": {"snapshot"},         # Watchdog + Admission
+    "elements/query.py": {"_note_span"},      # client span + remote agg
+    "distributed/service.py": {"_stamp_server_spans"},  # server span
+}
+TELEMETRY_PY = os.path.join(PKG, "core", "telemetry.py")
+
+
+def _iter_sources():
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _str_keys(fn_node: ast.AST) -> Set[str]:
+    """String dict-literal keys + string subscript-assign keys inside one
+    function body."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    keys.add(t.slice.value)
+    return keys
+
+
+def _metric_literals(fn_node: ast.AST) -> Set[str]:
+    """Every complete ``nns.*`` string literal inside one function
+    (f-string fragments — dynamic names like the ``nns.health.<key>``
+    auto-map — are excluded)."""
+    in_fstring = {
+        id(v) for node in ast.walk(fn_node)
+        if isinstance(node, ast.JoinedStr) for v in node.values
+    }
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and node.value.startswith("nns.")
+                and id(node) not in in_fstring):
+            out.add(node.value)
+    return out
+
+
+def collect() -> Tuple[Set[str], Set[str], Set[str], List[str]]:
+    """(health_keys, metric_names_catalog, metric_literals_used,
+    parse_problems)."""
+    health_keys: Set[str] = set()
+    used_metrics: Set[str] = set()
+    problems: List[str] = []
+    for path in _iter_sources():
+        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+        want = set(SCAN_FUNCS) | SCAN_SCOPED.get(rel, set())
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            problems.append(f"{rel}: unparseable: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in want:
+                continue
+            keys = _str_keys(node)
+            # metric-name literals are catalogued, not health keys
+            health_keys |= {k for k in keys if not k.startswith("nns.")}
+            used_metrics |= _metric_literals(node)
+    # telemetry catalog (METRICS) + the health-key mapping targets
+    catalog: Set[str] = set()
+    mapping_targets: Set[str] = set()
+    with open(TELEMETRY_PY) as f:
+        tree = ast.parse(f.read(), filename=TELEMETRY_PY)
+    for node in tree.body:
+        target = None
+        value = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            target, value = node.target.id, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        if target == "METRICS" and isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    catalog.add(k.value)
+        elif target == "HEALTH_KEY_METRICS" and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    health_keys.add(k.value)
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    mapping_targets.add(v.value)
+        elif target == "HEALTH_KEYS_SPECIAL" and isinstance(
+                value, (ast.Tuple, ast.List)):
+            for el in value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    health_keys.add(el.value)
+    # the collector itself uses literal metric names too
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name == "collect_pipeline":
+            used_metrics |= _metric_literals(node)
+    for m in sorted(mapping_targets - catalog):
+        problems.append(
+            f"HEALTH_KEY_METRICS maps to {m!r}, which is not in "
+            "telemetry.METRICS")
+    return health_keys, catalog, used_metrics, problems
+
+
+def _doc_text() -> str:
+    chunks = []
+    for d in DOC_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(d):
+            for fn in filenames:
+                if fn.endswith(".md"):
+                    with open(os.path.join(dirpath, fn)) as f:
+                        chunks.append(f.read())
+    for p in DOC_FILES:
+        if os.path.exists(p):
+            with open(p) as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def scan() -> List[str]:
+    """All schema problems (empty = clean).  Importable from tests."""
+    health_keys, catalog, used_metrics, problems = collect()
+    # 3. catalog coverage for metric literals actually used
+    for m in sorted(used_metrics - catalog):
+        if m.startswith("nns.health."):
+            continue  # the documented auto-map namespace
+        problems.append(
+            f"metric literal {m!r} is used but not declared in "
+            "telemetry.METRICS")
+    # 2. documentation coverage (backticked occurrence)
+    docs = _doc_text()
+    for name in sorted(health_keys | catalog):
+        if f"`{name}`" not in docs:
+            problems.append(
+                f"{name!r} is not documented (no backticked mention in "
+                "Documentation/*.md)")
+    # 1. snapshot equality
+    current = {
+        "health_keys": sorted(health_keys),
+        "metric_names": sorted(catalog),
+    }
+    if not os.path.exists(SNAPSHOT_PATH):
+        problems.append(
+            f"snapshot {SNAPSHOT_PATH} missing; run "
+            "`python tools/check_health_schema.py --write`")
+        return problems
+    with open(SNAPSHOT_PATH) as f:
+        snap = json.load(f)
+    for field in ("health_keys", "metric_names"):
+        have = set(current[field])
+        want = set(snap.get(field, []))
+        for name in sorted(want - have):
+            problems.append(
+                f"{field}: {name!r} disappeared from the code — a silent "
+                "rename/removal breaks consumers; if deliberate, update "
+                "Documentation/observability.md and regenerate the "
+                "snapshot (--write)")
+        for name in sorted(have - want):
+            problems.append(
+                f"{field}: {name!r} is new — document it in "
+                "Documentation/observability.md and regenerate the "
+                "snapshot (--write)")
+    return problems
+
+
+def write_snapshot() -> None:
+    health_keys, catalog, _used, problems = collect()
+    for p in problems:
+        print(f"[schema] {p}", file=sys.stderr)
+    with open(SNAPSHOT_PATH, "w") as f:
+        json.dump({
+            "health_keys": sorted(health_keys),
+            "metric_names": sorted(catalog),
+        }, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {SNAPSHOT_PATH}")
+
+
+def main() -> int:
+    if "--write" in sys.argv[1:]:
+        write_snapshot()
+        return 0
+    problems = scan()
+    for p in problems:
+        print(f"[schema] {p}")
+    if problems:
+        print(f"{len(problems)} health/metric schema problem(s)")
+        return 1
+    print("health/metric schema clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
