@@ -47,14 +47,45 @@ class ServerBusyError(RemoteApplicationError):
     """The server refused the request at ADMISSION (load shed).
 
     Subclasses :class:`RemoteApplicationError`: the server answered, so
-    breakers/cooldowns must not count it against the remote's health.
+    breakers/cooldowns must not count it against the remote's health —
+    tenant-quota refusals included (one tenant over ITS quota says
+    nothing about the server's ability to serve anyone else).
     Admission-refused requests provably never executed, which makes a
     resend safe even under at-most-once delivery — clients retry these
-    on a RetryPolicy-paced budget separate from ``retries``."""
+    on a RetryPolicy-paced budget separate from ``retries``.
 
-    def __init__(self, msg: str = "server busy", retry_after: float = 0.05):
+    ``tenant``/``reason`` identify WHY the shed happened (``"quota"`` =
+    the tenant's own quota, ``"priority"`` = priority-class headroom,
+    ``"load"`` = the global watermark): diagnostics only, the client
+    contract is identical for all three."""
+
+    def __init__(self, msg: str = "server busy", retry_after: float = 0.05,
+                 tenant: str = "", reason: str = "load"):
         super().__init__(msg)
         self.retry_after = float(retry_after)
+        self.tenant = tenant
+        self.reason = reason
+
+
+# -- tenant identity on the wire --------------------------------------------
+#: frame.meta key carrying the requesting tenant's name.  An ORDINARY
+#: meta key (no TL_ prefix): it crosses both transports inside the JSON
+#: meta blob, so per-tenant admission needs no wire-format change.
+TENANT_META = "_nns_tenant"
+#: frame.meta key carrying the request's priority class, 0..3 (3 =
+#: highest).  Requests without it are treated as priority 3 — the exact
+#: pre-tenancy admission semantics.
+PRIORITY_META = "_nns_priority"
+#: priority classes (inclusive bounds)
+PRIORITY_MIN, PRIORITY_MAX = 0, 3
+
+
+def clamp_priority(p) -> int:
+    try:
+        p = int(p)
+    except (TypeError, ValueError):
+        return PRIORITY_MAX
+    return max(PRIORITY_MIN, min(PRIORITY_MAX, p))
 
 
 # ---------------------------------------------------------------------------
@@ -367,3 +398,236 @@ class AdmissionController:
                 "admitted": self.admitted,
                 "shed": self.shed,
             }
+
+
+class TenantAdmissionController(AdmissionController):
+    """Per-tenant quotas and priority classes layered on the watermark
+    admission controller — the "one hot tenant must shed before
+    starving the fleet" piece of fleet overload resilience.
+
+    Check order (the shed truth table, pinned by tests):
+
+    1. **Tenant quota** (``reason="quota"``): a named tenant may hold at
+       most ``quota`` in-flight slots (per-tenant override in
+       ``quotas``, else ``default_quota``; 0 = unlimited; unnamed
+       requests are never quota-checked).  The refusal is weighted
+       per-tenant: ``retry_after`` grows with the tenant's consecutive
+       shed streak (capped 8x) so a tenant hammering its quota is paced
+       harder than one that just grazed it, and an admit resets the
+       pacing.
+    2. **Priority headroom** (``reason="priority"``): with a global
+       ``high`` watermark armed, priority class ``p`` (0..3) may only
+       fill ``ceil(high * (p+1) / 4)`` slots — low-priority work hits
+       its ceiling first, so under pressure it sheds while priority-3
+       traffic still has headroom.  Requests without a priority class
+       are priority 3: the exact pre-tenancy admission semantics.
+    3. **Global watermark** (``reason="load"``): the inherited
+       high/low-hysteresis band, applied to everything.
+
+    All three refusals surface as :class:`ServerBusyError` — answered
+    instantly at admission, provably never executed, breaker-immune.
+
+    **Sustained-shed incidents**: a tenant whose QUOTA sheds persist
+    beyond ``shed_window_s`` without a single admit fires
+    ``on_sustained_shed(tenant)`` (rate-limited to once per window per
+    tenant) — the serversrc routes it into the pipeline's flight
+    recorder so "who is drowning this server" is answerable without a
+    repro.
+
+    Single-lock design: quota, priority, and watermark accounting
+    update atomically, so per-tenant ``admitted/shed/inflight`` counts
+    are exact even under concurrent admission (the acceptance contract
+    of the fleet chaos e2e)."""
+
+    def __init__(self, high: int = 0, low: Optional[int] = None,
+                 default_quota: int = 0,
+                 quotas: Optional[Dict[str, int]] = None,
+                 shed_window_s: float = 5.0,
+                 on_sustained_shed: Optional[Callable[[str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(high, low)
+        self.default_quota = max(0, int(default_quota))
+        self.quotas: Dict[str, int] = {
+            str(k): max(0, int(v)) for k, v in (quotas or {}).items()
+        }
+        self.shed_window_s = float(shed_window_s)
+        self.on_sustained_shed = on_sustained_shed
+        self._clock = clock
+        # priority-class admission ceilings (high > 0 only); p=3 equals
+        # `high` so the top class is governed by the watermark alone
+        if self.high > 0:
+            self._pri_high = [
+                -(-self.high * (p + 1) // 4) for p in range(4)
+            ]
+        else:
+            self._pri_high = None
+        # LRU-ordered so the bound below can evict the LEAST-recently
+        # active idle tenant: the tenant name comes straight off the
+        # wire (client-controlled), so an unbounded dict would let a
+        # hostile peer grow server memory and metric cardinality forever
+        self._tenants: "Dict[str, Dict[str, Any]]" = {}
+        self.tenants_evicted = 0
+
+    #: cap on the streak-scaled retry-after multiplier (quota sheds)
+    RETRY_AFTER_CAP = 8.0
+    #: max tracked tenant ledgers; idle (inflight == 0) least-recently
+    #: active entries are evicted beyond this (their admitted/shed
+    #: history stays in the aggregate counters; `tenants_evicted`
+    #: counts the dropped rows so truncation is never silent)
+    TENANT_MAP_MAX = 1024
+
+    def quota_for(self, tenant: str) -> int:
+        """The in-flight quota governing ``tenant`` (0 = unlimited;
+        unnamed tenants are never quota-bound)."""
+        if not tenant:
+            return 0
+        return self.quotas.get(tenant, self.default_quota)
+
+    def _tenant_entry(self, tenant: str) -> Dict[str, Any]:
+        t = self._tenants.get(tenant)
+        if t is None:
+            if len(self._tenants) >= self.TENANT_MAP_MAX:
+                # evict the least-recently ACTIVE idle ledger (dicts
+                # iterate in insertion order; _touch re-inserts on every
+                # admit/shed, so iteration order IS activity order) —
+                # in-flight tenants are never evicted, their release
+                # accounting must find them
+                for name, row in self._tenants.items():
+                    if row["inflight"] == 0:
+                        del self._tenants[name]
+                        self.tenants_evicted += 1
+                        break
+            t = {
+                "inflight": 0, "admitted": 0, "shed": 0,
+                "streak": 0, "shed_since": None,
+                "last_incident": float("-inf"),
+            }
+            self._tenants[tenant] = t
+        return t
+
+    def _touch(self, tenant: str, t: Dict[str, Any]) -> None:
+        """Move the ledger to the back of the activity order (cheap
+        LRU: delete + re-insert on the plain dict)."""
+        if next(reversed(self._tenants), None) != tenant:
+            del self._tenants[tenant]
+            self._tenants[tenant] = t
+
+    def admit(self, n: int = 1, tenant: str = "",
+              priority: int = PRIORITY_MAX,
+              retry_after: float = 0.05) -> None:
+        """Admit ``n`` slots for ``tenant`` at ``priority`` or raise
+        :class:`ServerBusyError` carrying the per-tenant retry-after.
+        Pair every successful call with :meth:`release`."""
+        tenant = str(tenant or "")
+        p = clamp_priority(priority)
+        fire: Optional[str] = None
+        err: Optional[ServerBusyError] = None
+        with self._lock:
+            t = self._tenant_entry(tenant)
+            quota = self.quota_for(tenant)
+            reason = None
+            if quota > 0 and t["inflight"] + n > quota:
+                reason = "quota"
+            elif self._pri_high is not None:
+                # base watermark semantics first (identical to
+                # AdmissionController for priority 3), then the
+                # priority-class ceiling — a hard threshold with no
+                # hysteresis of its own (the global band supplies that)
+                if self._shedding and self._inflight > self.low:
+                    reason = "load"
+                elif self._inflight + n > self.high:
+                    self._shedding = True
+                    reason = "load"
+                elif (p < PRIORITY_MAX
+                        and self._inflight + n > self._pri_high[p]):
+                    reason = "priority"
+                else:
+                    self._shedding = False
+            if reason is None:
+                t["inflight"] += n
+                t["admitted"] += n
+                t["streak"] = 0
+                t["shed_since"] = None
+                self._inflight += n
+                self.admitted += n
+                self._touch(tenant, t)
+            else:
+                t["shed"] += n
+                self.shed += n
+                self._touch(tenant, t)
+                pace = float(retry_after)
+                if reason == "quota":
+                    # streak-scaled pacing is a QUOTA property: a tenant
+                    # hammering its own quota backs off harder.  Global
+                    # load/priority sheds keep the flat pre-tenancy
+                    # retry-after — otherwise unnamed clients sharing
+                    # the "" ledger would couple each other's pacing
+                    t["streak"] += 1
+                    pace *= min(self.RETRY_AFTER_CAP, float(t["streak"]))
+                    now = self._clock()
+                    if t["shed_since"] is None:
+                        t["shed_since"] = now
+                    elif (now - t["shed_since"] >= self.shed_window_s
+                            and now - t["last_incident"]
+                            >= self.shed_window_s):
+                        t["last_incident"] = now
+                        fire = tenant
+                err = ServerBusyError(
+                    f"server busy ({reason}"
+                    + (f", tenant={tenant}" if tenant else "") + ")",
+                    retry_after=pace, tenant=tenant, reason=reason,
+                )
+        if fire is not None and self.on_sustained_shed is not None:
+            try:
+                self.on_sustained_shed(fire)
+            except Exception:  # accounting hook must never break admission
+                log.exception("on_sustained_shed(%r) failed", fire)
+        if err is not None:
+            raise err
+
+    def release(self, n: int = 1, tenant: str = "") -> None:
+        tenant = str(tenant or "")
+        with self._lock:
+            self._inflight = max(0, self._inflight - n)
+            if self._shedding and self._inflight <= self.low:
+                self._shedding = False
+            t = self._tenants.get(tenant)
+            if t is not None:
+                t["inflight"] = max(0, t["inflight"] - n)
+
+    def tenant_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Exact per-tenant accounting for health()/metrics: {tenant:
+        {inflight, admitted, shed, quota}}."""
+        with self._lock:
+            return {
+                name: {
+                    "inflight": t["inflight"],
+                    "admitted": t["admitted"],
+                    "shed": t["shed"],
+                    "quota": self.quota_for(name),
+                }
+                for name, t in self._tenants.items()
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = super().snapshot()
+        snap["tenants"] = self.tenant_snapshot()
+        snap["tenants_evicted"] = self.tenants_evicted
+        return snap
+
+
+def parse_tenant_quotas(raw: str, owner: str = "") -> Dict[str, int]:
+    """Parse a ``"tenantA:8,tenantB:4"`` property value into a quota
+    dict (shared by the serversrc prop and the chaos harness)."""
+    out: Dict[str, int] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, q = part.rpartition(":")
+        if not sep or not name or not q.lstrip("-").isdigit() or int(q) < 0:
+            raise ValueError(
+                f"{owner or 'tenant-quotas'}: bad entry {part!r} "
+                "(want tenant:quota, quota >= 0)")
+        out[name] = int(q)
+    return out

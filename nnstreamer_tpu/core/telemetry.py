@@ -141,8 +141,15 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.query.ingress_depth": ("gauge", "frames queued for the server pipeline"),
     "nns.query.corrupt_requests": ("counter", "corrupt requests refused"),
     "nns.query.goaway_sent": ("counter", "requests refused with GOAWAY"),
+    # per-tenant admission (TenantAdmissionController; tenant= label)
+    "nns.query.tenant_inflight": ("gauge", "requests in flight for the tenant"),
+    "nns.query.tenant_admitted": ("counter", "requests admitted for the tenant"),
+    "nns.query.tenant_shed": ("counter", "requests shed for the tenant (quota/priority/load)"),
+    "nns.query.tenant_quota": ("gauge", "in-flight quota governing the tenant (0 = unlimited)"),
     # tensor_query client (failover / integrity / degrade / spans)
     "nns.query.client_inflight": ("gauge", "client requests dispatched and unanswered"),
+    "nns.query.affinity_remaps": ("counter", "consistent-hash affinity owner changes (fleet resizes)"),
+    "nns.query.remote_inflight": ("gauge", "live client requests in flight to the remote"),
     "nns.query.delivered": ("counter", "logical frames answered by a server"),
     "nns.query.retried": ("counter", "extra attempts dispatched, all causes"),
     "nns.query.busy_replies": ("counter", "BUSY sheds seen"),
@@ -217,6 +224,7 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "corruption_detected": "nns.query.corruption_detected",
     "degraded_frames": "nns.query.degraded_frames",
     "breaker_trips_evicted": "nns.query.breaker_trips_evicted",
+    "affinity_remaps": "nns.query.affinity_remaps",
     "corrupt_dropped": "nns.wire.corrupt_dropped",
     "truncated_samples": "nns.datarepo.truncated_samples",
     "pending_frames": "nns.source.pending",
@@ -228,6 +236,8 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
 HEALTH_KEYS_SPECIAL = (
     "state", "policy", "last_error", "model", "servers", "breakers",
     "remotes", "lifecycle", "swap_state", "swap_last_error",
+    # fleet routing / tenancy (handled by dedicated collector branches)
+    "tenants", "remote_inflight", "endpoint_hints", "routing",
 )
 
 
@@ -860,6 +870,28 @@ def collect_pipeline(pipe) -> List[Sample]:
                     out.append(Sample(
                         "nns.query.breaker_failures", dict(rl),
                         snap.get("recent_failures", 0), "gauge"))
+                continue
+            if key == "tenants" and isinstance(val, dict):
+                for tenant, row in val.items():
+                    tl = {**labels, "tenant": tenant or "_"}
+                    out.append(Sample(
+                        "nns.query.tenant_inflight", dict(tl),
+                        row.get("inflight", 0), "gauge"))
+                    out.append(Sample(
+                        "nns.query.tenant_admitted", dict(tl),
+                        row.get("admitted", 0), "counter"))
+                    out.append(Sample(
+                        "nns.query.tenant_shed", dict(tl),
+                        row.get("shed", 0), "counter"))
+                    out.append(Sample(
+                        "nns.query.tenant_quota", dict(tl),
+                        row.get("quota", 0), "gauge"))
+                continue
+            if key == "remote_inflight" and isinstance(val, dict):
+                for remote, v in val.items():
+                    out.append(Sample(
+                        "nns.query.remote_inflight",
+                        {**labels, "remote": remote}, v, "gauge"))
                 continue
             if key == "remotes" and isinstance(val, dict):
                 for remote, agg in val.items():

@@ -32,9 +32,10 @@ class Announcement:
                  info: dict, logger=None):
         self.topic = topic
         self.log = logger or log
+        self.info = dict(info)
         self._client = MqttClient(broker_host, broker_port)
         self._client.publish(
-            topic, json.dumps(info).encode(), retain=True, qos=1
+            topic, json.dumps(self.info).encode(), retain=True, qos=1
         )
         # QoS-1 ack before the caller proceeds: "started" must imply
         # "discoverable", or a client racing the start misses the server
@@ -42,6 +43,28 @@ class Announcement:
             self.log.warning(
                 "endpoint announce on %s unacknowledged by the broker",
                 topic,
+            )
+
+    def update(self, patch: dict, wait_ack: bool = True) -> None:
+        """Merge ``patch`` into the announce and re-publish it retained:
+        the discovery plane carries live server STATE (draining flag,
+        load summary), not just topology — late discoverers see the
+        current state, subscribed discoverers see the change.
+
+        ``wait_ack=False`` skips the QoS-1 ack wait: a state update
+        published from a serving thread (the serversrc's drain entry)
+        must not stall behind a slow broker — the publish is still
+        QoS-1 on the socket, only the confirmation wait is elided."""
+        if self._client is None:
+            return
+        self.info.update(patch)
+        self._client.publish(
+            self.topic, json.dumps(self.info).encode(), retain=True, qos=1
+        )
+        if wait_ack and self._client.drain(5.0):
+            self.log.warning(
+                "endpoint announce update on %s unacknowledged by the "
+                "broker", self.topic,
             )
 
     def clear(self) -> None:

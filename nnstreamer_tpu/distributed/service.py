@@ -36,7 +36,15 @@ import grpc
 
 from ..core.buffer import BatchFrame, TensorFrame
 from ..core.lifecycle import ServerGoawayError
-from ..core.liveness import AdmissionController, ServerBusyError, stamp_deadline
+from ..core.liveness import (
+    PRIORITY_MAX,
+    PRIORITY_META,
+    TENANT_META,
+    ServerBusyError,
+    TenantAdmissionController,
+    clamp_priority,
+    stamp_deadline,
+)
 from ..core.log import get_logger
 from ..core.telemetry import SRV_SPAN_META, TL_INVOKE_META, TL_RX_META
 from ..core.types import StreamSpec
@@ -86,11 +94,14 @@ class QueryServerCore:
         self._tcp = None  # raw-TCP transport (tcp_query.TcpQueryServer)
         self.refs = 0
         # overload admission (core/liveness.py): default unlimited; the
-        # serversrc's max-inflight/low-watermark props rebuild it.  Shed
-        # requests are refused with BUSY before touching the ingress
-        # queue — overload answers in O(1) instead of timing out deep in
-        # the pipeline.
-        self.admission = AdmissionController(0)
+        # serversrc's max-inflight/low-watermark/tenant-quota props
+        # rebuild it.  Shed requests are refused with BUSY before
+        # touching the ingress queue — overload answers in O(1) instead
+        # of timing out deep in the pipeline.  Tenant identity and
+        # priority ride the request meta (TENANT_META / PRIORITY_META),
+        # so per-tenant quotas and weighted shedding work identically
+        # over both transports with no wire-format change.
+        self.admission = TenantAdmissionController(0)
         self.busy_retry_after = 0.05
         self.expired_drops = 0  # requests expired before ingest
         # data-plane integrity (Documentation/wire-protocol.md): both
@@ -171,8 +182,7 @@ class QueryServerCore:
             # request provably never executed (resend-safe failover)
             self.goaway_sent += 1
             raise ServerGoawayError()
-        if not self.admission.try_admit():
-            raise ServerBusyError(retry_after=self.busy_retry_after)
+        tenant = self._admit(frames)
         try:
             budget = min(timeout, 300.0)
             # trace spans (core/telemetry.py): stamp the receive instant
@@ -199,7 +209,40 @@ class QueryServerCore:
                 self._stamp_server_spans(answers)
                 return answers
         finally:
-            self.admission.release()
+            self._release(tenant)
+
+    @staticmethod
+    def request_identity(frames: List[TensorFrame]) -> Tuple[str, int]:
+        """(tenant, priority) of one request, read from the first
+        frame's meta — the identity rides the ordinary JSON meta blob,
+        so it crosses both transports unchanged.  Absent keys degrade
+        to the pre-tenancy semantics (unnamed tenant, priority 3)."""
+        meta = frames[0].meta if frames else {}
+        tenant = str(meta.get(TENANT_META, "") or "")
+        priority = clamp_priority(meta.get(PRIORITY_META, PRIORITY_MAX))
+        return tenant, priority
+
+    def _admit(self, frames: List[TensorFrame]) -> str:
+        """Tenant-aware admission for one request (both transports,
+        unary + stream).  Raises :class:`ServerBusyError` carrying the
+        per-tenant retry-after on any shed; returns the tenant to hand
+        back to :meth:`_release`."""
+        tenant, priority = self.request_identity(frames)
+        adm = self.admission
+        if isinstance(adm, TenantAdmissionController):
+            adm.admit(tenant=tenant, priority=priority,
+                      retry_after=self.busy_retry_after)
+        elif not adm.try_admit():
+            # a plain AdmissionController swapped in by tests/tools
+            raise ServerBusyError(retry_after=self.busy_retry_after)
+        return tenant
+
+    def _release(self, tenant: str) -> None:
+        adm = self.admission
+        if isinstance(adm, TenantAdmissionController):
+            adm.release(tenant=tenant)
+        else:
+            adm.release()
 
     @staticmethod
     def _stamp_server_spans(answers: List[TensorFrame]) -> None:
@@ -310,10 +353,12 @@ class QueryServerCore:
             self.goaway_sent += 1
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "goaway: server draining")
-        if not self.admission.try_admit():
+        try:
+            tenant = self._admit([frame])
+        except ServerBusyError as e:
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"server busy; retry_after={self.busy_retry_after:.6f}",
+                f"server busy; retry_after={e.retry_after:.6f}",
             )
         try:
             with self._pending_client([frame]) as answer_q:
@@ -348,7 +393,7 @@ class QueryServerCore:
                                     self._heuristic_closed.append(cid)
                         return
         finally:
-            self.admission.release()
+            self._release(tenant)
 
     def resolve(self, client_id: int, frame: TensorFrame,
                 limit: int = 0) -> bool:
@@ -408,6 +453,13 @@ class QueryServerCore:
             "shedding": snap["shedding"],
             "admission_high": snap["high"],
             "admission_low": snap["low"],
+            # exact per-tenant {inflight, admitted, shed, quota} rows —
+            # the fleet-chaos accounting contract (empty for a plain
+            # AdmissionController swapped in by tests); tenants_evicted
+            # counts idle ledgers dropped by the cardinality bound, so
+            # a truncated tenant table is never silent
+            "tenants": snap.get("tenants", {}),
+            "tenants_evicted": snap.get("tenants_evicted", 0),
             "ingress_depth": self.ingress.qsize(),
             "corrupt_requests": self.corrupt_requests,
             "draining": self.draining,

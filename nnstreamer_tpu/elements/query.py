@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Deque, Iterator, List, Optional, Tuple
@@ -28,9 +28,21 @@ from ..core.buffer import BatchFrame, CustomEvent, TensorFrame
 from ..core.lifecycle import ServerGoawayError
 from ..core.liveness import (
     DEADLINE_META,
-    AdmissionController,
+    PRIORITY_MAX,
+    PRIORITY_META,
+    TENANT_META,
     ServerBusyError,
+    TenantAdmissionController,
     deadline_remaining,
+    parse_tenant_quotas,
+)
+from ..core.routing import (
+    TIER_DOWN,
+    TIER_DRAINING,
+    TIER_OK,
+    ewma_scores,
+    order_remotes,
+    rendezvous_owner,
 )
 from ..core.resilience import (
     CircuitBreaker,
@@ -111,7 +123,23 @@ class TensorQueryServerSrc(SourceElement):
             "until in-flight drains to this (0 = max-inflight/2)"),
         "retry-after": Property(
             float, 0.05, "seconds suggested to BUSY-shed clients before "
-            "they retry"),
+            "they retry (per-tenant sheds scale this with the tenant's "
+            "shed streak)"),
+        # per-tenant admission (core/liveness.py TenantAdmissionController):
+        # tenant identity + priority class ride the request meta over both
+        # transports, so one hot tenant sheds before starving the fleet
+        "tenant-quota": Property(
+            int, 0, "default per-tenant in-flight quota (0 = unlimited): "
+            "a tenant over its quota is shed with BUSY carrying a "
+            "per-tenant retry-after while other tenants keep being "
+            "served — quota sheds never trip client breakers"),
+        "tenant-quotas": Property(
+            str, "", "per-tenant quota overrides 'tenantA:8,tenantB:4' "
+            "(tenants absent here use tenant-quota)"),
+        "shed-window": Property(
+            float, 5.0, "seconds of uninterrupted tenant-quota shedding "
+            "before a rate-limited flight-recorder incident names the "
+            "tenant"),
         # data-plane integrity (Documentation/wire-protocol.md): corrupt
         # requests are refused at the door ('C' / DATA_LOSS) without the
         # server dying; off = serve whatever decodes (debug only)
@@ -158,9 +186,15 @@ class TensorQueryServerSrc(SourceElement):
             self._core.caps = self.props["caps"]
         self._core.block_ingress = bool(self.props["block-ingress"])
         try:
-            self._core.admission = AdmissionController(
+            self._core.admission = TenantAdmissionController(
                 int(self.props["max-inflight"]),
                 int(self.props["low-watermark"]) or None,
+                default_quota=int(self.props["tenant-quota"]),
+                quotas=parse_tenant_quotas(
+                    self.props["tenant-quotas"],
+                    f"{self.name}: tenant-quotas"),
+                shed_window_s=float(self.props["shed-window"]),
+                on_sustained_shed=self._on_sustained_shed,
             )
         except ValueError as e:
             raise ElementError(f"{self.name}: {e}") from None
@@ -212,9 +246,44 @@ class TensorQueryServerSrc(SourceElement):
             {
                 "host": host, "port": self._core.port,
                 "connect_type": self.props["connect-type"],
+                # discovery-plane health: clients deprioritize a
+                # draining host from the broker state alone, before the
+                # first GOAWAY round trip
+                "draining": False,
+                "inflight": 0,
             },
             logger=self.log,
         )
+
+    def _announce_state(self, draining: bool) -> None:
+        """Re-publish the retained announce with this server's live
+        state (draining flag + the point-in-time load summary) — the
+        discovery plane carries health, not just topology.  Fired from
+        the request pump, so it never waits for the broker ack: a slow
+        broker must not stall the very in-flight requests the drain is
+        protecting."""
+        if self._announcement is None:
+            return
+        try:
+            self._announcement.update({
+                "draining": bool(draining),
+                "inflight": (self._core.admission.inflight
+                             if self._core is not None else 0),
+            }, wait_ack=False)
+        except Exception as e:  # noqa: BLE001 — broker I/O is best-effort
+            self.log.warning("draining announce update failed: %s", e)
+
+    def _on_sustained_shed(self, tenant: str) -> None:
+        """A tenant's quota sheds persisted past shed-window: dump the
+        flight recorder naming the tenant (rate-limited by both the
+        admission controller and the recorder)."""
+        self.log.warning(
+            "tenant %r quota-shed sustained for > %.1fs; recording "
+            "incident", tenant, float(self.props["shed-window"]),
+        )
+        p = self._pipeline
+        if p is not None:
+            p.incident("tenant_shed", self.name, f"tenant={tenant}")
 
     def stop(self):
         if self._announcement is not None:
@@ -257,6 +326,10 @@ class TensorQueryServerSrc(SourceElement):
                     or (p is not None and p.draining)):
                 self._lc_state = "draining"
                 core.begin_drain()
+                # tell the discovery plane FIRST: clients that re-rank
+                # remotes off the broker stop picking this host without
+                # paying a GOAWAY round trip each
+                self._announce_state(draining=True)
                 drain_deadline = _time.monotonic() + max(
                     0.0, float(self.props["drain-deadline"]))
             try:
@@ -338,11 +411,15 @@ class _PoolState:
     from a previous run can neither trigger a swap of, nor resend a dead
     run's frame into, the new run's pool."""
 
-    __slots__ = ("conns", "targets", "gen", "epoch", "down_until")
+    __slots__ = ("conns", "targets", "addrs", "gen", "epoch", "down_until")
 
     def __init__(self, conns, targets, gen, epoch=-1):
         self.conns = tuple(conns)
         self.targets = tuple(targets)
+        # "host:port" strings precomputed once per pool generation: the
+        # routing decision runs per request and must not re-format six
+        # addresses per call
+        self.addrs = tuple(f"{h}:{p}" for h, p in targets)
         self.gen = gen
         self.epoch = epoch
         self.down_until: dict = {}
@@ -382,6 +459,38 @@ class TensorQueryClient(Element):
         ),
         "timeout": Property(float, 10.0, "per-request timeout, seconds"),
         "max-in-flight": Property(int, 8, "pipelined outstanding requests"),
+        # fleet routing (core/routing.py): close the loop on the load
+        # signals the servers already emit — least-inflight / span-EWMA
+        # selection instead of blind rotation, with breaker-open and
+        # draining remotes ALWAYS deprioritized below healthy ones
+        "routing": Property(
+            str, "rotate",
+            "remote selection policy: rotate (round-robin) | "
+            "least-inflight (fewest live in-flight requests to the "
+            "remote) | ewma (lowest end-to-end latency EWMA from the "
+            "trace spans, in-flight tie-break).  All policies rank "
+            "breaker-open, cooled-down, and announced-draining remotes "
+            "below every healthy alternative",
+            convert=enum_prop_check(
+                "routing", "rotate", "least-inflight", "ewma")),
+        "affinity-key": Property(
+            str, "",
+            "consistent-hash session affinity: frames whose meta carries "
+            "this key stick to the key's rendezvous-hash owner among the "
+            "current servers (stateful generation streams stay on one "
+            "host; fleet resize remaps the provable minimum of keys).  "
+            "Failover still applies when the owner is unhealthy.  "
+            "Empty = no affinity"),
+        # per-tenant admission (server side pairs these with
+        # tenant-quota/tenant-quotas on the serversrc)
+        "tenant": Property(
+            str, "", "tenant identity stamped into request meta "
+            "(drives server-side per-tenant quotas and accounting); "
+            "frames already carrying a tenant keep theirs"),
+        "priority": Property(
+            int, 3, "priority class 0..3 stamped into request meta "
+            "(3 = highest; lower classes shed first under server "
+            "overload); frames already carrying a priority keep theirs"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
         # elastic recovery (SURVEY §5.3: preemptible workers need client-side
         # retry/requeue — net-new vs the reference's single timeout)
@@ -491,10 +600,30 @@ class TensorQueryClient(Element):
         self._retried = 0  # extra attempts dispatched (all causes)
         self._retry_policy = RetryPolicy()  # rebuilt from props in start()
         # trace spans (core/telemetry.py): per-remote EWMA segment
-        # aggregation — the live load signal fleet routing will consume
-        # (under _breakers_lock like the other worker-raced counters)
+        # aggregation — the live load signal the ewma routing policy
+        # consumes (under _breakers_lock like the other worker-raced
+        # counters)
         self._remote_spans: dict = {}
         self._rtt_hist = None  # registry histogram, bound at start()
+        # fleet routing state (core/routing.py), all under _breakers_lock:
+        # live per-remote attempt counts (self-cleaning: entries vanish
+        # when they drain to 0, so endpoint churn never grows the dict),
+        # consistent-hash affinity assignments (bounded LRU; a remap is
+        # an OWNER change, failover of a sticky request is not), and the
+        # per-endpoint health hints the discovery plane announced
+        self._remote_inflight: dict = {}
+        self._affinity_map: "OrderedDict[str, str]" = OrderedDict()
+        self._affinity_remaps = 0
+        # discovery hints age out (_HINT_TTL_S past _hints_ts): a
+        # drained server restarts and re-announces draining=false, but
+        # a client with no failing requests never rediscovers — without
+        # the TTL the restarted host would stay deprioritized forever
+        self._endpoint_hints: dict = {}
+        self._hints_ts = float("-inf")
+        # ewma-score cache: (spans revision, addrs) -> {idx: score};
+        # _note_span/_rediscover bump the revision
+        self._spans_rev = 0
+        self._scores_cache = None
 
     @property
     def _conns(self) -> tuple:
@@ -510,6 +639,7 @@ class TensorQueryClient(Element):
         from ..distributed.hybrid import discover_endpoints, probe_endpoint
 
         want_ct = self.props["connect-type"]
+        hints: dict = {}
 
         def validate(topic: str, info: dict) -> bool:
             got_ct = info.get("connect_type", want_ct)
@@ -519,6 +649,23 @@ class TensorQueryClient(Element):
                     topic, got_ct, want_ct,
                 )
                 return False
+            # discovery-plane health propagation: the announce carries
+            # the server's live state — a host that says it is draining
+            # is deprioritized by routing BEFORE the first GOAWAY.
+            # ALWAYS overwrite per endpoint: a restarted server
+            # announces healthy on a new instance topic but the same
+            # host:port, and its fresh announce must override the dead
+            # instance's retained draining=true.  Only the draining
+            # FLAG is kept client-side: the announced inflight number
+            # is a point-in-time summary at the last state change, and
+            # exporting it as if live would mislead (routing already
+            # has genuinely-live signals of its own)
+            try:
+                hints[(str(info["host"]), int(info["port"]))] = {
+                    "draining": bool(info.get("draining", False)),
+                }
+            except (KeyError, TypeError, ValueError):
+                pass
             return True
 
         found = discover_endpoints(
@@ -550,6 +697,17 @@ class TensorQueryClient(Element):
                 f"{self.props['topic']!r} within "
                 f"{self.props['discovery-timeout']}s"
             )
+        # hints are replaced wholesale per discovery: a vanished
+        # endpoint's row disappears with the membership that carried
+        # it, and only DRAINING rows are kept (absent row = healthy)
+        with self._breakers_lock:
+            self._endpoint_hints = {
+                f"{h}:{p}": hints[(h, p)] for h, p in targets
+                if hints.get((h, p), {}).get("draining")
+            }
+            import time as _time
+
+            self._hints_ts = _time.monotonic()
         return targets
 
     def start(self):
@@ -567,6 +725,10 @@ class TensorQueryClient(Element):
             # a misleading discovery timeout
             raise ElementError(
                 f"{self.name}: connect-type={ct!r} (want grpc|tcp)")
+        if not 0 <= int(self.props["priority"]) <= PRIORITY_MAX:
+            raise ElementError(
+                f"{self.name}: priority={self.props['priority']} "
+                f"(want 0..{PRIORITY_MAX})")
         targets: List[Tuple[str, int]] = []
         if self.props["topic"] and self.props["dest-port"] > 0:
             targets = self._discover_targets()
@@ -762,9 +924,16 @@ class TensorQueryClient(Element):
                 }
                 for k, agg in self._remote_spans.items()
             }
+            remote_inflight = dict(self._remote_inflight)
+            hints = {k: dict(v) for k, v in self._endpoint_hints.items()
+                     if v}
         return {
             "breakers": breakers,
             "remotes": remotes,
+            "remote_inflight": remote_inflight,
+            "endpoint_hints": hints,
+            "routing": self.props["routing"],
+            "affinity_remaps": self._affinity_remaps,
             "breaker_trips_evicted": self._evicted_breaker_trips,
             "degraded_frames": self._degraded,
             "busy_replies": self._busy_replies,
@@ -777,7 +946,11 @@ class TensorQueryClient(Element):
         }
 
     def metrics_info(self):
-        """Registry samples (core/telemetry.py, scrape time only)."""
+        """Registry samples (core/telemetry.py, scrape time only).
+        ``affinity_remaps`` / ``remote_inflight`` are NOT repeated here:
+        they already export through the ``health_info()`` collector path
+        (HEALTH_KEY_METRICS / the ``remote_inflight`` branch) — emitting
+        them twice would duplicate the series in one scrape."""
         return [("nns.query.client_inflight", len(self._inflight))]
 
     _SPAN_EWMA = 0.2  # smoothing for the per-remote load signal
@@ -848,26 +1021,132 @@ class TensorQueryClient(Element):
                  + last["device_compute"]) * 1e3)
             agg["client_queue_ms"] = roll(
                 agg["client_queue_ms"], last["client_queue"] * 1e3)
+            self._spans_rev += 1  # invalidate the routing score cache
 
-    def _healthy_order(self, ps: "_PoolState", first: int) -> List[int]:
-        """Conn indices of ``ps`` starting at `first`, known-down ones
-        (cooldown not expired, or circuit breaker open) pushed to the
-        back so a hung server doesn't eat a full timeout per frame."""
+    _AFFINITY_MAP_MAX = 4096  # LRU bound on tracked affinity keys
+    #: seconds a discovery hints generation stays authoritative —
+    #: comfortably past a drain (drain-deadline default 10 s) but short
+    #: enough that a restarted host regains traffic without waiting for
+    #: a failure-triggered rediscovery
+    _HINT_TTL_S = 30.0
+
+    def _tiers_and_signals(self, ps: "_PoolState", n: int, policy: str,
+                           now: float):
+        """One pass, ONE lock acquisition: the availability tier of
+        every remote plus the load signals the policy needs.
+
+        Tiers: cooled-down or breaker-OPEN remotes are TIER_DOWN (the
+        selection-side guard — no policy may rank them above a healthy
+        remote), hosts the discovery plane announced as draining are
+        TIER_DRAINING (deprioritized before the first GOAWAY round
+        trip), everything else TIER_OK.  Breaker state is a peek only —
+        allow() reserves half-open probe slots and must be called
+        exactly once, at attempt time; a breaker that was never created
+        is closed by definition (creation stays lazy, at attempt
+        time)."""
+        down = ps.down_until
+        addrs = ps.addrs
+        peek_breakers = int(self.props["breaker-threshold"]) > 0
+        tiers = {}
+        inflight = scores = None
+        # a whole hints generation expires at once (all rows come from
+        # one discovery pass): a stale "draining" must decay, or a host
+        # that drained, restarted, and re-announced healthy would stay
+        # deprioritized until the next failure-triggered rediscovery
+        hints_fresh = now - self._hints_ts < self._HINT_TTL_S
+        # lock-free reads, by design (same contract as the watchdog's
+        # heartbeat pings): every signal is a GIL-atomic dict get whose
+        # worst-case staleness costs one suboptimal ranking, never a
+        # crash — taking _breakers_lock here would put a lock acquisition
+        # on every request of every pool worker
+        breakers = self._breakers
+        hints = self._endpoint_hints
+        for i in range(n):
+            if down.get(i, 0) > now:
+                tiers[i] = TIER_DOWN
+                continue
+            b = breakers.get(addrs[i]) if peek_breakers else None
+            if b is not None and b.state == CircuitBreaker.OPEN:
+                tiers[i] = TIER_DOWN
+                continue
+            h = hints.get(addrs[i]) if hints_fresh else None
+            tiers[i] = (TIER_DRAINING if h and h.get("draining")
+                        else TIER_OK)
+        if policy != "rotate":
+            ri = self._remote_inflight
+            inflight = {i: ri.get(addrs[i], 0) for i in range(n)}
+            if policy == "ewma":
+                # consulted per-CURRENT-address only: EWMA rows for
+                # endpoints _rediscover evicted are unreachable by
+                # construction.  Scores are cached per spans revision —
+                # recomputed only when a completed exchange actually
+                # moved an EWMA (or the pool changed), so bursts between
+                # completions pay one dict lookup
+                rev = (self._spans_rev, addrs)
+                cached = self._scores_cache
+                if cached is not None and cached[0] == rev:
+                    scores = cached[1]
+                else:
+                    scores = ewma_scores(
+                        range(n), addrs, self._remote_spans)
+                    self._scores_cache = (rev, scores)
+        return tiers, inflight, scores
+
+    def _note_affinity(self, key: str, target: Tuple[str, int]) -> None:
+        """Track the consistent-hash owner per affinity key; an OWNER
+        change (fleet resize moved the key) counts as one remap — a
+        failover of a sticky request is not a remap, the owner
+        assignment is a pure function of the endpoint set."""
+        addr = f"{target[0]}:{target[1]}"
+        with self._breakers_lock:
+            prev = self._affinity_map.pop(key, None)
+            if prev is not None and prev != addr:
+                self._affinity_remaps += 1
+            self._affinity_map[key] = addr
+            while len(self._affinity_map) > self._AFFINITY_MAP_MAX:
+                self._affinity_map.popitem(last=False)
+
+    def _route_order(self, ps: "_PoolState", frame_or_batch,
+                     first: int) -> List[int]:
+        """The routing decision for one request: every conn index of
+        ``ps``, best first (``routing`` policy within availability
+        tiers, consistent-hash affinity owner promoted within its
+        tier).  Known-down remotes always rank last so a hung server
+        doesn't eat a full timeout per frame."""
         import time
 
+        n = len(ps.conns)
+        akey = self.props["affinity-key"]
+        if n == 1 and not akey:
+            return [0]  # single remote, no affinity ledger to keep
         now = time.monotonic()
-        order = [(first + k) % len(ps.conns) for k in range(len(ps.conns))]
+        policy = self.props["routing"]
+        tiers, inflight, scores = self._tiers_and_signals(
+            ps, n, policy, now)
+        owner = None
+        if akey:
+            f0 = (frame_or_batch[0] if isinstance(frame_or_batch, list)
+                  else frame_or_batch)
+            meta = getattr(f0, "meta", None)
+            val = meta.get(akey) if meta is not None else None
+            if val is not None:
+                owner = rendezvous_owner(str(val), ps.targets)
+                self._note_affinity(str(val), ps.targets[owner])
+        return order_remotes(
+            policy, tiers, first, n, inflight, scores, owner)
 
-        def fine(i: int) -> bool:
-            if ps.down_until.get(i, 0) > now:
-                return False
-            b = self._breaker_for(ps.targets[i])
-            # peek only — allow() reserves half-open probe slots and must
-            # be called exactly once, at attempt time
-            return b is None or b.state != CircuitBreaker.OPEN
+    def _inflight_begin(self, addr: str) -> None:
+        with self._breakers_lock:
+            self._remote_inflight[addr] = (
+                self._remote_inflight.get(addr, 0) + 1)
 
-        healthy = [i for i in order if fine(i)]
-        return healthy + [i for i in order if i not in healthy]
+    def _inflight_end(self, addr: str) -> None:
+        with self._breakers_lock:
+            v = self._remote_inflight.get(addr, 0) - 1
+            if v <= 0:
+                self._remote_inflight.pop(addr, None)
+            else:
+                self._remote_inflight[addr] = v
 
     def _rediscover(self, failed_ps: "_PoolState") -> bool:
         """Topic mode elastic recovery: refresh the server set from the
@@ -980,6 +1259,7 @@ class TensorQueryClient(Element):
                 for key in [k for k in self._remote_spans
                             if k not in keep]:
                     del self._remote_spans[key]
+                self._spans_rev += 1  # evictions invalidate scores too
         for c in retired:
             try:
                 c.close()
@@ -1094,7 +1374,7 @@ class TensorQueryClient(Element):
         corrupt_budget = max(0, int(self.props["corrupt-retries"]))
         timeout = self.props["timeout"]
         retry_policy = self._retry_policy
-        order = self._healthy_order(ps, first)
+        order = self._route_order(ps, frame, first)
         err: Optional[BaseException] = None
         open_err: Optional[BaseException] = None
         cursor = 0
@@ -1141,10 +1421,17 @@ class TensorQueryClient(Element):
             conn = ps.conns[i]
             try:
                 t_send = time.perf_counter()
-                if isinstance(frame, list):
-                    result = conn.invoke_batch(frame, req_timeout)
-                else:
-                    result = conn.invoke(frame, req_timeout)
+                # live per-remote in-flight count: the least-inflight
+                # routing signal (self-cleaning dict — see __init__)
+                addr_i = ps.addrs[i]
+                self._inflight_begin(addr_i)
+                try:
+                    if isinstance(frame, list):
+                        result = conn.invoke_batch(frame, req_timeout)
+                    else:
+                        result = conn.invoke(frame, req_timeout)
+                finally:
+                    self._inflight_end(addr_i)
                 t_recv = time.perf_counter()
                 ps.down_until.pop(i, None)
                 if breaker is not None:
@@ -1330,11 +1617,20 @@ class TensorQueryClient(Element):
         import time as _time
 
         now = _time.perf_counter()
+        tenant = self.props["tenant"]
+        prio = int(self.props["priority"])
         for f in frames:
             m = f.meta
             if TRACE_ID_META not in m:
                 m[TRACE_ID_META] = new_trace_id()
             m[TL_ENQ_META] = now
+            # tenant identity / priority class ride ordinary meta (JSON
+            # blob on the wire, both transports); frames stamped by an
+            # upstream multi-tenant ingest keep their own identity
+            if tenant and TENANT_META not in m:
+                m[TENANT_META] = tenant
+            if prio != PRIORITY_MAX and PRIORITY_META not in m:
+                m[PRIORITY_META] = prio
         if self.props["stream"]:
             # sequential per-request streams: chunk frames of request j
             # leave BEFORE request j+1 is sent (the scheduler pushes each
@@ -1376,7 +1672,7 @@ class TensorQueryClient(Element):
         ps = self._pstate  # snapshot (same contract as _invoke_failover)
         if not ps.conns:
             raise RuntimeError(f"{self.name}: no connections (stopped?)")
-        order = self._healthy_order(ps, self._rr % len(ps.conns))
+        order = self._route_order(ps, frame, self._rr % len(ps.conns))
         self._rr += 1
         # retries=0 means SINGLE attempt: a request the server may already
         # have ingested must not be silently re-executed elsewhere unless
@@ -1417,12 +1713,17 @@ class TensorQueryClient(Element):
                     err = self._note_expired()
                     expired_terminal = True
                     break
-                for ans in conn.invoke_stream(frame, req_timeout):
-                    started = True
-                    ps.down_until.pop(i, None)
-                    if deadline_ts is not None:
-                        ans.meta[DEADLINE_META] = deadline_ts
-                    yield (0, ans)
+                addr_i = ps.addrs[i]
+                self._inflight_begin(addr_i)
+                try:
+                    for ans in conn.invoke_stream(frame, req_timeout):
+                        started = True
+                        ps.down_until.pop(i, None)
+                        if deadline_ts is not None:
+                            ans.meta[DEADLINE_META] = deadline_ts
+                        yield (0, ans)
+                finally:
+                    self._inflight_end(addr_i)
                 if breaker is not None:
                     # success is recorded on clean COMPLETION (empty
                     # streams included — a half-open probe slot must not

@@ -1,0 +1,415 @@
+#!/usr/bin/env python
+"""Deterministic fleet chaos harness: scripted membership churn under
+continuous multi-tenant load.
+
+Drives N query servers + M tenant clients through the failure classes a
+serving fleet actually sees — hard kill, rolling restart (GOAWAY drain,
+PR-5), server join, hot-tenant burst — and computes an exact verdict:
+zero lost/duplicated frames, per-tenant delivered/shed accounting,
+breaker-trip census, and consistent-hash affinity remap counts.
+
+Everything is scripted and event-ordered (actions run between push
+waves, never on wall-clock timers), so the same script asserts the same
+contracts in CI (the chaos-marked e2e in ``tests/test_fleet.py``) and at
+the terminal::
+
+    python tools/chaos_fleet.py            # default 3-server script
+    python tools/chaos_fleet.py --servers 4 --keys 200 --frames 30
+
+Fleet membership travels over the hybrid MQTT discovery plane (an
+in-process :class:`MiniBroker`): servers announce retained endpoints
+(with their live ``draining`` state — Documentation/resilience.md),
+clients resolve the pool from the broker.  Because this is a CHAOS
+harness, membership refreshes can also be forced between waves
+(:meth:`FleetHarness.refresh_client`) instead of waiting for a failure
+wave to trigger elastic rediscovery — scripted churn must not depend on
+luck."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(xs: List[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+class ClientHandle:
+    """One tenant's client pipeline: appsrc -> tensor_query_client ->
+    tensor_sink, plus the exact push ledger the verdict checks against."""
+
+    def __init__(self, harness: "FleetHarness", name: str, pipe,
+                 tenant: str):
+        self._h = harness
+        self.name = name
+        self.tenant = tenant
+        self.pipe = pipe
+        self.pushed: List[float] = []
+
+    @property
+    def element(self):
+        return self.pipe["q"]
+
+    def push(self, value: float, key: Optional[str] = None,
+             meta: Optional[Dict[str, Any]] = None) -> None:
+        import numpy as np
+
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        m = dict(meta or {})
+        if key is not None:
+            m[self._h.affinity_key] = key
+        self.pipe["src"].push(TensorFrame([np.float32([value])], meta=m))
+        self.pushed.append(float(value))
+
+    def settle(self, timeout: float = 30.0) -> None:
+        """Wait until every pushed frame has been answered (or counted
+        degraded) WITHOUT ending the stream — the load stays continuous
+        across chaos actions, and phase-boundary counters are exact."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            answered = len(self.pipe["out"].frames)
+            degraded = int(self.health().get("degraded_frames", 0))
+            if answered + degraded >= len(self.pushed):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"client {self.name}: {len(self.pushed)} pushed but only "
+            f"{len(self.pipe['out'].frames)} answered after {timeout}s")
+
+    def values(self) -> List[float]:
+        return [float(f.tensors[0][0]) for f in self.pipe["out"].frames]
+
+    def spans_ms(self) -> List[float]:
+        """Per-answer end-to-end latencies from the trace-span meta."""
+        from nnstreamer_tpu.core.telemetry import SPAN_META
+
+        out = []
+        for f in self.pipe["out"].frames:
+            span = f.meta.get(SPAN_META)
+            if span:
+                out.append(float(span["total"]) * 1e3)
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        return self.pipe.health()["q"]
+
+    def finish(self, timeout: float = 60.0) -> None:
+        self.pipe["src"].end_of_stream()
+        self.pipe.wait(timeout=timeout)
+
+    def stop(self) -> None:
+        self.pipe.stop()
+
+
+class FleetHarness:
+    """N query servers + M tenant clients on one hybrid discovery plane.
+
+    Servers are ``serversrc ! identity sleep= ! scaler x2 !
+    serversink`` pipelines announcing on ``nns/query/<topic>/``;
+    clients resolve the pool from the broker.  ``expected(values)`` for
+    every answered frame is ``value * 2``."""
+
+    def __init__(self, topic: str = "chaosfleet", connect_type: str = "tcp",
+                 server_sleep: float = 0.01, max_inflight: int = 32,
+                 tenant_quotas: str = "", shed_window_s: float = 5.0,
+                 affinity_key: str = "sess", base_id: int = 9600):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        self.topic = topic
+        self.connect_type = connect_type
+        self.server_sleep = server_sleep
+        self.max_inflight = max_inflight
+        self.tenant_quotas = tenant_quotas
+        self.shed_window_s = shed_window_s
+        self.affinity_key = affinity_key
+        self.base_id = base_id
+        self.broker = MiniBroker()
+        self.servers: Dict[int, Any] = {}   # idx -> pipeline (live only)
+        self.ports: Dict[int, int] = {}     # idx -> port (survives kills)
+        self.clients: List[ClientHandle] = []
+        # per-tenant counters of servers that LEFT the fleet, captured at
+        # kill time so fleet-wide accounting stays exact across churn
+        self.retired_tenants: List[Dict[str, Any]] = []
+
+    # -- servers ------------------------------------------------------------
+    def start_server(self, idx: int, port: int = 0):
+        from nnstreamer_tpu.pipeline.parser import parse_pipeline
+
+        quotas = (f"tenant-quotas={self.tenant_quotas} "
+                  if self.tenant_quotas else "")
+        pipe = parse_pipeline(
+            f"tensor_query_serversrc name=ssrc id={self.base_id + idx} "
+            f"port={port} connect-type={self.connect_type} "
+            f"topic={self.topic} dest-host=127.0.0.1 "
+            f"dest-port={self.broker.port} "
+            f"max-inflight={self.max_inflight} {quotas}"
+            f"shed-window={self.shed_window_s} ! "
+            f"identity sleep={self.server_sleep} ! "
+            "tensor_filter framework=scaler custom=factor:2 ! "
+            f"tensor_query_serversink id={self.base_id + idx}",
+            name=f"server{idx}",
+        )
+        pipe.start()
+        self.servers[idx] = pipe
+        self.ports[idx] = pipe["ssrc"].props["port"]
+        return pipe
+
+    def kill_server(self, idx: int) -> None:
+        """Hard stop: no drain, no GOAWAY — in-flight requests die with
+        their sockets (the announce is tombstoned by element stop)."""
+        pipe = self.servers.pop(idx)
+        self.retired_tenants.append(self.server_tenant_rows(pipe))
+        pipe.stop()
+
+    def rolling_restart(self, idx: int, drain_timeout: float = 15.0) -> Dict[str, Any]:
+        """PR-5 zero-downtime roll: drain (GOAWAY to new requests,
+        in-flight finish), stop, restart on the SAME port."""
+        pipe = self.servers[idx]
+        res = pipe.drain(timeout=drain_timeout)
+        health = pipe.health()["ssrc"]
+        self.retired_tenants.append(self.server_tenant_rows(pipe))
+        pipe.stop()
+        self.servers.pop(idx)
+        self.start_server(idx, port=self.ports[idx])
+        return {"drain": res, "health": health}
+
+    def add_server(self) -> int:
+        idx = (max(self.ports) + 1) if self.ports else 0
+        self.start_server(idx)
+        return idx
+
+    @staticmethod
+    def server_tenant_rows(pipe) -> Dict[str, Any]:
+        return {
+            t: dict(row)
+            for t, row in pipe.health()["ssrc"].get("tenants", {}).items()
+        }
+
+    def fleet_tenants(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant {admitted, shed} summed over every server that is
+        or ever was in the fleet (retired servers contribute their
+        last-observed counters)."""
+        total: Dict[str, Dict[str, int]] = {}
+        rows = [self.server_tenant_rows(p) for p in self.servers.values()]
+        rows.extend(self.retired_tenants)
+        for by_tenant in rows:
+            for t, row in by_tenant.items():
+                agg = total.setdefault(t, {"admitted": 0, "shed": 0})
+                agg["admitted"] += int(row.get("admitted", 0))
+                agg["shed"] += int(row.get("shed", 0))
+        return total
+
+    # -- clients ------------------------------------------------------------
+    def make_client(self, name: str, tenant: str = "",
+                    routing: str = "least-inflight", priority: int = 3,
+                    affinity: bool = False, retries: int = 3,
+                    busy_retries: int = 8, breaker_threshold: int = 8,
+                    max_in_flight: int = 4, timeout: float = 5.0,
+                    degrade: str = "error",
+                    discovery_timeout: float = 10.0,
+                    static_hosts: bool = False) -> ClientHandle:
+        from nnstreamer_tpu.pipeline.parser import parse_pipeline
+
+        akey = f"affinity-key={self.affinity_key} " if affinity else ""
+        tprop = f"tenant={tenant} " if tenant else ""
+        if static_hosts:
+            # pinned membership (no discovery, no elastic rediscovery):
+            # the burst client of the e2e uses this so every push maps
+            # to EXACTLY one admission attempt — exact shed accounting
+            hosts = ",".join(
+                f"localhost:{self.ports[i]}" for i in sorted(self.servers))
+            plane = f"hosts={hosts} "
+        else:
+            plane = (
+                f"topic={self.topic} dest-host=127.0.0.1 "
+                f"dest-port={self.broker.port} "
+                f"discovery-timeout={discovery_timeout} ")
+        pipe = parse_pipeline(
+            "appsrc name=src max-buffers=1024 ! "
+            f"tensor_query_client name=q connect-type={self.connect_type} "
+            f"{plane}"
+            f"routing={routing} {akey}{tprop}priority={priority} "
+            f"retries={retries} busy-retries={busy_retries} "
+            f"breaker-threshold={breaker_threshold} retry-backoff=0.02 "
+            f"max-in-flight={max_in_flight} timeout={timeout} "
+            f"degrade={degrade} ! "
+            "tensor_sink name=out",
+            name=f"client-{name}",
+        )
+        pipe.start()
+        handle = ClientHandle(self, name, pipe, tenant)
+        self.clients.append(handle)
+        return handle
+
+    def refresh_client(self, handle: ClientHandle) -> bool:
+        """Force one elastic rediscovery NOW (scripted membership churn;
+        production clients refresh on failure waves instead).  Returns
+        True when the pool actually swapped."""
+        el = handle.element
+        el._last_discovery_ts = float("-inf")  # skip the churn cooldown
+        return el._rediscover(el._pstate)
+
+    # -- verdict ------------------------------------------------------------
+    @staticmethod
+    def check_exact(handle: ClientHandle) -> Dict[str, Any]:
+        """Zero-lost / zero-duplicated check for one client: every pushed
+        value answered exactly once as value*2 (minus frames the client
+        itself dropped under degrade=skip, which it counts)."""
+        got = sorted(handle.values())
+        degraded = int(handle.health().get("degraded_frames", 0))
+        want = sorted(v * 2.0 for v in handle.pushed)
+        lost = dup = 0
+        if degraded == 0:
+            from collections import Counter
+
+            cw, cg = Counter(want), Counter(got)
+            lost = sum((cw - cg).values())
+            dup = sum((cg - cw).values())
+        else:
+            # degrade=skip clients: delivered subset must still be
+            # duplicate-free and correct
+            from collections import Counter
+
+            cg = Counter(got)
+            cw = Counter(want)
+            dup = sum((cg - cw).values())
+            lost = sum((cw - cg).values()) - degraded
+        return {
+            "pushed": len(handle.pushed), "answered": len(got),
+            "degraded": degraded, "lost": lost, "duplicated": dup,
+        }
+
+    def breaker_trips(self) -> int:
+        trips = 0
+        for c in self.clients:
+            h = c.health()
+            trips += int(h.get("breaker_trips_evicted", 0))
+            for snap in h.get("breakers", {}).values():
+                trips += int(snap.get("trips", 0))
+        return trips
+
+    def verdict(self) -> Dict[str, Any]:
+        per_client = {c.name: self.check_exact(c) for c in self.clients}
+        p50 = {
+            c.name: round(_median(c.spans_ms()), 3) for c in self.clients
+        }
+        return {
+            "clients": per_client,
+            "p50_ms": p50,
+            "tenants": self.fleet_tenants(),
+            "breaker_trips": self.breaker_trips(),
+            "goaway_replies": sum(
+                int(c.health().get("goaway_replies", 0))
+                for c in self.clients),
+            "affinity_remaps": {
+                c.name: int(c.health().get("affinity_remaps", 0))
+                for c in self.clients
+            },
+            "lost": sum(r["lost"] for r in per_client.values()),
+            "duplicated": sum(r["duplicated"] for r in per_client.values()),
+        }
+
+    def stop_all(self) -> None:
+        for c in self.clients:
+            try:
+                c.stop()
+            except Exception:  # allow-silent: teardown best-effort
+                pass
+        for pipe in list(self.servers.values()):
+            try:
+                pipe.stop()
+            except Exception:  # allow-silent: teardown best-effort
+                pass
+        self.servers.clear()
+        self.broker.close()
+
+
+# ---------------------------------------------------------------------------
+# The default script (CLI mode; the e2e in tests/test_fleet.py pins the
+# same phases with exact assertions)
+# ---------------------------------------------------------------------------
+def run_default_script(servers: int = 3, frames: int = 30,
+                       keys: int = 120) -> Dict[str, Any]:
+    import math
+
+    h = FleetHarness(tenant_quotas="A:6,B:2", server_sleep=0.01)
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        ca = h.make_client("A", tenant="A", routing="least-inflight")
+        cb = h.make_client("B", tenant="B", routing="ewma", busy_retries=12)
+        ck = h.make_client("K", affinity=True, routing="rotate")
+        seq = iter(range(10**6))
+        key_names = [f"sess-{k}" for k in range(keys)]
+
+        def wave(tag: str, n: int = frames) -> None:
+            for _ in range(n):
+                ca.push(next(seq))
+                cb.push(10_000 + next(seq))
+            for k in key_names:
+                ck.push(20_000 + next(seq), key=k)
+            for c in (ca, cb, ck):
+                c.settle()
+
+        wave("baseline")
+        roll = h.rolling_restart(0)
+        wave("after-roll")
+        joined = h.add_server()
+        h.refresh_client(ck)
+        remaps_before = ck.health()["affinity_remaps"]
+        wave("after-join")
+        remap_join = ck.health()["affinity_remaps"] - remaps_before
+        h.kill_server(servers - 1)
+        for c in (ca, cb, ck):
+            h.refresh_client(c)
+        wave("after-kill")
+        for c in (ca, cb, ck):
+            c.finish()
+        v = h.verdict()
+        v["rolling_restart"] = {
+            "goaway_sent": roll["health"].get("goaway_sent", 0),
+            "drain_dropped": roll["drain"]["dropped"],
+        }
+        v["remap_join"] = remap_join
+        v["remap_join_bound"] = math.ceil(keys / max(1, len(h.servers)))
+        v["joined_server"] = joined
+        v["ok"] = (
+            v["lost"] == 0 and v["duplicated"] == 0
+            and v["breaker_trips"] == 0
+            and remap_join <= v["remap_join_bound"]
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
+def main() -> int:
+    import argparse
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--servers", type=int, default=3)
+    ap.add_argument("--frames", type=int, default=30,
+                    help="frames per tenant per wave")
+    ap.add_argument("--keys", type=int, default=120,
+                    help="distinct affinity sessions")
+    args = ap.parse_args()
+    verdict = run_default_script(args.servers, args.frames, args.keys)
+    print(json.dumps(verdict, indent=1, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
