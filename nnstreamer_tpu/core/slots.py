@@ -1,0 +1,721 @@
+"""Continuous batching for the generation path: the slot scheduler.
+
+The serving shape of "millions of users" LLM inference: MANY concurrent
+autoregressive streams share ONE fixed-width decode batch.  Each live
+request occupies a *slot*; the jitted transformer decode scan runs over
+the whole slot batch every iteration (``k = min(chunk, min remaining)``
+tokens per active slot — per-token dispatch amortized exactly like the
+unslotted path), so aggregate token throughput is bound by the token
+batch, not by the request count — the roofline view the perf evidence
+reports (Documentation/performance.md "Continuous batching").
+
+Mechanics (model halves: ``models/transformer.SlotModel``):
+
+* **join at token boundaries** — a new prompt claims a free slot, its
+  pages are reset (only ITS slot is touched), then its prompt is
+  prefilled in ``prefill_chunk``-sized pieces INTERLEAVED with the decode
+  loop (``prefill_priority`` chunks per decode step), so one long prompt
+  never stalls the tokens other streams are owed;
+* **leave immediately** — finished, cancelled and deadline-evicted
+  streams free their slot at the next token boundary; the idle-slot
+  mask keeps the decode step shape-stable, so churn causes ZERO
+  retracing (``SlotModel.decode_compiles`` stays at the fixed bucket
+  count);
+* **per-token deadline QoS** — a stream whose request deadline
+  (PR-2 ``DEADLINE_META`` budget, crossed the wire) or per-token pace
+  budget (``token_budget_s``) is blown is EVICTED from its slot and
+  answered with a typed-expiry final chunk (partial tokens preserved,
+  ``evicted="deadline"`` meta) instead of rotting in the batch;
+* **priority joins** — free slots go to the highest PR-8 priority class
+  first (FIFO within a class), so tenant QoS extends to slot admission.
+
+Threading: the engine runs its own decode pump thread (the PR-6
+CompletionWindow reaper discipline) so decode never waits on the
+element's mailbox poll; the ELEMENT drains ready chunks on its dispatch
+thread via :meth:`pop_ready` (emission and supervision attribution stay
+on the pipeline thread), and engine errors re-raise there too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .log import get_logger
+
+log = get_logger("slots")
+
+#: terminal stream states
+DONE_STATES = ("done", "evicted", "cancelled", "failed")
+
+
+def lru_bucket(lru: "OrderedDict", key, build, cap: int):
+    """THE bounded compile-bucket discipline (filter _stack_jit_cache,
+    PR-3), shared by every chunk-length jit cache — the slot engine's
+    prefill/decode buckets AND the unslotted generator's decode chunks
+    — so the eviction rule cannot drift between paths.  Returns the
+    cached (or freshly built) entry; evicts least-recently-used past
+    ``cap`` (evicted lengths simply retrace on next use)."""
+    fn = lru.get(key)
+    if fn is not None:
+        lru.move_to_end(key)
+        return fn
+    fn = build(key)
+    lru[key] = fn
+    while len(lru) > cap:
+        lru.popitem(last=False)
+    return fn
+
+
+class GenStream:
+    """One generation stream: a prompt waiting for / occupying a slot.
+
+    ``frame`` is the source TensorFrame (kept alive so emitted chunks
+    inherit its meta — client_id, trace id, tenant — via
+    ``with_tensors``); tokens accumulate in ``pending`` until a chunk
+    boundary or a terminal event flushes them.
+    """
+
+    __slots__ = (
+        "sid", "frame", "prompt", "max_new", "chunk", "tenant", "priority",
+        "deadline_ts", "token_budget_s", "state", "slot", "prefill_pos",
+        "gen", "tok", "pending", "pending_n", "chunk_index", "tokens_out",
+        "evict_reason", "submitted_ts", "last_token_ts", "joined_ts",
+    )
+
+    def __init__(self, sid: int, frame, prompt, max_new: int, chunk: int,
+                 tenant: str = "", priority: int = 3,
+                 deadline_ts: Optional[float] = None,
+                 token_budget_s: float = 0.0, now: float = 0.0):
+        self.sid = sid
+        self.frame = frame
+        self.prompt = prompt              # np.int32 (1, Tp)
+        self.max_new = int(max_new)
+        self.chunk = max(1, int(chunk))
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.deadline_ts = deadline_ts    # absolute monotonic or None
+        self.token_budget_s = float(token_budget_s)
+        self.state = "waiting"            # waiting|prefill|decoding|<DONE>
+        self.slot: Optional[int] = None
+        self.prefill_pos = 0
+        self.gen = 0                      # tokens generated so far
+        self.tok = 0                      # last token (host int)
+        self.pending: List[Any] = []      # np arrays (1, k) awaiting a chunk
+        self.pending_n = 0
+        self.chunk_index = 0
+        self.tokens_out = 0               # tokens actually emitted
+        self.evict_reason: Optional[str] = None
+        self.submitted_ts = now
+        self.last_token_ts = now
+        self.joined_ts: Optional[float] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.state in DONE_STATES
+
+
+class SimSlotModel:
+    """Deterministic SIMULATED slot model (the async-sim discipline,
+    PR-6): duck-types ``models.transformer.SlotModel`` but replaces the
+    transformer with a token recurrence plus TPU-SHAPED step costs —
+    every decode step pays a B-INDEPENDENT base (weight streaming +
+    dispatch, the memory-bound LLM-decode regime batching amortizes)
+    plus a small per-active-slot increment.
+
+    This is what the ``pytest -m perf`` continuous-batching floor and
+    the chaos harness drive: the object under test is the SLOT
+    SCHEDULER (join/evict correctness, multiplexing win, emission-path
+    overhead), not XLA-CPU GEMM scaling, which inverts the real
+    accelerator's batch economics at zoo-model sizes.
+
+    Token oracle: token 1 = ``sum(prompt) % vocab``; token j+1 =
+    ``(31 * t_j + 17) % vocab`` — exact per-stream accounting is
+    checkable without running a model.  The per-slot "pages" are a
+    position counter that asserts slot isolation (a write to slot i can
+    never touch slot j by construction, and tests pin the counters).
+    """
+
+    def __init__(self, slots: int, vocab: int = 997,
+                 step_base_ms: float = 1.0, step_per_slot_ms: float = 0.05,
+                 prefill_ms_per_token: float = 0.02,
+                 sleep=time.sleep):
+        import numpy as np
+
+        self._np = np
+        self.slots = int(slots)
+        self.vocab = int(vocab)
+        self.step_base_s = step_base_ms * 1e-3
+        self.step_per_slot_s = step_per_slot_ms * 1e-3
+        self.prefill_s_per_token = prefill_ms_per_token * 1e-3
+        self._sleep = sleep
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        #: simulated device-busy seconds (occupancy evidence)
+        self.busy_s = 0.0
+        # running prompt-sum per slot: chunked prefill accumulates into
+        # it so token 1 covers the WHOLE prompt across chunk boundaries
+        self._prefill_carry: Dict[int, int] = {}
+
+    def init_cache(self):
+        np = self._np
+        return {"pos": np.zeros((self.slots,), np.int64)}
+
+    def reset_slot(self, cache, slot):
+        cache = {"pos": cache["pos"].copy()}
+        cache["pos"][int(slot)] = 0
+        self._prefill_carry[int(slot)] = 0
+        return cache
+
+    def prefill_fn(self, n: int):
+        np = self._np
+        self.prefill_compiles += 1
+
+        def fn(params, cache, toks, slot):
+            dt = self.prefill_s_per_token * toks.shape[1]
+            self._sleep(dt)
+            self.busy_s += dt
+            cache = {"pos": cache["pos"].copy()}
+            cache["pos"][int(slot)] += toks.shape[1]
+            tot = (self._prefill_carry.get(int(slot), 0)
+                   + int(toks.sum())) % self.vocab
+            self._prefill_carry[int(slot)] = tot
+            # "logits": one-hot at the oracle's token 1 so pick_first
+            # recovers it
+            logits = np.zeros((1, self.vocab), np.float32)
+            logits[0, tot] = 1.0
+            return cache, logits
+
+        return fn
+
+    def pick_first(self, logits):
+        np = self._np
+        return np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+
+    def step_token(self, t: int) -> int:
+        return (31 * int(t) + 17) % self.vocab
+
+    def decode_fn(self, k: int):
+        np = self._np
+        self.decode_compiles += 1
+
+        def fn(params, cache, tok, gen, active):
+            n_active = int(active.sum())
+            dt = k * (self.step_base_s
+                      + self.step_per_slot_s * n_active)
+            self._sleep(dt)
+            self.busy_s += dt
+            tok = np.asarray(tok).copy()
+            gen = np.asarray(gen).copy()
+            cache = {"pos": cache["pos"].copy()}
+            toks = np.zeros((self.slots, k), np.int32)
+            for step in range(k):
+                for slot in range(self.slots):
+                    if active[slot]:
+                        tok[slot] = self.step_token(tok[slot])
+                        toks[slot, step] = tok[slot]
+                gen = gen + active
+            cache["pos"] = cache["pos"] + k * active.astype(np.int64)
+            return cache, tok, gen, toks
+
+        return fn
+
+
+class SlotEngine:
+    """Fixed-width continuous-batching scheduler over a
+    :class:`~nnstreamer_tpu.models.transformer.SlotModel`.
+
+    Public API (thread-safe): :meth:`submit`, :meth:`cancel`,
+    :meth:`pop_ready`, :meth:`pending`, :meth:`wait_progress`,
+    :meth:`snapshot`.  ``start``/``stop`` bound the pump thread's life
+    to the owning element's.
+    """
+
+    #: bound on live prefill jit buckets (chunk-length LRU — same
+    #: discipline as the filter's _stack_jit_cache, PR-3)
+    JIT_BUCKET_MAX = 16
+    #: deadline evictions fire this far BEFORE the request deadline: the
+    #: typed-expiry answer must still reach a client whose own timeout
+    #: fires exactly AT the deadline (one reply's worth of headroom)
+    EVICT_MARGIN_S = 0.05
+
+    def __init__(self, model, params, *, max_seq: int, chunk: int = 8,
+                 prefill_chunk: int = 32, prefill_priority: int = 1,
+                 token_budget_s: float = 0.0,
+                 jit_bucket_max: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "slots"):
+        import numpy as np
+
+        self._np = np
+        self.model = model
+        self.params = params
+        self.slots = int(model.slots)
+        self.max_seq = int(max_seq)
+        self.chunk = max(1, int(chunk))
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.prefill_priority = max(0, int(prefill_priority))
+        self.token_budget_s = float(token_budget_s)
+        self.jit_bucket_max = int(jit_bucket_max or self.JIT_BUCKET_MAX)
+        self.clock = clock
+        self.name = name
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)       # pump wakeups
+        self._progress = threading.Condition(self._lock)   # consumer waits
+        self._waiting: List[GenStream] = []
+        self._occupants: List[Optional[GenStream]] = [None] * self.slots
+        self._ready: List[Tuple[int, Any]] = []  # (pad, TensorFrame) outs
+        self._streams: Dict[int, GenStream] = {}  # live (non-terminal)
+        self._sid = 0
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # device state (pump-thread-private after start)
+        self._cache = None
+        self._tok_vec = None
+        self._gen_vec = None
+        # chunk-length jit buckets, LRU-bounded (filter _stack_jit_cache
+        # discipline): one per distinct prefill piece / decode scan length
+        self._prefill_lru: "OrderedDict[int, Any]" = OrderedDict()
+        self._decode_lru: "OrderedDict[int, Any]" = OrderedDict()
+
+        # exact accounting (lock-held writes, GIL-atomic reads)
+        self.joins = 0
+        self.completions = 0
+        self.evictions = 0
+        self.cancellations = 0
+        self.decode_steps = 0
+        self.prefill_chunks = 0
+        self.tokens_total = 0
+        self.tokens_per_step = 0.0  # EWMA of active slots per decode step
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        np = self._np
+        self._stop.clear()
+        self._error = None
+        self._cache = self.model.init_cache()
+        # engine-owned state vectors are HOST numpy (model-agnostic: the
+        # jax halves convert at the jit boundary — (S,) ints, negligible
+        # — and sim models consume them directly)
+        self._tok_vec = np.zeros((self.slots,), np.int32)
+        self._gen_vec = np.zeros((self.slots,), np.int32)
+        self._thread = threading.Thread(
+            target=self._pump, name=f"{self.name}-slots", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+            self._progress.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+            self._thread = None
+        with self._lock:
+            abandoned = len(self._streams)  # waiting ones are members too
+            if abandoned:
+                log.warning(
+                    "%s: engine stopped with %d stream(s) abandoned",
+                    self.name, abandoned)
+            self._waiting.clear()
+            self._streams.clear()
+            self._occupants = [None] * self.slots
+            self._ready.clear()
+        self._cache = None
+        self._prefill_lru.clear()
+        self._decode_lru.clear()
+
+    # -- submission / cancellation -----------------------------------------
+    def submit(self, frame, prompt, max_new: int, chunk: int,
+               tenant: str = "", priority: int = 3,
+               deadline_ts: Optional[float] = None) -> GenStream:
+        """Queue one prompt for a slot.  ``prompt`` is host int32
+        (1, Tp), already validated against ``max_seq`` by the caller."""
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            self._sid += 1
+            s = GenStream(
+                self._sid, frame, prompt, max_new, chunk,
+                tenant=tenant, priority=priority, deadline_ts=deadline_ts,
+                token_budget_s=self.token_budget_s, now=self.clock(),
+            )
+            self._streams[s.sid] = s
+            self._waiting.append(s)
+            self._work.notify_all()
+            return s
+
+    def cancel(self, sid: Optional[int] = None,
+               client_id: Optional[int] = None) -> bool:
+        """Cancel by stream id or by the source frame's client_id meta
+        (the serversink's client-gone feedback).  The slot frees at the
+        next token boundary; no further chunks are emitted."""
+        with self._lock:
+            for s in list(self._streams.values()):
+                if s.finished:
+                    continue  # reaped at the next boundary; never recount
+                if (sid is not None and s.sid == sid) or (
+                        client_id is not None
+                        and s.frame.meta.get("client_id") == client_id):
+                    s.state = "cancelled"
+                    self.cancellations += 1
+                    self._work.notify_all()
+                    return True
+        return False
+
+    # -- consumer side (element dispatch thread) ----------------------------
+    def pop_ready(self) -> List[Tuple[int, Any]]:
+        """Drain ready chunk frames (FIFO).  Re-raises any pump-thread
+        error HERE, so supervision attributes it to the element call.
+        The error is STICKY: a dead pump must keep failing loudly (and
+        keep refusing submits) — a restart re-opens the element and
+        builds a fresh engine."""
+        with self._lock:
+            if self._error is not None and not self._ready:
+                raise self._error
+            out, self._ready = self._ready, []
+            return out
+
+    def pending(self) -> int:
+        """Logical frames parked in the engine (``pending_frames`` hook:
+        scheduler fast-poll + drain/stop accounting): live streams
+        (``_streams`` already includes the waiting ones) plus
+        undelivered ready chunks."""
+        with self._lock:
+            return len(self._streams) + len(self._ready)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._streams and not self._ready
+
+    def wait_progress(self, timeout: float = 0.1) -> None:
+        """Block the caller until the pump makes progress (EOS flush)."""
+        with self._progress:
+            if self._ready or self._error is not None:
+                return
+            self._progress.wait(timeout)
+
+    # -- accounting ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            occupied = sum(1 for s in self._occupants if s is not None)
+            return {
+                "gen_slots": self.slots,
+                "gen_occupied": occupied,
+                "gen_waiting": len(self._waiting),
+                "gen_joins": self.joins,
+                "gen_completed": self.completions,
+                "gen_evicted": self.evictions,
+                "gen_cancelled": self.cancellations,
+                "gen_tokens": self.tokens_total,
+                "gen_decode_steps": self.decode_steps,
+                "gen_prefill_chunks": self.prefill_chunks,
+                "gen_tokens_per_step": round(self.tokens_per_step, 3),
+                "gen_jit_buckets": (
+                    len(self._prefill_lru) + len(self._decode_lru)),
+                "gen_decode_compiles": self.model.decode_compiles,
+            }
+
+    # -- pump internals -----------------------------------------------------
+    def _prefill_fn(self, n: int):
+        return lru_bucket(
+            self._prefill_lru, n, self.model.prefill_fn,
+            self.jit_bucket_max)
+
+    def _decode_fn(self, k: int):
+        return lru_bucket(
+            self._decode_lru, k, self.model.decode_fn,
+            self.jit_bucket_max)
+
+    def _take(self, s: GenStream, n: int):
+        """Slice the first ``n`` pending tokens off the stream's buffer
+        (lock held)."""
+        np = self._np
+        buf = (s.pending[0] if len(s.pending) == 1
+               else np.concatenate(s.pending, axis=1))
+        piece = buf[:, :n]
+        rest = buf[:, n:]
+        s.pending = [rest] if rest.shape[1] else []
+        s.pending_n = buf.shape[1] - n
+        return piece
+
+    def _emit_frame(self, s: GenStream, toks, final: bool,
+                    extra_meta: Optional[Dict[str, Any]] = None) -> None:
+        """Emit one chunk frame (lock held).  ``toks`` may be None for
+        a terminal answer with nothing pending (eviction at a chunk
+        boundary / never-joined stream): the stream still gets its
+        FINAL answer as a tensor-LESS frame — the wire carries
+        zero-tensor frames, while a (1, 0) tensor it would refuse."""
+        np = self._np
+        if toks is not None:
+            s.tokens_out += toks.shape[1]
+            tensors = [toks.astype(np.int32)]
+        else:
+            tensors = []
+        out = s.frame.with_tensors(tensors)
+        out.meta.update(
+            stream_seq=s.frame.seq, chunk_index=s.chunk_index,
+            tokens_done=s.tokens_out, final=bool(final),
+        )
+        if extra_meta:
+            out.meta.update(extra_meta)
+        s.chunk_index += 1
+        self._ready.append((0, out))
+        self._progress.notify_all()
+
+    def _emit_boundary(self, s: GenStream) -> None:
+        """Emit EXACTLY chunk-sized pieces (lock held) — identical
+        chunking to the unslotted path, whatever the scan length was."""
+        while s.pending_n >= s.chunk:
+            self._emit_frame(s, self._take(s, s.chunk), final=False)
+
+    def _emit_terminal(self, s: GenStream,
+                       extra_meta: Optional[Dict[str, Any]] = None
+                       ) -> None:
+        """Terminal flush (lock held): full chunks first, then the tail
+        as the FINAL frame (exactly the unslotted tail semantics)."""
+        while s.pending_n > s.chunk:
+            self._emit_frame(s, self._take(s, s.chunk), final=False)
+        self._emit_frame(
+            s, self._take(s, s.pending_n) if s.pending_n else None,
+            final=True, extra_meta=extra_meta)
+
+    def _free_slot(self, s: GenStream) -> None:
+        """Release the stream's slot (lock held): pages become reusable
+        without touching neighbors; the idle mask clears outside."""
+        if s.slot is not None:
+            self._occupants[s.slot] = None
+        self._streams.pop(s.sid, None)
+
+    def _finish(self, s: GenStream, state: str,
+                extra_meta: Optional[Dict[str, Any]] = None) -> None:
+        s.state = state
+        if state == "done":
+            self.completions += 1
+            self._emit_terminal(s)
+        elif state == "evicted":
+            self.evictions += 1
+            self._emit_terminal(s, extra_meta=extra_meta or {})
+        # cancelled: the consumer is gone — nothing to emit
+        self._free_slot(s)
+
+    def _sweep_deadlines(self, now: float) -> None:
+        """Evict streams whose request deadline or per-token budget is
+        blown; expire waiting streams that died in the queue (lock
+        held).  The typed-expiry chunk preserves partial tokens."""
+        for s in list(self._streams.values()):
+            if s.finished:
+                continue
+            if not (s.deadline_ts is not None
+                    and now >= s.deadline_ts - self.EVICT_MARGIN_S):
+                continue
+            if s.state == "waiting":
+                try:
+                    self._waiting.remove(s)
+                except ValueError:
+                    pass
+            self._evict(s, "deadline")
+
+    def _evict(self, s: GenStream, reason: str) -> None:
+        """Typed-expiry eviction (lock held): partial tokens flush with
+        the eviction meta, the slot frees at this boundary."""
+        s.evict_reason = reason
+        self._finish(s, "evicted", extra_meta={
+            "evicted": reason, "deadline_expired": True,
+        })
+        log.warning(
+            "%s: stream %d evicted (%s) after %d token(s)",
+            self.name, s.sid, reason, s.tokens_out)
+
+    def _reap_cancelled(self) -> None:
+        """Free slots of streams cancelled since the last boundary and
+        drop cancelled entries still waiting (lock held)."""
+        self._waiting = [w for w in self._waiting if w.state != "cancelled"]
+        for s in list(self._streams.values()):
+            if s.state == "cancelled":
+                self._free_slot(s)
+
+    def _join_waiting(self, now: float) -> List[GenStream]:
+        """Assign free slots to waiting streams — highest PR-8 priority
+        class first, FIFO within a class (lock held).  Returns the
+        joined streams (their pages reset OUTSIDE the lock)."""
+        joined = []
+        free = [i for i, oc in enumerate(self._occupants) if oc is None]
+        if not free or not self._waiting:
+            return joined
+        order = sorted(
+            range(len(self._waiting)),
+            key=lambda i: (-self._waiting[i].priority, i),
+        )
+        winners = sorted(order[: len(free)])  # FIFO among the admitted
+        for slot, wi in zip(free, winners):
+            s = self._waiting[wi]
+            s.slot = slot
+            s.state = "prefill"
+            s.joined_ts = now
+            s.last_token_ts = now
+            self._occupants[slot] = s
+            self.joins += 1
+            joined.append(s)
+        taken = set(winners)
+        self._waiting = [
+            w for i, w in enumerate(self._waiting) if i not in taken
+        ]
+        return joined
+
+    def _pump(self) -> None:
+        try:
+            self._pump_loop()
+        except BaseException as e:  # noqa: BLE001 — thread boundary
+            with self._lock:
+                self._error = e
+                self._progress.notify_all()
+            if not self._stop.is_set():
+                log.exception("%s: slot pump failed", self.name)
+
+    def _pump_loop(self) -> None:
+        np = self._np
+
+        while not self._stop.is_set():
+            with self._work:
+                self._reap_cancelled()
+                self._sweep_deadlines(self.clock())
+                joined = self._join_waiting(self.clock())
+                have_prefill = any(
+                    s is not None and s.state == "prefill"
+                    for s in self._occupants)
+                have_decode = any(
+                    s is not None and s.state == "decoding"
+                    for s in self._occupants)
+                if not (joined or have_prefill or have_decode):
+                    self._work.wait(0.05)
+                    continue
+
+            # ---- prefill phase: while decoding, up to prefill_priority
+            # chunks interleave per scan (a long prompt never stalls
+            # live streams for more than that); with the decode batch
+            # EMPTY there is nothing to protect — run every pending
+            # joiner's next chunk so the batch fills immediately
+            prefilling = [
+                s for s in self._occupants
+                if s is not None and s.state == "prefill"
+                and not s.finished
+            ]
+            budget = (self.prefill_priority if have_decode
+                      else max(1, len(prefilling)))
+            for s in prefilling:
+                if budget <= 0:
+                    break
+                budget -= 1
+                self._prefill_one(s)
+
+            # ---- decode phase: k tokens for every active slot in ONE
+            # lax.scan dispatch (k = min(chunk, min remaining), so every
+            # stream completes exactly at a scan boundary and joins/
+            # leaves happen at token boundaries)
+            with self._lock:
+                decoding = [
+                    s for s in self._occupants
+                    if s is not None and s.state == "decoding"
+                    and not s.finished
+                ]
+            if not decoding:
+                continue
+            k = min(
+                self.chunk,
+                min(s.max_new - s.gen for s in decoding),
+            )
+            k = max(1, k)
+            active = np.zeros((self.slots,), np.int32)
+            for s in decoding:
+                active[s.slot] = 1
+            self._cache, tok, gen, toks = self._decode_fn(k)(
+                self.params, self._cache, self._tok_vec,
+                self._gen_vec, active,
+            )
+            # materialize BEFORE emission: a yielded token must EXIST,
+            # not merely be dispatched (generator element contract)
+            toks_host = np.asarray(toks)  # (slots, k)
+            # np.array (not asarray): a jax result view is read-only and
+            # prefill writes per-slot entries in place
+            self._tok_vec = np.array(tok, dtype=np.int32)
+            self._gen_vec = np.array(gen, dtype=np.int32)
+            now = self.clock()
+            with self._lock:
+                self.decode_steps += 1
+                self.tokens_total += k * len(decoding)
+                a = 0.2  # EWMA horizon ~ last 5 scans
+                self.tokens_per_step = (
+                    len(decoding) if self.decode_steps == 1
+                    else (1 - a) * self.tokens_per_step + a * len(decoding)
+                )
+                for s in decoding:
+                    if s.finished:  # cancelled mid-scan: tokens discarded
+                        continue
+                    row = toks_host[s.slot:s.slot + 1, :]  # (1, k)
+                    s.tok = int(row[0, -1])
+                    s.gen += k
+                    # per-token pace QoS: the scan's OWN per-token rate
+                    # against the stream's budget — a stream decoding
+                    # slower than its pace is evicted (tokens from this
+                    # scan are preserved in the typed-expiry flush)
+                    pace_blown = (
+                        s.token_budget_s > 0.0
+                        and (now - s.last_token_ts) / k > s.token_budget_s
+                    )
+                    s.last_token_ts = now
+                    s.pending.append(row.astype(np.int32))
+                    s.pending_n += k
+                    if s.gen >= s.max_new:
+                        self._finish(s, "done")
+                    elif pace_blown:
+                        self._evict(s, "token_budget")
+                    else:
+                        self._emit_boundary(s)
+
+    def _prefill_one(self, s: GenStream) -> None:
+        """One chunked-prefill step for a joining stream: reset pages on
+        first touch, run one chunk, pick token 1 when the prompt is
+        done.  Device work runs OUTSIDE the lock."""
+        np = self._np
+
+        slot = np.int32(s.slot)
+        if s.prefill_pos == 0:
+            self._cache = self.model.reset_slot(self._cache, slot)
+        tp = s.prompt.shape[1]
+        n = min(self.prefill_chunk, tp - s.prefill_pos)
+        toks = s.prompt[:, s.prefill_pos:s.prefill_pos + n].astype(np.int32)
+        self._cache, logits = self._prefill_fn(n)(
+            self.params, self._cache, toks, slot)
+        s.prefill_pos += n
+        with self._lock:
+            self.prefill_chunks += 1
+        if s.prefill_pos < tp:
+            return
+        # prompt fully prefilled: pick token 1 (raw gen_seed key — the
+        # exact pick the unslotted prefill applies)
+        t1 = self.model.pick_first(logits)
+        t1_host = int(np.asarray(t1)[0])
+        self._tok_vec[s.slot] = t1_host
+        self._gen_vec[s.slot] = 1
+        now = self.clock()
+        with self._lock:
+            if s.finished:  # cancelled during prefill
+                return
+            s.tok = t1_host
+            s.gen = 1
+            self.tokens_total += 1  # token 1 comes from the prefill pick
+            s.last_token_ts = now
+            s.pending.append(np.array([[t1_host]], np.int32))
+            s.pending_n = 1
+            if s.max_new <= 1:
+                self._finish(s, "done")
+            else:
+                s.state = "decoding"
+                self._emit_boundary(s)

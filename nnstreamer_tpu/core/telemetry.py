@@ -170,6 +170,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.query.remote_server_ms": ("gauge", "EWMA server-side time via the remote"),
     "nns.query.remote_client_queue_ms": ("gauge", "EWMA client-queue segment"),
     # sources/sinks, wire integrity, datarepo
+    # -- continuous batching (core/slots.py + tensor_generator) ------------
+    "nns.gen.slots": ("gauge", "configured slot-batch width"),
+    "nns.gen.occupied": ("gauge", "slots held by live generation streams"),
+    "nns.gen.waiting": ("gauge", "prompts queued for a free slot"),
+    "nns.gen.joins": ("counter", "streams that claimed a slot"),
+    "nns.gen.completed": ("counter", "streams that finished their tokens"),
+    "nns.gen.evicted": ("counter", "streams evicted on deadline/pace (typed expiry)"),
+    "nns.gen.cancelled": ("counter", "streams cancelled (consumer gone)"),
+    "nns.gen.tokens": ("counter", "tokens decoded across all slots"),
+    "nns.gen.decode_steps": ("counter", "slot-batch decode steps"),
+    "nns.gen.prefill_chunks": ("counter", "chunked-prefill pieces interleaved"),
+    "nns.gen.tokens_per_step": ("gauge", "EWMA active slots per decode step"),
+    "nns.gen.jit_buckets": ("gauge", "live decode/prefill compile buckets (LRU-bounded)"),
+    "nns.gen.decode_compiles": ("counter", "slotted decode-step retraces (shape churn)"),
+
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
     "nns.wire.corrupt_dropped": ("counter", "undecodable pub/sub frames dropped"),
@@ -229,6 +244,19 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "truncated_samples": "nns.datarepo.truncated_samples",
     "pending_frames": "nns.source.pending",
     "rendered_frames": "nns.sink.rendered",
+    "gen_slots": "nns.gen.slots",
+    "gen_occupied": "nns.gen.occupied",
+    "gen_waiting": "nns.gen.waiting",
+    "gen_joins": "nns.gen.joins",
+    "gen_completed": "nns.gen.completed",
+    "gen_evicted": "nns.gen.evicted",
+    "gen_cancelled": "nns.gen.cancelled",
+    "gen_tokens": "nns.gen.tokens",
+    "gen_decode_steps": "nns.gen.decode_steps",
+    "gen_prefill_chunks": "nns.gen.prefill_chunks",
+    "gen_tokens_per_step": "nns.gen.tokens_per_step",
+    "gen_jit_buckets": "nns.gen.jit_buckets",
+    "gen_decode_compiles": "nns.gen.decode_compiles",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by

@@ -272,6 +272,68 @@ class QueryServerCore:
                 "total": total,
             }
 
+    def client_live(self, client_id: int) -> bool:
+        """True while the client's RPC/connection still waits for
+        answers (the serversink's client-gone feedback probes this
+        before cancelling a generation stream upstream)."""
+        with self._pending_lock:
+            return client_id in self._pending
+
+    def process_stream(self, frame: TensorFrame, timeout: float):
+        """One STREAMING request (transport-shared: gRPC ``InvokeStream``
+        and the raw-TCP 'S' message): admit, inject the prompt, then
+        yield answer frames as the server pipeline produces them until
+        one carries ``meta["final"] is True`` (the tensor_generator
+        chunk contract; an answer with NO ``final`` key — a plain 1:1
+        graph — closes the stream after one message).
+
+        Raises :class:`ServerGoawayError` / :class:`ServerBusyError`
+        BEFORE any ingest (resend-safe refusals) and ``TimeoutError``
+        when the pipeline goes silent mid-stream.  The request frame is
+        deadline-stamped from the client's remaining budget (PR-2
+        plumbing), so a continuous-batching generator can EVICT the
+        stream with a typed expiry instead of decoding past the budget.
+        Cleanup (pending slot, admission release) runs on ANY exit,
+        including the transport abandoning the generator mid-yield."""
+        if self.draining:
+            self.goaway_sent += 1
+            raise ServerGoawayError()
+        tenant = self._admit([frame])
+        try:
+            # the CLIENT's deadline governs the whole stream (a long
+            # generation is the point); hard backstop only against
+            # deadline-less channels
+            budget = min(timeout, 3600.0)
+            rx = time.perf_counter()
+            stamp_deadline(frame, budget)
+            frame.meta[TL_RX_META] = rx
+            with self._pending_client([frame]) as answer_q:
+                deadline = time.monotonic() + budget
+                while True:
+                    try:
+                        ans = answer_q.get(
+                            timeout=max(0.0, deadline - time.monotonic())
+                        )
+                    except queue.Empty:
+                        raise TimeoutError(
+                            "server pipeline produced no (further) "
+                            "answer in time"
+                        ) from None
+                    # per-chunk span decomposition (each chunk's meta is
+                    # a fresh copy of the request's, so "total" reads as
+                    # time-since-request at that chunk)
+                    self._stamp_server_spans([ans])
+                    yield ans
+                    if ans.meta.get("final", True):
+                        if "final" not in ans.meta:
+                            cid = ans.meta.get("client_id")
+                            if cid is not None:
+                                with self._pending_lock:
+                                    self._heuristic_closed.append(cid)
+                        return
+        finally:
+            self._release(tenant)
+
     def _ingress_items(self, frames: List[TensorFrame]) -> List[TensorFrame]:
         """block_ingress: a wire micro-batch becomes ONE BatchFrame so the
         server pipeline pays per-frame Python costs once per batch; falls
@@ -349,51 +411,27 @@ class QueryServerCore:
             self.corrupt_requests += 1
             log.warning("corrupt stream request refused (DATA_LOSS): %s", e)
             context.abort(grpc.StatusCode.DATA_LOSS, f"corrupt request: {e}")
-        if self.draining:
-            self.goaway_sent += 1
+        gen = self.process_stream(
+            frame, float(context.time_remaining() or 30.0))
+        try:
+            for ans in gen:
+                yield encode_frame(ans, version=self.wire_version)
+        except ServerGoawayError:
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "goaway: server draining")
-        try:
-            tenant = self._admit([frame])
         except ServerBusyError as e:
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"server busy; retry_after={e.retry_after:.6f}",
             )
-        try:
-            with self._pending_client([frame]) as answer_q:
-                # the CLIENT's deadline governs the whole stream (a long
-                # generation is the point); hard backstop only against
-                # deadline-less channels
-                deadline = time.monotonic() + min(
-                    float(context.time_remaining() or 30.0), 3600.0
-                )
-                while True:
-                    try:
-                        ans = answer_q.get(
-                            timeout=max(0.0, deadline - time.monotonic())
-                        )
-                    except queue.Empty:
-                        context.abort(
-                            grpc.StatusCode.DEADLINE_EXCEEDED,
-                            "server pipeline produced no (further) answer "
-                            "in time",
-                        )
-                    yield encode_frame(ans, version=self.wire_version)
-                    # a non-streaming graph emits exactly one answer with
-                    # no "final" key -> treat absent as final.  A
-                    # multi-answer graph MUST stamp meta["final"] (False
-                    # on intermediate chunks) or its stream truncates here
-                    # — resolve() flags the dropped answers with the cause.
-                    if ans.meta.get("final", True):
-                        if "final" not in ans.meta:
-                            cid = ans.meta.get("client_id")
-                            if cid is not None:
-                                with self._pending_lock:
-                                    self._heuristic_closed.append(cid)
-                        return
+        except TimeoutError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         finally:
-            self._release(tenant)
+            # a cancelled RPC abandons this handler mid-yield: closing
+            # the shared generator runs its cleanup (pending slot freed
+            # -> the serversink's next chunk delivery sees client-gone
+            # and cancels the stream upstream; admission released)
+            gen.close()
 
     def resolve(self, client_id: int, frame: TensorFrame,
                 limit: int = 0) -> bool:

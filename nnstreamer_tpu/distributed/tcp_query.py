@@ -105,6 +105,14 @@ _T_GOAWAY = ord("G")
 # integrity: the request failed checksum/envelope verification before any
 # execution — resend-safe; body = error text
 _T_CORRUPT = ord("C")
+# server-streaming invoke (continuous batching / tensor_generator): ONE
+# request frame in, a sequence of 'S' replies out — each body one NNSQ
+# answer frame — until a reply's meta carries ``final`` True (or no
+# ``final`` key: a plain 1:1 graph answers once).  Errors keep their
+# usual types ('B'/'G'/'C' before the first chunk, 'T' on a silent
+# pipeline, 'E' app errors); the connection is HELD by the stream for
+# its whole life (the client pool provides concurrency across streams).
+_T_STREAM = ord("S")
 
 # liveness bound for the server reader: a peer that begins a message and
 # then stalls (no bytes) this long is dropped instead of wedging the
@@ -318,6 +326,11 @@ class TcpQueryConnection:
         self._verify = bool(verify_checksum)
         self._peer_v1 = self._wire_version == V1
         self._sock_ver: Dict[socket.socket, int] = {}
+        # sockets currently checked out to callers: close() force-closes
+        # them too, so an in-flight STREAM dies with its client element
+        # (the server sees the break and cancels the generation) instead
+        # of outliving it until the consumer generator is collected
+        self._held: set = set()
 
     # -- socket pool --------------------------------------------------------
     def _negotiate(self, sock: socket.socket) -> int:
@@ -379,7 +392,9 @@ class TcpQueryConnection:
                         except OSError:
                             pass
                 elif self._free:
-                    return self._free.pop(), True
+                    sock = self._free.pop()
+                    self._held.add(sock)
+                    return sock, True
                 if self._live < self._nconns:
                     self._live += 1
                     break
@@ -387,15 +402,29 @@ class TcpQueryConnection:
                     raise TimeoutError(
                         f"no free connection to {self.addr} in {timeout}s")
         try:
-            return self._connect(), False
+            sock = self._connect()
         except Exception:
             with self._cv:
                 self._live -= 1
                 self._cv.notify()
             raise
+        with self._cv:
+            if self._closed:
+                # close() ran while we dialed: don't leak a live socket
+                self._live -= 1
+                self._sock_ver.pop(sock, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._cv.notify()
+                raise ConnectionError("connection closed")
+            self._held.add(sock)
+        return sock, False
 
     def _checkin(self, sock: socket.socket, broken: bool) -> None:
         with self._cv:
+            self._held.discard(sock)
             if broken or self._closed:
                 self._live -= 1
                 self._sock_ver.pop(sock, None)
@@ -519,10 +548,91 @@ class TcpQueryConnection:
         self._check_reply(rtype, body)
         return decode_frames(body, verify=self._verify)
 
+    def invoke_stream(self, frame: TensorFrame,
+                      timeout: Optional[float] = None):
+        """Server-streaming invoke over raw TCP ('S' message): yields
+        answer frames as they arrive until one is final-flagged (or has
+        no ``final`` meta).  ``timeout`` bounds the WHOLE stream; one
+        pooled socket is held for its duration (API parity with
+        :meth:`.service.QueryConnection.invoke_stream`).
+
+        Failure contract: a send-phase failure on a REUSED socket gets
+        one fresh-dial retry (the request provably never executed);
+        anything after the send follows the stream rules — typed refusal
+        replies ('B'/'G'/'C'/'T'/'E') leave the socket aligned and
+        poolable, a transport break or an abandoned stream evicts it."""
+        timeout = self._timeout if timeout is None else timeout
+        for attempt in (0, 1):
+            sock, reused = self._checkout(timeout, fresh=(attempt == 1))
+            ver = self._sock_ver.get(sock, V1)
+            broken = True
+            sent = False
+            try:
+                sock.settimeout(timeout)
+                FAULTS.check("tcp_query.send")
+                parts = encode_frame_parts(frame, version=ver)
+                if FAULTS.is_armed():
+                    parts = FAULTS.mangle_parts("tcp_query.send", parts)
+                _send_msg(sock, _T_STREAM, parts,
+                          deadline_s=timeout, version=ver)
+                sent = True
+                FAULTS.check("tcp_query.recv")
+                deadline = time.monotonic() + timeout
+                while True:
+                    # the WHOLE-stream budget is a hard bound (gRPC
+                    # parity: the RPC deadline kills the stream): a
+                    # server still producing chunks past it must not
+                    # keep the stream alive through per-recv grace
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"stream to {self.addr} exceeded its "
+                            f"{timeout}s budget")
+                    # each chunk wait is carved from the stream budget
+                    sock.settimeout(
+                        max(0.05, deadline - time.monotonic()))
+                    try:
+                        rtype, body, _ = _recv_msg(
+                            sock, version=ver, verify=self._verify)
+                    except socket.timeout:
+                        raise TimeoutError(
+                            f"stream to {self.addr}: no (further) answer "
+                            f"within the {timeout}s budget") from None
+                    if FAULTS.is_armed():
+                        body = FAULTS.mangle("tcp_query.recv", body)
+                    if rtype != _T_STREAM:
+                        # typed refusal/timeout reply: the framing is
+                        # intact — socket back to the pool, error raised
+                        broken = False
+                        self._check_reply(rtype, body)
+                        raise RemoteApplicationError(
+                            f"unexpected stream reply type {rtype}")
+                    ans = decode_frame(body, verify=self._verify)
+                    if ans.meta.get("final", True):
+                        broken = False  # clean completion
+                        yield ans
+                        return
+                    yield ans
+            except (ConnectionError, OSError) as e:
+                if (attempt == 0 and reused and not sent
+                        and not isinstance(e, TimeoutError)):
+                    log.debug(
+                        "stale pooled socket to %s (%s); retrying stream "
+                        "on a fresh connection", self.addr, e)
+                    continue
+                raise
+            finally:
+                self._checkin(sock, broken)
+            return
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
             socks, self._free = self._free, []
+            # force-close HELD sockets too: the caller blocked on them
+            # gets a prompt OSError (its checkin then evicts the entry),
+            # and a server streaming into one sees the break and cancels
+            # the generation — a stopped client must look dead, not idle
+            socks.extend(self._held)
             self._sock_ver.clear()
             self._cv.notify_all()
         for s in socks:
@@ -753,6 +863,47 @@ class TcpQueryServer:
                                                     version=conn_ver)
                         )
                         self._reply(conn, _T_QUERY, parts, conn_ver)
+                    elif mtype == _T_STREAM:
+                        try:
+                            frame = decode_frame(body, verify=self._verify)
+                        except WireError as e:
+                            self._note_corrupt(e)
+                            self._reply(conn, _T_CORRUPT, [str(e).encode()],
+                                        conn_ver)
+                            continue
+                        gen = self._core.process_stream(
+                            frame, deadline_s if deadline_s > 0 else 30.0)
+                        try:
+                            while True:
+                                try:
+                                    ans = next(gen)
+                                except StopIteration:
+                                    break
+                                except TimeoutError as e:
+                                    # scoped to the GENERATOR only: a
+                                    # socket.timeout from the chunk
+                                    # sends below is a TimeoutError too
+                                    # and must stay an OSError-path
+                                    # connection drop, not a 'T' reply
+                                    # on a wedged socket (same contract
+                                    # as the unary handler)
+                                    self._reply(conn, _T_TIMEOUT,
+                                                [str(e).encode()],
+                                                conn_ver)
+                                    break
+                                self._reply(
+                                    conn, _T_STREAM,
+                                    encode_frame_parts(ans,
+                                                       version=conn_ver),
+                                    conn_ver)
+                        finally:
+                            # a peer that died mid-stream breaks the
+                            # reply send (OSError path below): closing
+                            # the generator HERE frees the pending slot
+                            # + admission deterministically, so the next
+                            # chunk delivery sees client-gone and the
+                            # generation stream is cancelled upstream
+                            gen.close()
                     else:
                         self._reply(
                             conn, _T_ERROR,

@@ -11,27 +11,53 @@ transport, no extra machinery.
 TPU-first structure: the zoo transformer's KV cache (device-resident
 pytree) is carried across jitted calls — prefill is one causal pass, each
 chunk is one ``lax.scan`` segment (compile buckets: one per distinct
-chunk length, i.e. the chunk size + one tail).  Python dispatch cost is
-per CHUNK, not per token.  Sampling (greedy/temperature/top-k, per-step
-key folding) is bit-identical to one-shot ``generate:<N>`` serving
-(``models/transformer.py make_stream_generate``).
+chunk length, i.e. the chunk size + one tail, bounded by an LRU).  Python
+dispatch cost is per CHUNK, not per token.  Sampling (greedy/temperature/
+top-k, per-step key folding) is bit-identical to one-shot ``generate:<N>``
+serving (``models/transformer.py make_stream_generate``).
 
-Emission contract: ``handle_frame`` returns a GENERATOR; the scheduler
-pushes each yielded frame downstream as it is produced (frames stream,
-they do not wait for the full completion).  Each chunk frame carries
-tokens (B, n) int32 plus meta ``stream_seq`` (source frame seq),
-``chunk_index``, ``tokens_done`` and ``final``.
+Continuous batching (``slots=N``, core/slots.py): the element multiplexes
+MANY concurrent prompt streams into one fixed-width slot batch — live
+requests occupy slots, new prompts join at token boundaries via chunked
+prefill interleaved with decode, finished/cancelled/deadline-evicted
+streams free their slot immediately, and the idle-slot mask keeps the
+jitted decode step shape-stable (zero retracing as streams churn).  A
+single occupant's output stays bit-identical to the seed per-request
+path.  The engine decodes on its own pump thread; chunks are EMITTED on
+the element's dispatch thread (``handle_frame``/``handle_idle`` drain
+``pop_ready``), so supervision attribution is unchanged — the PR-6
+CompletionWindow discipline.
+
+Emission contract: ``handle_frame`` returns frames/generators; the
+scheduler pushes each yielded frame downstream as it is produced (frames
+stream, they do not wait for the full completion).  Each chunk frame
+carries tokens (B, n) int32 plus meta ``stream_seq`` (source frame seq),
+``chunk_index``, ``tokens_done`` and ``final`` (evicted streams add
+``evicted``/``deadline_expired`` — the typed expiry).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict
 
 import numpy as np
 
 from ..core.buffer import BatchFrame
+from ..core.liveness import (
+    DEADLINE_META,
+    PRIORITY_MAX,
+    PRIORITY_META,
+    TENANT_META,
+    clamp_priority,
+)
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, element
+
+#: bound on live decode-chunk jit buckets (LRU — the discipline of the
+#: filter's _stack_jit_cache, PR-3): distinct chunk lengths churn (tail
+#: chunks, reconfigured clients) but live executables stay bounded
+_JIT_BUCKET_MAX = 16
 
 
 @element("tensor_generator")
@@ -48,6 +74,31 @@ class TensorGenerator(Element):
         "max-new": Property(int, 32, "tokens to generate per prompt"),
         "chunk": Property(int, 8, "tokens per streamed chunk frame"),
         "max-buffers": Property(int, 0, "mailbox depth override"),
+        # continuous batching (core/slots.py): 0 = per-request streaming
+        # (seed path), N>0 = N-wide slot batch shared by concurrent
+        # streams (compile-once per width; requests join/leave at token
+        # boundaries)
+        "slots": Property(
+            int, 0,
+            "continuous-batching slot width: concurrent prompt streams "
+            "share one fixed decode batch (0 = serve requests one at a "
+            "time, the pre-slot path)"),
+        "prefill-chunk": Property(
+            int, 32,
+            "prompt tokens prefilled per engine iteration when joining a "
+            "slot (chunked prefill interleaves with decode so a long "
+            "prompt never stalls live streams)"),
+        "prefill-priority": Property(
+            int, 1,
+            "prefill chunks interleaved per decode step (0 = joining "
+            "prompts prefill only while nothing is decoding — decode "
+            "throughput over join latency)"),
+        "token-budget-s": Property(
+            float, 0.0,
+            "per-token pace budget: a slotted stream that takes longer "
+            "than this between tokens is evicted with the typed expiry "
+            "(0 = off; the request's own deadline-s budget is always "
+            "honored)"),
     }
 
     def __init__(self, name=None):
@@ -56,7 +107,8 @@ class TensorGenerator(Element):
         self._decode = None
         self._params = None
         self._max_seq = 0
-        self._jit_chunks: Dict[int, Any] = {}
+        self._jit_chunks: "OrderedDict[int, Any]" = OrderedDict()
+        self._engine = None
 
     def start(self):
         import jax
@@ -69,26 +121,80 @@ class TensorGenerator(Element):
                 k, _, v = part.partition(":")
                 props[k.strip()] = v.strip()
         props.pop("arch", None)  # tolerated for zoo-dialect symmetry
+        slots = int(self.props["slots"])
+        if slots < 0:
+            raise ElementError(f"{self.name}: slots must be >= 0")
+        # slotted mode needs its OWN mailbox + dispatch thread: the
+        # scheduler's idle hook (handle_idle) and pending_frames fast-poll
+        # only run for chain heads, and they are how engine-completed
+        # chunks reach the wire between input frames.  Checked by the
+        # fusion partition, which runs after start().
+        self.THREAD_BOUNDARY = slots > 0
+        if slots > 0:
+            from ..core.slots import SimSlotModel, SlotEngine
+
+            if props.get("sim", "") not in ("", "0", "false"):
+                # async-sim proxy (PR-6 discipline): deterministic token
+                # recurrence + TPU-shaped step costs — drives the slot
+                # SCHEDULER through the full pipeline without a model
+                # (perf floors + chaos harness)
+                model = SimSlotModel(
+                    slots,
+                    vocab=int(props.get("vocab", "997")),
+                    step_base_ms=float(props.get("sim_step_ms", "1.0")),
+                    step_per_slot_ms=float(
+                        props.get("sim_per_slot_ms", "0.05")),
+                    prefill_ms_per_token=float(
+                        props.get("sim_prefill_ms", "0.02")),
+                )
+                params = None
+                self._max_seq = int(props.get("seq", str(1 << 30)))
+            else:
+                from ..models.transformer import build_slot_stream
+
+                model, params, self._max_seq = build_slot_stream(
+                    props, slots)
+            self._params = params
+            self._engine = SlotEngine(
+                model, params,
+                max_seq=self._max_seq,
+                chunk=max(1, int(self.props["chunk"])),
+                prefill_chunk=int(self.props["prefill-chunk"]),
+                prefill_priority=int(self.props["prefill-priority"]),
+                token_budget_s=float(self.props["token-budget-s"]),
+                name=self.name,
+            )
+            self._engine.start()
+            return
+        if props.get("sim", "") not in ("", "0", "false"):
+            raise ElementError(
+                f"{self.name}: custom sim: needs slots >= 1 (the sim "
+                "proxy drives the slot engine; slots=1 is the "
+                "request-serial baseline)")
         prefill, decode_chunk, params, self._max_seq = build_stream(props)
         self._prefill = jax.jit(prefill)
         self._decode = decode_chunk
         self._params = params
-        self._jit_chunks = {}
+        self._jit_chunks = OrderedDict()
 
     def stop(self):
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None
         self._prefill = self._decode = self._params = None
         self._jit_chunks.clear()
 
     def _decode_n(self, n: int):
         import jax
 
-        fn = self._jit_chunks.get(n)
-        if fn is None:
-            fn = jax.jit(
-                lambda p, cache, tok, t0: self._decode(p, cache, tok, t0, n)
+        from ..core.slots import lru_bucket
+
+        def build(k):
+            return jax.jit(
+                lambda p, cache, tok, t0: self._decode(p, cache, tok, t0, k)
             )
-            self._jit_chunks[n] = fn
-        return fn
+
+        return lru_bucket(self._jit_chunks, n, build, _JIT_BUCKET_MAX)
 
     # -- negotiation --------------------------------------------------------
     def accept_spec(self, pad, spec):
@@ -98,8 +204,66 @@ class TensorGenerator(Element):
         # chunk length varies (tail chunk): flexible stream
         return StreamSpec((), FORMAT_FLEXIBLE)
 
+    # -- observability ------------------------------------------------------
+    def health_info(self) -> Dict[str, Any]:
+        """Slot occupancy / join / evict / tokens-per-step counters —
+        merged into ``Pipeline.health()`` AND exported to the PR-7
+        registry as ``nns.gen.*`` via the health collector's key map
+        (ONE export path; metrics_info here would double-emit the same
+        series).  ``gen_jit_buckets`` counts live decode-chunk compile
+        buckets on BOTH paths, so retrace churn is visible."""
+        info: Dict[str, Any] = {"gen_jit_buckets": len(self._jit_chunks)}
+        if self._engine is not None:
+            info.update(self._engine.snapshot())
+            info["gen_jit_buckets"] += len(self._jit_chunks)
+        return info
+
+    # -- continuous-batching hooks ------------------------------------------
+    def pending_frames(self) -> int:
+        """Streams parked in the slot engine plus undelivered ready
+        chunks (scheduler fast-poll + drain/stop accounting)."""
+        return self._engine.pending() if self._engine is not None else 0
+
+    def handle_idle(self):
+        """Drain chunks the engine completed since the last call —
+        emission happens HERE, on the dispatch thread."""
+        if self._engine is None:
+            return []
+        return self._engine.pop_ready()
+
+    def note_stream_cancel(self, meta: Dict[str, Any]) -> None:
+        """Downstream feedback (serversink): the consumer of this stream
+        is GONE — free its slot immediately instead of decoding tokens
+        nobody will read."""
+        if self._engine is None:
+            return
+        cid = meta.get("client_id")
+        if cid is not None:
+            self._engine.cancel(client_id=cid)
+
+    def handle_eos(self, pad):
+        """Slotted mode: the stream only ends once every live generation
+        completed — flush the engine through the dispatch thread."""
+        eng = self._engine
+        if eng is None:
+            return []
+
+        def flush():
+            while True:
+                for out in eng.pop_ready():
+                    yield out
+                if eng.idle():
+                    return
+                if self.interrupted:
+                    return  # watchdog escalation: stop flushing
+                eng.wait_progress(0.05)
+
+        return flush()
+
     # -- processing ---------------------------------------------------------
     def handle_frame(self, pad, frame):
+        if self._engine is not None:
+            return self._handle_slotted(frame)
         assert self._prefill is not None, f"{self.name} not started"
         if isinstance(frame, BatchFrame):
             # lazily chain one stream per logical prompt: chunk frames of
@@ -113,7 +277,7 @@ class TensorGenerator(Element):
             return multi()
         return self._stream_one(frame)
 
-    def _stream_one(self, frame):
+    def _validated_prompt(self, frame, max_new: int) -> np.ndarray:
         prompt = np.asarray(frame.tensors[0])
         if prompt.ndim == 1:
             prompt = prompt[None]
@@ -122,8 +286,6 @@ class TensorGenerator(Element):
                 f"{self.name}: prompt must be int tokens (B, Tp) or (Tp,), "
                 f"got {prompt.shape} {prompt.dtype}"
             )
-        max_new = int(self.props["max-new"])
-        chunk = max(1, int(self.props["chunk"]))
         if prompt.shape[1] + max_new > self._max_seq:
             # the cache ring would wrap and pos_embed would index past
             # max_seq — fail loud instead of streaming corrupt tokens
@@ -131,6 +293,41 @@ class TensorGenerator(Element):
                 f"{self.name}: prompt {prompt.shape[1]} + max-new "
                 f"{max_new} exceeds the model's seq {self._max_seq}"
             )
+        return prompt
+
+    def _handle_slotted(self, frame):
+        """Submit the prompt(s) to the slot engine and drain whatever
+        chunks are already ready — new prompts JOIN live decoding at the
+        next token boundary instead of queueing behind it."""
+        max_new = int(self.props["max-new"])
+        chunk = max(1, int(self.props["chunk"]))
+        logical = frame.split() if isinstance(frame, BatchFrame) else [frame]
+        for lf in logical:
+            prompt = self._validated_prompt(lf, max_new)
+            if prompt.shape[0] != 1:
+                # one stream per slot: split multi-row prompts upstream
+                # (appsrc push_block) or serve them on the pre-slot path
+                raise ElementError(
+                    f"{self.name}: slots>0 serves one prompt per stream; "
+                    f"got a (B={prompt.shape[0]}) prompt batch — push a "
+                    "block of single prompts instead"
+                )
+            if max_new <= 0:
+                continue
+            meta = lf.meta
+            self._engine.submit(
+                lf, prompt.astype(np.int32), max_new, chunk,
+                tenant=str(meta.get(TENANT_META, "") or ""),
+                priority=clamp_priority(
+                    meta.get(PRIORITY_META, PRIORITY_MAX)),
+                deadline_ts=meta.get(DEADLINE_META),
+            )
+        return self._engine.pop_ready()
+
+    def _stream_one(self, frame):
+        prompt = self._validated_prompt(frame, int(self.props["max-new"]))
+        max_new = int(self.props["max-new"])
+        chunk = max(1, int(self.props["chunk"]))
         if max_new <= 0:
             return []
 
@@ -172,6 +369,3 @@ class TensorGenerator(Element):
                 t += n
 
         return stream()
-
-    def handle_eos(self, pad):
-        return []

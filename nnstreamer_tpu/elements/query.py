@@ -395,9 +395,18 @@ class TensorQueryServerSink(SinkElement):
                 f"{self.name}: frame lacks client_id meta (did it pass through "
                 "an element that drops meta?)"
             )
-        self._core.resolve(
+        delivered = self._core.resolve(
             int(client_id), frame, limit=self.props["limit"]
         )
+        if (not delivered and frame.meta.get("final") is False
+                and not self._core.client_live(int(client_id))):
+            # mid-stream chunk for a VANISHED client (RPC cancelled,
+            # socket died): tell upstream stream producers so a slot
+            # engine frees the dead stream's slot immediately instead of
+            # decoding tokens nobody will read
+            p = self._pipeline
+            if p is not None:
+                p.stream_cancel_feedback(self, frame.meta)
 
 
 class _PoolState:
@@ -553,10 +562,10 @@ class TensorQueryClient(Element):
         "wire-batch": Property(int, 1, "max frames per RPC (1 = no batching)"),
         "stream": Property(
             bool, False,
-            "server-streaming invoke (gRPC): answer frames are emitted as "
-            "the remote pipeline produces them until a final-flagged one "
-            "arrives — remote streaming generation; incompatible with "
-            "wire-batch > 1 and connect-type=tcp",
+            "server-streaming invoke (gRPC InvokeStream / raw-TCP 'S' "
+            "message): answer frames are emitted as the remote pipeline "
+            "produces them until a final-flagged one arrives — remote "
+            "streaming generation; incompatible with wire-batch > 1",
         ),
         "connect-type": Property(
             str, "grpc",
@@ -742,11 +751,6 @@ class TensorQueryClient(Element):
             raise ElementError(f"{self.name}: query client needs host/port")
         ct = self.props["connect-type"]
         if self.props["stream"]:
-            if ct != "grpc":
-                raise ElementError(
-                    f"{self.name}: stream=true needs connect-type=grpc "
-                    "(server-streaming RPC)"
-                )
             if int(self.props["wire-batch"]) > 1:
                 raise ElementError(
                     f"{self.name}: stream=true is per-request; "
@@ -1721,6 +1725,12 @@ class TensorQueryClient(Element):
                         ps.down_until.pop(i, None)
                         if deadline_ts is not None:
                             ans.meta[DEADLINE_META] = deadline_ts
+                        if ans.meta.get("deadline_expired"):
+                            # server-side slot eviction (typed expiry):
+                            # the stream was ANSWERED with its partial
+                            # tokens — count the blown budget without
+                            # discarding what already decoded
+                            self._note_expired()
                         yield (0, ans)
                 finally:
                     self._inflight_end(addr_i)

@@ -52,6 +52,14 @@ class Block(nn.Module):
     mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
     decode: bool = False  # KV-cache single-token step (generation serving)
+    # continuous batching (core/slots.py): the cache becomes SLOT-INDEXED
+    # pages — per-slot write positions instead of one shared scalar, so
+    # independent generation streams at different depths share one batch.
+    # Each slot's pages are written through its own dynamic_update_slice
+    # (a joining stream touches only its slot; a leaving stream's pages
+    # are reusable without touching neighbors) and the causal mask is
+    # per-slot, so the jitted step stays shape-stable as streams churn.
+    slotted: bool = False
 
     def _dense(self, features, name):
         from ._quant_flax import dense_or_quant
@@ -60,7 +68,7 @@ class Block(nn.Module):
         return dense_or_quant(self.cfg.quant, features, self.cfg.dtype, name)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, active=None):
         cfg = self.cfg
         B, T, D = x.shape
         H = cfg.n_heads
@@ -70,7 +78,62 @@ class Block(nn.Module):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        if self.decode:
+        if self.decode and self.slotted:
+            # per-slot paged KV cache: index is a VECTOR (one write
+            # position per slot).  Idle slots (active=0) keep writing
+            # harmlessly into their frozen position but never advance —
+            # the mask math stays identical for every occupied slot, so
+            # a single occupant's row is bit-identical to the unslotted
+            # path (row independence; pinned in tests).
+            ck = self.variable(
+                "cache", "key",
+                lambda: jnp.zeros((B, cfg.max_seq, H, D // H), cfg.dtype),
+            )
+            cv = self.variable(
+                "cache", "value",
+                lambda: jnp.zeros((B, cfg.max_seq, H, D // H), cfg.dtype),
+            )
+            idx = self.variable(
+                "cache", "index", lambda: jnp.zeros((B,), jnp.int32)
+            )
+            pos = idx.value  # (B,)
+
+            # per-slot page write WITHOUT a scatter: vmapped
+            # dynamic_update_slice lowers to lax.scatter, which XLA's CPU
+            # backend executes orders of magnitude slower than the
+            # equivalent dense select; one broadcast `where` per chunk
+            # position (T is static) keeps the write a single vectorized
+            # pass over the slot's pages
+            def write(c, kk):
+                for t in range(T):
+                    hit = (
+                        jnp.arange(cfg.max_seq)[None, :]
+                        == (pos + t)[:, None]
+                    )[..., None, None]  # (B, S, 1, 1)
+                    c = jnp.where(hit, kk[:, t:t + 1], c)
+                return c
+
+            ck.value = write(ck.value, k)
+            cv.value = write(cv.value, v)
+            adv = T if active is None else T * active.astype(jnp.int32)
+            idx.value = pos + adv
+            # slot b, query i (global position pos[b]+i) sees cache
+            # slots <= pos[b]+i
+            mask = (
+                jnp.arange(cfg.max_seq)[None, None, :]
+                <= (pos[:, None] + jnp.arange(T)[None, :])[..., None]
+            )  # (B, T, S)
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q.astype(jnp.float32),
+                ck.value.astype(jnp.float32),
+            ) / np.sqrt(D // H)
+            scores = jnp.where(mask[:, None], scores, -1e30)
+            attn = jnp.einsum(
+                "bhts,bshd->bthd",
+                jax.nn.softmax(scores, axis=-1),
+                cv.value.astype(jnp.float32),
+            ).astype(cfg.dtype)
+        elif self.decode:
             # KV-cache attention over a static-shape ring of max_seq slots
             # (dynamic_update_slice keeps the generate loop one compiled
             # program — no growing shapes).  T == 1 is the per-token decode
@@ -139,13 +202,23 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
     decode: bool = False
+    slotted: bool = False  # per-slot cache positions (continuous batching)
 
     @nn.compact
-    def __call__(self, tokens):  # (B, T) int32
+    def __call__(self, tokens, active=None):  # (B, T) int32
         cfg = self.cfg
         x = nn.Embed(cfg.vocab, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
-        T = tokens.shape[1]
-        if self.decode:
+        B, T = tokens.shape
+        if self.decode and self.slotted:
+            # per-slot position counter: each stream advances its own
+            # step; idle slots (active=0) stay frozen
+            step = self.variable(
+                "cache", "step", lambda: jnp.zeros((B,), jnp.int32)
+            )
+            positions = step.value[:, None] + jnp.arange(T)[None, :]
+            adv = T if active is None else T * active.astype(jnp.int32)
+            step.value = step.value + adv
+        elif self.decode:
             step = self.variable(
                 "cache", "step", lambda: jnp.zeros((), jnp.int32)
             )
@@ -160,8 +233,8 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             x = Block(
                 cfg, self.mesh, self.seq_axis, decode=self.decode,
-                name=f"block{i}",
-            )(x)
+                slotted=self.slotted, name=f"block{i}",
+            )(x, active)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab, use_bias=False, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
@@ -235,6 +308,26 @@ def make_generate(
     return gen
 
 
+def _make_pick(temperature: float, top_k: int):
+    """The ONE sampling rule every generation path shares (one-shot,
+    streaming, slotted): greedy argmax at ``temperature<=0``, else
+    softmax(logits/temperature) truncated to ``top_k``.  Factored out so
+    the slotted per-slot picker provably applies the same math per row."""
+
+    def pick(logits, key):  # (B, V) -> (B,)
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][
+                :, -1:
+            ]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return pick
+
+
 def make_stream_generate(
     cfg: TransformerConfig,
     temperature: float = 0.0,
@@ -259,18 +352,7 @@ def make_stream_generate(
     bit-equal to the one-shot path for the same seed.
     """
     model_dec = TransformerLM(cfg, decode=True)
-
-    def pick(logits, key):  # (B, V) -> (B,)
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][
-                :, -1:
-            ]
-            scaled = jnp.where(scaled >= kth, scaled, -1e30)
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-
+    pick = _make_pick(temperature, top_k)
     key0 = jax.random.PRNGKey(seed)
 
     def prefill(params, prompt):
@@ -328,6 +410,191 @@ def build_stream(props: Dict[str, str]):
         seed=int(props.get("gen_seed", "0")),
     )
     return prefill, decode_chunk, params, cfg.max_seq
+
+
+class SlotModel:
+    """The jittable halves of the SLOTTED decode path (continuous
+    batching, ``core/slots.py``): a fixed-width slot batch whose cache
+    pytree is slot-indexed pages with PER-SLOT positions, so independent
+    generation streams join/leave at token boundaries without retracing.
+
+    Sampling semantics are IDENTICAL to :func:`make_stream_generate`:
+    token 1 is picked with the raw gen_seed key, token j>=1 with
+    ``fold_in(key0, j)`` — per slot, via a vmapped per-row pick (vmap of
+    a key-batched draw is bit-equal to the per-row loop), so a single
+    occupant's token stream is bit-identical to the seed ``generate:<N>``
+    one-shot path and to the unslotted streaming path.
+
+    * ``init_cache()`` — zeroed (slots, max_seq, ...) page pytree;
+    * ``reset_slot(cache, slot)`` — zero ONE slot's pages + positions (a
+      join touches only its own slot; jitted once, slot is traced);
+    * ``prefill_chunk(params, cache, toks (1,n), slot)`` — slice the
+      slot's pages to a B=1 view, run one causal chunk (the chunked
+      prefill that interleaves with decode), scatter back; returns
+      ``(cache, last_logits (1,V))``.  One compile bucket per distinct
+      n — callers bound them (core/slots.py LRU);
+    * ``pick_first(logits (1,V))`` — token 1 (same op as the unslotted
+      prefill pick);
+    * ``decode_fn(k)(params, cache, tok (S,), gen (S,), active (S,))`` —
+      ``k`` tokens for every active slot in ONE ``lax.scan`` dispatch
+      (the same per-chunk amortization the unslotted path gets; callers
+      pick ``k = min(chunk, min remaining)`` so streams complete exactly
+      at scan boundaries).  Compiled once per (slot width, k) — the
+      idle-slot mask keeps each bucket shape-stable as streams churn.
+      The cache argument is DONATED off-CPU (the engine's cache is
+      caller-private — PR-6 donation discipline; XLA ignores donation on
+      CPU and warns, so it is gated exactly like
+      ``backends/jax_xla._donation_ok``).
+    """
+
+    def __init__(self, cfg: TransformerConfig, slots: int,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 donate: Optional[bool] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self._model = TransformerLM(cfg, decode=True, slotted=True)
+        self._pick = _make_pick(temperature, top_k)
+        self._temperature = temperature
+        self._key0 = jax.random.PRNGKey(seed)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = (1,) if donate else ()
+        #: compile counters — the shape-stability contract is observable
+        #: (tests pin decode_compiles staying at the bucket count across
+        #: join/leave churn)
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self.reset_slot = jax.jit(self._reset_slot)
+        self.pick_first = jax.jit(self._pick_first)
+
+    # -- cache lifecycle ----------------------------------------------------
+    def init_cache(self):
+        shapes = jax.eval_shape(
+            lambda: self._model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((self.slots, 1), jnp.int32),
+            )["cache"]
+        )
+        return jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+
+    @staticmethod
+    def _row_start(c, slot):
+        return (slot,) + (0,) * (c.ndim - 1)
+
+    def _reset_slot(self, cache, slot):
+        def zero_row(c):
+            row = jnp.zeros((1,) + c.shape[1:], c.dtype)
+            return jax.lax.dynamic_update_slice(
+                c, row, self._row_start(c, slot))
+
+        return jax.tree.map(zero_row, cache)
+
+    # -- prefill (chunked, one slot at a time) ------------------------------
+    def _prefill_chunk(self, params, cache, toks, slot):
+        sl = jax.tree.map(
+            lambda c: jax.lax.dynamic_slice(
+                c, self._row_start(c, slot), (1,) + c.shape[1:]),
+            cache,
+        )
+        logits, upd = self._model.apply(
+            {"params": params["params"], "cache": sl},
+            toks, mutable=["cache"],
+        )
+        cache = jax.tree.map(
+            lambda c, u: jax.lax.dynamic_update_slice(
+                c, u, self._row_start(c, slot)),
+            cache, upd["cache"],
+        )
+        return cache, logits[:, -1, :]
+
+    def prefill_fn(self, n: int):
+        """One jitted prefill bucket for chunk length ``n`` (caller
+        caches/bounds these — core/slots.py shares the LRU discipline of
+        the generator element's decode buckets)."""
+
+        def traced(params, cache, toks, slot):
+            self.prefill_compiles += 1  # trace-time only
+            return self._prefill_chunk(params, cache, toks, slot)
+
+        del n  # bucketing key only; the shape specializes the jit
+        return jax.jit(traced, donate_argnums=self._donate)
+
+    def _pick_first(self, logits):  # (1, V) -> (1,)
+        return self._pick(logits, self._key0)
+
+    # -- decode (whole slot batch, k tokens per dispatch) -------------------
+    def _pick_slots(self, lg, gen):  # (S, V), (S,) -> (S,)
+        if self._temperature <= 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        # per-slot key folded at the slot's OWN generated count — the
+        # same fold the unslotted scan applies at global step t (vmap of
+        # a key-batched draw is bit-equal to the per-row loop)
+        key0 = self._key0
+        keys = jax.vmap(lambda g: jax.random.fold_in(key0, g))(gen)
+        keys = jnp.where((gen == 0)[:, None], key0[None], keys)
+        pick = self._pick
+
+        def one(l, k):  # (V,), key -> ()
+            return pick(l[None], k)[0]
+
+        return jax.vmap(one)(lg, keys).astype(jnp.int32)
+
+    def _decode_scan(self, k, params, cache, tok, gen, active):
+        def step(carry, _i):
+            cache, tok, gen = carry
+            logits, upd = self._model.apply(
+                {"params": params["params"], "cache": cache},
+                tok[:, None], mutable=["cache"], active=active,
+            )
+            nxt = self._pick_slots(logits[:, -1, :], gen)
+            # idle slots keep their token/fold-count frozen, so the
+            # scan is bit-transparent for every occupied row
+            tok = jnp.where(active > 0, nxt, tok)
+            gen = gen + active
+            return (upd["cache"], tok, gen), nxt
+
+        (cache, tok, gen), toks = jax.lax.scan(
+            step, (cache, tok, gen), jnp.arange(k)
+        )
+        return cache, tok, gen, jnp.moveaxis(toks, 0, 1)  # (S, k)
+
+    def decode_fn(self, k: int):
+        """One jitted decode bucket: ``k`` tokens for every active slot
+        per dispatch (caller caches/bounds these alongside the prefill
+        buckets).  Returns ``(cache, tok, gen, toks (S, k))``."""
+
+        def traced(params, cache, tok, gen, active):
+            self.decode_compiles += 1  # trace-time only
+            return self._decode_scan(k, params, cache, tok, gen, active)
+
+        return jax.jit(traced, donate_argnums=self._donate)
+
+
+def build_slot_stream(props: Dict[str, str], slots: int,
+                      donate: Optional[bool] = None):
+    """Factory for the CONTINUOUS-BATCHING generator path: same
+    ``custom`` dialect and seed semantics as :func:`build_stream`
+    (``seed`` = params, ``gen_seed`` = sampling), so a single occupant's
+    stream is bit-equal to ``generate:<N>`` one-shot serving.  Returns
+    ``(SlotModel, params, max_seq)``."""
+    cfg = _cfg_from_props(props)
+    params = host_init(
+        TransformerLM(cfg).init,
+        int(props.get("seed", "0")),
+        np.zeros((1, min(8, cfg.max_seq)), np.int32),
+    )
+    model = SlotModel(
+        cfg, slots,
+        temperature=float(props.get("temperature", "0")),
+        top_k=int(props.get("top_k", "0")),
+        seed=int(props.get("gen_seed", "0")),
+        donate=donate,
+    )
+    return model, params, cfg.max_seq
 
 
 def build(custom_props=None):

@@ -1164,6 +1164,29 @@ class Pipeline:
                         self.log.exception("note_qos failed for %s", up.name)
                 stack.append(up.name)
 
+    def stream_cancel_feedback(self, el: Element, meta: dict) -> None:
+        """A downstream consumer of a generation stream is GONE (the
+        serversink's client vanished mid-stream): walk upstream — the
+        ``note_qos`` routing — and tell every element exposing
+        ``note_stream_cancel(meta)``, so a continuous-batching slot
+        engine frees the dead stream's slot instead of decoding tokens
+        nobody will read."""
+        seen = {el.name}
+        stack = [el.name]
+        while stack:
+            for up in self._upstream.get(stack.pop(), ()):
+                if up.name in seen:
+                    continue
+                seen.add(up.name)
+                note = getattr(up, "note_stream_cancel", None)
+                if note is not None:
+                    try:
+                        note(meta)
+                    except Exception:
+                        self.log.exception(
+                            "note_stream_cancel failed for %s", up.name)
+                stack.append(up.name)
+
     def _dead_letter(self, el: Element, frames, err: BaseException) -> None:
         """skip policy: record dropped frame(s) + bus warning."""
         h = self.health_map[el.name]

@@ -419,3 +419,29 @@ def test_routing_decision_overhead_floor():
             f"routing={policy} adds {delta * 1e6:.2f} us/request over "
             "rotate (floor 2 us)"
         )
+
+
+def test_continuous_batching_multiplex_floor():
+    """Continuous-batching gate (ROADMAP item 2): >= 4 concurrent
+    generation streams through shared slots must sustain >= 2x the
+    aggregate token throughput of the same requests served one at a
+    time, at bounded p50 per-token latency (measured ~2.5-3x on the
+    async-sim proxy, whose simulated decode step pays the batch-
+    independent weight-streaming cost real accelerator decode pays;
+    threshold at the acceptance floor with the rest as CI-noise
+    margin).  SAME harness bench.py publishes as `sim_speedup`, so the
+    banked evidence and this gate cannot drift."""
+    import bench
+
+    res = bench.measure_slot_multiplex_speedup(
+        slots=4, streams=4, max_new=64, chunk=8)
+    assert res["sim_speedup"] >= 2.0, (
+        f"slotted vs request-serial generation: {res['sim_speedup']}x "
+        f"aggregate tokens/s (floor 2x; measured ~2.5-3x): {res}"
+    )
+    # bounded per-token latency: the roofline per-token cost is
+    # ~1.2ms (base 1.0 + 4 slots x 0.05); 10ms means the scheduler,
+    # not the device, is pacing tokens
+    assert res["sim_p50_ms_per_token"] <= 10.0, res
+    # slots are genuinely multiplexed, not serialized
+    assert res["sim_slot_occupancy"] >= 0.5, res

@@ -111,18 +111,143 @@ class ClientHandle:
         self.pipe.stop()
 
 
+class GenClientHandle:
+    """One tenant's long-lived GENERATION-STREAM client (continuous
+    batching, PR-9): ``appsrc -> tensor_query_client stream=true ->
+    tensor_sink``; each pushed prompt opens one server-streaming
+    request whose token chunks flow back until a final-flagged frame.
+
+    Exactness: every COMPLETED stream's concatenated tokens must equal
+    the sim oracle for its prompt (token 1 = sum(prompt) % vocab, then
+    the fixed recurrence — the servers run the async-sim generator), so
+    cross-slot contamination or duplicated/lost chunks are exact-fail.
+    Streams are grouped by trace id (unique per request; stream_seq can
+    collide across servers)."""
+
+    def __init__(self, harness: "FleetHarness", name: str, pipe,
+                 tenant: str):
+        self._h = harness
+        self.name = name
+        self.tenant = tenant
+        self.pipe = pipe
+        self.prompts: Dict[str, Any] = {}  # trace id -> prompt array
+        self._seq = 0
+
+    @property
+    def element(self):
+        return self.pipe["q"]
+
+    def push_prompt(self, key: Optional[str] = None):
+        import numpy as np
+
+        from nnstreamer_tpu.core.buffer import TensorFrame
+        from nnstreamer_tpu.core.telemetry import TRACE_ID_META, new_trace_id
+
+        self._seq += 1
+        prompt = (np.arange(4, dtype=np.int32)[None] * 13
+                  + self._seq) % self._h.gen_vocab
+        trace = new_trace_id()
+        meta: Dict[str, Any] = {TRACE_ID_META: trace}
+        if key is not None:
+            meta[self._h.affinity_key] = key
+        self.pipe["src"].push(TensorFrame([prompt], meta=meta))
+        self.prompts[trace] = prompt
+        return trace
+
+    def _by_trace(self) -> Dict[str, list]:
+        from nnstreamer_tpu.core.telemetry import TRACE_ID_META
+
+        out: Dict[str, list] = {}
+        for f in self.pipe["out"].frames:
+            out.setdefault(f.meta.get(TRACE_ID_META), []).append(f)
+        return out
+
+    def finished(self) -> int:
+        return sum(
+            1 for frames in self._by_trace().values()
+            if any(f.meta.get("final") for f in frames))
+
+    def settle(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.finished() >= len(self.prompts):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"gen client {self.name}: {len(self.prompts)} streams pushed "
+            f"but only {self.finished()} finished after {timeout}s")
+
+    def check_exact(self) -> Dict[str, Any]:
+        """Per-stream verdict: every stream's tokens equal its oracle
+        EXACTLY, chunk meta coherent, zero duplicated chunks."""
+        import numpy as np
+
+        from nnstreamer_tpu.core.slots import SimSlotModel
+
+        sim = SimSlotModel(1, vocab=self._h.gen_vocab)
+        ok = bad = 0
+        tokens = 0
+        by_trace = self._by_trace()  # ONE index pass for every stream
+        for trace, prompt in self.prompts.items():
+            frames = sorted(by_trace.get(trace, []),
+                            key=lambda f: f.meta["chunk_index"])
+            idxs = [f.meta["chunk_index"] for f in frames]
+            if idxs != list(range(len(frames))) or not frames or (
+                    not frames[-1].meta.get("final")):
+                bad += 1
+                continue
+            parts = [np.asarray(f.tensors[0]) for f in frames
+                     if f.tensors]
+            # an eviction before the first token answers with ONE
+            # tensor-less typed-expiry frame: zero tokens, counted
+            # below as a mismatched (incomplete) stream, never a crash
+            toks = (np.concatenate(parts, axis=1) if parts
+                    else np.zeros((1, 0), np.int32))
+            t = int(prompt.sum()) % sim.vocab
+            want = [t]
+            for _ in range(self._h.gen_max_new - 1):
+                t = sim.step_token(t)
+                want.append(t)
+            if toks.tolist() == [want]:
+                ok += 1
+                tokens += toks.shape[1]
+            else:
+                bad += 1
+        return {"streams": len(self.prompts), "exact": ok,
+                "mismatched": bad, "tokens": tokens}
+
+    def health(self) -> Dict[str, Any]:
+        return self.pipe.health()["q"]
+
+    def finish(self, timeout: float = 120.0) -> None:
+        self.pipe["src"].end_of_stream()
+        self.pipe.wait(timeout=timeout)
+
+    def stop(self) -> None:
+        self.pipe.stop()
+
+
 class FleetHarness:
     """N query servers + M tenant clients on one hybrid discovery plane.
 
     Servers are ``serversrc ! identity sleep= ! scaler x2 !
     serversink`` pipelines announcing on ``nns/query/<topic>/``;
     clients resolve the pool from the broker.  ``expected(values)`` for
-    every answered frame is ``value * 2``."""
+    every answered frame is ``value * 2``.
+
+    ``mode="generate"`` swaps the server graph for a continuous-batching
+    generator (``serversrc ! tensor_generator slots=N custom=sim:... !
+    serversink``) and clients for :class:`GenClientHandle` long-lived
+    streams — rolling-restart / kill verdicts then cover STATEFUL
+    streams with PR-8 session affinity."""
 
     def __init__(self, topic: str = "chaosfleet", connect_type: str = "tcp",
                  server_sleep: float = 0.01, max_inflight: int = 32,
                  tenant_quotas: str = "", shed_window_s: float = 5.0,
-                 affinity_key: str = "sess", base_id: int = 9600):
+                 affinity_key: str = "sess", base_id: int = 9600,
+                 mode: str = "unary", gen_slots: int = 2,
+                 gen_max_new: int = 24, gen_vocab: int = 997,
+                 gen_step_ms: float = 1.0):
         from nnstreamer_tpu.distributed.mqtt import MiniBroker
 
         self.topic = topic
@@ -133,10 +258,16 @@ class FleetHarness:
         self.shed_window_s = shed_window_s
         self.affinity_key = affinity_key
         self.base_id = base_id
+        self.mode = mode
+        self.gen_slots = gen_slots
+        self.gen_max_new = gen_max_new
+        self.gen_vocab = gen_vocab
+        self.gen_step_ms = gen_step_ms
         self.broker = MiniBroker()
         self.servers: Dict[int, Any] = {}   # idx -> pipeline (live only)
         self.ports: Dict[int, int] = {}     # idx -> port (survives kills)
         self.clients: List[ClientHandle] = []
+        self.gen_clients: List[GenClientHandle] = []
         # per-tenant counters of servers that LEFT the fleet, captured at
         # kill time so fleet-wide accounting stays exact across churn
         self.retired_tenants: List[Dict[str, Any]] = []
@@ -147,6 +278,22 @@ class FleetHarness:
 
         quotas = (f"tenant-quotas={self.tenant_quotas} "
                   if self.tenant_quotas else "")
+        if self.mode == "generate":
+            # continuous-batching generator fleet: each server
+            # multiplexes concurrent token streams into shared slots
+            # over the deterministic async-sim model
+            core = (
+                f"tensor_generator name=gen slots={self.gen_slots} "
+                f"custom=sim:1,sim_step_ms:{self.gen_step_ms},"
+                f"sim_per_slot_ms:0.05,sim_prefill_ms:0.02,"
+                f"vocab:{self.gen_vocab} "
+                f"max-new={self.gen_max_new} chunk=4 ! "
+            )
+        else:
+            core = (
+                f"identity sleep={self.server_sleep} ! "
+                "tensor_filter framework=scaler custom=factor:2 ! "
+            )
         pipe = parse_pipeline(
             f"tensor_query_serversrc name=ssrc id={self.base_id + idx} "
             f"port={port} connect-type={self.connect_type} "
@@ -154,8 +301,7 @@ class FleetHarness:
             f"dest-port={self.broker.port} "
             f"max-inflight={self.max_inflight} {quotas}"
             f"shed-window={self.shed_window_s} ! "
-            f"identity sleep={self.server_sleep} ! "
-            "tensor_filter framework=scaler custom=factor:2 ! "
+            f"{core}"
             f"tensor_query_serversink id={self.base_id + idx}",
             name=f"server{idx}",
         )
@@ -251,6 +397,39 @@ class FleetHarness:
         self.clients.append(handle)
         return handle
 
+    def make_gen_client(self, name: str, tenant: str = "",
+                        routing: str = "least-inflight",
+                        affinity: bool = False, retries: int = 3,
+                        busy_retries: int = 8,
+                        breaker_threshold: int = 8,
+                        timeout: float = 60.0,
+                        discovery_timeout: float = 10.0
+                        ) -> GenClientHandle:
+        """A long-lived generation-STREAM client (``stream=true``): each
+        pushed prompt holds one server-streaming request until its final
+        chunk; PR-8 affinity pins a session's streams to one server."""
+        from nnstreamer_tpu.pipeline.parser import parse_pipeline
+
+        akey = f"affinity-key={self.affinity_key} " if affinity else ""
+        tprop = f"tenant={tenant} " if tenant else ""
+        pipe = parse_pipeline(
+            "appsrc name=src max-buffers=1024 ! "
+            f"tensor_query_client name=q connect-type={self.connect_type} "
+            f"topic={self.topic} dest-host=127.0.0.1 "
+            f"dest-port={self.broker.port} "
+            f"discovery-timeout={discovery_timeout} "
+            f"stream=true routing={routing} {akey}{tprop}"
+            f"retries={retries} busy-retries={busy_retries} "
+            f"breaker-threshold={breaker_threshold} retry-backoff=0.02 "
+            f"timeout={timeout} ! "
+            "tensor_sink name=out",
+            name=f"genclient-{name}",
+        )
+        pipe.start()
+        handle = GenClientHandle(self, name, pipe, tenant)
+        self.gen_clients.append(handle)
+        return handle
+
     def refresh_client(self, handle: ClientHandle) -> bool:
         """Force one elastic rediscovery NOW (scripted membership churn;
         production clients refresh on failure waves instead).  Returns
@@ -291,7 +470,7 @@ class FleetHarness:
 
     def breaker_trips(self) -> int:
         trips = 0
-        for c in self.clients:
+        for c in list(self.clients) + list(self.gen_clients):
             h = c.health()
             trips += int(h.get("breaker_trips_evicted", 0))
             for snap in h.get("breakers", {}).values():
@@ -320,7 +499,7 @@ class FleetHarness:
         }
 
     def stop_all(self) -> None:
-        for c in self.clients:
+        for c in list(self.clients) + list(self.gen_clients):
             try:
                 c.stop()
             except Exception:  # allow-silent: teardown best-effort
@@ -393,6 +572,71 @@ def run_default_script(servers: int = 3, frames: int = 30,
         h.stop_all()
 
 
+def run_generate_script(servers: int = 2, streams: int = 12) -> Dict[str, Any]:
+    """Generation-STREAM chaos (continuous batching, PR-9): long-lived
+    token streams multiplexed into shared slots across the fleet, with
+    PR-8 session affinity, surviving a rolling restart mid-wave — the
+    drain lets in-flight streams FINISH (they hold their admission slot
+    until the final chunk) while new streams fail over on GOAWAY."""
+    h = FleetHarness(mode="generate", gen_slots=2, gen_max_new=24,
+                     gen_step_ms=1.0, base_id=9700,
+                     topic="chaosgen")
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        ca = h.make_gen_client("A", tenant="A")
+        ck = h.make_gen_client("K", affinity=True, routing="rotate")
+        total = 2 * (streams // 2)  # pushed per client across both waves
+
+        # wave 1: concurrent streams share slots, exact tokens
+        for j in range(streams // 2):
+            ca.push_prompt()
+            ck.push_prompt(key=f"sess-{j % 4}")
+        ca.settle()
+        ck.settle()
+
+        # wave 2 pushed, then a rolling restart lands MID-WAVE: stateful
+        # streams on the draining server complete (zero loss), affinity
+        # sessions re-pin once the server returns on the same port
+        for j in range(streams // 2):
+            ca.push_prompt()
+            ck.push_prompt(key=f"sess-{j % 4}")
+        roll = h.rolling_restart(0)
+        ca.settle()
+        ck.settle()
+        for c in (ca, ck):
+            c.finish()
+        va, vk = ca.check_exact(), ck.check_exact()
+        gen_totals = {}
+        for pipe in h.servers.values():
+            for k, val in pipe.health().get("gen", {}).items():
+                if isinstance(val, (int, float)):
+                    gen_totals[k] = gen_totals.get(k, 0) + val
+        v = {
+            "clients": {"A": va, "K": vk},
+            "rolling_restart": {
+                "goaway_sent": roll["health"].get("goaway_sent", 0),
+                "drain_dropped": roll["drain"]["dropped"],
+            },
+            "goaway_replies": sum(
+                int(c.health().get("goaway_replies", 0))
+                for c in (ca, ck)),
+            "breaker_trips": h.breaker_trips(),
+            "gen": {k: gen_totals.get(k, 0) for k in (
+                "gen_joins", "gen_completed", "gen_evicted",
+                "gen_cancelled", "gen_tokens")},
+        }
+        v["ok"] = (
+            va["mismatched"] == 0 and vk["mismatched"] == 0
+            and va["exact"] == total and vk["exact"] == total
+            and roll["drain"]["dropped"] == 0
+            and v["breaker_trips"] == 0
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
 def main() -> int:
     import argparse
 
@@ -405,8 +649,18 @@ def main() -> int:
                     help="frames per tenant per wave")
     ap.add_argument("--keys", type=int, default=120,
                     help="distinct affinity sessions")
+    ap.add_argument("--mode", choices=("unary", "generate"),
+                    default="unary",
+                    help="unary request fleet (default) or long-lived "
+                    "generation-stream fleet (continuous batching)")
+    ap.add_argument("--streams", type=int, default=12,
+                    help="generation streams per client (--mode generate)")
     args = ap.parse_args()
-    verdict = run_default_script(args.servers, args.frames, args.keys)
+    if args.mode == "generate":
+        verdict = run_generate_script(max(1, min(args.servers, 4)),
+                                      args.streams)
+    else:
+        verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))
     return 0 if verdict["ok"] else 1
 
