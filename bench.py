@@ -130,6 +130,41 @@ def _utc_iso(ts: float = None) -> str:
     )
 
 
+def age_days(captured_at: str, now: float = None) -> "float | None":
+    """Days since an evidence row's ISO-8601 ``captured_at``/``stale_since``
+    stamp (None when unparseable) — every stale row the bench serves
+    carries this explicitly so the trend report (tools/perf_truth.py
+    --report) and the driver artifact can label row age without
+    re-deriving timestamp math."""
+    import calendar
+
+    try:
+        # timegm, not mktime-minus-timezone: the stamp is UTC, and
+        # mktime's DST guess for the stamp's date would skew the epoch
+        # by up to an hour on DST-observing boxes
+        then = calendar.timegm(time.strptime(
+            str(captured_at), "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, OverflowError):
+        return None
+    now = time.time() if now is None else now
+    return round(max(0.0, (now - then) / 86400.0), 1)
+
+
+def git_rev() -> "str | None":
+    """Short git revision of the harness tree (None outside a checkout).
+    Stamped onto cpu_proxy rows so proxy history aligns with commits in
+    the trend report."""
+    try:
+        r = subprocess.run(
+            ["git", "-C", _HERE, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = r.stdout.strip()
+    return rev if r.returncode == 0 and rev else None
+
+
 @contextlib.contextmanager
 def _cache_lock(path: str):
     """Serialize read-modify-replace on the evidence cache: overlapping
@@ -577,6 +612,60 @@ def measure_slot_multiplex_speedup(slots: int = 4, streams: int = 4,
     }
 
 
+def measure_dispatch_overlap(nbatches: int = 24,
+                             budget_s: float = 8.0) -> dict:
+    """``{"dispatch_overlap", "dispatch_thread_blocking_syncs"}`` for the
+    async dispatch window on the async-sim fake device (compute 4ms
+    single-server, transfer 3ms on the syncing thread, dispatch 1ms):
+    pipeline throughput over the device's own serial service rate (1.0 =
+    the window hides all framework cost), plus the structural count of
+    dispatch-thread blocking syncs (must be 0 — the reaper owns those
+    waits).  Shared by the cpu_proxy evidence and the perf-truth
+    baseline, so the published ratio and the gated one measure the SAME
+    harness."""
+    import numpy as np
+
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    compute_ms, transfer_ms, dispatch_ms, mb = 4.0, 3.0, 1.0, 8
+    pipe = parse_pipeline(
+        "appsrc name=src max-buffers=512 ! tensor_filter name=f "
+        "framework=async-sim "
+        f"custom=compute_ms:{compute_ms},transfer_ms:{transfer_ms},"
+        f"dispatch_ms:{dispatch_ms} "
+        f"max-batch={mb} dispatch-depth=8 ! tensor_sink name=out "
+        "max-stored=1",
+        name="proxy",
+    )
+    pipe.start()
+    done = {"n": 0}
+    pipe["out"].connect_new_data(
+        lambda f: done.__setitem__("n", done["n"] + 1))
+    n = mb * nbatches
+    arr = np.zeros((64,), np.float32)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pipe["src"].push(arr)
+    cap = max(5.0, budget_s)
+    while done["n"] < n and time.perf_counter() - t0 < cap:
+        time.sleep(0.002)
+    elapsed = time.perf_counter() - t0
+    be = pipe["f"].backend
+    blocked = [
+        t for t in be.blocking_syncs if not t.endswith("-reaper")
+    ]
+    pipe["src"].end_of_stream()
+    pipe.wait(timeout=15)
+    pipe.stop()
+    # device service rate = 1000/compute_ms batches/s (single server);
+    # 1.0 means the window hid every framework cost behind compute
+    pipeline_rate = (done["n"] / mb) / elapsed if elapsed else 0.0
+    return {
+        "dispatch_overlap": round(pipeline_rate / (1000.0 / compute_ms), 3),
+        "dispatch_thread_blocking_syncs": len(blocked),
+    }
+
+
 def cpu_proxy_measures(budget_s: float = 8.0) -> dict:
     """Fresh, explicitly-labeled CPU-proxy evidence for the async-feed
     axes, measured in-process in a few seconds (no accelerator, no jit):
@@ -598,56 +687,18 @@ def cpu_proxy_measures(budget_s: float = 8.0) -> dict:
       serialized stack+transfer+compute on the same costs.
     * ``device_pool_reuse_rate`` — staging-buffer reuse across the run.
     """
-    import numpy as np
-
     from nnstreamer_tpu.core.buffer import DEVICE_POOL
-    from nnstreamer_tpu.pipeline import parse_pipeline
 
     proxy: dict = {"proxy": True, "platform": "cpu",
-                   "captured_at": _utc_iso()}
+                   "captured_at": _utc_iso(), "git_rev": git_rev()}
     t_start = time.time()
     # pool counters are process-global: snapshot so the reported reuse
     # rate is THIS measurement's, not the process's lifetime history
     pool_reused0, pool_alloc0 = DEVICE_POOL.reused, DEVICE_POOL.allocated
 
-    # -- dispatch window overlap (async-sim: compute 4ms single-server,
-    #    transfer 3ms on the syncing thread, dispatch 1ms) --------------
-    compute_ms, transfer_ms, dispatch_ms, mb, nbatches = 4.0, 3.0, 1.0, 8, 24
-    pipe = parse_pipeline(
-        "appsrc name=src max-buffers=512 ! tensor_filter name=f "
-        "framework=async-sim "
-        f"custom=compute_ms:{compute_ms},transfer_ms:{transfer_ms},"
-        f"dispatch_ms:{dispatch_ms} "
-        f"max-batch={mb} dispatch-depth=8 ! tensor_sink name=out "
-        "max-stored=1",
-        name="proxy",
-    )
-    pipe.start()
-    done = {"n": 0}
-    pipe["out"].connect_new_data(
-        lambda f: done.__setitem__("n", done["n"] + 1))
-    n = mb * nbatches
-    arr = np.zeros((64,), np.float32)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        pipe["src"].push(arr)
-    cap = max(5.0, budget_s - (time.time() - t_start))
-    while done["n"] < n and time.perf_counter() - t0 < cap:
-        time.sleep(0.002)
-    elapsed = time.perf_counter() - t0
-    be = pipe["f"].backend
-    blocked = [
-        t for t in be.blocking_syncs if not t.endswith("-reaper")
-    ]
-    pipe["src"].end_of_stream()
-    pipe.wait(timeout=15)
-    pipe.stop()
-    # device service rate = 1000/compute_ms batches/s (single server);
-    # 1.0 means the window hid every framework cost behind compute
-    pipeline_rate = (done["n"] / mb) / elapsed if elapsed else 0.0
-    proxy["dispatch_overlap"] = round(
-        pipeline_rate / (1000.0 / compute_ms), 3)
-    proxy["dispatch_thread_blocking_syncs"] = len(blocked)
+    # -- dispatch window overlap (shared perf-truth harness) -------------
+    proxy.update(measure_dispatch_overlap(
+        nbatches=24, budget_s=max(5.0, budget_s - (time.time() - t_start))))
 
     # -- pipeline-vs-raw roofline distance (shared perf-gate harness) ----
     raw_fps, pipe_fps = measure_pipeline_vs_raw()
@@ -690,7 +741,8 @@ def emit_failure(metric: str, unit: str, meta: dict, err: str,
             # fills fields the banked row lacks
             emit({
                 **meta, **row, "stale": True, "stale_since": since,
-                "stale_source": source, "live_error": err, **extra,
+                "stale_source": source, "age_days": age_days(since),
+                "live_error": err, **extra,
             })
             return
     emit({
@@ -832,32 +884,45 @@ def bench_fuse() -> bool:
     )
 
 
-def overhead_row(deadline_ts: float) -> dict:
-    """Scheduler-overhead microbench: appsrc ! identity x3 ! tensor_sink
-    (5 elements), tiny host frames, CPU-safe (no accelerator, no model).
-    Measures BOTH dataplanes every run — `value` is the configured
-    BENCH_FUSE mode's fps, `fused_fps`/`unfused_fps`/`fuse_speedup`
-    record the tentpole's delta explicitly."""
+def measure_fuse_overhead(n_frames: int = 30000, cap_s: float = 60.0,
+                          deadline_ts: float = None) -> dict:
+    """Fused vs unfused identity-chain fps on the 5-element scheduler-
+    overhead chain (appsrc ! identity x3 ! tensor_sink, CPU-safe) —
+    ``{"fused_fps", "unfused_fps", "fuse_speedup", "telemetry"}``.
+    Shared by the BENCH_MODEL=overhead row and the perf-truth baseline,
+    so the published speedup and the regression-gated one measure the
+    SAME harness.
+
+    Both runs are measured with the TRACER ARMED (always-on latency
+    histograms recording), symmetrically — the ratio stays fair, the
+    published fps IS the histograms-armed number (the per-frame cost
+    claim is in the evidence, not beside it), and the row's telemetry
+    dump carries the per-element p50/p95/p99."""
     import numpy as np
 
     from nnstreamer_tpu.pipeline import parse_pipeline
 
-    n_frames = int(os.environ.get("BENCH_FRAMES", "30000"))
     pool = [np.zeros((64,), np.float32) for _ in range(16)]
 
-    def run(fuse: bool) -> float:
+    def run(fuse: bool):
+        # the cap is re-derived PER RUN from the absolute deadline (when
+        # given): a stalled fused run must shrink the unfused run's
+        # window, not grant it a second full budget past the deadline
+        cap = cap_s
+        if deadline_ts is not None:
+            cap = max(10.0, min(cap_s, deadline_ts - time.time() - 15.0))
         pipe = parse_pipeline(
             "appsrc name=src max-buffers=256 ! identity ! identity ! "
             "identity ! tensor_sink name=out max-stored=1",
             name="overhead", fuse=fuse,
         )
+        pipe.enable_tracing()
         pipe.start()
         src, sink = pipe["src"], pipe["out"]
         done = {"n": 0}
         sink.connect_new_data(
             lambda f: done.__setitem__("n", done["n"] + 1)
         )
-        cap = max(10.0, min(60.0, deadline_ts - time.time() - 15.0))
         for i in range(256):  # warmup: settle thread scheduling
             src.push(pool[i % 16])
         t_w = time.time()
@@ -879,18 +944,36 @@ def overhead_row(deadline_ts: float) -> dict:
 
     fused, fused_telemetry = run(True)
     unfused, _ = run(False)
-    value = fused if bench_fuse() else unfused
+    return {
+        "fused_fps": round(fused, 1),
+        "unfused_fps": round(unfused, 1),
+        "fuse_speedup": round(fused / unfused, 2) if unfused else None,
+        "telemetry": fused_telemetry,
+    }
+
+
+def overhead_row(deadline_ts: float) -> dict:
+    """Scheduler-overhead microbench: appsrc ! identity x3 ! tensor_sink
+    (5 elements), tiny host frames, CPU-safe (no accelerator, no model).
+    Measures BOTH dataplanes every run — `value` is the configured
+    BENCH_FUSE mode's fps, `fused_fps`/`unfused_fps`/`fuse_speedup`
+    record the tentpole's delta explicitly."""
+    n_frames = int(os.environ.get("BENCH_FRAMES", "30000"))
+    res = measure_fuse_overhead(
+        n_frames=n_frames, cap_s=60.0, deadline_ts=deadline_ts,
+    )
+    value = res["fused_fps"] if bench_fuse() else res["unfused_fps"]
     return {
         "metric": METRICS["overhead"][0],
         "value": round(value, 1),
         "unit": "fps",
         "vs_baseline": None,
-        "fused_fps": round(fused, 1),
-        "unfused_fps": round(unfused, 1),
-        "fuse_speedup": round(fused / unfused, 2) if unfused else None,
+        "fused_fps": res["fused_fps"],
+        "unfused_fps": res["unfused_fps"],
+        "fuse_speedup": res["fuse_speedup"],
         "chain": "appsrc!identity!identity!identity!tensor_sink",
         "frames": n_frames,
-        "telemetry": fused_telemetry,
+        "telemetry": res["telemetry"],
     }
 
 

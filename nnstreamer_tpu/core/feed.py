@@ -31,16 +31,19 @@ boundary contracts account every parked frame (the filter's
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .buffer import DEVICE_POOL, materialize as _materialize
+from .telemetry import Log2Histogram
 
 
 class _WindowEntry:
-    __slots__ = ("out_b", "payload", "mats", "error", "done", "claimed")
+    __slots__ = ("out_b", "payload", "mats", "error", "done", "claimed",
+                 "t_park")
 
     def __init__(self, out_b, payload):
         self.out_b = out_b
@@ -49,6 +52,7 @@ class _WindowEntry:
         self.error: Optional[BaseException] = None
         self.done = False
         self.claimed = False
+        self.t_park = time.perf_counter()
 
 
 class CompletionWindow:
@@ -73,7 +77,7 @@ class CompletionWindow:
     """
 
     __slots__ = ("name", "_materialize", "_dq", "_cv", "_reaper", "_closed",
-                 "reaped", "dispatch_waits")
+                 "reaped", "dispatch_waits", "dwell")
 
     def __init__(self, name: str = "window",
                  materialize: Optional[Callable] = None):
@@ -86,6 +90,10 @@ class CompletionWindow:
         # stats (exact under the cv; perf smoke reads them)
         self.reaped = 0
         self.dispatch_waits = 0
+        # park -> pop_ready dwell distribution (always on: one
+        # perf_counter per micro-batch pop, off the per-frame path;
+        # single-writer — only the dispatch thread pops)
+        self.dwell = Log2Histogram()
 
     def __len__(self) -> int:
         return len(self._dq)
@@ -146,6 +154,10 @@ class CompletionWindow:
                 popped.append(self._dq.popleft())
         if err is not None:
             raise err
+        if popped:
+            now = time.perf_counter()
+            for e in popped:
+                self.dwell.record(now - e.t_park)
         return [(e.mats, e.payload) for e in popped]
 
     def oldest_ready(self) -> bool:

@@ -68,6 +68,10 @@ TL_RX_META = "_nns_tl_rx"
 TL_INVOKE_META = "_nns_tl_invoke"
 #: client enqueue stamp (perf_counter at the query client's doorstep)
 TL_ENQ_META = "_nns_tl_enq"
+#: mailbox enqueue stamp (perf_counter at _push/_put_many; popped at
+#: dequeue into the consuming element's queue-wait histogram) — only
+#: written while a tracer is armed, host-local like every TL_ key
+TL_QPUT_META = "_nns_tl_qput"
 #: the client-local end-to-end decomposition attached to answer frames:
 #: {"client_queue","wire","server_queue","device_dispatch",
 #:  "device_compute","total"} — seconds, summing exactly to "total"
@@ -131,6 +135,20 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.feed.dispatch_waits": ("counter", "full-window backpressure waits"),
     "nns.feed.lane_pending": ("gauge", "staging jobs queued on the ingest lane"),
     "nns.feed.lane_staged": ("counter", "micro-batches staged by the ingest lane"),
+    # always-on latency histograms (log2 buckets; armed with the tracer)
+    "nns.element.handle_seconds": ("histogram", "per-element handler wall time, log2 buckets"),
+    "nns.element.handle_p50_us": ("gauge", "p50 handler wall time, us (log2 estimate)"),
+    "nns.element.handle_p95_us": ("gauge", "p95 handler wall time, us (log2 estimate)"),
+    "nns.element.handle_p99_us": ("gauge", "p99 handler wall time, us (log2 estimate)"),
+    "nns.element.queue_wait_seconds": ("histogram", "mailbox wait, producer handoff to dequeue, log2 buckets"),
+    "nns.element.queue_wait_p50_us": ("gauge", "p50 mailbox queue wait, us (log2 estimate)"),
+    "nns.element.queue_wait_p99_us": ("gauge", "p99 mailbox queue wait, us (log2 estimate)"),
+    "nns.feed.window_dwell_seconds": ("histogram", "micro-batch dwell in the completion window, log2 buckets"),
+    "nns.feed.window_dwell_p50_us": ("gauge", "p50 completion-window dwell, us (log2 estimate)"),
+    "nns.feed.window_dwell_p99_us": ("gauge", "p99 completion-window dwell, us (log2 estimate)"),
+    # profilers (jax trace session + incident-time thread sampler)
+    "nns.profiler.active": ("gauge", "1 while the element holds a jax-profiler trace ref"),
+    "nns.profiler.captures": ("counter", "thread-profile captures attached to incident dumps"),
     # tensor_query server (admission / wire integrity / rolling restart)
     "nns.query.inflight": ("gauge", "requests admitted and not yet answered"),
     "nns.query.admitted": ("counter", "requests admitted"),
@@ -257,6 +275,7 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "gen_tokens_per_step": "nns.gen.tokens_per_step",
     "gen_jit_buckets": "nns.gen.jit_buckets",
     "gen_decode_compiles": "nns.gen.decode_compiles",
+    "profiler_active": "nns.profiler.active",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by
@@ -400,6 +419,140 @@ class Histogram:
             out.append(Sample(
                 f"{self.name}_count", self.labels, self._count, "counter"))
         return out
+
+
+#: log2 bucket layout shared by every Log2Histogram: boundary i is
+#: 2**(LOG2_E_MIN + i) seconds — 2^-20 s (~1 µs) up to 2^4 s (16 s),
+#: plus one overflow bucket.  Fixed at import so fused/unfused (and any
+#: two processes) bucket identically.
+LOG2_E_MIN = -20
+LOG2_NBUCKETS = 25  # boundaries 2^-20 .. 2^4
+_LOG2_SCALE = float(2 ** -LOG2_E_MIN)
+LOG2_BOUNDS = tuple(2.0 ** (LOG2_E_MIN + i) for i in range(LOG2_NBUCKETS))
+
+
+class Log2Histogram:
+    """Fixed-bucket log2-scale latency histogram, hot-path-safe.
+
+    The record path is one float multiply, one ``int.bit_length`` and one
+    list increment — no lock, no allocation, no branch-per-bucket scan
+    (the :class:`Histogram` record path takes a lock and walks its bucket
+    list; this one is safe to arm on every frame).  The contract is
+    SINGLE-WRITER per instrument on the record path — which the scheduler
+    guarantees: each element's handler (and each mailbox's consumer, and
+    each dispatch window's ``pop_ready``) runs on exactly one streaming
+    thread.  Scrape-time readers may race a write and see a snapshot off
+    by the in-flight observation; quantiles are estimates by design.
+
+    Quantiles are log-linear interpolations within a bucket, so p50/p95/
+    p99 carry ~2x resolution — the right grain for "where did the time
+    go", not for microbenchmarks (use the tracer's proc ring for those).
+    """
+
+    __slots__ = ("_counts", "_sum")
+
+    def __init__(self):
+        self._counts = [0] * (LOG2_NBUCKETS + 1)  # +1: overflow tail
+        self._sum = 0.0
+
+    def record(self, seconds: float) -> None:
+        # bucket i collects v in [2^(i-1), 2^i) * 2^LOG2_E_MIN seconds
+        idx = int(seconds * _LOG2_SCALE).bit_length()
+        if idx > LOG2_NBUCKETS:
+            idx = LOG2_NBUCKETS
+        self._counts[idx] += 1
+        self._sum += seconds
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def state(self) -> Tuple[int, ...]:
+        """Immutable bucket-count snapshot (parity tests pin this)."""
+        return tuple(self._counts)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile in seconds (None when empty)."""
+        counts = list(self._counts)
+        total = sum(counts)
+        if total == 0:
+            return None
+        target = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c and cum + c >= target:
+                lo = 0.0 if i == 0 else 2.0 ** (LOG2_E_MIN + i - 1)
+                hi = 2.0 ** (LOG2_E_MIN + min(i, LOG2_NBUCKETS))
+                return lo + (hi - lo) * (target - cum) / c
+            cum += c
+        return 2.0 ** (LOG2_E_MIN + LOG2_NBUCKETS)
+
+    def percentiles_us(self) -> Dict[str, float]:
+        """{p50, p95, p99} in microseconds (empty dict when empty)."""
+        out: Dict[str, float] = {}
+        for tag, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            if v is None:
+                return {}
+            out[tag] = v * 1e6
+        return out
+
+    def samples(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> List["Sample"]:
+        """Prometheus classic-histogram samples (cumulative le buckets)."""
+        labels = dict(labels or {})
+        counts = list(self._counts)
+        out: List[Sample] = []
+        cum = 0
+        for i, b in enumerate(LOG2_BOUNDS):
+            cum += counts[i]
+            out.append(Sample(
+                f"{name}_bucket", {**labels, "le": repr(b)}, cum, "counter"))
+        cum += counts[-1]
+        out.append(Sample(
+            f"{name}_bucket", {**labels, "le": "+Inf"}, cum, "counter"))
+        out.append(Sample(f"{name}_sum", labels, self._sum, "counter"))
+        out.append(Sample(f"{name}_count", dict(labels), cum, "counter"))
+        return out
+
+
+#: quantile gauges derived from each log2 histogram at scrape time
+#: (PURE LITERAL: the schema lint reads metric names statically)
+HIST_QUANTILE_GAUGES: Dict[str, Tuple[Tuple[str, float], ...]] = {
+    "nns.element.handle_seconds": (
+        ("nns.element.handle_p50_us", 0.5),
+        ("nns.element.handle_p95_us", 0.95),
+        ("nns.element.handle_p99_us", 0.99),
+    ),
+    "nns.element.queue_wait_seconds": (
+        ("nns.element.queue_wait_p50_us", 0.5),
+        ("nns.element.queue_wait_p99_us", 0.99),
+    ),
+    "nns.feed.window_dwell_seconds": (
+        ("nns.feed.window_dwell_p50_us", 0.5),
+        ("nns.feed.window_dwell_p99_us", 0.99),
+    ),
+}
+
+
+def hist_samples(name: str, hist: Log2Histogram,
+                 labels: Optional[Dict[str, str]] = None) -> List["Sample"]:
+    """A log2 histogram as exported samples: the classic bucket series
+    plus the derived p50/p95/p99 gauges (µs) catalogued for it.  Empty
+    histograms export nothing — an element that never crossed a mailbox
+    must not show a fake zero-latency series."""
+    if hist.count == 0:
+        return []
+    out = hist.samples(name, labels)
+    for gname, q in HIST_QUANTILE_GAUGES.get(name, ()):
+        v = hist.quantile(q)
+        if v is not None:
+            out.append(Sample(gname, dict(labels or {}), v * 1e6, "gauge"))
+    return out
 
 
 @dataclass
@@ -697,9 +850,14 @@ class TelemetrySnapshot:
 
     def flat(self) -> Dict[str, float]:
         """{name: value} — counters summed across labelsets, gauges
-        maxed; the compact labeled dump bench rows carry."""
+        maxed; the compact labeled dump bench rows carry.  Histogram
+        ``_bucket`` series are elided (cumulative per-le counts summed
+        across labels are meaningless); their ``_sum``/``_count`` and the
+        derived p50/p95/p99 gauges stay."""
         out: Dict[str, float] = {}
         for s in self.samples:
+            if s.name.endswith("_bucket"):
+                continue
             if s.kind == "counter":
                 out[s.name] = out.get(s.name, 0.0) + float(s.value)
             else:
@@ -723,11 +881,23 @@ class FlightRecorder:
     STUCK inside a hung element), ``end`` appends the completed span to
     the ring.  ``dump`` writes the assembled per-trace timelines to log +
     a JSON file, rate-limited so an incident storm cannot turn the
-    recorder into its own outage."""
+    recorder into its own outage.
+
+    With ``profile_incidents`` (default on) each dump also runs the
+    incident-time thread profiler (:func:`~.profiler.profile_threads`):
+    the named framework threads are wall-clock-sampled for a bounded
+    window and their collapsed top-stacks land in the dump's
+    ``thread_profile`` field — a hung element's streaming thread shows
+    exactly where it is stuck, without a chip or TensorBoard.  The
+    capture blocks the dumping thread for ``profile_duration_s``
+    (default 0.2 s), bounded overall by the dump rate limit."""
 
     def __init__(self, capacity: int = 4096, dump_dir: Optional[str] = None,
                  min_dump_interval_s: float = 5.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 profile_incidents: bool = True,
+                 profile_duration_s: float = 0.2,
+                 profile_hz: float = 50.0):
         self._ring: deque = deque(maxlen=max(16, capacity))
         self._open: Dict[str, Tuple[Any, float]] = {}
         self._dump_dir = dump_dir
@@ -735,6 +905,9 @@ class FlightRecorder:
         self._clock = clock
         self._last_dump_ts = float("-inf")
         self._dump_lock = threading.Lock()
+        self._profile = bool(profile_incidents)
+        self._profile_duration_s = float(profile_duration_s)
+        self._profile_hz = float(profile_hz)
         self.dumps = 0
         self.suppressed = 0
 
@@ -791,12 +964,26 @@ class FlightRecorder:
                 self.suppressed += 1
                 return None
             self._last_dump_ts = now
+        # thread profile FIRST: a stalled thread is still parked on its
+        # hang site right now — sample it before assembling timelines
+        profile = None
+        if self._profile:
+            try:
+                from .profiler import profile_threads
+
+                profile = profile_threads(
+                    duration_s=self._profile_duration_s,
+                    hz=self._profile_hz)
+                REGISTRY.counter("nns.profiler.captures").inc()
+            except Exception:  # profiling must never break the dump
+                (logger or log).exception("incident thread profile failed")
         timelines = self.timelines()
         payload = {
             "reason": reason,
             "source": source,
             "detail": repr(detail) if detail is not None else None,
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "thread_profile": profile,
             "traces": [
                 {"trace_id": tid, "spans": spans}
                 for tid, spans in timelines.items()
@@ -961,12 +1148,23 @@ def collect_pipeline(pipe) -> List[Sample]:
                     continue
                 out.append(Sample(mname, dict(labels), float(v),
                                   metric_kind(mname)))
+        # always-on log2 latency histograms (handle time + mailbox
+        # queue-wait), with their derived p50/p95/p99 gauges
+        for el_name, mname, h in tracer.latency_histograms():
+            out.extend(hist_samples(mname, h, {**base, "element": el_name}))
     # -- element-specific gauges (filter window/lane, client inflight) ------
     for el_name, el in pipe.elements.items():
+        labels = {**base, "element": el_name}
+        hinfo = getattr(el, "histograms_info", None)
+        if hinfo is not None:
+            try:
+                for mname, h in hinfo() or ():
+                    out.extend(hist_samples(mname, h, labels))
+            except Exception:  # scrape must survive element bugs
+                log.exception("histograms_info failed for %s", el_name)
         info = getattr(el, "metrics_info", None)
         if info is None:
             continue
-        labels = {**base, "element": el_name}
         try:
             rows = info() or ()
         except Exception:  # scrape must survive element bugs

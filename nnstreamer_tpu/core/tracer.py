@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .telemetry import TRACE_ID_META, new_trace_id
+from .telemetry import TRACE_ID_META, Log2Histogram, new_trace_id
 
 META_SRC_TS = "_nns_trace_src_ts"  # wall stamp set when a frame leaves a source
 
@@ -41,13 +41,18 @@ class _ElementStats:
     __slots__ = (
         "frames", "calls", "proc_ring", "t_first", "t_last",
         "inter_sum", "inter_max", "inter_n", "bytes", "q_sum", "q_max",
-        "q_n", "q_cap", "sched_ring", "t_prev_in",
+        "q_n", "q_cap", "sched_ring", "t_prev_in", "lat_hist",
     )
 
     def __init__(self) -> None:
         self.frames = 0
         self.calls = 0
         self.proc_ring: deque = deque(maxlen=1024)  # seconds per call
+        # full-history fixed-memory handle-latency distribution (the
+        # proc ring keeps only the last 1024 calls; percentile EVIDENCE
+        # needs every observation) — lock-free: frame_out is
+        # single-writer per element by the scheduler's threading model
+        self.lat_hist = Log2Histogram()
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
         self.inter_sum = 0.0
@@ -76,6 +81,10 @@ class PipelineTracer:
 
     def __init__(self, detail: bool = False, recorder=None) -> None:
         self._stats: Dict[str, _ElementStats] = {}
+        # mailbox queue-wait distributions (enqueue -> dequeue), one per
+        # consuming element; single-writer: each mailbox has exactly one
+        # consumer thread
+        self._qwait: Dict[str, Log2Histogram] = {}
         self._lock = threading.Lock()
         self.t_started = time.perf_counter()
         # cpuusage: process CPU time vs wall time over the traced window
@@ -104,6 +113,20 @@ class PipelineTracer:
         if self.recorder is not None:
             self.recorder.begin(name, frame)
 
+    def queue_wait(self, name: str, wait_s: float) -> None:
+        """One frame's mailbox wait, recorded by the consuming streaming
+        thread.  The origin stamp is the producer's handoff ATTEMPT
+        (``_push``/``_put_many``), so time spent blocked on a full
+        mailbox counts too — backpressure IS queue pressure; p99 here
+        can therefore exceed capacity x service time.  On a fan-out pad
+        the shared stamp yields ONE observation per frame, attributed to
+        whichever consumer dequeues first."""
+        h = self._qwait.get(name)
+        if h is None:
+            with self._lock:
+                h = self._qwait.setdefault(name, Log2Histogram())
+        h.record(wait_s)
+
     def queue_level(self, name: str, depth: int, cap: int) -> None:
         st = self._get(name)
         st.q_sum += depth
@@ -125,6 +148,7 @@ class PipelineTracer:
         st.calls += 1
         st.frames += nframes
         st.proc_ring.append(t_out - t_in)
+        st.lat_hist.record(t_out - t_in)
         if st.t_prev_in is not None:
             st.sched_ring.append(t_in - st.t_prev_in)
         st.t_prev_in = t_in
@@ -147,6 +171,26 @@ class PipelineTracer:
         return st
 
     # -- reporting ----------------------------------------------------------
+    def latency_histograms(self):
+        """``[(element, metric_name, Log2Histogram)]`` for the always-on
+        log2 instruments: per-element handle latency
+        (``nns.element.handle_seconds``) and mailbox queue-wait
+        (``nns.element.queue_wait_seconds``).  The telemetry collector
+        exports these (buckets + derived p50/p95/p99 gauges) at scrape
+        time."""
+        with self._lock:
+            stats = list(self._stats.items())
+            qwait = list(self._qwait.items())
+        out = [
+            (name, "nns.element.handle_seconds", st.lat_hist)
+            for name, st in stats
+        ]
+        out.extend(
+            (name, "nns.element.queue_wait_seconds", h)
+            for name, h in qwait
+        )
+        return out
+
     def cpu_usage(self) -> float:
         """Process CPU seconds per wall second since tracing began
         (GstShark cpuusage analog; >1.0 = more than one busy core)."""

@@ -959,6 +959,9 @@ class TensorFilter(TransformElement):
             "swaps": 0,
             "swap_failures": 0,
             "rollbacks": 0,
+            # jax-profiler session held by this element (trace=1) —
+            # exported as nns.profiler.active via the health collector
+            "profiler_active": 1 if getattr(self, "_tracing", False) else 0,
         }
         if self._swapper is not None:
             info.update(self._swapper.snapshot())
@@ -982,6 +985,12 @@ class TensorFilter(TransformElement):
             ("nns.feed.lane_staged",
              lane.staged if lane is not None else 0),
         ]
+
+    def histograms_info(self):
+        """Always-on log2 latency histograms exported by the telemetry
+        collector (buckets + derived p50/p99 gauges at scrape time):
+        completion-window dwell, park -> pop."""
+        return [("nns.feed.window_dwell_seconds", self._inflight.dwell)]
 
     @staticmethod
     def _stamp_invoke_spans(frames: Sequence[TensorFrame],

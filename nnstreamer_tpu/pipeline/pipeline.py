@@ -42,6 +42,7 @@ from ..core.buffer import (
 from ..core.liveness import DEADLINE_META, StallError, Watchdog, stamp_deadline
 from ..core.log import get_logger
 from ..core.resilience import FAULTS
+from ..core.telemetry import TL_QPUT_META
 from ..core.tracer import META_SRC_TS, PipelineTracer, frame_nbytes
 from .element import Element, ElementError, SinkElement, SourceElement
 
@@ -357,13 +358,19 @@ class Pipeline:
     # -- fleet telemetry (core/telemetry.py) ---------------------------------
     def enable_flight_recorder(self, capacity: int = 4096,
                                dump_dir: Optional[str] = None,
-                               min_dump_interval_s: float = 5.0):
+                               min_dump_interval_s: float = 5.0,
+                               profile_incidents: bool = True,
+                               profile_duration_s: float = 0.2):
         """Attach a flight recorder: a bounded ring of recent per-frame
         span timelines, dumped automatically (rate-limited, to log + a
         JSON file) on watchdog stall, dead-letter, swap rollback, or
         breaker trip.  Rides the tracer (one is attached if absent), so
         pipelines without it keep the one-branch-per-frame disabled
-        path.  Returns the recorder."""
+        path.  ``profile_incidents`` (default on) additionally attaches
+        an incident-time thread profile — collapsed top-stacks of the
+        named framework threads over a ``profile_duration_s`` sampling
+        window — to every dump (core/profiler.py).  Returns the
+        recorder."""
         from ..core.telemetry import FlightRecorder
 
         if self.tracer is None:
@@ -371,6 +378,8 @@ class Pipeline:
         self._recorder = FlightRecorder(
             capacity=capacity, dump_dir=dump_dir,
             min_dump_interval_s=min_dump_interval_s,
+            profile_incidents=profile_incidents,
+            profile_duration_s=profile_duration_s,
         )
         self.tracer.recorder = self._recorder
         return self._recorder
@@ -1445,6 +1454,10 @@ class Pipeline:
         must never be lost."""
         pad = el.srcpads[src_pad]
         is_frame = isinstance(item, TensorFrame)
+        if is_frame and self.tracer is not None:
+            # queue-wait origin stamp (host-local, popped at dequeue);
+            # tracer-armed only — the disabled path stays one branch
+            item.meta[TL_QPUT_META] = time.perf_counter()
         for dst, sink_pad in pad.links:
             box = dst._mailbox
             if is_frame and isinstance(box, _LeakyMailbox):
@@ -1469,6 +1482,11 @@ class Pipeline:
         accounting needs the exact split: delivered entries are counted
         in the mailbox sweep, the rest stay on the emitter)."""
         box = dst._mailbox
+        if self.tracer is not None:
+            now = time.perf_counter()
+            for _, it in items:
+                if isinstance(it, TensorFrame):
+                    it.meta[TL_QPUT_META] = now
         put_many = getattr(box, "put_many", None)
         idx, n_items = 0, len(items)
         while idx < n_items:
@@ -2011,13 +2029,22 @@ class Pipeline:
             if item is _STOP:
                 return
             tracer = self.tracer
-            if tracer is not None and has_qsize:
-                try:
-                    tracer.queue_level(
-                        el.name, box.qsize(), getattr(box, "maxsize", 0),
-                    )
-                except Exception:
-                    self.log.debug("tracer queue_level failed", exc_info=True)
+            if tracer is not None:
+                if has_qsize:
+                    try:
+                        tracer.queue_level(
+                            el.name, box.qsize(), getattr(box, "maxsize", 0),
+                        )
+                    except Exception:
+                        self.log.debug(
+                            "tracer queue_level failed", exc_info=True)
+                if isinstance(item, TensorFrame):
+                    # queue-wait histogram: enqueue stamp -> this dequeue
+                    # (stash dwell counts too — the frame was waiting)
+                    t_q = item.meta.pop(TL_QPUT_META, None)
+                    if t_q is not None:
+                        tracer.queue_wait(
+                            el.name, time.perf_counter() - t_q)
             if batching and isinstance(item, TensorFrame):
                 # micro-batching: batch-capable elements drain extra
                 # queued frames and process them in one call (the TPU
@@ -2055,7 +2082,15 @@ class Pipeline:
                     except queue.Empty:
                         break
                     boundary = False
+                    now_q = (
+                        time.perf_counter() if tracer is not None else 0.0
+                    )
                     for p2, nxt in chunk:
+                        if tracer is not None and isinstance(
+                                nxt, TensorFrame):
+                            t_q = nxt.meta.pop(TL_QPUT_META, None)
+                            if t_q is not None:
+                                tracer.queue_wait(el.name, now_q - t_q)
                         if (not boundary
                                 and isinstance(nxt, TensorFrame)
                                 and p2 == pad

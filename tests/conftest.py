@@ -50,6 +50,13 @@ def rng():
 #    floors in a cold process measure thread-pool/allocator warmup);
 # 2. an autouse fixture makes each perf test wait (bounded) until no
 #    framework threads from a previous test are still winding down.
+#
+# When BISECTING a perf failure, additionally run the perf-marked files
+# with `-p no:randomly` (tier-1 already does): pytest-randomly reseeds
+# NumPy/random per test, and while guard 1 keeps the perf BLOCK
+# contiguous, a shuffled neighborhood still changes which suites warmed
+# the process before the block — the contiguity of the block is pinned
+# by tests/test_perf_truth.py::test_perf_block_stays_contiguous.
 # ---------------------------------------------------------------------------
 @pytest.hookimpl(hookwrapper=True)
 def pytest_collection_modifyitems(config, items):

@@ -667,24 +667,38 @@ class TestContinuousBatchingChaos:
         np.testing.assert_array_equal(
             got, sim_oracle(sim, p_kill, got.shape[1]))
 
-        # evicted stream: typed expiry, partial tokens exact
+        # evicted stream: typed expiry, partial tokens exact.  How many
+        # tokens land before the budget blows depends on when the killed
+        # victim's slot frees (cancel-feedback detection is ~0.1s but
+        # races the 0.5s budget on a slow box) — zero tokens is a LEGAL
+        # outcome of that race (the engine logs "evicted after 0
+        # token(s)" and the final marker is tensor-less; the chaos
+        # harness's check_exact tolerates it the same way).  What is
+        # deterministic: the typed-expiry answer, exact tokens_done
+        # accounting, and oracle-prefix integrity of whatever DID land.
         assert evict_frames, "eviction must ANSWER the stream"
         last = evict_frames[-1].meta
         assert last["final"] is True
         assert last["evicted"] == "deadline"
         assert last["deadline_expired"] is True
-        etoks = np.concatenate(
-            [np.asarray(f.tensors[0]) for f in evict_frames
-             if f.tensors], axis=1)
-        assert 0 < etoks.shape[1] < n
-        np.testing.assert_array_equal(
-            etoks, sim_oracle(sim, p_evict, etoks.shape[1]))
-        assert etoks.shape[1] == last["tokens_done"]
+        etok_arrays = [
+            np.asarray(f.tensors[0]) for f in evict_frames if f.tensors
+        ]
+        n_etoks = sum(a.shape[1] for a in etok_arrays)
+        assert n_etoks < n  # the budget really cut the stream short
+        if etok_arrays:
+            etoks = np.concatenate(etok_arrays, axis=1)
+            np.testing.assert_array_equal(
+                etoks, sim_oracle(sim, p_evict, etoks.shape[1]))
+        assert n_etoks == last["tokens_done"]
         assert evict_health["deadline_expired"] >= 1
 
-        # server-side verdict: every slot freed, counters exact
+        # server-side verdict: every slot freed, counters exact.  The
+        # evict stream JOINS only when a slot freed inside its budget:
+        # delivered tokens imply a join; a waiting-queue eviction
+        # legally leaves joins at 2 (same race as above).
         assert gen_health["gen_occupied"] == 0
-        assert gen_health["gen_joins"] == 3
+        assert gen_health["gen_joins"] in ((3,) if n_etoks else (2, 3))
         assert gen_health["gen_completed"] == 1
         assert gen_health["gen_evicted"] == 1
         assert gen_health["gen_cancelled"] == 1

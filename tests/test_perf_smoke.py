@@ -65,6 +65,86 @@ def test_fusion_speedup_and_absolute_floor():
     assert fused >= 4000
 
 
+def test_histograms_armed_identity_floor():
+    """PR-11 pin: with the ALWAYS-ON log2 latency histograms armed (a
+    tracer attached records per-element handle latency per call plus a
+    mailbox queue-wait stamp per crossing), the fused identity chain
+    still clears the PR-3/PR-6 absolute 4000 fps floor — the lock-free
+    array-increment record path is cheap enough to leave on in
+    production."""
+    from nnstreamer_tpu.pipeline import parse_pipeline as parse
+
+    n = 2500
+    pipe = parse(CHAIN, name="histperf", fuse=True)
+    tracer = pipe.enable_tracing()
+    pipe.start()
+    src, sink = pipe["src"], pipe["out"]
+    done = {"n": 0}
+    sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+    pool = [np.zeros((64,), np.float32) for _ in range(16)]
+    for i in range(128):
+        src.push(pool[i % 16])
+    t_w = time.time()
+    while done["n"] < 128 and time.time() - t_w < 30:
+        time.sleep(0.005)
+    assert done["n"] >= 128, "warmup stalled"
+    done["n"] = 0
+    t0 = time.perf_counter()
+    for i in range(n):
+        src.push(pool[i % 16])
+    while done["n"] < n and time.perf_counter() - t0 < 60:
+        time.sleep(0.002)
+    fps = done["n"] / (time.perf_counter() - t0)
+    src.end_of_stream()
+    pipe.wait(timeout=30)
+    hists = {
+        (el, name): h for el, name, h in tracer.latency_histograms()
+    }
+    snap = pipe.metrics_snapshot()
+    pipe.stop()
+    assert done["n"] == n, "frames lost with histograms armed"
+    assert fps >= 4000, (
+        f"histogram-armed dataplane regressed: {fps:.0f} fps < 4000"
+    )
+    # the instruments really recorded: every element's handle histogram
+    # holds one observation per call, and the percentiles surface in the
+    # snapshot under their stable names
+    h_out = hists[("out", "nns.element.handle_seconds")]
+    assert h_out.count == n + 128
+    assert snap.get("nns.element.handle_p99_us", element="out") > 0
+    assert snap.sum("nns.element.handle_seconds_count", element="out") == (
+        n + 128)
+
+
+def test_perf_truth_fast_check_against_committed_baseline():
+    """The per-PR perf-truth gate (tier-1, next to the three lint
+    gates): the FAST axis subset must land inside the committed
+    PERF_BASELINE.json distribution — median beyond ``median - tol``
+    counts as a regression (tolerance math pinned by
+    tests/test_perf_truth.py; best-of-k with early exit absorbs ambient
+    load).  This replaces hand-picked binary floors with the committed
+    distribution for every PR, chip or no chip."""
+    import importlib.util
+    import os
+
+    pt_path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "tools",
+        "perf_truth.py")
+    spec = importlib.util.spec_from_file_location("perf_truth_gate", pt_path)
+    pt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pt)
+    report = pt.check(fast=True, k=3, verbose=False)
+    bad = {
+        name: ax for name, ax in report["axes"].items()
+        if ax["verdict"] != "ok"
+    }
+    assert report["ok"], (
+        "perf-truth regression vs committed baseline "
+        f"(PERF_BASELINE.json, captured {report['baseline_captured_at']}"
+        f"): {bad}"
+    )
+
+
 def test_telemetry_disabled_per_frame_overhead():
     """PR-7 pin: with the telemetry layer present but DISABLED (the
     default — no tracer, no flight recorder, no exposition endpoint),

@@ -507,8 +507,16 @@ class TestFusedParity:
                 key: v
                 for key, v in pipe.metrics_snapshot().counters().items()
                 # process-global pools accumulate across runs — excluded
-                # (everything else is per-pipeline deterministic)
+                # (everything else is per-pipeline deterministic).  The
+                # log2 latency histograms' bucket/sum series hold TIMING
+                # (nondeterministic by nature), and queue-wait exists
+                # only where mailboxes exist — which fusion elides by
+                # design; their deterministic subset (handle-latency
+                # _count) stays in and is additionally pinned by
+                # test_handle_histogram_counts_identical.
                 if not key[0].startswith("nns.pool.")
+                and not key[0].endswith(("_bucket", "_sum"))
+                and not key[0].startswith("nns.element.queue_wait_seconds")
             }
             health = {
                 el: {k: entry[k] for k in (
@@ -534,6 +542,358 @@ class TestFusedParity:
         assert dict(cnt_f)[
             ("nns.pipeline.delivered", (("pipeline", "parity"),))
         ] == self.N - 6
+
+    def _run_hists(self, fuse: bool):
+        """Handle-latency log2 histograms after the supervision
+        truth-table pipeline: {element: (count, bucket_count_sum)}."""
+        FAULTS.reset()
+        pipe = parse_pipeline(
+            "appsrc name=src ! identity name=a error-policy=skip ! "
+            "identity name=b ! tensor_sink name=out",
+            name="hparity", fuse=fuse,
+        )
+        tracer = pipe.enable_tracing()
+        FAULTS.arm("element.a.handle_frame",
+                   exc=ValueError("poison"), every=4)
+        pipe.start()
+        try:
+            for i in range(self.N):
+                pipe["src"].push(np.float32([i]))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=20)
+            out = {}
+            for el, mname, h in tracer.latency_histograms():
+                if mname != "nns.element.handle_seconds":
+                    continue
+                out[el] = (h.count, sum(h.state()))
+            return out
+        finally:
+            FAULTS.reset()
+            pipe.stop()
+
+    def test_handle_histogram_counts_identical(self):
+        """PR-11 satellite (PR-7 registry-parity discipline): on the
+        supervision truth-table pipeline, each element's handle-latency
+        histogram records BYTE-IDENTICAL observation counts fused vs
+        unfused, and the per-bucket counts sum exactly to the total in
+        both modes (no observation is lost or double-bucketed by the
+        lock-free record path).  Bucket PLACEMENT is timing and is
+        deliberately not compared."""
+        hf = self._run_hists(True)
+        hu = self._run_hists(False)
+        assert set(hf) == set(hu) == {"a", "b", "out"}
+        assert hf == hu
+        for el, (count, bucket_sum) in hf.items():
+            assert count == bucket_sum, (
+                f"{el}: bucket counts do not sum to the total")
+        # the truth table's exact shape: 'a' is called once per frame,
+        # poison included (the handler raised INSIDE the call — it still
+        # began and ended); b/out see only the 18 survivors
+        assert hf["a"][0] == self.N
+        assert hf["b"][0] == self.N - 6
+        assert hf["out"][0] == self.N - 6
+
+
+# ---------------------------------------------------------------------------
+# Profilers: jax trace-session refcount hygiene + the incident-time
+# thread sampler
+# ---------------------------------------------------------------------------
+class _FakeJaxProfiler:
+    """Scripted stand-in for the jax.profiler singleton."""
+
+    def __init__(self, fail_starts=0):
+        self.fail_starts = fail_starts
+        self.starts = []
+        self.stops = 0
+
+    def start_trace(self, d):
+        if self.fail_starts > 0:
+            self.fail_starts -= 1
+            raise RuntimeError("injected start_trace failure")
+        self.starts.append(d)
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+@pytest.fixture
+def _clean_profiler():
+    """Snapshot/restore the profiler module's global session state."""
+    from nnstreamer_tpu.core import profiler
+
+    refs, d = profiler._refs, profiler._dir
+    yield profiler
+    profiler._refs, profiler._dir = refs, d
+
+
+class TestJaxTraceSession:
+    def test_failed_start_leaves_state_fully_reset(self, monkeypatch,
+                                                   _clean_profiler):
+        """Satellite bugfix pin: a trace_start whose start_trace raises
+        returns False with refs==0 and dir==None AND resets the jax
+        singleton (stop_trace called best-effort) — so a later
+        successful start from ANOTHER element enters the clean refs==0
+        path instead of refcounting on top of stale state."""
+        import jax
+
+        profiler = _clean_profiler
+        profiler._refs, profiler._dir = 0, None
+        fake = _FakeJaxProfiler(fail_starts=1)
+        monkeypatch.setattr(jax, "profiler", fake)
+        assert profiler.trace_start("/tmp/t1") is False
+        assert profiler._refs == 0 and profiler._dir is None
+        assert fake.stops == 1  # the half-armed singleton was reset
+        assert profiler.trace_active() is False
+        # a subsequent start (different element, different dir) succeeds
+        # through the clean refs==0 path
+        assert profiler.trace_start("/tmp/t2") is True
+        assert profiler._refs == 1 and profiler._dir == "/tmp/t2"
+        assert fake.starts == ["/tmp/t2"]
+        assert profiler.trace_active() is True
+        # join + full teardown refcounts exactly
+        assert profiler.trace_start("/tmp/t2") is True
+        assert profiler._refs == 2
+        profiler.trace_stop()
+        assert profiler._refs == 1 and fake.stops == 1
+        profiler.trace_stop()
+        assert profiler._refs == 0 and profiler._dir is None
+        assert fake.stops == 2
+
+    def test_foreign_active_session_is_not_reset(self, monkeypatch,
+                                                 _clean_profiler):
+        """A start that fails because the jax singleton is ALREADY
+        active (someone else's TensorBoard capture) must NOT be reset —
+        the failure-path stop_trace would kill their trace mid-run."""
+        import jax
+
+        profiler = _clean_profiler
+        profiler._refs, profiler._dir = 0, None
+
+        class Busy(_FakeJaxProfiler):
+            def start_trace(self, d):
+                raise RuntimeError("profiler session already active")
+
+        fake = Busy()
+        monkeypatch.setattr(jax, "profiler", fake)
+        assert profiler.trace_start("/tmp/t3") is False
+        assert profiler._refs == 0 and profiler._dir is None
+        assert fake.stops == 0  # the foreign session survives
+
+    def test_profiler_active_gauge_via_health_collector(self, monkeypatch,
+                                                        _clean_profiler):
+        """Satellite pin: the filter's trace session surfaces as the
+        `profiler_active` health key -> nns.profiler.active gauge via
+        the ONE health-collector path (no duplicate series)."""
+        import jax
+
+        profiler = _clean_profiler
+        profiler._refs, profiler._dir = 0, None
+        monkeypatch.setattr(jax, "profiler", _FakeJaxProfiler())
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=scaler "
+            "custom=factor:2 trace=1 trace-dir=/tmp/nns_t ! "
+            "tensor_sink name=out",
+            name="profgauge",
+        )
+        pipe.start()
+        try:
+            assert pipe.health()["f"]["profiler_active"] == 1
+            snap = pipe.metrics_snapshot()
+            assert snap.get("nns.profiler.active", element="f") == 1.0
+            samples = [
+                s for s in snap.samples if s.name == "nns.profiler.active"
+                and s.labels.get("element") == "f"
+            ]
+            assert len(samples) == 1  # one export path, one series
+        finally:
+            pipe.stop()
+        assert profiler._refs == 0  # stop() released the session
+
+
+class TestThreadProfiler:
+    def test_samples_named_framework_thread(self):
+        """A named framework thread parked in a known function shows up
+        with that function in its collapsed top stack; ignored-prefix
+        threads (Thread-N etc.) do not."""
+        import threading
+        import time as _time
+
+        from nnstreamer_tpu.core.profiler import profile_threads
+
+        release = threading.Event()
+
+        def distinctive_parked_fn():
+            release.wait(10)
+
+        t = threading.Thread(target=distinctive_parked_fn,
+                             name="tprof-seg", daemon=True)
+        anon = threading.Thread(target=lambda: release.wait(10),
+                                daemon=True)  # "Thread-N": ignored
+        t.start()
+        anon.start()
+        try:
+            prof = profile_threads(duration_s=0.15, hz=50)
+        finally:
+            release.set()
+            t.join(timeout=5)
+            anon.join(timeout=5)
+        assert prof["samples"] >= 1
+        assert "tprof-seg" in prof["threads"]
+        top = prof["threads"]["tprof-seg"]["top_stacks"]
+        assert top and top[0]["count"] >= 1
+        assert "distinctive_parked_fn" in top[0]["stack"]
+        assert not any(n.startswith("Thread-") for n in prof["threads"])
+
+    def test_stall_dump_contains_stalled_threads_stack(self, tmp_path):
+        """Acceptance: a watchdog-stall incident dump carries collapsed
+        thread stacks NAMING the stalled element's streaming thread,
+        with the hang site visible in its top stack — "where did the
+        time go" from the dump file alone."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! identity name=work stall-timeout=0.3 "
+            "stall-policy=restart ! tensor_sink name=out",
+            name="profstall", fuse=False,  # thread named after 'work'
+        )
+        pipe.enable_flight_recorder(dump_dir=str(tmp_path))
+        FAULTS.arm("element.work.handle_frame", hang=True, after=2, times=1)
+        pipe.start()
+        try:
+            for i in range(4):
+                pipe["src"].push(np.float32([i]))
+            deadline = time.time() + 15
+            files = []
+            while not files and time.time() < deadline:
+                files = list(tmp_path.glob("nns_flight_*.json"))
+                time.sleep(0.05)
+            assert files, "no flight dump on watchdog stall"
+            FAULTS.reset()  # release the hang -> restart, zero loss
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=15)
+            data = json.loads(files[0].read_text())
+            prof = data["thread_profile"]
+            assert prof and prof["samples"] >= 1
+            assert "work" in prof["threads"], sorted(prof["threads"])
+            stacks = [
+                s["stack"]
+                for s in prof["threads"]["work"]["top_stacks"]
+            ]
+            # the hung thread is parked inside the injected fault's
+            # cooperative hang (resilience.py) under the supervised
+            # handler — its collapsed stack says so
+            assert any("resilience.py" in s for s in stacks), stacks
+            assert any("pipeline.py" in s for s in stacks), stacks
+            assert len(pipe["out"].frames) == 4  # zero loss after restart
+        finally:
+            FAULTS.reset()
+            pipe.stop()
+        from nnstreamer_tpu.core.telemetry import REGISTRY
+
+        caps = [
+            s for s in REGISTRY.collect()
+            if s.name == "nns.profiler.captures"
+        ]
+        assert caps and caps[0].value >= 1
+
+
+# ---------------------------------------------------------------------------
+# Always-on latency histograms (tentpole 2): snapshot + exposition
+# ---------------------------------------------------------------------------
+class TestLatencyHistograms:
+    def test_log2_histogram_units(self):
+        from nnstreamer_tpu.core.telemetry import (
+            LOG2_NBUCKETS,
+            Log2Histogram,
+        )
+
+        h = Log2Histogram()
+        assert h.quantile(0.5) is None and h.percentiles_us() == {}
+        for v in (2e-6, 2e-6, 2e-6, 1e-3, 1e-3, 0.25, 100.0):
+            h.record(v)
+        assert h.count == 7
+        assert sum(h.state()) == 7
+        assert h.sum == pytest.approx(100.252006, rel=1e-6)
+        # overflow lands in the +Inf tail, never out of range
+        assert h.state()[LOG2_NBUCKETS] == 1
+        # quantile estimates respect bucket edges (log2 resolution)
+        assert 1e-6 <= h.quantile(0.25) <= 4e-6
+        assert 5e-4 <= h.quantile(0.65) <= 2e-3
+        p = h.percentiles_us()
+        assert p["p50"] <= p["p95"] <= p["p99"]
+        # sub-resolution values land in bucket 0, not a crash
+        h.record(1e-9)
+        assert h.state()[0] >= 1
+
+    def test_quantiles_in_summary_and_prometheus(self):
+        """Acceptance: per-element p50/p95/p99 are visible in
+        telemetry_summary() and on /metrics (via the registry's
+        exposition render) with a tracer armed, window dwell included."""
+        from nnstreamer_tpu.core.telemetry import REGISTRY
+
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter name=f framework=async-sim "
+            "custom=compute_ms:1 max-batch=4 dispatch-depth=4 ! "
+            "tensor_sink name=out",
+            name="histvis",
+        )
+        pipe.enable_tracing()
+        pipe.start()
+        try:
+            for i in range(32):
+                pipe["src"].push(np.float32([i]))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=20)
+            flat = pipe.telemetry_summary()
+            for key in ("nns.element.handle_p50_us",
+                        "nns.element.handle_p95_us",
+                        "nns.element.handle_p99_us",
+                        "nns.feed.window_dwell_p50_us",
+                        "nns.feed.window_dwell_p99_us"):
+                assert flat.get(key, 0) > 0, key
+            # the compact summary never carries raw bucket series
+            assert not any(k.endswith("_bucket") for k in flat)
+            snap = pipe.metrics_snapshot()
+            assert snap.get("nns.element.handle_p99_us",
+                            element="f") > 0
+            assert snap.sum("nns.feed.window_dwell_seconds_count",
+                            element="f") >= 1
+            text = REGISTRY.render_prometheus()
+            assert "# TYPE nns_element_handle_seconds histogram" in text
+            assert re.search(
+                r'nns_element_handle_seconds_bucket\{[^}]*le="\+Inf"', text)
+            assert "nns_feed_window_dwell_seconds_count" in text
+            assert "nns_element_handle_p99_us" in text
+            _parse_prometheus(text)  # parseable end to end
+        finally:
+            pipe.stop()
+
+    def test_queue_wait_recorded_at_thread_boundaries(self):
+        """Unfused (every element owns a mailbox): each consuming
+        element records one queue-wait observation per frame; the
+        stamps are host-local and never reach the wire."""
+        from nnstreamer_tpu.core.telemetry import TL_QPUT_META
+
+        pipe = parse_pipeline(
+            "appsrc name=src ! identity name=a ! tensor_sink name=out",
+            name="qwait", fuse=False,
+        )
+        pipe.enable_tracing()
+        pipe.start()
+        try:
+            for i in range(10):
+                pipe["src"].push(np.float32([i]))
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=15)
+            snap = pipe.metrics_snapshot()
+            for el in ("a", "out"):
+                assert snap.sum("nns.element.queue_wait_seconds_count",
+                                element=el) == 10, el
+                assert snap.get("nns.element.queue_wait_p50_us",
+                                element=el) >= 0
+            # the dequeue popped the stamp off every delivered frame
+            for f in pipe["out"].frames:
+                assert TL_QPUT_META not in f.meta
+        finally:
+            pipe.stop()
 
 
 # ---------------------------------------------------------------------------
