@@ -1,0 +1,242 @@
+"""Stream continuity: checkpointed resume and live migration for
+generation streams (Documentation/resilience.md "Stream continuity").
+
+A generation stream used to die with the server it started on — a crash
+mid-decode lost every remaining token, and a rolling restart had to
+choose between cutting live streams and waiting them out.  This module
+is the shared vocabulary that lets a stream OUTLIVE its server:
+
+* every chunk a slotted :class:`~.slots.SlotEngine` emits carries a
+  **resume state** in meta (:data:`RESUME_META`): an opaque model/
+  sampling signature, the prompt digest, and the server's chunk size —
+  alongside the ``tokens_done`` / ``chunk_index`` counters the chunks
+  already carried.  Because the per-step sampling key is folded at the
+  ABSOLUTE token index (``models/transformer.py``), prompt + generated
+  prefix is a complete checkpoint: re-prefilling it on any server with
+  the same signature reproduces the remaining tokens bit-identically;
+* the query client accumulates the delivered tokens per stream in a
+  :class:`StreamContinuity` ledger.  On a mid-stream transport break —
+  or a draining server's resumable GOAWAY handoff chunk — it builds a
+  **RESUME request** (:data:`RESUME_REQ_META` + [prompt, prefix]
+  tensors) and re-routes it to a healthy server;
+* resume points snap DOWN to the last full chunk boundary, so the
+  resumed server's chunk grid stays aligned with an uninterrupted run —
+  the ledger dedupes the re-decoded overlap by ``tokens_done``
+  (``duplicate_tokens_dropped``), keeping delivered tokens EXACTLY-ONCE
+  and the emitted chunk indices contiguous across the migration.
+
+The resume state is ordinary JSON meta and the prefix an ordinary int32
+tensor, so the protocol rides both transports with zero wire changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+#: chunk meta: the resume state stamped on every resumable chunk
+RESUME_META = "_nns_resume"
+#: request meta: marks a stream request as a RESUME of an earlier one
+RESUME_REQ_META = "_nns_resume_req"
+#: chunk meta: a draining server handed this stream off (resumable
+#: final chunk — partial tokens + resume state; a migration, NOT a
+#: failure: breaker-immune, no crash cooldown)
+GOAWAY_META = "goaway"
+#: chunk meta: the server refused a RESUME request (signature/digest/
+#: shape mismatch) with a typed terminal chunk instead of an error —
+#: the server pipeline survives, the client tries elsewhere
+RESUME_REJECT_META = "resume_reject"
+
+
+def prompt_digest(prompt) -> str:
+    """Stable digest of a normalized (1, Tp) int32 prompt: the resumed
+    server verifies the prefix it is asked to re-prefill belongs to THIS
+    prompt (a mismatched resume must refuse, not decode garbage)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+    h = hashlib.sha1()
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def resume_signature(kind: str, **cfg: Any) -> str:
+    """Opaque signature of everything that determines the TOKEN sequence
+    (model family + params seed + sampling rule + generation length).
+    Servers stamp it on chunks and verify it on resume; clients only
+    echo it — two servers produce interchangeable streams iff their
+    signatures match."""
+    h = hashlib.sha1()
+    h.update(kind.encode())
+    for k in sorted(cfg):
+        h.update(f"|{k}={cfg[k]}".encode())
+    return h.hexdigest()
+
+
+class ChunkVerdict:
+    """What :meth:`StreamContinuity.accept` decided about one incoming
+    chunk: the (possibly trimmed/renumbered) frame to emit downstream
+    (or None), how many duplicate tokens were dropped, and whether the
+    chunk was a migration handoff, a resume rejection, or the stream's
+    true completion."""
+
+    __slots__ = ("emit", "dup", "handoff", "finished", "reject")
+
+    def __init__(self):
+        self.emit = None
+        self.dup = 0
+        self.handoff = False
+        self.finished = False
+        self.reject: Optional[str] = None
+
+
+class StreamContinuity:
+    """Client-side ledger of ONE logical generation stream across any
+    number of servers.
+
+    Feed every received chunk through :meth:`accept`; it passes
+    non-resumable streams through untouched (``capable`` stays False and
+    the legacy no-replay semantics apply).  Once a chunk carries
+    :data:`RESUME_META` the ledger latches the stream's signature /
+    digest / chunk size, accumulates the delivered tokens, renumbers
+    emitted ``chunk_index`` contiguously, and dedupes any re-decoded
+    overlap after a resume.  :meth:`build_resume_frame` produces the
+    RESUME request for the next attempt."""
+
+    __slots__ = (
+        "frame", "capable", "sig", "digest", "chunk", "delivered",
+        "duplicates_dropped", "emit_idx", "_tokens", "_stream_seq",
+        "_handoff",
+    )
+
+    def __init__(self, frame):
+        self.frame = frame
+        self.capable = False
+        self.sig = ""
+        self.digest = ""
+        self.chunk = 1
+        self.delivered = 0          # tokens delivered downstream
+        self.duplicates_dropped = 0
+        self.emit_idx = 0           # contiguous downstream chunk numbering
+        self._tokens = []           # np (1, n) pieces, concat == delivered
+        self._stream_seq = None     # latched: one seq for the whole stream
+        self._handoff = False
+
+    def accept(self, ans) -> ChunkVerdict:
+        """Classify one received chunk and compute what (if anything) to
+        emit downstream.  Exactly-once contract: tokens past the
+        ledger's ``delivered`` mark are new (emitted + appended), tokens
+        at or below it are duplicates from a post-resume overlap
+        (dropped + counted)."""
+        import numpy as np
+
+        v = ChunkVerdict()
+        meta = ans.meta
+        rj = meta.get(RESUME_REJECT_META)
+        if rj is not None:
+            v.reject = str(rj)
+            return v
+        rs = meta.get(RESUME_META)
+        if rs is not None and not self.capable:
+            try:
+                self.sig = str(rs["sig"])
+                self.digest = str(rs["digest"])
+                self.chunk = max(1, int(rs["chunk"]))
+                self.capable = True
+            except (KeyError, TypeError, ValueError):
+                self.capable = False
+        if not self.capable:
+            # legacy / non-generator stream: emit untouched
+            v.emit = ans
+            v.finished = bool(meta.get("final", True))
+            return v
+        toks = None
+        n = 0
+        if ans.tensors:
+            toks = np.asarray(ans.tensors[0])
+            if toks.ndim == 1:
+                toks = toks[None]
+            n = int(toks.shape[1])
+        done = meta.get("tokens_done")
+        done = int(done) if done is not None else self.delivered + n
+        start = done - n  # this chunk covers tokens (start, done]
+        final = bool(meta.get("final", True))
+        handoff = final and bool(meta.get(GOAWAY_META))
+        dup = min(max(0, self.delivered - start), n)
+        if dup:
+            self.duplicates_dropped += dup
+            v.dup = dup
+            toks = toks[:, dup:]
+            n -= dup
+        if n > 0:
+            self._tokens.append(np.ascontiguousarray(toks, dtype=np.int32))
+            if done > self.delivered:
+                self.delivered = done
+        v.handoff = handoff
+        if handoff:
+            self._handoff = True
+        v.finished = final and not handoff
+        if n > 0 or v.finished:
+            out = ans.with_tensors(
+                [np.ascontiguousarray(toks, dtype=np.int32)] if n > 0
+                else [])
+            # contiguous downstream view across migrations: one chunk
+            # numbering, one stream_seq, cumulative tokens_done; the
+            # handoff markers never leave the client
+            out.meta["chunk_index"] = self.emit_idx
+            self.emit_idx += 1
+            out.meta["tokens_done"] = self.delivered
+            out.meta["final"] = v.finished
+            if self._stream_seq is None:
+                self._stream_seq = out.meta.get("stream_seq")
+            elif "stream_seq" in out.meta:
+                out.meta["stream_seq"] = self._stream_seq
+            if handoff:
+                out.meta.pop(GOAWAY_META, None)
+                out.meta.pop("evicted", None)
+            v.emit = out
+        return v
+
+    def take_handoff(self) -> bool:
+        """True once after a handoff chunk arrived (migration trigger)."""
+        h, self._handoff = self._handoff, False
+        return h
+
+    def resume_point(self) -> int:
+        """Where the next attempt resumes: the last FULL chunk boundary
+        at or below ``delivered``.  Snapping down keeps the resumed
+        server's chunk grid aligned with an uninterrupted run; the
+        overlap (partial tokens past the boundary that were already
+        delivered) is re-decoded and deduped by :meth:`accept`."""
+        return (self.delivered // self.chunk) * self.chunk
+
+    def build_resume_frame(self):
+        """The RESUME request for the next attempt: tensors = [original
+        prompt, generated prefix (1, R)], meta = the original request's
+        meta (trace id, tenant, priority, deadline, affinity key all
+        carry over) plus :data:`RESUME_REQ_META`."""
+        import numpy as np
+
+        from .buffer import TensorFrame
+
+        if not self.capable:
+            raise RuntimeError("stream carries no resume state")
+        total = (np.concatenate(self._tokens, axis=1) if self._tokens
+                 else np.zeros((1, 0), np.int32))
+        if int(total.shape[1]) != self.delivered:
+            # the ledger lost coherence (out-of-order / gapped chunks):
+            # resuming could violate exactly-once — refuse loudly
+            self.capable = False
+            raise RuntimeError(
+                f"resume ledger incoherent: {total.shape[1]} tokens held "
+                f"vs {self.delivered} delivered")
+        r = self.resume_point()
+        prefix = np.ascontiguousarray(total[:, :r], dtype=np.int32)
+        meta: Dict[str, Any] = dict(self.frame.meta)
+        meta[RESUME_REQ_META] = {
+            "v": 1, "sig": self.sig, "digest": self.digest,
+            "chunk": int(self.chunk), "tokens_done": int(r),
+        }
+        return TensorFrame(
+            [np.asarray(self.frame.tensors[0]), prefix], meta=meta)

@@ -38,6 +38,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .buffer import DEVICE_POOL, materialize as _materialize
+from .liveness import ThreadBeat
 from .telemetry import Log2Histogram
 
 
@@ -77,7 +78,7 @@ class CompletionWindow:
     """
 
     __slots__ = ("name", "_materialize", "_dq", "_cv", "_reaper", "_closed",
-                 "reaped", "dispatch_waits", "dwell")
+                 "reaped", "dispatch_waits", "dwell", "heartbeat")
 
     def __init__(self, name: str = "window",
                  materialize: Optional[Callable] = None):
@@ -87,6 +88,10 @@ class CompletionWindow:
         self._cv = threading.Condition()
         self._reaper: Optional[threading.Thread] = None
         self._closed = False
+        # background-thread liveness: the reaper beats once per loop —
+        # a reaper with parked entries and a stale beat is wedged
+        # inside a device sync (named-thread census in filter health)
+        self.heartbeat = ThreadBeat(f"{name}-reaper")
         # stats (exact under the cv; perf smoke reads them)
         self.reaped = 0
         self.dispatch_waits = 0
@@ -107,11 +112,14 @@ class CompletionWindow:
                     target=self._reap_loop,
                     name=f"{self.name}-reaper", daemon=True,
                 )
+                self.heartbeat.bind(self._reaper)
+                self.heartbeat.beat()
                 self._reaper.start()
             self._cv.notify_all()
 
     def _reap_loop(self) -> None:
         while True:
+            self.heartbeat.beat()
             with self._cv:
                 entry = None
                 while entry is None:
@@ -124,6 +132,11 @@ class CompletionWindow:
                     if entry is None:
                         self._cv.wait()
                 entry.claimed = True
+            # beat AFTER claiming, before the blocking sync: the loop-top
+            # beat precedes an unbounded idle wait, so without this a
+            # healthy first job after a long idle would show the exact
+            # stale-beat-while-busy signature the census calls wedged
+            self.heartbeat.beat()
             try:
                 mats = self._materialize(entry.out_b)
                 err = None
@@ -267,7 +280,7 @@ class HostStagingLane:
     """
 
     __slots__ = ("name", "_to_device", "_pool", "_q", "_cv", "_worker",
-                 "_closed", "staged")
+                 "_closed", "staged", "heartbeat")
 
     def __init__(self, to_device: Callable[[List[np.ndarray]], List[Any]],
                  pool=None, name: str = "lane"):
@@ -279,6 +292,10 @@ class HostStagingLane:
         self._worker: Optional[threading.Thread] = None
         self._closed = False
         self.staged = 0  # stats
+        # background-thread liveness: the worker beats once per job —
+        # a lane with work and a stale beat is wedged inside to_device
+        # (named-thread census in filter health)
+        self.heartbeat = ThreadBeat(f"{name}-stage")
 
     def submit(self, per_frame: List[List[np.ndarray]]) -> StagedBatch:
         """Stage one micro-batch: ``per_frame`` is a list of per-frame
@@ -291,18 +308,24 @@ class HostStagingLane:
                 self._worker = threading.Thread(
                     target=self._run, name=f"{self.name}-stage", daemon=True,
                 )
+                self.heartbeat.bind(self._worker)
+                self.heartbeat.beat()
                 self._worker.start()
             self._cv.notify_all()
         return job
 
     def _run(self) -> None:
         while True:
+            self.heartbeat.beat()
             with self._cv:
                 while not self._q:
                     if self._closed:
                         return
                     self._cv.wait()
                 job, per_frame = self._q.popleft()
+            # beat after the (possibly long-idle) dequeue — see the
+            # reaper's matching comment
+            self.heartbeat.beat()
             bufs: List[np.ndarray] = []
             try:
                 n = len(per_frame)

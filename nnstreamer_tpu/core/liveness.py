@@ -152,6 +152,83 @@ def is_expired(
 
 
 # ---------------------------------------------------------------------------
+# Background-thread heartbeats
+# ---------------------------------------------------------------------------
+class ThreadBeat:
+    """Watchdog heartbeat for one NAMED background framework thread
+    (slot-engine pump, completion-window reaper, staging-lane worker).
+
+    The owning thread calls :meth:`beat` once per loop iteration —
+    lock-free, one clock read + two GIL-atomic stores (the watchdog-ping
+    discipline) — and the element-side consumer asks
+    :meth:`check_stall` ``(busy=...)`` from its dispatch thread: a
+    thread that has WORK (``busy``) but has not beaten for
+    ``stall_after_s`` is wedged (stuck inside a device call / C
+    extension), which a sticky error can never surface because the
+    thread never returns.  ``check_stall`` is edge-triggered — one True
+    per stall episode — so the caller can fire a single flight-recorder
+    incident instead of a dump storm.  :meth:`snapshot` feeds the
+    named-thread census in ``health()``."""
+
+    __slots__ = ("name", "stall_after_s", "_clock", "_last", "beats",
+                 "stalls", "_flagged", "_thread")
+
+    def __init__(self, name: str, stall_after_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.stall_after_s = float(stall_after_s)
+        self._clock = clock
+        self._last = clock()
+        self.beats = 0
+        self.stalls = 0
+        self._flagged = False
+        self._thread: Optional[threading.Thread] = None
+
+    def bind(self, thread: Optional[threading.Thread]) -> None:
+        """Attach the live Thread object (liveness census reads
+        ``is_alive``)."""
+        self._thread = thread
+
+    def beat(self) -> None:
+        self._last = self._clock()
+        self.beats += 1  # single-writer: the beating thread itself
+
+    def alive(self) -> bool:
+        t = self._thread
+        return bool(t is not None and t.is_alive())
+
+    def age_s(self) -> float:
+        return max(0.0, self._clock() - self._last)
+
+    def check_stall(self, busy: bool) -> bool:
+        """True ONCE per stall episode: the thread has pending work but
+        has not beaten within ``stall_after_s``.  An idle thread (or a
+        beat arriving again) re-arms the edge."""
+        if not busy or self.age_s() < self.stall_after_s:
+            self._flagged = False
+            return False
+        if self._flagged:
+            return False
+        self._flagged = True
+        self.stalls += 1
+        return True
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "alive": self.alive(),
+            "age_s": round(self.age_s(), 3),
+            "beats": self.beats,
+            "stalls": self.stalls,
+        }
+
+
+def thread_census(*beats: Optional["ThreadBeat"]) -> Dict[str, Any]:
+    """``health()`` census of an element's background threads: one row
+    per :class:`ThreadBeat`, keyed by thread name (Nones skipped)."""
+    return {b.name: b.snapshot() for b in beats if b is not None}
+
+
+# ---------------------------------------------------------------------------
 # Watchdog
 # ---------------------------------------------------------------------------
 class _Watch:

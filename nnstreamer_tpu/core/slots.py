@@ -43,6 +43,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .continuity import GOAWAY_META, RESUME_META, prompt_digest
+from .liveness import ThreadBeat
 from .log import get_logger
 
 log = get_logger("slots")
@@ -83,6 +85,11 @@ class GenStream:
         "deadline_ts", "token_budget_s", "state", "slot", "prefill_pos",
         "gen", "tok", "pending", "pending_n", "chunk_index", "tokens_out",
         "evict_reason", "submitted_ts", "last_token_ts", "joined_ts",
+        # stream continuity (core/continuity.py): what the chunked
+        # prefill actually runs over (prompt, or prompt + generated
+        # prefix on a RESUME), the checkpoint to restart decode from,
+        # and the per-chunk resume state stamped into emitted meta
+        "prefill_src", "resume_tok", "resume_gen", "resume_info",
     )
 
     def __init__(self, sid: int, frame, prompt, max_new: int, chunk: int,
@@ -111,6 +118,10 @@ class GenStream:
         self.submitted_ts = now
         self.last_token_ts = now
         self.joined_ts: Optional[float] = None
+        self.prefill_src = prompt         # prompt (+ prefix[:-1] on resume)
+        self.resume_tok = 0               # last prefix token (resume only)
+        self.resume_gen = 0               # tokens already delivered (resume)
+        self.resume_info: Optional[Dict[str, Any]] = None
 
     @property
     def finished(self) -> bool:
@@ -246,7 +257,8 @@ class SlotEngine:
                  token_budget_s: float = 0.0,
                  jit_bucket_max: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 name: str = "slots"):
+                 name: str = "slots",
+                 resume_sig: Optional[str] = None):
         import numpy as np
 
         self._np = np
@@ -261,6 +273,18 @@ class SlotEngine:
         self.jit_bucket_max = int(jit_bucket_max or self.JIT_BUCKET_MAX)
         self.clock = clock
         self.name = name
+        # stream continuity (core/continuity.py): with a signature armed,
+        # every chunk carries resume state in meta, and a drain hands
+        # live streams off as resumable GOAWAY final chunks instead of
+        # waiting them out; None = legacy engine (no stamping, drains
+        # let streams finish)
+        self.resume_sig = resume_sig
+        self._goaway = False
+        # background-thread liveness: the pump beats once per loop —
+        # a pump with pending work and a stale beat is WEDGED (stuck in
+        # a device call), which the sticky pop_ready error can never
+        # surface because the thread never returns
+        self.heartbeat = ThreadBeat(f"{name}-slots", clock=clock)
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)       # pump wakeups
@@ -292,12 +316,15 @@ class SlotEngine:
         self.prefill_chunks = 0
         self.tokens_total = 0
         self.tokens_per_step = 0.0  # EWMA of active slots per decode step
+        self.resumes = 0            # streams joined via a RESUME request
+        self.goaway_evicted = 0     # live streams handed off on drain
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         np = self._np
         self._stop.clear()
         self._error = None
+        self._goaway = False
         self._cache = self.model.init_cache()
         # engine-owned state vectors are HOST numpy (model-agnostic: the
         # jax halves convert at the jit boundary — (S,) ints, negligible
@@ -306,6 +333,8 @@ class SlotEngine:
         self._gen_vec = np.zeros((self.slots,), np.int32)
         self._thread = threading.Thread(
             target=self._pump, name=f"{self.name}-slots", daemon=True)
+        self.heartbeat.bind(self._thread)
+        self.heartbeat.beat()
         self._thread.start()
 
     def stop(self) -> None:
@@ -334,9 +363,21 @@ class SlotEngine:
     # -- submission / cancellation -----------------------------------------
     def submit(self, frame, prompt, max_new: int, chunk: int,
                tenant: str = "", priority: int = 3,
-               deadline_ts: Optional[float] = None) -> GenStream:
+               deadline_ts: Optional[float] = None,
+               resume: Optional[Dict[str, Any]] = None) -> GenStream:
         """Queue one prompt for a slot.  ``prompt`` is host int32
-        (1, Tp), already validated against ``max_seq`` by the caller."""
+        (1, Tp), already validated against ``max_seq`` by the caller.
+
+        ``resume`` = ``{"prefix": (1, R) int32, "tokens_done": R}``
+        joins a CHECKPOINTED stream instead of a fresh one: the chunked
+        prefill runs over prompt + prefix[:-1], decode restarts from the
+        prefix's last token at absolute step R (the per-step sampling
+        key folds at the absolute index, so the remaining tokens are
+        bit-identical to an uninterrupted run), and emitted
+        ``tokens_done`` / ``chunk_index`` continue from R.  The caller
+        validated signature/digest/shape; R == 0 degrades to a fresh
+        join (full replay, client-side dedupe owns the overlap)."""
+        np = self._np
         with self._lock:
             if self._error is not None:
                 raise self._error
@@ -346,10 +387,45 @@ class SlotEngine:
                 tenant=tenant, priority=priority, deadline_ts=deadline_ts,
                 token_budget_s=self.token_budget_s, now=self.clock(),
             )
+            if self.resume_sig is not None:
+                s.resume_info = {
+                    "v": 1, "sig": self.resume_sig,
+                    "digest": prompt_digest(prompt), "chunk": int(s.chunk),
+                }
+            if resume is not None:
+                self.resumes += 1
+                r = int(resume.get("tokens_done", 0))
+                if r > 0:
+                    prefix = np.asarray(resume["prefix"], dtype=np.int32)
+                    s.prefill_src = (
+                        np.concatenate([prompt, prefix[:, :r - 1]], axis=1)
+                        .astype(np.int32) if r > 1 else prompt)
+                    s.resume_tok = int(prefix[0, r - 1])
+                    s.resume_gen = r
+                    s.tokens_out = r
+                    s.chunk_index = r // s.chunk
             self._streams[s.sid] = s
             self._waiting.append(s)
             self._work.notify_all()
             return s
+
+    def begin_goaway(self) -> None:
+        """Drain handoff (rolling restart): from the next token boundary
+        on, every live stream — decoding, prefilling, or still waiting —
+        is flushed with a RESUMABLE final chunk (partial tokens +
+        resume state + the ``goaway`` marker) and its slot freed, so the
+        client migrates it to a healthy server and the serversrc's
+        drain completes as soon as the handoffs are delivered.  No-op on
+        a legacy engine without a resume signature: a handoff chunk the
+        client cannot resume would silently truncate the stream."""
+        if self.resume_sig is None:
+            log.warning(
+                "%s: drain without resume state armed — live streams "
+                "will finish in place instead of migrating", self.name)
+            return
+        with self._work:
+            self._goaway = True
+            self._work.notify_all()
 
     def cancel(self, sid: Optional[int] = None,
                client_id: Optional[int] = None) -> bool:
@@ -420,6 +496,8 @@ class SlotEngine:
                 "gen_jit_buckets": (
                     len(self._prefill_lru) + len(self._decode_lru)),
                 "gen_decode_compiles": self.model.decode_compiles,
+                "gen_resumes": self.resumes,
+                "gen_goaway_evicted": self.goaway_evicted,
             }
 
     # -- pump internals -----------------------------------------------------
@@ -463,6 +541,11 @@ class SlotEngine:
             stream_seq=s.frame.seq, chunk_index=s.chunk_index,
             tokens_done=s.tokens_out, final=bool(final),
         )
+        if s.resume_info is not None:
+            # stream continuity: every chunk is a checkpoint — the
+            # client can rebuild the stream from its accumulated tokens
+            # plus this state on ANY server with a matching signature
+            out.meta[RESUME_META] = s.resume_info
         if extra_meta:
             out.meta.update(extra_meta)
         s.chunk_index += 1
@@ -533,6 +616,34 @@ class SlotEngine:
             "%s: stream %d evicted (%s) after %d token(s)",
             self.name, s.sid, reason, s.tokens_out)
 
+    def _sweep_goaway(self) -> None:
+        """Drain handoff (lock held): flush EVERY live stream with a
+        resumable GOAWAY final chunk and free its slot.  Unlike a
+        deadline eviction this is a MIGRATION, not a failure: no
+        ``deadline_expired`` marker (the client must not count a blown
+        budget), partial tokens ride the final chunk, and the resume
+        state on it lets the client continue bit-identically elsewhere.
+        Runs every boundary while draining, so streams admitted just
+        before the drain hand off too."""
+        for s in list(self._streams.values()):
+            if s.finished:
+                continue
+            if s.state == "waiting":
+                try:
+                    self._waiting.remove(s)
+                except ValueError:
+                    pass
+            s.state = "evicted"
+            s.evict_reason = "goaway"
+            self.goaway_evicted += 1
+            self._emit_terminal(s, extra_meta={
+                GOAWAY_META: True, "evicted": "goaway",
+            })
+            self._free_slot(s)
+            log.info(
+                "%s: stream %d handed off on drain after %d token(s)",
+                self.name, s.sid, s.tokens_out)
+
     def _reap_cancelled(self) -> None:
         """Free slots of streams cancelled since the last boundary and
         drop cancelled entries still waiting (lock held)."""
@@ -583,8 +694,11 @@ class SlotEngine:
         np = self._np
 
         while not self._stop.is_set():
+            self.heartbeat.beat()
             with self._work:
                 self._reap_cancelled()
+                if self._goaway:
+                    self._sweep_goaway()
                 self._sweep_deadlines(self.clock())
                 joined = self._join_waiting(self.clock())
                 have_prefill = any(
@@ -682,21 +796,46 @@ class SlotEngine:
     def _prefill_one(self, s: GenStream) -> None:
         """One chunked-prefill step for a joining stream: reset pages on
         first touch, run one chunk, pick token 1 when the prompt is
-        done.  Device work runs OUTSIDE the lock."""
+        done.  Device work runs OUTSIDE the lock.
+
+        RESUME joins prefill ``prefill_src`` = prompt + generated
+        prefix[:-1] through the SAME buckets — the cache after the
+        prefill is bit-identical to the incremental decode that built
+        it on the dead server — then skip the pick entirely: the next
+        decode input is the prefix's LAST token at absolute step
+        ``resume_gen``, both known from the checkpoint."""
         np = self._np
 
         slot = np.int32(s.slot)
         if s.prefill_pos == 0:
             self._cache = self.model.reset_slot(self._cache, slot)
-        tp = s.prompt.shape[1]
+        tp = s.prefill_src.shape[1]
         n = min(self.prefill_chunk, tp - s.prefill_pos)
-        toks = s.prompt[:, s.prefill_pos:s.prefill_pos + n].astype(np.int32)
+        toks = s.prefill_src[:, s.prefill_pos:s.prefill_pos + n].astype(
+            np.int32)
         self._cache, logits = self._prefill_fn(n)(
             self.params, self._cache, toks, slot)
         s.prefill_pos += n
         with self._lock:
             self.prefill_chunks += 1
         if s.prefill_pos < tp:
+            return
+        if s.resume_gen:
+            # checkpointed restart: no pick, no token-1 emission — the
+            # client already holds tokens 1..resume_gen
+            self._tok_vec[s.slot] = s.resume_tok
+            self._gen_vec[s.slot] = s.resume_gen
+            now = self.clock()
+            with self._lock:
+                if s.finished:  # cancelled/handed off during prefill
+                    return
+                s.tok = s.resume_tok
+                s.gen = s.resume_gen
+                s.last_token_ts = now
+                if s.resume_gen >= s.max_new:
+                    self._finish(s, "done")  # defensive: nothing left
+                else:
+                    s.state = "decoding"
             return
         # prompt fully prefilled: pick token 1 (raw gen_seed key — the
         # exact pick the unslotted prefill applies)

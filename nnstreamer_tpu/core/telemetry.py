@@ -175,6 +175,10 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.query.deadline_expired": ("counter", "requests abandoned: budget ran out"),
     "nns.query.corruption_detected": ("counter", "corrupt exchanges detected"),
     "nns.query.degraded_frames": ("counter", "frames answered by degrade= instead of a server"),
+    "nns.query.stream_resumes": ("counter", "generation streams resumed after a mid-stream break"),
+    "nns.query.stream_migrations": ("counter", "generation streams migrated off a draining server"),
+    "nns.query.duplicate_tokens_dropped": ("counter", "post-resume overlap tokens deduped (exactly-once)"),
+    "nns.query.resume_failures": ("counter", "stream resume attempts that failed (reject/no-progress/exhaustion)"),
     "nns.query.breaker_trips_evicted": ("counter", "trips of breakers evicted on pool swaps"),
     "nns.query.breaker_open": ("gauge", "1 while the remote's breaker is open"),
     "nns.query.breaker_trips": ("counter", "lifetime breaker trips for the remote"),
@@ -202,6 +206,9 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.gen.tokens_per_step": ("gauge", "EWMA active slots per decode step"),
     "nns.gen.jit_buckets": ("gauge", "live decode/prefill compile buckets (LRU-bounded)"),
     "nns.gen.decode_compiles": ("counter", "slotted decode-step retraces (shape churn)"),
+    "nns.gen.resumes": ("counter", "streams joined from a RESUME checkpoint"),
+    "nns.gen.goaway_evicted": ("counter", "live streams handed off as resumable GOAWAY chunks on drain"),
+    "nns.gen.resume_rejects": ("counter", "RESUME requests refused (signature/digest/shape mismatch)"),
 
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
@@ -258,6 +265,10 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "degraded_frames": "nns.query.degraded_frames",
     "breaker_trips_evicted": "nns.query.breaker_trips_evicted",
     "affinity_remaps": "nns.query.affinity_remaps",
+    "stream_resumes": "nns.query.stream_resumes",
+    "stream_migrations": "nns.query.stream_migrations",
+    "duplicate_tokens_dropped": "nns.query.duplicate_tokens_dropped",
+    "resume_failures": "nns.query.resume_failures",
     "corrupt_dropped": "nns.wire.corrupt_dropped",
     "truncated_samples": "nns.datarepo.truncated_samples",
     "pending_frames": "nns.source.pending",
@@ -275,6 +286,9 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "gen_tokens_per_step": "nns.gen.tokens_per_step",
     "gen_jit_buckets": "nns.gen.jit_buckets",
     "gen_decode_compiles": "nns.gen.decode_compiles",
+    "gen_resumes": "nns.gen.resumes",
+    "gen_goaway_evicted": "nns.gen.goaway_evicted",
+    "gen_resume_rejects": "nns.gen.resume_rejects",
     "profiler_active": "nns.profiler.active",
 }
 
@@ -285,6 +299,9 @@ HEALTH_KEYS_SPECIAL = (
     "remotes", "lifecycle", "swap_state", "swap_last_error",
     # fleet routing / tenancy (handled by dedicated collector branches)
     "tenants", "remote_inflight", "endpoint_hints", "routing",
+    # background-thread census ({thread name: ThreadBeat.snapshot()}):
+    # liveness detail for operators, not a numeric series
+    "threads",
 )
 
 

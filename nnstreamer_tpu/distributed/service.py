@@ -119,6 +119,11 @@ class QueryServerCore:
         # finish normally; then the serversrc closes the listeners
         self.draining = False
         self.goaway_sent = 0  # requests refused with GOAWAY
+        # hard stop: answer waits poll this so a handler thread blocked
+        # on a stream the engine abandoned unwinds in ~0.25s instead of
+        # wedging until its whole budget (a killed server must release
+        # its reader threads promptly — the fleet kill-latency contract)
+        self.closed = False
 
     # -- transport-agnostic handlers ----------------------------------------
     def check_caps(self, client_caps: str) -> str:
@@ -196,20 +201,32 @@ class QueryServerCore:
                 answers = []
                 deadline = time.monotonic() + budget
                 for _ in frames:
-                    try:
-                        answers.append(
-                            answer_q.get(
-                                timeout=max(0.0, deadline - time.monotonic())
-                            )
-                        )
-                    except queue.Empty:
-                        raise TimeoutError(
-                            "server pipeline produced no answer in time"
-                        ) from None
+                    answers.append(
+                        self._await_answer(answer_q, deadline))
                 self._stamp_server_spans(answers)
                 return answers
         finally:
             self._release(tenant)
+
+    def _await_answer(self, answer_q: "queue.Queue[TensorFrame]",
+                      deadline: float) -> TensorFrame:
+        """One answer off the client's queue, bounded by ``deadline``
+        AND responsive to :attr:`closed`: short poll slices so a
+        handler thread waiting on an answer that will never come (the
+        server was hard-stopped mid-request) unwinds promptly instead
+        of wedging ``stop()`` behind its whole budget."""
+        while True:
+            try:
+                return answer_q.get(
+                    timeout=min(0.25, max(0.0,
+                                          deadline - time.monotonic())))
+            except queue.Empty:
+                if self.closed:
+                    raise TimeoutError("server stopping") from None
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "server pipeline produced no answer in time"
+                    ) from None
 
     @staticmethod
     def request_identity(frames: List[TensorFrame]) -> Tuple[str, int]:
@@ -310,15 +327,7 @@ class QueryServerCore:
             with self._pending_client([frame]) as answer_q:
                 deadline = time.monotonic() + budget
                 while True:
-                    try:
-                        ans = answer_q.get(
-                            timeout=max(0.0, deadline - time.monotonic())
-                        )
-                    except queue.Empty:
-                        raise TimeoutError(
-                            "server pipeline produced no (further) "
-                            "answer in time"
-                        ) from None
+                    ans = self._await_answer(answer_q, deadline)
                     # per-chunk span decomposition (each chunk's meta is
                     # a fresh copy of the request's, so "total" reads as
                     # time-since-request at that chunk)
@@ -535,6 +544,7 @@ class QueryServerCore:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        self.closed = False
         if self._server is not None:
             return
         handlers = {
@@ -569,6 +579,7 @@ class QueryServerCore:
         (connect-type=tcp; ≙ the reference's nns-edge TCP default).
         Re-entrant: a listener closed by a drain re-opens on the same
         port (rolling restart of the serversrc element)."""
+        self.closed = False
         if self._tcp is not None:
             self._tcp.start()  # no-op when the listener is already live
             return
@@ -583,6 +594,7 @@ class QueryServerCore:
         self.port = self._tcp.port
 
     def stop(self) -> None:
+        self.closed = True  # unwedge handler threads parked on answers
         if self._server is not None:
             self._server.stop(grace=0.5)
             self._server = None

@@ -965,6 +965,17 @@ class TensorFilter(TransformElement):
         }
         if self._swapper is not None:
             info.update(self._swapper.snapshot())
+        # named-thread census (core/liveness.py ThreadBeat): the async
+        # feed's reaper + staging-lane workers are part of the health
+        # story — a wedged one shows alive=True with a growing age
+        from ..core.liveness import thread_census
+
+        win = self._inflight
+        lane = self._lane
+        info["threads"] = thread_census(
+            win.heartbeat if win is not None else None,
+            lane.heartbeat if lane is not None else None,
+        )
         return info
 
     def metrics_info(self):

@@ -44,12 +44,19 @@ from typing import Any, Dict
 import numpy as np
 
 from ..core.buffer import BatchFrame
+from ..core.continuity import (
+    RESUME_REJECT_META,
+    RESUME_REQ_META,
+    prompt_digest,
+    resume_signature,
+)
 from ..core.liveness import (
     DEADLINE_META,
     PRIORITY_MAX,
     PRIORITY_META,
     TENANT_META,
     clamp_priority,
+    thread_census,
 )
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import Element, ElementError, Property, element
@@ -109,6 +116,8 @@ class TensorGenerator(Element):
         self._max_seq = 0
         self._jit_chunks: "OrderedDict[int, Any]" = OrderedDict()
         self._engine = None
+        self._resume_sig = None   # token-sequence signature (slotted)
+        self._resume_rejects = 0  # RESUME requests refused (mismatch)
 
     def start(self):
         import jax
@@ -133,7 +142,26 @@ class TensorGenerator(Element):
         if slots > 0:
             from ..core.slots import SimSlotModel, SlotEngine
 
-            if props.get("sim", "") not in ("", "0", "false"):
+            sim = props.get("sim", "") not in ("", "0", "false")
+            # stream continuity: the signature covers everything that
+            # determines the TOKEN sequence — two servers may serve the
+            # same stream iff it matches (chunk size and sim timing
+            # knobs deliberately excluded: they shape latency, not
+            # tokens)
+            max_new = int(self.props["max-new"])
+            if sim:
+                self._resume_sig = resume_signature(
+                    "sim", vocab=int(props.get("vocab", "997")),
+                    max_new=max_new)
+            else:
+                self._resume_sig = resume_signature(
+                    "zoo", max_new=max_new, **{
+                        k: props.get(k, "")
+                        for k in ("vocab", "d_model", "heads", "layers",
+                                  "d_ff", "seq", "seed", "gen_seed",
+                                  "temperature", "top_k")
+                    })
+            if sim:
                 # async-sim proxy (PR-6 discipline): deterministic token
                 # recurrence + TPU-shaped step costs — drives the slot
                 # SCHEDULER through the full pipeline without a model
@@ -163,6 +191,7 @@ class TensorGenerator(Element):
                 prefill_priority=int(self.props["prefill-priority"]),
                 token_budget_s=float(self.props["token-budget-s"]),
                 name=self.name,
+                resume_sig=self._resume_sig,
             )
             self._engine.start()
             return
@@ -212,10 +241,19 @@ class TensorGenerator(Element):
         (ONE export path; metrics_info here would double-emit the same
         series).  ``gen_jit_buckets`` counts live decode-chunk compile
         buckets on BOTH paths, so retrace churn is visible."""
-        info: Dict[str, Any] = {"gen_jit_buckets": len(self._jit_chunks)}
+        info: Dict[str, Any] = {
+            "gen_jit_buckets": len(self._jit_chunks),
+            # both paths refuse resumes they cannot validate (the
+            # pre-slot path refuses ALL of them)
+            "gen_resume_rejects": self._resume_rejects,
+        }
         if self._engine is not None:
             info.update(self._engine.snapshot())
             info["gen_jit_buckets"] += len(self._jit_chunks)
+            # named-thread census: the pump's liveness is part of the
+            # health story (a wedged pump fires an incident from
+            # handle_idle; the census makes it visible between polls)
+            info["threads"] = thread_census(self._engine.heartbeat)
         return info
 
     # -- continuous-batching hooks ------------------------------------------
@@ -226,10 +264,36 @@ class TensorGenerator(Element):
 
     def handle_idle(self):
         """Drain chunks the engine completed since the last call —
-        emission happens HERE, on the dispatch thread."""
-        if self._engine is None:
+        emission happens HERE, on the dispatch thread.  Doubling as the
+        pump's liveness check: a pump that holds work but stopped
+        beating is WEDGED (stuck inside a device call) — surface it as
+        a flight-recorder incident NOW instead of waiting for a sticky
+        error that a hung thread can never raise."""
+        eng = self._engine
+        if eng is None:
             return []
-        return self._engine.pop_ready()
+        if eng.pending() > 0 and eng.heartbeat.check_stall(busy=True):
+            self.log.warning(
+                "slot pump %s wedged: no heartbeat for %.1fs with %d "
+                "stream(s)/chunk(s) pending", eng.heartbeat.name,
+                eng.heartbeat.age_s(), eng.pending(),
+            )
+            p = self._pipeline
+            if p is not None:
+                p.incident(
+                    "thread_stall", self.name,
+                    f"{eng.heartbeat.name} wedged "
+                    f"({eng.heartbeat.age_s():.1f}s, "
+                    f"pending={eng.pending()})")
+        return eng.pop_ready()
+
+    def note_stream_drain(self) -> None:
+        """The query serversrc of this pipeline entered its drain
+        (rolling restart): hand live generation streams off as
+        resumable GOAWAY chunks so clients migrate them instead of the
+        drain racing its deadline against whole generations."""
+        if self._engine is not None:
+            self._engine.begin_goaway()
 
     def note_stream_cancel(self, meta: Dict[str, Any]) -> None:
         """Downstream feedback (serversink): the consumer of this stream
@@ -272,10 +336,29 @@ class TensorGenerator(Element):
 
             def multi():
                 for lf in logical:
-                    yield from self._stream_one(lf)
+                    rej = self._refuse_unslotted_resume(lf)
+                    if rej is not None:
+                        yield rej
+                    else:
+                        yield from self._stream_one(lf)
 
             return multi()
+        rej = self._refuse_unslotted_resume(frame)
+        if rej is not None:
+            return [rej]
         return self._stream_one(frame)
+
+    def _refuse_unslotted_resume(self, lf):
+        """A RESUME request landing on a pre-slot (slots=0) generator
+        must be REFUSED with the typed reject, never served: this path
+        has no checkpoint validation, so silently replaying the prompt
+        from token 0 under a possibly-different config would corrupt
+        the client's exactly-once ledger without any error (durable
+        streams require slots >= 1)."""
+        if lf.meta.get(RESUME_REQ_META) is None:
+            return None
+        return self._resume_reject(
+            lf, "resume requires a slotted generator (slots >= 1)")
 
     def _validated_prompt(self, frame, max_new: int) -> np.ndarray:
         prompt = np.asarray(frame.tensors[0])
@@ -298,10 +381,13 @@ class TensorGenerator(Element):
     def _handle_slotted(self, frame):
         """Submit the prompt(s) to the slot engine and drain whatever
         chunks are already ready — new prompts JOIN live decoding at the
-        next token boundary instead of queueing behind it."""
+        next token boundary instead of queueing behind it.  A frame
+        carrying :data:`RESUME_REQ_META` re-joins a checkpointed stream
+        (validated below) instead of starting a fresh one."""
         max_new = int(self.props["max-new"])
         chunk = max(1, int(self.props["chunk"]))
         logical = frame.split() if isinstance(frame, BatchFrame) else [frame]
+        rejects = []
         for lf in logical:
             prompt = self._validated_prompt(lf, max_new)
             if prompt.shape[0] != 1:
@@ -315,14 +401,69 @@ class TensorGenerator(Element):
             if max_new <= 0:
                 continue
             meta = lf.meta
+            resume = None
+            rs = meta.get(RESUME_REQ_META)
+            if rs is not None:
+                resume, reason = self._check_resume(
+                    lf, prompt, max_new, rs)
+                if resume is None:
+                    rejects.append(self._resume_reject(lf, reason))
+                    continue
             self._engine.submit(
                 lf, prompt.astype(np.int32), max_new, chunk,
                 tenant=str(meta.get(TENANT_META, "") or ""),
                 priority=clamp_priority(
                     meta.get(PRIORITY_META, PRIORITY_MAX)),
                 deadline_ts=meta.get(DEADLINE_META),
+                resume=resume,
             )
-        return self._engine.pop_ready()
+        return rejects + self._engine.pop_ready()
+
+    def _check_resume(self, lf, prompt, max_new: int, rs):
+        """Validate one RESUME request against THIS server's token
+        signature and the prompt it arrived with.  Returns
+        ``(engine_resume_dict, None)`` or ``(None, reason)`` — a
+        mismatch is a per-stream typed refusal, never a pipeline
+        error."""
+        try:
+            sig = str(rs["sig"])
+            r = int(rs["tokens_done"])
+        except (KeyError, TypeError, ValueError):
+            return None, "malformed resume state"
+        if sig != self._resume_sig:
+            return None, "model/sampling signature mismatch"
+        if str(rs.get("digest", "")) != prompt_digest(
+                prompt.astype(np.int32)):
+            return None, "prompt digest mismatch"
+        if not 0 <= r < max_new:
+            return None, f"tokens_done {r} outside [0, {max_new})"
+        if r == 0:
+            return {"tokens_done": 0}, None
+        if len(lf.tensors) < 2:
+            return None, "resume request lacks the prefix tensor"
+        prefix = np.asarray(lf.tensors[1])
+        if prefix.ndim == 1:
+            prefix = prefix[None]
+        if (prefix.ndim != 2 or prefix.shape != (1, r)
+                or prefix.dtype.kind not in "iu"):
+            return None, (
+                f"prefix {prefix.shape} {prefix.dtype} != (1, {r}) int")
+        return {"tokens_done": r,
+                "prefix": prefix.astype(np.int32)}, None
+
+    def _resume_reject(self, lf, reason: str):
+        """Typed terminal refusal of one RESUME request: the stream gets
+        a tensor-less final chunk naming the reason (the client counts
+        a resume failure and tries another server); the server pipeline
+        — and the other streams it is decoding — survive."""
+        self._resume_rejects += 1
+        self.log.warning("resume refused: %s", reason)
+        out = lf.with_tensors([])
+        out.meta.update(
+            stream_seq=lf.seq, chunk_index=0, tokens_done=0, final=True,
+        )
+        out.meta[RESUME_REJECT_META] = reason
+        return (0, out)
 
     def _stream_one(self, frame):
         prompt = self._validated_prompt(frame, int(self.props["max-new"]))

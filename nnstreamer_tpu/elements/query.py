@@ -47,6 +47,7 @@ from ..core.routing import (
 from ..core.resilience import (
     CircuitBreaker,
     CircuitOpenError,
+    RemoteApplicationError,
     RetryPolicy,
     is_remote_application_error,
 )
@@ -326,6 +327,14 @@ class TensorQueryServerSrc(SourceElement):
                     or (p is not None and p.draining)):
                 self._lc_state = "draining"
                 core.begin_drain()
+                # stream handoff (Documentation/resilience.md "Stream
+                # continuity"): live generation streams are flushed as
+                # resumable GOAWAY chunks so clients MIGRATE them —
+                # the drain below then waits for the handoffs to
+                # deliver (they hold their admission slot until the
+                # final chunk is out), bounded by drain-deadline
+                if p is not None:
+                    p.stream_drain_feedback()
                 # tell the discovery plane FIRST: clients that re-rank
                 # remotes off the broker stop picking this host without
                 # paying a GOAWAY round trip each
@@ -432,6 +441,20 @@ class _PoolState:
         self.gen = gen
         self.epoch = epoch
         self.down_until: dict = {}
+
+
+class _StreamInterrupt(Exception):
+    """Internal control flow of the stream-continuity layer: one
+    transport attempt of a RESUMABLE stream ended without the stream
+    completing.  ``kind`` distinguishes a crash (``"break"``: breaker/
+    cooldown already recorded), a draining server's planned handoff
+    (``"handoff"``: breaker-immune), and a server refusing the resume
+    (``"reject"``); ``cause`` is what surfaces if the budget runs out."""
+
+    def __init__(self, cause: BaseException, kind: str):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.kind = kind
 
 
 @element("tensor_query_client")
@@ -567,6 +590,28 @@ class TensorQueryClient(Element):
             "produces them until a final-flagged one arrives — remote "
             "streaming generation; incompatible with wire-batch > 1",
         ),
+        # stream continuity (Documentation/resilience.md): a generation
+        # stream outlives the server it started on — chunks from slotted
+        # tensor_generator servers carry resume state, so a mid-stream
+        # break re-routes a RESUME request (prompt + delivered prefix)
+        # to a healthy server, with per-chunk sequence numbers deduping
+        # the overlap (delivered tokens exactly-once, bit-identical to
+        # an uninterrupted run)
+        "stream-resume": Property(
+            bool, True,
+            "resume a broken generation stream on another server from "
+            "its delivered-token checkpoint, and migrate streams a "
+            "draining server hands off with resumable GOAWAY chunks; "
+            "false = legacy no-replay semantics (a mid-stream break "
+            "surfaces as an error).  Only streams whose chunks carry "
+            "resume state participate"),
+        "resume-retries": Property(
+            int, 3,
+            "consecutive resume attempts without progress before a "
+            "stream gives up (each delivered chunk refills the budget, "
+            "so long streams survive repeated rolling restarts); "
+            "exhaustion fires a flight-recorder incident and surfaces "
+            "the original break"),
         "connect-type": Property(
             str, "grpc",
             "transport: grpc (interop default) | tcp (zero-copy raw TCP "
@@ -607,6 +652,13 @@ class TensorQueryClient(Element):
         self._corruption_detected = 0  # corrupt exchanges (request or reply)
         self._delivered = 0  # logical frames answered by a server
         self._retried = 0  # extra attempts dispatched (all causes)
+        # stream continuity (core/continuity.py), exact by the chaos
+        # acceptance contract: crash resumes vs planned migrations are
+        # distinct counters, dedupe is visible, failures are loud
+        self._stream_resumes = 0    # crash-initiated resumes issued
+        self._stream_migrations = 0  # drain handoffs migrated
+        self._duplicate_tokens_dropped = 0  # post-resume overlap deduped
+        self._resume_failures = 0   # resume attempts that failed
         self._retry_policy = RetryPolicy()  # rebuilt from props in start()
         # trace spans (core/telemetry.py): per-remote EWMA segment
         # aggregation — the live load signal the ewma routing policy
@@ -946,6 +998,10 @@ class TensorQueryClient(Element):
             "corruption_detected": self._corruption_detected,
             "delivered": self._delivered,
             "retried": self._retried,
+            "stream_resumes": self._stream_resumes,
+            "stream_migrations": self._stream_migrations,
+            "duplicate_tokens_dropped": self._duplicate_tokens_dropped,
+            "resume_failures": self._resume_failures,
             "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
         }
 
@@ -1329,6 +1385,27 @@ class TensorQueryClient(Element):
         with self._breakers_lock:
             self._retried += 1
 
+    def _note_stream_resume(self, migration: bool) -> None:
+        with self._breakers_lock:
+            if migration:
+                self._stream_migrations += 1
+            else:
+                self._stream_resumes += 1
+
+    def _note_resume_failure(self) -> None:
+        with self._breakers_lock:
+            self._resume_failures += 1
+
+    def _note_dup_tokens(self, n: int) -> None:
+        with self._breakers_lock:
+            self._duplicate_tokens_dropped += n
+
+    def _resume_armed(self, cont) -> bool:
+        """True when the continuity layer owns this stream's failures:
+        resume enabled AND the chunks seen so far carried resume
+        state."""
+        return bool(self.props["stream-resume"]) and cont.capable
+
     def _note_expired(self) -> TimeoutError:
         with self._breakers_lock:
             self._deadline_expired += 1
@@ -1663,14 +1740,115 @@ class TensorQueryClient(Element):
             return self._dispatch(frames[0])
         return self._dispatch(list(frames))
 
-    def _stream_invoke(self, frame, rediscovered: bool = False):
-        """One server-streaming request: healthy-first server order, whole
-        streams fail over only BEFORE the first answer arrives (a stream
-        broken mid-way surfaces as an error — replaying half a generation
-        could duplicate tokens at the consumer).  Topic mode recovers
-        elastically like the unary path: pre-first-answer failure of all
-        attempts refreshes the pool and retries once under the same
-        resend-safety contract."""
+    def _stream_invoke(self, frame):
+        """One LOGICAL server-streaming request across any number of
+        servers (Documentation/resilience.md "Stream continuity").
+
+        Transport attempts run in :meth:`_stream_attempt`.  When an
+        attempt of a RESUMABLE stream (chunks carry resume state) is
+        interrupted — mid-stream crash, a draining server's GOAWAY
+        handoff, or a resume rejection — the continuity ledger builds a
+        RESUME request from the original prompt plus the delivered
+        prefix and re-routes it through the normal healthy-first
+        ordering; the ledger dedupes the re-decoded overlap, so
+        delivered tokens stay exactly-once and bit-identical to an
+        uninterrupted run.  Progress refills the resume budget (a long
+        stream survives arbitrarily many rolling restarts); exhaustion
+        fires a flight-recorder incident and surfaces the break."""
+        import time as _time
+
+        from ..core.continuity import StreamContinuity
+
+        cont = StreamContinuity(frame)
+        budget = max(0, int(self.props["resume-retries"]))
+        left = budget
+        resuming = False
+        last_delivered = 0
+        req = frame
+        while True:
+            try:
+                yield from self._stream_attempt(req, cont)
+                return
+            except _StreamInterrupt as si:
+                if not self._resume_armed(cont):
+                    # stream-resume=false: legacy semantics — the
+                    # handoff/reject surfaces instead of resuming
+                    raise si.cause
+                progressed = cont.delivered > last_delivered
+                last_delivered = cont.delivered
+                counted = False
+                if si.kind == "reject":
+                    self._note_resume_failure()
+                    counted = True
+                elif (resuming and not progressed
+                      and si.kind == "break"):
+                    # the previous resume restored nothing before
+                    # breaking again — that attempt failed (a handoff
+                    # without progress is a planned migration, not a
+                    # failed resume)
+                    self._note_resume_failure()
+                    counted = True
+                if progressed:
+                    left = budget
+                if left <= 0 or self._stopped:
+                    if not counted:
+                        self._note_resume_failure()
+                    self.log.warning(
+                        "stream resume budget exhausted after %d "
+                        "delivered token(s); surfacing: %s",
+                        cont.delivered, si.cause)
+                    p = self._pipeline
+                    if p is not None:
+                        p.incident(
+                            "resume_exhausted", self.name,
+                            f"{cont.delivered} token(s) delivered; "
+                            f"cause: {si.cause}")
+                    raise si.cause
+                left -= 1
+                if si.kind == "break":
+                    # crash resumes are paced like failover attempts (a
+                    # fleet-wide outage must not spin); a planned
+                    # handoff migrates immediately
+                    delay = self._retry_policy.delay_for(budget - left)
+                    if delay > 0:
+                        _time.sleep(delay)
+                fresh_break = not resuming or progressed
+                resuming = True
+                try:
+                    req = cont.build_resume_frame()
+                except RuntimeError as e:
+                    self._note_resume_failure()
+                    self.log.warning("cannot build resume request: %s", e)
+                    raise si.cause from e
+                # exactly ONE count per logical recovery, so the fleet
+                # cross-check 'client resumes + migrations == engine
+                # gen_resumes' holds whenever resume_failures == 0: a
+                # reject retry and a break-retry of a no-progress
+                # resume continue the SAME recovery (already counted as
+                # a failure; a rejecting/unreached server never
+                # submits), while every handoff is its own migration
+                if si.kind == "handoff":
+                    self._note_stream_resume(migration=True)
+                elif si.kind == "break" and fresh_break:
+                    self._note_stream_resume(migration=False)
+
+    def _stream_attempt(self, frame, cont, rediscovered: bool = False):
+        """One transport attempt of a server-streaming request:
+        healthy-first server order, whole streams fail over only BEFORE
+        the first answer arrives.  Topic mode recovers elastically like
+        the unary path: pre-first-answer failure of all attempts
+        refreshes the pool and retries once under the same
+        resend-safety contract.
+
+        Mid-stream events route through ``cont`` (the stream-continuity
+        ledger): a crash is classified as remote ill-health (breaker +
+        cooldown) and then — for resumable streams — handed to
+        :meth:`_stream_invoke` as a :class:`_StreamInterrupt`; a
+        draining server's resumable GOAWAY handoff chunk is a planned
+        migration (breaker-immune, brief deprioritization only, never
+        the crash cooldown); non-resumable streams keep the legacy
+        semantics (a mid-stream break surfaces as an error — replaying
+        half a generation blind could duplicate tokens)."""
         import time as _time
 
         ps = self._pstate  # snapshot (same contract as _invoke_failover)
@@ -1718,6 +1896,7 @@ class TensorQueryClient(Element):
                     expired_terminal = True
                     break
                 addr_i = ps.addrs[i]
+                reject = None
                 self._inflight_begin(addr_i)
                 try:
                     for ans in conn.invoke_stream(frame, req_timeout):
@@ -1731,9 +1910,51 @@ class TensorQueryClient(Element):
                             # tokens — count the blown budget without
                             # discarding what already decoded
                             self._note_expired()
-                        yield (0, ans)
+                        # stream continuity: the ledger dedupes
+                        # post-resume overlap, keeps the downstream
+                        # chunk numbering contiguous across servers,
+                        # and spots handoff/reject markers; chunks
+                        # without resume state pass through untouched
+                        v = cont.accept(ans)
+                        if v.dup:
+                            self._note_dup_tokens(v.dup)
+                        if v.reject is not None:
+                            reject = v.reject
+                            break
+                        if v.emit is not None:
+                            yield (0, v.emit)
                 finally:
                     self._inflight_end(addr_i)
+                if reject is not None:
+                    # this server REFUSED the resume with a typed
+                    # terminal chunk (signature/digest mismatch): the
+                    # framing stayed aligned and the server is healthy
+                    # — another server may still match
+                    if breaker is not None:
+                        breaker.record_success()
+                    # handoff/reject markers only exist on resumable
+                    # chunks: ALWAYS route through the continuity
+                    # wrapper (it surfaces the cause when stream-resume
+                    # is off) — raising the bare error here would be
+                    # caught by the pre-first-answer handlers below and
+                    # silently replay a half-delivered stream
+                    raise _StreamInterrupt(RemoteApplicationError(
+                        f"resume refused by {conn.addr}: {reject}"),
+                        "reject")
+                if cont.take_handoff():
+                    # live migration: the draining server flushed this
+                    # stream as a resumable final chunk.  A PLANNED
+                    # restart, not a failure — breaker records health
+                    # and the host is only briefly deprioritized (the
+                    # unary-GOAWAY treatment), never the crash path's
+                    # 10s cooldown or breaker failure
+                    if breaker is not None:
+                        breaker.record_success()
+                    ps.down_until[i] = _time.monotonic() + min(
+                        float(timeout), 5.0)
+                    raise _StreamInterrupt(ServerGoawayError(
+                        f"{conn.addr} handed the stream off mid-"
+                        "generation (draining)"), "handoff")
                 if breaker is not None:
                     # success is recorded on clean COMPLETION (empty
                     # streams included — a half-open probe slot must not
@@ -1743,6 +1964,8 @@ class TensorQueryClient(Element):
                     breaker.record_success()
                 self._note_delivered(1)
                 return
+            except _StreamInterrupt:
+                raise  # continuity control flow, classified above
             except ServerGoawayError as e:
                 # rolling restart: only ever raised BEFORE the first
                 # answer (refused pre-ingest) — immediate unpaced
@@ -1794,15 +2017,22 @@ class TensorQueryClient(Element):
                     # failed verification): counted like the unary path
                     self._note_corruption()
                 if started:
-                    # mid-stream break: no safe replay — but it IS a
-                    # health signal; without recording it, a server that
-                    # repeatedly dies mid-stream keeps winning the
-                    # healthy-first ordering over an actually-good one
+                    # mid-stream break: a health signal either way (a
+                    # server that repeatedly dies mid-stream must stop
+                    # winning the healthy-first ordering), so breaker +
+                    # crash cooldown are recorded FIRST.  With resume
+                    # state armed there now IS a safe replay: the
+                    # continuity ledger re-prefills the delivered
+                    # prefix elsewhere and dedupes the overlap — only
+                    # streams without resume state keep the legacy
+                    # no-replay error
                     if not is_remote_application_error(e):
                         if breaker is not None:
                             breaker.record_failure()
                         ps.down_until[i] = _time.monotonic() + min(
                             float(timeout), 10.0)
+                    if self._resume_armed(cont):
+                        raise _StreamInterrupt(e, "break") from e
                     raise
                 err = e
                 # short cooldown (10s cap): the stream timeout is
@@ -1816,7 +2046,7 @@ class TensorQueryClient(Element):
         if err is None:
             err = open_err  # only breaker refusals happened (or nothing)
         if expired_terminal:
-            raise err  # no answer can matter anymore: no rediscover/resend
+            raise err  # no answer can matter anymore: no rediscover/resume
         if err is not None and not rediscovered:
             safe = (
                 self.props["retries"] > 0
@@ -1827,9 +2057,17 @@ class TensorQueryClient(Element):
                                     ServerGoawayError, WireError))
             )
             if self._rediscover(ps) and safe:
-                yield from self._stream_invoke(frame, rediscovered=True)
+                yield from self._stream_attempt(frame, cont,
+                                                rediscovered=True)
                 return
-        raise err if err is not None else RuntimeError("no servers")
+        if err is None:
+            raise RuntimeError("no servers")
+        if self._resume_armed(cont) and cont.delivered > 0:
+            # a RESUME attempt died before its first answer: the stream
+            # still holds delivered tokens — hand control back to the
+            # budget-paced continuity loop instead of killing it
+            raise _StreamInterrupt(err, "break")
+        raise err
 
     def _note_degraded(self, n: int, mode: str, err: BaseException) -> None:
         """Shared degrade bookkeeping (unary + stream paths): counter,

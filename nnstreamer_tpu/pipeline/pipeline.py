@@ -1196,6 +1196,24 @@ class Pipeline:
                             "note_stream_cancel failed for %s", up.name)
                 stack.append(up.name)
 
+    def stream_drain_feedback(self) -> None:
+        """A query serversrc of THIS pipeline entered its rolling-restart
+        drain: tell every element exposing ``note_stream_drain()`` (the
+        continuous-batching generator) so live generation streams are
+        handed off as resumable GOAWAY chunks — the client migrates them
+        to a healthy server — instead of the drain-deadline racing whole
+        generations.  Never fired by a plain ``drain()`` on a pipeline
+        without a serversrc: local streams flush, they don't migrate."""
+        for el in self.elements.values():
+            note = getattr(el, "note_stream_drain", None)
+            if note is None:
+                continue
+            try:
+                note()
+            except Exception:
+                self.log.exception(
+                    "note_stream_drain failed for %s", el.name)
+
     def _dead_letter(self, el: Element, frames, err: BaseException) -> None:
         """skip policy: record dropped frame(s) + bus warning."""
         h = self.health_map[el.name]

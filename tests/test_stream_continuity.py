@@ -1,0 +1,961 @@
+"""Durable generation streams: checkpointed resume and live migration
+across server death (core/continuity.py + SlotEngine resume/goaway +
+the query client's stream-continuity layer).
+
+Oracles:
+
+* REAL model — a stream killed at a chunk boundary and RESUMED on a
+  fresh engine (prompt + prefix re-prefilled through the chunked-prefill
+  path) must be BIT-IDENTICAL to an uninterrupted run, greedy AND
+  seeded top-k (the per-step key folds at the absolute token index).
+* SIM model — token 1 = ``sum(prompt) % vocab``, token j+1 =
+  ``(31 t_j + 17) % vocab``: exact end-to-end accounting through kills,
+  drains, and migrations without model cost.
+* LEDGER — per-chunk ``tokens_done`` sequence numbers dedupe the
+  post-resume overlap exactly: delivered tokens are exactly-once, the
+  downstream chunk numbering contiguous, duplicates counted.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.continuity import (
+    GOAWAY_META,
+    RESUME_META,
+    RESUME_REJECT_META,
+    RESUME_REQ_META,
+    StreamContinuity,
+    prompt_digest,
+    resume_signature,
+)
+from nnstreamer_tpu.core.liveness import ThreadBeat, thread_census
+from nnstreamer_tpu.core.slots import SimSlotModel, SlotEngine
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+PROPS = {
+    "dtype": "float32", "vocab": 61, "d_model": 32, "heads": 2,
+    "layers": 2, "d_ff": 64, "seq": 64, "seed": 11,
+}
+SAMPLING = {"temperature": "0.8", "top_k": "7", "gen_seed": "3"}
+
+
+def sim_oracle(vocab, prompt, n):
+    sim = SimSlotModel(1, vocab=vocab)
+    t = int(prompt.sum()) % vocab
+    out = [t]
+    for _ in range(n - 1):
+        t = sim.step_token(t)
+        out.append(t)
+    return np.asarray([out], np.int32)
+
+
+def _drain_engine(engine, timeout=60.0):
+    """Collect emitted frames until a final one (or timeout)."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out.extend(f for _, f in engine.pop_ready())
+        if out and out[-1].meta.get("final"):
+            return out
+        time.sleep(0.002)
+    raise TimeoutError(f"engine produced no final chunk ({len(out)} frames)")
+
+
+def _tokens(frames):
+    parts = [np.asarray(f.tensors[0]) for f in frames if f.tensors]
+    return (np.concatenate(parts, axis=1) if parts
+            else np.zeros((1, 0), np.int32))
+
+
+def _chunk(prompt, toks, idx, done, final=False, goaway=False,
+           sig="S", chunk=4, extra=None):
+    """Fabricate one resumable wire chunk the way the engine emits it."""
+    f = TensorFrame([np.asarray(toks, np.int32)] if toks is not None
+                    else [])
+    f.meta.update(stream_seq=7, chunk_index=idx, tokens_done=done,
+                  final=final)
+    f.meta[RESUME_META] = {
+        "v": 1, "sig": sig, "digest": prompt_digest(prompt),
+        "chunk": chunk,
+    }
+    if goaway:
+        f.meta[GOAWAY_META] = True
+        f.meta["evicted"] = "goaway"
+    if extra:
+        f.meta.update(extra)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# The client-side ledger: dedupe exactness, renumbering, resume frames
+# ---------------------------------------------------------------------------
+class TestContinuityLedger:
+    def test_non_resumable_chunks_pass_through_untouched(self):
+        req = TensorFrame([np.int32([[1, 2]])])
+        cont = StreamContinuity(req)
+        ans = TensorFrame([np.float32([3.0])], meta={"final": True})
+        v = cont.accept(ans)
+        assert v.emit is ans and v.finished and not cont.capable
+        assert v.dup == 0 and not v.handoff
+
+    def test_dedupe_exactness_across_a_handoff(self):
+        """The issue's exactly-once contract, pinned deterministically:
+        chunks 0-1 delivered, a handoff flushes 2 partial tokens, the
+        resume snaps DOWN to the chunk boundary, and the resumed
+        server's overlapping chunk is trimmed to exactly the new
+        tokens — contiguous downstream indices, exact dup count."""
+        prompt = np.int32([[5, 6, 7]])
+        oracle = np.arange(100, 114, dtype=np.int32)[None]  # 14 tokens
+        req = TensorFrame([prompt])
+        cont = StreamContinuity(req)
+        emitted = []
+
+        def feed(*a, **kw):
+            v = cont.accept(_chunk(prompt, *a, **kw))
+            if v.emit is not None:
+                emitted.append(v.emit)
+            return v
+
+        feed(oracle[:, 0:4], 0, 4)
+        feed(oracle[:, 4:8], 1, 8)
+        assert cont.capable and cont.delivered == 8
+        # handoff: 2 partial tokens past the boundary ride the final
+        v = feed(oracle[:, 8:10], 2, 10, final=True, goaway=True)
+        assert v.handoff and not v.finished and cont.take_handoff()
+        assert cont.delivered == 10 and cont.resume_point() == 8
+        rf = cont.build_resume_frame()
+        rs = rf.meta[RESUME_REQ_META]
+        assert rs["tokens_done"] == 8 and rs["chunk"] == 4
+        assert rs["digest"] == prompt_digest(prompt)
+        np.testing.assert_array_equal(rf.tensors[0], prompt)
+        np.testing.assert_array_equal(rf.tensors[1], oracle[:, :8])
+        # resumed server re-decodes from token 9: its first chunk
+        # overlaps the 2 delivered partials -> trimmed exactly
+        v = feed(oracle[:, 8:12], 2, 12)
+        assert v.dup == 2 and cont.duplicates_dropped == 2
+        np.testing.assert_array_equal(
+            np.asarray(v.emit.tensors[0]), oracle[:, 10:12])
+        assert v.emit.meta["tokens_done"] == 12
+        v = feed(oracle[:, 12:14], 3, 14, final=True)
+        assert v.finished
+        # downstream view: contiguous indices, exactly-once tokens
+        assert [f.meta["chunk_index"] for f in emitted] == [0, 1, 2, 3, 4]
+        np.testing.assert_array_equal(_tokens(emitted), oracle)
+        assert all(f.meta.get("stream_seq") == 7 for f in emitted)
+        assert GOAWAY_META not in emitted[2].meta
+
+    def test_fully_duplicate_chunk_drops_silently(self):
+        prompt = np.int32([[1]])
+        cont = StreamContinuity(TensorFrame([prompt]))
+        oracle = np.arange(8, dtype=np.int32)[None]
+        cont.accept(_chunk(prompt, oracle[:, :4], 0, 4))
+        cont.accept(_chunk(prompt, oracle[:, 4:8], 1, 8))
+        v = cont.accept(_chunk(prompt, oracle[:, 4:8], 1, 8))
+        assert v.emit is None and v.dup == 4
+        assert cont.delivered == 8 and cont.duplicates_dropped == 4
+
+    def test_reject_chunk_classified(self):
+        prompt = np.int32([[1]])
+        cont = StreamContinuity(TensorFrame([prompt]))
+        f = TensorFrame([])
+        f.meta[RESUME_REJECT_META] = "signature mismatch"
+        v = cont.accept(f)
+        assert v.reject == "signature mismatch" and v.emit is None
+
+    def test_incoherent_ledger_refuses_to_resume(self):
+        """A gapped token ledger can no longer guarantee exactly-once:
+        build_resume_frame must refuse loudly, never resume wrong."""
+        prompt = np.int32([[1]])
+        cont = StreamContinuity(TensorFrame([prompt]))
+        oracle = np.arange(12, dtype=np.int32)[None]
+        cont.accept(_chunk(prompt, oracle[:, :4], 0, 4))
+        # chunk 2 arrives with a tokens_done GAP (chunk 1 lost)
+        cont.accept(_chunk(prompt, oracle[:, 8:12], 2, 12))
+        with pytest.raises(RuntimeError, match="incoherent"):
+            cont.build_resume_frame()
+        assert not cont.capable
+
+
+# ---------------------------------------------------------------------------
+# Engine-level resume: bit-parity matrix (kill at every chunk boundary)
+# ---------------------------------------------------------------------------
+class TestEngineResumeParity:
+    def _oracle_sim(self, prompt, max_new, chunk):
+        m = SimSlotModel(2, step_base_ms=0.05)
+        e = SlotEngine(m, None, max_seq=1 << 20, chunk=chunk,
+                       resume_sig="SIG")
+        e.start()
+        try:
+            e.submit(TensorFrame([prompt]), prompt, max_new, chunk)
+            return _tokens(_drain_engine(e))
+        finally:
+            e.stop()
+
+    def test_sim_resume_bit_parity_every_point(self):
+        """Resume from EVERY possible delivered count 1..max_new-1 (the
+        client snaps to boundaries, but the engine contract is general):
+        suffix bit-identical, meta counters continue from R."""
+        prompt = np.arange(4, dtype=np.int32)[None]
+        max_new, chunk = 16, 4
+        oracle = self._oracle_sim(prompt, max_new, chunk)
+        assert oracle.shape[1] == max_new
+        for r in range(1, max_new):
+            m = SimSlotModel(2, step_base_ms=0.05)
+            e = SlotEngine(m, None, max_seq=1 << 20, chunk=chunk,
+                           resume_sig="SIG")
+            e.start()
+            try:
+                e.submit(
+                    TensorFrame([prompt]), prompt, max_new, chunk,
+                    resume={"tokens_done": r, "prefix": oracle[:, :r]})
+                frames = _drain_engine(e)
+            finally:
+                e.stop()
+            got = _tokens(frames)
+            np.testing.assert_array_equal(got, oracle[:, r:],
+                                          err_msg=f"resume at {r}")
+            assert frames[-1].meta["tokens_done"] == max_new
+            assert frames[0].meta[RESUME_META]["sig"] == "SIG"
+            assert e.resumes == 1
+
+    @pytest.mark.parametrize("extra", [None, SAMPLING],
+                             ids=["greedy", "seeded-topk"])
+    def test_zoo_resume_bit_parity_every_boundary(self, rng, extra):
+        """REAL transformer: kill at every chunk boundary x {greedy,
+        seeded top-k}; the resumed engine re-prefills prompt + prefix
+        through the chunked-prefill path and the remaining tokens are
+        BIT-IDENTICAL (per-step key folded at the absolute index)."""
+        from nnstreamer_tpu.models.transformer import build_slot_stream
+
+        props = {k: str(v) for k, v in PROPS.items()}
+        if extra:
+            props.update(extra)
+        prompt = rng.integers(0, 61, (1, 6)).astype(np.int32)
+        max_new, chunk = 12, 4
+
+        def engine():
+            model, params, max_seq = build_slot_stream(props, 2)
+            return SlotEngine(model, params, max_seq=max_seq,
+                              chunk=chunk, resume_sig="Z")
+
+        e = engine()
+        e.start()
+        try:
+            e.submit(TensorFrame([prompt]), prompt, max_new, chunk)
+            oracle = _tokens(_drain_engine(e))
+        finally:
+            e.stop()
+        assert oracle.shape[1] == max_new
+        # every chunk boundary + one non-boundary point (engine general)
+        for r in [chunk, 2 * chunk, 6]:
+            e = engine()
+            e.start()
+            try:
+                e.submit(
+                    TensorFrame([prompt]), prompt, max_new, chunk,
+                    resume={"tokens_done": r, "prefix": oracle[:, :r]})
+                got = _tokens(_drain_engine(e))
+            finally:
+                e.stop()
+            np.testing.assert_array_equal(got, oracle[:, r:],
+                                          err_msg=f"resume at {r}")
+
+    def test_goaway_handoff_resumable_chunk(self):
+        """A drain flushes live streams as resumable GOAWAY final
+        chunks: partial tokens + resume state, no deadline_expired
+        marker, slot freed, counters exact."""
+        prompt = np.arange(4, dtype=np.int32)[None]
+        m = SimSlotModel(2, step_base_ms=3.0)
+        e = SlotEngine(m, None, max_seq=1 << 20, chunk=4,
+                       resume_sig="SIG")
+        e.start()
+        try:
+            e.submit(TensorFrame([prompt]), prompt, 64, 4)
+            deadline = time.monotonic() + 10
+            while e.tokens_total < 8 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            e.begin_goaway()
+            frames = _drain_engine(e)
+            last = frames[-1]
+            assert last.meta.get(GOAWAY_META) is True
+            assert last.meta["final"] is True
+            assert "deadline_expired" not in last.meta
+            assert last.meta[RESUME_META]["sig"] == "SIG"
+            got = _tokens(frames)
+            oracle = sim_oracle(m.vocab, prompt, 64)
+            np.testing.assert_array_equal(
+                got, oracle[:, :got.shape[1]])
+            assert 0 < got.shape[1] < 64
+            assert e.goaway_evicted == 1
+            snap = e.snapshot()
+            assert snap["gen_occupied"] == 0
+            assert snap["gen_goaway_evicted"] == 1
+            # a stream submitted DURING the drain hands off too
+            e.submit(TensorFrame([prompt]), prompt, 64, 4)
+            frames2 = _drain_engine(e)
+            assert frames2[-1].meta.get(GOAWAY_META) is True
+            assert e.goaway_evicted == 2
+        finally:
+            e.stop()
+
+    def test_legacy_engine_without_sig_ignores_goaway(self):
+        """No resume signature armed -> no handoff (a chunk the client
+        cannot resume would silently truncate the stream): streams
+        finish in place."""
+        prompt = np.arange(3, dtype=np.int32)[None]
+        m = SimSlotModel(1, step_base_ms=0.2)
+        e = SlotEngine(m, None, max_seq=1 << 20, chunk=4)
+        e.start()
+        try:
+            e.submit(TensorFrame([prompt]), prompt, 12, 4)
+            e.begin_goaway()  # warns + no-op
+            frames = _drain_engine(e)
+            assert _tokens(frames).shape[1] == 12
+            assert e.goaway_evicted == 0
+            assert RESUME_META not in frames[0].meta
+        finally:
+            e.stop()
+
+
+# ---------------------------------------------------------------------------
+# Client classification: crash vs drain-handoff (the satellite pin)
+# ---------------------------------------------------------------------------
+def _client(props=None):
+    from nnstreamer_tpu.elements.query import TensorQueryClient
+
+    q = TensorQueryClient("q")
+    q.set_property("stream", True)
+    q.set_property("timeout", 30.0)
+    q.set_property("retry-backoff", 0.0)
+    for k, v in (props or {}).items():
+        q.set_property(k, v)
+    q._stopped = False
+    return q
+
+
+PROMPT = np.arange(5, dtype=np.int32)[None]
+ORACLE = np.arange(200, 216, dtype=np.int32)[None]  # 16 "tokens"
+
+
+class _ResumeServer:
+    """Fake conn serving the ORACLE suffix from a RESUME request."""
+
+    def __init__(self, addr="good:2", sig="S", reject=None):
+        self.addr = addr
+        self.sig = sig
+        self.reject = reject
+        self.resume_reqs = []
+
+    def invoke_stream(self, frame, timeout):
+        rs = frame.meta.get(RESUME_REQ_META)
+        assert rs is not None, "expected a RESUME request"
+        self.resume_reqs.append(rs)
+        if self.reject is not None:
+            f = TensorFrame([])
+            f.meta.update(stream_seq=9, chunk_index=0, tokens_done=0,
+                          final=True)
+            f.meta[RESUME_REJECT_META] = self.reject
+            yield f
+            return
+        assert rs["sig"] == self.sig
+        r = int(rs["tokens_done"])
+        np.testing.assert_array_equal(
+            np.asarray(frame.tensors[1]), ORACLE[:, :r])
+        for i in range(r, 16, 4):
+            yield _chunk(PROMPT, ORACLE[:, i:i + 4], i // 4, i + 4,
+                         final=(i + 4 >= 16), sig=self.sig)
+
+
+class TestGoawayClassification:
+    def test_crash_vs_handoff_breaker_and_cooldown(self):
+        """THE satellite pin: a drain-initiated mid-stream break must
+        not burn the 10s crash cooldown or count as a breaker failure
+        the way a crash does — and both resume exactly-once."""
+        import time as _t
+
+        for kind in ("crash", "handoff"):
+            class Breaks:
+                addr = "bad:1"
+
+                def invoke_stream(self, frame, timeout):
+                    yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                    yield _chunk(PROMPT, ORACLE[:, 4:8], 1, 8)
+                    if kind == "crash":
+                        raise ConnectionResetError("server died")
+                    # drain handoff: 2 partial tokens + resume state
+                    yield _chunk(PROMPT, ORACLE[:, 8:10], 2, 10,
+                                 final=True, goaway=True)
+
+            from nnstreamer_tpu.elements.query import _PoolState
+
+            good = _ResumeServer()
+            q = _client({"breaker-threshold": 1})
+            ps = _PoolState((Breaks(), good),
+                            (("bad", 1), ("good", 2)), 0)
+            q._pstate = ps
+            t0 = _t.monotonic()
+            out = [f for _, f in q._stream_invoke(TensorFrame([PROMPT]))]
+            np.testing.assert_array_equal(_tokens(out), ORACLE)
+            assert [f.meta["chunk_index"] for f in out] == list(
+                range(len(out)))
+            h = q.health_info()
+            bad = h["breakers"].get("bad:1", {})
+            cool = ps.down_until.get(0, 0) - t0
+            if kind == "crash":
+                # crash: breaker failure (threshold 1 -> trip) + the
+                # 10s cooldown; counted as a RESUME
+                assert bad.get("trips") == 1
+                assert 8.0 < cool <= 10.5
+                assert h["stream_resumes"] == 1
+                assert h["stream_migrations"] == 0
+                assert h["duplicate_tokens_dropped"] == 0
+            else:
+                # handoff: breaker-immune (no failure, no trip), only
+                # the short draining deprioritization; counted as a
+                # MIGRATION; the 2 re-decoded partials deduped exactly
+                assert bad.get("trips", 0) == 0
+                assert 0 < cool <= 5.5
+                assert h["stream_migrations"] == 1
+                assert h["stream_resumes"] == 0
+                assert h["duplicate_tokens_dropped"] == 2
+            assert h["resume_failures"] == 0
+            # resume snapped DOWN to the chunk boundary either way
+            assert good.resume_reqs == [{
+                "v": 1, "sig": "S", "digest": prompt_digest(PROMPT),
+                "chunk": 4, "tokens_done": 8,
+            }]
+
+    def test_resume_disabled_keeps_legacy_no_replay(self):
+        class Breaks:
+            addr = "bad:1"
+
+            def invoke_stream(self, frame, timeout):
+                yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                raise ConnectionResetError("server died")
+
+        from nnstreamer_tpu.elements.query import _PoolState
+
+        q = _client({"stream-resume": False})
+        q._pstate = _PoolState((Breaks(), _ResumeServer()),
+                               (("bad", 1), ("good", 2)), 0)
+        with pytest.raises(ConnectionResetError):
+            list(q._stream_invoke(TensorFrame([PROMPT])))
+        h = q.health_info()
+        assert h["stream_resumes"] == 0 and h["resume_failures"] == 0
+
+    def test_reject_retry_counts_one_resume_not_two(self):
+        """The fleet cross-check 'client resumes + migrations == engine
+        gen_resumes' requires a retry after a REJECT to continue the
+        SAME logical resume — one break, one reject, one success must
+        count exactly ONE resume and ONE failure."""
+        from nnstreamer_tpu.elements.query import _PoolState
+
+        class Breaks:
+            addr = "bad:1"
+
+            def invoke_stream(self, frame, timeout):
+                yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                raise ConnectionResetError("server died")
+
+        rejecter = _ResumeServer(addr="rej:2", reject="sig mismatch")
+        good = _ResumeServer(addr="good:3")
+        q = _client()
+        q._pstate = _PoolState(
+            (Breaks(), rejecter, good),
+            (("bad", 1), ("rej", 2), ("good", 3)), 0)
+        out = [f for _, f in q._stream_invoke(TensorFrame([PROMPT]))]
+        np.testing.assert_array_equal(_tokens(out), ORACLE)
+        h = q.health_info()
+        assert h["stream_resumes"] == 1  # NOT one per reject retry
+        assert h["resume_failures"] == 1
+        assert len(rejecter.resume_reqs) == 1
+        assert len(good.resume_reqs) == 1
+
+    def test_failed_resume_attempt_not_recounted(self):
+        """A resume attempt that dies before reaching a server does NOT
+        bump stream_resumes again (it continues the same logical
+        recovery, already counted as a failure) — the client-vs-engine
+        cross-check stays exact."""
+        from nnstreamer_tpu.elements.query import _PoolState
+
+        class Breaks:
+            addr = "bad:1"
+
+            def invoke_stream(self, frame, timeout):
+                yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                raise ConnectionResetError("server died")
+
+        class Refuses:
+            addr = "dead:2"
+
+            def invoke_stream(self, frame, timeout):
+                raise ConnectionRefusedError("refused")
+
+        good = _ResumeServer(addr="good:3")
+        q = _client()
+        q._pstate = _PoolState(
+            (Breaks(), Refuses(), good),
+            (("bad", 1), ("dead", 2), ("good", 3)), 0)
+        out = [f for _, f in q._stream_invoke(TensorFrame([PROMPT]))]
+        np.testing.assert_array_equal(_tokens(out), ORACLE)
+        h = q.health_info()
+        assert h["stream_resumes"] == 1  # one logical recovery
+        assert h["resume_failures"] == 1  # the unreachable attempt
+        assert len(good.resume_reqs) == 1
+
+    def test_unslotted_generator_refuses_resume(self):
+        """A RESUME request landing on a pre-slot (slots=0) generator is
+        refused with the typed reject — the unvalidated path must never
+        silently replay under a possibly-different config."""
+        from nnstreamer_tpu.elements.generator import TensorGenerator
+
+        g = TensorGenerator("g")
+        g._prefill = object()  # "started", pre-slot path
+        f = TensorFrame([PROMPT])
+        f.meta[RESUME_REQ_META] = {
+            "v": 1, "sig": "x", "digest": "y", "chunk": 4,
+            "tokens_done": 4,
+        }
+        out = g.handle_frame(0, f)
+        assert len(out) == 1
+        rej = out[0][1]
+        assert "slotted" in rej.meta[RESUME_REJECT_META]
+        assert rej.meta["final"] is True and not rej.tensors
+        assert g.health_info()["gen_resume_rejects"] == 1
+
+    def test_handoff_with_resume_disabled_surfaces_goaway(self):
+        """stream-resume=false: a mid-stream handoff must SURFACE (the
+        legacy contract), never be silently replayed by the
+        pre-first-answer GOAWAY failover."""
+        from nnstreamer_tpu.core.lifecycle import ServerGoawayError
+        from nnstreamer_tpu.elements.query import _PoolState
+
+        class HandsOff:
+            addr = "bad:1"
+
+            def invoke_stream(self, frame, timeout):
+                yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                yield _chunk(PROMPT, ORACLE[:, 4:8], 1, 8,
+                             final=True, goaway=True)
+
+        q = _client({"stream-resume": False})
+        q._pstate = _PoolState((HandsOff(), _ResumeServer()),
+                               (("bad", 1), ("good", 2)), 0)
+        out = []
+        with pytest.raises(ServerGoawayError, match="handed the stream"):
+            for item in q._stream_invoke(TensorFrame([PROMPT])):
+                out.append(item)
+        # chunk 0 plus the handoff's tokens (delivered, never final):
+        # the error then tells the consumer the stream is dead
+        assert len(out) == 2
+        assert not out[-1][1].meta["final"]
+        h = q.health_info()
+        assert h["stream_migrations"] == 0
+
+    def test_resume_reject_budget_and_surfacing(self):
+        """Every healthy server refuses the resume (config mismatch):
+        the budget bounds the attempts, failures are counted, and the
+        refusal surfaces as the typed application error."""
+        from nnstreamer_tpu.core.resilience import RemoteApplicationError
+        from nnstreamer_tpu.elements.query import _PoolState
+
+        class Breaks:
+            addr = "bad:1"
+
+            def invoke_stream(self, frame, timeout):
+                yield _chunk(PROMPT, ORACLE[:, 0:4], 0, 4)
+                raise ConnectionResetError("server died")
+
+        rejecter = _ResumeServer(reject="signature mismatch")
+        q = _client({"resume-retries": 2})
+        q._pstate = _PoolState((Breaks(), rejecter),
+                               (("bad", 1), ("good", 2)), 0)
+        with pytest.raises(RemoteApplicationError, match="resume refused"):
+            list(q._stream_invoke(TensorFrame([PROMPT])))
+        h = q.health_info()
+        # interrupt 1 = the crash (a resume), then rejects until the
+        # budget (2) runs out
+        assert h["stream_resumes"] >= 1
+        assert h["resume_failures"] >= 2
+        assert len(rejecter.resume_reqs) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Pooled-socket hygiene after a mid-stream break (satellite)
+# ---------------------------------------------------------------------------
+class TestSocketHygiene:
+    def test_mid_stream_death_evicts_socket_never_repools(self):
+        """A socket whose stream died mid-chunk is desynced: it must be
+        EVICTED, never handed to the next unary request."""
+        from nnstreamer_tpu.distributed.tcp_query import (
+            TcpQueryConnection,
+            _T_QUERY,
+            _T_STREAM,
+            encode_msg,
+            parse_msg,
+        )
+        from nnstreamer_tpu.distributed.wire import decode_frame, encode_frame
+
+        ls = socket.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(4)
+        port = ls.getsockname()[1]
+        served = {"n": 0}
+
+        def serve():
+            while True:
+                try:
+                    c, _ = ls.accept()
+                except OSError:
+                    return
+                served["n"] += 1
+                try:
+                    data = c.recv(1 << 20)
+                    mtype, body, _ = parse_msg(data, version=1)
+                    if mtype == _T_STREAM:
+                        ans = decode_frame(bytes(body)).with_tensors(
+                            [np.int32([[1, 2]])])
+                        ans.meta.update(final=False, chunk_index=0,
+                                        tokens_done=2)
+                        c.sendall(encode_msg(
+                            _T_STREAM, encode_frame(ans), version=1))
+                        c.close()  # die mid-stream
+                    elif mtype == _T_QUERY:
+                        ans = decode_frame(bytes(body))
+                        c.sendall(encode_msg(
+                            _T_QUERY, encode_frame(ans), version=1))
+                        c.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        conn = TcpQueryConnection("127.0.0.1", port, timeout=5.0,
+                                  wire_version=1)
+        try:
+            frame = TensorFrame([np.int32([[9]])])
+            got = []
+            with pytest.raises((ConnectionError, OSError)):
+                for ans in conn.invoke_stream(frame, 5.0):
+                    got.append(ans)
+            assert len(got) == 1  # the one chunk before the death
+            # the desynced socket was evicted, not repooled
+            assert conn._free == [] and conn._live == 0
+            assert not conn._held
+            # the next unary request gets a FRESH dial and succeeds
+            ans = conn.invoke(frame, 5.0)
+            np.testing.assert_array_equal(
+                np.asarray(ans.tensors[0]), [[9]])
+            assert served["n"] >= 2  # provably a second connection
+        finally:
+            conn.close()
+            ls.close()
+
+
+# ---------------------------------------------------------------------------
+# Background-thread liveness (satellite)
+# ---------------------------------------------------------------------------
+class TestThreadLiveness:
+    def test_threadbeat_edge_triggered_stall(self):
+        clock = [0.0]
+        hb = ThreadBeat("pump", stall_after_s=1.0,
+                        clock=lambda: clock[0])
+        hb.beat()
+        assert not hb.check_stall(busy=True)
+        clock[0] = 2.5
+        assert hb.check_stall(busy=False) is False  # idle: never stalled
+        assert hb.check_stall(busy=True) is True    # wedged: fires ONCE
+        assert hb.check_stall(busy=True) is False   # edge-triggered
+        assert hb.stalls == 1
+        hb.beat()
+        assert not hb.check_stall(busy=True)        # beat re-arms
+        clock[0] = 4.0
+        assert hb.check_stall(busy=True) is True
+        assert hb.stalls == 2
+        snap = hb.snapshot()
+        assert snap["beats"] == 2 and snap["stalls"] == 2
+        assert snap["alive"] is False  # never bound to a thread
+
+    def test_wedged_pump_fires_incident(self):
+        """A pump stuck inside a device call never returns, so the
+        sticky pop_ready error can never surface — the element's idle
+        poll must detect the stale heartbeat and fire ONE incident."""
+        from nnstreamer_tpu.elements.generator import TensorGenerator
+
+        class WedgeModel(SimSlotModel):
+            def __init__(self):
+                super().__init__(1, step_base_ms=0.01)
+                self.release = threading.Event()
+
+            def decode_fn(self, k):
+                inner = super().decode_fn(k)
+
+                def fn(*a):
+                    self.release.wait(20.0)
+                    return inner(*a)
+
+                return fn
+
+        class FakePipe:
+            def __init__(self):
+                self.incidents = []
+
+            def incident(self, kind, source, detail=None):
+                self.incidents.append((kind, source, detail))
+
+        model = WedgeModel()
+        eng = SlotEngine(model, None, max_seq=1 << 20, chunk=4)
+        g = TensorGenerator("g")
+        g._engine = eng
+        pipe = FakePipe()
+        g._pipeline = pipe
+        eng.start()
+        try:
+            prompt = np.arange(3, dtype=np.int32)[None]
+            eng.submit(TensorFrame([prompt]), prompt, 8, 4)
+            deadline = time.monotonic() + 10
+            while (model.prefill_compiles == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            time.sleep(0.1)  # let the pump enter the wedged decode
+            eng.heartbeat.stall_after_s = 0.05
+            time.sleep(0.15)
+            g.handle_idle()
+            assert pipe.incidents and pipe.incidents[0][0] == "thread_stall"
+            assert "slots" in pipe.incidents[0][2]
+            g.handle_idle()
+            assert len(pipe.incidents) == 1  # edge-triggered
+            census = g.health_info()["threads"]
+            row = census[eng.heartbeat.name]
+            assert row["alive"] is True and row["stalls"] == 1
+        finally:
+            model.release.set()
+            eng.stop()
+
+    def test_named_thread_census_in_health(self):
+        """Generator pump + filter window-reaper/staging-lane rows show
+        up in Pipeline.health() under ``threads``."""
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_generator name=gen slots=1 "
+            "custom=sim:1 max-new=4 chunk=2 ! tensor_sink name=out")
+        pipe.start()
+        try:
+            prompt = np.arange(3, dtype=np.int32)[None]
+            pipe["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 10
+            while (len(pipe["out"].frames) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            h = pipe.health()["gen"]
+            row = h["threads"]["gen-slots"]
+            assert row["alive"] is True and row["beats"] > 0
+            assert h["gen_resume_rejects"] == 0
+        finally:
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            pipe.stop()
+        fpipe = parse_pipeline(
+            "appsrc name=src ! tensor_filter framework=scaler "
+            "custom=factor:2 name=f ! tensor_sink name=out")
+        fpipe.start()
+        try:
+            threads = fpipe.health()["f"]["threads"]
+            assert any(k.endswith("-reaper") for k in threads)
+        finally:
+            fpipe.stop()
+
+    def test_census_helper_skips_none(self):
+        hb = ThreadBeat("x")
+        assert set(thread_census(None, hb)) == {"x"}
+
+    def test_lane_beats_on_dequeue_after_idle(self):
+        """The worker beats when it CLAIMS a job, not only at the loop
+        top: after a long idle wait, a healthy first job must not show
+        the stale-beat-while-busy wedge signature."""
+        from nnstreamer_tpu.core.feed import HostStagingLane
+
+        lane = HostStagingLane(lambda bufs: [b.copy() for b in bufs],
+                               name="t")
+        try:
+            lane.submit([[np.zeros((2,), np.float32)]]).result()
+            lane.heartbeat._last -= 100.0  # simulate a long idle
+            lane.submit([[np.zeros((2,), np.float32)]]).result()
+            deadline = time.monotonic() + 5
+            while (lane.heartbeat.age_s() > 50
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert lane.heartbeat.age_s() < 50
+        finally:
+            lane.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E over raw TCP: kill + drain with real servers (fused and unfused)
+# ---------------------------------------------------------------------------
+def _gen_server(sid, port=0, vocab=997, step_ms=3.0, max_new=48,
+                name="server"):
+    pipe = parse_pipeline(
+        f"tensor_query_serversrc name=ssrc id={sid} port={port} "
+        "connect-type=tcp ! "
+        f"tensor_generator name=gen slots=4 "
+        f"custom=sim:1,sim_step_ms:{step_ms},vocab:{vocab} "
+        f"max-new={max_new} chunk=4 ! "
+        f"tensor_query_serversink id={sid}", name=name)
+    pipe.start()
+    return pipe
+
+
+class TestDurableStreamE2E:
+    @pytest.mark.parametrize("fuse", [True, False],
+                             ids=["fused", "unfused"])
+    def test_kill_mid_stream_resumes_bit_exact(self, fuse):
+        """Hard server kill mid-decode: the stream resumes on the
+        second server, delivered tokens bit-identical to the sim
+        oracle, exactly-once, with exact counters — fused AND unfused
+        client dataplane."""
+        s1 = _gen_server(9901, name="cont-s1")
+        s2 = _gen_server(9902, name="cont-s2")
+        p1 = s1["ssrc"].props["port"]
+        p2 = s2["ssrc"].props["port"]
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q "
+            f"connect-type=tcp hosts=localhost:{p1},localhost:{p2} "
+            "stream=true timeout=60 retry-backoff=0.01 ! "
+            "tensor_sink name=out", fuse=fuse, name=f"cli-fuse{fuse}")
+        client.start()
+        try:
+            prompt = np.arange(6, dtype=np.int32)[None]
+            client["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 30
+            while (not client["out"].frames
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert client["out"].frames, "no chunk before the kill"
+            s1.stop()  # hard kill mid-decode
+            client["src"].end_of_stream()
+            client.wait(timeout=60)
+            frames = list(client["out"].frames)
+            oracle = sim_oracle(997, prompt, 48)
+            np.testing.assert_array_equal(_tokens(frames), oracle)
+            assert [f.meta["chunk_index"] for f in frames] == list(
+                range(len(frames)))
+            h = client.health()["q"]
+            assert h["stream_resumes"] == 1
+            assert h["stream_migrations"] == 0
+            assert h["resume_failures"] == 0
+            srv_h = s2.health()["gen"]
+            assert srv_h["gen_resumes"] == 1
+        finally:
+            client.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_rolling_drain_migrates_stream(self):
+        """request_drain() on the serving host mid-decode: the stream
+        is handed off as a resumable GOAWAY chunk and MIGRATES —
+        bit-exact tokens, a migration (never a failure), zero breaker
+        trips, and the drain completes with zero dropped frames."""
+        s1 = _gen_server(9903, name="mig-s1")
+        s2 = _gen_server(9904, name="mig-s2")
+        p1 = s1["ssrc"].props["port"]
+        p2 = s2["ssrc"].props["port"]
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q "
+            f"connect-type=tcp hosts=localhost:{p1},localhost:{p2} "
+            "stream=true timeout=60 retry-backoff=0.01 ! "
+            "tensor_sink name=out", name="cli-mig")
+        client.start()
+        try:
+            prompt = np.arange(5, dtype=np.int32)[None]
+            client["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 30
+            while (not client["out"].frames
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            res = s1.drain(timeout=15)
+            assert res["dropped"] == 0
+            client["src"].end_of_stream()
+            client.wait(timeout=60)
+            frames = list(client["out"].frames)
+            oracle = sim_oracle(997, prompt, 48)
+            np.testing.assert_array_equal(_tokens(frames), oracle)
+            h = client.health()["q"]
+            assert h["stream_migrations"] == 1
+            assert h["stream_resumes"] == 0
+            assert h["resume_failures"] == 0
+            assert all(b["trips"] == 0
+                       for b in h["breakers"].values())
+            # the handoff's partial tokens were deduped exactly: total
+            # received == delivered + duplicates (oracle pins delivered)
+            assert h["duplicate_tokens_dropped"] >= 0
+            srv_h = s2.health()["gen"]
+            assert srv_h["gen_resumes"] == 1
+        finally:
+            client.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_resume_reject_on_mismatched_fleet(self):
+        """The second server runs a DIFFERENT model config: it refuses
+        the resume with a typed chunk (its other slots keep serving),
+        and the client surfaces the failure after its budget."""
+        s1 = _gen_server(9905, vocab=997, name="rej-s1")
+        s2 = _gen_server(9906, vocab=499, name="rej-s2")  # mismatched
+        p1 = s1["ssrc"].props["port"]
+        p2 = s2["ssrc"].props["port"]
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q "
+            f"connect-type=tcp hosts=localhost:{p1},localhost:{p2} "
+            "stream=true timeout=20 retry-backoff=0.01 "
+            "resume-retries=1 ! tensor_sink name=out", name="cli-rej")
+        client.start()
+        try:
+            prompt = np.arange(4, dtype=np.int32)[None]
+            client["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 30
+            while (not client["out"].frames
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            s1.stop()  # kill: resume can only try the mismatched host
+            client["src"].end_of_stream()
+            with pytest.raises(Exception):
+                client.wait(timeout=60)
+            h = client.health()["q"]
+            assert h["resume_failures"] >= 1
+            assert s2.health()["gen"]["gen_resume_rejects"] >= 1
+        finally:
+            client.stop()
+            s1.stop()
+            s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance chaos e2e (tier-1, chaos-marked): 8 concurrent streams
+# survive a hard kill AND a rolling restart mid-decode
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_generate_resume_chaos_smoke():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.chaos_fleet import run_generate_resume_script
+
+    v = run_generate_resume_script(servers=3, streams=8, seed=7)
+    assert v["ok"], v
+    # the acceptance contract, spelled out
+    assert v["exact"] == 8 and v["mismatched"] == 0
+    assert v["resumes"]["stream_resumes"] == 8
+    assert v["resumes"]["stream_migrations"] == v["rolled_goaway_evicted"]
+    assert v["rolled_goaway_evicted"] >= 1
+    assert v["gen"]["gen_resumes"] == (
+        v["resumes"]["stream_resumes"]
+        + v["resumes"]["stream_migrations"])
+    assert v["resumes"]["resume_failures"] == 0
+    assert v["foreign_breaker_trips"] == 0
+    assert v["rolling_restart"]["drain_dropped"] == 0

@@ -216,6 +216,14 @@ class GenClientHandle:
         return {"streams": len(self.prompts), "exact": ok,
                 "mismatched": bad, "tokens": tokens}
 
+    def tokens_done(self, trace: str) -> int:
+        """Tokens delivered so far for one stream (drives the seeded
+        mid-decode chaos points: act once every stream crossed a token
+        threshold, never on wall-clock luck)."""
+        frames = self._by_trace().get(trace, [])
+        return max(
+            (int(f.meta.get("tokens_done", 0)) for f in frames), default=0)
+
     def health(self) -> Dict[str, Any]:
         return self.pipe.health()["q"]
 
@@ -271,6 +279,10 @@ class FleetHarness:
         # per-tenant counters of servers that LEFT the fleet, captured at
         # kill time so fleet-wide accounting stays exact across churn
         self.retired_tenants: List[Dict[str, Any]] = []
+        # generator counters of retired servers (mode="generate"): the
+        # resume/migration invariants sum over every engine that ever
+        # decoded a token, including killed/rolled ones
+        self.retired_gen: List[Dict[str, Any]] = []
 
     # -- servers ------------------------------------------------------------
     def start_server(self, idx: int, port: int = 0):
@@ -315,6 +327,7 @@ class FleetHarness:
         their sockets (the announce is tombstoned by element stop)."""
         pipe = self.servers.pop(idx)
         self.retired_tenants.append(self.server_tenant_rows(pipe))
+        self.retired_gen.append(self.server_gen_row(pipe))
         pipe.stop()
 
     def rolling_restart(self, idx: int, drain_timeout: float = 15.0) -> Dict[str, Any]:
@@ -323,16 +336,42 @@ class FleetHarness:
         pipe = self.servers[idx]
         res = pipe.drain(timeout=drain_timeout)
         health = pipe.health()["ssrc"]
+        gen_health = self.server_gen_row(pipe)
         self.retired_tenants.append(self.server_tenant_rows(pipe))
+        self.retired_gen.append(gen_health)
         pipe.stop()
         self.servers.pop(idx)
         self.start_server(idx, port=self.ports[idx])
-        return {"drain": res, "health": health}
+        return {"drain": res, "health": health, "gen": gen_health}
 
     def add_server(self) -> int:
         idx = (max(self.ports) + 1) if self.ports else 0
         self.start_server(idx)
         return idx
+
+    @staticmethod
+    def server_gen_row(pipe) -> Dict[str, Any]:
+        """Numeric generator counters of one server (empty outside
+        mode="generate")."""
+        return {
+            k: v for k, v in pipe.health().get("gen", {}).items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+
+    def fleet_gen(self) -> Dict[str, float]:
+        """Generator counters summed over every server that is or ever
+        was in the fleet (retired engines contribute their
+        last-observed counters)."""
+        total: Dict[str, float] = {}
+        rows = [self.server_gen_row(p) for p in self.servers.values()]
+        rows.extend(self.retired_gen)
+        for row in rows:
+            for k, v in row.items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def fleet_tokens(self) -> int:
+        return int(self.fleet_gen().get("gen_tokens", 0))
 
     @staticmethod
     def server_tenant_rows(pipe) -> Dict[str, Any]:
@@ -637,6 +676,143 @@ def run_generate_script(servers: int = 2, streams: int = 12) -> Dict[str, Any]:
         h.stop_all()
 
 
+def run_generate_resume_script(servers: int = 3, streams: int = 8,
+                               seed: int = 0) -> Dict[str, Any]:
+    """Durable-stream chaos (stream continuity, Documentation/
+    resilience.md): N concurrent LONG generation streams survive a hard
+    server kill AND a rolling restart, both landing at seeded random
+    decode points mid-stream.  The kill exercises checkpointed RESUME
+    (mid-stream transport break -> re-prefill on a healthy server); the
+    roll exercises live MIGRATION (resumable GOAWAY handoff chunks).
+
+    Exactness contract: every stream's concatenated tokens equal the
+    sim oracle bit-for-bit (zero lost, zero duplicated), client
+    ``stream_resumes`` equals the streams broken by the kill, client
+    ``stream_migrations`` equals the rolled engine's
+    ``gen_goaway_evicted``, fleet ``gen_resumes`` equals resumes +
+    migrations (every attempt landed exactly once), zero resume
+    failures, and zero breaker trips beyond the killed host.
+
+    One stream per client (streams inside one client element are
+    sequential by design), so ``streams`` clients run concurrently.
+    Fresh clients deterministically rank the lowest-addressed server
+    first under least-inflight with zero load, so wave placement — and
+    therefore the kill's exact resume count — is scripted, not luck."""
+    import random
+
+    h = FleetHarness(mode="generate", gen_slots=max(8, streams),
+                     gen_max_new=96, gen_step_ms=3.0, base_id=9800,
+                     topic="chaosgenres")
+    rng = random.Random(seed)
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        clients = [
+            h.make_gen_client(f"C{i}", routing="least-inflight",
+                              timeout=120.0)
+            for i in range(streams)
+        ]
+        traces = [c.push_prompt() for c in clients]
+
+        def wait_tokens_each(n: int, timeout: float = 60.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if all(c.tokens_done(t) >= n
+                       for c, t in zip(clients, traces)):
+                    return
+                time.sleep(0.005)
+            raise TimeoutError(
+                f"streams never all reached {n} delivered tokens")
+
+        def min_port_live() -> int:
+            return min(h.servers, key=lambda i: h.ports[i])
+
+        # seeded random decode points (chunk multiples, comfortably
+        # inside the 96-token streams so both events land MID-decode)
+        t_kill = 4 * rng.randint(1, 3)
+        t_roll = t_kill + 4 * rng.randint(4, 8)
+
+        # hard kill: every fresh client ranked the same lowest-address
+        # server first, so ALL streams are on it — resumes are exact
+        killed = min_port_live()
+        killed_addr = f"127.0.0.1:{h.ports[killed]}"
+        wait_tokens_each(t_kill)
+        h.kill_server(killed)
+
+        # rolling restart mid-decode: roll whichever live server holds
+        # the most resumed streams (occupancy read from health, so the
+        # roll provably lands on active streams)
+        wait_tokens_each(t_roll)
+        rolled = max(
+            h.servers,
+            key=lambda i: h.servers[i].health()["gen"].get(
+                "gen_occupied", 0))
+        roll = h.rolling_restart(rolled)
+
+        for c in clients:
+            c.settle(timeout=120.0)
+        for c in clients:
+            c.finish()
+
+        checks = [c.check_exact() for c in clients]
+        exact = sum(r["exact"] for r in checks)
+        mismatched = sum(r["mismatched"] for r in checks)
+        res = {
+            k: sum(int(c.health().get(k, 0)) for c in clients)
+            for k in ("stream_resumes", "stream_migrations",
+                      "duplicate_tokens_dropped", "resume_failures",
+                      "goaway_replies")
+        }
+        gen = h.fleet_gen()
+        # breaker census: trips are allowed ONLY against the killed
+        # host (evicted-breaker trips belong to it too — it is the one
+        # endpoint rediscovery dropped)
+        foreign_trips = 0
+        for c in clients:
+            for addr, snap in c.health().get("breakers", {}).items():
+                if addr != killed_addr:
+                    foreign_trips += int(snap.get("trips", 0))
+        migrated = int(roll["gen"].get("gen_goaway_evicted", 0))
+        v = {
+            "streams": streams,
+            "exact": exact,
+            "mismatched": mismatched,
+            "tokens": sum(r["tokens"] for r in checks),
+            "seed": seed,
+            "decode_points": {"kill": t_kill, "roll": t_roll},
+            "killed": killed_addr,
+            "rolled_goaway_evicted": migrated,
+            "rolling_restart": {
+                "goaway_sent": roll["health"].get("goaway_sent", 0),
+                "drain_dropped": roll["drain"]["dropped"],
+            },
+            "resumes": res,
+            "gen": {k: int(gen.get(k, 0)) for k in (
+                "gen_joins", "gen_completed", "gen_resumes",
+                "gen_goaway_evicted", "gen_evicted", "gen_cancelled",
+                "gen_tokens")},
+            "foreign_breaker_trips": foreign_trips,
+        }
+        v["ok"] = bool(
+            mismatched == 0 and exact == streams
+            # the kill broke every stream mid-decode: each resumed once
+            and res["stream_resumes"] == streams
+            # every handoff the rolled engine emitted was migrated by
+            # exactly one client, and the roll landed on live streams
+            and res["stream_migrations"] == migrated
+            and migrated >= 1
+            # every resume/migration attempt landed exactly once
+            and gen.get("gen_resumes", 0)
+            == res["stream_resumes"] + res["stream_migrations"]
+            and res["resume_failures"] == 0
+            and foreign_trips == 0
+            and roll["drain"]["dropped"] == 0
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
 def main() -> int:
     import argparse
 
@@ -649,16 +825,27 @@ def main() -> int:
                     help="frames per tenant per wave")
     ap.add_argument("--keys", type=int, default=120,
                     help="distinct affinity sessions")
-    ap.add_argument("--mode", choices=("unary", "generate"),
+    ap.add_argument("--mode",
+                    choices=("unary", "generate", "generate-resume"),
                     default="unary",
-                    help="unary request fleet (default) or long-lived "
-                    "generation-stream fleet (continuous batching)")
+                    help="unary request fleet (default), long-lived "
+                    "generation-stream fleet (continuous batching), or "
+                    "the durable-stream chaos: hard kill + rolling "
+                    "restart at seeded random decode points with "
+                    "checkpointed resume / live migration")
     ap.add_argument("--streams", type=int, default=12,
-                    help="generation streams per client (--mode generate)")
+                    help="generation streams per client (--mode "
+                    "generate) or concurrent streams (generate-resume)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seed for the generate-resume decode points")
     args = ap.parse_args()
     if args.mode == "generate":
         verdict = run_generate_script(max(1, min(args.servers, 4)),
                                       args.streams)
+    elif args.mode == "generate-resume":
+        verdict = run_generate_resume_script(
+            max(2, min(args.servers, 4)), max(2, args.streams),
+            args.seed)
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))
