@@ -125,6 +125,11 @@ class FilterBackend:
     #: their "device arrays" would alias the reusable staging memory.
     SUPPORTS_STAGING = False
 
+    #: True when the backend honors the filter's ``mesh=`` prop (serving
+    #: one logical model sharded across a device mesh).  The filter
+    #: REFUSES ``mesh=`` on backends that would silently ignore it.
+    SUPPORTS_MESH = False
+
     def __init__(self):
         self.stats = InvokeStats()
         self.model_path: Optional[str] = None
@@ -200,6 +205,15 @@ class FilterBackend:
         identity (host backends consume host arrays directly) and is why
         the base class keeps ``SUPPORTS_STAGING = False``."""
         return list(arrays)
+
+    def staging_placement(self):
+        """Hashable token naming WHERE :meth:`to_device` places staged
+        batches (a device ordinal, a mesh spec, ...).  The staging-buffer
+        pool keys its rings on it so buffers sized/warmed for one
+        placement domain are never handed to a caller staging for
+        another (``core.buffer.DeviceBufferPool``).  ``None`` = the
+        backend has no placement identity (host backends)."""
+        return None
 
     @property
     def supports_batch(self) -> bool:

@@ -121,14 +121,19 @@ class FakeDeviceArray:
     Every pre-completion blocking sync is recorded with the calling
     thread's name, so tests can pin "the dispatch thread never sat inside
     device_get" structurally instead of by timing.
+
+    ``done`` may be a tuple of events — a MESH-sharded value whose shards
+    complete independently: the buffer is ready only when EVERY shard is
+    (the contract the sharded CompletionWindow rides — readiness means
+    all shards, never just shard 0).
     """
 
     __slots__ = ("_value", "_done", "_transfer_s", "_sim", "_host")
 
-    def __init__(self, value: np.ndarray, done: threading.Event,
+    def __init__(self, value: np.ndarray, done,
                  transfer_s: float, sim: "AsyncSim"):
         self._value = value
-        self._done = done
+        self._done = done if isinstance(done, tuple) else (done,)
         self._transfer_s = transfer_s
         self._sim = sim
         self._host: Optional[np.ndarray] = None  # transfer paid once
@@ -146,16 +151,17 @@ class FakeDeviceArray:
         return self._value.ndim
 
     def is_ready(self) -> bool:
-        return self._done.is_set()
+        return all(ev.is_set() for ev in self._done)
 
     def copy_to_host_async(self) -> None:
         self._sim.copy_hints += 1  # hint only; no overlap (tunnel-real)
 
     def _materialize(self) -> np.ndarray:
         if self._host is None:
-            if not self._done.is_set():
+            if not self.is_ready():
                 self._sim.note_blocking_sync()
-                self._done.wait()
+                for ev in self._done:
+                    ev.wait()
             if self._transfer_s > 0:
                 time.sleep(self._transfer_s)  # transfer occupies the caller
             self._host = self._value
@@ -187,6 +193,16 @@ class AsyncSim(FilterBackend):
     * ``h2d_ms``      — ``to_device`` cost paid on the staging-lane thread.
     * ``manual``      — "1": batches complete only via :meth:`release_one`
       / :meth:`release_all` (deterministic window unit tests).
+    * ``mesh_dp``     — N > 1: a SIMULATED dp mesh — N independent device
+      servers, each serving its 1/N batch shard concurrently (per-shard
+      service = compute_ms / N, the compute-bound split), outputs ready
+      only when ALL shards are.  This is the deterministic twin the
+      sharded-dataplane perf floor drives: on a single-core box the real
+      XLA CPU proxy mesh cannot exhibit dp parallelism (both virtual
+      devices share the one core), so the ≥1.5x dp:2 aggregate floor
+      measures the FEED/dispatch structure over sleeping shard servers —
+      the PR-9 SimSlotModel discipline.  Distinct from the jax-xla
+      ``mesh=`` prop (a real jax.sharding.Mesh).
     """
 
     NAME = "async-sim"
@@ -194,9 +210,11 @@ class AsyncSim(FilterBackend):
 
     def __init__(self):
         super().__init__()
-        self._pending: "deque[threading.Event]" = deque()
+        # one FIFO + one serve thread per simulated device server
+        # (mesh_dp sizes the list; the default is the single server)
+        self._pending: List["deque[threading.Event]"] = [deque()]
         self._cv = threading.Condition()
-        self._worker: Optional[threading.Thread] = None
+        self._workers: List[Optional[threading.Thread]] = [None]
         self._closed = False
         # census (inspected by tests; written under locks / GIL-atomic)
         self.blocking_syncs: List[str] = []
@@ -212,6 +230,10 @@ class AsyncSim(FilterBackend):
     def manual(self) -> bool:
         return self.custom_props.get("manual", "") in ("1", "true")
 
+    @property
+    def mesh_dp(self) -> int:
+        return max(1, int(self.custom_props.get("mesh_dp", "1")))
+
     def note_blocking_sync(self) -> None:
         self.blocking_syncs.append(threading.current_thread().name)
 
@@ -224,58 +246,77 @@ class AsyncSim(FilterBackend):
     def set_input_info(self, in_spec: StreamSpec) -> StreamSpec:
         return in_spec
 
-    # -- device worker --------------------------------------------------------
-    def _ensure_worker(self) -> None:
+    # -- device workers -------------------------------------------------------
+    def _ensure_servers(self) -> None:
+        nsrv = self.mesh_dp
+        with self._cv:
+            while len(self._pending) < nsrv:
+                self._pending.append(deque())
+                self._workers.append(None)
         if self.manual:
             return
-        if self._worker is None or not self._worker.is_alive():
-            self._closed = False
-            self._worker = threading.Thread(
-                target=self._serve, name="async-sim-device", daemon=True)
-            self._worker.start()
+        for i in range(nsrv):
+            w = self._workers[i]
+            if w is None or not w.is_alive():
+                self._closed = False
+                self._workers[i] = threading.Thread(
+                    target=self._serve, args=(i,),
+                    name=f"async-sim-device-{i}" if nsrv > 1
+                    else "async-sim-device",
+                    daemon=True)
+                self._workers[i].start()
 
-    def _serve(self) -> None:
-        service = self._ms("compute_ms")
+    def _serve(self, idx: int) -> None:
+        # per-shard service: a dp mesh splits the batch, so each server
+        # pays its 1/N share of the whole-batch compute knob
+        service = self._ms("compute_ms") / self.mesh_dp
         while True:
             with self._cv:
-                while not self._pending:
+                while not self._pending[idx]:
                     if self._closed:
                         return
                     self._cv.wait()
-                ev = self._pending.popleft()
+                ev = self._pending[idx].popleft()
             if service > 0:
                 t0 = time.perf_counter()
-                time.sleep(service)  # single server: batches serialize
+                time.sleep(service)  # per server: its batches serialize
                 # sleep() overshoots by timer granularity: record the
                 # ACTUAL service time so overlap ratios divide by what
                 # the device really spent, not the nominal knob
                 self.busy_s += time.perf_counter() - t0
             ev.set()
 
-    def release_one(self) -> bool:
-        """manual mode: complete the oldest in-service batch."""
+    def release_one(self, server: int = 0) -> bool:
+        """manual mode: complete ``server``'s oldest in-service shard
+        (the single-server default keeps the pre-mesh signature)."""
         with self._cv:
-            if not self._pending:
+            if server >= len(self._pending) or not self._pending[server]:
                 return False
-            self._pending.popleft().set()
+            self._pending[server].popleft().set()
             return True
 
     def release_all(self) -> int:
         n = 0
-        while self.release_one():
-            n += 1
+        with self._cv:
+            for dq in self._pending:
+                while dq:
+                    dq.popleft().set()
+                    n += 1
         return n
 
     def close(self):
         with self._cv:
             self._closed = True
-            for ev in self._pending:
-                ev.set()  # never strand a parked batch at teardown
-            self._pending.clear()
+            for dq in self._pending:
+                for ev in dq:
+                    ev.set()  # never strand a parked batch at teardown
+                dq.clear()
             self._cv.notify_all()
-            worker, self._worker = self._worker, None
-        if worker is not None and worker.is_alive():
-            worker.join(timeout=2.0)
+            workers = [w for w in self._workers if w is not None]
+            self._workers = [None] * len(self._workers)
+        for worker in workers:
+            if worker.is_alive():
+                worker.join(timeout=2.0)
 
     # -- execution ------------------------------------------------------------
     def to_device(self, arrays: List[Any]) -> List[Any]:
@@ -294,16 +335,20 @@ class AsyncSim(FilterBackend):
         if dispatch > 0:
             time.sleep(dispatch)  # dispatch cost on the calling thread
         self.dispatched += 1
-        done = threading.Event()
+        nsrv = self.mesh_dp
+        # one completion event per dp shard, each queued on its own
+        # server: the output is ready only when EVERY shard completed
+        done = tuple(threading.Event() for _ in range(nsrv))
         outs = [
             FakeDeviceArray(
                 np.asarray(a) * 2 + 1, done, self._ms("transfer_ms"), self)
             for a in inputs
         ]
+        self._ensure_servers()  # grows queues/workers to nsrv (one owner)
         with self._cv:
-            self._pending.append(done)
+            for i, ev in enumerate(done):
+                self._pending[i].append(ev)
             self._cv.notify_all()
-        self._ensure_worker()
         return outs
 
 

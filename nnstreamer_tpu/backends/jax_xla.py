@@ -32,10 +32,15 @@ TPU-first design:
   jax-xla filters never bounce through host (≙ zero-copy GstMemory).
 * optional ``dtype:bfloat16`` custom prop casts params/compute to bf16
   (MXU-native).
-* **sharded serving** — custom props ``mesh_dp:2,mesh_tp:4`` run ONE
-  logical filter across a device mesh: params sharded by the parallel
-  layer's rules (``parallel/sharding.py``), micro-batches scattered over
-  ``dp``, XLA SPMD inserts the collectives.  The reference's only
+* **sharded serving** — the ``mesh=`` prop (``mesh=tp:4`` /
+  ``mesh=dp:2,tp:2``; legacy custom props ``mesh_dp:2,mesh_tp:4`` still
+  accepted) runs ONE logical filter across a device mesh: params sharded
+  by the parallel layer's rules (``parallel/sharding.py``) and staged
+  across the WHOLE mesh before serving, ``invoke``/``invoke_batch``/
+  ``invoke_batch_donated`` compiled under explicit ``NamedSharding``
+  in/out specs (batch scattered over ``dp``, replicated over ``tp``),
+  host-staged batches placed directly in the sharded layout by the
+  ingest lane, XLA SPMD inserts the collectives.  The reference's only
   multi-device story is stream fan-out over nnstreamer-edge transports
   (SURVEY §2.3); intra-model sharding of a *serving* pipeline is
   TPU-native net-new.
@@ -195,6 +200,9 @@ class JaxXla(FilterBackend):
     #: the filter's staging lane may reuse its host buffers after emission
     SUPPORTS_STAGING = True
 
+    #: honors the ``mesh=`` prop (sharded serving across a device mesh)
+    SUPPORTS_MESH = True
+
     def __init__(self):
         super().__init__()
         self._fn: Optional[Callable] = None
@@ -202,15 +210,24 @@ class JaxXla(FilterBackend):
         self._in_spec: Optional[StreamSpec] = None
         self._out_spec: Optional[StreamSpec] = None
         self._device = None
-        self._jit_cache: Dict[Tuple, Any] = {}
+        # compile cache, LRU-bounded (core/slots.lru_bucket — the shared
+        # compile-bucket discipline): a mesh-shape / flexible-shape sweep
+        # mints a fresh (donate, nargs, shapes) key per configuration and
+        # each entry pins a compiled XLA program, so unbounded growth is
+        # a slow leak on long-lived servers (evicted keys just retrace)
+        from collections import OrderedDict
+
+        self._jit_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._cache_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # double-buffered hot reload
         self._posts: List[Callable[[List[Any]], List[Any]]] = []
-        # sharded serving (mesh_* custom props)
+        # sharded serving (mesh= prop / legacy mesh_* custom props)
         self._mesh = None
+        self._mesh_axes: Dict[str, int] = {}
         self._dp = 1
         self._batch_sharding = None
         self._replicated = None
+        self.mesh_scatters = 0  # host batches scattered onto the mesh
 
     # -- framework info -----------------------------------------------------
     def framework_info(self):
@@ -338,13 +355,20 @@ class JaxXla(FilterBackend):
         return (fn, None, spec_of(exported.in_avals),
                 spec_of(exported.out_avals))
 
-    def _mesh_axes_from_props(self) -> Dict[str, int]:
-        """``mesh_<axis>:<size>`` custom props (e.g. ``mesh_dp:2,mesh_tp:4``;
-        ``-1`` = remaining devices).  Empty dict = unsharded."""
+    def _mesh_axes_from_props(self, props: Dict[str, Any]) -> Dict[str, int]:
+        """The serving mesh config: the first-class ``mesh=`` prop
+        (``mesh=tp:4`` / ``mesh=dp:2,tp:2`` — parallel/mesh.py grammar)
+        merged over legacy ``mesh_<axis>:<size>`` custom props.  Empty
+        dict = unsharded."""
+        from ..parallel.mesh import parse_mesh_spec
+
         axes = {}
         for k, v in self.custom_props.items():
             if k.startswith("mesh_"):
                 axes[k[len("mesh_"):]] = int(v)
+        spec = str(props.get("mesh") or "")
+        if spec:
+            axes.update(parse_mesh_spec(spec))
         return axes
 
     def open(self, model_path, props):
@@ -371,11 +395,9 @@ class JaxXla(FilterBackend):
                 else a,
                 self._params,
             )
-        mesh_axes = self._mesh_axes_from_props()
+        mesh_axes = self._mesh_axes_from_props(props)
         if mesh_axes:
-            import math
-
-            from ..parallel.mesh import make_mesh
+            from ..parallel.mesh import claim_devices, make_mesh
             from ..parallel.sharding import (
                 batch_sharding,
                 replicated,
@@ -383,19 +405,20 @@ class JaxXla(FilterBackend):
                 transformer_rules,
             )
 
-            # explicit sizes claim a sub-mesh of the first N devices; a -1
-            # wildcard claims them all
-            if any(v == -1 for v in mesh_axes.values()):
-                devices = jax.devices()
-            else:
-                devices = jax.devices()[: math.prod(mesh_axes.values())]
-            self._mesh = make_mesh(mesh_axes, devices=devices)
+            self._mesh = make_mesh(
+                mesh_axes, devices=claim_devices(mesh_axes))
+            self._mesh_axes = {k: self._mesh.shape[k] for k in mesh_axes}
             self._dp = self._mesh.shape.get("dp", 1)
             if self._params is not None:
                 # rule misses fall back to replicated — safe for any family
                 self._params = shard_params(
                     self._params, self._mesh, transformer_rules(tp_axis="tp")
                 )
+                # every shard LANDED on its device before this backend is
+                # declared open: a hot swap's pointer exchange must never
+                # activate a half-staged mesh (the staging thread pays
+                # this wait, not the serving thread)
+                jax.block_until_ready(self._params)
             self._batch_sharding = batch_sharding(self._mesh, "dp")
             self._replicated = replicated(self._mesh)
         elif self._params is not None:
@@ -420,6 +443,9 @@ class JaxXla(FilterBackend):
                 params = shard_params(
                     params, self._mesh, transformer_rules(tp_axis="tp")
                 )
+                # fully staged across the mesh BEFORE the pointer swap
+                # below — the serving thread never sees a torn half-mesh
+                jax.block_until_ready(params)
             else:
                 params = jax.device_put(params, self._device)
         with self._reload_lock:
@@ -516,39 +542,85 @@ class JaxXla(FilterBackend):
             return forced
         return self._device is not None and self._device.platform != "cpu"
 
-    def _compiled(self, key: Tuple, donate: bool = False):
-        cache_key = (donate,) + key
-        fn = self._jit_cache.get(cache_key)
-        if fn is not None:
-            return fn
+    #: live compiled programs kept per backend (LRU; evicted keys retrace)
+    JIT_CACHE_MAX = 64
+
+    def _compiled(self, key: Tuple, donate: bool = False,
+                  batched: bool = False):
+        from ..core.slots import lru_bucket
+
+        cache_key = (donate, batched) + key
+
+        def build(_key):
+            import jax
+
+            model = self._fn
+            out_sharding = None
+            if self._mesh is not None:
+                # mesh mode: outputs carry explicit NamedSharding specs —
+                # batch-carrying leaves stay scattered on dp, everything
+                # else replicated — so a chained consumer (pool, window,
+                # next filter) sees a committed placement, not whatever
+                # GSPMD happened to infer
+                bucket = key[1][0][0] if batched else None
+
+                def out_sharding(o):  # noqa: F811 — trace-time closure
+                    if (batched and getattr(o, "ndim", 0) >= 1
+                            and o.shape[0] == bucket):
+                        return self._batch_sharding
+                    return self._replicated
+
+            def call(params, *xs):
+                outs = self._normalize_out(model(params, list(xs)))
+                outs = self._apply_posts(outs)
+                if out_sharding is not None:
+                    outs = [
+                        jax.lax.with_sharding_constraint(o, out_sharding(o))
+                        for o in outs
+                    ]
+                return tuple(outs)
+
+            # donation: XLA reuses the input arrays' HBM for outputs
+            # (zero per-batch device allocations in steady state).
+            # Only ever set for inputs the CALLER declared private —
+            # the filter's freshly stacked/staged batches — or when
+            # the "donate:true" custom prop pins it; upstream-shared
+            # arrays (tee fan-out, pre-batched blocks) never donate.
+            donate_nums = tuple(range(1, 1 + key[0])) if donate else ()
+            if self._mesh is None:
+                return jax.jit(call, donate_argnums=donate_nums)
+            # mesh mode: inputs compiled under explicit NamedSharding in
+            # specs — params at their rule-derived placements, the data
+            # args scattered on dp (batch) or replicated (per-frame)
+            in_sh = self._batch_sharding if batched else self._replicated
+            param_sh = (
+                jax.tree.map(lambda a: a.sharding, self._params)
+                if self._params is not None else None
+            )
+            return jax.jit(
+                call, donate_argnums=donate_nums,
+                in_shardings=(param_sh,) + (in_sh,) * key[0],
+            )
+
         with self._cache_lock:
-            fn = self._jit_cache.get(cache_key)
-            if fn is None:
-                import jax
-
-                model = self._fn
-
-                def call(params, *xs):
-                    outs = self._normalize_out(model(params, list(xs)))
-                    return tuple(self._apply_posts(outs))
-
-                # donation: XLA reuses the input arrays' HBM for outputs
-                # (zero per-batch device allocations in steady state).
-                # Only ever set for inputs the CALLER declared private —
-                # the filter's freshly stacked/staged batches — or when
-                # the "donate:true" custom prop pins it; upstream-shared
-                # arrays (tee fan-out, pre-batched blocks) never donate.
-                donate_nums = tuple(range(1, 1 + key[0])) if donate else ()
-                fn = jax.jit(call, donate_argnums=donate_nums)
-                self._jit_cache[cache_key] = fn
-        return fn
+            return lru_bucket(
+                self._jit_cache, cache_key, build, self.JIT_CACHE_MAX)
 
     def _put(self, a, sharding=None) -> Any:
         import jax
 
+        if self._mesh is not None:
+            # mesh placement: a bare put means "replicate" (per-frame
+            # invoke), never a single-device gather.  Resharding an
+            # already-placed array is a device-side scatter/collective,
+            # not a host bounce; an array already carrying the target
+            # sharding passes through untouched.
+            target = sharding if sharding is not None else self._replicated
+            if isinstance(a, jax.Array) and a.sharding == target:
+                return a
+            return jax.device_put(
+                a if isinstance(a, jax.Array) else np.asarray(a), target)
         if sharding is not None:
-            # mesh placement: resharding an already-placed array is a
-            # device-side scatter/collective, not a host bounce
             return jax.device_put(a, sharding)
         if isinstance(a, jax.Array):
             # zero-copy pass-through only when the array already lives on
@@ -561,6 +633,56 @@ class JaxXla(FilterBackend):
             return jax.device_put(a, self._device)
         return jax.device_put(np.asarray(a), self._device)
 
+    def _bucket(self, n: int) -> int:
+        """Compile-bucket size for a batch of ``n``: next power of two,
+        rounded up to a dp multiple so the mesh scatter is always even."""
+        bucket = _next_pow2(n)
+        if bucket % self._dp:
+            bucket = ((bucket + self._dp - 1) // self._dp) * self._dp
+        return bucket
+
+    @staticmethod
+    def _pad_rows(arr, bucket: int, xp=np):
+        """THE pad-to-bucket rule (edge-repeat rows on dim 0), shared by
+        every staging/dispatch site; ``xp`` picks host np or device
+        jnp.  Identity when already at the bucket."""
+        n = int(arr.shape[0])
+        if bucket == n:
+            return arr
+        return xp.pad(
+            arr, [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1),
+            mode="edge")
+
+    @property
+    def _mesh_on_cpu(self) -> bool:
+        return (self._mesh is not None
+                and next(iter(self._mesh.devices.flat)).platform == "cpu")
+
+    # -- placement identity / mesh observability ----------------------------
+    def staging_placement(self):
+        """Hashable placement-domain token for the staging-buffer pool:
+        buffers staged for one mesh/device must never be pooled into
+        another's ring (core.buffer.DeviceBufferPool keys on it)."""
+        if self._mesh is not None:
+            from ..parallel.mesh import mesh_spec_str
+
+            return ("mesh", mesh_spec_str(self._mesh_axes),
+                    tuple(d.id for d in self._mesh.devices.flat))
+        if self._device is not None:
+            return ("dev", self._device.platform, self._device.id)
+        return None
+
+    def mesh_info(self) -> Dict[str, Any]:
+        """Serving-mesh facts for health()/the metrics registry
+        (``nns.mesh.*``): empty when unsharded."""
+        if self._mesh is None:
+            return {}
+        from ..parallel.mesh import mesh_health_info
+
+        info = mesh_health_info(self._mesh, self._mesh_axes)
+        info["mesh_scatters"] = int(self.mesh_scatters)
+        return info
+
     # -- execution ----------------------------------------------------------
     def invoke(self, inputs: List[Any]) -> List[Any]:
         with self._reload_lock:
@@ -572,19 +694,49 @@ class JaxXla(FilterBackend):
             )(self._params, *xs)
         return list(out)
 
+    def _stage_sharded(self, arrays: List[Any]) -> List[Any]:
+        """Lane-thread hook body for a mesh backend: pad each host batch
+        to the dp-divisible compile bucket and scatter it STRAIGHT into
+        the batch NamedSharding — each dp shard lands on its owning
+        device from here, so the transfer overlaps the previous batch's
+        compute exactly like the single-device lane path (the scatter
+        never re-runs on the dispatch thread)."""
+        import jax
+
+        n = int(arrays[0].shape[0])
+        bucket = self._bucket(n)
+        staged = []
+        for a in arrays:
+            arr = np.asarray(a)
+            if bucket != n:
+                arr = self._pad_rows(arr, bucket)  # pad copies
+            elif self._mesh_on_cpu:
+                # XLA's CPU client zero-copies aligned host arrays into
+                # device_put shards: hand it a private copy or the staged
+                # jax.Array aliases the pooled staging buffer the lane is
+                # about to overwrite (same bug class as the single-device
+                # path below; regression-pinned there)
+                arr = np.array(arr)
+            staged.append(jax.device_put(arr, self._batch_sharding))
+        jax.block_until_ready(staged)
+        self.mesh_scatters += 1
+        return staged
+
     def to_device(self, arrays: List[Any]) -> List[Any]:
         """Staging-lane hook: place host-staged batches on this filter's
         device.  Runs ON THE LANE THREAD, so the ``block_until_ready``
         below IS the overlapped transfer — it orders the copy strictly
         before return, which is the lane's buffer-reuse contract (the
         staging buffers go back to the pool the moment this returns).
-        On a mesh the scatter stays inside invoke_batch (host-pad +
-        per-shard placement), so a private host copy satisfies the
-        contract while the stack cost still overlaps compute."""
+        On a mesh the lane stages straight to the sharded layout
+        (:meth:`_stage_sharded`): dp shards land on their owning devices
+        from the lane thread, so the scatter overlaps compute too."""
         import jax
 
         if self._batch_sharding is not None:
-            return [np.array(a) for a in arrays]
+            # mesh backend: the lane thread scatters straight to the
+            # sharded layout (overlap preserved; dispatch never re-puts)
+            return self._stage_sharded(arrays)
         if self._device is None or self._device.platform == "cpu":
             # XLA's CPU client ZERO-COPIES suitably-aligned host arrays:
             # device_put returns a jax.Array that ALIASES the staging
@@ -616,13 +768,12 @@ class JaxXla(FilterBackend):
         bucket size compiles exactly once (and, on a mesh, stays divisible
         by the dp axis so the scatter is even)."""
         n = int(inputs[0].shape[0])
-        bucket = _next_pow2(n)
-        if bucket % self._dp:
-            bucket = ((bucket + self._dp - 1) // self._dp) * self._dp
+        bucket = self._bucket(n)
         with self._reload_lock:
             import jax
 
             xs = []
+            scattered = False
             for a in inputs:
                 if self._batch_sharding is not None and not isinstance(
                     a, jax.Array
@@ -630,24 +781,28 @@ class JaxXla(FilterBackend):
                     # host batch onto a mesh: pad host-side, then scatter
                     # each dp shard straight to its owning device (no
                     # whole-batch bounce through device 0)
-                    arr = np.asarray(a)
-                    if bucket != n:
-                        pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
-                        arr = np.pad(arr, pad, mode="edge")
+                    arr = self._pad_rows(np.asarray(a), bucket)
                     arr = self._put(arr, self._batch_sharding)
+                    scattered = True
                     xs.append(arr)
                     continue
-                arr = self._put(a)
-                if bucket != n:
+                if self._batch_sharding is not None:
+                    # device-resident batch on a mesh (chained filter /
+                    # lane-staged): pad on device, commit the batch
+                    # sharding (no-op when the lane already placed it)
                     import jax.numpy as jnp
 
-                    pad = [(0, bucket - n)] + [(0, 0)] * (arr.ndim - 1)
-                    arr = jnp.pad(arr, pad, mode="edge")
-                if self._batch_sharding is not None:
-                    arr = self._put(arr, self._batch_sharding)
-                xs.append(arr)
+                    arr = self._pad_rows(a, bucket, xp=jnp)
+                    xs.append(self._put(arr, self._batch_sharding))
+                    continue
+                import jax.numpy as jnp
+
+                xs.append(self._pad_rows(self._put(a), bucket, xp=jnp))
+            if scattered:
+                self.mesh_scatters += 1
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-            out = self._compiled(key, donate=donate)(self._params, *xs)
+            out = self._compiled(
+                key, donate=donate, batched=True)(self._params, *xs)
         if bucket != n:
             out = [o[:n] for o in out]
         return list(out)

@@ -302,7 +302,7 @@ FRAME_POOL = FramePool(int(os.environ.get("NNS_FRAME_POOL", "1024")))
 # Device/staging buffer pool (async device feed — zero-alloc steady state)
 # ---------------------------------------------------------------------------
 class DeviceBufferPool:
-    """Free-list of STAGING buffers keyed by ``(shape, dtype)``.
+    """Free-list of STAGING buffers keyed by ``(shape, dtype, placement)``.
 
     The host->device ingest lane stacks every micro-batch into a host
     staging array before the transfer; allocating that array per batch is
@@ -323,6 +323,17 @@ class DeviceBufferPool:
     under its own key) but the double-release of a buffer still in use is
     the caller's bug — never release early.
 
+    Placement domains: ``acquire``/``release`` take an optional hashable
+    ``placement`` token (``FilterBackend.staging_placement()`` — a device
+    ordinal, a mesh spec) that joins the ring key, so a buffer staged for
+    one placement is never recycled into a caller staging for another.
+    Shape+dtype alone is NOT an identity once meshes exist: a replicated
+    carcass handed to a dp-sharded caller would be re-placed with the
+    wrong scatter (and, on platforms with pinned-host staging, carry the
+    wrong pinning).  Callers must pass the SAME token to release that
+    they acquired under — the ring key is derived per call, not stored
+    on the buffer.
+
     Thread-safe; counters (``allocated``/``reused``) are exact under the
     lock and drive the perf smoke's reuse-rate floor.
     """
@@ -341,13 +352,14 @@ class DeviceBufferPool:
         self.reused = 0
 
     @staticmethod
-    def _key(shape, dtype) -> Tuple:
-        return (tuple(int(d) for d in shape), np.dtype(dtype).str)
+    def _key(shape, dtype, placement=None) -> Tuple:
+        return (tuple(int(d) for d in shape), np.dtype(dtype).str, placement)
 
-    def acquire(self, shape, dtype) -> np.ndarray:
-        """A writable host buffer of exactly (shape, dtype): recycled when
-        one is free, freshly allocated otherwise (contents undefined)."""
-        key = self._key(shape, dtype)
+    def acquire(self, shape, dtype, placement=None) -> np.ndarray:
+        """A writable host buffer of exactly (shape, dtype) for the given
+        placement domain: recycled when one is free, freshly allocated
+        otherwise (contents undefined)."""
+        key = self._key(shape, dtype, placement)
         if self.enabled:
             with self._lock:
                 lst = self._free.get(key)
@@ -357,12 +369,13 @@ class DeviceBufferPool:
                 self.allocated += 1
         return np.empty(shape, np.dtype(dtype))
 
-    def release(self, buf: np.ndarray) -> bool:
-        """Return ``buf`` to the free list (True) or drop it when the
-        per-key ring is full / pooling is disabled (False)."""
+    def release(self, buf: np.ndarray, placement=None) -> bool:
+        """Return ``buf`` to its placement domain's free list (True) or
+        drop it when the per-key ring is full / pooling is disabled
+        (False).  ``placement`` must match the acquire-side token."""
         if not self.enabled or not isinstance(buf, np.ndarray):
             return False
-        key = self._key(buf.shape, buf.dtype)
+        key = self._key(buf.shape, buf.dtype, placement)
         with self._lock:
             lst = self._free.setdefault(key, [])
             if len(lst) >= self._max_per_key:

@@ -279,14 +279,18 @@ class HostStagingLane:
     steady state reuses the same ring of buffers with zero allocations.
     """
 
-    __slots__ = ("name", "_to_device", "_pool", "_q", "_cv", "_worker",
-                 "_closed", "staged", "heartbeat")
+    __slots__ = ("name", "_to_device", "_pool", "_placement", "_q", "_cv",
+                 "_worker", "_closed", "staged", "heartbeat")
 
     def __init__(self, to_device: Callable[[List[np.ndarray]], List[Any]],
-                 pool=None, name: str = "lane"):
+                 pool=None, name: str = "lane", placement=None):
         self.name = name
         self._to_device = to_device
         self._pool = pool if pool is not None else DEVICE_POOL
+        # placement-domain token (FilterBackend.staging_placement): the
+        # pool keys its rings on it so this lane's buffers never recycle
+        # into a lane staging for a different device/mesh
+        self._placement = placement
         self._q: "deque[Tuple[StagedBatch, List[List[np.ndarray]]]]" = deque()
         self._cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
@@ -333,7 +337,9 @@ class HostStagingLane:
                 for t in range(ntensors):
                     rows = [pf[t] for pf in per_frame]
                     a0 = np.asarray(rows[0])
-                    buf = self._pool.acquire((n,) + a0.shape, a0.dtype)
+                    buf = self._pool.acquire(
+                        (n,) + a0.shape, a0.dtype,
+                        placement=self._placement)
                     np.stack([np.asarray(r) for r in rows], out=buf)
                     bufs.append(buf)
                 dev = self._to_device(bufs)
@@ -347,7 +353,7 @@ class HostStagingLane:
                 # to_device returned (or failed): the staging buffers are
                 # no longer readable by anyone — back to the ring
                 for b in bufs:
-                    self._pool.release(b)
+                    self._pool.release(b, placement=self._placement)
 
     def pending(self) -> int:
         with self._cv:

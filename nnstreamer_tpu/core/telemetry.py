@@ -210,6 +210,12 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.gen.goaway_evicted": ("counter", "live streams handed off as resumable GOAWAY chunks on drain"),
     "nns.gen.resume_rejects": ("counter", "RESUME requests refused (signature/digest/shape mismatch)"),
 
+    # -- mesh-sharded serving (backends/jax_xla.py mesh= prop) -------------
+    "nns.mesh.devices": ("gauge", "devices in the filter's serving mesh (0 = unsharded)"),
+    "nns.mesh.dp": ("gauge", "data-parallel axis size of the serving mesh"),
+    "nns.mesh.tp": ("gauge", "tensor-parallel axis size of the serving mesh"),
+    "nns.mesh.scatters": ("counter", "host micro-batches scattered onto the mesh"),
+
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
     "nns.wire.corrupt_dropped": ("counter", "undecodable pub/sub frames dropped"),
@@ -289,6 +295,10 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "gen_resumes": "nns.gen.resumes",
     "gen_goaway_evicted": "nns.gen.goaway_evicted",
     "gen_resume_rejects": "nns.gen.resume_rejects",
+    "mesh_devices": "nns.mesh.devices",
+    "mesh_dp": "nns.mesh.dp",
+    "mesh_tp": "nns.mesh.tp",
+    "mesh_scatters": "nns.mesh.scatters",
     "profiler_active": "nns.profiler.active",
 }
 
@@ -297,6 +307,9 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
 HEALTH_KEYS_SPECIAL = (
     "state", "policy", "last_error", "model", "servers", "breakers",
     "remotes", "lifecycle", "swap_state", "swap_last_error",
+    # mesh config string ("dp:2,tp:2") — the numeric axis sizes export
+    # separately as nns.mesh.*
+    "mesh_axes",
     # fleet routing / tenancy (handled by dedicated collector branches)
     "tenants", "remote_inflight", "endpoint_hints", "routing",
     # background-thread census ({thread name: ThreadBeat.snapshot()}):

@@ -251,6 +251,17 @@ class TensorFilter(TransformElement):
         "model": Property(str, "", "model path / registry key"),
         "custom": Property(str, "", "backend-specific options 'k1:v1,k2:v2'"),
         "accelerator": Property(str, "", "'true:tpu.N,cpu' ordered wish list -> real device pinning"),
+        # mesh-sharded serving (parallel/mesh.py grammar): one logical
+        # filter across a device mesh — params sharded by the parallel
+        # layer's rules, micro-batches scattered over dp, replicated on
+        # tp; XLA SPMD inserts the collectives (jax-xla only)
+        "mesh": Property(
+            str, "",
+            "serve this model sharded across a device mesh: 'tp:4' / "
+            "'dp:2,tp:2' / 'dp:-1' (-1 = remaining devices; empty = "
+            "unsharded).  Params shard per parallel/sharding.py rules, "
+            "micro-batches scatter on dp; backend must support meshes "
+            "(jax-xla)"),
         "input-combination": Property(str, "", "subset/reorder input tensors, e.g. '0,2'"),
         "output-combination": Property(str, "", "compose output from 'iN'/'oN' tensors"),
         "latency": Property(int, 0, "1 = enable per-invoke latency measurement"),
@@ -557,6 +568,15 @@ class TensorFilter(TransformElement):
                 f"{self.name}: invoke-dynamic is per-frame "
                 "(incompatible with max-batch>1)"
             )
+        if self.props["mesh"]:
+            # parse NOW so a typo'd mesh spec fails at start, not after
+            # the backend loaded a model (grammar owned by parallel/mesh)
+            from ..parallel.mesh import parse_mesh_spec
+
+            try:
+                parse_mesh_spec(self.props["mesh"])
+            except ValueError as e:
+                raise ElementError(f"{self.name}: {e}") from None
         fw = self.props["framework"]
         model = self.props["model"] or None
         if model:
@@ -574,6 +594,13 @@ class TensorFilter(TransformElement):
         # latched for hot model swaps: a reload keeps the framework
         # resolved at start (≙ the reference RELOAD_MODEL contract)
         self._backend_cls, self._framework = backend_cls, fw
+        if self.props["mesh"] and not getattr(
+                backend_cls, "SUPPORTS_MESH", False):
+            # refusing beats silently serving unsharded: the operator
+            # asked for a placement this backend cannot honor
+            raise ElementError(
+                f"{self.name}: mesh={self.props['mesh']!r} but backend "
+                f"{fw!r} does not support mesh-sharded serving")
 
         key = self.props["shared-tensor-filter-key"]
         if key:
@@ -661,6 +688,10 @@ class TensorFilter(TransformElement):
                 self._lane = HostStagingLane(
                     lambda arrs: self.backend.to_device(arrs),
                     name=self.name,
+                    # placement-domain key for the staging-buffer pool: a
+                    # mesh/device identity, so this lane's rings never mix
+                    # with a differently-placed filter's (core/buffer.py)
+                    placement=self.backend.staging_placement(),
                 )
             elif lane_mode == "on":
                 raise ElementError(
@@ -965,6 +996,12 @@ class TensorFilter(TransformElement):
         }
         if self._swapper is not None:
             info.update(self._swapper.snapshot())
+        # mesh-sharded serving facts (jax-xla mesh= prop): devices/axis
+        # sizes + host-batch scatters — exported as nns.mesh.* via the
+        # ONE health-collector path (metrics_info here would double-emit)
+        be = self.backend
+        if be is not None and hasattr(be, "mesh_info"):
+            info.update(be.mesh_info())
         # named-thread census (core/liveness.py ThreadBeat): the async
         # feed's reaper + staging-lane workers are part of the health
         # story — a wedged one shows alive=True with a growing age

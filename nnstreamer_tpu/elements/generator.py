@@ -106,6 +106,16 @@ class TensorGenerator(Element):
             "than this between tokens is evicted with the typed expiry "
             "(0 = off; the request's own deadline-s budget is always "
             "honored)"),
+        # mesh-sharded decode (parallel/mesh.py grammar, tp only): the
+        # slot batch's transformer runs tensor-parallel across a device
+        # mesh — params tp-sharded, per-slot KV pages sharded on heads
+        # along tp.  Token sequences are unchanged (the resume signature
+        # deliberately excludes the mesh), so sharded and unsharded
+        # servers can serve the same durable streams.
+        "mesh": Property(
+            str, "",
+            "decode the slot batch tensor-parallel across a device mesh: "
+            "'tp:N' (slots >= 1 required; empty = unsharded)"),
     }
 
     def __init__(self, name=None):
@@ -116,6 +126,8 @@ class TensorGenerator(Element):
         self._max_seq = 0
         self._jit_chunks: "OrderedDict[int, Any]" = OrderedDict()
         self._engine = None
+        self._mesh = None         # tp decode mesh (mesh= prop, slotted)
+        self._mesh_axes = {}
         self._resume_sig = None   # token-sequence signature (slotted)
         self._resume_rejects = 0  # RESUME requests refused (mismatch)
 
@@ -133,6 +145,34 @@ class TensorGenerator(Element):
         slots = int(self.props["slots"])
         if slots < 0:
             raise ElementError(f"{self.name}: slots must be >= 0")
+        mesh = None
+        self._mesh_axes = {}
+        if self.props["mesh"]:
+            from ..parallel.mesh import (
+                claim_devices,
+                make_mesh,
+                parse_mesh_spec,
+            )
+
+            try:
+                axes = parse_mesh_spec(self.props["mesh"])
+            except ValueError as e:
+                raise ElementError(f"{self.name}: {e}") from None
+            if axes and set(axes) != {"tp"}:
+                # the slot batch IS the data axis: scattering it over dp
+                # would break the per-slot page/index layout, and sp/pp
+                # have no decode-step story here — refuse loudly
+                raise ElementError(
+                    f"{self.name}: mesh={self.props['mesh']!r} — the "
+                    "slotted decode path shards on tp only")
+            if axes and slots < 1:
+                raise ElementError(
+                    f"{self.name}: mesh= requires slots >= 1 (the mesh "
+                    "serves the slot batch)")
+            if axes:
+                mesh = make_mesh(axes, devices=claim_devices(axes))
+                self._mesh_axes = {k: mesh.shape[k] for k in axes}
+        self._mesh = mesh
         # slotted mode needs its OWN mailbox + dispatch thread: the
         # scheduler's idle hook (handle_idle) and pending_frames fast-poll
         # only run for chain heads, and they are how engine-completed
@@ -161,6 +201,10 @@ class TensorGenerator(Element):
                                   "d_ff", "seq", "seed", "gen_seed",
                                   "temperature", "top_k")
                     })
+            if sim and mesh is not None:
+                raise ElementError(
+                    f"{self.name}: mesh= needs the real transformer "
+                    "(custom sim: has no device placement)")
             if sim:
                 # async-sim proxy (PR-6 discipline): deterministic token
                 # recurrence + TPU-shaped step costs — drives the slot
@@ -181,7 +225,7 @@ class TensorGenerator(Element):
                 from ..models.transformer import build_slot_stream
 
                 model, params, self._max_seq = build_slot_stream(
-                    props, slots)
+                    props, slots, mesh=mesh)
             self._params = params
             self._engine = SlotEngine(
                 model, params,
@@ -250,6 +294,10 @@ class TensorGenerator(Element):
         if self._engine is not None:
             info.update(self._engine.snapshot())
             info["gen_jit_buckets"] += len(self._jit_chunks)
+            if self._mesh is not None:
+                from ..parallel.mesh import mesh_health_info
+
+                info.update(mesh_health_info(self._mesh, self._mesh_axes))
             # named-thread census: the pump's liveness is part of the
             # health story (a wedged pump fires an incident from
             # handle_idle; the census makes it visible between polls)

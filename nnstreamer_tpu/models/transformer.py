@@ -449,7 +449,8 @@ class SlotModel:
 
     def __init__(self, cfg: TransformerConfig, slots: int,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 mesh: Optional[Mesh] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.cfg = cfg
@@ -458,6 +459,28 @@ class SlotModel:
         self._pick = _make_pick(temperature, top_k)
         self._temperature = temperature
         self._key0 = jax.random.PRNGKey(seed)
+        # mesh-sharded decode (continuous batching past one chip): the
+        # per-slot KV pages shard on HEADS along tp — pages are
+        # (slots, max_seq, H, D/H), so dim 2 scatters and every device
+        # holds all slots' pages for its head shard; the slot batch
+        # itself stays replicated (the engine's tok/gen/active vectors
+        # are tiny).  GSPMD propagates the placements through the jitted
+        # step, so the shape-stable bucket contract is unchanged.
+        self.mesh = mesh
+        self._page_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            tp = mesh.shape.get("tp", 1)
+
+            def page_spec(shape):
+                # shard the heads dim when it exists and divides; the
+                # per-slot index/step vectors replicate
+                if len(shape) >= 3 and shape[2] % tp == 0 and tp > 1:
+                    return NamedSharding(mesh, P(None, None, "tp"))
+                return NamedSharding(mesh, P())
+
+            self._page_sharding = page_spec
         if donate is None:
             donate = jax.default_backend() != "cpu"
         self._donate = (1,) if donate else ()
@@ -469,6 +492,17 @@ class SlotModel:
         self.reset_slot = jax.jit(self._reset_slot)
         self.pick_first = jax.jit(self._pick_first)
 
+    def shard_params(self, params):
+        """Place a host param pytree for this model's mesh (tp rules;
+        fully staged before return) — identity when unsharded."""
+        if self.mesh is None:
+            return params
+        from ..parallel.sharding import shard_params, transformer_rules
+
+        params = shard_params(params, self.mesh, transformer_rules())
+        jax.block_until_ready(params)
+        return params
+
     # -- cache lifecycle ----------------------------------------------------
     def init_cache(self):
         shapes = jax.eval_shape(
@@ -477,6 +511,13 @@ class SlotModel:
                 jnp.zeros((self.slots, 1), jnp.int32),
             )["cache"]
         )
+        if self._page_sharding is not None:
+            page = self._page_sharding
+            return jax.tree.map(
+                lambda s: jax.device_put(
+                    jnp.zeros(s.shape, s.dtype), page(s.shape)),
+                shapes,
+            )
         return jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
@@ -575,12 +616,17 @@ class SlotModel:
 
 
 def build_slot_stream(props: Dict[str, str], slots: int,
-                      donate: Optional[bool] = None):
+                      donate: Optional[bool] = None,
+                      mesh: Optional[Mesh] = None):
     """Factory for the CONTINUOUS-BATCHING generator path: same
     ``custom`` dialect and seed semantics as :func:`build_stream`
     (``seed`` = params, ``gen_seed`` = sampling), so a single occupant's
-    stream is bit-equal to ``generate:<N>`` one-shot serving.  Returns
-    ``(SlotModel, params, max_seq)``."""
+    stream is bit-equal to ``generate:<N>`` one-shot serving.  With a
+    ``mesh`` the params tensor-shard on tp and the per-slot KV pages
+    shard on heads along tp (params fully staged across the mesh before
+    return) — the token SEQUENCE is unchanged, only its placement, so
+    the stream-continuity resume signature deliberately excludes the
+    mesh.  Returns ``(SlotModel, params, max_seq)``."""
     cfg = _cfg_from_props(props)
     params = host_init(
         TransformerLM(cfg).init,
@@ -593,7 +639,9 @@ def build_slot_stream(props: Dict[str, str], slots: int,
         top_k=int(props.get("top_k", "0")),
         seed=int(props.get("gen_seed", "0")),
         donate=donate,
+        mesh=mesh,
     )
+    params = model.shard_params(params)
     return model, params, cfg.max_seq
 
 

@@ -25,6 +25,91 @@ from jax.sharding import Mesh
 
 DP, FSDP, TP, SP, PP, EP = "dp", "fsdp", "tp", "sp", "pp", "ep"
 
+#: the axis vocabulary serving configs may name (typo guard for the
+#: ``mesh=`` element-prop grammar; make_mesh itself accepts any names)
+KNOWN_AXES = (DP, FSDP, TP, SP, PP, EP)
+
+
+def parse_mesh_spec(text: str) -> Dict[str, int]:
+    """Parse the serving-config mesh grammar: ``"tp:4"`` /
+    ``"dp:2,tp:2"`` / ``"dp:-1"`` (-1 = remaining devices, at most one
+    axis) into ``{axis: size}``.  Empty/``"0"``/``"off"`` -> ``{}``
+    (unsharded).  The one grammar shared by the tensor_filter /
+    tensor_generator ``mesh=`` props, the jax-xla backend, and bench's
+    ``BENCH_MESH`` axis — config surfaces cannot drift."""
+    text = (text or "").strip()
+    if text in ("", "0", "off", "none"):
+        return {}
+    axes: Dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, size = part.partition(":")
+        name = name.strip().lower()
+        if not sep:
+            raise ValueError(
+                f"mesh spec {text!r}: expected axis:size, got {part!r}")
+        if name not in KNOWN_AXES:
+            raise ValueError(
+                f"mesh spec {text!r}: unknown axis {name!r} "
+                f"(want one of {', '.join(KNOWN_AXES)})")
+        if name in axes:
+            raise ValueError(f"mesh spec {text!r}: duplicate axis {name!r}")
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(
+                f"mesh spec {text!r}: axis {name} size {size!r} is not "
+                "an integer") from None
+        if n == 0 or n < -1:
+            raise ValueError(
+                f"mesh spec {text!r}: axis {name} size must be >= 1 "
+                "(or -1 = remaining devices)")
+        axes[name] = n
+    if sum(1 for v in axes.values() if v == -1) > 1:
+        raise ValueError(f"mesh spec {text!r}: at most one axis may be -1")
+    return axes
+
+
+def claim_devices(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """THE device-claiming rule for a parsed serving mesh spec (shared
+    by the jax-xla backend and the slotted generator): a ``-1`` wildcard
+    claims every device, explicit sizes claim a sub-mesh of the first
+    N."""
+    import math
+
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if any(v == -1 for v in axes.values()):
+        return devices
+    return devices[: math.prod(axes.values())]
+
+
+def mesh_spec_str(axes: Dict[str, int]) -> str:
+    """Canonical string form of a parsed mesh spec (health/evidence
+    labels): ``{}`` -> ``"0"``, else ``"dp:2,tp:2"`` in KNOWN_AXES
+    order."""
+    if not axes:
+        return "0"
+    known = [a for a in KNOWN_AXES if a in axes]
+    rest = [a for a in axes if a not in KNOWN_AXES]
+    return ",".join(f"{a}:{axes[a]}" for a in known + rest)
+
+
+def mesh_health_info(mesh: Mesh, axes: Dict[str, int]) -> Dict[str, object]:
+    """THE serving-mesh health/metrics dict (``mesh_devices``/``mesh_dp``/
+    ``mesh_tp``/``mesh_axes``), shared by every element that serves on a
+    mesh (jax-xla filter backend, slotted generator) so the exported
+    ``nns.mesh.*`` surface cannot drift between them."""
+    return {
+        "mesh_devices": int(mesh.size),
+        "mesh_dp": int(mesh.shape.get(DP, 1)),
+        "mesh_tp": int(mesh.shape.get(TP, 1)),
+        "mesh_axes": mesh_spec_str(axes),
+    }
+
 
 def make_mesh(
     axes: Dict[str, int], devices: Optional[Sequence] = None

@@ -41,7 +41,7 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
-from .ring_attention import _ring_attn_local, vary_over
+from .ring_attention import _ring_attn_local, shard_map_compat, vary_over
 
 
 @dataclasses.dataclass(frozen=True)
@@ -319,14 +319,48 @@ def make_pipeline_train_step(
         n = lax.psum(cnt, ("pp", "dp", "sp", "tp", "ep"))
         return total / n
 
+    # ---- per-shard loss AND grad in ONE shard-mapped body ----------------
+    # value_and_grad lives INSIDE the body (per-shard grads, psum'd over
+    # each param's replication axes) instead of wrapping the shard_map:
+    # differentiating through a shard_map with replicated out_specs is
+    # exactly the transform old (pre-vma) jax cannot transpose
+    # (_SpecError), while per-shard AD through the body's collectives is
+    # the classic pmap-era recipe every jax generation supports.  The
+    # math is identical: the final psum's transpose seeds cotangent 1 on
+    # every device, so local partials summed over a param's replication
+    # axes ARE the global grad.
+    mesh_axes = tuple(mesh.axis_names)
+
+    def _repl_axes(spec: P):
+        named = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                named.update(entry)
+            else:
+                named.add(entry)
+        return tuple(a for a in mesh_axes if a not in named)
+
+    grad_psum_axes = {k: _repl_axes(specs[k]) for k in params}
+
+    def _fwd_loss_and_grad(p, tokens):
+        loss, grads = jax.value_and_grad(_fwd_loss)(p, tokens)
+        grads = {
+            k: (lax.psum(g, grad_psum_axes[k]) if grad_psum_axes[k] else g)
+            for k, g in grads.items()
+        }
+        return loss, grads
+
     in_specs = ({k: specs[k] for k in params}, P("dp", "sp"))
-    sharded_loss = shard_map(
-        _fwd_loss, mesh=mesh, in_specs=in_specs, out_specs=P(),
-        check_vma=False,
+    sharded_loss_and_grad = shard_map_compat(
+        _fwd_loss_and_grad, mesh, in_specs=in_specs,
+        out_specs=(P(), {k: specs[k] for k in params}),
+        check=False,
     )
 
     def _step(p, opt, tokens):
-        loss, grads = jax.value_and_grad(sharded_loss)(p, tokens)
+        loss, grads = sharded_loss_and_grad(p, tokens)
         updates, opt = tx.update(grads, opt, p)
         p = optax.apply_updates(p, updates)
         return p, opt, loss

@@ -29,11 +29,39 @@ except ImportError:  # pragma: no cover — older jax
 
 def vary_over(x, axes):
     """Mark a constant as device-varying over manual mesh axes (shard_map
-    vma typing; pcast on jax >= 0.8, pvary before)."""
+    vma typing; pcast on jax >= 0.8, pvary before).  On jax generations
+    WITHOUT vma typing (0.4.x: neither pcast nor pvary exists) the mark
+    is meaningless — closed-over constants are handled by the old
+    ``check_rep`` replication tracking — so the identity is correct."""
     try:
         return lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):  # pragma: no cover — older jax
+        pass
+    try:
         return lax.pvary(x, axes)
+    except AttributeError:  # pre-vma jax: no mark exists or is needed
+        return x
+
+
+def shard_map_compat(body, mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across jax generations: the strictness knob is
+    ``check_vma`` on vma-typed jax (>= 0.8 era), ``check_rep`` on the
+    older replication-tracked jax, and absent before either.  Callers
+    pass ``check=False`` for bodies the checker cannot type (the pallas
+    interpreter emits internal constants without vma, and old jax has no
+    pallas replication rule at all) — the SAME intent lands on whichever
+    kwarg this jax speaks.  One wrapper shared by every manual-SPMD
+    subsystem (ring/ulysses attention, the pipeline-parallel trainer) so
+    the version shim cannot drift between them."""
+    for kwargs in ({"check_vma": check}, {"check_rep": check}, {}):
+        try:
+            return shard_map(
+                body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs,
+            )
+        except TypeError:  # this jax doesn't know the kwarg — next shim
+            continue
+    raise RuntimeError("shard_map rejected every known strictness kwarg")
 
 
 def _block_attn(q, k, v, q_pos, k_pos, causal: bool, scale: float):
@@ -208,21 +236,14 @@ def ring_attention(
             _ring_attn_local, axis_name=seq_axis, all_axes=all_axes,
             causal=causal,
         )
-    kwargs = {}
-    if use_flash:
-        # the pallas interpreter/lowering emits internal constants without
-        # vma; jax's documented workaround is to disable the check for
-        # this body (the jnp ring keeps strict vma typing)
-        kwargs["check_vma"] = False
-    try:
-        fn = shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            **kwargs,
-        )
-    except TypeError:  # pragma: no cover — older jax without check_vma
-        fn = shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        )
+    # the pallas interpreter/lowering emits internal constants without
+    # vma (and pre-vma jax has no pallas replication rule at all);
+    # jax's documented workaround is to disable the check for this body
+    # (the jnp ring keeps strict typing)
+    fn = shard_map_compat(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check=not use_flash,
+    )
     return fn(q, k, v)
 
 

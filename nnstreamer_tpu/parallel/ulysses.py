@@ -24,7 +24,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .ring_attention import reference_attention, ring_attention, shard_map
+from .ring_attention import (
+    reference_attention,
+    ring_attention,
+    shard_map_compat,
+)
 
 
 def _local_attention(q, k, v, causal: bool):
@@ -83,9 +87,9 @@ def ulysses_attention(
         else (batch_axes[0] if len(batch_axes) == 1 else batch_axes)
     )
     spec = P(batch_spec, seq_axis, None, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(_ulysses_local, seq_axis=seq_axis, causal=causal),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )

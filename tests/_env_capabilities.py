@@ -23,38 +23,60 @@ def has_reference_tree() -> bool:
 
 @functools.lru_cache(maxsize=None)
 def spmd_stack_ok() -> bool:
-    """True when jax carries the shard_map feature set the manual-SPMD
-    stack (ring/flash attention on a mesh, pipeline-parallel transformer)
-    is written against: ``check_vma``/varying-manual-axes handling
-    (``jax.lax.pvary``) and the pallas_call replication rule that ships
-    with it.  jax 0.4.x lacks all three — the kernels still run
-    single-device (interpret mode), but any shard_map-wrapped use
-    fails with version errors, not correctness ones."""
-    import inspect
-
+    """PROBE-AND-RUN: True when a tiny shard_map program actually runs
+    on this process's multi-device CPU mesh through the repo's own
+    compat shim (``parallel.ring_attention.shard_map_compat`` maps the
+    strictness knob to ``check_vma``/``check_rep``/nothing per jax
+    generation, and ``vary_over`` degrades to the identity pre-vma).
+    The old guard keyed on jax-0.8-era API names (check_vma/pvary) and
+    skipped the whole manual-SPMD suite on any older jax even though
+    the stack runs there — now the capability is the EXECUTION, so the
+    suite runs wherever >= 2 devices exist and the shim holds."""
     import jax
 
     try:
-        try:
-            from jax import shard_map  # newer spelling
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
-        return (
-            hasattr(jax.lax, "pvary")
-            and "check_vma" in inspect.signature(shard_map).parameters
+        if len(jax.devices()) < 2:
+            return False  # a mesh program needs a mesh
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from nnstreamer_tpu.parallel.mesh import make_mesh
+        from nnstreamer_tpu.parallel.ring_attention import (
+            shard_map_compat,
+            vary_over,
         )
+
+        mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+
+        def body(x):
+            acc = vary_over(jnp.zeros(x.shape, x.dtype), ("sp",))
+            rolled = jax.lax.ppermute(x, "sp", [(0, 1), (1, 0)])
+            return acc + x + rolled
+
+        fn = shard_map_compat(
+            body, mesh, in_specs=(P("sp"),), out_specs=P("sp"))
+        out = fn(jnp.arange(4, dtype=jnp.float32))
+        return float(out.sum()) == 12.0
     except Exception:
         return False
 
 
 @functools.lru_cache(maxsize=None)
 def multihost_cpu_ok() -> bool:
-    """True when jax supports per-process virtual CPU device counts
-    (``jax_num_cpu_devices``), which the localhost multi-process
-    "multi-host" tests need to build their 2x4 hybrid mesh."""
+    """PROBE-AND-RUN: True when this box can actually host a localhost
+    multi-process "multi-host" gang.  The old guard keyed on
+    ``jax_num_cpu_devices`` existing; ``parallel.multihost.initialize``
+    now falls back to ``XLA_FLAGS=--xla_force_host_platform_device_
+    count`` (workers are FRESH processes, so the flag lands before
+    their backend initializes) and selects the gloo CPU collectives, so
+    the jax version no longer gates these tests.  What still does is
+    the HARDWARE: a 2-4 process gang, each with 4 virtual devices,
+    starves gloo barriers into timeouts on a single-core box under
+    tier-1 load — the one genuine "needs a real multi-host runtime"
+    residue, probed as cores >= 2."""
     import jax
 
     try:
-        return hasattr(jax.config, "jax_num_cpu_devices")
+        return hasattr(jax, "distributed") and (os.cpu_count() or 1) >= 2
     except Exception:
         return False

@@ -366,3 +366,40 @@ class TestMainIntegration:
         assert proxy["proxy"] is True and proxy["platform"] == "cpu"
         assert proxy["dispatch_thread_blocking_syncs"] == 0
         assert proxy["ingest_overlap_speedup"] is not None
+        # sharded-dataplane proxy evidence rides the same failure row
+        # (shared measure_sharded_overhead harness): mesh plumbing ~free
+        # on a single-device-equivalent mesh, dp:2 aggregate >= 1.5x on
+        # the sim mesh twin
+        assert proxy["sharded_ratio"] >= 0.85
+        assert proxy["dp2_speedup"] >= 1.5
+
+    def test_mesh_axis_separates_evidence(
+        self, cache_paths, monkeypatch, capsys
+    ):
+        """A row banked from single-device serving (then-implicit
+        mesh=0 via _SIG_DEFAULTS) must NEVER stand in for a sharded
+        run: pre-mesh fps under a mesh=dp:2,tp:2 config would mislabel
+        the dataplane that produced the number."""
+        assert bench._SIG_DEFAULTS["mesh"] == 0  # pre-mesh implicit value
+        bench.bank_row(_row())  # no mesh key -> then-implicit mesh=0
+        monkeypatch.setattr(
+            bench, "probe_backend", lambda *a, **k: ("down", "")
+        )
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        for k in ("BENCH_MODEL", "BENCH_PLATFORM", "BENCH_NO_STALE"):
+            monkeypatch.delenv(k, raising=False)
+        # match every other axis of the banked row; flip ONLY the mesh
+        monkeypatch.setenv("BENCH_FUSE", "0")
+        monkeypatch.setenv("BENCH_INGEST_LANE", "off")
+        monkeypatch.setenv("BENCH_PROXY", "0")
+        monkeypatch.setenv("BENCH_MESH", "dp:2,tp:2")
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] is None  # no mislabeled stale fallback
+        assert out.get("stale") is not True
+        assert out["mesh"] == "dp:2,tp:2"  # canonical axis label
+        # and the same banked row IS served when the mesh axis matches
+        monkeypatch.setenv("BENCH_MESH", "")
+        bench.main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["value"] == 1821.1 and out["stale"] is True

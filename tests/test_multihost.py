@@ -19,8 +19,9 @@ import _env_capabilities
 
 pytestmark = pytest.mark.skipif(
     not _env_capabilities.multihost_cpu_ok(),
-    reason="jax lacks jax_num_cpu_devices (per-process virtual CPU "
-    "devices) needed to build the localhost multi-process mesh",
+    reason="multi-process CPU gang needs >= 2 cores (workers get "
+    "virtual devices via jax_num_cpu_devices or the XLA_FLAGS "
+    "fallback; on one core the gang starves gloo barriers)",
 )
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -525,3 +525,35 @@ def test_continuous_batching_multiplex_floor():
     assert res["sim_p50_ms_per_token"] <= 10.0, res
     # slots are genuinely multiplexed, not serialized
     assert res["sim_slot_occupancy"] >= 0.5, res
+
+
+def test_sharded_serving_floors():
+    """The two mesh-sharded dataplane gates (ROADMAP item 4), both over
+    the ONE bench.measure_sharded_overhead harness the cpu_proxy
+    evidence and the perf-truth `sharded_overhead` axis publish:
+
+    * dispatch overhead <= 15% on a single-device-equivalent mesh —
+      jax-xla invoke_batch through the FULL sharded machinery
+      (mesh=dp:1: NamedSharding in/out specs, scatter path, mesh-keyed
+      pooling) must reach >= 0.85x the unsharded fps (measured ~1.0:
+      the plumbing is free; interleaved rounds cancel ambient load);
+    * >= 1.5x dp:2 aggregate throughput — the full pipeline over the
+      async-sim mesh twin (2 concurrent shard servers, compute-bound
+      knobs; measured ~1.9x).  The device layer is simulated because a
+      single-core box cannot exhibit real XLA-CPU dp parallelism (both
+      virtual devices share the one core) — what this floor pins is
+      the sharded FEED structure: even scatter, all-shards readiness,
+      no per-shard serialization anywhere in the dataplane.
+    """
+    import bench
+
+    res = bench.measure_sharded_overhead()
+    assert res["sharded_ratio"] >= 0.85, (
+        f"single-device-equivalent mesh costs more than 15% dispatch "
+        f"overhead: sharded/unsharded fps = {res['sharded_ratio']} "
+        f"(floor 0.85; measured ~1.0): {res}"
+    )
+    assert res["dp2_speedup"] >= 1.5, (
+        f"dp:2 aggregate throughput only {res['dp2_speedup']}x the "
+        f"single-server dataplane (floor 1.5x; measured ~1.9x): {res}"
+    )

@@ -72,8 +72,18 @@ STALE_AFTER_DAYS = 2.0  # chip evidence older than this is labeled STALE
 def _force_cpu() -> None:
     """The perf-truth layer is chip-free BY CONSTRUCTION: pin jax to CPU
     (env + config, like tests/conftest.py — the container sitecustomize
-    force-points jax at the tunnel)."""
+    force-points jax at the tunnel).  When jax has not been imported yet
+    this also requests a 2-device virtual CPU PROXY MESH (XLA_FLAGS —
+    the tests/_env_capabilities.py probe's mechanism) so the
+    sharded_overhead axis constructs real meshes; with jax already
+    loaded the single-device-equivalent dp:1 harness still measures."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if ("xla_force_host_platform_device_count" not in flags
+            and "jax" not in sys.modules):
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
     try:
         import jax
 
@@ -143,6 +153,13 @@ def _axes() -> Dict[str, Axis]:
              lambda: _bench().measure_generate_throughput(
                  slots=4, streams=4, max_new=24, chunk=8,
                  timeout_s=180.0)["tokens_per_s"]),
+        # mesh plumbing on a single-device-equivalent proxy mesh: fps
+        # ratio sharded/unsharded (1.0 = free; interleaved rounds cancel
+        # ambient load).  The dp:2 aggregate floor lives in pytest -m
+        # perf over the same measure_sharded_overhead harness.
+        Axis("sharded_overhead", "bench.measure_sharded_overhead", "ratio",
+             False, 5, 2,
+             lambda: _bench().measure_sharded_overhead()["sharded_ratio"]),
     )}
 
 
