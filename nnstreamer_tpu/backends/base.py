@@ -134,6 +134,11 @@ class FilterBackend:
         self.stats = InvokeStats()
         self.model_path: Optional[str] = None
         self.custom_props: Dict[str, str] = {}
+        #: set by the device-loss recovery ladder: this backend saw a
+        #: device vanish and is (or is being replaced while) serving in
+        #: a reduced configuration — health reports it, the discovery
+        #: plane deprioritizes the owning server
+        self.degraded = False
 
     # -- framework info -----------------------------------------------------
     def framework_info(self) -> FrameworkInfo:
@@ -205,6 +210,36 @@ class FilterBackend:
         identity (host backends consume host arrays directly) and is why
         the base class keeps ``SUPPORTS_STAGING = False``."""
         return list(arrays)
+
+    def trim_caches(self) -> int:
+        """Release memory the backend can recreate on demand (compiled-
+        program caches, device scratch) — the memory-pressure relief
+        hook the filter's OOM recovery and the watermark monitor call.
+        Returns the number of entries released; the default backend
+        holds nothing trimmable."""
+        return 0
+
+    def remesh_spec_after_loss(self, lost_ids: Sequence[int]):
+        """``(spec, dead_ids)`` this backend should be rebuilt with
+        after losing ``lost_ids`` (device ordinals; may be empty when
+        the runtime did not name them — the backend then identifies the
+        dead members itself, e.g. by probing), or ``None`` when the
+        backend has no re-mesh story (unsharded / not a device backend)
+        — the caller then falls back to supervision.  ``spec`` of
+        ``""`` means "rebuild unsharded"; ``dead_ids`` is never empty
+        and the caller excludes them from every future device claim."""
+        return None
+
+    def dead_ordinals_after_loss(self, lost_ids: Sequence[int]):
+        """Ordinals provably dead after a :class:`DeviceLostError`, for
+        the caller's exclusion list even when there is NO re-mesh story
+        (:meth:`remesh_spec_after_loss` returned ``None``): without the
+        exclusion a supervision restart would deterministically re-pick
+        the dead chip and crash-loop on it.  The default backend knows
+        only what the runtime reported; device backends may probe their
+        own serving device.  ``()`` = nothing provably dead (a spurious
+        loss — restart freely)."""
+        return tuple(int(i) for i in (lost_ids or ()))
 
     def staging_placement(self):
         """Hashable token naming WHERE :meth:`to_device` places staged

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core.resilience import FAULTS, DeviceLostError, DeviceOomError
 from ..core.types import StreamSpec, TensorSpec
 from .base import FilterBackend, register_backend
 
@@ -203,6 +204,22 @@ class AsyncSim(FilterBackend):
       measures the FEED/dispatch structure over sleeping shard servers —
       the PR-9 SimSlotModel discipline.  Distinct from the jax-xla
       ``mesh=`` prop (a real jax.sharding.Mesh).
+
+    Device-resource chaos (the typed taxonomy, core/resilience.py —
+    deterministic twins of the chip failing, so the OOM/lost recovery
+    ladders are testable chip-free):
+
+    * ``oom_at``   — invoke_batch index K (0-based) raises
+      :class:`~..core.resilience.DeviceOomError` ONCE (the injected OOM
+      burst: the shrink-retry ladder must redeliver every frame).
+    * ``oom_every``— every Nth invoke_batch raises DeviceOomError
+      (sustained pressure; N >= 2 or the retry itself would OOM forever).
+    * ``lost_at``  — invoke_batch index K raises
+      :class:`~..core.resilience.DeviceLostError` ONCE (mesh-member
+      death) and marks the backend degraded.
+
+    The process-wide ``device.oom`` / ``device.lost`` fault sites fire
+    here too, mirroring the jax-xla backend's sites.
     """
 
     NAME = "async-sim"
@@ -220,6 +237,7 @@ class AsyncSim(FilterBackend):
         self.blocking_syncs: List[str] = []
         self.copy_hints = 0
         self.dispatched = 0
+        self._attempts = 0  # includes faulted attempts (chaos knobs)
         self.busy_s = 0.0  # actual device-service wall time (not nominal)
 
     # -- knobs ---------------------------------------------------------------
@@ -330,10 +348,36 @@ class AsyncSim(FilterBackend):
     def invoke(self, inputs: List[Any]) -> List[Any]:
         return [np.asarray(a) * 2 + 1 for a in inputs]
 
+    def _maybe_device_fault(self, idx: int) -> None:
+        """Deterministic device-resource chaos at invoke index ``idx``
+        (see the class docstring knobs), plus the process-wide fault
+        sites the jax-xla backend also instruments."""
+        if FAULTS.is_armed():
+            FAULTS.check("device.oom")
+            FAULTS.check("device.lost")
+        cp = self.custom_props
+        lost_at = cp.get("lost_at")
+        if lost_at is not None and idx == int(lost_at):
+            self.degraded = True
+            raise DeviceLostError(
+                "async-sim: simulated mesh-member death", device_ids=(0,))
+        oom_at = cp.get("oom_at")
+        if oom_at is not None and idx == int(oom_at):
+            raise DeviceOomError("async-sim: simulated HBM exhaustion")
+        every = int(cp.get("oom_every", "0") or 0)
+        if every >= 2 and idx > 0 and (idx % every) == 0:
+            raise DeviceOomError("async-sim: simulated sustained HBM pressure")
+
     def invoke_batch(self, inputs: List[Any]) -> List[Any]:
         dispatch = self._ms("dispatch_ms")
         if dispatch > 0:
             time.sleep(dispatch)  # dispatch cost on the calling thread
+        # faults key off the ATTEMPT index (advances even when the
+        # attempt faults): "oom_at:K" fires exactly once and the
+        # element's retry — a fresh attempt — proceeds
+        idx = self._attempts
+        self._attempts += 1
+        self._maybe_device_fault(idx)
         self.dispatched += 1
         nsrv = self.mesh_dp
         # one completion event per dp shard, each queued on its own

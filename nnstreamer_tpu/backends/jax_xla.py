@@ -56,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.config import EXPORTED_MODEL_EXTS
+from ..core.resilience import DeviceLostError, device_call
 from ..core.types import FORMAT_STATIC, StreamSpec, TensorSpec
 from .base import FilterBackend, register_backend
 
@@ -191,6 +192,40 @@ def pick_device(wishes):
             wishes, family_fallback)
         return family_fallback
     return jax.devices()[0]
+
+
+def probe_device_ids(ids):
+    """Per-device liveness probe: a tiny transfer+sync against each of
+    the given ordinals, returning the ids that FAILED (the dead set).
+    The re-mesh ladder calls this when a :class:`DeviceLostError`
+    carries no ordinals — real XLA status strings usually name no chip,
+    and guessing wrong would re-place the rebuilt backend on the dead
+    one.  A probe that cannot even enumerate devices returns ``None``
+    (the caller falls back to its conservative guess); ``()`` means
+    every probed member ANSWERED — the loss did not reproduce, and the
+    caller must not condemn a healthy chip."""
+    import jax
+
+    from ..core.log import get_logger
+
+    try:
+        by_id = {int(d.id): d for d in jax.devices()}
+    except Exception as e:  # noqa: BLE001 — runtime may be wedged
+        get_logger("jax-xla").warning("device probe: enumeration failed (%s)", e)
+        return None
+    dead = []
+    for i in ids:
+        d = by_id.get(int(i))
+        try:
+            if d is None:
+                raise RuntimeError("no longer enumerated")
+            jax.device_put(np.zeros((1,), np.float32), d).block_until_ready()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — dead chip detection
+            get_logger("jax-xla").warning("device probe: id %d dead (%s)", i, e)
+            dead.append(int(i))
+    return tuple(dead)
 
 
 class JaxXla(FilterBackend):
@@ -359,14 +394,20 @@ class JaxXla(FilterBackend):
         """The serving mesh config: the first-class ``mesh=`` prop
         (``mesh=tp:4`` / ``mesh=dp:2,tp:2`` — parallel/mesh.py grammar)
         merged over legacy ``mesh_<axis>:<size>`` custom props.  Empty
-        dict = unsharded."""
+        dict = unsharded.  A degraded re-shard's survivor spec
+        (``mesh_remesh_override``) REPLACES the configured mesh
+        entirely — legacy ``mesh_*`` custom props included: a shrunk
+        config must never re-merge axes the survivors can no longer
+        satisfy."""
         from ..parallel.mesh import parse_mesh_spec
 
+        spec = str(props.get("mesh") or "")
+        if props.get("mesh_remesh_override"):
+            return dict(parse_mesh_spec(spec)) if spec else {}
         axes = {}
         for k, v in self.custom_props.items():
             if k.startswith("mesh_"):
                 axes[k[len("mesh_"):]] = int(v)
-        spec = str(props.get("mesh") or "")
         if spec:
             axes.update(parse_mesh_spec(spec))
         return axes
@@ -381,6 +422,20 @@ class JaxXla(FilterBackend):
             model_path
         )
         self._device = pick_device(props.get("accelerators") or ["auto"])
+        dead = {int(i) for i in (props.get("mesh_exclude_ids") or ())}
+        if dead and int(self._device.id) in dead:
+            # degraded re-shard bottomed out at unsharded: the default
+            # pick may be the very chip that died — place on a survivor
+            # (same platform preferred) instead of crash-looping on it
+            alive = [d for d in jax.devices()
+                     if d.platform == self._device.platform
+                     and int(d.id) not in dead] or [
+                d for d in jax.devices() if int(d.id) not in dead]
+            if not alive:
+                raise DeviceLostError(
+                    "no surviving device to place on",
+                    device_ids=tuple(sorted(dead)))
+            self._device = alive[0]
         # cache keyed off the device we will actually compile for (on CPU
         # the auto-enabled cache only emits AOT feature-mismatch noise)
         enable_compile_cache(platform=self._device.platform)
@@ -405,8 +460,14 @@ class JaxXla(FilterBackend):
                 transformer_rules,
             )
 
+            # degraded re-shard (element recovery ladder): lost device
+            # ordinals are excluded from the claimable pool, so a
+            # rebuilt backend lands only on survivors
             self._mesh = make_mesh(
-                mesh_axes, devices=claim_devices(mesh_axes))
+                mesh_axes,
+                devices=claim_devices(
+                    mesh_axes,
+                    exclude=props.get("mesh_exclude_ids") or ()))
             self._mesh_axes = {k: self._mesh.shape[k] for k in mesh_axes}
             self._dp = self._mesh.shape.get("dp", 1)
             if self._params is not None:
@@ -606,7 +667,94 @@ class JaxXla(FilterBackend):
             return lru_bucket(
                 self._jit_cache, cache_key, build, self.JIT_CACHE_MAX)
 
+    def _device_call(self, fn, *args, inject=True):
+        """Every compiled-program execution funnels through the shared
+        classification boundary (``core/resilience.device_call``: the
+        deterministic ``device.oom`` / ``device.lost`` fault sites plus
+        raw-runtime-error typing) so the element-side recovery ladders —
+        shrink-retry, slot shed, degraded re-mesh — key on types, never
+        on XLA status strings.  Transfer/staging paths pass
+        ``inject=False``: they still get the typed classification (a
+        transfer-time ``RESOURCE_EXHAUSTED`` engages the same OOM
+        ladder) but armed fault counters keep firing at compiled-call
+        boundaries only.  A lost device marks this backend degraded
+        until it is replaced."""
+        try:
+            return device_call(fn, *args, inject=inject)
+        except DeviceLostError:
+            self.degraded = True
+            raise
+
+    def trim_caches(self) -> int:
+        """Memory-pressure relief: drop the OLDEST half of the live
+        compiled programs (they retrace on next use; the hot bucket —
+        most recently used — survives, so the steady-state stream pays
+        nothing).  Called by the filter's OOM recovery and the
+        watermark monitor."""
+        with self._cache_lock:
+            drop = len(self._jit_cache) // 2
+            for _ in range(drop):
+                self._jit_cache.popitem(last=False)
+        return drop
+
+    def mesh_device_ids(self) -> Tuple[int, ...]:
+        """Ordinals of the devices this backend serves on (empty when
+        unsharded) — the survivors calculation of the re-mesh ladder."""
+        if self._mesh is None:
+            return ()
+        return tuple(int(d.id) for d in self._mesh.devices.flat)
+
+    def remesh_spec_after_loss(self, lost_ids):
+        """``(spec, dead_ids)`` to rebuild with after a device loss
+        (``parallel/mesh.remesh_after_loss``: dp gives way first, then
+        tp halves, then unsharded).  When the runtime did not name the
+        lost ordinals (real XLA status strings usually don't),
+        :func:`probe_device_ids` finds them with a per-device liveness
+        probe; only if the probe is UNAVAILABLE is the LAST member
+        conservatively assumed dead.  A probe that reaches every member
+        (the loss did not reproduce) yields ``None`` just like an
+        unsharded backend: no re-mesh story — the caller escalates to
+        supervision, whose plain retry may cure a transient, rather
+        than condemning a healthy chip.  ``dead_ids`` is never empty
+        when a pair IS returned — the caller excludes them from every
+        future claim, so the rebuilt backend cannot land back on the
+        dead chip."""
+        if self._mesh is None:
+            return None
+        from ..parallel.mesh import remesh_after_loss
+
+        dead, _axes, spec = remesh_after_loss(
+            self.mesh_device_ids(), self._mesh_axes, lost_ids,
+            probe=probe_device_ids)
+        if not dead:
+            return None
+        return spec, dead
+
+    def dead_ordinals_after_loss(self, lost_ids):
+        """Exclusion ordinals when there is no re-mesh story: reported
+        ids win; an UNSHARDED backend probes its own serving device —
+        the only chip the loss could implicate — so the supervision
+        restart places on a survivor instead of crash-looping on the
+        dead ordinal.  A probe that answers "alive" yields ``()`` (a
+        spurious loss condemns nobody); a probe that cannot even
+        enumerate condemns the lone chip conservatively."""
+        ids = tuple(int(i) for i in (lost_ids or ()))
+        if ids or self._mesh is not None or self._device is None:
+            return ids
+        own = int(self._device.id)
+        probed = probe_device_ids((own,))
+        if probed is None:
+            return (own,)
+        return tuple(int(i) for i in probed)
+
     def _put(self, a, sharding=None) -> Any:
+        # classification-only boundary (inject=False): a transfer-time
+        # RESOURCE_EXHAUSTED surfaces typed so the element-side OOM
+        # ladder (shrink-retry, trim) engages, without the armed fault
+        # sites firing mid-staging
+        return self._device_call(self._put_raw, a, sharding, inject=False)
+
+    def _put_raw(self, a, sharding=None) -> Any:
         import jax
 
         if self._mesh is not None:
@@ -689,9 +837,9 @@ class JaxXla(FilterBackend):
             # single frame has no batch dim to scatter: replicate on a mesh
             xs = [self._put(a, self._replicated) for a in inputs]
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-            out = self._compiled(
-                key, donate=bool(self._donation_forced())
-            )(self._params, *xs)
+            out = self._device_call(
+                self._compiled(key, donate=bool(self._donation_forced())),
+                self._params, *xs)
         return list(out)
 
     def _stage_sharded(self, arrays: List[Any]) -> List[Any]:
@@ -701,6 +849,10 @@ class JaxXla(FilterBackend):
         device from here, so the transfer overlaps the previous batch's
         compute exactly like the single-device lane path (the scatter
         never re-runs on the dispatch thread)."""
+        return self._device_call(
+            self._stage_sharded_raw, arrays, inject=False)
+
+    def _stage_sharded_raw(self, arrays: List[Any]) -> List[Any]:
         import jax
 
         n = int(arrays[0].shape[0])
@@ -731,12 +883,15 @@ class JaxXla(FilterBackend):
         On a mesh the lane stages straight to the sharded layout
         (:meth:`_stage_sharded`): dp shards land on their owning devices
         from the lane thread, so the scatter overlaps compute too."""
-        import jax
-
         if self._batch_sharding is not None:
             # mesh backend: the lane thread scatters straight to the
             # sharded layout (overlap preserved; dispatch never re-puts)
             return self._stage_sharded(arrays)
+        return self._device_call(self._to_device_raw, arrays, inject=False)
+
+    def _to_device_raw(self, arrays: List[Any]) -> List[Any]:
+        import jax
+
         if self._device is None or self._device.platform == "cpu":
             # XLA's CPU client ZERO-COPIES suitably-aligned host arrays:
             # device_put returns a jax.Array that ALIASES the staging
@@ -801,8 +956,9 @@ class JaxXla(FilterBackend):
             if scattered:
                 self.mesh_scatters += 1
             key = (len(xs),) + tuple((tuple(x.shape), str(x.dtype)) for x in xs)
-            out = self._compiled(
-                key, donate=donate, batched=True)(self._params, *xs)
+            out = self._device_call(
+                self._compiled(key, donate=donate, batched=True),
+                self._params, *xs)
         if bucket != n:
             out = [o[:n] for o in out]
         return list(out)

@@ -292,6 +292,15 @@ class FramePool:
         self.recycled += 1
         return True
 
+    def trim(self) -> int:
+        """Drop every retained carcass (memory-pressure relief valve —
+        the watermark monitor calls this at the high watermark).  The
+        pool keeps recycling afterwards; returns the carcasses freed."""
+        n = len(self._free) + len(self._free_batch)
+        self._free.clear()
+        self._free_batch.clear()
+        return n
+
 
 #: process-wide default pool used by the scheduler dispatch loop,
 #: BatchFrame.split, and tensor_filter's batch emitter
@@ -334,22 +343,36 @@ class DeviceBufferPool:
     they acquired under — the ring key is derived per call, not stored
     on the buffer.
 
+    Key-space bound: the ring DICT itself is LRU-bounded at
+    ``MAX_KEYS`` distinct ``(shape, dtype, placement)`` keys — a
+    flexible-shape or mesh-config sweep mints a fresh key per
+    configuration and each ring pins full-size staging buffers, the
+    same slow-leak class the jit-cache LRU bounds (an evicted ring just
+    re-allocates on next use).  ``rings_evicted`` counts dropped rings
+    so truncation is never silent.
+
     Thread-safe; counters (``allocated``/``reused``) are exact under the
     lock and drive the perf smoke's reuse-rate floor.
     """
 
     __slots__ = ("_free", "_lock", "_max_per_key", "enabled",
-                 "allocated", "reused")
+                 "allocated", "reused", "rings_evicted", "trims")
+
+    #: max distinct (shape, dtype, placement) rings kept live (LRU)
+    MAX_KEYS = 32
 
     def __init__(self, max_per_key: int = 8):
         import threading
+        from collections import OrderedDict
 
-        self._free: Dict[Tuple, List[np.ndarray]] = {}
+        self._free: "OrderedDict[Tuple, List[np.ndarray]]" = OrderedDict()
         self._lock = threading.Lock()
         self._max_per_key = max(0, max_per_key)
         self.enabled = self._max_per_key > 0
         self.allocated = 0
         self.reused = 0
+        self.rings_evicted = 0  # whole rings dropped by the key LRU
+        self.trims = 0          # memory-pressure trim() calls
 
     @staticmethod
     def _key(shape, dtype, placement=None) -> Tuple:
@@ -363,9 +386,11 @@ class DeviceBufferPool:
         if self.enabled:
             with self._lock:
                 lst = self._free.get(key)
-                if lst:
-                    self.reused += 1
-                    return lst.pop()
+                if lst is not None:
+                    self._free.move_to_end(key)  # ring touched = ring live
+                    if lst:
+                        self.reused += 1
+                        return lst.pop()
                 self.allocated += 1
         return np.empty(shape, np.dtype(dtype))
 
@@ -377,11 +402,33 @@ class DeviceBufferPool:
             return False
         key = self._key(buf.shape, buf.dtype, placement)
         with self._lock:
-            lst = self._free.setdefault(key, [])
+            lst = self._free.get(key)
+            if lst is None:
+                lst = self._free[key] = []
+                while len(self._free) > self.MAX_KEYS:
+                    # evict the least-recently-touched ring wholesale
+                    # (its buffers are plain host arrays; dropping the
+                    # references IS the free)
+                    self._free.popitem(last=False)
+                    self.rings_evicted += 1
+            else:
+                self._free.move_to_end(key)
             if len(lst) >= self._max_per_key:
                 return False
             lst.append(buf)
         return True
+
+    def trim(self) -> int:
+        """Drop every pooled staging buffer (memory-pressure relief
+        valve: the watermark monitor and the filter's OOM recovery both
+        call this).  Outstanding (acquired) buffers are untouched —
+        ownership is the caller's until release.  Returns buffers
+        freed."""
+        with self._lock:
+            n = sum(len(lst) for lst in self._free.values())
+            self._free.clear()
+            self.trims += 1
+        return n
 
     @property
     def reuse_rate(self) -> float:

@@ -25,6 +25,7 @@ Design rules (same as resilience.py):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -56,8 +57,10 @@ class ServerBusyError(RemoteApplicationError):
 
     ``tenant``/``reason`` identify WHY the shed happened (``"quota"`` =
     the tenant's own quota, ``"priority"`` = priority-class headroom,
-    ``"load"`` = the global watermark): diagnostics only, the client
-    contract is identical for all three."""
+    ``"load"`` = the global watermark, ``"memory"`` = the memory
+    watermark — the chip is near HBM exhaustion, so the server sheds
+    BEFORE it OOMs): diagnostics only, the client contract is identical
+    for all four."""
 
     def __init__(self, msg: str = "server busy", retry_after: float = 0.05,
                  tenant: str = "", reason: str = "load"):
@@ -403,6 +406,205 @@ class Watchdog:
 
 
 # ---------------------------------------------------------------------------
+# Memory-pressure watermark monitor
+# ---------------------------------------------------------------------------
+def host_rss_bytes() -> int:
+    """Resident set size of THIS process (bytes), from /proc (Linux) —
+    no psutil dependency; 0 where unreadable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def host_total_bytes() -> int:
+    """Total physical memory of the host (bytes); 0 where unreadable.
+    The default denominator of the host-RSS watermark fallback, so the
+    monitor stays meaningful on platforms whose devices report no
+    ``memory_stats()`` (CPU) without any explicit limit configured."""
+    try:
+        return os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError):
+        return 0
+
+
+def device_memory_sample() -> Tuple[int, int, int]:
+    """``(bytes_in_use, bytes_limit, host_rss)`` for the most-loaded
+    visible accelerator (the fraction that matters is the worst chip's).
+
+    Consults jax ONLY when the process already imported it (the monitor
+    must never be the reason jax initializes), and tolerates platforms
+    whose ``Device.memory_stats()`` is absent/None (CPU) — those report
+    (0, 0, rss) and the monitor falls back to the host-RSS watermark."""
+    import sys
+
+    in_use = limit = 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            best = -1.0
+            for d in jax.devices():
+                ms = getattr(d, "memory_stats", None)
+                stats = ms() if callable(ms) else None
+                if not stats:
+                    continue
+                bl = int(stats.get("bytes_limit", 0) or 0)
+                bi = int(stats.get("bytes_in_use", 0) or 0)
+                frac = (bi / bl) if bl else 0.0
+                if frac > best:
+                    best, in_use, limit = frac, bi, bl
+        except Exception:  # allow-silent: a stats probe must never fault serving
+            in_use = limit = 0
+    return in_use, limit, host_rss_bytes()
+
+
+class MemoryPressureMonitor:
+    """High/low-watermark HBM + host-RSS pressure signal (the "shed
+    BUSY *before* the chip OOMs" piece of the degrade-don't-die ladder).
+
+    Polled from slow cadences only — the watchdog sweeper thread and the
+    serversrc's idle request-pump tick — never from a per-frame path:
+    :meth:`poll` is internally rate-limited to ``min_poll_s`` and the
+    hot-path read is the plain :attr:`pressured` attribute (one bool).
+
+    State machine (hysteresis, the admission-controller discipline):
+    the watermark FRACTION (device ``bytes_in_use/bytes_limit`` when the
+    platform reports it, else host RSS over ``host_limit_bytes``,
+    itself defaulting to the host's physical RAM so an armed watermark
+    is never silently inert) crossing ``high`` enters pressure; it
+    persists until the
+    fraction falls back to ``low``.  Entering pressure fires the
+    ``trim_hooks`` (frame pool, staging-buffer pool, backend compile
+    caches — memory the process can recreate); pressure SUSTAINED for
+    ``sustain_s`` fires ``on_pressure(snapshot)`` once per
+    ``incident_interval_s`` (the serversrc routes it into the flight
+    recorder, which attaches the PR-11 thread profiler).
+
+    ``sample``/``clock`` are injectable — tier-1 drives the whole ladder
+    on fake samples with a fake clock."""
+
+    def __init__(self, high: float = 0.90, low: float = 0.75,
+                 sustain_s: float = 2.0, min_poll_s: float = 0.25,
+                 incident_interval_s: float = 30.0,
+                 host_limit_bytes: int = 0,
+                 sample: Callable[[], Tuple[int, int, int]] = device_memory_sample,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_pressure: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 trim_hooks: Tuple[Callable[[], int], ...] = ()):
+        if not 0.0 <= low <= high:
+            raise ValueError(
+                f"memory watermarks low={low} high={high} "
+                "(want 0 <= low <= high)")
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain_s = float(sustain_s)
+        self.min_poll_s = float(min_poll_s)
+        self.incident_interval_s = float(incident_interval_s)
+        self.host_limit_bytes = int(host_limit_bytes)
+        self._sample = sample
+        self._clock = clock
+        self.on_pressure = on_pressure
+        self.trim_hooks: List[Callable[[], int]] = list(trim_hooks)
+        #: the hot-path signal: one GIL-atomic bool read (admission)
+        self.pressured = False
+        self._pressured_since: Optional[float] = None
+        self._last_poll = float("-inf")
+        self._last_incident = float("-inf")
+        # last sample (scrape-time gauges)
+        self.bytes_in_use = 0
+        self.bytes_limit = 0
+        self.host_rss = 0
+        self.fraction = 0.0
+        # exact accounting
+        self.polls = 0
+        self.trims = 0           # trim-hook sweeps fired
+        self.trimmed_entries = 0  # entries the hooks reported freeing
+        self.incidents = 0
+
+    def add_trim_hook(self, hook: Callable[[], int]) -> None:
+        self.trim_hooks.append(hook)
+
+    def _fraction(self) -> float:
+        if self.bytes_limit > 0:
+            return self.bytes_in_use / self.bytes_limit
+        if self.host_limit_bytes > 0:
+            return self.host_rss / self.host_limit_bytes
+        # stats-less platform, no explicit limit: RSS over physical RAM
+        # (never silently inert — an armed watermark must watch SOMETHING)
+        total = host_total_bytes()
+        if total > 0:
+            return self.host_rss / total
+        return 0.0
+
+    def poll(self, now: Optional[float] = None) -> bool:
+        """One watermark evaluation (rate-limited; safe from any slow
+        cadence).  Returns the post-poll :attr:`pressured` state."""
+        now = self._clock() if now is None else now
+        if now - self._last_poll < self.min_poll_s:
+            return self.pressured
+        self._last_poll = now
+        self.polls += 1
+        self.bytes_in_use, self.bytes_limit, self.host_rss = self._sample()
+        self.fraction = self._fraction()
+        if not self.pressured:
+            if self.fraction >= self.high:
+                self.pressured = True
+                self._pressured_since = now
+                self._trim()
+                log.warning(
+                    "memory pressure ENTERED: fraction %.3f >= high %.3f "
+                    "(in_use=%d limit=%d rss=%d)", self.fraction,
+                    self.high, self.bytes_in_use, self.bytes_limit,
+                    self.host_rss)
+        elif self.fraction <= self.low:
+            self.pressured = False
+            self._pressured_since = None
+            log.info("memory pressure cleared: fraction %.3f <= low %.3f",
+                     self.fraction, self.low)
+        if (self.pressured and self._pressured_since is not None
+                and now - self._pressured_since >= self.sustain_s
+                and now - self._last_incident >= self.incident_interval_s):
+            self._last_incident = now
+            self.incidents += 1
+            if self.on_pressure is not None:
+                try:
+                    self.on_pressure(self.snapshot())
+                except Exception:
+                    log.exception("on_pressure hook failed")
+        return self.pressured
+
+    def _trim(self) -> None:
+        freed = 0
+        for hook in self.trim_hooks:
+            try:
+                freed += int(hook() or 0)
+            except Exception:
+                log.exception("memory trim hook failed")
+        self.trims += 1
+        self.trimmed_entries += freed
+        if freed:
+            log.info("memory pressure: trimmed %d pooled/cached entries",
+                     freed)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``mem_*`` health keys (exported as ``nns.mem.*`` gauges via
+        the health collector)."""
+        return {
+            "mem_bytes_in_use": int(self.bytes_in_use),
+            "mem_bytes_limit": int(self.bytes_limit),
+            "mem_host_rss": int(self.host_rss),
+            "mem_fraction": round(float(self.fraction), 4),
+            "mem_pressure": 1 if self.pressured else 0,
+            "mem_polls": int(self.polls),
+            "mem_trims": int(self.trims),
+            "mem_trimmed_entries": int(self.trimmed_entries),
+            "mem_incidents": int(self.incidents),
+        }
+
+
+# ---------------------------------------------------------------------------
 # Admission control
 # ---------------------------------------------------------------------------
 class AdmissionController:
@@ -538,6 +740,13 @@ class TenantAdmissionController(AdmissionController):
             ]
         else:
             self._pri_high = None
+        # memory-watermark coupling (MemoryPressureMonitor): when set
+        # and True, every admission sheds with reason="memory" — the
+        # server refuses work BEFORE the chip OOMs.  One attribute read
+        # + (armed only) one bool call per admission; breaker-immune
+        # like every other shed.
+        self.pressure: Optional[Callable[[], bool]] = None
+        self.memory_shed = 0
         # LRU-ordered so the bound below can evict the LEAST-recently
         # active idle tenant: the tenant name comes straight off the
         # wire (client-controlled), so an unbounded dict would let a
@@ -605,6 +814,12 @@ class TenantAdmissionController(AdmissionController):
             reason = None
             if quota > 0 and t["inflight"] + n > quota:
                 reason = "quota"
+            elif self.pressure is not None and self.pressure():
+                # memory watermark: shed EVERYTHING (all tenants, all
+                # priority classes) — HBM exhaustion takes the whole
+                # chip down, so no class has headroom against it
+                reason = "memory"
+                self.memory_shed += n
             elif self._pri_high is not None:
                 # base watermark semantics first (identical to
                 # AdmissionController for priority 3), then the
@@ -690,6 +905,7 @@ class TenantAdmissionController(AdmissionController):
         snap = super().snapshot()
         snap["tenants"] = self.tenant_snapshot()
         snap["tenants_evicted"] = self.tenants_evicted
+        snap["memory_shed"] = self.memory_shed
         return snap
 
 

@@ -65,6 +65,99 @@ class FatalError(RuntimeError):
     """Raise (or wrap with) this to force fatal classification."""
 
 
+class DeviceOomError(TransientError):
+    """The accelerator ran out of memory (XLA ``RESOURCE_EXHAUSTED``).
+
+    Transient BY DESIGN: the op may succeed on a smaller batch bucket or
+    after cache/pool trimming — the filter's shrink-retry and the slot
+    engine's slot-shed ladder both cure it without a restart.  Carries
+    no device identity: the chip is still there, just full."""
+
+
+class DeviceLostError(TransientError):
+    """A device vanished from under the program (chip reset, mesh member
+    death, runtime lost its connection to the accelerator).
+
+    Transient at the SERVING level — a re-mesh onto the surviving
+    devices (or an element restart that re-picks devices) cures it —
+    but never curable by a plain same-device retry, so recovery paths
+    must re-place, not just re-call.  ``device_ids`` names the lost
+    device ordinals when the runtime (or an injected fault) knows them;
+    empty means "one unidentified member"."""
+
+    def __init__(self, msg: str = "device lost", device_ids=()):
+        super().__init__(msg)
+        self.device_ids = tuple(device_ids)
+
+
+#: message fragments that mark an XLA runtime error as OOM vs device
+#: loss (the jax runtime has no stable typed taxonomy; string-matching
+#: its status text is the supported art, and the fragments below cover
+#: PJRT/XLA across the generations this repo runs on)
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM",
+    "Resource exhausted", "Failed to allocate",
+)
+_LOST_MARKERS = (
+    "device is lost", "Device lost", "DEVICE_LOST",
+    "device not found", "No such device", "device unavailable",
+    "failed to connect to device", "chip reset", "halted",
+    "INTERNAL: Mesh", "missing device",
+)
+
+
+def classify_device_error(err: BaseException):
+    """Map a raw backend/runtime exception to the typed device-error
+    taxonomy: returns a :class:`DeviceOomError` / :class:`DeviceLostError`
+    (the original as ``__cause__``) or ``None`` when the error is not a
+    device-resource failure.  Already-typed errors pass through.  The
+    single classification point for every invoke path (jax-xla backend,
+    slot-engine pump), so the OOM/lost vocabulary cannot drift."""
+    if isinstance(err, (DeviceOomError, DeviceLostError)):
+        return err
+    mod = type(err).__module__ or ""
+    name = type(err).__name__
+    if not (name == "XlaRuntimeError" or mod.startswith("jaxlib")
+            or mod.startswith("jax.")):
+        return None
+    msg = str(err)
+    if any(m in msg for m in _OOM_MARKERS):
+        out = DeviceOomError(f"device OOM: {msg[:400]}")
+        out.__cause__ = err
+        return out
+    if any(m in msg for m in _LOST_MARKERS):
+        out = DeviceLostError(f"device lost: {msg[:400]}")
+        out.__cause__ = err
+        return out
+    return None
+
+
+def device_call(fn, *args, inject=True):
+    """THE classification boundary around a raw device call (shared by
+    the jax-xla backend and the slot-engine pump so the two wrappers
+    cannot drift): fires the deterministic ``device.oom`` /
+    ``device.lost`` fault sites where the real chip would fail, maps
+    raw runtime errors through :func:`classify_device_error`, and
+    re-raises everything else untouched.  ``inject=False`` keeps the
+    typed classification but skips the fault sites — transfer/staging
+    paths use it so an armed ``device.oom``/``device.lost`` counter
+    keeps firing at compiled-call boundaries only (deterministic
+    injection placement), while a REAL transfer-time
+    ``RESOURCE_EXHAUSTED`` still surfaces typed."""
+    try:
+        if inject and FAULTS.is_armed():
+            FAULTS.check("device.oom")
+            FAULTS.check("device.lost")
+        return fn(*args)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:  # noqa: BLE001 — classification boundary
+        typed = classify_device_error(e)
+        if typed is None or typed is e:
+            raise
+        raise typed from e
+
+
 class RemoteApplicationError(RuntimeError):
     """The remote ANSWERED — with an application-level error reply.
 

@@ -23,8 +23,11 @@ plain data:
   signals, produce the order in which the client should try remotes.
   The tier partition encodes the selection-side guard the breakers
   need: a remote whose breaker is OPEN (or that announced it is
-  draining) is NEVER ranked ahead of a closed-breaker, serving
-  alternative — load scores only reorder remotes *within* a tier.
+  degraded or draining) is NEVER ranked ahead of a closed-breaker,
+  healthy alternative — load scores only reorder remotes *within* a
+  tier.  A DEGRADED remote (lost a device, serving on a shrunk mesh)
+  still serves correctly, so it ranks above draining/down — it just
+  never wins while a whole server exists.
 
 Everything here is allocation-light and clock-free; the element owns
 locks, clocks, and sockets.
@@ -40,8 +43,9 @@ ROUTING_POLICIES = ("rotate", "least-inflight", "ewma")
 
 #: availability tiers, best first — ranking never promotes across tiers
 TIER_OK = 0        # serving, breaker closed, no cooldown
-TIER_DRAINING = 1  # announced draining (discovery hint / GOAWAY cooldown)
-TIER_DOWN = 2      # cooldown active or breaker open
+TIER_DEGRADED = 1  # announced degraded (lost a device; serving reduced)
+TIER_DRAINING = 2  # announced draining (discovery hint / GOAWAY cooldown)
+TIER_DOWN = 3      # cooldown active or breaker open
 
 
 def rendezvous_owner(key: str, targets: Sequence[Tuple[str, int]]) -> int:
@@ -156,7 +160,7 @@ def order_remotes(
     breaker-open still waits behind every healthy alternative, so
     stickiness can never pin a session to a dead host."""
     out: List[int] = []
-    for tier in (TIER_OK, TIER_DRAINING, TIER_DOWN):
+    for tier in (TIER_OK, TIER_DEGRADED, TIER_DRAINING, TIER_DOWN):
         idxs = [i for i, t in tiers.items() if t == tier]
         if not idxs:
             continue

@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .continuity import GOAWAY_META, RESUME_META, prompt_digest
 from .liveness import ThreadBeat
 from .log import get_logger
+from .resilience import DeviceLostError, DeviceOomError, device_call
 
 log = get_logger("slots")
 
@@ -152,7 +153,9 @@ class SimSlotModel:
     def __init__(self, slots: int, vocab: int = 997,
                  step_base_ms: float = 1.0, step_per_slot_ms: float = 0.05,
                  prefill_ms_per_token: float = 0.02,
-                 sleep=time.sleep):
+                 sleep=time.sleep,
+                 oom_at_step: Optional[int] = None,
+                 lost_at_step: Optional[int] = None):
         import numpy as np
 
         self._np = np
@@ -164,11 +167,28 @@ class SimSlotModel:
         self._sleep = sleep
         self.decode_compiles = 0
         self.prefill_compiles = 0
+        # deterministic device-resource chaos (the AsyncSim twin knobs):
+        # decode ATTEMPT index N raises the typed error exactly once —
+        # the attempt counter advances on faulted attempts, so the
+        # engine's retry (a fresh attempt) proceeds.  Token sequences
+        # are unaffected: the fault fires before any state mutation.
+        self.oom_at_step = oom_at_step
+        self.lost_at_step = lost_at_step
+        self._attempts = 0
+        self._pending_fault: Optional[str] = None
         #: simulated device-busy seconds (occupancy evidence)
         self.busy_s = 0.0
         # running prompt-sum per slot: chunked prefill accumulates into
         # it so token 1 covers the WHOLE prompt across chunk boundaries
         self._prefill_carry: Dict[int, int] = {}
+
+    def fail_next(self, kind: str) -> None:
+        """Arm the NEXT decode attempt to raise the typed device error
+        (``"oom"`` | ``"lost"``), race-free against a running pump —
+        the chaos harness's scripted injection point."""
+        if kind not in ("oom", "lost"):
+            raise ValueError(f"fail_next({kind!r}): want oom|lost")
+        self._pending_fault = kind
 
     def init_cache(self):
         np = self._np
@@ -213,6 +233,18 @@ class SimSlotModel:
         self.decode_compiles += 1
 
         def fn(params, cache, tok, gen, active):
+            idx = self._attempts
+            self._attempts += 1
+            pending, self._pending_fault = self._pending_fault, None
+            if pending == "lost" or (
+                    self.lost_at_step is not None
+                    and idx == self.lost_at_step):
+                raise DeviceLostError(
+                    "sim: simulated mesh-member death", device_ids=(0,))
+            if pending == "oom" or (
+                    self.oom_at_step is not None
+                    and idx == self.oom_at_step):
+                raise DeviceOomError("sim: simulated HBM exhaustion")
             n_active = int(active.sum())
             dt = k * (self.step_base_s
                       + self.step_per_slot_s * n_active)
@@ -258,7 +290,8 @@ class SlotEngine:
                  jit_bucket_max: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
                  name: str = "slots",
-                 resume_sig: Optional[str] = None):
+                 resume_sig: Optional[str] = None,
+                 on_device_lost: Optional[Callable[..., Any]] = None):
         import numpy as np
 
         self._np = np
@@ -280,6 +313,13 @@ class SlotEngine:
         # let streams finish)
         self.resume_sig = resume_sig
         self._goaway = False
+        # degrade-don't-die (core/resilience.py device taxonomy): the
+        # element-supplied recovery hook for a lost mesh member —
+        # ``on_device_lost(err) -> (model, params) | None`` rebuilds the
+        # model on the surviving devices (None = the model recovered in
+        # place, e.g. the sim twin).  Without a hook a lost device is a
+        # sticky engine error (supervision restart rebuilds the element).
+        self.on_device_lost = on_device_lost
         # background-thread liveness: the pump beats once per loop —
         # a pump with pending work and a stale beat is WEDGED (stuck in
         # a device call), which the sticky pop_ready error can never
@@ -318,6 +358,13 @@ class SlotEngine:
         self.tokens_per_step = 0.0  # EWMA of active slots per decode step
         self.resumes = 0            # streams joined via a RESUME request
         self.goaway_evicted = 0     # live streams handed off on drain
+        # device-resource resilience accounting (exact; the chaos e2e
+        # and the registry read these)
+        self.oom_retries = 0        # device steps retried after an OOM
+        self.oom_sheds = 0          # slots shed (resumably) to relieve HBM
+        self.device_lost = 0        # lost-device events survived
+        self.device_lost_evicted = 0  # live streams handed off on loss
+        self.remeshes = 0           # models rebuilt on surviving devices
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -498,6 +545,11 @@ class SlotEngine:
                 "gen_decode_compiles": self.model.decode_compiles,
                 "gen_resumes": self.resumes,
                 "gen_goaway_evicted": self.goaway_evicted,
+                "gen_oom_retries": self.oom_retries,
+                "gen_oom_sheds": self.oom_sheds,
+                "gen_device_lost": self.device_lost,
+                "gen_device_lost_evicted": self.device_lost_evicted,
+                "gen_remeshes": self.remeshes,
             }
 
     # -- pump internals -----------------------------------------------------
@@ -616,33 +668,157 @@ class SlotEngine:
             "%s: stream %d evicted (%s) after %d token(s)",
             self.name, s.sid, reason, s.tokens_out)
 
-    def _sweep_goaway(self) -> None:
-        """Drain handoff (lock held): flush EVERY live stream with a
-        resumable GOAWAY final chunk and free its slot.  Unlike a
-        deadline eviction this is a MIGRATION, not a failure: no
+    def _handoff_one(self, s: GenStream, reason: str) -> None:
+        """Flush ONE live stream as a resumable handoff final chunk and
+        free its slot (lock held).  A MIGRATION, not a failure: no
         ``deadline_expired`` marker (the client must not count a blown
         budget), partial tokens ride the final chunk, and the resume
         state on it lets the client continue bit-identically elsewhere.
-        Runs every boundary while draining, so streams admitted just
-        before the drain hand off too."""
+        On a legacy engine (no resume signature) the chunk still closes
+        the stream typed — truncation is loud, never a poisoned frame."""
+        if s.state == "waiting":
+            try:
+                self._waiting.remove(s)
+            except ValueError:
+                pass
+        s.state = "evicted"
+        s.evict_reason = reason
+        extra = {"evicted": reason}
+        if self.resume_sig is not None:
+            extra[GOAWAY_META] = True  # client migrates; tokens survive
+        self._emit_terminal(s, extra_meta=extra)
+        self._free_slot(s)
+
+    def _sweep_goaway(self) -> None:
+        """Drain handoff (lock held): flush EVERY live stream with a
+        resumable GOAWAY final chunk and free its slot.  Runs every
+        boundary while draining, so streams admitted just before the
+        drain hand off too."""
         for s in list(self._streams.values()):
             if s.finished:
                 continue
-            if s.state == "waiting":
-                try:
-                    self._waiting.remove(s)
-                except ValueError:
-                    pass
-            s.state = "evicted"
-            s.evict_reason = "goaway"
+            self._handoff_one(s, "goaway")
             self.goaway_evicted += 1
-            self._emit_terminal(s, extra_meta={
-                GOAWAY_META: True, "evicted": "goaway",
-            })
-            self._free_slot(s)
             log.info(
                 "%s: stream %d handed off on drain after %d token(s)",
                 self.name, s.sid, s.tokens_out)
+
+    # -- device-resource resilience (degrade, don't die) ---------------------
+    def _device_step(self, fn, *args):
+        """Every model call of the pump funnels through the shared
+        classification boundary (``resilience.device_call``: the
+        deterministic ``device.oom`` / ``device.lost`` sites plus
+        raw-runtime-error typing) — the pump's recovery ladder keys on
+        types, never on XLA status strings."""
+        return device_call(fn, *args)
+
+    def _handle_oom(self) -> None:
+        """HBM exhaustion mid-step: shed the LOWEST-priority occupant as
+        a resumable continuity chunk (its tokens survive — the client
+        migrates the stream), freeing its slot's KV pages, then let the
+        failed step retry on the smaller active set.  Never a
+        restart-budget burn, never a poisoned frame."""
+        with self._lock:
+            self.oom_retries += 1
+            live = [
+                s for s in self._occupants
+                if s is not None and not s.finished
+            ]
+            if not live:
+                return  # nothing held; the bare retry is the relief
+            victim = min(
+                live,
+                key=lambda s: (s.priority, -(s.joined_ts or 0.0)),
+            )
+            self.oom_sheds += 1
+            self._handoff_one(victim, "oom")
+            log.warning(
+                "%s: device OOM — shed stream %d (priority %d, %d "
+                "token(s) safe) and retrying the step",
+                self.name, victim.sid, victim.priority, victim.tokens_out)
+
+    def _recover_donated_cache(self) -> None:
+        """Donation invalidates at DISPATCH, not at success: on a real
+        (non-CPU) backend the decode/prefill jits donate the KV cache,
+        so the step that just OOMed may have consumed it — retrying
+        with deleted buffers would raise an UNTYPED "Array has been
+        deleted" and kill the pump with every remaining stream.  When
+        the cache died with the step, every occupant's device context
+        is gone: hand ALL live streams off as resumable continuity
+        chunks (resume re-prefills from prompt+tokens — bit-exact) and
+        re-init device state clean.  No-op on the sim twin and CPU,
+        where nothing donates."""
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(self._cache)
+        except Exception:  # noqa: BLE001 — sim twin / no jax
+            return
+        if not any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in leaves):
+            return
+        shed = 0
+        with self._lock:
+            for s in list(self._streams.values()):
+                if s.finished:
+                    continue
+                self._handoff_one(s, "oom")
+                self.oom_sheds += 1
+                shed += 1
+        self._reset_device_state()
+        log.warning(
+            "%s: donated KV cache died with the OOMed step — %d "
+            "stream(s) handed off resumable, cache re-initialized",
+            self.name, shed)
+
+    def _reset_device_state(self, clear_jit_lrus: bool = False) -> None:
+        """Re-init the engine's per-device decode state clean (fresh KV
+        cache, zeroed token/progress vectors) after every occupant was
+        handed off — shared by the donated-cache OOM recovery and the
+        device-loss rebuild so the two paths cannot drift.
+        ``clear_jit_lrus`` additionally drops the compiled prefill/
+        decode programs (a REPLACEMENT model invalidates them; a cache
+        re-init on the same model does not)."""
+        np = self._np
+        self._cache = self.model.init_cache()
+        self._tok_vec = np.zeros((self.slots,), np.int32)
+        self._gen_vec = np.zeros((self.slots,), np.int32)
+        if clear_jit_lrus:
+            self._prefill_lru.clear()
+            self._decode_lru.clear()
+
+    def _handle_device_lost(self, err: DeviceLostError) -> None:
+        """A mesh member died under the batch: hand EVERY live stream
+        off with resume state (exactly the drain contract — clients
+        migrate them), then rebuild the model on the surviving devices
+        via the element's ``on_device_lost`` hook and keep serving
+        degraded.  Without a hook the loss is sticky (supervision
+        restart rebuilds the element)."""
+        handed = 0
+        with self._lock:
+            self.device_lost += 1
+            for s in list(self._streams.values()):
+                if s.finished:
+                    continue
+                self._handoff_one(s, "device_lost")
+                self.device_lost_evicted += 1
+                handed += 1
+        hook = self.on_device_lost
+        if hook is None:
+            raise err
+        replacement = hook(err)  # raises = unrecoverable -> sticky error
+        with self._lock:
+            if replacement is not None:
+                self.model, self.params = replacement
+            self.remeshes += 1
+        # every slot was freed above: device state re-inits clean on
+        # the replacement model (compile buckets retrace on demand)
+        self._reset_device_state(clear_jit_lrus=True)
+        log.warning(
+            "%s: device lost (%s) — %d stream(s) handed off, model "
+            "rebuilt on survivors (remesh #%d)",
+            self.name, err, handed, self.remeshes)
 
     def _reap_cancelled(self) -> None:
         """Free slots of streams cancelled since the last boundary and
@@ -723,11 +899,21 @@ class SlotEngine:
             ]
             budget = (self.prefill_priority if have_decode
                       else max(1, len(prefilling)))
-            for s in prefilling:
-                if budget <= 0:
-                    break
-                budget -= 1
-                self._prefill_one(s)
+            try:
+                for s in prefilling:
+                    if budget <= 0:
+                        break
+                    budget -= 1
+                    self._prefill_one(s)
+            except DeviceOomError:
+                # prefill state is re-entrant (prefill_pos advanced only
+                # on success): shed a slot and re-run next iteration
+                self._handle_oom()
+                self._recover_donated_cache()
+                continue
+            except DeviceLostError as e:
+                self._handle_device_lost(e)
+                continue
 
             # ---- decode phase: k tokens for every active slot in ONE
             # lax.scan dispatch (k = min(chunk, min remaining), so every
@@ -749,10 +935,22 @@ class SlotEngine:
             active = np.zeros((self.slots,), np.int32)
             for s in decoding:
                 active[s.slot] = 1
-            self._cache, tok, gen, toks = self._decode_fn(k)(
-                self.params, self._cache, self._tok_vec,
-                self._gen_vec, active,
-            )
+            try:
+                self._cache, tok, gen, toks = self._device_step(
+                    self._decode_fn(k),
+                    self.params, self._cache, self._tok_vec,
+                    self._gen_vec, active,
+                )
+            except DeviceOomError:
+                # the step raised before any state assignment: shed the
+                # lowest-priority slot (its tokens survive as a
+                # resumable chunk) and retry on the smaller batch
+                self._handle_oom()
+                self._recover_donated_cache()
+                continue
+            except DeviceLostError as e:
+                self._handle_device_lost(e)
+                continue
             # materialize BEFORE emission: a yielded token must EXIST,
             # not merely be dispatched (generator element contract)
             toks_host = np.asarray(toks)  # (slots, k)
@@ -813,8 +1011,8 @@ class SlotEngine:
         n = min(self.prefill_chunk, tp - s.prefill_pos)
         toks = s.prefill_src[:, s.prefill_pos:s.prefill_pos + n].astype(
             np.int32)
-        self._cache, logits = self._prefill_fn(n)(
-            self.params, self._cache, toks, slot)
+        self._cache, logits = self._device_step(
+            self._prefill_fn(n), self.params, self._cache, toks, slot)
         s.prefill_pos += n
         with self._lock:
             self.prefill_chunks += 1

@@ -216,6 +216,31 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.mesh.tp": ("gauge", "tensor-parallel axis size of the serving mesh"),
     "nns.mesh.scatters": ("counter", "host micro-batches scattered onto the mesh"),
 
+    # -- device-resource resilience (OOM / device loss; core/resilience.py)
+    "nns.device.oom_retries": ("counter", "invokes retried after a device OOM"),
+    "nns.device.oom_shrinks": ("counter", "micro-batches split to a smaller bucket on OOM"),
+    "nns.device.oom_evictions": ("counter", "cache/pool entries trimmed by OOM recovery"),
+    "nns.device.lost": ("counter", "device-loss events seen by the element"),
+    "nns.device.remeshes": ("counter", "backends/models rebuilt on surviving devices"),
+    "nns.device.degraded": ("gauge", "1 while serving in a reduced (post-loss) configuration"),
+    "nns.gen.oom_retries": ("counter", "slot-engine device steps retried after an OOM"),
+    "nns.gen.oom_sheds": ("counter", "slots shed resumably to relieve HBM pressure"),
+    "nns.gen.device_lost": ("counter", "lost-device events survived by the slot engine"),
+    "nns.gen.device_lost_evicted": ("counter", "live streams handed off on device loss"),
+    "nns.gen.remeshes": ("counter", "slot models rebuilt on surviving devices"),
+
+    # -- memory-pressure watermarks (core/liveness.py monitor) -------------
+    "nns.mem.bytes_in_use": ("gauge", "device HBM bytes in use (most-loaded chip)"),
+    "nns.mem.bytes_limit": ("gauge", "device HBM capacity (most-loaded chip; 0 = unreported)"),
+    "nns.mem.host_rss": ("gauge", "process resident set size, bytes"),
+    "nns.mem.fraction": ("gauge", "watermark fraction driving the pressure state"),
+    "nns.mem.pressure": ("gauge", "1 while above the high memory watermark (hysteresis)"),
+    "nns.mem.polls": ("counter", "watermark evaluations (sweeper cadence)"),
+    "nns.mem.trims": ("counter", "pool/cache trim sweeps fired at the high watermark"),
+    "nns.mem.trimmed_entries": ("counter", "entries freed by memory-pressure trims"),
+    "nns.mem.incidents": ("counter", "sustained-pressure flight-recorder incidents"),
+    "nns.query.memory_shed": ("counter", "requests shed with BUSY at the memory watermark"),
+
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
     "nns.wire.corrupt_dropped": ("counter", "undecodable pub/sub frames dropped"),
@@ -226,6 +251,8 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.pool.device_allocated": ("counter", "staging buffers freshly allocated"),
     "nns.pool.device_reused": ("counter", "staging buffers reused"),
     "nns.pool.device_reuse_rate": ("gauge", "staging-buffer reuse fraction"),
+    "nns.pool.rings_evicted": ("counter", "staging-buffer rings evicted by the key-space LRU"),
+    "nns.pool.trims": ("counter", "staging-pool memory-pressure trims"),
     # flight recorder
     "nns.flight.dumps": ("counter", "flight-recorder incident dumps written"),
 }
@@ -300,6 +327,29 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "mesh_tp": "nns.mesh.tp",
     "mesh_scatters": "nns.mesh.scatters",
     "profiler_active": "nns.profiler.active",
+    # device-resource resilience (filter + slot engine)
+    "oom_retries": "nns.device.oom_retries",
+    "oom_shrinks": "nns.device.oom_shrinks",
+    "oom_evictions": "nns.device.oom_evictions",
+    "device_lost": "nns.device.lost",
+    "remeshes": "nns.device.remeshes",
+    "degraded": "nns.device.degraded",
+    "gen_oom_retries": "nns.gen.oom_retries",
+    "gen_oom_sheds": "nns.gen.oom_sheds",
+    "gen_device_lost": "nns.gen.device_lost",
+    "gen_device_lost_evicted": "nns.gen.device_lost_evicted",
+    "gen_remeshes": "nns.gen.remeshes",
+    # memory-pressure watermarks (serversrc health row)
+    "mem_bytes_in_use": "nns.mem.bytes_in_use",
+    "mem_bytes_limit": "nns.mem.bytes_limit",
+    "mem_host_rss": "nns.mem.host_rss",
+    "mem_fraction": "nns.mem.fraction",
+    "mem_pressure": "nns.mem.pressure",
+    "mem_polls": "nns.mem.polls",
+    "mem_trims": "nns.mem.trims",
+    "mem_trimmed_entries": "nns.mem.trimmed_entries",
+    "mem_incidents": "nns.mem.incidents",
+    "memory_shed": "nns.query.memory_shed",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by
@@ -1226,4 +1276,8 @@ def collect_pipeline(pipe) -> List[Sample]:
                       DEVICE_POOL.reused, "counter"))
     out.append(Sample("nns.pool.device_reuse_rate", dict(base),
                       DEVICE_POOL.reuse_rate, "gauge"))
+    out.append(Sample("nns.pool.rings_evicted", dict(base),
+                      DEVICE_POOL.rings_evicted, "counter"))
+    out.append(Sample("nns.pool.trims", dict(base),
+                      DEVICE_POOL.trims, "counter"))
     return out

@@ -507,6 +507,10 @@ class QueryServerCore:
             # a truncated tenant table is never silent
             "tenants": snap.get("tenants", {}),
             "tenants_evicted": snap.get("tenants_evicted", 0),
+            # memory-watermark sheds (reason="memory"): requests refused
+            # because the chip was near HBM exhaustion — the "shed BUSY
+            # before the OOM" contract, counted exactly
+            "memory_shed": snap.get("memory_shed", 0),
             "ingress_depth": self.ingress.qsize(),
             "corrupt_requests": self.corrupt_requests,
             "draining": self.draining,

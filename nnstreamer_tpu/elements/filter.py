@@ -36,7 +36,7 @@ from ..core.feed import CompletionWindow, HostStagingLane, StagedBatch
 from ..core.lifecycle import HotSwapCoordinator, SwapTicket
 from ..core.liveness import StallError
 from ..core.model_uri import resolve_model_uri
-from ..core.resilience import FAULTS
+from ..core.resilience import FAULTS, DeviceLostError, DeviceOomError
 from ..core.telemetry import TL_INVOKE_META, TL_RX_META
 from ..core.types import ANY, FORMAT_FLEXIBLE, StreamSpec
 from ..pipeline.element import ElementError, Property, TransformElement, element
@@ -137,6 +137,11 @@ def _parse_combination(text: str) -> Optional[List[Tuple[str, int]]]:
 _STACK_JIT_MAX = 64
 _stack_jit_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
 _stack_jit_lock = threading.Lock()
+
+
+def _never() -> bool:
+    """``is_deleted`` stand-in for host arrays (numpy has no donation)."""
+    return False
 
 
 def _stack_tensors(arrs: List[Any]):
@@ -379,6 +384,18 @@ class TensorFilter(TransformElement):
         # hot-swap coordinator (core/lifecycle.py), created on the first
         # reload request; None keeps the per-call check to one attr read
         self._swapper: Optional[HotSwapCoordinator] = None
+        # device-resource resilience (core/resilience.py taxonomy):
+        # lifetime accounting + the degraded-mesh override a re-shard
+        # leaves behind (a restart keeps serving the shrunk mesh — the
+        # dead chip is still dead)
+        self._oom_retries = 0     # invokes retried after a device OOM
+        self._oom_shrinks = 0     # micro-batches split to a smaller bucket
+        self._oom_evictions = 0   # cache/pool entries trimmed on OOM
+        self._device_lost = 0     # lost-device events seen
+        self._remeshes = 0        # backends rebuilt on surviving devices
+        self._degraded = False    # serving in a reduced configuration
+        self._mesh_override: Optional[str] = None
+        self._mesh_exclude: Tuple[int, ...] = ()
 
     @property
     def batch_through_active(self) -> bool:
@@ -538,6 +555,15 @@ class TensorFilter(TransformElement):
         props = dict(self.props)
         enabled, wishes = parse_accelerator(self.props["accelerator"])
         props["accelerators"] = wishes if enabled else ["cpu"]
+        if self._mesh_override is not None:
+            # degraded re-shard: every backend built from here on (the
+            # re-mesh itself, later hot swaps, restarts) claims only the
+            # surviving devices at the shrunk mesh config — which
+            # REPLACES any legacy mesh_* custom props outright
+            props["mesh"] = self._mesh_override
+            props["mesh_remesh_override"] = True
+        if self._mesh_exclude:
+            props["mesh_exclude_ids"] = list(self._mesh_exclude)
         be.open(model, props)
         return be
 
@@ -927,6 +953,136 @@ class TensorFilter(TransformElement):
             return self.backend.timed_invoke_batch(inputs)
         return self._observed_invoke(True, inputs)
 
+    # -- device-resource resilience (degrade, don't die) ---------------------
+    def _resilient_invoke(self, inputs: List[Any]) -> List[Any]:
+        """Per-frame invoke with the OOM/device-loss recovery ladder."""
+        try:
+            return self._backend_invoke(inputs)
+        except DeviceOomError:
+            # a single frame has no batch to split: trim recreatable
+            # memory and retry the frame once
+            self._oom_retries += 1
+            self._trim_for_oom()
+            return self._backend_invoke(inputs)
+        except DeviceLostError as e:
+            self._remesh_after_loss(e)
+            return self._backend_invoke(inputs)
+
+    def _resilient_invoke_batch(
+        self, inputs: List[Any], private: bool = False
+    ) -> List[Any]:
+        """Micro-batch invoke with the recovery ladder: on device OOM,
+        trim recreatable memory and retry ONCE at the next-smaller
+        batch bucket (the halves re-bucket through the backend's own
+        ``_pad_rows`` machinery — a strictly smaller compile bucket,
+        hence a strictly smaller peak working set); on device loss,
+        re-mesh onto the survivors and retry.  Retries never donate:
+        both halves slice the same underlying arrays."""
+        try:
+            return self._backend_invoke_batch(inputs, private=private)
+        except DeviceOomError:
+            if any(getattr(t, "is_deleted", _never)() for t in inputs):
+                # the donated first attempt consumed its inputs before
+                # the OOM landed (donation invalidates at dispatch, not
+                # at success): nothing left to slice — surface the
+                # typed transient error to supervision instead of
+                # crashing on a deleted array
+                raise
+            self._oom_retries += 1
+            self._trim_for_oom()
+            n = int(inputs[0].shape[0])
+            if n <= 1:
+                return self._backend_invoke_batch(inputs)
+            self._oom_shrinks += 1
+            self.log.warning(
+                "device OOM on a %d-row micro-batch: trimmed caches, "
+                "retrying as two half-bucket invokes", n)
+            h = (n + 1) // 2
+            out1 = self._backend_invoke_batch([t[:h] for t in inputs])
+            out2 = self._backend_invoke_batch([t[h:] for t in inputs])
+            return [
+                _concat_tensors([a, b]) for a, b in zip(out1, out2)
+            ]
+        except DeviceLostError as e:
+            self._remesh_after_loss(e)
+            if any(getattr(t, "is_deleted", _never)() for t in inputs):
+                # donated inputs died with the device: the re-mesh cures
+                # the NEXT frames; this one surfaces typed to supervision
+                raise
+            return self._backend_invoke_batch(inputs)
+
+    def _trim_for_oom(self) -> None:
+        """Release every recreatable byte before the retry: the
+        backend's compiled-program cache and the process staging-buffer
+        pool (exact ``oom_evictions`` accounting)."""
+        from ..core.buffer import DEVICE_POOL
+
+        freed = 0
+        be = self.backend
+        if be is not None:
+            freed += int(be.trim_caches() or 0)
+        freed += DEVICE_POOL.trim()
+        self._oom_evictions += freed
+
+    def _remesh_after_loss(self, err: DeviceLostError) -> None:
+        """Degraded-mesh re-shard: build a replacement backend on the
+        surviving devices (``parallel/mesh.shrink_axes`` ladder via the
+        backend's ``remesh_spec_after_loss``), swap the serving pointer
+        atomically once the replacement is FULLY staged, retire the
+        wounded backend through the hot-swap graveyard (closed only
+        after the in-flight window drains), and mark this element —
+        and, via the pipeline, the serving plane — degraded.  Backends
+        with no re-mesh story (or shared backends, whose pointer this
+        element does not own) re-raise into supervision: an element
+        restart re-picks devices."""
+        self._device_lost += 1
+        be = self.backend
+        if be is None or not self._owns_backend:
+            # shared backends (pointer not ours) re-raise untouched —
+            # checked BEFORE remesh_spec_after_loss, whose per-device
+            # liveness probe may block against a wedged runtime only to
+            # have its result discarded here
+            raise err
+        reported = getattr(err, "device_ids", ()) or ()
+        res = be.remesh_spec_after_loss(reported)
+        if res is None:
+            # no re-mesh story (unsharded, or the probe found every
+            # mesh member alive): record any ordinals PROVABLY dead so
+            # the supervision restart cannot re-pick the dead chip —
+            # open()'s survivor placement honors the exclusion even
+            # unsharded — then escalate
+            dead = be.dead_ordinals_after_loss(reported)
+            if dead:
+                self._mesh_exclude = tuple(
+                    set(self._mesh_exclude) | set(dead))
+            raise err
+        spec, lost = res
+        self._mesh_override = spec
+        # always exclude the identified dead members (reported, probed,
+        # or conservatively guessed): ordinal-first claiming would
+        # otherwise hand the rebuilt backend the dead chip back
+        self._mesh_exclude = tuple(set(self._mesh_exclude) | set(lost))
+        model = self.props["model"] or None
+        if model:
+            model = resolve_model_uri(model)
+        self.log.error(
+            "device lost (%s): re-sharding onto survivors as mesh=%r",
+            err, spec or "unsharded")
+        new_be = self._make_backend(model)  # fully staged before return
+        new_be.degraded = True
+        old_be, self.backend = self.backend, new_be
+        self._win_async = None  # re-latch for the fresh backend
+        self._ensure_swapper().discard(old_be)  # reaped at a drained boundary
+        self._remeshes += 1
+        self._degraded = True
+        p = self._pipeline
+        if p is not None:
+            p.incident("device_lost", self.name, {
+                "lost_devices": list(lost), "remesh": spec or "unsharded",
+            })
+            p.degraded_feedback(
+                self.name, f"device lost; serving on mesh={spec or 'none'}")
+
     def _observed_invoke(self, batched: bool, inputs: List[Any]) -> List[Any]:
         """Invoke inside the post-swap observation window: an error is
         served by the RETAINED old model (zero frame loss) and counted;
@@ -993,6 +1149,18 @@ class TensorFilter(TransformElement):
             # jax-profiler session held by this element (trace=1) —
             # exported as nns.profiler.active via the health collector
             "profiler_active": 1 if getattr(self, "_tracing", False) else 0,
+            # device-resource resilience (nns.device.*): exact OOM
+            # shrink-retry / trim / re-mesh accounting, plus the
+            # degraded flag the discovery plane mirrors
+            "oom_retries": self._oom_retries,
+            "oom_shrinks": self._oom_shrinks,
+            "oom_evictions": self._oom_evictions,
+            "device_lost": self._device_lost,
+            "remeshes": self._remeshes,
+            "degraded": 1 if (
+                self._degraded
+                or (self.backend is not None and self.backend.degraded)
+            ) else 0,
         }
         if self._swapper is not None:
             info.update(self._swapper.snapshot())
@@ -1175,11 +1343,11 @@ class TensorFilter(TransformElement):
             # part of one frame's shape (and a mesh backend would
             # REPLICATE instead of shard).  invoke_batch's per-frame
             # fallback covers batchless backends.
-            outputs = self._backend_invoke_batch(inputs)
+            outputs = self._resilient_invoke_batch(inputs)
             dt = time.perf_counter() - t0
             self._record_stats(dt, frame.batch_size)
         else:
-            outputs = self._backend_invoke(inputs)
+            outputs = self._resilient_invoke(inputs)
             dt = time.perf_counter() - t0
             self._record_stats(dt, 1)
         self._stamp_invoke_spans((frame,), 0.0, dt)
@@ -1262,7 +1430,7 @@ class TensorFilter(TransformElement):
 
         FAULTS.check("filter.invoke", interrupt=lambda: self.interrupted)
         t0 = time.perf_counter()
-        out_b = self._backend_invoke_batch(batched, private=private)
+        out_b = self._resilient_invoke_batch(batched, private=private)
         dt = time.perf_counter() - t0
         self._record_stats(dt, nlogical)
         self._stamp_invoke_spans(

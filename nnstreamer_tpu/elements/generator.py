@@ -130,6 +130,15 @@ class TensorGenerator(Element):
         self._mesh_axes = {}
         self._resume_sig = None   # token-sequence signature (slotted)
         self._resume_rejects = 0  # RESUME requests refused (mismatch)
+        # device-loss resilience: lifetime degraded state + the device
+        # ordinals excluded from any future mesh claim (the dead stay
+        # dead across restarts of this element)
+        self._degraded = False
+        self._mesh_exclude = ()
+        self._mesh_override = None  # survivor spec a re-shard leaves behind
+        self._zoo_props = {}      # parsed custom dialect (rebuild hook)
+        self._slots = 0
+        self._sim = False
 
     def start(self):
         import jax
@@ -147,7 +156,14 @@ class TensorGenerator(Element):
             raise ElementError(f"{self.name}: slots must be >= 0")
         mesh = None
         self._mesh_axes = {}
-        if self.props["mesh"]:
+        mesh_spec = self.props["mesh"]
+        if self._mesh_override is not None:
+            # a degraded re-shard left a survivor config behind: any
+            # later restart keeps serving the shrunk mesh ("" =
+            # unsharded) — the original spec no longer fits once the
+            # dead ordinals are excluded from the claim
+            mesh_spec = self._mesh_override
+        if mesh_spec:
             from ..parallel.mesh import (
                 claim_devices,
                 make_mesh,
@@ -155,7 +171,7 @@ class TensorGenerator(Element):
             )
 
             try:
-                axes = parse_mesh_spec(self.props["mesh"])
+                axes = parse_mesh_spec(mesh_spec)
             except ValueError as e:
                 raise ElementError(f"{self.name}: {e}") from None
             if axes and set(axes) != {"tp"}:
@@ -170,7 +186,10 @@ class TensorGenerator(Element):
                     f"{self.name}: mesh= requires slots >= 1 (the mesh "
                     "serves the slot batch)")
             if axes:
-                mesh = make_mesh(axes, devices=claim_devices(axes))
+                mesh = make_mesh(
+                    axes,
+                    devices=claim_devices(
+                        axes, exclude=self._mesh_exclude))
                 self._mesh_axes = {k: mesh.shape[k] for k in axes}
         self._mesh = mesh
         # slotted mode needs its OWN mailbox + dispatch thread: the
@@ -209,7 +228,10 @@ class TensorGenerator(Element):
                 # async-sim proxy (PR-6 discipline): deterministic token
                 # recurrence + TPU-shaped step costs — drives the slot
                 # SCHEDULER through the full pipeline without a model
-                # (perf floors + chaos harness)
+                # (perf floors + chaos harness).  sim_oom_step /
+                # sim_lost_step are the device-resource chaos twins:
+                # decode attempt N raises the typed OOM / device-loss
+                # error exactly once (core/resilience.py taxonomy).
                 model = SimSlotModel(
                     slots,
                     vocab=int(props.get("vocab", "997")),
@@ -218,6 +240,10 @@ class TensorGenerator(Element):
                         props.get("sim_per_slot_ms", "0.05")),
                     prefill_ms_per_token=float(
                         props.get("sim_prefill_ms", "0.02")),
+                    oom_at_step=(int(props["sim_oom_step"])
+                                 if "sim_oom_step" in props else None),
+                    lost_at_step=(int(props["sim_lost_step"])
+                                  if "sim_lost_step" in props else None),
                 )
                 params = None
                 self._max_seq = int(props.get("seq", str(1 << 30)))
@@ -226,7 +252,11 @@ class TensorGenerator(Element):
 
                 model, params, self._max_seq = build_slot_stream(
                     props, slots, mesh=mesh)
+                params = self._place_on_survivor(params, mesh)
             self._params = params
+            self._zoo_props = dict(props)
+            self._slots = slots
+            self._sim = sim
             self._engine = SlotEngine(
                 model, params,
                 max_seq=self._max_seq,
@@ -236,6 +266,7 @@ class TensorGenerator(Element):
                 token_budget_s=float(self.props["token-budget-s"]),
                 name=self.name,
                 resume_sig=self._resume_sig,
+                on_device_lost=self._rebuild_on_device_loss,
             )
             self._engine.start()
             return
@@ -290,6 +321,9 @@ class TensorGenerator(Element):
             # both paths refuse resumes they cannot validate (the
             # pre-slot path refuses ALL of them)
             "gen_resume_rejects": self._resume_rejects,
+            # device-loss resilience: 1 while serving in a reduced
+            # configuration (mirrored on the discovery plane)
+            "degraded": 1 if self._degraded else 0,
         }
         if self._engine is not None:
             info.update(self._engine.snapshot())
@@ -334,6 +368,103 @@ class TensorGenerator(Element):
                     f"({eng.heartbeat.age_s():.1f}s, "
                     f"pending={eng.pending()})")
         return eng.pop_ready()
+
+    # -- device-loss resilience (degrade, don't die) -------------------------
+    def _place_on_survivor(self, params, mesh):
+        """Commit an UNSHARDED build's params to a surviving device when
+        past losses excluded ordinals — the default placement would hand
+        the dead chip back (``host_init`` pins builds to cpu:0 by
+        design, so the exclusion must be applied post-build; the jitted
+        steps then follow the committed params).  Identity with a mesh
+        (the claim already excludes the dead) or with no exclusions."""
+        if mesh is not None or not self._mesh_exclude or params is None:
+            return params
+        import jax
+
+        from ..core.resilience import DeviceLostError
+
+        dead = {int(i) for i in self._mesh_exclude}
+        for d in jax.devices():
+            if int(d.id) not in dead:
+                params = jax.device_put(params, d)
+                jax.block_until_ready(params)
+                return params
+        raise DeviceLostError(
+            "no surviving device to place on",
+            device_ids=tuple(sorted(dead)))
+
+    def _rebuild_on_device_loss(self, err):
+        """SlotEngine ``on_device_lost`` hook (runs on the PUMP thread,
+        after every live stream was handed off with resume state):
+        rebuild the slotted model on the surviving devices — the
+        ``parallel/mesh.shrink_axes`` ladder, tp halving down to
+        unsharded — and mark this server degraded on the discovery
+        plane.  Token sequences are untouched (the resume signature
+        deliberately excludes the mesh), so streams that resume HERE
+        stay bit-exact.  The sim twin recovers in place (no devices to
+        lose for real); a real UNSHARDED model has no survivor to
+        rebuild on — the loss re-raises into supervision, whose element
+        restart re-picks devices."""
+        if not self._sim and self._mesh is None:
+            self.log.error(
+                "device lost (%s): unsharded model has no survivors to "
+                "re-mesh onto — escalating to supervision", err)
+            raise err
+        was_degraded = self._degraded
+        self._degraded = True
+        replacement = None
+        detail = "sim"
+        if not self._sim:
+            from ..backends.jax_xla import probe_device_ids
+            from ..models.transformer import build_slot_stream
+            from ..parallel.mesh import (
+                claim_devices,
+                make_mesh,
+                remesh_after_loss,
+            )
+
+            current = [int(d.id) for d in self._mesh.devices.flat]
+            dead, axes, spec = remesh_after_loss(
+                current, self._mesh_axes,
+                getattr(err, "device_ids", ()) or (),
+                probe=probe_device_ids)
+            if not dead:
+                # the probe reached every mesh member — the loss did
+                # not reproduce: escalate to supervision (the restart
+                # re-picks devices; streams already handed off resume
+                # anywhere) instead of condemning a healthy chip
+                self._degraded = was_degraded
+                self.log.error(
+                    "device lost (%s): probe found all mesh members "
+                    "alive — escalating to supervision", err)
+                raise err
+            self._mesh_exclude = tuple(
+                set(self._mesh_exclude) | set(dead))
+            # later restarts must claim the SHRUNK config: the original
+            # spec no longer fits once the dead ordinals are excluded
+            self._mesh_override = spec
+            mesh = None
+            if axes:
+                mesh = make_mesh(
+                    axes,
+                    devices=claim_devices(axes, exclude=self._mesh_exclude))
+            detail = spec or "unsharded"
+            self.log.error(
+                "device lost (%s): rebuilding slot model on survivors "
+                "as mesh=%s", err, detail)
+            model, params, self._max_seq = build_slot_stream(
+                self._zoo_props, self._slots, mesh=mesh)
+            params = self._place_on_survivor(params, mesh)
+            self._mesh = mesh
+            self._mesh_axes = axes if mesh is not None else {}
+            self._params = params
+            replacement = (model, params)
+        p = self._pipeline
+        if p is not None:
+            p.incident("device_lost", self.name, {"remesh": detail})
+            p.degraded_feedback(
+                self.name, f"device lost; decoding on mesh={detail}")
+        return replacement
 
     def note_stream_drain(self) -> None:
         """The query serversrc of this pipeline entered its drain

@@ -37,6 +37,7 @@ from ..core.liveness import (
     parse_tenant_quotas,
 )
 from ..core.routing import (
+    TIER_DEGRADED,
     TIER_DOWN,
     TIER_DRAINING,
     TIER_OK,
@@ -141,6 +142,22 @@ class TensorQueryServerSrc(SourceElement):
             float, 5.0, "seconds of uninterrupted tenant-quota shedding "
             "before a rate-limited flight-recorder incident names the "
             "tenant"),
+        # memory watermarks (core/liveness.py MemoryPressureMonitor):
+        # shed BUSY at admission while the chip is near HBM exhaustion,
+        # BEFORE an invoke can OOM — the degrade-don't-die coupling
+        "mem-high-watermark": Property(
+            float, 0.0, "arm the pipeline's memory-pressure monitor at "
+            "this device-HBM/host-RSS fraction: crossing it sheds every "
+            "request with BUSY (reason=memory) and trims recreatable "
+            "pools/caches until pressure clears (0 = off; equivalent to "
+            "Pipeline.enable_memory_monitor)"),
+        "mem-low-watermark": Property(
+            float, 0.0, "pressure clears once the watermark fraction "
+            "falls back to this (hysteresis; 0 = 0.8 * high)"),
+        "mem-sustain": Property(
+            float, 2.0, "seconds of sustained pressure before a "
+            "rate-limited memory_pressure flight-recorder incident "
+            "(thread profiler attached)"),
         # data-plane integrity (Documentation/wire-protocol.md): corrupt
         # requests are refused at the door ('C' / DATA_LOSS) without the
         # server dying; off = serve whatever decodes (debug only)
@@ -168,6 +185,10 @@ class TensorQueryServerSrc(SourceElement):
         self._announcement = None
         self._drain_requested = threading.Event()
         self._lc_state = "serving"  # serving | draining | stopped
+        # device-loss resilience: a serving element of this pipeline
+        # lost a device and re-sharded — the announce carries it so
+        # fleet routing deprioritizes this server (TIER_DEGRADED)
+        self._degraded = False
 
     def request_drain(self) -> None:
         """Begin the rolling-restart drain of THIS server: GOAWAY to new
@@ -199,6 +220,24 @@ class TensorQueryServerSrc(SourceElement):
             )
         except ValueError as e:
             raise ElementError(f"{self.name}: {e}") from None
+        # memory-watermark coupling (core/liveness.py): when the owning
+        # pipeline armed a MemoryPressureMonitor, admission sheds BUSY
+        # (reason="memory") while the watermark is crossed — the server
+        # refuses work BEFORE the chip OOMs.  One attr read when unarmed.
+        self._core.admission.pressure = self._memory_pressured
+        high = float(self.props["mem-high-watermark"])
+        if high > 0:
+            p = self._pipeline
+            if p is not None and p.memory_monitor is None:
+                low = float(self.props["mem-low-watermark"]) or high * 0.8
+                try:
+                    # runs before the pipeline's _arm_watchdog pass, so
+                    # the sweeper thread picks the monitor up
+                    p.enable_memory_monitor(
+                        high=high, low=low,
+                        sustain_s=float(self.props["mem-sustain"]))
+                except ValueError as e:
+                    raise ElementError(f"{self.name}: {e}") from None
         self._core.busy_retry_after = float(self.props["retry-after"])
         self._core.verify_checksum = bool(self.props["verify-checksum"])
         # clamp to a version the codecs speak: the gRPC reply path hands
@@ -248,9 +287,10 @@ class TensorQueryServerSrc(SourceElement):
                 "host": host, "port": self._core.port,
                 "connect_type": self.props["connect-type"],
                 # discovery-plane health: clients deprioritize a
-                # draining host from the broker state alone, before the
-                # first GOAWAY round trip
+                # draining or degraded host from the broker state
+                # alone, before the first GOAWAY/failure round trip
                 "draining": False,
+                "degraded": self._degraded,
                 "inflight": 0,
             },
             logger=self.log,
@@ -268,11 +308,34 @@ class TensorQueryServerSrc(SourceElement):
         try:
             self._announcement.update({
                 "draining": bool(draining),
+                "degraded": bool(self._degraded),
                 "inflight": (self._core.admission.inflight
                              if self._core is not None else 0),
             }, wait_ack=False)
         except Exception as e:  # noqa: BLE001 — broker I/O is best-effort
             self.log.warning("draining announce update failed: %s", e)
+
+    def note_degraded(self, detail: str = "") -> None:
+        """Pipeline feedback (``Pipeline.degraded_feedback``): a serving
+        element of this pipeline lost a device and re-sharded onto
+        survivors.  Re-publish the retained announce with
+        ``degraded:true`` so fleet routing deprioritizes this server
+        (TIER_DEGRADED) before its next failure — the server keeps
+        serving correctly, it just stops winning placement races."""
+        if self._degraded:
+            return
+        self._degraded = True
+        self.log.warning(
+            "server degraded (%s); announcing degraded:true", detail)
+        self._announce_state(draining=self._lc_state == "draining")
+
+    def _memory_pressured(self) -> bool:
+        """Admission's memory-watermark probe: True while the owning
+        pipeline's MemoryPressureMonitor is above the high watermark
+        (two attribute reads when no monitor is armed)."""
+        p = self._pipeline
+        mon = p.memory_monitor if p is not None else None
+        return mon is not None and mon.pressured
 
     def _on_sustained_shed(self, tenant: str) -> None:
         """A tenant's quota sheds persisted past shed-window: dump the
@@ -300,9 +363,15 @@ class TensorQueryServerSrc(SourceElement):
 
     def health_info(self) -> dict:
         """Admission/load-shed counters merged into Pipeline.health()."""
-        info = {"lifecycle": self._lc_state}
+        info = {"lifecycle": self._lc_state,
+                "degraded": 1 if self._degraded else 0}
         if self._core is not None:
             info.update(self._core.liveness_snapshot())
+        p = self._pipeline
+        mon = p.memory_monitor if p is not None else None
+        if mon is not None:
+            # nns.mem.* watermark gauges ride the server's health row
+            info.update(mon.snapshot())
         return info
 
     def frames(self) -> Iterator[TensorFrame]:
@@ -724,6 +793,7 @@ class TensorQueryClient(Element):
             try:
                 hints[(str(info["host"]), int(info["port"]))] = {
                     "draining": bool(info.get("draining", False)),
+                    "degraded": bool(info.get("degraded", False)),
                 }
             except (KeyError, TypeError, ValueError):
                 pass
@@ -760,11 +830,13 @@ class TensorQueryClient(Element):
             )
         # hints are replaced wholesale per discovery: a vanished
         # endpoint's row disappears with the membership that carried
-        # it, and only DRAINING rows are kept (absent row = healthy)
+        # it, and only DRAINING/DEGRADED rows are kept (absent row =
+        # healthy)
         with self._breakers_lock:
             self._endpoint_hints = {
                 f"{h}:{p}": hints[(h, p)] for h, p in targets
                 if hints.get((h, p), {}).get("draining")
+                or hints.get((h, p), {}).get("degraded")
             }
             import time as _time
 
@@ -1130,8 +1202,14 @@ class TensorQueryClient(Element):
                 tiers[i] = TIER_DOWN
                 continue
             h = hints.get(addrs[i]) if hints_fresh else None
-            tiers[i] = (TIER_DRAINING if h and h.get("draining")
-                        else TIER_OK)
+            if h and h.get("draining"):
+                tiers[i] = TIER_DRAINING
+            elif h and h.get("degraded"):
+                # lost a device, serving reduced: correct but wounded —
+                # deprioritized below whole servers, above draining
+                tiers[i] = TIER_DEGRADED
+            else:
+                tiers[i] = TIER_OK
         if policy != "rotate":
             ri = self._remote_inflight
             inflight = {i: ri.get(addrs[i], 0) for i in range(n)}

@@ -72,19 +72,100 @@ def parse_mesh_spec(text: str) -> Dict[str, int]:
     return axes
 
 
-def claim_devices(axes: Dict[str, int], devices: Optional[Sequence] = None):
+def claim_devices(axes: Dict[str, int], devices: Optional[Sequence] = None,
+                  exclude: Sequence[int] = ()):
     """THE device-claiming rule for a parsed serving mesh spec (shared
     by the jax-xla backend and the slotted generator): a ``-1`` wildcard
     claims every device, explicit sizes claim a sub-mesh of the first
-    N."""
+    N.  ``exclude`` removes device ORDINALS from the claimable pool
+    first — the degraded re-shard path claims the survivors of a lost
+    mesh member this way, so a rebuilt backend can never land back on
+    the dead chip."""
     import math
 
     import jax
 
     devices = list(devices if devices is not None else jax.devices())
+    if exclude:
+        dead = {int(i) for i in exclude}
+        devices = [d for d in devices if int(d.id) not in dead]
     if any(v == -1 for v in axes.values()):
         return devices
     return devices[: math.prod(axes.values())]
+
+
+def shrink_axes(axes: Dict[str, int], n_avail: int) -> Dict[str, int]:
+    """THE degraded-mesh shrink ladder: the largest mesh config that
+    fits ``n_avail`` surviving devices, derived from the serving mesh
+    ``axes``.  Data parallelism gives way first (``dp:2,tp:2`` on 3
+    survivors -> ``dp:1,tp:2`` — dp only changes batch scatter, never
+    the math); when even the non-dp product no longer fits, ``tp``
+    halves down pow2-style (params re-shard by the same rules); an
+    empty dict means "serve unsharded on one survivor".  Shared by the
+    jax-xla filter backend and the slotted generator so both re-shard
+    identically."""
+    if n_avail <= 1:
+        return {}
+    out = {k: int(v) for k, v in axes.items() if k != DP}
+    other = math.prod(out.values()) if out else 1
+    if other <= n_avail:
+        if DP in axes:
+            out[DP] = n_avail // other
+        return out
+    # non-dp axes alone no longer fit: halve tp until they do
+    tp = out.get(TP, 1)
+    rest = other // max(1, tp)
+    while tp > 1 and rest * tp > n_avail:
+        tp //= 2
+    if rest * max(1, tp) > n_avail:
+        return {}
+    if TP in out:
+        if tp > 1:
+            out[TP] = tp
+        else:
+            out.pop(TP)
+    return out
+
+
+def remesh_after_loss(current_ids: Sequence[int], axes: Dict[str, int],
+                      lost_ids: Sequence[int] = (), probe=None):
+    """THE survivors/shrink computation after a device loss, shared by
+    the jax-xla backend and the slotted generator so both re-shard
+    identically.  Identify the dead members — the runtime's reported
+    ordinals when it names them, else ``probe(current_ids)`` (a
+    per-device liveness probe; real XLA status strings usually do NOT
+    carry the ordinal), else conservatively the LAST mesh member — and
+    shrink ``axes`` to the survivors via :func:`shrink_axes`.
+
+    Returns ``(dead_ids, new_axes, spec)`` with ``spec`` the
+    :func:`mesh_spec_str` string of ``new_axes`` (``""`` = rebuild
+    unsharded).  The probe distinguishes CANNOT-PROBE (``None`` —
+    enumeration itself failed; fall back to the conservative
+    last-member guess) from ALL-ALIVE (``()`` — every member answered,
+    the loss did not reproduce): in the latter case ``dead_ids`` comes
+    back EMPTY with ``axes`` unchanged, and callers must escalate to
+    supervision (a plain retry may cure a transient) instead of
+    condemning a healthy chip.  Whenever ``dead_ids`` is non-empty,
+    every rebuild path EXCLUDES them from its device claim, so a
+    replacement backend can never land back on the chip that just
+    died."""
+    current = [int(i) for i in current_ids]
+    dead = {int(i) for i in (lost_ids or ())}
+    if not dead:
+        probed = probe(current) if probe is not None else None
+        if probed is None:
+            # no probe / probe unavailable: conservative last-member guess
+            dead = {current[-1]}
+        else:
+            dead = {int(i) for i in probed}
+    if not dead:
+        # every member answered the probe: nothing provably dead,
+        # nothing to shrink — the caller escalates to supervision
+        return (), dict(axes), mesh_spec_str(axes)
+    survivors = [i for i in current if i not in dead]
+    new_axes = shrink_axes(axes, len(survivors))
+    spec = mesh_spec_str(new_axes) if new_axes else ""
+    return tuple(sorted(dead)), new_axes, spec
 
 
 def mesh_spec_str(axes: Dict[str, int]) -> str:

@@ -296,6 +296,9 @@ class Pipeline:
         self._recorder = None
         self._metrics_server = None
         self._collector_registered = False
+        # memory-pressure watermark monitor (core/liveness.py): polled
+        # on the watchdog-sweeper cadence; None = zero cost everywhere
+        self._mem_monitor = None
         # registry label: claimed lazily (names default to "pipeline", so
         # the label must be unique among LIVE pipelines or one stop()
         # would evict a concurrent namesake's instruments)
@@ -387,6 +390,84 @@ class Pipeline:
     @property
     def flight_recorder(self):
         return self._recorder
+
+    # -- memory-pressure watermarks (core/liveness.py) -----------------------
+    def enable_memory_monitor(self, high: float = 0.90, low: float = 0.75,
+                              sustain_s: float = 2.0,
+                              host_limit_bytes: int = 0,
+                              sample=None, clock=None,
+                              min_poll_s: float = 0.25):
+        """Attach a :class:`~..core.liveness.MemoryPressureMonitor`:
+        device HBM (and host RSS) watermarks polled on the watchdog-
+        sweeper cadence — NEVER on a per-frame path.  Crossing the high
+        watermark trims the process frame/staging pools and every
+        owned filter backend's compiled-program cache; pressure
+        sustained for ``sustain_s`` fires a rate-limited
+        ``memory_pressure`` flight-recorder incident (with the
+        incident-time thread profiler attached when the recorder has
+        one); a query serversrc on this pipeline couples the monitor
+        into admission, shedding BUSY *before* the chip OOMs.  Returns
+        the monitor (``sample``/``clock`` injectable for tests)."""
+        from ..core.buffer import DEVICE_POOL, FRAME_POOL
+        from ..core.liveness import MemoryPressureMonitor
+
+        def trim_backends() -> int:
+            freed = 0
+            for el in self.elements.values():
+                be = getattr(el, "backend", None)
+                trim = getattr(be, "trim_caches", None)
+                if trim is not None:
+                    try:
+                        freed += int(trim() or 0)
+                    except Exception:
+                        self.log.exception(
+                            "trim_caches failed for %s", el.name)
+            return freed
+
+        kwargs = {}
+        if sample is not None:
+            kwargs["sample"] = sample
+        if clock is not None:
+            kwargs["clock"] = clock
+        mon = MemoryPressureMonitor(
+            high=high, low=low, sustain_s=sustain_s,
+            min_poll_s=min_poll_s, host_limit_bytes=host_limit_bytes,
+            on_pressure=lambda snap: self.incident(
+                "memory_pressure", self.name, snap),
+            trim_hooks=(FRAME_POOL.trim, DEVICE_POOL.trim, trim_backends),
+            **kwargs,
+        )
+        self._mem_monitor = mon
+        if self._started and (self._wd_thread is None
+                              or not self._wd_thread.is_alive()):
+            # armed mid-run with no sweeper: start one for the monitor
+            self._wd_thread = threading.Thread(
+                target=self._watchdog_loop, args=(mon.min_poll_s,),
+                name=f"{self.name}-watchdog", daemon=True,
+            )
+            self._wd_thread.start()
+        return mon
+
+    @property
+    def memory_monitor(self):
+        return self._mem_monitor
+
+    # -- degraded-capacity feedback (device loss) ----------------------------
+    def degraded_feedback(self, source: str, detail: str = "") -> None:
+        """An element of THIS pipeline lost a device and re-sharded onto
+        survivors: tell every element exposing ``note_degraded`` (the
+        query serversrc) so the discovery plane announces
+        ``degraded:true`` and fleet routing deprioritizes this server
+        ahead of its next failure.  Also posted on the bus."""
+        self.post(BusMessage("warning", source, {"degraded": detail}))
+        for el in self.elements.values():
+            note = getattr(el, "note_degraded", None)
+            if note is None:
+                continue
+            try:
+                note(detail)
+            except Exception:
+                self.log.exception("note_degraded failed for %s", el.name)
 
     def incident(self, kind: str, source: str, detail: Any = None
                  ) -> Optional[str]:
@@ -813,6 +894,14 @@ class Pipeline:
             or float(el.props.get("frame-deadline") or 0.0) > 0
         ]
         if not armed:
+            if self._mem_monitor is not None:
+                # no liveness watches, but the memory monitor still
+                # needs the sweeper cadence
+                self._wd_thread = threading.Thread(
+                    target=self._watchdog_loop,
+                    args=(self._mem_monitor.min_poll_s,),
+                    name=f"{self.name}-watchdog", daemon=True,
+                )
             return
         self._watchdog = Watchdog()
         for el in armed:
@@ -843,9 +932,16 @@ class Pipeline:
     def _watchdog_loop(self, interval: float) -> None:
         while not self._stop_flag.wait(interval):
             try:
-                self._watchdog.check()
+                if self._watchdog is not None:
+                    self._watchdog.check()
             except Exception:  # a sweep bug must never kill liveness
                 self.log.exception("watchdog sweep failed")
+            mon = self._mem_monitor
+            if mon is not None:
+                try:
+                    mon.poll()  # rate-limited internally
+                except Exception:
+                    self.log.exception("memory-pressure poll failed")
 
     def _on_liveness(self, el: Element, kind: str, elapsed: float) -> None:
         """Watchdog escalation (runs on the sweeper thread): bus warning

@@ -901,3 +901,31 @@ class TestFleetChaos:
         tenants = v["tenants"]
         assert tenants["A"]["shed"] == 0
         assert tenants["B"]["shed"] >= shed_b
+
+
+# ---------------------------------------------------------------------------
+# The device-loss chaos e2e (acceptance — degrade, don't die)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestDeviceLossChaos:
+    """A mesh member dies mid-decode under concurrent slotted
+    generation load: every live stream hands off with resume state and
+    lands bit-exact to the oracle, the engine re-meshes atomically onto
+    the survivors (``gen_device_lost == 1`` / ``gen_remeshes == 1``,
+    migrations exactly equal handoffs), the wounded server announces
+    ``degraded:true`` on the discovery plane (observed client-side
+    after one rediscovery and reflected in health), and ZERO breakers
+    trip anywhere — the chip died, no server did."""
+
+    def test_device_loss_survived_fleet_wide(self):
+        from chaos_fleet import run_device_loss_script
+
+        v = run_device_loss_script(servers=3, streams=4, seed=0)
+        assert v["ok"], v
+        assert v["exact"] == 4 and v["mismatched"] == 0, v
+        assert v["gen"]["gen_device_lost"] == 1, v
+        assert v["gen"]["gen_remeshes"] == 1, v
+        assert v["handed_off"] >= 1, v
+        assert v["resumes"]["stream_migrations"] == v["handed_off"], v
+        assert v["degraded_announce_seen"] and v["victim_degraded_health"], v
+        assert v["breaker_trips"] == 0, v

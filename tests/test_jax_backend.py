@@ -157,6 +157,8 @@ class TestJaxXlaInPipeline:
 
 
 class TestMobileNetV2:
+    @pytest.mark.slow  # tier-1 budget: ~20s mobilenet compile; the
+    # kws/mnist family forwards keep the zoo-backend path covered
     def test_forward_shapes_cpu(self):
         # tiny input keeps CPU compile fast; real 224 path runs in bench.py
         from nnstreamer_tpu.models import build

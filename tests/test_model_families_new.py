@@ -6,6 +6,7 @@ real .wav audio through the media ingest path.
 """
 
 import numpy as np
+import pytest
 
 from nnstreamer_tpu.backends.jax_xla import register_jax_model, unregister_jax_model
 from nnstreamer_tpu.media.wav import write_wav
@@ -14,6 +15,8 @@ from nnstreamer_tpu.pipeline import parse_pipeline
 
 
 class TestDeepLab:
+    @pytest.mark.slow  # tier-1 budget: ~19s deeplab build; the
+    # pipeline-with-segment-decoder e2e below keeps deeplab covered
     def test_build_shapes(self):
         fn, params, in_spec, out_spec = build(
             "deeplab", {"dtype": "float32", "size": "65", "classes": "5"}

@@ -22,6 +22,8 @@ class TestZoo:
 
 
 class TestSSD:
+    @pytest.mark.slow  # tier-1 budget: ~24s SSD build+decode; the
+    # decoder truth tables keep bounding-box decode covered
     def test_shapes_and_decode(self, tmp_path):
         from nnstreamer_tpu.decoders.bounding_box import BoundingBoxes
         from nnstreamer_tpu.models.ssd_mobilenet import (
@@ -51,6 +53,8 @@ class TestSSD:
 
 
 class TestYolo:
+    @pytest.mark.slow  # tier-1 budget: ~31s yolov5 build+decode; the
+    # in-graph NMS unit + decoder truth tables stay in tier-1
     def test_shapes_and_decode(self, tmp_path):
         from nnstreamer_tpu.decoders.bounding_box import BoundingBoxes
         from nnstreamer_tpu.models.yolov5 import num_candidates

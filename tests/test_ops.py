@@ -52,6 +52,8 @@ class TestBatchedNMS:
         assert keep[0, 0] and keep[0, 1]
         assert not keep[0, 2:].any() and not keep[1].any()  # padded rows
 
+    @pytest.mark.slow  # tier-1 budget: ~21s yolov5-in-graph compile;
+    # the batched-NMS kernel units above keep NMS covered
     def test_yolov5_in_graph_nms(self):
         from nnstreamer_tpu.models import build
 

@@ -145,6 +145,8 @@ class TestShardedTraining:
 
 class TestGraftEntry:
     @needs_spmd_stack
+    @pytest.mark.slow  # tier-1 budget: ~32s 8-way dryrun; the
+    # 2/4-way entry compiles keep the graft entry covered
     def test_dryrun_multichip_8(self, capsys):
         import __graft_entry__ as ge
 

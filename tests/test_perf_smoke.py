@@ -172,6 +172,82 @@ def test_telemetry_disabled_per_frame_overhead():
     )
 
 
+def test_memory_monitor_armed_identity_floor():
+    """PR-14 pin: with the memory-pressure watermark monitor ARMED
+    (sweeper-thread polling of real device/host memory stats), the
+    fused identity chain still clears the PR-3/PR-6 absolute 4000 fps
+    floor — the monitor touches NO per-frame path; its entire cost is
+    a rate-limited poll on the sweeper cadence plus one bool read per
+    ADMISSION (and this chain has no admission at all).  Structural
+    half: a pipeline without enable_memory_monitor holds no monitor
+    object, so the disabled dataplane is byte-identical to PR-13's."""
+    pipe = parse_pipeline(CHAIN, name="memperf", fuse=True)
+    mon = pipe.enable_memory_monitor(min_poll_s=0.01)
+    pipe.start()
+    src, sink = pipe["src"], pipe["out"]
+    done = {"n": 0}
+    sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+    pool = [np.zeros((64,), np.float32) for _ in range(16)]
+    for i in range(128):
+        src.push(pool[i % 16])
+    t_w = time.time()
+    while done["n"] < 128 and time.time() - t_w < 30:
+        time.sleep(0.005)
+    assert done["n"] >= 128, "warmup stalled"
+    done["n"] = 0
+    n = 2500
+    t0 = time.perf_counter()
+    for i in range(n):
+        src.push(pool[i % 16])
+    while done["n"] < n and time.perf_counter() - t0 < 60:
+        time.sleep(0.002)
+    fps = done["n"] / (time.perf_counter() - t0)
+    src.end_of_stream()
+    pipe.wait(timeout=30)
+    pipe.stop()
+    assert done["n"] == n, "frames lost with the memory monitor armed"
+    assert fps >= 4000, (
+        f"memory-monitor-armed dataplane regressed: {fps:.0f} fps < 4000"
+    )
+    # the monitor really ran on the sweeper (not on the frame path)
+    assert mon.polls > 0
+    # structural: a default pipeline holds no monitor at all
+    off = parse_pipeline(CHAIN, name="memoff", fuse=True)
+    assert off.memory_monitor is None
+
+
+def test_oom_retry_accounting_parity_fused_vs_unfused():
+    """PR-14 satellite: the OOM shrink-retry ladder produces IDENTICAL
+    outputs and identical ``oom_retries``/``oom_shrinks`` accounting
+    fused and unfused — recovery must not depend on the threading
+    topology."""
+    def run(fuse: bool):
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_filter name=f framework=async-sim custom=oom_at:0 "
+            "max-batch=8 ! tensor_sink name=out max-stored=64",
+            name=f"oomparity{fuse}", fuse=fuse)
+        pipe.start()
+        got = []
+        pipe["out"].connect_new_data(
+            lambda f: got.append(float(np.asarray(f.tensors[0])[0])))
+        pipe["src"].push_block(
+            np.arange(8, dtype=np.float32).reshape(8, 1))
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=30)
+        h = pipe.health()["f"]
+        pipe.stop()
+        # oom_evictions excluded from the parity tuple: it counts
+        # whatever the PROCESS-WIDE staging pool happened to hold when
+        # the trim fired, which earlier tests legitimately vary
+        return got, (h["oom_retries"], h["oom_shrinks"],
+                     h["dead_letters"], h["restarts"])
+    got_f, acc_f = run(True)
+    got_u, acc_u = run(False)
+    assert got_f == got_u == [v * 2.0 + 1.0 for v in range(8)]
+    assert acc_f == acc_u == (1, 1, 0, 0)
+
+
 def test_hot_path_allocation_budget():
     """tracemalloc gate: the fused dispatch loop must not RETAIN
     allocations per frame in steady state (frame-pool regression, a

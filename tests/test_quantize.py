@@ -62,6 +62,8 @@ def test_int8_dense_matches_float(rng):
     assert rel < 0.05, rel
 
 
+@pytest.mark.slow  # tier-1 budget: ~18s mobilenet PTQ compile; the
+# int8_dense/int8 matmul kernels above keep quantization covered
 def test_mobilenet_quantized_runs(rng):
     from nnstreamer_tpu.models import build
 
@@ -127,6 +129,8 @@ def test_yolov5_quantized_shares_weights_and_tracks_float(rng):
     assert corr > 0.8, corr
 
 
+@pytest.mark.slow  # tier-1 budget: ~37s double mobilenet build; the
+# kernel-level PTQ accuracy checks above stay in tier-1
 def test_mobilenet_quantized_tracks_float(rng):
     """Same weights, quantized vs float forward: logits stay correlated
     (dynamic-range PTQ keeps the prediction signal)."""

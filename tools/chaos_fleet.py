@@ -349,6 +349,27 @@ class FleetHarness:
         self.start_server(idx)
         return idx
 
+    def inject_device_loss(self, idx: int) -> None:
+        """Kill one mesh member of server ``idx``'s sim model on its
+        NEXT decode attempt (mode="generate" only): the engine hands
+        every live stream off with resume state, rebuilds on the
+        "survivors", and the server announces degraded:true — the
+        degrade-don't-die ladder under scripted, not wall-clock,
+        timing."""
+        self.servers[idx]["gen"]._engine.model.fail_next("lost")
+
+    def wait_device_lost(self, idx: int, timeout: float = 30.0) -> Dict[str, Any]:
+        """Block until server ``idx`` survived a device loss (engine
+        counter visible in health); returns its gen health row."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            row = self.server_gen_row(self.servers[idx])
+            if int(row.get("gen_device_lost", 0)) >= 1:
+                return row
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"server {idx} never reported a device loss in {timeout}s")
+
     @staticmethod
     def server_gen_row(pipe) -> Dict[str, Any]:
         """Numeric generator counters of one server (empty outside
@@ -813,6 +834,127 @@ def run_generate_resume_script(servers: int = 3, streams: int = 8,
         h.stop_all()
 
 
+def run_device_loss_script(servers: int = 3, streams: int = 8,
+                           seed: int = 0) -> Dict[str, Any]:
+    """Device-loss chaos (degrade, don't die — Documentation/
+    resilience.md "Resource pressure & device loss"): N concurrent
+    slotted generation streams are decoding on one server when a mesh
+    member DIES mid-scan.  The engine hands every live stream off as a
+    resumable continuity chunk, rebuilds its model on the survivors
+    (re-mesh), and the server announces ``degraded:true`` — clients
+    migrate the streams (possibly straight back to the degraded server:
+    the resume signature excludes the mesh, so tokens stay bit-exact)
+    and fleet routing deprioritizes the wounded host from the broker
+    state alone.
+
+    Exactness contract: every stream's concatenated tokens equal the
+    sim oracle bit-for-bit, client ``stream_migrations`` equals the
+    wounded engine's ``gen_device_lost_evicted`` (every handoff landed
+    exactly once), ``gen_device_lost == 1`` / ``gen_remeshes == 1``,
+    zero frame loss, zero resume failures, ZERO breaker trips anywhere
+    (no server died — the chip did), and the degraded announce is
+    observed client-side after one rediscovery."""
+    import random
+
+    h = FleetHarness(mode="generate", gen_slots=max(8, streams),
+                     gen_max_new=96, gen_step_ms=3.0, base_id=9900,
+                     topic="chaosdevloss")
+    rng = random.Random(seed)
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        clients = [
+            h.make_gen_client(f"C{i}", routing="least-inflight",
+                              timeout=120.0)
+            for i in range(streams)
+        ]
+        traces = [c.push_prompt() for c in clients]
+
+        def wait_tokens_each(n: int, timeout: float = 60.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if all(c.tokens_done(t) >= n
+                       for c, t in zip(clients, traces)):
+                    return
+                time.sleep(0.005)
+            raise TimeoutError(
+                f"streams never all reached {n} delivered tokens")
+
+        # seeded mid-decode loss point (chunk multiple, well inside the
+        # 96-token streams); every fresh client ranked the same
+        # lowest-address server first, so all streams share one victim
+        t_loss = 4 * rng.randint(2, 6)
+        wait_tokens_each(t_loss)
+        victim = max(
+            h.servers,
+            key=lambda i: h.servers[i].health()["gen"].get(
+                "gen_occupied", 0))
+        victim_addr = f"127.0.0.1:{h.ports[victim]}"
+        h.inject_device_loss(victim)
+        loss_row = h.wait_device_lost(victim)
+
+        # the degraded announce: visible to any client after ONE
+        # rediscovery (production clients refresh on failure waves; the
+        # script forces it so the observation is deterministic)
+        h.refresh_client(clients[0])
+        hints = dict(clients[0].element._endpoint_hints)
+        degraded_seen = bool(hints.get(victim_addr, {}).get("degraded"))
+
+        for c in clients:
+            c.settle(timeout=120.0)
+        for c in clients:
+            c.finish()
+
+        checks = [c.check_exact() for c in clients]
+        exact = sum(r["exact"] for r in checks)
+        mismatched = sum(r["mismatched"] for r in checks)
+        res = {
+            k: sum(int(c.health().get(k, 0)) for c in clients)
+            for k in ("stream_resumes", "stream_migrations",
+                      "duplicate_tokens_dropped", "resume_failures")
+        }
+        gen = h.fleet_gen()
+        victim_health = h.servers[victim].health()
+        handed_off = int(
+            victim_health["gen"].get("gen_device_lost_evicted", 0))
+        v = {
+            "streams": streams,
+            "exact": exact,
+            "mismatched": mismatched,
+            "tokens": sum(r["tokens"] for r in checks),
+            "seed": seed,
+            "loss_point": t_loss,
+            "victim": victim_addr,
+            "handed_off": handed_off,
+            "degraded_announce_seen": degraded_seen,
+            "victim_degraded_health": int(
+                victim_health["ssrc"].get("degraded", 0)),
+            "resumes": res,
+            "gen": {k: int(gen.get(k, 0)) for k in (
+                "gen_joins", "gen_completed", "gen_device_lost",
+                "gen_device_lost_evicted", "gen_remeshes",
+                "gen_resumes", "gen_tokens")},
+            # no server process died: trips anywhere are a failure
+            "breaker_trips": h.breaker_trips(),
+        }
+        v["ok"] = bool(
+            mismatched == 0 and exact == streams
+            and int(loss_row.get("gen_device_lost", 0)) == 1
+            and int(gen.get("gen_remeshes", 0)) == 1
+            # every handoff the wounded engine emitted was migrated by
+            # exactly one client, and the loss landed on live streams
+            and res["stream_migrations"] == handed_off
+            and handed_off >= 1
+            and res["resume_failures"] == 0
+            and degraded_seen
+            and v["victim_degraded_health"] == 1
+            and v["breaker_trips"] == 0
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
 def main() -> int:
     import argparse
 
@@ -826,13 +968,17 @@ def main() -> int:
     ap.add_argument("--keys", type=int, default=120,
                     help="distinct affinity sessions")
     ap.add_argument("--mode",
-                    choices=("unary", "generate", "generate-resume"),
+                    choices=("unary", "generate", "generate-resume",
+                             "device-loss"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
-                    "generation-stream fleet (continuous batching), or "
+                    "generation-stream fleet (continuous batching), "
                     "the durable-stream chaos: hard kill + rolling "
                     "restart at seeded random decode points with "
-                    "checkpointed resume / live migration")
+                    "checkpointed resume / live migration, or the "
+                    "device-loss chaos: a mesh member dies mid-decode "
+                    "— streams hand off resumably, the engine "
+                    "re-meshes, the server announces degraded")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -844,6 +990,10 @@ def main() -> int:
                                       args.streams)
     elif args.mode == "generate-resume":
         verdict = run_generate_resume_script(
+            max(2, min(args.servers, 4)), max(2, args.streams),
+            args.seed)
+    elif args.mode == "device-loss":
+        verdict = run_device_loss_script(
             max(2, min(args.servers, 4)), max(2, args.streams),
             args.seed)
     else:
