@@ -486,6 +486,21 @@ def _drive_generate(custom: str, slot_width: int, prompts, max_new: int,
             raise RuntimeError(
                 f"generate warmup incomplete after {timeout_s}s")
         arrivals.clear()
+        # fleet-rollup evidence (slotted runs): digest the pipeline at
+        # the window edges through the SAME builder the serversrc
+        # publishes with, so banked generation rows carry the capacity
+        # view (tokens/s, occupancy, headroom) a fleet controller reads
+        digest_pub = None
+        if slot_width > 0:
+            from nnstreamer_tpu.core.fleet import (
+                DigestPublisher,
+                pipeline_digest_stats,
+            )
+
+            digest_pub = DigestPublisher(
+                lambda: pipeline_digest_stats(pipe), lambda d: None,
+                interval_s=0.05, name="bench")
+            digest_pub.poll(force=True)  # tokens baseline at the window
         t0 = time.perf_counter()
         for p in prompts:
             pipe["src"].push(p)
@@ -508,7 +523,7 @@ def _drive_generate(custom: str, slot_width: int, prompts, max_new: int,
             (end - t0) * 1e3 / max_new for end in per_stream_end.values()
         )
         gen_health = pipe.health()["gen"]
-        return {
+        out = {
             "tokens": got,
             "tokens_per_s": got / dt,
             "p50_ms_per_token": per_token_ms[len(per_token_ms) // 2],
@@ -519,6 +534,19 @@ def _drive_generate(custom: str, slot_width: int, prompts, max_new: int,
                 if slot_width > 0 else 1.0
             ),
         }
+        if digest_pub is not None:
+            from nnstreamer_tpu.core.fleet import FleetObservatory
+
+            d = digest_pub.poll(force=True)  # window-end digest
+            obs = FleetObservatory(topic="bench")
+            obs.ingest("bench", {"host": "local", "port": 0, "digest": d})
+            roll = obs.rollup()
+            out["fleet"] = {
+                k: roll[k] for k in (
+                    "tokens", "tokens_per_s", "occupancy",
+                    "slot_headroom", "mem_headroom_bytes", "slots")
+            }
+        return out
     finally:
         pipe["src"].end_of_stream()
         pipe.wait(timeout=30)
@@ -568,6 +596,10 @@ def measure_generate_throughput(slots: int = 4, streams: int = 4,
             serial["p50_ms_per_token"], 3),
         "slot_occupancy": round(
             slotted["tokens_per_step"] / max(1, slots), 3),
+        # fleet-rollup capacity view of the slotted run (observatory
+        # machinery — tokens/s, occupancy, admittable headroom) rides
+        # the banked row next to the telemetry dump
+        "fleet": slotted.get("fleet"),
     }
 
 

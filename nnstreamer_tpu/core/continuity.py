@@ -232,11 +232,17 @@ class StreamContinuity:
                 f"resume ledger incoherent: {total.shape[1]} tokens held "
                 f"vs {self.delivered} delivered")
         r = self.resume_point()
-        prefix = np.ascontiguousarray(total[:, :r], dtype=np.int32)
         meta: Dict[str, Any] = dict(self.frame.meta)
         meta[RESUME_REQ_META] = {
             "v": 1, "sig": self.sig, "digest": self.digest,
             "chunk": int(self.chunk), "tokens_done": int(r),
         }
-        return TensorFrame(
-            [np.asarray(self.frame.tensors[0]), prefix], meta=meta)
+        tensors = [np.asarray(self.frame.tensors[0])]
+        if r > 0:
+            tensors.append(
+                np.ascontiguousarray(total[:, :r], dtype=np.int32))
+        # r == 0 (broken before the first full chunk): a fresh full
+        # replay — NO prefix tensor, because the wire refuses (1, 0)
+        # shapes and the server's resume validation expects the prefix
+        # only when tokens_done > 0
+        return TensorFrame(tensors, meta=meta)

@@ -291,7 +291,8 @@ class SlotEngine:
                  clock: Callable[[], float] = time.monotonic,
                  name: str = "slots",
                  resume_sig: Optional[str] = None,
-                 on_device_lost: Optional[Callable[..., Any]] = None):
+                 on_device_lost: Optional[Callable[..., Any]] = None,
+                 slo=None):
         import numpy as np
 
         self._np = np
@@ -320,6 +321,12 @@ class SlotEngine:
         # place, e.g. the sim twin).  Without a hook a lost device is a
         # sticky engine error (supervision restart rebuilds the element).
         self.on_device_lost = on_device_lost
+        # per-stream SLO accounting (telemetry.SloTracker, engine side):
+        # one TTFT stamp at the first-token pick, one record_n per
+        # decode scan, one counter per terminal outcome — all on the
+        # pump thread (the tracker's single-writer contract); None =
+        # zero cost everywhere
+        self.slo = slo
         # background-thread liveness: the pump beats once per loop —
         # a pump with pending work and a stale beat is WEDGED (stuck in
         # a device call), which the sticky pop_ready error can never
@@ -633,12 +640,20 @@ class SlotEngine:
         s.state = state
         if state == "done":
             self.completions += 1
+            self._slo_stream(s, "good")
             self._emit_terminal(s)
         elif state == "evicted":
             self.evictions += 1
+            # typed expiry (deadline/pace): the SLO ledger classifies
+            # it as expired, never goodput
+            self._slo_stream(s, "expired")
             self._emit_terminal(s, extra_meta=extra_meta or {})
         # cancelled: the consumer is gone — nothing to emit
         self._free_slot(s)
+
+    def _slo_stream(self, s: GenStream, outcome: str) -> None:
+        if self.slo is not None:
+            self.slo.note_stream(s.tenant, outcome)
 
     def _sweep_deadlines(self, now: float) -> None:
         """Evict streams whose request deadline or per-token budget is
@@ -822,10 +837,14 @@ class SlotEngine:
 
     def _reap_cancelled(self) -> None:
         """Free slots of streams cancelled since the last boundary and
-        drop cancelled entries still waiting (lock held)."""
+        drop cancelled entries still waiting (lock held).  SLO
+        classification happens HERE (pump thread — the tracker's
+        single-writer contract), exactly once per cancelled stream
+        (``_free_slot`` removes it from ``_streams``)."""
         self._waiting = [w for w in self._waiting if w.state != "cancelled"]
         for s in list(self._streams.values()):
             if s.state == "cancelled":
+                self._slo_stream(s, "evicted")
                 self._free_slot(s)
 
     def _join_waiting(self, now: float) -> List[GenStream]:
@@ -981,6 +1000,12 @@ class SlotEngine:
                         s.token_budget_s > 0.0
                         and (now - s.last_token_ts) / k > s.token_budget_s
                     )
+                    # SLO per-token inter-arrival: the scan's k tokens
+                    # as k observations of the same pace — one bucket
+                    # increment, reusing the pace sweep's clock reads
+                    if self.slo is not None:
+                        self.slo.note_tokens(
+                            s.tenant, max(0.0, now - s.last_token_ts), k)
                     s.last_token_ts = now
                     s.pending.append(row.astype(np.int32))
                     s.pending_n += k
@@ -1042,6 +1067,10 @@ class SlotEngine:
         self._tok_vec[s.slot] = t1_host
         self._gen_vec[s.slot] = 1
         now = self.clock()
+        # SLO TTFT: the promised one-stamp-per-first-token — resumed
+        # streams skip it above (their first token predates this server)
+        if self.slo is not None:
+            self.slo.note_ttft(s.tenant, max(0.0, now - s.submitted_ts))
         with self._lock:
             if s.finished:  # cancelled during prefill
                 return

@@ -241,6 +241,47 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.mem.incidents": ("counter", "sustained-pressure flight-recorder incidents"),
     "nns.query.memory_shed": ("counter", "requests shed with BUSY at the memory watermark"),
 
+    # -- per-stream SLO accounting (SloTracker; tenant= label) -------------
+    "nns.slo.ttft_seconds": ("histogram", "time to first token, log2 buckets"),
+    "nns.slo.ttft_p95_ms": ("gauge", "p95 time to first token, ms (log2 estimate)"),
+    "nns.slo.ttft_burn": ("gauge", "TTFT error-budget burn rate (1.0 = consuming exactly the budget)"),
+    "nns.slo.token_seconds": ("histogram", "per-token inter-arrival time, log2 buckets"),
+    "nns.slo.token_p99_ms": ("gauge", "p99 per-token inter-arrival, ms (log2 estimate)"),
+    "nns.slo.token_burn": ("gauge", "per-token-latency error-budget burn rate"),
+    "nns.slo.availability": ("gauge", "observed goodput fraction (good / classified streams)"),
+    "nns.slo.availability_burn": ("gauge", "availability error-budget burn rate"),
+    "nns.slo.status": ("gauge", "worst armed objective: 0 met / 1 warn / 2 burned"),
+    "nns.slo.good": ("counter", "streams that completed to their final token (goodput)"),
+    "nns.slo.shed": ("counter", "streams refused by admission (BUSY exhausted)"),
+    "nns.slo.evicted": ("counter", "streams cancelled/evicted before completion"),
+    "nns.slo.expired": ("counter", "streams evicted on deadline/pace (typed expiry)"),
+    "nns.slo.errors": ("counter", "streams lost to transport/server errors"),
+
+    # -- fleet observatory (core/fleet.py; fleet= label) -------------------
+    "nns.query.digests": ("counter", "telemetry digests published on the discovery plane"),
+    "nns.fleet.servers": ("gauge", "live servers with a fresh digest"),
+    "nns.fleet.draining": ("gauge", "live servers announcing draining"),
+    "nns.fleet.degraded": ("gauge", "live servers announcing degraded"),
+    "nns.fleet.swapping": ("gauge", "live servers mid hot-swap"),
+    "nns.fleet.mem_pressured": ("gauge", "live servers above their memory watermark"),
+    "nns.fleet.inflight": ("gauge", "requests in flight fleet-wide"),
+    "nns.fleet.slots": ("gauge", "generation slots fleet-wide"),
+    "nns.fleet.occupied": ("gauge", "occupied generation slots fleet-wide"),
+    "nns.fleet.waiting": ("gauge", "prompts queued for a slot fleet-wide"),
+    "nns.fleet.occupancy": ("gauge", "fleet slot occupancy (occupied / slots)"),
+    "nns.fleet.tokens_per_s": ("gauge", "aggregate decode throughput, tokens/s (sum of live EWMAs)"),
+    "nns.fleet.slot_headroom": ("gauge", "admittable free slots on unpressured servers"),
+    "nns.fleet.mem_headroom_bytes": ("gauge", "bytes until the memory high watermark, fleet-wide"),
+    "nns.fleet.tokens": ("counter", "tokens decoded fleet-wide (retired servers included)"),
+    "nns.fleet.admitted": ("counter", "requests admitted fleet-wide (retired servers included)"),
+    "nns.fleet.shed": ("counter", "requests shed fleet-wide (retired servers included)"),
+    "nns.fleet.tenant_admitted": ("counter", "requests admitted for the tenant, fleet-wide"),
+    "nns.fleet.tenant_shed": ("counter", "requests shed for the tenant, fleet-wide"),
+    "nns.fleet.slo_burn": ("gauge", "worst per-tenant SLO burn rate across live servers"),
+    "nns.fleet.digests": ("counter", "digests ingested by the observatory"),
+    "nns.fleet.retired": ("counter", "server rows retired on announce tombstone"),
+    "nns.fleet.stale_evicted": ("counter", "server rows retired on digest TTL expiry"),
+
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
     "nns.wire.corrupt_dropped": ("counter", "undecodable pub/sub frames dropped"),
@@ -350,6 +391,8 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "mem_trimmed_entries": "nns.mem.trimmed_entries",
     "mem_incidents": "nns.mem.incidents",
     "memory_shed": "nns.query.memory_shed",
+    # fleet observatory (discovery-plane digests, serversrc health row)
+    "digests_published": "nns.query.digests",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by
@@ -362,6 +405,8 @@ HEALTH_KEYS_SPECIAL = (
     "mesh_axes",
     # fleet routing / tenancy (handled by dedicated collector branches)
     "tenants", "remote_inflight", "endpoint_hints", "routing",
+    # per-tenant SLO rows ({tenant: SloTracker row} — dedicated branch)
+    "slo",
     # background-thread census ({thread name: ThreadBeat.snapshot()}):
     # liveness detail for operators, not a numeric series
     "threads",
@@ -542,6 +587,29 @@ class Log2Histogram:
             idx = LOG2_NBUCKETS
         self._counts[idx] += 1
         self._sum += seconds
+
+    def record_n(self, seconds: float, n: int) -> None:
+        """``n`` observations of the same value in ONE bucket increment —
+        how per-token inter-arrival is recorded from a k-token decode
+        scan / chunk (k tokens at dt/k each) without k bucketing
+        passes."""
+        idx = int(seconds * _LOG2_SCALE).bit_length()
+        if idx > LOG2_NBUCKETS:
+            idx = LOG2_NBUCKETS
+        self._counts[idx] += n
+        self._sum += seconds * n
+
+    def count_over(self, seconds: float) -> int:
+        """Observations in buckets strictly ABOVE the bucket holding
+        ``seconds`` — the (bucket-grain, deterministic) violation count
+        SLO burn rates are computed from.  Observations sharing the
+        threshold's bucket count as compliant: at log2 grain that is the
+        conservative reading, and it is exactly reproducible, which the
+        burn-rate truth table pins."""
+        idx = int(seconds * _LOG2_SCALE).bit_length()
+        if idx >= LOG2_NBUCKETS:
+            return 0
+        return sum(self._counts[idx + 1:])
 
     @property
     def count(self) -> int:
@@ -950,6 +1018,189 @@ class TelemetrySnapshot:
 
 
 # ---------------------------------------------------------------------------
+# Per-stream SLO accounting
+# ---------------------------------------------------------------------------
+#: numeric status codes exported as ``nns.slo.status`` (documented map)
+SLO_STATUS_CODES = {"met": 0, "warn": 1, "burned": 2}
+#: burn-rate band edges: burn <= 1.0 is inside budget ("met"); above
+#: SLO_BURN_BURNED the budget is being consumed at 2x+ ("burned")
+SLO_BURN_BURNED = 2.0
+
+
+def slo_status(burn: Optional[float]) -> str:
+    """The met/warn/burned truth table for one burn rate (None = no
+    armed objective = trivially met)."""
+    if burn is None or burn <= 1.0:
+        return "met"
+    if burn < SLO_BURN_BURNED:
+        return "warn"
+    return "burned"
+
+
+class _SloRow:
+    """One tenant's SLO instruments.  Histogram record paths follow the
+    Log2Histogram single-writer contract (each element's tracker is
+    written from exactly one thread: the generator's pump or the
+    client's dispatch thread)."""
+
+    __slots__ = ("ttft", "token", "good", "shed", "evicted", "expired",
+                 "errors")
+
+    def __init__(self):
+        self.ttft = Log2Histogram()
+        self.token = Log2Histogram()
+        self.good = 0
+        self.shed = 0
+        self.evicted = 0
+        self.expired = 0
+        self.errors = 0
+
+
+class SloTracker:
+    """Declarative per-tenant SLO objectives + the instruments their
+    error-budget burn rates are computed from.
+
+    Hot-path cost: ONE Log2Histogram record per first token (TTFT), one
+    ``record_n`` per chunk/scan (per-token inter-arrival), one integer
+    increment per stream outcome.  Burn rates, percentiles, and the
+    met/warn/burned status are computed at SNAPSHOT (scrape) time only.
+
+    Objectives (0 / None = not armed):
+
+    * ``ttft_p95_s`` — 95% of streams must see their first token within
+      this many seconds; burn = observed-over fraction / 0.05.
+    * ``token_p99_s`` — 99% of token inter-arrivals under this bound;
+      burn = observed-over fraction / 0.01.
+    * ``availability`` — goodput fraction objective (e.g. 0.999); bad =
+      shed + evicted + expired + errors; burn = bad fraction / allowed
+      bad fraction.
+
+    Violation counts use :meth:`Log2Histogram.count_over` — bucket-grain
+    and deterministic, the documented precision of the log2 machinery."""
+
+    def __init__(self, ttft_p95_s: float = 0.0, token_p99_s: float = 0.0,
+                 availability: float = 0.0):
+        self.ttft_p95_s = max(0.0, float(ttft_p95_s or 0.0))
+        self.token_p99_s = max(0.0, float(token_p99_s or 0.0))
+        self.availability = float(availability or 0.0)
+        if not 0.0 <= self.availability < 1.0:
+            raise ValueError(
+                f"availability objective {availability!r} must be in "
+                "[0, 1) (1.0 leaves a zero error budget — nothing can "
+                "meet it)")
+        self._rows: Dict[str, _SloRow] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.ttft_p95_s or self.token_p99_s
+                    or self.availability)
+
+    def _row(self, tenant: str) -> _SloRow:
+        row = self._rows.get(tenant)
+        if row is None:
+            with self._lock:
+                row = self._rows.setdefault(tenant, _SloRow())
+        return row
+
+    # -- record paths (cheap; single writer per element) --------------------
+    def note_ttft(self, tenant: str, seconds: float) -> None:
+        self._row(tenant).ttft.record(seconds)
+
+    def note_tokens(self, tenant: str, elapsed_s: float, n: int) -> None:
+        """``n`` tokens arrived ``elapsed_s`` after the previous ones:
+        n inter-arrival observations of elapsed/n each (one bucket
+        increment — see :meth:`Log2Histogram.record_n`)."""
+        if n > 0:
+            self._row(tenant).token.record_n(elapsed_s / n, n)
+
+    def note_stream(self, tenant: str, outcome: str) -> None:
+        """Terminal classification of one stream: ``good`` | ``shed`` |
+        ``evicted`` | ``expired`` | ``error``."""
+        row = self._row(tenant)
+        if outcome == "good":
+            row.good += 1
+        elif outcome == "shed":
+            row.shed += 1
+        elif outcome == "evicted":
+            row.evicted += 1
+        elif outcome == "expired":
+            row.expired += 1
+        else:
+            row.errors += 1
+
+    # -- scrape-time views --------------------------------------------------
+    @staticmethod
+    def _latency_burn(hist: Log2Histogram, objective_s: float,
+                      allowed_frac: float) -> Optional[float]:
+        if objective_s <= 0.0 or hist.count == 0:
+            return None
+        frac_over = hist.count_over(objective_s) / hist.count
+        return frac_over / allowed_frac
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """{tenant: row} for ``health_info()`` — numeric gauges/counters
+        only (the telemetry collector's ``slo`` branch maps them onto
+        ``nns.slo.*`` samples with a tenant label); burn rates and
+        percentiles computed HERE, at read time."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            rows = dict(self._rows)
+        for tenant, row in rows.items():
+            classified = (row.good + row.shed + row.evicted + row.expired
+                          + row.errors)
+            entry: Dict[str, Any] = {
+                "good": row.good,
+                "shed": row.shed,
+                "evicted": row.evicted,
+                "expired": row.expired,
+                "errors": row.errors,
+            }
+            burns = []
+            ttft_burn = self._latency_burn(row.ttft, self.ttft_p95_s, 0.05)
+            if row.ttft.count:
+                p95 = row.ttft.quantile(0.95)
+                if p95 is not None:
+                    entry["ttft_p95_ms"] = round(p95 * 1e3, 3)
+            if ttft_burn is not None:
+                entry["ttft_burn"] = round(ttft_burn, 3)
+                burns.append(ttft_burn)
+            token_burn = self._latency_burn(
+                row.token, self.token_p99_s, 0.01)
+            if row.token.count:
+                p99 = row.token.quantile(0.99)
+                if p99 is not None:
+                    entry["token_p99_ms"] = round(p99 * 1e3, 3)
+            if token_burn is not None:
+                entry["token_burn"] = round(token_burn, 3)
+                burns.append(token_burn)
+            if classified:
+                avail = row.good / classified
+                entry["availability"] = round(avail, 6)
+                if self.availability > 0.0:
+                    avail_burn = (1.0 - avail) / (1.0 - self.availability)
+                    entry["availability_burn"] = round(avail_burn, 3)
+                    burns.append(avail_burn)
+            worst = max(burns) if burns else None
+            entry["status"] = SLO_STATUS_CODES[slo_status(worst)]
+            out[tenant] = entry
+        return out
+
+    def hist_rows(self) -> List[Tuple[str, Log2Histogram, Dict[str, str]]]:
+        """(metric name, histogram, extra labels) triples for the
+        element ``histograms_info`` hook — bucket series export with a
+        ``tenant`` label, scrape time only."""
+        with self._lock:
+            rows = dict(self._rows)
+        out = []
+        for tenant, row in rows.items():
+            labels = {"tenant": tenant or "_"}
+            out.append(("nns.slo.ttft_seconds", row.ttft, labels))
+            out.append(("nns.slo.token_seconds", row.token, labels))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Flight recorder
 # ---------------------------------------------------------------------------
 class FlightRecorder:
@@ -1188,6 +1439,20 @@ def collect_pipeline(pipe) -> List[Sample]:
                         "nns.query.remote_inflight",
                         {**labels, "remote": remote}, v, "gauge"))
                 continue
+            if key == "slo" and isinstance(val, dict):
+                # per-tenant SLO rows (SloTracker.snapshot): every
+                # numeric field maps onto its catalogued nns.slo.* name
+                for tenant, srow in val.items():
+                    tl = {**labels, "tenant": tenant or "_"}
+                    for skey, sval in srow.items():
+                        n = _num(sval)
+                        if n is None:
+                            continue
+                        mname = f"nns.slo.{skey}"
+                        if mname in METRICS:
+                            out.append(Sample(
+                                mname, dict(tl), n, metric_kind(mname)))
+                continue
             if key == "remotes" and isinstance(val, dict):
                 for remote, agg in val.items():
                     rl = {**labels, "remote": remote}
@@ -1238,8 +1503,14 @@ def collect_pipeline(pipe) -> List[Sample]:
         hinfo = getattr(el, "histograms_info", None)
         if hinfo is not None:
             try:
-                for mname, h in hinfo() or ():
-                    out.extend(hist_samples(mname, h, labels))
+                for hrow in hinfo() or ():
+                    # (name, hist) or (name, hist, extra_labels) — the
+                    # 3-form carries per-tenant labels (SLO histograms)
+                    mname, h = hrow[0], hrow[1]
+                    lb = dict(labels)
+                    if len(hrow) > 2 and hrow[2]:
+                        lb.update(hrow[2])
+                    out.extend(hist_samples(mname, h, lb))
             except Exception:  # scrape must survive element bugs
                 log.exception("histograms_info failed for %s", el_name)
         info = getattr(el, "metrics_info", None)

@@ -106,6 +106,23 @@ class TensorGenerator(Element):
             "than this between tokens is evicted with the typed expiry "
             "(0 = off; the request's own deadline-s budget is always "
             "honored)"),
+        # per-stream SLO accounting (core/telemetry.py SloTracker,
+        # engine side): declarative objectives; burn-rate gauges are
+        # computed at scrape time from the log2 histograms and exported
+        # per tenant as nns.slo.* (0 = objective not armed)
+        "slo-ttft-p95": Property(
+            float, 0.0,
+            "TTFT objective: 95% of fresh streams must emit their first "
+            "token within this many seconds (0 = off)"),
+        "slo-token-p99": Property(
+            float, 0.0,
+            "per-token objective: 99% of token inter-arrivals must be "
+            "under this many seconds (0 = off)"),
+        "slo-availability": Property(
+            float, 0.0,
+            "goodput objective, e.g. 0.999: completed streams / "
+            "classified streams (shed+evicted+expired+errors are the "
+            "error budget; 0 = off)"),
         # mesh-sharded decode (parallel/mesh.py grammar, tp only): the
         # slot batch's transformer runs tensor-parallel across a device
         # mesh — params tp-sharded, per-slot KV pages sharded on heads
@@ -139,6 +156,7 @@ class TensorGenerator(Element):
         self._zoo_props = {}      # parsed custom dialect (rebuild hook)
         self._slots = 0
         self._sim = False
+        self._slo = None          # SloTracker (slo-* props; slotted only)
 
     def start(self):
         import jax
@@ -257,6 +275,7 @@ class TensorGenerator(Element):
             self._zoo_props = dict(props)
             self._slots = slots
             self._sim = sim
+            self._slo = self._build_slo()
             self._engine = SlotEngine(
                 model, params,
                 max_seq=self._max_seq,
@@ -267,6 +286,7 @@ class TensorGenerator(Element):
                 name=self.name,
                 resume_sig=self._resume_sig,
                 on_device_lost=self._rebuild_on_device_loss,
+                slo=self._slo,
             )
             self._engine.start()
             return
@@ -308,6 +328,21 @@ class TensorGenerator(Element):
         # chunk length varies (tail chunk): flexible stream
         return StreamSpec((), FORMAT_FLEXIBLE)
 
+    def _build_slo(self):
+        """SloTracker from the slo-* props (None when no objective is
+        armed — the engine's record paths then cost nothing)."""
+        from ..core.telemetry import SloTracker
+
+        try:
+            tracker = SloTracker(
+                ttft_p95_s=float(self.props["slo-ttft-p95"]),
+                token_p99_s=float(self.props["slo-token-p99"]),
+                availability=float(self.props["slo-availability"]),
+            )
+        except ValueError as e:
+            raise ElementError(f"{self.name}: {e}") from None
+        return tracker if tracker.armed else None
+
     # -- observability ------------------------------------------------------
     def health_info(self) -> Dict[str, Any]:
         """Slot occupancy / join / evict / tokens-per-step counters —
@@ -336,7 +371,16 @@ class TensorGenerator(Element):
             # health story (a wedged pump fires an incident from
             # handle_idle; the census makes it visible between polls)
             info["threads"] = thread_census(self._engine.heartbeat)
+        if self._slo is not None:
+            # per-tenant SLO rows (burn rates computed at read time);
+            # the collector's `slo` branch exports them as nns.slo.*
+            info["slo"] = self._slo.snapshot()
         return info
+
+    def histograms_info(self):
+        """Per-tenant TTFT / inter-token log2 bucket series (scrape-time
+        export; empty histograms emit nothing)."""
+        return self._slo.hist_rows() if self._slo is not None else []
 
     # -- continuous-batching hooks ------------------------------------------
     def pending_frames(self) -> int:

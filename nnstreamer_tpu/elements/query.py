@@ -177,6 +177,16 @@ class TensorQueryServerSrc(SourceElement):
         "drain-deadline": Property(
             float, 10.0, "max seconds a drain waits for in-flight "
             "requests to finish before closing the listeners anyway"),
+        # fleet observatory (core/fleet.py): periodic telemetry digest
+        # published on the retained announce — seq + monotonic age,
+        # tokens/s EWMA, slot occupancy, memory headroom, per-tenant
+        # admitted/shed, draining/degraded/swap state.  Driven on the
+        # watchdog-sweeper cadence (zero per-frame cost); requires
+        # announcing (topic= + dest-port=)
+        "digest-interval": Property(
+            float, 2.0, "seconds between telemetry-digest publishes on "
+            "the discovery plane (0 = digests off; state changes and "
+            "stop still force a final publish)"),
     }
 
     def __init__(self, name=None):
@@ -189,6 +199,9 @@ class TensorQueryServerSrc(SourceElement):
         # lost a device and re-sharded — the announce carries it so
         # fleet routing deprioritizes this server (TIER_DEGRADED)
         self._degraded = False
+        # fleet observatory: the telemetry-digest publisher (armed in
+        # start() when announcing; polled from the watchdog sweeper)
+        self._digest = None
 
     def request_drain(self) -> None:
         """Begin the rolling-restart drain of THIS server: GOAWAY to new
@@ -262,6 +275,70 @@ class TensorQueryServerSrc(SourceElement):
                 # listener/refcount leaks for the process lifetime
                 self.stop()
                 raise
+            interval = float(self.props["digest-interval"])
+            if interval > 0:
+                from ..core.fleet import DigestPublisher
+
+                self._digest = DigestPublisher(
+                    self._digest_stats, self._publish_digest,
+                    interval_s=interval, name=self.name)
+                p = self._pipeline
+                if p is not None:
+                    # runs before the pipeline's _arm_watchdog pass, so
+                    # the sweeper thread picks the publisher up (the
+                    # memory-monitor precedent: slow cadence, zero
+                    # per-frame cost)
+                    p.register_sweep(
+                        self._digest.poll, min(interval, 1.0))
+
+    def _digest_stats(self) -> dict:
+        """Raw stats for one telemetry digest: this server's admission
+        ledger merged with the pipeline-wide scan (slot engines, swap
+        state, SLO burn, memory headroom) — see
+        :func:`~nnstreamer_tpu.core.fleet.pipeline_digest_stats`."""
+        from ..core.fleet import pipeline_digest_stats
+
+        stats: dict = {
+            # any non-serving state reads as draining: a drained server
+            # whose pipeline has not been stopped yet keeps its sweeper
+            # running, and a periodic digest must never flip the
+            # retained announce back to draining=false while the
+            # listeners are closed (clients would dial a dead port)
+            "draining": self._lc_state != "serving",
+            "degraded": self._degraded,
+        }
+        core = self._core
+        if core is not None:
+            snap = core.admission.snapshot()
+            stats.update(
+                inflight=snap["inflight"], admitted=snap["admitted"],
+                shed=snap["shed"], tenants=snap.get("tenants", {}),
+            )
+        p = self._pipeline
+        if p is not None:
+            stats.update(pipeline_digest_stats(p))
+        return stats
+
+    def _publish_digest(self, digest: dict) -> None:
+        """Ship one digest via the retained announce (never waits for
+        the broker ack — the sweeper thread must not stall).  The legacy
+        top-level draining/degraded keys ride along so pre-digest
+        clients keep reading the same facts (mixed-fleet contract)."""
+        ann = self._announcement
+        if ann is None:
+            return
+        ann.update({
+            "digest": digest,
+            "draining": bool(digest.get("draining", False)),
+            "degraded": bool(digest.get("degraded", False)),
+        }, wait_ack=False)
+
+    def publish_digest(self, force: bool = True):
+        """Publish a digest NOW (chaos harness / operator hook; the
+        periodic path is the sweeper-driven poll)."""
+        if self._digest is None:
+            return None
+        return self._digest.poll(force=force)
 
     def _announce(self) -> None:
         """Retained per-instance endpoint announce on the MQTT control
@@ -304,6 +381,12 @@ class TensorQueryServerSrc(SourceElement):
         broker must not stall the very in-flight requests the drain is
         protecting."""
         if self._announcement is None:
+            return
+        if self._digest is not None:
+            # one publish carries BOTH the state flags and a fresh
+            # digest — the digest's own draining/degraded fields must
+            # never lag a state change the legacy keys already announced
+            self._digest.poll(force=True)
             return
         try:
             self._announcement.update({
@@ -351,8 +434,15 @@ class TensorQueryServerSrc(SourceElement):
 
     def stop(self):
         if self._announcement is not None:
+            if self._digest is not None:
+                # final flush BEFORE the tombstone: sources stop first,
+                # so the rest of the pipeline (slot engines, admission
+                # ledgers) is still live — the observatory's retired
+                # accumulator keeps this server's EXACT final counters
+                self._digest.poll(force=True)
             self._announcement.clear()
             self._announcement = None
+        self._digest = None
         if self._core is not None:
             release_query_server(self.props["id"])
             self._core = None
@@ -365,6 +455,8 @@ class TensorQueryServerSrc(SourceElement):
         """Admission/load-shed counters merged into Pipeline.health()."""
         info = {"lifecycle": self._lc_state,
                 "degraded": 1 if self._degraded else 0}
+        if self._digest is not None:
+            info["digests_published"] = self._digest.published
         if self._core is not None:
             info.update(self._core.liveness_snapshot())
         p = self._pipeline
@@ -681,6 +773,23 @@ class TensorQueryClient(Element):
             "so long streams survive repeated rolling restarts); "
             "exhaustion fires a flight-recorder incident and surfaces "
             "the original break"),
+        # per-stream SLO accounting (core/telemetry.py SloTracker,
+        # client side — what the USER experienced, across failovers and
+        # resumes): TTFT / per-token inter-arrival histograms + goodput
+        # classification per tenant, burn-rate gauges at scrape time
+        "slo-ttft-p95": Property(
+            float, 0.0,
+            "client-observed TTFT objective: 95% of streams must see "
+            "their first chunk within this many seconds (0 = off)"),
+        "slo-token-p99": Property(
+            float, 0.0,
+            "client-observed per-token objective: 99% of token "
+            "inter-arrivals under this many seconds (0 = off)"),
+        "slo-availability": Property(
+            float, 0.0,
+            "goodput objective, e.g. 0.999: streams completed / "
+            "streams classified (shed/evicted/expired/errors are the "
+            "error budget; 0 = off)"),
         "connect-type": Property(
             str, "grpc",
             "transport: grpc (interop default) | tcp (zero-copy raw TCP "
@@ -754,6 +863,9 @@ class TensorQueryClient(Element):
         # _note_span/_rediscover bump the revision
         self._spans_rev = 0
         self._scores_cache = None
+        # per-stream SLO accounting (slo-* props; streams only) — the
+        # client-side half: what the user experienced end-to-end
+        self._slo = None
 
     @property
     def _conns(self) -> tuple:
@@ -785,16 +897,19 @@ class TensorQueryClient(Element):
             # ALWAYS overwrite per endpoint: a restarted server
             # announces healthy on a new instance topic but the same
             # host:port, and its fresh announce must override the dead
-            # instance's retained draining=true.  Only the draining
-            # FLAG is kept client-side: the announced inflight number
-            # is a point-in-time summary at the last state change, and
-            # exporting it as if live would mislead (routing already
-            # has genuinely-live signals of its own)
+            # instance's retained draining=true.  ONE capture path
+            # (core/fleet.hint_from_announce): the telemetry digest's
+            # draining/degraded fields when present, the legacy
+            # top-level keys for pre-digest servers — routing and the
+            # fleet observatory read the same facts.  Only the FLAGS
+            # are kept client-side: point-in-time load numbers must
+            # never be exported as if live (routing has genuinely-live
+            # signals of its own)
+            from ..core.fleet import hint_from_announce
+
             try:
-                hints[(str(info["host"]), int(info["port"]))] = {
-                    "draining": bool(info.get("draining", False)),
-                    "degraded": bool(info.get("degraded", False)),
-                }
+                hints[(str(info["host"]), int(info["port"]))] = (
+                    hint_from_announce(info))
             except (KeyError, TypeError, ValueError):
                 pass
             return True
@@ -910,6 +1025,17 @@ class TensorQueryClient(Element):
             "nns.query.rtt_seconds",
             labels={"pipeline": pname, "element": self.name},
         )
+        from ..core.telemetry import SloTracker
+
+        try:
+            slo = SloTracker(
+                ttft_p95_s=float(self.props["slo-ttft-p95"]),
+                token_p99_s=float(self.props["slo-token-p99"]),
+                availability=float(self.props["slo-availability"]),
+            )
+        except ValueError as e:
+            raise ElementError(f"{self.name}: {e}") from None
+        self._slo = slo if slo.armed else None
 
     def _make_conns(self, targets: List[Tuple[str, int]]) -> list:
         ct = self.props["connect-type"]
@@ -1075,7 +1201,14 @@ class TensorQueryClient(Element):
             "duplicate_tokens_dropped": self._duplicate_tokens_dropped,
             "resume_failures": self._resume_failures,
             "servers": [f"{h}:{p}" for h, p in self._pstate.targets],
+            **({"slo": self._slo.snapshot()}
+               if self._slo is not None else {}),
         }
+
+    def histograms_info(self):
+        """Client-side per-tenant TTFT / inter-token log2 bucket series
+        (scrape-time export; empty histograms emit nothing)."""
+        return self._slo.hist_rows() if self._slo is not None else []
 
     def metrics_info(self):
         """Registry samples (core/telemetry.py, scrape time only).
@@ -1819,6 +1952,62 @@ class TensorQueryClient(Element):
         return self._dispatch(list(frames))
 
     def _stream_invoke(self, frame):
+        """One LOGICAL server-streaming request, SLO-accounted: the
+        resume/migration loop below does the work; with ``slo-*``
+        objectives armed the wrapper stamps client-observed TTFT on the
+        first chunk, per-token inter-arrival per chunk, and classifies
+        the terminal outcome (good / shed / expired / error) — per
+        tenant, across every failover and resume, because what the USER
+        experienced is the stream end-to-end, not one transport
+        attempt."""
+        gen = self._stream_resume_loop(frame)
+        if self._slo is None:
+            return gen
+        return self._slo_wrap_stream(frame, gen)
+
+    def _slo_wrap_stream(self, frame, gen):
+        import time as _time
+
+        slo = self._slo
+        tenant = str(frame.meta.get(TENANT_META, "") or "")
+        t_prev = _time.perf_counter()
+        first = True
+        expired = False
+        try:
+            for item in gen:
+                out = item[1]
+                n = 0
+                if out.tensors:
+                    t0 = out.tensors[0]
+                    n = (int(t0.shape[1])
+                         if getattr(t0, "ndim", 0) == 2 else 1)
+                if n > 0:
+                    now = _time.perf_counter()
+                    if first:
+                        slo.note_ttft(tenant, now - t_prev)
+                        first = False
+                    else:
+                        slo.note_tokens(tenant, now - t_prev, n)
+                    t_prev = now
+                if out.meta.get("deadline_expired"):
+                    # server-side typed expiry: the stream was answered
+                    # with partial tokens, but the budget was blown
+                    expired = True
+                yield item
+        except GeneratorExit:
+            raise  # consumer abandoned the generator: not an outcome
+        except ServerBusyError:
+            slo.note_stream(tenant, "shed")
+            raise
+        except TimeoutError:
+            slo.note_stream(tenant, "expired")
+            raise
+        except BaseException:
+            slo.note_stream(tenant, "error")
+            raise
+        slo.note_stream(tenant, "expired" if expired else "good")
+
+    def _stream_resume_loop(self, frame):
         """One LOGICAL server-streaming request across any number of
         servers (Documentation/resilience.md "Stream continuity").
 

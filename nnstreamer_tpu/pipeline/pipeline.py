@@ -28,7 +28,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.buffer import (
     EOS,
@@ -299,6 +299,11 @@ class Pipeline:
         # memory-pressure watermark monitor (core/liveness.py): polled
         # on the watchdog-sweeper cadence; None = zero cost everywhere
         self._mem_monitor = None
+        # generic sweeper hooks (fn, min_poll_s): slow-cadence pollers
+        # elements register at start() (the serversrc's telemetry-digest
+        # publisher) — called from the watchdog sweeper thread, NEVER on
+        # a per-frame path; hooks rate-limit internally
+        self._sweep_hooks: List[Tuple[Callable[[], Any], float]] = []
         # registry label: claimed lazily (names default to "pipeline", so
         # the label must be unique among LIVE pipelines or one stop()
         # would evict a concurrent namesake's instruments)
@@ -489,12 +494,12 @@ class Pipeline:
     def telemetry_label(self) -> str:
         """The ``pipeline=`` label this pipeline's registry series carry:
         the name when it is unique among live pipelines, else
-        ``name#N`` (claimed lazily, released at stop())."""
-        if self._telemetry_label is None:
-            from ..core.telemetry import claim_pipeline_label
-
-            self._telemetry_label = claim_pipeline_label(self.name)
-        return self._telemetry_label
+        ``name#N``.  Claimed at start(), released at stop(); a pipeline
+        that is not running reads as its bare name WITHOUT claiming — a
+        scrape must never be the claimant (a registry scrape racing
+        stop(), or walking the collector of a pipeline a sloppy caller
+        abandoned, would otherwise hold the label forever)."""
+        return self._telemetry_label or self.name
 
     def metrics_snapshot(self):
         """Pollable telemetry snapshot of THIS pipeline: every signal
@@ -788,6 +793,15 @@ class Pipeline:
     def start(self) -> "Pipeline":
         if self._started:
             return self
+        # claim the registry label BEFORE any element start: elements
+        # bind instruments to it in their start() (the query client's
+        # rtt histogram), so the label must be settled first — and
+        # claiming here (not lazily at scrape time) means a scrape can
+        # never resurrect a released label
+        if self._telemetry_label is None:
+            from ..core.telemetry import claim_pipeline_label
+
+            self._telemetry_label = claim_pipeline_label(self.name)
         started: List[Element] = []
         try:
             # start (open models/resources) BEFORE the static negotiation
@@ -804,6 +818,10 @@ class Pipeline:
                     el.stop()
                 except Exception:
                     self.log.exception("stop() failed for %s", el.name)
+            from ..core.telemetry import release_pipeline_label
+
+            release_pipeline_label(self._telemetry_label)
+            self._telemetry_label = None
             raise
         # a terminal is any non-source element with no LINKED src pad (a
         # trailing element whose output nobody consumes still ends the
@@ -881,6 +899,15 @@ class Pipeline:
         self._started = True
         return self
 
+    def register_sweep(self, fn: Callable[[], Any],
+                       min_poll_s: float = 1.0) -> None:
+        """Register a slow-cadence poller on the watchdog sweeper thread
+        (elements call this from ``start()`` — before ``_arm_watchdog``
+        runs, so the sweeper picks it up).  ``fn`` must rate-limit
+        itself; ``min_poll_s`` only bounds the sweeper's wakeup
+        interval.  Hooks are cleared at the next ``start()``."""
+        self._sweep_hooks.append((fn, max(0.05, float(min_poll_s))))
+
     def _arm_watchdog(self) -> None:
         """Build the liveness watchdog for every element that armed a
         stall-timeout / frame-deadline; no-op (zero threads, zero hot-path
@@ -894,12 +921,15 @@ class Pipeline:
             or float(el.props.get("frame-deadline") or 0.0) > 0
         ]
         if not armed:
+            extra = [s for _, s in self._sweep_hooks]
             if self._mem_monitor is not None:
-                # no liveness watches, but the memory monitor still
-                # needs the sweeper cadence
+                extra.append(self._mem_monitor.min_poll_s)
+            if extra:
+                # no liveness watches, but the memory monitor / sweep
+                # hooks (digest publisher) still need the cadence
                 self._wd_thread = threading.Thread(
                     target=self._watchdog_loop,
-                    args=(self._mem_monitor.min_poll_s,),
+                    args=(min(extra),),
                     name=f"{self.name}-watchdog", daemon=True,
                 )
             return
@@ -923,9 +953,12 @@ class Pipeline:
                 on_event=lambda w, kind, elapsed, el=el: self._on_liveness(
                     el, kind, elapsed),
             )
+        interval = min(
+            [self._watchdog.min_interval()]
+            + [s for _, s in self._sweep_hooks])
         self._wd_thread = threading.Thread(
             target=self._watchdog_loop,
-            args=(self._watchdog.min_interval(),),
+            args=(interval,),
             name=f"{self.name}-watchdog", daemon=True,
         )
 
@@ -942,6 +975,11 @@ class Pipeline:
                     mon.poll()  # rate-limited internally
                 except Exception:
                     self.log.exception("memory-pressure poll failed")
+            for fn, _ in self._sweep_hooks:
+                try:
+                    fn()  # rate-limited internally (register_sweep)
+                except Exception:
+                    self.log.exception("sweep hook %r failed", fn)
 
     def _on_liveness(self, el: Element, kind: str, elapsed: float) -> None:
         """Watchdog escalation (runs on the sweeper thread): bus warning
@@ -1044,6 +1082,9 @@ class Pipeline:
         # socket is closed synchronously here (leak-check contract)
         self._unregister_telemetry()
         self._threads.clear()
+        # sweep hooks die with the run (elements re-register at the
+        # next start(); a restart must not accumulate stale pollers)
+        self._sweep_hooks = []
         self._started = False
 
     def wait(self, timeout: Optional[float] = None) -> None:

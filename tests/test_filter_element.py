@@ -135,6 +135,9 @@ class TestFilterElement:
         pipe["src"].push(np.float32([1]))
         pipe["src"].end_of_stream()
         assert pipe["out"].eos_received.wait(timeout=10)
+        # stop, or the pipeline's registry collector stays registered
+        # for the rest of the session (visible to any /metrics scrape)
+        pipe.stop()
 
     def test_latency_throughput_props(self):
         pipe = parse_pipeline(

@@ -1,0 +1,792 @@
+"""Fleet observatory: discovery-plane telemetry digests, fleet-wide
+rollup exactness, per-stream SLO accounting, and the chaos acceptance
+(Documentation/observability.md "Fleet observatory" / "SLO accounting").
+
+Contracts pinned here:
+
+* DigestPublisher — fake-clock cadence, seq monotonicity, bounded
+  serialized size (tenant-map truncation is loud), tokens/s EWMA.
+* FleetObservatory — rollups EXACTLY equal hand-built per-server sums
+  (retired servers included), TTL age-out retires stale rows, tombstones
+  retire counters, duplicate/stale seqs ignored, table bounded.
+* SLO burn-rate math — the met/warn/burned truth table, deterministic
+  bucket-grain violation counts, availability burn.
+* Engine + client accounting — classification truth (good/expired/
+  evicted), fused/unfused parity of TTFT/goodput accounting.
+* Trace continuity — a resumed/migrated stream keeps ONE trace id
+  end-to-end (the resume request re-stamps, never re-mints) and every
+  chunk's server-span decomposition sums exactly on both sides of the
+  handoff.
+* The chaos acceptance: rolling restart + hot-tenant burst + crash with
+  exact observatory-vs-ledger cross-checks and /metrics visibility.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from nnstreamer_tpu.core.buffer import TensorFrame
+from nnstreamer_tpu.core.fleet import (
+    DIGEST_MAX_BYTES,
+    DIGEST_MAX_TENANTS,
+    DigestPublisher,
+    FleetObservatory,
+    hint_from_announce,
+    pipeline_digest_stats,
+)
+from nnstreamer_tpu.core.slots import SimSlotModel, SlotEngine
+from nnstreamer_tpu.core.telemetry import (
+    SRV_SPAN_META,
+    TRACE_ID_META,
+    Log2Histogram,
+    SloTracker,
+    slo_status,
+)
+from nnstreamer_tpu.pipeline import parse_pipeline
+
+
+# ---------------------------------------------------------------------------
+# Digest publisher (fake clock)
+# ---------------------------------------------------------------------------
+class TestDigestPublisher:
+    def _pub(self, stats, interval=2.0):
+        t = [0.0]
+        published = []
+        pub = DigestPublisher(
+            lambda: stats, published.append, interval_s=interval,
+            clock=lambda: t[0])
+        return t, published, pub
+
+    def test_cadence_and_seq_monotonic(self):
+        stats = {"inflight": 1}
+        t, published, pub = self._pub(stats)
+        assert pub.poll() is not None          # first poll publishes
+        assert pub.poll() is None              # inside the interval
+        t[0] = 1.99
+        assert pub.poll() is None
+        t[0] = 2.0
+        assert pub.poll() is not None
+        t[0] = 2.5
+        forced = pub.poll(force=True)          # force beats the interval
+        assert forced is not None
+        seqs = [d["seq"] for d in published]
+        assert seqs == sorted(seqs) == list(range(1, len(seqs) + 1))
+        assert published[-1]["age_s"] == 2.5   # publisher monotonic age
+        assert pub.published == 3
+
+    def test_tokens_per_s_ewma_from_counter_deltas(self):
+        stats = {"tokens": 0}
+        t, published, pub = self._pub(stats, interval=1.0)
+        pub.poll()
+        assert published[-1]["tokens_per_s"] == 0.0
+        stats["tokens"] = 100
+        t[0] = 1.0
+        pub.poll()
+        assert published[-1]["tokens_per_s"] == 100.0
+        stats["tokens"] = 100          # stalled: rate decays toward 0
+        t[0] = 2.0
+        pub.poll()
+        assert 0.0 < published[-1]["tokens_per_s"] < 100.0
+
+    def test_bounded_tenant_map_is_loud(self):
+        stats = {"tenants": {
+            f"t{i}": {"admitted": i, "shed": 0} for i in range(40)
+        }}
+        _, published, pub = self._pub(stats)
+        d = pub.poll()
+        assert len(d["tenants"]) == DIGEST_MAX_TENANTS
+        assert d["tenants_dropped"] == 40 - DIGEST_MAX_TENANTS
+        # busiest tenants won the bound
+        assert "t39" in d["tenants"] and "t0" not in d["tenants"]
+
+    def test_oversize_digest_truncates_loudly(self):
+        stats = {"tenants": {
+            ("x" * 400 + str(i)): {"admitted": 1, "shed": 0}
+            for i in range(12)
+        }}
+        _, _, pub = self._pub(stats)
+        d = pub.poll()
+        assert len(json.dumps(d)) <= DIGEST_MAX_BYTES
+        assert d.get("truncated") is True
+        assert "tenants" not in d
+
+    def test_publish_failure_counted_never_raises(self):
+        t = [0.0]
+
+        def boom(d):
+            raise OSError("broker gone")
+
+        pub = DigestPublisher(lambda: {}, boom, interval_s=1.0,
+                              clock=lambda: t[0])
+        assert pub.poll() is None
+        assert pub.publish_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# Observatory rollup exactness (hand-built tables)
+# ---------------------------------------------------------------------------
+def _digest(seq=1, ttl=10.0, **kw):
+    d = {"v": 1, "seq": seq, "age_s": 0.0, "interval_s": 1.0,
+         "ttl_s": ttl, "draining": False, "degraded": False,
+         "swap": "idle", "inflight": 0, "admitted": 0, "shed": 0,
+         "tokens_per_s": 0.0}
+    d.update(kw)
+    return d
+
+
+def _announce(digest, host="h", port=1):
+    return {"host": host, "port": port, "digest": digest}
+
+
+class TestObservatoryRollups:
+    def test_rollup_exactly_equals_hand_built_table(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        obs.ingest("a", _announce(_digest(
+            seq=3, inflight=2, admitted=10, shed=1, tokens=100,
+            slots=4, occupied=3, waiting=1, tokens_per_s=50.0,
+            mem_headroom_bytes=1000,
+            tenants={"A": {"admitted": 6, "shed": 1}}), port=1))
+        obs.ingest("b", _announce(_digest(
+            seq=7, inflight=1, admitted=20, shed=2, tokens=200,
+            slots=4, occupied=1, tokens_per_s=25.0, draining=True,
+            mem_headroom_bytes=500, slo_burn={"A": 1.5},
+            tenants={"A": {"admitted": 15, "shed": 2},
+                     "B": {"admitted": 5, "shed": 0}}), port=2))
+        # memory-pressured server: its free slots are NOT admittable
+        obs.ingest("c", _announce(_digest(
+            seq=1, admitted=5, shed=0, tokens=50, slots=4, occupied=1,
+            mem_pressure=1, degraded=True, swap="staging",
+            slo_burn={"A": 0.5, "B": 2.5}), port=3))
+        r = obs.rollup()
+        assert r["servers"] == 3
+        assert r["draining"] == 1 and r["degraded"] == 1
+        assert r["swapping"] == 1 and r["mem_pressured"] == 1
+        assert r["inflight"] == 3
+        assert r["slots"] == 12 and r["occupied"] == 5
+        assert r["occupancy"] == round(5 / 12, 4)
+        assert r["tokens_per_s"] == 75.0
+        # a (4-3) + b (4-1) admittable; c pressured -> 0
+        assert r["slot_headroom"] == 1 + 3
+        assert r["mem_headroom_bytes"] == 1500
+        assert r["tokens"] == 350
+        assert r["admitted"] == 35 and r["shed"] == 3
+        assert r["tenants"] == {
+            "A": {"admitted": 21, "shed": 3},
+            "B": {"admitted": 5, "shed": 0},
+        }
+        # worst burn per tenant across live servers
+        assert r["slo_burn"] == {"A": 1.5, "B": 2.5}
+        assert r["servers_seen"] == 3 and r["digests"] == 3
+
+    def test_ttl_age_out_retires_counters_exactly(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        obs.ingest("a", _announce(_digest(
+            seq=1, ttl=5.0, tokens=100, admitted=7, shed=2,
+            tenants={"A": {"admitted": 7, "shed": 2}})))
+        t[0] = 4.9
+        assert obs.rollup()["servers"] == 1
+        t[0] = 5.1
+        r = obs.rollup()
+        assert r["servers"] == 0
+        assert r["stale_evicted"] == 1 and r["retired"] == 0
+        # the stale row's counters RETIRED, not lost (exactness across
+        # crashes that never tombstone their announce)
+        assert r["tokens"] == 100 and r["admitted"] == 7
+        assert r["tenants"] == {"A": {"admitted": 7, "shed": 2}}
+
+    def test_tombstone_retires_and_restart_reaccumulates(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        obs.ingest("a", _announce(_digest(seq=5, tokens=100,
+                                          admitted=10)))
+        obs.note_tombstone("a")
+        r = obs.rollup()
+        assert r["servers"] == 0 and r["retired"] == 1
+        assert r["tokens"] == 100
+        # the restarted instance (new topic) counts from zero — totals
+        # keep both generations
+        obs.ingest("a2", _announce(_digest(seq=1, tokens=30, admitted=3)))
+        r = obs.rollup()
+        assert r["tokens"] == 130 and r["admitted"] == 13
+        assert r["servers_seen"] == 2
+
+    def test_duplicate_and_stale_seq_ignored(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        assert obs.ingest("a", _announce(_digest(seq=3, tokens=10)))
+        assert not obs.ingest("a", _announce(_digest(seq=3, tokens=99)))
+        assert not obs.ingest("a", _announce(_digest(seq=2, tokens=99)))
+        assert obs.rollup()["tokens"] == 10
+        assert obs.ingest("a", _announce(_digest(seq=4, tokens=11)))
+        assert obs.rollup()["tokens"] == 11
+
+    def test_non_digest_and_foreign_version_announces_skipped(self):
+        obs = FleetObservatory(topic="x")
+        assert not obs.ingest("a", {"host": "h", "port": 1})
+        assert not obs.ingest("a", _announce({"v": 99, "seq": 1}))
+        assert obs.rollup()["servers"] == 0
+
+    def test_table_bound_retires_oldest(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", max_servers=3,
+                               clock=lambda: t[0])
+        for i in range(5):
+            t[0] = float(i)
+            obs.ingest(f"s{i}", _announce(
+                _digest(seq=1, ttl=100.0, tokens=1), port=i))
+        r = obs.rollup()
+        assert r["servers"] == 3
+        assert r["stale_evicted"] == 2
+        assert r["tokens"] == 5  # evicted rows retired, not lost
+
+    def test_resurrected_row_never_double_counts(self):
+        """A row TTL-evicted while its server was merely slow, then
+        re-ingested from the SAME instance topic, must reverse its
+        retired contribution — cumulative counters may count once."""
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        obs.ingest("a", _announce(_digest(
+            seq=1, ttl=5.0, tokens=100, admitted=7, shed=1,
+            tenants={"A": {"admitted": 7, "shed": 1}})))
+        t[0] = 6.0  # transient staleness: evicted + retired
+        assert obs.rollup()["stale_evicted"] == 1
+        # the same instance comes back with HIGHER cumulative counters
+        obs.ingest("a", _announce(_digest(
+            seq=2, ttl=5.0, tokens=140, admitted=9, shed=1,
+            tenants={"A": {"admitted": 9, "shed": 1}})))
+        r = obs.rollup()
+        assert r["servers"] == 1
+        assert r["tokens"] == 140          # once, not 100 + 140
+        assert r["admitted"] == 9
+        assert r["tenants"] == {"A": {"admitted": 9, "shed": 1}}
+        assert r["servers_seen"] == 1      # same instance, not a new one
+        assert obs.resurrected == 1
+        # a LATER eviction retires the fresh counters exactly once
+        t[0] = 12.0
+        r = obs.rollup()
+        assert r["servers"] == 0 and r["tokens"] == 140
+
+    def test_empty_topic_subscribes_to_every_announce(self):
+        """FleetObservatory(topic=\"\") must see servers announcing
+        under ANY topic (MQTT matches level-by-level: the pattern has
+        to be nns/query/#, never nns/query//#)."""
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker, MqttClient
+
+        broker = MiniBroker()
+        obs = FleetObservatory(topic="", default_ttl_s=30.0)
+        obs.start("127.0.0.1", broker.port)
+        pub = MqttClient("127.0.0.1", broker.port)
+        try:
+            pub.publish(
+                "nns/query/prod/inst1",
+                json.dumps(_announce(_digest(seq=1, tokens=5))).encode(),
+                retain=True, qos=1)
+            deadline = time.monotonic() + 10
+            while (obs.rollup()["servers"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert obs.rollup()["servers"] == 1
+        finally:
+            pub.close()
+            obs.stop()
+            broker.close()
+
+    def test_hint_unification_digest_wins_legacy_accepted(self):
+        # digest fields are the ONE capture path when present...
+        info = _announce(_digest(draining=True, degraded=False))
+        info.update(draining=False, degraded=True)  # stale legacy keys
+        assert hint_from_announce(info) == {
+            "draining": True, "degraded": False}
+        # ...and pre-digest announces (mixed fleets) keep working
+        assert hint_from_announce(
+            {"host": "h", "port": 1, "draining": True}) == {
+            "draining": True, "degraded": False}
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math
+# ---------------------------------------------------------------------------
+class TestSloMath:
+    def test_status_truth_table(self):
+        assert slo_status(None) == "met"
+        assert slo_status(0.0) == "met"
+        assert slo_status(1.0) == "met"      # exactly on budget
+        assert slo_status(1.001) == "warn"
+        assert slo_status(1.999) == "warn"
+        assert slo_status(2.0) == "burned"
+        assert slo_status(50.0) == "burned"
+
+    def test_count_over_is_bucket_deterministic(self):
+        h = Log2Histogram()
+        for v in (0.001, 0.002, 0.004, 0.1, 1.0):
+            h.record(v)
+        assert h.count_over(0.01) == 2     # 0.1 and 1.0
+        assert h.count_over(10.0) == 0
+        assert h.count_over(1e-9) == 5
+
+    def test_ttft_burn_met_warn_burned(self):
+        # objective: p95 under 0.1s -> 5% violation budget
+        slo = SloTracker(ttft_p95_s=0.1)
+        for _ in range(99):
+            slo.note_ttft("t", 0.01)
+        slo.note_ttft("t", 1.0)            # 1% over -> burn 0.2: met
+        snap = slo.snapshot()["t"]
+        assert snap["ttft_burn"] == pytest.approx(0.2)
+        assert snap["status"] == 0
+        for _ in range(4):
+            slo.note_ttft("t", 1.0)        # 5/104 over -> burn ~0.96
+        assert slo.snapshot()["t"]["status"] == 0
+        for _ in range(8):
+            slo.note_ttft("t", 1.0)        # 13/112 over -> burn ~2.3
+        snap = slo.snapshot()["t"]
+        assert snap["ttft_burn"] > 2.0
+        assert snap["status"] == 2
+        # warn band: between 1x and 2x the budget
+        slo2 = SloTracker(ttft_p95_s=0.1)
+        for _ in range(93):
+            slo2.note_ttft("t", 0.01)
+        for _ in range(7):
+            slo2.note_ttft("t", 1.0)       # 7% over -> burn 1.4
+        snap2 = slo2.snapshot()["t"]
+        assert 1.0 < snap2["ttft_burn"] < 2.0
+        assert snap2["status"] == 1
+
+    def test_availability_burn_and_goodput_classification(self):
+        slo = SloTracker(availability=0.99)
+        for _ in range(98):
+            slo.note_stream("t", "good")
+        slo.note_stream("t", "shed")
+        slo.note_stream("t", "expired")
+        snap = slo.snapshot()["t"]
+        assert snap["good"] == 98 and snap["shed"] == 1
+        assert snap["expired"] == 1
+        assert snap["availability"] == pytest.approx(0.98)
+        assert snap["availability_burn"] == pytest.approx(2.0)
+        assert snap["status"] == 2
+
+    def test_unarmed_objectives_never_burn(self):
+        slo = SloTracker()
+        assert not slo.armed
+        slo2 = SloTracker(token_p99_s=0.01)
+        slo2.note_stream("t", "error")     # availability NOT armed
+        snap = slo2.snapshot()["t"]
+        assert "availability_burn" not in snap
+        assert snap["status"] == 0         # no armed objective violated
+
+    def test_invalid_availability_objective_refused(self):
+        with pytest.raises(ValueError):
+            SloTracker(availability=1.0)
+        with pytest.raises(ValueError):
+            SloTracker(availability=-0.1)
+
+    def test_token_record_n_bulk_counts(self):
+        slo = SloTracker(token_p99_s=0.01)
+        slo.note_tokens("t", 0.8, 8)       # 8 tokens at 100ms each
+        slo.note_tokens("t", 0.008, 8)     # 8 tokens at 1ms each
+        snap = slo.snapshot()["t"]
+        # 8/16 over the 10ms bound -> burn 50x, and counts are exact
+        assert snap["token_burn"] == pytest.approx(50.0)
+        row_counts = {
+            name: h.count for name, h, lbl in slo.hist_rows()
+        }
+        assert row_counts["nns.slo.token_seconds"] == 16
+
+
+# ---------------------------------------------------------------------------
+# Engine-side accounting: classification truth + fused/unfused parity
+# ---------------------------------------------------------------------------
+class TestEngineSloAccounting:
+    def test_engine_classification_good_expired_evicted(self):
+        slo = SloTracker(ttft_p95_s=10.0, availability=0.5)
+        eng = SlotEngine(SimSlotModel(2, vocab=97, step_base_ms=0.2),
+                         None, max_seq=1 << 20, chunk=4, slo=slo)
+        eng.start()
+        try:
+            prompt = np.arange(4, dtype=np.int32)[None]
+            # good: completes
+            eng.submit(TensorFrame([prompt]), prompt, 8, 4, tenant="A")
+            deadline = time.monotonic() + 20
+            done = []
+            while time.monotonic() < deadline:
+                done.extend(f for _, f in eng.pop_ready())
+                if done and done[-1].meta.get("final"):
+                    break
+                time.sleep(0.002)
+            assert done and done[-1].meta.get("final")
+            # expired: deadline already blown at submit
+            eng.submit(TensorFrame([prompt]), prompt, 8, 4, tenant="A",
+                       deadline_ts=time.monotonic() - 1.0)
+            deadline = time.monotonic() + 10
+            while (eng.snapshot()["gen_evicted"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            # evicted: consumer gone
+            s = eng.submit(TensorFrame([prompt]), prompt, 64, 4,
+                           tenant="A")
+            eng.cancel(sid=s.sid)
+            deadline = time.monotonic() + 10
+            while (eng.snapshot()["gen_cancelled"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                row = slo.snapshot().get("A", {})
+                if (row.get("good") == 1 and row.get("expired") == 1
+                        and row.get("evicted") == 1):
+                    break
+                time.sleep(0.01)
+            row = slo.snapshot()["A"]
+            assert row["good"] == 1
+            assert row["expired"] == 1
+            assert row["evicted"] == 1
+            # TTFT recorded exactly once (the completed stream; the
+            # pre-expired and cancelled ones may or may not have decoded)
+            assert row["ttft_p95_ms"] > 0
+        finally:
+            eng.stop()
+
+    @staticmethod
+    def _run_gen_pipeline(fuse: bool, streams: int = 4,
+                          max_new: int = 12):
+        pipe = parse_pipeline(
+            "appsrc name=src ! "
+            "tensor_generator name=gen slots=4 "
+            "custom=sim:1,sim_step_ms:0.2,vocab:997 "
+            f"max-new={max_new} chunk=4 "
+            "slo-ttft-p95=30 slo-token-p99=5 slo-availability=0.9 ! "
+            "tensor_sink name=out",
+            fuse=fuse, name=f"slo-parity-{fuse}")
+        pipe.start()
+        try:
+            for i in range(streams):
+                prompt = (np.arange(4, dtype=np.int32)[None] + i) % 997
+                pipe["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                finals = sum(
+                    1 for f in pipe["out"].frames
+                    if f.meta.get("final"))
+                if finals >= streams:
+                    break
+                time.sleep(0.005)
+            assert finals >= streams, "streams never finished"
+            pipe["src"].end_of_stream()
+            pipe.wait(timeout=30)
+            slo_row = pipe.health()["gen"]["slo"][""]
+            hist_counts = {
+                name: h.count
+                for name, h, lbl in pipe["gen"].histograms_info()
+            }
+            return slo_row, hist_counts
+        finally:
+            pipe.stop()
+
+    def test_ttft_goodput_parity_fused_vs_unfused(self):
+        """The PR's parity satellite: identical classification counters
+        and histogram OBSERVATION counts on both dataplanes (bucket
+        values are timing, counts are structure)."""
+        streams, max_new = 4, 12
+        row_f, hists_f = self._run_gen_pipeline(True, streams, max_new)
+        row_u, hists_u = self._run_gen_pipeline(False, streams, max_new)
+        for row in (row_f, row_u):
+            assert row["good"] == streams
+            assert row["shed"] == row["evicted"] == row["expired"] == 0
+            assert row["errors"] == 0
+            assert row["availability"] == 1.0
+            assert row["status"] == 0
+        # exact observation counts: one TTFT per fresh stream, one
+        # inter-arrival observation per decoded token after token 1
+        assert hists_f["nns.slo.ttft_seconds"] == streams
+        assert hists_f["nns.slo.token_seconds"] == streams * (max_new - 1)
+        assert hists_f == hists_u
+        deterministic = {
+            k: v for k, v in row_f.items()
+            if not k.endswith("_ms")  # quantiles are timing, not structure
+        }
+        assert deterministic == {
+            k: v for k, v in row_u.items() if not k.endswith("_ms")}
+
+
+# ---------------------------------------------------------------------------
+# Trace continuity across resume/migration (satellite pin)
+# ---------------------------------------------------------------------------
+class TestTraceContinuity:
+    def test_resume_frame_restamps_never_remints(self):
+        """The RESUME request must carry the ORIGINAL stream's trace id
+        — a re-mint would split one logical stream across two traces."""
+        from nnstreamer_tpu.core.continuity import (
+            RESUME_META,
+            StreamContinuity,
+            prompt_digest,
+        )
+
+        prompt = np.arange(4, dtype=np.int32)[None]
+        frame = TensorFrame([prompt])
+        frame.meta[TRACE_ID_META] = "trace-origin-1"
+        cont = StreamContinuity(frame)
+        chunk = frame.with_tensors([np.int32([[5, 6, 7, 8]])])
+        chunk.meta.update(stream_seq=1, chunk_index=0, tokens_done=4,
+                          final=False)
+        chunk.meta[RESUME_META] = {
+            "v": 1, "sig": "S", "digest": prompt_digest(prompt),
+            "chunk": 4}
+        cont.accept(chunk)
+        resume = cont.build_resume_frame()
+        assert resume.meta[TRACE_ID_META] == "trace-origin-1"
+
+    def test_one_trace_id_and_exact_spans_across_migration(self):
+        """Drain-migration e2e: every chunk the client delivers — from
+        BOTH servers — carries the one original trace id, and each
+        chunk's server-side span decomposition sums exactly
+        (queue + dispatch + compute == total) on both sides of the
+        handoff."""
+        def gen_server(sid, name):
+            pipe = parse_pipeline(
+                f"tensor_query_serversrc name=ssrc id={sid} port=0 "
+                "connect-type=tcp ! "
+                "tensor_generator name=gen slots=4 "
+                "custom=sim:1,sim_step_ms:3.0,vocab:997 "
+                "max-new=48 chunk=4 ! "
+                f"tensor_query_serversink id={sid}", name=name)
+            pipe.start()
+            return pipe
+
+        s1 = gen_server(10051, "trace-s1")
+        s2 = gen_server(10052, "trace-s2")
+        p1 = s1["ssrc"].props["port"]
+        p2 = s2["ssrc"].props["port"]
+        client = parse_pipeline(
+            "appsrc name=src ! tensor_query_client name=q "
+            f"connect-type=tcp hosts=localhost:{p1},localhost:{p2} "
+            "stream=true timeout=60 retry-backoff=0.01 ! "
+            "tensor_sink name=out", name="cli-trace")
+        client.start()
+        try:
+            prompt = np.arange(5, dtype=np.int32)[None]
+            req = TensorFrame([prompt])
+            req.meta[TRACE_ID_META] = "trace-mig-7"
+            client["src"].push(req)
+            deadline = time.monotonic() + 30
+            while (not client["out"].frames
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert client["out"].frames, "no chunk before the drain"
+            res = s1.drain(timeout=15)
+            assert res["dropped"] == 0
+            client["src"].end_of_stream()
+            client.wait(timeout=60)
+            frames = list(client["out"].frames)
+            assert client.health()["q"]["stream_migrations"] == 1
+            # ONE trace id across the whole migrated stream
+            assert all(
+                f.meta.get(TRACE_ID_META) == "trace-mig-7"
+                for f in frames), [f.meta.get(TRACE_ID_META)
+                                   for f in frames]
+            # both servers served chunks of this one trace
+            assert s2.health()["gen"]["gen_resumes"] == 1
+            # span decomposition sums EXACTLY per chunk, pre- and
+            # post-handoff alike (the server-span additivity contract)
+            spans = [f.meta.get(SRV_SPAN_META) for f in frames]
+            spans = [s for s in spans if s]
+            assert spans, "no server spans on delivered chunks"
+            for s in spans:
+                assert (s["queue"] + s["dispatch"] + s["compute"]
+                        == pytest.approx(s["total"], abs=1e-9))
+        finally:
+            client.stop()
+            s1.stop()
+            s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Discovery-plane wiring: digests on the announce, hints, health
+# ---------------------------------------------------------------------------
+class TestDigestOnDiscoveryPlane:
+    def test_serversrc_digests_reach_observatory_and_hints(self):
+        """One server announcing with digests armed: the observatory
+        ingests them (seq advances on the sweeper cadence), the client's
+        endpoint hints read the digest's state fields, and
+        health()/metrics expose digests_published."""
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        broker = MiniBroker()
+        server = parse_pipeline(
+            "tensor_query_serversrc name=ssrc id=10060 connect-type=tcp "
+            "topic=obstest dest-host=127.0.0.1 "
+            f"dest-port={broker.port} digest-interval=0.1 ! "
+            "tensor_generator name=gen slots=2 "
+            "custom=sim:1,sim_step_ms:0.5,vocab:997 max-new=8 chunk=4 ! "
+            "tensor_query_serversink id=10060", name="obsw-srv")
+        server.start()
+        obs = FleetObservatory(topic="obstest", default_ttl_s=10.0)
+        obs.start("127.0.0.1", broker.port)
+        client = None
+        try:
+            deadline = time.monotonic() + 15
+            while (obs.rollup()["servers"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            rows = obs.servers()
+            assert len(rows) == 1
+            first_seq = rows[0]["seq"]
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                rows = obs.servers()
+                if rows and rows[0]["seq"] > first_seq:
+                    break
+                time.sleep(0.02)
+            assert rows[0]["seq"] > first_seq, "digest seq never advanced"
+            assert server.health()["ssrc"]["digests_published"] >= 2
+            # the client's ONE capture path reads the digest state
+            client = parse_pipeline(
+                "appsrc name=src ! tensor_query_client name=q "
+                "connect-type=tcp topic=obstest dest-host=127.0.0.1 "
+                f"dest-port={broker.port} discovery-timeout=10 ! "
+                "tensor_sink name=out", name="obsw-cli")
+            client.start()
+            # healthy server: no hint row kept (absent = healthy)
+            assert client["q"]._endpoint_hints == {}
+            # a degraded DIGEST becomes a degraded hint on rediscovery
+            # (the ONE capture path: the hint is read from the digest's
+            # state fields, which note_degraded force-publishes)
+            server["ssrc"].note_degraded("test")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                q = client["q"]
+                q._last_discovery_ts = float("-inf")
+                q._rediscover(q._pstate)
+                if any(h.get("degraded")
+                       for h in q._endpoint_hints.values()):
+                    break
+                time.sleep(0.05)
+            assert any(h.get("degraded")
+                       for h in client["q"]._endpoint_hints.values())
+            # the observatory reads the same fact from the same digest
+            deadline = time.monotonic() + 10
+            while (obs.rollup()["degraded"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert obs.rollup()["degraded"] == 1
+        finally:
+            if client is not None:
+                client.stop()
+            server.stop()
+            obs.stop()
+            broker.close()
+
+    def test_stopped_server_digest_stays_draining(self):
+        """After a drain completes (_lc_state == \"stopped\") the
+        pipeline's sweeper may still tick: a periodic digest must NEVER
+        flip the retained announce back to draining=false while the
+        listeners are closed (clients would dial a dead port)."""
+        from nnstreamer_tpu.elements.query import TensorQueryServerSrc
+
+        src = TensorQueryServerSrc("ssrc")
+        for state, want in (("serving", False), ("draining", True),
+                            ("stopped", True)):
+            src._lc_state = state
+            assert src._digest_stats()["draining"] is want, state
+
+    def test_pipeline_digest_stats_scans_health(self):
+        pipe = parse_pipeline(
+            "appsrc name=src ! tensor_generator name=gen slots=2 "
+            "custom=sim:1,sim_step_ms:0.2,vocab:997 max-new=8 chunk=4 "
+            "slo-ttft-p95=10 ! tensor_sink name=out", name="pds")
+        pipe.start()
+        try:
+            prompt = np.arange(4, dtype=np.int32)[None]
+            pipe["src"].push(TensorFrame([prompt]))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if any(f.meta.get("final") for f in pipe["out"].frames):
+                    break
+                time.sleep(0.005)
+            stats = pipeline_digest_stats(pipe)
+            assert stats["slots"] == 2
+            assert stats["tokens"] == 8
+            assert stats["swap"] == "idle"
+            assert "slo_burn" in stats  # armed objectives surface burns
+        finally:
+            pipe.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet_top rendering (pure function of a snapshot)
+# ---------------------------------------------------------------------------
+def test_fleet_top_render_unit():
+    from tools.fleet_top import render
+
+    snapshot = {
+        "rollup": {
+            "servers": 2, "draining": 1, "degraded": 0, "retired": 1,
+            "stale_evicted": 0, "tokens_per_s": 123.4,
+            "occupancy": 0.5, "occupied": 4, "slots": 8,
+            "slot_headroom": 4, "mem_headroom_bytes": 2 << 30,
+            "inflight": 3, "tokens": 1000, "admitted": 50, "shed": 2,
+            "tenants": {"A": {"admitted": 40, "shed": 1}},
+            "slo_burn": {"A": 1.25},
+        },
+        "servers": [
+            {"addr": "127.0.0.1:9000", "seq": 12, "seen_s": 0.4,
+             "inflight": 2, "slots": 4, "occupied": 3,
+             "tokens_per_s": 100.0, "shed": 1,
+             "mem_headroom_bytes": 1 << 30},
+            {"addr": "127.0.0.1:9001", "seq": 9, "seen_s": 1.0,
+             "draining": True, "inflight": 1, "slots": 4,
+             "occupied": 1, "tokens_per_s": 23.4, "shed": 1},
+        ],
+    }
+    out = render(snapshot, "prod")
+    assert "127.0.0.1:9000" in out and "127.0.0.1:9001" in out
+    assert "draining" in out
+    assert "123.4" in out           # rollup tokens/s
+    assert "A: 40/1" in out         # tenant admitted/shed
+    assert "A: 1.25" in out         # slo burn
+    # empty fleet renders a hint, not a crash
+    empty = render({"rollup": {
+        "servers": 0, "draining": 0, "degraded": 0, "retired": 0,
+        "stale_evicted": 0, "tokens_per_s": 0.0, "occupancy": 0.0,
+        "occupied": 0, "slots": 0, "slot_headroom": 0,
+        "mem_headroom_bytes": 0, "inflight": 0, "tokens": 0,
+        "admitted": 0, "shed": 0, "tenants": {}, "slo_burn": {},
+    }, "servers": []}, "")
+    assert "no live digests" in empty
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance (tier-1, chaos-marked)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_fleet_observatory_chaos_smoke():
+    """The acceptance contract: under generate-mode rolling-restart and
+    hot-tenant-burst (plus a tombstone-less crash), the observatory's
+    fleet rollups are EXACTLY the sum of per-server ledgers including
+    retired servers, digests were observed from every server, the stale
+    digest was TTL-evicted, and the per-tenant SLO burn gauges are
+    visible in /metrics — with zero lost streams and zero breaker
+    trips."""
+    from tools.chaos_fleet import run_observatory_script
+
+    v = run_observatory_script(servers=3, streams=8)
+    assert v["ok"], v
+    # the contract, spelled out
+    assert v["mismatched"] == 0
+    assert v["crosscheck_pre_crash"]["exact"]
+    assert v["crosscheck_post_crash"]["exact"]
+    cc = v["crosscheck_post_crash"]
+    assert cc["rollup_tokens"] == cc["ledger_tokens"]
+    assert cc["rollup_tenants"] == cc["ledger_tenants"]
+    assert cc["servers_seen"] == cc["server_starts"]
+    assert cc["stale_evicted"] >= 1
+    assert v["burst_shed_B"] > 0
+    assert v["metrics_endpoint_ok"]
+    assert v["rolling_restart"]["drain_dropped"] == 0
+    assert v["breaker_trips"] == 0

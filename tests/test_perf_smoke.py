@@ -216,6 +216,70 @@ def test_memory_monitor_armed_identity_floor():
     assert off.memory_monitor is None
 
 
+def test_fleet_observatory_armed_identity_floor():
+    """PR-15 pin: with the FLEET OBSERVATORY fully armed in-process — a
+    digest publisher polling on the sweeper cadence, a live
+    FleetObservatory ingesting every digest, its ``nns.fleet.*``
+    registry collector registered, and SLO instruments holding
+    observations — the fused identity chain still clears the absolute
+    4000 fps floor.  The whole plane is sweeper- and scrape-time-only:
+    an armed-but-idle observatory costs ZERO on the per-frame path."""
+    from nnstreamer_tpu.core.fleet import (
+        DigestPublisher,
+        FleetObservatory,
+        pipeline_digest_stats,
+    )
+    from nnstreamer_tpu.core.telemetry import REGISTRY, SloTracker
+
+    pipe = parse_pipeline(CHAIN, name="fleetperf", fuse=True)
+    obs = FleetObservatory(topic="perf", default_ttl_s=60.0)
+    REGISTRY.register_collector(obs._collect)
+    slo = SloTracker(ttft_p95_s=0.5, token_p99_s=0.01, availability=0.99)
+    slo.note_ttft("perf", 0.01)
+    slo.note_tokens("perf", 0.02, 8)
+    slo.note_stream("perf", "good")
+    pub = DigestPublisher(
+        lambda: {**pipeline_digest_stats(pipe), "inflight": 0,
+                 "slo_burn": {t: r.get("ttft_burn", 0.0)
+                              for t, r in slo.snapshot().items()}},
+        lambda d: obs.ingest(
+            "nns/query/perf/a", {"host": "x", "port": 1, "digest": d}),
+        interval_s=0.02, name="perf")
+    pipe.register_sweep(pub.poll, 0.02)
+    try:
+        pipe.start()
+        src, sink = pipe["src"], pipe["out"]
+        done = {"n": 0}
+        sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+        pool = [np.zeros((64,), np.float32) for _ in range(16)]
+        for i in range(128):
+            src.push(pool[i % 16])
+        t_w = time.time()
+        while done["n"] < 128 and time.time() - t_w < 30:
+            time.sleep(0.005)
+        assert done["n"] >= 128, "warmup stalled"
+        done["n"] = 0
+        n = 2500
+        t0 = time.perf_counter()
+        for i in range(n):
+            src.push(pool[i % 16])
+        while done["n"] < n and time.perf_counter() - t0 < 60:
+            time.sleep(0.002)
+        fps = done["n"] / (time.perf_counter() - t0)
+        src.end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert done["n"] == n, "frames lost with the observatory armed"
+        assert fps >= 4000, (
+            f"observatory-armed dataplane regressed: {fps:.0f} fps < 4000"
+        )
+        # the digest plane really ran on the sweeper, not the frame path
+        assert pub.published > 0
+        assert obs.rollup()["digests"] > 0
+    finally:
+        REGISTRY.unregister_collector(obs._collect)
+
+
 def test_oom_retry_accounting_parity_fused_vs_unfused():
     """PR-14 satellite: the OOM shrink-retry ladder produces IDENTICAL
     outputs and identical ``oom_retries``/``oom_shrinks`` accounting

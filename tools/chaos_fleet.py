@@ -255,7 +255,8 @@ class FleetHarness:
                  affinity_key: str = "sess", base_id: int = 9600,
                  mode: str = "unary", gen_slots: int = 2,
                  gen_max_new: int = 24, gen_vocab: int = 997,
-                 gen_step_ms: float = 1.0):
+                 gen_step_ms: float = 1.0, digest_interval: float = 0.0,
+                 gen_slo: str = ""):
         from nnstreamer_tpu.distributed.mqtt import MiniBroker
 
         self.topic = topic
@@ -271,6 +272,12 @@ class FleetHarness:
         self.gen_max_new = gen_max_new
         self.gen_vocab = gen_vocab
         self.gen_step_ms = gen_step_ms
+        # fleet observatory (core/fleet.py): >0 arms the servers'
+        # telemetry-digest publishers; gen_slo adds slo-* props on the
+        # generator (e.g. "slo-ttft-p95=10 slo-availability=0.9")
+        self.digest_interval = digest_interval
+        self.gen_slo = gen_slo
+        self.observatory = None
         self.broker = MiniBroker()
         self.servers: Dict[int, Any] = {}   # idx -> pipeline (live only)
         self.ports: Dict[int, int] = {}     # idx -> port (survives kills)
@@ -283,6 +290,11 @@ class FleetHarness:
         # resume/migration invariants sum over every engine that ever
         # decoded a token, including killed/rolled ones
         self.retired_gen: List[Dict[str, Any]] = []
+        # global admission counters of retired servers (the observatory
+        # cross-check sums admitted/shed over every server that ever
+        # served, exactly like the per-tenant rows above)
+        self.retired_admission: List[Dict[str, int]] = []
+        self.server_starts = 0
 
     # -- servers ------------------------------------------------------------
     def start_server(self, idx: int, port: int = 0):
@@ -290,6 +302,7 @@ class FleetHarness:
 
         quotas = (f"tenant-quotas={self.tenant_quotas} "
                   if self.tenant_quotas else "")
+        slo = f"{self.gen_slo} " if self.gen_slo else ""
         if self.mode == "generate":
             # continuous-batching generator fleet: each server
             # multiplexes concurrent token streams into shared slots
@@ -299,18 +312,20 @@ class FleetHarness:
                 f"custom=sim:1,sim_step_ms:{self.gen_step_ms},"
                 f"sim_per_slot_ms:0.05,sim_prefill_ms:0.02,"
                 f"vocab:{self.gen_vocab} "
-                f"max-new={self.gen_max_new} chunk=4 ! "
+                f"max-new={self.gen_max_new} chunk=4 {slo}! "
             )
         else:
             core = (
                 f"identity sleep={self.server_sleep} ! "
                 "tensor_filter framework=scaler custom=factor:2 ! "
             )
+        digest = (f"digest-interval={self.digest_interval} "
+                  if self.digest_interval > 0 else "digest-interval=0 ")
         pipe = parse_pipeline(
             f"tensor_query_serversrc name=ssrc id={self.base_id + idx} "
             f"port={port} connect-type={self.connect_type} "
             f"topic={self.topic} dest-host=127.0.0.1 "
-            f"dest-port={self.broker.port} "
+            f"dest-port={self.broker.port} {digest}"
             f"max-inflight={self.max_inflight} {quotas}"
             f"shed-window={self.shed_window_s} ! "
             f"{core}"
@@ -320,14 +335,45 @@ class FleetHarness:
         pipe.start()
         self.servers[idx] = pipe
         self.ports[idx] = pipe["ssrc"].props["port"]
+        self.server_starts += 1
         return pipe
+
+    def _retire_rows(self, pipe) -> None:
+        self.retired_tenants.append(self.server_tenant_rows(pipe))
+        self.retired_gen.append(self.server_gen_row(pipe))
+        self.retired_admission.append(self.server_admission_row(pipe))
 
     def kill_server(self, idx: int) -> None:
         """Hard stop: no drain, no GOAWAY — in-flight requests die with
         their sockets (the announce is tombstoned by element stop)."""
         pipe = self.servers.pop(idx)
-        self.retired_tenants.append(self.server_tenant_rows(pipe))
-        self.retired_gen.append(self.server_gen_row(pipe))
+        self._retire_rows(pipe)
+        pipe.stop()
+
+    def crash_server(self, idx: int) -> None:
+        """Crash simulation for the OBSERVATORY's staleness contract: the
+        process dies without tombstoning its retained announce (a real
+        SIGKILL never runs ``stop()``'s clear), so the stale digest must
+        be TTL-evicted by the observatory, not retired by a tombstone.
+        The last force-published digest still carries the final
+        counters, so fleet totals stay exact."""
+        pipe = self.servers.pop(idx)
+        self._retire_rows(pipe)
+        ssrc = pipe["ssrc"]
+        if ssrc._digest is not None:
+            ssrc._digest.poll(force=True)
+        # detach the announce BEFORE stop: clear() then has nothing to
+        # tombstone — exactly a crashed process's broker state (the
+        # retained digest stays).  The mqtt client itself must still be
+        # CLOSED: its reconnect-enabled reader/ping threads would
+        # otherwise outlive the harness (and trip the test suite's
+        # framework-thread quiesce guard for the rest of the session)
+        ann = ssrc._announcement
+        if ann is not None:
+            client, ann._client = ann._client, None
+            ssrc._announcement = None
+            if client is not None:
+                client.close()
         pipe.stop()
 
     def rolling_restart(self, idx: int, drain_timeout: float = 15.0) -> Dict[str, Any]:
@@ -337,8 +383,7 @@ class FleetHarness:
         res = pipe.drain(timeout=drain_timeout)
         health = pipe.health()["ssrc"]
         gen_health = self.server_gen_row(pipe)
-        self.retired_tenants.append(self.server_tenant_rows(pipe))
-        self.retired_gen.append(gen_health)
+        self._retire_rows(pipe)
         pipe.stop()
         self.servers.pop(idx)
         self.start_server(idx, port=self.ports[idx])
@@ -414,6 +459,94 @@ class FleetHarness:
                 agg["admitted"] += int(row.get("admitted", 0))
                 agg["shed"] += int(row.get("shed", 0))
         return total
+
+    @staticmethod
+    def server_admission_row(pipe) -> Dict[str, int]:
+        h = pipe.health()["ssrc"]
+        return {"admitted": int(h.get("admitted", 0)),
+                "shed": int(h.get("load_shed", 0))}
+
+    def fleet_admission(self) -> Dict[str, int]:
+        """Global {admitted, shed} over every server that ever served."""
+        total = {"admitted": 0, "shed": 0}
+        rows = [self.server_admission_row(p) for p in self.servers.values()]
+        rows.extend(self.retired_admission)
+        for r in rows:
+            total["admitted"] += r["admitted"]
+            total["shed"] += r["shed"]
+        return total
+
+    # -- fleet observatory --------------------------------------------------
+    def attach_observatory(self, ttl_s: float = 10.0):
+        """Subscribe a :class:`FleetObservatory` to this harness's
+        broker (requires ``digest_interval`` > 0 on the servers)."""
+        from nnstreamer_tpu.core.fleet import FleetObservatory
+
+        self.observatory = FleetObservatory(
+            topic=self.topic, default_ttl_s=ttl_s,
+        ).start("127.0.0.1", self.broker.port)
+        return self.observatory
+
+    def publish_digests(self) -> None:
+        """Force a digest publish on every LIVE server NOW (scripted
+        verdict points must not wait out the publish interval)."""
+        for pipe in self.servers.values():
+            pipe["ssrc"].publish_digest(force=True)
+
+    def observatory_settled(self, timeout: float = 10.0) -> None:
+        """Block until the observatory ingested every live server's
+        LATEST published digest (by seq) — the verdict must compare
+        final ledgers against final digests, not in-flight ones."""
+        want = {}
+        for pipe in self.servers.values():
+            ssrc = pipe["ssrc"]
+            if ssrc._digest is not None and ssrc._announcement is not None:
+                want[ssrc._announcement.topic] = ssrc._digest.seq
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = {r["topic"]: r for r in self.observatory.servers()}
+            if all(
+                t in rows and int(rows[t].get("seq", 0)) >= seq
+                for t, seq in want.items()
+            ):
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"observatory never caught up to {want} (has "
+            f"{[(r['topic'], r.get('seq')) for r in self.observatory.servers()]})")
+
+    def observatory_crosscheck(self) -> Dict[str, Any]:
+        """The acceptance cross-check: the observatory's fleet rollups
+        must EXACTLY equal the sum of per-server ledgers, retired
+        servers included.  Call after :meth:`publish_digests` +
+        :meth:`observatory_settled` at a quiescent point."""
+        roll = self.observatory.rollup()
+        ledger_tenants = {
+            t: {"admitted": r["admitted"], "shed": r["shed"]}
+            for t, r in self.fleet_tenants().items()
+        }
+        ledger_adm = self.fleet_admission()
+        tokens_exact = roll["tokens"] == self.fleet_tokens()
+        admitted_exact = roll["admitted"] == ledger_adm["admitted"]
+        shed_exact = roll["shed"] == ledger_adm["shed"]
+        tenants_exact = roll["tenants"] == ledger_tenants
+        return {
+            "rollup_tokens": roll["tokens"],
+            "ledger_tokens": self.fleet_tokens(),
+            "rollup_admitted": roll["admitted"],
+            "ledger_admitted": ledger_adm["admitted"],
+            "rollup_shed": roll["shed"],
+            "ledger_shed": ledger_adm["shed"],
+            "rollup_tenants": roll["tenants"],
+            "ledger_tenants": ledger_tenants,
+            "servers_seen": self.observatory.servers_seen,
+            "server_starts": self.server_starts,
+            "stale_evicted": roll["stale_evicted"],
+            "retired": roll["retired"],
+            "slo_burn": roll["slo_burn"],
+            "exact": bool(tokens_exact and admitted_exact and shed_exact
+                          and tenants_exact),
+        }
 
     # -- clients ------------------------------------------------------------
     def make_client(self, name: str, tenant: str = "",
@@ -570,6 +703,12 @@ class FleetHarness:
             except Exception:  # allow-silent: teardown best-effort
                 pass
         self.servers.clear()
+        if self.observatory is not None:
+            try:
+                self.observatory.stop()
+            except Exception:  # allow-silent: teardown best-effort
+                pass
+            self.observatory = None
         self.broker.close()
 
 
@@ -834,6 +973,121 @@ def run_generate_resume_script(servers: int = 3, streams: int = 8,
         h.stop_all()
 
 
+def run_observatory_script(servers: int = 3, streams: int = 8) -> Dict[str, Any]:
+    """Fleet-observatory chaos acceptance (Documentation/observability.md
+    "Fleet observatory"): a generate-mode fleet publishing telemetry
+    digests survives a rolling restart mid-wave, a hot-tenant burst over
+    quota, and a tombstone-less CRASH — and at every quiescent point the
+    observatory's fleet rollups (tokens, admitted, shed, per-tenant
+    rows) are EXACTLY equal to the sum of per-server ledgers, retired
+    servers included.
+
+    Contract pinned by the verdict: digests observed from every server
+    that ever started, the crashed server's stale digest TTL-evicted
+    (its counters retired exactly), per-tenant SLO burn gauges and
+    ``nns.fleet.*`` rollups visible in ``/metrics``, zero lost streams,
+    zero breaker trips (the crash lands after clients finished)."""
+    from urllib.request import urlopen
+
+    h = FleetHarness(mode="generate", gen_slots=4, gen_max_new=24,
+                     gen_step_ms=1.0, base_id=10000, topic="chaosobs",
+                     tenant_quotas="B:1", digest_interval=0.25,
+                     gen_slo=("slo-ttft-p95=30 slo-token-p99=5 "
+                              "slo-availability=0.5"))
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        obs = h.attach_observatory(ttl_s=5.0)
+        mport = obs.serve_metrics(0)
+        ca = [h.make_gen_client(f"A{i}", tenant="A") for i in range(2)]
+
+        # wave 1: steady 2-client tenant-A load
+        for _ in range(max(1, streams // 2)):
+            for c in ca:
+                c.push_prompt()
+        for c in ca:
+            c.settle(timeout=120.0)
+
+        # wave 2 pushed, rolling restart lands MID-WAVE (digesting
+        # server drains: streams migrate, its final digest retires its
+        # exact counters, the restarted instance digests from zero)
+        for _ in range(max(1, streams // 2)):
+            for c in ca:
+                c.push_prompt()
+        roll = h.rolling_restart(0)
+        for c in ca:
+            c.settle(timeout=120.0)
+
+        # hot-tenant burst: 3 concurrent tenant-B streams against a
+        # B:1 quota — fresh least-inflight clients all rank the same
+        # lowest-address server first, so quota sheds are guaranteed;
+        # busy-retries spread the losers to other servers (all finish)
+        cb = [
+            h.make_gen_client(f"B{i}", tenant="B", busy_retries=40)
+            for i in range(3)
+        ]
+        for c in cb:
+            c.push_prompt()
+        for c in cb:
+            c.settle(timeout=120.0)
+
+        for c in ca + cb:
+            c.finish()
+        checks = [c.check_exact() for c in ca + cb]
+
+        # quiescent verdict point 1: force digests, wait for ingest,
+        # cross-check rollups vs ledgers EXACTLY (retired roll incl.)
+        h.publish_digests()
+        h.observatory_settled()
+        cc_pre = h.observatory_crosscheck()
+
+        # crash (no tombstone): the observatory must TTL-evict the
+        # stale row and retire its exact final counters
+        h.crash_server(max(h.servers))
+        stale_deadline = time.monotonic() + 15.0
+        while (h.observatory.rollup()["stale_evicted"] < 1
+               and time.monotonic() < stale_deadline):
+            time.sleep(0.05)
+        cc_post = h.observatory_crosscheck()
+
+        body = urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5).read().decode()
+        metrics_ok = all(
+            frag in body for frag in (
+                "nns_fleet_tokens", "nns_fleet_servers",
+                "nns_fleet_tenant_shed", "nns_fleet_slo_burn",
+                "nns_slo_availability_burn",
+            ))
+        shed_b = h.fleet_tenants().get("B", {}).get("shed", 0)
+        v = {
+            "clients": {c.name: r for c, r in zip(ca + cb, checks)},
+            "exact": sum(r["exact"] for r in checks),
+            "mismatched": sum(r["mismatched"] for r in checks),
+            "rolling_restart": {
+                "goaway_sent": roll["health"].get("goaway_sent", 0),
+                "drain_dropped": roll["drain"]["dropped"],
+            },
+            "burst_shed_B": shed_b,
+            "crosscheck_pre_crash": cc_pre,
+            "crosscheck_post_crash": cc_post,
+            "metrics_endpoint_ok": metrics_ok,
+            "breaker_trips": h.breaker_trips(),
+        }
+        v["ok"] = bool(
+            v["mismatched"] == 0
+            and cc_pre["exact"] and cc_post["exact"]
+            and cc_post["servers_seen"] == h.server_starts
+            and cc_post["stale_evicted"] >= 1
+            and shed_b > 0
+            and roll["drain"]["dropped"] == 0
+            and metrics_ok
+            and v["breaker_trips"] == 0
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
 def run_device_loss_script(servers: int = 3, streams: int = 8,
                            seed: int = 0) -> Dict[str, Any]:
     """Device-loss chaos (degrade, don't die — Documentation/
@@ -969,16 +1223,19 @@ def main() -> int:
                     help="distinct affinity sessions")
     ap.add_argument("--mode",
                     choices=("unary", "generate", "generate-resume",
-                             "device-loss"),
+                             "device-loss", "observatory"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
                     "generation-stream fleet (continuous batching), "
                     "the durable-stream chaos: hard kill + rolling "
                     "restart at seeded random decode points with "
-                    "checkpointed resume / live migration, or the "
+                    "checkpointed resume / live migration, the "
                     "device-loss chaos: a mesh member dies mid-decode "
                     "— streams hand off resumably, the engine "
-                    "re-meshes, the server announces degraded")
+                    "re-meshes, the server announces degraded, or the "
+                    "observatory chaos: digest-publishing fleet under "
+                    "rolling restart + hot-tenant burst + crash, with "
+                    "exact fleet-rollup-vs-ledger cross-checks")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -996,6 +1253,9 @@ def main() -> int:
         verdict = run_device_loss_script(
             max(2, min(args.servers, 4)), max(2, args.streams),
             args.seed)
+    elif args.mode == "observatory":
+        verdict = run_observatory_script(
+            max(2, min(args.servers, 4)), max(2, args.streams))
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""fleet_top: live fleet dashboard over the discovery-plane telemetry
+digests (Documentation/observability.md "Fleet observatory").
+
+Subscribes a :class:`FleetObservatory` to the retained announces under
+``nns/query/<topic>/#`` and renders the fleet: one row per live server
+(state, digest seq/staleness, inflight, slot occupancy, tokens/s,
+memory headroom, per-server shed) under a rollup header (aggregate
+tokens/s, weighted occupancy, admittable-slot headroom, per-tenant
+admitted/shed, SLO burn).  No server-side changes needed — servers
+publish digests whenever ``digest-interval`` > 0 and they announce.
+
+Modes::
+
+    python tools/fleet_top.py --broker-port 1883 --topic prod           # one-shot table
+    python tools/fleet_top.py --broker-port 1883 --topic prod --json    # one-shot JSON (scripts)
+    python tools/fleet_top.py --broker-port 1883 --topic prod --watch   # live terminal view
+    python tools/fleet_top.py ... --metrics-port 9464                   # + Prometheus endpoint
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_bytes(n: int) -> str:
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
+def _server_state(row: Dict[str, Any]) -> str:
+    if row.get("draining"):
+        return "draining"
+    if row.get("degraded"):
+        return "degraded"
+    if row.get("swap", "idle") != "idle":
+        return f"swap:{row['swap']}"
+    if row.get("mem_pressure"):
+        return "mem-pressure"
+    return "serving"
+
+
+def render(snapshot: Dict[str, Any], topic: str) -> str:
+    """The terminal view: rollup header + one aligned row per server.
+    Pure function of the snapshot (unit-testable without a broker)."""
+    roll = snapshot["rollup"]
+    servers: List[Dict[str, Any]] = snapshot["servers"]
+    lines = [
+        f"fleet '{topic or '#'}' — {roll['servers']} server(s) live, "
+        f"{roll['draining']} draining, {roll['degraded']} degraded, "
+        f"{roll['retired']} retired, {roll['stale_evicted']} stale-evicted",
+        f"tokens/s {roll['tokens_per_s']:.1f}   occupancy "
+        f"{roll['occupancy']:.2f} ({roll['occupied']}/{roll['slots']})   "
+        f"slot headroom {roll['slot_headroom']}   mem headroom "
+        f"{_fmt_bytes(roll['mem_headroom_bytes'])}   inflight "
+        f"{roll['inflight']}",
+        f"totals (retired incl.): tokens {roll['tokens']}  admitted "
+        f"{roll['admitted']}  shed {roll['shed']}",
+    ]
+    if roll.get("tenants"):
+        parts = [
+            f"{t or '<unnamed>'}: {r['admitted']}/{r['shed']}"
+            for t, r in sorted(roll["tenants"].items())
+        ]
+        lines.append("tenants (admitted/shed): " + "  ".join(parts))
+    if roll.get("slo_burn"):
+        parts = [
+            f"{t or '<unnamed>'}: {b:.2f}"
+            for t, b in sorted(roll["slo_burn"].items())
+        ]
+        lines.append("slo burn (worst per tenant): " + "  ".join(parts))
+    lines.append("")
+    hdr = (f"{'ADDR':<22}{'STATE':<14}{'SEQ':>6}{'AGE':>7}{'INFL':>6}"
+           f"{'SLOTS':>8}{'TOK/S':>9}{'SHED':>7}{'HEADROOM':>10}")
+    lines.append(hdr)
+    for row in servers:
+        occ = (f"{row.get('occupied', 0)}/{row.get('slots', 0)}"
+               if row.get("slots") else "-")
+        lines.append(
+            f"{row['addr']:<22}{_server_state(row):<14}"
+            f"{row.get('seq', 0):>6}{row.get('seen_s', 0.0):>6.1f}s"
+            f"{row.get('inflight', 0):>6}{occ:>8}"
+            f"{row.get('tokens_per_s', 0.0):>9.1f}"
+            f"{row.get('shed', 0):>7}"
+            f"{_fmt_bytes(row.get('mem_headroom_bytes', 0)):>10}"
+        )
+    if not servers:
+        lines.append("(no live digests — servers down, digests off, or "
+                     "wrong topic)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--broker-host", default="localhost")
+    ap.add_argument("--broker-port", type=int, required=True)
+    ap.add_argument("--topic", default="",
+                    help="announce topic (empty = every topic)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the snapshot as JSON and exit (scripts)")
+    ap.add_argument("--watch", action="store_true",
+                    help="live terminal view (redraw every --interval)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch redraw interval, seconds")
+    ap.add_argument("--settle", type=float, default=1.0,
+                    help="seconds to gather retained announces before "
+                    "the first render")
+    ap.add_argument("--ttl", type=float, default=10.0,
+                    help="fallback staleness TTL for digests that carry "
+                    "none, seconds")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="also serve /metrics (Prometheus) on this port "
+                    "(0 = ephemeral; -1 = off)")
+    args = ap.parse_args()
+
+    from nnstreamer_tpu.core.fleet import FleetObservatory
+
+    obs = FleetObservatory(topic=args.topic, default_ttl_s=args.ttl)
+    obs.start(args.broker_host, args.broker_port)
+    try:
+        if args.metrics_port >= 0:
+            port = obs.serve_metrics(args.metrics_port)
+            print(f"# /metrics on http://127.0.0.1:{port}/metrics",
+                  file=sys.stderr)
+        time.sleep(max(0.0, args.settle))
+        if args.json:
+            print(json.dumps(obs.snapshot(), indent=1, sort_keys=True))
+            return 0
+        if not args.watch:
+            print(render(obs.snapshot(), args.topic))
+            return 0
+        while True:
+            # ANSI home+clear-below: redraw without scrollback spam
+            sys.stdout.write("\x1b[H\x1b[2J")
+            print(time.strftime("%H:%M:%S"))
+            print(render(obs.snapshot(), args.topic))
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        obs.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
