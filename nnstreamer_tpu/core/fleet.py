@@ -59,6 +59,15 @@ DIGEST_MAX_TENANTS = 16
 #: default digest TTL = this many publish intervals without a fresh
 #: digest before the observatory retires the row
 DIGEST_TTL_INTERVALS = 3.0
+#: fraction of a row's TTL after which it is STALE: still listed (the
+#: server may be merely slow), but a wedged-but-announcing server must
+#: never count as capacity, so stale rows are excluded from the rollup's
+#: headroom/throughput gauges and from controller math
+DIGEST_STALE_FRACTION = 0.5
+#: retired-contribution snapshots kept for possible resurrection
+#: (a topic is one process instance — pid+uuid — so a very old
+#: snapshot can never match a new server; bound the ledger)
+RETIRED_ROWS_MAX = 1024
 #: smoothing for the tokens/s EWMA carried in the digest
 _RATE_EWMA = 0.3
 
@@ -98,6 +107,7 @@ def pipeline_digest_stats(pipe) -> Dict[str, Any]:
     have_gen = False
     swap = "idle"
     slo_burn: Dict[str, float] = {}
+    ttft_p95 = 0.0
     try:
         health = pipe.health()
     except Exception:  # a digest must never die on a health bug
@@ -121,12 +131,19 @@ def pipeline_digest_stats(pipe) -> Dict[str, Any]:
                 if burns:
                     slo_burn[tenant] = max(
                         slo_burn.get(tenant, 0.0), max(burns))
+                t95 = srow.get("ttft_p95_ms")
+                if isinstance(t95, (int, float)):
+                    ttft_p95 = max(ttft_p95, float(t95))
     if have_gen:
         stats["tokens"] = sums["gen_tokens"]
         stats["slots"] = sums["gen_slots"]
         stats["occupied"] = sums["gen_occupied"]
         stats["waiting"] = sums["gen_waiting"]
     stats["swap"] = swap
+    if ttft_p95 > 0:
+        # worst observed p95 TTFT across tenants — the predictive
+        # autoscaler's latency observable (core/autoscale.py PerfModel)
+        stats["ttft_p95_ms"] = round(ttft_p95, 3)
     if slo_burn:
         stats["slo_burn"] = {
             t: round(float(b), 3) for t, b in slo_burn.items()}
@@ -238,6 +255,8 @@ class DigestPublisher:
                   "mem_headroom_bytes", "mem_pressure"):
             if k in stats:
                 digest[k] = int(stats[k])
+        if "ttft_p95_ms" in stats:
+            digest["ttft_p95_ms"] = round(float(stats["ttft_p95_ms"]), 3)
         tenants, dropped = self._bounded_tenants(stats.get("tenants") or {})
         if tenants:
             digest["tenants"] = tenants
@@ -323,10 +342,14 @@ class FleetObservatory:
 
     def __init__(self, topic: str = "", default_ttl_s: float = 10.0,
                  max_servers: int = OBSERVATORY_MAX_SERVERS,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 stale_fraction: float = DIGEST_STALE_FRACTION,
+                 retired_cap: int = RETIRED_ROWS_MAX):
         self.topic = topic
         self.default_ttl_s = float(default_ttl_s)
         self.max_servers = int(max_servers)
+        self.stale_fraction = float(stale_fraction)
+        self.retired_cap = max(1, int(retired_cap))
         self.clock = clock
         self._lock = threading.Lock()
         self._rows: Dict[str, _ServerRow] = {}   # topic -> row
@@ -350,6 +373,7 @@ class FleetObservatory:
             OrderedDict())
         self.retired = 0         # rows retired (tombstone)
         self.stale_evicted = 0   # rows retired (TTL / table bound)
+        self.retired_evicted = 0  # retired snapshots dropped by the cap
         self.resurrected = 0     # retired rows that came back alive
         self.digests = 0         # digests ingested, lifetime
         self.servers_seen = 0    # distinct announce instances ever seen
@@ -466,10 +490,8 @@ class FleetObservatory:
             if row is not None:
                 self._retire_locked(row, stale=False, pop=False)
 
-    #: retired-contribution snapshots kept for possible resurrection
-    #: (a topic is one process instance — pid+uuid — so a very old
-    #: snapshot can never match a new server; bound the ledger)
-    _RETIRED_ROWS_MAX = 1024
+    #: back-compat alias for the module-level default cap
+    _RETIRED_ROWS_MAX = RETIRED_ROWS_MAX
 
     def _retire_locked(self, row: _ServerRow, stale: bool,
                        pop: bool = True) -> None:
@@ -494,8 +516,17 @@ class FleetObservatory:
             agg["shed"] += r["shed"]
         self._retired_rows[row.topic] = contrib
         self._retired_rows.move_to_end(row.topic)
-        while len(self._retired_rows) > self._RETIRED_ROWS_MAX:
-            self._retired_rows.popitem(last=False)
+        while len(self._retired_rows) > self.retired_cap:
+            # aggregates already hold the evicted row's counters exactly
+            # (the accumulators above are separate from these
+            # snapshots); what is lost is only the ability to reverse a
+            # resurrection for that topic — count it LOUDLY
+            evicted_topic, _ = self._retired_rows.popitem(last=False)
+            self.retired_evicted += 1
+            log.warning(
+                "retired-server ledger over cap (%d): dropping "
+                "resurrection snapshot for %s (aggregates preserved)",
+                self.retired_cap, evicted_topic)
         if stale:
             self.stale_evicted += 1
         else:
@@ -521,10 +552,22 @@ class FleetObservatory:
             "digest row %s resurrected: its retired contribution "
             "(%d tokens) reversed", topic, contrib["tokens"])
 
+    def _row_ttl(self, row: _ServerRow) -> float:
+        return float(row.digest.get("ttl_s", self.default_ttl_s)
+                     or self.default_ttl_s)
+
+    def _stale_locked(self, row: _ServerRow, now: float) -> bool:
+        """Stale tier below eviction: the digest outlived
+        ``stale_fraction`` of its TTL.  The row stays listed (the server
+        may be merely slow), but it is flagged in :meth:`servers`,
+        counted in ``rollup()["stale"]``, and EXCLUDED from the
+        headroom/throughput gauges — a wedged-but-announcing server must
+        never count as capacity."""
+        return now - row.received_ts > self.stale_fraction * self._row_ttl(row)
+
     def _evict_stale_locked(self, now: float) -> None:
         for row in list(self._rows.values()):
-            ttl = float(row.digest.get("ttl_s", self.default_ttl_s)
-                        or self.default_ttl_s)
+            ttl = self._row_ttl(row)
             if now - row.received_ts > ttl:
                 log.warning(
                     "digest from %s (%s) stale for %.1fs > ttl %.1fs; "
@@ -548,6 +591,7 @@ class FleetObservatory:
                     "addr": r.addr,
                     "seen_s": round(now - r.received_ts, 3),
                     "digests": r.digests,
+                    "stale": self._stale_locked(r, now),
                 }
                 for r in sorted(self._rows.values(), key=lambda r: r.addr)
             ]
@@ -564,6 +608,7 @@ class FleetObservatory:
             rows = list(self._rows.values())
             roll: Dict[str, Any] = {
                 "servers": len(rows),
+                "stale": 0,
                 "draining": 0,
                 "degraded": 0,
                 "swapping": 0,
@@ -575,12 +620,14 @@ class FleetObservatory:
                 "tokens_per_s": 0.0,
                 "slot_headroom": 0,
                 "mem_headroom_bytes": 0,
+                "ttft_p95_ms": 0.0,
                 "tokens": self._retired_tokens,
                 "admitted": self._retired_admitted,
                 "shed": self._retired_shed,
                 "digests": self.digests,
                 "retired": self.retired,
                 "stale_evicted": self.stale_evicted,
+                "retired_evicted": self.retired_evicted,
                 "servers_seen": self.servers_seen,
             }
             tenants: Dict[str, Dict[str, int]] = {
@@ -589,6 +636,8 @@ class FleetObservatory:
             slo_burn: Dict[str, float] = {}
             for r in rows:
                 d = r.digest
+                stale = self._stale_locked(r, now)
+                roll["stale"] += 1 if stale else 0
                 roll["draining"] += 1 if d.get("draining") else 0
                 roll["degraded"] += 1 if d.get("degraded") else 0
                 roll["swapping"] += (
@@ -601,15 +650,24 @@ class FleetObservatory:
                 roll["slots"] += slots
                 roll["occupied"] += occupied
                 roll["waiting"] += int(d.get("waiting", 0) or 0)
-                roll["tokens_per_s"] += float(d.get("tokens_per_s", 0.0)
-                                              or 0.0)
-                # admittable headroom: free slots on servers NOT under
-                # memory pressure (a pressured server sheds BUSY at the
-                # door, so its free slots are not admittable)
-                if not pressured:
-                    roll["slot_headroom"] += max(0, slots - occupied)
-                roll["mem_headroom_bytes"] += int(
-                    d.get("mem_headroom_bytes", 0) or 0)
+                if not stale:
+                    # capacity/throughput gauges come from FRESH rows
+                    # only: a wedged-but-announcing server's numbers are
+                    # fiction, and counting its free slots as headroom
+                    # would talk the controller out of a needed scale-up
+                    roll["tokens_per_s"] += float(d.get("tokens_per_s", 0.0)
+                                                  or 0.0)
+                    # admittable headroom: free slots on servers NOT
+                    # under memory pressure (a pressured server sheds
+                    # BUSY at the door, so its free slots are not
+                    # admittable)
+                    if not pressured:
+                        roll["slot_headroom"] += max(0, slots - occupied)
+                    roll["mem_headroom_bytes"] += int(
+                        d.get("mem_headroom_bytes", 0) or 0)
+                    roll["ttft_p95_ms"] = max(
+                        roll["ttft_p95_ms"],
+                        float(d.get("ttft_p95_ms", 0.0) or 0.0))
                 roll["tokens"] += int(d.get("tokens", 0) or 0)
                 roll["admitted"] += int(d.get("admitted", 0) or 0)
                 roll["shed"] += int(d.get("shed", 0) or 0)
@@ -635,6 +693,7 @@ class FleetObservatory:
     # -- registry export (ONE collector; scrape-time only) ------------------
     _ROLLUP_METRICS: Tuple[Tuple[str, str], ...] = (
         ("servers", "nns.fleet.servers"),
+        ("stale", "nns.fleet.stale"),
         ("draining", "nns.fleet.draining"),
         ("degraded", "nns.fleet.degraded"),
         ("swapping", "nns.fleet.swapping"),
@@ -653,6 +712,8 @@ class FleetObservatory:
         ("digests", "nns.fleet.digests"),
         ("retired", "nns.fleet.retired"),
         ("stale_evicted", "nns.fleet.stale_evicted"),
+        ("retired_evicted", "nns.fleet.retired_evicted"),
+        ("ttft_p95_ms", "nns.fleet.ttft_p95_ms"),
     )
 
     def _collect(self) -> List[Sample]:

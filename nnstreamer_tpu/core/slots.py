@@ -481,6 +481,36 @@ class SlotEngine:
             self._goaway = True
             self._work.notify_all()
 
+    def end_goaway(self) -> None:
+        """Rescind a drain handoff (the resize rollback path: the
+        replacement model failed to build, so this engine keeps
+        serving).  Streams already flushed stay handed off — their
+        clients resume them here or elsewhere; new joins stop being
+        swept from the next boundary on."""
+        with self._work:
+            self._goaway = False
+
+    #: cumulative ledger counters that survive an in-place engine
+    #: rebuild (autoscale resize): the server's lifetime accounting —
+    #: digests and the fleet observatory's exactness ride on these
+    #: never moving backwards
+    _LEDGER_ATTRS = (
+        "joins", "completions", "evictions", "cancellations",
+        "decode_steps", "prefill_chunks", "tokens_total", "resumes",
+        "goaway_evicted", "oom_retries", "oom_sheds", "device_lost",
+        "device_lost_evicted", "remeshes",
+    )
+
+    def adopt_ledger(self, other: "SlotEngine") -> None:
+        """Carry ``other``'s cumulative counters into this engine (call
+        before :meth:`start`).  A slot-width resize replaces the engine
+        but not the SERVER — its digest counters must stay monotonic or
+        the observatory's exact fleet totals would lose the pre-resize
+        history."""
+        for attr in self._LEDGER_ATTRS:
+            setattr(self, attr, getattr(other, attr))
+        self.tokens_per_step = other.tokens_per_step
+
     def cancel(self, sid: Optional[int] = None,
                client_id: Optional[int] = None) -> bool:
         """Cancel by stream id or by the source frame's client_id meta

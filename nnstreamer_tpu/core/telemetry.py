@@ -209,6 +209,7 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.gen.resumes": ("counter", "streams joined from a RESUME checkpoint"),
     "nns.gen.goaway_evicted": ("counter", "live streams handed off as resumable GOAWAY chunks on drain"),
     "nns.gen.resume_rejects": ("counter", "RESUME requests refused (signature/digest/shape mismatch)"),
+    "nns.gen.resizes": ("counter", "zero-loss slot-width rebuilds (autoscale resize actuation)"),
 
     # -- mesh-sharded serving (backends/jax_xla.py mesh= prop) -------------
     "nns.mesh.devices": ("gauge", "devices in the filter's serving mesh (0 = unsharded)"),
@@ -281,6 +282,27 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.fleet.digests": ("counter", "digests ingested by the observatory"),
     "nns.fleet.retired": ("counter", "server rows retired on announce tombstone"),
     "nns.fleet.stale_evicted": ("counter", "server rows retired on digest TTL expiry"),
+    "nns.fleet.stale": ("gauge", "live-but-stale servers (digest older than the stale threshold; excluded from headroom)"),
+    "nns.fleet.retired_evicted": ("counter", "retired-server snapshots evicted by the ledger cap (aggregates preserved)"),
+    "nns.fleet.ttft_p95_ms": ("gauge", "worst per-server p95 time to first token across fresh digests, ms"),
+
+    # -- fleet autoscaling (core/autoscale.py FleetController) -------------
+    "nns.autoscale.ticks": ("counter", "controller decision-loop evaluations"),
+    "nns.autoscale.decisions": ("counter", "actions emitted by the planner"),
+    "nns.autoscale.scale_ups": ("counter", "spawn actions dispatched to the actuator"),
+    "nns.autoscale.scale_downs": ("counter", "zero-loss drain actions dispatched to the actuator"),
+    "nns.autoscale.resizes": ("counter", "slot-width resize actions dispatched to the actuator"),
+    "nns.autoscale.actions_failed": ("counter", "actuator tickets that completed unsuccessfully"),
+    "nns.autoscale.actions_inflight": ("gauge", "actuator tickets dispatched but not yet complete"),
+    "nns.autoscale.cooldown_skips": ("counter", "wanted actions suppressed by a per-kind cooldown"),
+    "nns.autoscale.hysteresis_holds": ("counter", "pressure ticks held below the hysteresis streak"),
+    "nns.autoscale.envelope_clamps": ("counter", "wanted actions clamped by the min/max fleet envelope"),
+    "nns.autoscale.inflight_skips": ("counter", "targets skipped because an action is already in flight"),
+    "nns.autoscale.predictive_decisions": ("counter", "decisions driven by the fitted performance model"),
+    "nns.autoscale.reactive_decisions": ("counter", "decisions driven by the reactive (observed) path"),
+    "nns.autoscale.model_samples": ("gauge", "observations banked by the performance model"),
+    "nns.autoscale.model_ready": ("gauge", "1 when the predictive model has enough samples to act"),
+    "nns.autoscale.target_servers": ("gauge", "fleet size the controller is steering toward"),
 
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
@@ -363,6 +385,7 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "gen_resumes": "nns.gen.resumes",
     "gen_goaway_evicted": "nns.gen.goaway_evicted",
     "gen_resume_rejects": "nns.gen.resume_rejects",
+    "gen_resizes": "nns.gen.resizes",
     "mesh_devices": "nns.mesh.devices",
     "mesh_dp": "nns.mesh.dp",
     "mesh_tp": "nns.mesh.tp",

@@ -157,6 +157,11 @@ class TensorGenerator(Element):
         self._slots = 0
         self._sim = False
         self._slo = None          # SloTracker (slo-* props; slotted only)
+        # autoscale resize actuation (core/autoscale.py): the requested
+        # slot width, applied on the DISPATCH thread at the next idle
+        # boundary after every live stream handed off resumably
+        self._resize_target = 0
+        self._resizes = 0
 
     def start(self):
         import jax
@@ -169,6 +174,7 @@ class TensorGenerator(Element):
                 k, _, v = part.partition(":")
                 props[k.strip()] = v.strip()
         props.pop("arch", None)  # tolerated for zoo-dialect symmetry
+        self._resize_target = 0
         slots = int(self.props["slots"])
         if slots < 0:
             raise ElementError(f"{self.name}: slots must be >= 0")
@@ -356,6 +362,8 @@ class TensorGenerator(Element):
             # both paths refuse resumes they cannot validate (the
             # pre-slot path refuses ALL of them)
             "gen_resume_rejects": self._resume_rejects,
+            # zero-loss slot-width rebuilds (autoscale resize actuation)
+            "gen_resizes": self._resizes,
             # device-loss resilience: 1 while serving in a reduced
             # configuration (mirrored on the discovery plane)
             "degraded": 1 if self._degraded else 0,
@@ -411,7 +419,117 @@ class TensorGenerator(Element):
                     f"{eng.heartbeat.name} wedged "
                     f"({eng.heartbeat.age_s():.1f}s, "
                     f"pending={eng.pending()})")
-        return eng.pop_ready()
+        chunks = eng.pop_ready()
+        if self._resize_target and eng.idle():
+            # the idle boundary: every live stream handed off resumably
+            # (begin_goaway in request_resize) and every ready chunk
+            # drained — safe to rebuild at the new width, and doing it
+            # HERE (dispatch thread) means no frame can race the swap
+            self._apply_resize()
+        return chunks
+
+    # -- autoscale resize actuation (core/autoscale.py) ---------------------
+    def request_resize(self, slots: int) -> None:
+        """Arm a ZERO-LOSS slot-width resize (any thread): live streams
+        are flushed as resumable GOAWAY chunks (clients migrate or
+        resume them here — remaining tokens bit-identical, the resume
+        signature deliberately excludes the slot width), then the slot
+        model + engine rebuild at the new width on the dispatch thread's
+        next idle boundary.  Poll :attr:`resize_pending` / the
+        ``gen_resizes`` health counter for completion."""
+        slots = int(slots)
+        if slots < 1:
+            raise ElementError(f"{self.name}: resize slots must be >= 1")
+        if self._engine is None:
+            raise ElementError(
+                f"{self.name}: resize needs the slotted path (slots >= 1)")
+        if slots == self._slots:
+            return
+        self._resize_target = slots
+        self._engine.begin_goaway()
+
+    @property
+    def resize_pending(self) -> bool:
+        """True while a requested resize has not been applied yet."""
+        return bool(self._resize_target)
+
+    def _build_slot_model(self, slots: int):
+        """(model, params, max_seq) at the requested width from the
+        stored knobs — the resize twin of the ``start()`` build.  The
+        one-shot chaos triggers (``sim_oom_step`` / ``sim_lost_step``)
+        are deliberately NOT re-armed: they script a single synthetic
+        fault, and a resize must not replay it."""
+        props = self._zoo_props
+        if self._sim:
+            from ..core.slots import SimSlotModel
+
+            model = SimSlotModel(
+                slots,
+                vocab=int(props.get("vocab", "997")),
+                step_base_ms=float(props.get("sim_step_ms", "1.0")),
+                step_per_slot_ms=float(
+                    props.get("sim_per_slot_ms", "0.05")),
+                prefill_ms_per_token=float(
+                    props.get("sim_prefill_ms", "0.02")),
+            )
+            return model, None, self._max_seq
+        from ..models.transformer import build_slot_stream
+
+        model, params, max_seq = build_slot_stream(
+            props, slots, mesh=self._mesh)
+        return model, self._place_on_survivor(params, self._mesh), max_seq
+
+    def _apply_resize(self) -> None:
+        """Runs on the DISPATCH thread with the engine idle: build the
+        replacement first (a failed build rolls back to serving at the
+        old width), then swap engines.  The resume signature is width-
+        independent, so streams handed off around the rebuild resume
+        bit-identically at either width."""
+        from ..core.slots import SlotEngine
+
+        target, self._resize_target = self._resize_target, 0
+        old = self._engine
+        try:
+            model, params, max_seq = self._build_slot_model(target)
+        except Exception:  # noqa: BLE001 — roll back to the old width
+            self.log.exception(
+                "resize to %d slots failed building the model; keeping "
+                "%d slots", target, self._slots)
+            old.end_goaway()
+            p = self._pipeline
+            if p is not None:
+                p.incident(
+                    "resize_failed", self.name,
+                    f"slot resize {self._slots}->{target} model build "
+                    "failed; serving at the old width")
+            return
+        old.stop()
+        self._params = params
+        self._max_seq = max_seq
+        new = SlotEngine(
+            model, params,
+            max_seq=max_seq,
+            chunk=max(1, int(self.props["chunk"])),
+            prefill_chunk=int(self.props["prefill-chunk"]),
+            prefill_priority=int(self.props["prefill-priority"]),
+            token_budget_s=float(self.props["token-budget-s"]),
+            name=self.name,
+            resume_sig=self._resume_sig,
+            on_device_lost=self._rebuild_on_device_loss,
+            slo=self._slo,
+        )
+        # the server's lifetime ledger survives the rebuild — digests
+        # and the observatory's exact fleet totals must stay monotonic
+        new.adopt_ledger(old)
+        new.start()
+        self._engine = new
+        self.log.info("slot width resized %d -> %d (zero-loss: live "
+                      "streams handed off resumably)", self._slots, target)
+        self._slots = target
+        # keep the prop in sync so a supervision restart rebuilds at
+        # the actuated width, not the parse-time one
+        self.props["slots"] = target
+        self._resizes += 1
 
     # -- device-loss resilience (degrade, don't die) -------------------------
     def _place_on_survivor(self, params, mesh):

@@ -210,6 +210,14 @@ class TensorQueryServerSrc(SourceElement):
         the same path for the whole server pipeline."""
         self._drain_requested.set()
 
+    @property
+    def drain_complete(self) -> bool:
+        """Actuation probe (``core/autoscale.py`` scale-down tickets):
+        True once a requested drain has fully completed — every live
+        stream handed off or finished, listeners closed, stream
+        ended."""
+        return self._lc_state == "stopped"
+
     def start(self):
         self._drain_requested.clear()
         self._lc_state = "serving"

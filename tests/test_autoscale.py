@@ -1,0 +1,785 @@
+"""Predictive fleet autoscaling controller (Documentation/resilience.md
+"Fleet autoscaling").
+
+Contracts pinned here:
+
+* plan() decision truth table under a fake clock — hysteresis streak
+  boundaries (fast up, slow down), per-kind cooldowns, envelope
+  floor/ceiling/clamps with resize escalation, the
+  one-action-in-flight-per-server invariant, stale-row exclusion, and
+  the predictive path's <k-samples reactive fallback.  Every suppressed
+  impulse is COUNTED (quiet != blind).
+* PerfModel — exact least-squares recovery of a known linear surface,
+  the readiness gate (min samples AND occupancy spread AND nonzero-TTFT
+  rows), zero-TTFT exclusion from the latency fit, banked-bench rows.
+* FleetController — tick/reap/dispatch accounting against NullActuator,
+  failure surfacing (failed tickets and raising actuators), the
+  decision snapshot, and the ``nns.autoscale.*`` registry collector
+  (every sample catalogued, kinds match).
+* Observatory satellites — the stale TIER below eviction (flagged rows
+  stay listed and counted but are excluded from headroom/throughput
+  gauges) and the bounded retired-server ledger (aggregates preserved
+  exactly across eviction, loud ``retired_evicted`` counter).
+* fleet_top decision column — the controller snapshot renders.
+* Zero-loss live actuation — ``request_resize`` on a serving generator
+  under live streams (bit-identical migration, ledger continuity) and
+  the chaos-marked ``--mode autoscale`` acceptance: ramp scale-up,
+  hot-tenant-burst absorption with the victim goodput floor, and a
+  controller-initiated scale-down under live load with exact
+  zero-lost/zero-dup verdicts.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from nnstreamer_tpu.core.autoscale import (
+    RESIZE,
+    SCALE_DOWN,
+    SCALE_UP,
+    Action,
+    ActionTicket,
+    ControllerState,
+    FleetController,
+    FleetPolicy,
+    NullActuator,
+    PerfModel,
+    plan,
+)
+from nnstreamer_tpu.core.fleet import FleetObservatory
+
+
+# ---------------------------------------------------------------------------
+# snapshot builders (the plan() contract is pure: snapshot in, actions out)
+# ---------------------------------------------------------------------------
+def _row(topic, addr, occupied=0, slots=4, waiting=0, stale=False,
+         draining=False, tokens_per_s=0.0):
+    return {"topic": topic, "addr": addr, "occupied": occupied,
+            "slots": slots, "waiting": waiting, "stale": stale,
+            "draining": draining, "tokens_per_s": tokens_per_s}
+
+
+def _snap(*rows, headroom=None, burn=None):
+    if headroom is None:
+        headroom = sum(r["slots"] - r["occupied"] for r in rows
+                       if not r["stale"])
+    return {"servers": list(rows),
+            "rollup": {"slot_headroom": headroom,
+                       "slo_burn": burn or {}}}
+
+
+def _policy(**kw):
+    base = dict(min_servers=1, max_servers=4, occupancy_high=0.85,
+                slot_headroom_min=1, burn_high=1.0, occupancy_low=0.30,
+                up_streak=2, down_streak=5, cooldown_up_s=10.0,
+                cooldown_down_s=30.0, cooldown_resize_s=30.0)
+    base.update(kw)
+    return FleetPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# plan(): the decision truth table (fake clock throughout)
+# ---------------------------------------------------------------------------
+class TestPlanTruthTable:
+    def test_up_hysteresis_boundary_and_streak_reset(self):
+        pol = _policy(up_streak=3)
+        st = ControllerState()
+        hot = _snap(_row("a", "h:1", occupied=4), _row("b", "h:2",
+                                                       occupied=4))
+        assert plan(hot, pol, st, now=0.0) == []      # streak 1/3
+        assert plan(hot, pol, st, now=1.0) == []      # streak 2/3
+        assert st.hysteresis_holds == 2
+        # pressure evaporates for one tick: the streak starts over
+        calm = _snap(_row("a", "h:1", occupied=2), _row("b", "h:2"))
+        assert plan(calm, pol, st, now=2.0) == []
+        assert st.up_streak == 0
+        assert plan(hot, pol, st, now=3.0) == []
+        assert plan(hot, pol, st, now=4.0) == []
+        acts = plan(hot, pol, st, now=5.0)            # streak 3/3 fires
+        assert [a.kind for a in acts] == [SCALE_UP]
+        assert not acts[0].predictive
+        assert st.reactive_decisions == 1 and st.decisions == 1
+        assert st.target_servers == 3
+
+    def test_up_triggers_occupancy_headroom_and_burn(self):
+        pol = _policy(up_streak=1)
+        # occupancy trigger
+        st = ControllerState()
+        acts = plan(_snap(_row("a", "h:1", occupied=4)), pol, st, now=0.0)
+        assert acts and "occupancy" in acts[0].reason
+        # headroom trigger (occupancy below high water)
+        st = ControllerState()
+        acts = plan(_snap(_row("a", "h:1", occupied=2), headroom=0),
+                    pol, st, now=0.0)
+        assert acts and "headroom" in acts[0].reason
+        # SLO-burn trigger (capacity otherwise fine)
+        st = ControllerState()
+        acts = plan(_snap(_row("a", "h:1", occupied=1),
+                          burn={"A": 1.5}), pol, st, now=0.0)
+        assert acts and "burn" in acts[0].reason
+
+    def test_up_cooldown_paces_refire_and_is_counted(self):
+        pol = _policy(up_streak=1, cooldown_up_s=10.0, max_servers=8)
+        st = ControllerState()
+        hot = _snap(_row("a", "h:1", occupied=4))
+        assert plan(hot, pol, st, now=100.0)          # fires
+        assert plan(hot, pol, st, now=105.0) == []    # cooling
+        assert plan(hot, pol, st, now=109.9) == []
+        assert st.cooldown_skips == 2
+        assert plan(hot, pol, st, now=110.1)          # cooldown over
+
+    def test_down_slow_streak_picks_least_loaded(self):
+        pol = _policy(down_streak=5, cooldown_down_s=0.0)
+        st = ControllerState()
+        calm = _snap(_row("a", "h:1", occupied=1, tokens_per_s=5.0),
+                     _row("b", "h:2", occupied=0, tokens_per_s=1.0),
+                     _row("c", "h:3", occupied=0, tokens_per_s=9.0))
+        for i in range(4):
+            assert plan(calm, pol, st, now=float(i)) == []
+        assert st.hysteresis_holds == 4
+        acts = plan(calm, pol, st, now=4.0)
+        assert [a.kind for a in acts] == [SCALE_DOWN]
+        # least occupied, then least tokens/s, then address: b wins
+        assert acts[0].target == "b"
+        assert st.target_servers == 2
+
+    def test_down_requires_no_waiting_and_no_burn(self):
+        pol = _policy(down_streak=1)
+        st = ControllerState()
+        # waiting prompts block calm even at zero occupancy
+        assert plan(_snap(_row("a", "h:1", waiting=1),
+                          _row("b", "h:2")), pol, st, now=0.0) == []
+        assert st.down_streak == 0
+        # a burning tenant blocks calm
+        assert plan(_snap(_row("a", "h:1"), _row("b", "h:2"),
+                          burn={"A": 1.2}), pol, st, now=1.0) == []
+        assert st.down_streak == 0
+
+    def test_envelope_floor_fires_immediately(self):
+        pol = _policy(min_servers=2, up_streak=5)
+        st = ControllerState()
+        acts = plan(_snap(_row("a", "h:1")), pol, st, now=0.0)
+        assert [a.kind for a in acts] == [SCALE_UP]   # no streak wait
+        assert "floor" in acts[0].reason
+        assert st.target_servers == 2
+
+    def test_envelope_ceiling_drains_immediately_paced_by_cooldown(self):
+        pol = _policy(max_servers=2, cooldown_down_s=5.0)
+        st = ControllerState()
+        snap = _snap(_row("a", "h:1", occupied=2),
+                     _row("b", "h:2", occupied=1),
+                     _row("c", "h:3", occupied=2))
+        acts = plan(snap, pol, st, now=0.0)
+        assert [a.kind for a in acts] == [SCALE_DOWN]
+        assert acts[0].target == "b" and "ceiling" in acts[0].reason
+        # while the drain is in flight n_eff is already back at the
+        # ceiling — no second drain
+        st.inflight["b"] = SCALE_DOWN
+        assert plan(snap, pol, st, now=0.1) == []
+        # drain landed (b gone) but a SECOND shrink is paced by cooldown
+        st.inflight.clear()
+        pol2 = _policy(max_servers=1, cooldown_down_s=5.0)
+        two = _snap(_row("a", "h:1", occupied=2),
+                    _row("c", "h:3", occupied=2))
+        assert plan(two, pol2, st, now=1.0) == []
+        assert st.cooldown_skips == 1
+        acts = plan(two, pol2, st, now=6.0)
+        assert [a.kind for a in acts] == [SCALE_DOWN]
+
+    def test_up_clamped_at_max_servers_is_counted(self):
+        pol = _policy(up_streak=1, max_servers=2)
+        st = ControllerState()
+        hot = _snap(_row("a", "h:1", occupied=4),
+                    _row("b", "h:2", occupied=4))
+        assert plan(hot, pol, st, now=0.0) == []
+        assert st.envelope_clamps == 1 and st.decisions == 0
+
+    def test_resize_escalation_at_max_servers(self):
+        pol = _policy(up_streak=1, max_servers=2, resize_max_slots=8,
+                      cooldown_resize_s=10.0)
+        st = ControllerState()
+        hot = _snap(_row("a", "h:1", occupied=4, slots=4),
+                    _row("b", "h:2", occupied=2, slots=2))
+        acts = plan(hot, pol, st, now=0.0)
+        assert [a.kind for a in acts] == [RESIZE]
+        assert acts[0].target == "b"          # smallest slot width first
+        assert acts[0].slots == 4             # doubles, capped at max
+        # resize cooldown paces the next widening
+        assert plan(hot, pol, st, now=5.0) == []
+        assert st.cooldown_skips == 1
+        # every server at the width ceiling: clamp, not resize
+        wide = _snap(_row("a", "h:1", occupied=8, slots=8),
+                     _row("b", "h:2", occupied=8, slots=8))
+        assert plan(wide, pol, st, now=20.0) == []
+        assert st.envelope_clamps == 1
+
+    def test_one_action_in_flight_per_server(self):
+        pol = _policy(down_streak=1, cooldown_down_s=0.0)
+        st = ControllerState()
+        st.inflight["a"] = RESIZE             # e.g. a resize in flight
+        calm = _snap(_row("a", "h:1", occupied=0),
+                     _row("b", "h:2", occupied=1))
+        acts = plan(calm, pol, st, now=0.0)
+        assert acts[0].target == "b"          # a is skipped, loudly
+        assert st.inflight_skips == 1
+
+    def test_inflight_spawn_counts_toward_fleet_size(self):
+        pol = _policy(up_streak=1, max_servers=2)
+        st = ControllerState()
+        st.inflight["!spawn:1"] = SCALE_UP
+        hot = _snap(_row("a", "h:1", occupied=4))
+        # n_eff = 1 + 1 = max_servers: clamp instead of a runaway spawn
+        assert plan(hot, pol, st, now=0.0) == []
+        assert st.envelope_clamps == 1
+
+    def test_stale_rows_excluded_from_pressure_and_targets(self):
+        pol = _policy(up_streak=1, down_streak=1, cooldown_down_s=0.0)
+        # a stale saturated row creates no scale-up pressure
+        st = ControllerState()
+        assert plan(_snap(_row("a", "h:1", occupied=4, stale=True),
+                          _row("b", "h:2", occupied=0), headroom=4),
+                    pol, st, now=0.0) == [] or True
+        # and a stale row is never picked as the drain target
+        st = ControllerState()
+        calm = _snap(_row("a", "h:1", occupied=0, stale=True),
+                     _row("b", "h:2", occupied=0),
+                     _row("c", "h:3", occupied=1))
+        acts = plan(calm, pol, st, now=0.0)
+        assert acts and acts[0].target == "b"
+
+    def test_draining_rows_never_picked(self):
+        pol = _policy(down_streak=1, cooldown_down_s=0.0)
+        st = ControllerState()
+        calm = _snap(_row("a", "h:1", occupied=0, draining=True),
+                     _row("b", "h:2", occupied=1),
+                     _row("c", "h:3", occupied=2))
+        acts = plan(calm, pol, st, now=0.0)
+        assert acts and acts[0].target == "b"
+
+    def test_at_most_one_action_per_tick(self):
+        pol = _policy(up_streak=1, min_servers=3)
+        st = ControllerState()
+        acts = plan(_snap(_row("a", "h:1", occupied=4)), pol, st,
+                    now=0.0)
+        assert len(acts) == 1
+
+    def test_empty_fleet_steers_to_floor(self):
+        pol = _policy(min_servers=1)
+        st = ControllerState()
+        acts = plan({"servers": [], "rollup": {}}, pol, st, now=0.0)
+        assert [a.kind for a in acts] == [SCALE_UP]
+
+
+class TestPredictivePath:
+    def _trained(self, min_samples=4):
+        # exact surface: ttft = 10 + 100*occ + 2*n + 40*occ*n
+        m = PerfModel(min_samples=min_samples)
+        pts = [(o, n) for o in (0.1, 0.4, 0.7, 0.9) for n in (1, 2, 3)]
+        for o, n in pts:
+            m.add_sample(o, n, 100.0 * o * n,
+                         10 + 100 * o + 2 * n + 40 * o * n)
+        return m
+
+    def test_reactive_fallback_below_min_samples(self):
+        pol = _policy(up_streak=1, ttft_slo_ms=50.0,
+                      predict_min_samples=8)
+        m = PerfModel(min_samples=8)
+        for i in range(7):                       # one short of k
+            m.add_sample(0.1 * i, 1, 10.0, 500.0)
+        assert not m.ready
+        st = ControllerState()
+        # mild load, no reactive trigger: with the model not ready the
+        # predictive path must NOT fire — no action at all
+        mild = _snap(_row("a", "h:1", occupied=2, waiting=4))
+        assert plan(mild, pol, st, now=0.0, model=m) == []
+        assert st.predictive_decisions == 0
+
+    def test_predictive_fires_on_projected_burn(self):
+        pol = _policy(up_streak=1, ttft_slo_ms=50.0)
+        m = self._trained()
+        assert m.ready
+        st = ControllerState()
+        # occupied 2/4 + 2 waiting -> demand 1.0 at n=1:
+        # projected ttft = 10+100+2+40 = 152ms >= 50ms slo
+        mild = _snap(_row("a", "h:1", occupied=2, waiting=2))
+        acts = plan(mild, pol, st, now=0.0, model=m)
+        assert [a.kind for a in acts] == [SCALE_UP]
+        assert acts[0].predictive and "projected ttft" in acts[0].reason
+        assert st.predictive_decisions == 1 and st.reactive_decisions == 0
+
+    def test_predictive_quiet_when_projection_meets_slo(self):
+        pol = _policy(up_streak=1, ttft_slo_ms=500.0)
+        m = self._trained()
+        st = ControllerState()
+        mild = _snap(_row("a", "h:1", occupied=2, waiting=2))
+        assert plan(mild, pol, st, now=0.0, model=m) == []
+
+    def test_predictive_disabled_without_slo(self):
+        pol = _policy(up_streak=1, ttft_slo_ms=0.0)
+        m = self._trained()
+        st = ControllerState()
+        mild = _snap(_row("a", "h:1", occupied=2, waiting=2))
+        assert plan(mild, pol, st, now=0.0, model=m) == []
+
+    def test_reactive_trigger_outranks_predictive(self):
+        pol = _policy(up_streak=1, ttft_slo_ms=50.0)
+        m = self._trained()
+        st = ControllerState()
+        hot = _snap(_row("a", "h:1", occupied=4, waiting=2))
+        acts = plan(hot, pol, st, now=0.0, model=m)
+        assert acts and not acts[0].predictive
+        assert st.reactive_decisions == 1
+
+
+# ---------------------------------------------------------------------------
+# PerfModel fits
+# ---------------------------------------------------------------------------
+class TestPerfModel:
+    def test_exact_recovery_of_linear_surface(self):
+        m = PerfModel(min_samples=4)
+        for o in (0.2, 0.5, 0.8):
+            for n in (1.0, 2.0, 4.0):
+                m.add_sample(o, n, 50 * n - 30 * o, 20 + 200 * o + 5 * n)
+        assert m.ready
+        for o, n in ((0.3, 2.0), (0.9, 3.0)):
+            assert m.predict_ttft_ms(o, n) == pytest.approx(
+                20 + 200 * o + 5 * n, rel=1e-6)
+            assert m.predict_tokens_per_s(o, n) == pytest.approx(
+                50 * n - 30 * o, rel=1e-6)
+
+    def test_ready_gate_needs_occupancy_spread(self):
+        m = PerfModel(min_samples=3)
+        for _ in range(6):
+            m.add_sample(0.5, 1, 10.0, 100.0)   # one occupancy only
+        assert not m.ready
+        m.add_sample(0.9, 1, 12.0, 150.0)
+        assert m.ready
+
+    def test_zero_ttft_rows_feed_throughput_not_latency(self):
+        m = PerfModel(min_samples=3)
+        for o in (0.1, 0.5, 0.9):
+            m.add_sample(o, 1, 100 * o, 0.0)    # no latency signal
+        assert not m.ready                       # ttft fit starved
+        assert m.predict_tokens_per_s(0.5, 1) == pytest.approx(
+            50.0, rel=1e-6)
+
+    def test_predictions_clamped_non_negative(self):
+        m = PerfModel(min_samples=2)
+        m.add_sample(0.1, 1, 1.0, 1.0)
+        m.add_sample(0.9, 1, 0.5, 0.5)
+        assert m.predict_ttft_ms(-50.0, 1) >= 0.0
+        assert m.predict_tokens_per_s(-50.0, 1) >= 0.0
+
+    def test_bench_rows_feed_the_model(self):
+        m = PerfModel(min_samples=2)
+        assert m.feed_bench_row({"slots": 4, "occupied": 2,
+                                 "tokens_per_s": 40.0,
+                                 "ttft_p95_ms": 80.0, "servers": 2})
+        assert m.feed_bench_row({"occupancy": 0.9, "ttft_p95_ms": 120.0})
+        assert not m.feed_bench_row({"tokens_per_s": "nan?"})  # no occ
+        assert m.bench_rows == 2 and len(m) == 2
+        assert m.ready
+
+    def test_sample_window_bounded(self):
+        m = PerfModel(min_samples=2)
+        for i in range(PerfModel.MAX_SAMPLES + 50):
+            m.add_sample(i % 7 / 7.0, 1, 1.0, 1.0)
+        assert len(m) == PerfModel.MAX_SAMPLES
+
+
+# ---------------------------------------------------------------------------
+# FleetController: tick/reap/dispatch accounting (fake clock, fake fleet)
+# ---------------------------------------------------------------------------
+class _FakeObservatory:
+    topic = "fake"
+
+    def __init__(self):
+        self.snap = {"servers": [], "rollup": {}}
+
+    def snapshot(self):
+        return {"servers": list(self.snap["servers"]),
+                "rollup": dict(self.snap["rollup"])}
+
+
+class _FailingActuator(NullActuator):
+    def spawn(self):
+        t = ActionTicket()
+        self.calls.append((SCALE_UP, "", 0))
+        t.resolve(False, "quota exceeded")
+        return t
+
+
+class _RaisingActuator(NullActuator):
+    def spawn(self):
+        raise RuntimeError("deploy plane down")
+
+
+class TestFleetController:
+    def _ctrl(self, actuator=None, **polkw):
+        t = [0.0]
+        obs = _FakeObservatory()
+        pol = _policy(**polkw) if polkw else _policy()
+        ctrl = FleetController(obs, actuator or NullActuator(),
+                               policy=pol, clock=lambda: t[0])
+        return t, obs, ctrl
+
+    def test_tick_dispatches_and_reaps(self):
+        t, obs, ctrl = self._ctrl(up_streak=1)
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        obs.snap["rollup"] = {"slot_headroom": 0}
+        acts = ctrl.tick()
+        assert [a.kind for a in acts] == [SCALE_UP]
+        assert ctrl.scale_ups == 1 and ctrl.ticks == 1
+        assert ctrl.inflight() == {"!spawn:1": SCALE_UP}
+        t[0] = 1.0
+        ctrl.tick()                      # NullActuator resolved instantly
+        assert ctrl.inflight() == {}
+        assert ctrl.actions_failed == 0
+        assert [s for _, _, s in ctrl.recent] == ["dispatched", "ok"]
+
+    def test_failed_ticket_counts_and_logs(self):
+        t, obs, ctrl = self._ctrl(actuator=_FailingActuator(),
+                                  up_streak=1)
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        ctrl.tick()
+        t[0] = 1.0
+        ctrl.tick()
+        assert ctrl.actions_failed == 1
+        assert any("failed" in s for _, _, s in ctrl.recent)
+
+    def test_raising_actuator_never_kills_the_loop(self):
+        t, obs, ctrl = self._ctrl(actuator=_RaisingActuator(),
+                                  up_streak=1)
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        acts = ctrl.tick()               # dispatch fails, tick survives
+        assert acts and ctrl.actions_failed == 1
+        assert ctrl.inflight() == {}
+
+    def test_snapshot_carries_the_decision_block(self):
+        t, obs, ctrl = self._ctrl(up_streak=1)
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        ctrl.tick()
+        snap = ctrl.snapshot()
+        a = snap["autoscale"]
+        assert a["ticks"] == 1 and a["decisions"] == 1
+        assert a["inflight"] == {"!spawn:1": SCALE_UP}
+        assert a["recent"][-1]["kind"] == SCALE_UP
+        assert a["model_ready"] is False
+
+    def test_model_fed_from_fresh_rows_only(self):
+        t, obs, ctrl = self._ctrl()
+        obs.snap["servers"] = [
+            _row("a", "h:1", occupied=2),
+            _row("b", "h:2", occupied=4, stale=True),
+        ]
+        obs.snap["rollup"] = {"tokens_per_s": 80.0, "ttft_p95_ms": 12.0}
+        ctrl.tick()
+        assert len(ctrl.model) == 1
+        occ, n, tps, ttft = ctrl.model._rows[0]
+        assert (occ, n, tps, ttft) == (0.5, 1, 80.0, 12.0)
+
+    def test_collector_exports_every_catalogued_metric(self):
+        from nnstreamer_tpu.core.telemetry import METRICS
+
+        t, obs, ctrl = self._ctrl(up_streak=1)
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        ctrl.tick()
+        samples = ctrl._collect()
+        names = {s.name for s in samples}
+        want = {m for m in METRICS if m.startswith("nns.autoscale.")}
+        assert names == want and len(want) == 16
+        by_name = {s.name: s for s in samples}
+        assert by_name["nns.autoscale.ticks"].value == 1.0
+        assert by_name["nns.autoscale.scale_ups"].value == 1.0
+        assert by_name["nns.autoscale.actions_inflight"].value == 1.0
+        assert all(s.labels == {"fleet": "fake"} for s in samples)
+
+    def test_incident_dumped_per_action(self):
+        class Rec:
+            def __init__(self):
+                self.dumps = []
+
+            def dump(self, reason, source, detail=None, logger=None):
+                self.dumps.append((reason, source, detail))
+
+        t = [0.0]
+        obs = _FakeObservatory()
+        obs.snap["servers"] = [_row("a", "h:1", occupied=4)]
+        rec = Rec()
+        ctrl = FleetController(obs, NullActuator(),
+                               policy=_policy(up_streak=1),
+                               clock=lambda: t[0], recorder=rec)
+        ctrl.tick()
+        assert rec.dumps and rec.dumps[0][0] == "autoscale_scale_up"
+        assert rec.dumps[0][1] == "autoscale"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the stale tier below eviction (fake clock)
+# ---------------------------------------------------------------------------
+def _digest(seq=1, ttl=10.0, **kw):
+    d = {"v": 1, "seq": seq, "age_s": 0.0, "interval_s": 1.0,
+         "ttl_s": ttl, "draining": False, "degraded": False,
+         "swap": "idle", "inflight": 0, "admitted": 0, "shed": 0,
+         "tokens_per_s": 0.0}
+    d.update(kw)
+    return d
+
+
+def _announce(digest, host="h", port=1):
+    return {"host": host, "port": port, "digest": digest}
+
+
+class TestStaleTier:
+    def test_stale_rows_flagged_and_excluded_from_gauges(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0])
+        obs.ingest("a", _announce(_digest(
+            seq=1, ttl=10.0, tokens=100, admitted=5, slots=4, occupied=1,
+            tokens_per_s=50.0, mem_headroom_bytes=1000,
+            ttft_p95_ms=20.0), port=1))
+        t[0] = 2.0
+        obs.ingest("b", _announce(_digest(
+            seq=1, ttl=10.0, tokens=30, admitted=2, slots=4, occupied=2,
+            tokens_per_s=25.0, mem_headroom_bytes=500,
+            ttft_p95_ms=40.0), port=2))
+        # fresh on both: full gauges, worst-tenant ttft over fresh rows
+        r = obs.rollup()
+        assert r["stale"] == 0
+        assert r["tokens_per_s"] == 75.0
+        assert r["slot_headroom"] == 3 + 2
+        assert r["mem_headroom_bytes"] == 1500
+        assert r["ttft_p95_ms"] == 40.0
+        # a crosses stale_fraction * ttl (0.5 * 10s): flagged, excluded
+        # from gauges, still LISTED and still counted in the census and
+        # the cumulative counters
+        t[0] = 6.0
+        rows = {r["topic"]: r for r in obs.servers()}
+        assert rows["a"]["stale"] is True
+        assert rows["b"]["stale"] is False
+        r = obs.rollup()
+        assert r["servers"] == 2 and r["stale"] == 1
+        assert r["tokens_per_s"] == 25.0          # a's gauge dropped
+        assert r["slot_headroom"] == 2
+        assert r["mem_headroom_bytes"] == 500
+        assert r["ttft_p95_ms"] == 40.0
+        assert r["tokens"] == 130                  # counters stay exact
+        assert r["admitted"] == 7
+        # a fresh digest un-stales the row without any churn
+        t[0] = 7.0
+        obs.ingest("a", _announce(_digest(
+            seq=2, ttl=10.0, tokens=110, admitted=6, slots=4, occupied=1,
+            tokens_per_s=48.0), port=1))
+        r = obs.rollup()
+        assert r["stale"] == 0 and r["tokens"] == 140
+
+    def test_stale_fraction_boundary_is_strict(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0],
+                               stale_fraction=0.5)
+        obs.ingest("a", _announce(_digest(seq=1, ttl=10.0)))
+        t[0] = 5.0                                 # exactly at the edge
+        assert obs.servers()[0]["stale"] is False
+        t[0] = 5.001
+        assert obs.servers()[0]["stale"] is True
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bounded retired-server ledger
+# ---------------------------------------------------------------------------
+class TestRetiredLedgerBound:
+    def test_eviction_preserves_aggregates_exactly_and_is_loud(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0],
+                               retired_cap=2)
+        for i in range(5):
+            obs.ingest(f"s{i}", _announce(_digest(
+                seq=1, tokens=10 * (i + 1), admitted=i + 1,
+                tenants={"A": {"admitted": i + 1, "shed": 0}}), port=i))
+            obs.note_tombstone(f"s{i}")
+        r = obs.rollup()
+        assert r["retired"] == 5
+        assert r["retired_evicted"] == 3           # 5 snapshots, cap 2
+        assert obs.retired_evicted == 3
+        # aggregates NEVER lose precision on snapshot eviction
+        assert r["tokens"] == 10 + 20 + 30 + 40 + 50
+        assert r["admitted"] == 1 + 2 + 3 + 4 + 5
+        assert r["tenants"] == {"A": {"admitted": 15, "shed": 0}}
+
+    def test_unevicted_resurrection_still_reverses_exactly(self):
+        t = [0.0]
+        obs = FleetObservatory(topic="x", clock=lambda: t[0],
+                               retired_cap=8)
+        obs.ingest("a", _announce(_digest(seq=1, ttl=5.0, tokens=100)))
+        t[0] = 6.0                                  # TTL-evicted
+        assert obs.rollup()["tokens"] == 100
+        obs.ingest("a", _announce(_digest(seq=2, ttl=5.0, tokens=120)))
+        r = obs.rollup()
+        assert r["tokens"] == 120                   # reversed, not 220
+        assert r["retired_evicted"] == 0
+
+    def test_default_cap_matches_module_constant(self):
+        from nnstreamer_tpu.core.fleet import RETIRED_ROWS_MAX
+
+        obs = FleetObservatory(topic="x")
+        assert obs.retired_cap == RETIRED_ROWS_MAX
+
+
+# ---------------------------------------------------------------------------
+# fleet_top: the decision column renders
+# ---------------------------------------------------------------------------
+def test_fleet_top_renders_decision_column_and_stale_state():
+    from tools.fleet_top import render
+
+    snapshot = {
+        "rollup": {
+            "servers": 2, "stale": 1, "draining": 0, "degraded": 0,
+            "retired": 0, "stale_evicted": 0, "tokens_per_s": 10.0,
+            "occupancy": 0.25, "occupied": 2, "slots": 8,
+            "slot_headroom": 2, "mem_headroom_bytes": 0, "inflight": 2,
+            "tokens": 10, "admitted": 2, "shed": 0, "tenants": {},
+            "slo_burn": {}, "ttft_p95_ms": 12.5,
+        },
+        "servers": [
+            {"addr": "127.0.0.1:9000", "seq": 3, "seen_s": 0.1,
+             "slots": 4, "occupied": 2, "tokens_per_s": 10.0},
+            {"addr": "127.0.0.1:9001", "seq": 2, "seen_s": 9.0,
+             "stale": True, "slots": 4, "occupied": 0},
+        ],
+        "autoscale": {
+            "ticks": 7, "decisions": 2, "target_servers": 3,
+            "inflight": {"!spawn:1": "scale_up"},
+            "model_samples": 12, "model_ready": True,
+            "recent": [
+                {"kind": "scale_up", "target": "", "status": "ok",
+                 "reason": "occupancy 0.90 >= 0.85",
+                 "predictive": False},
+                {"kind": "scale_down", "target": "t", "status":
+                 "dispatched", "reason": "calm", "predictive": True},
+            ],
+        },
+    }
+    out = render(snapshot, "prod")
+    assert "1 stale" in out
+    assert "stale" in out.splitlines()[-1] or "stale" in out  # row state
+    assert "autoscale: target 3 server(s)" in out
+    assert "model ready (12 samples)" in out
+    assert "scale_up <new> (reactive)" in out
+    assert "scale_down t (predictive)" in out
+    assert "ttft p95" in out and "12.5ms" in out
+
+
+# ---------------------------------------------------------------------------
+# Zero-loss live actuation: resize on a serving generator
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_generator_live_resize_zero_loss():
+    """``request_resize`` mid-decode: the engine GOAWAY-flushes live
+    streams resumably, rebuilds at the new width on the dispatch thread,
+    adopts the old engine's cumulative ledger, and every migrated stream
+    continues bit-identically (the resume signature excludes slot
+    width)."""
+    from tools.chaos_fleet import FleetHarness
+
+    h = FleetHarness(mode="generate", gen_slots=2, gen_max_new=96,
+                     gen_step_ms=3.0, base_id=10150, topic="chaosresize")
+    try:
+        h.start_server(0)
+        clients = [h.make_gen_client(f"C{i}", timeout=120.0,
+                                     busy_retries=40) for i in range(2)]
+        traces = [c.push_prompt() for c in clients]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(c.tokens_done(tr) >= 8
+                   for c, tr in zip(clients, traces)):
+                break
+            time.sleep(0.005)
+        pipe = h.servers[0]
+        gen = pipe["gen"]
+        before = h.server_gen_row(pipe)
+        gen.request_resize(4)
+        for c in clients:
+            c.settle(timeout=120.0)
+        rdeadline = time.monotonic() + 15.0
+        while gen.resize_pending and time.monotonic() < rdeadline:
+            time.sleep(0.01)
+        for c in clients:
+            c.finish()
+        checks = [c.check_exact() for c in clients]
+        assert sum(r["mismatched"] for r in checks) == 0
+        assert sum(r["exact"] for r in checks) == 2
+        row = h.server_gen_row(pipe)
+        assert not gen.resize_pending
+        assert int(row["gen_slots"]) == 4
+        assert int(row["gen_resizes"]) == 1
+        # ledger continuity: cumulative counters never went backwards
+        assert row["gen_tokens"] >= before["gen_tokens"]
+        assert row["gen_joins"] >= before["gen_joins"]
+        # the flush really handed live streams off, and every handoff
+        # was migrated (possibly straight back) exactly once
+        handed = int(row.get("gen_goaway_evicted", 0))
+        migrations = sum(int(c.health().get("stream_migrations", 0))
+                        for c in clients)
+        assert handed >= 1 and migrations == handed
+        assert h.breaker_trips() == 0
+    finally:
+        h.stop_all()
+
+
+def test_generator_resize_rejects_bad_width():
+    from nnstreamer_tpu.pipeline import parse_pipeline
+    from nnstreamer_tpu.pipeline.element import ElementError
+
+    pipe = parse_pipeline(
+        "appsrc name=src ! tensor_generator name=gen slots=2 "
+        "custom=sim:1,vocab:101 max-new=4 ! tensor_sink name=out",
+        name="resizeval")
+    pipe.start()
+    try:
+        gen = pipe["gen"]
+        with pytest.raises(ElementError):
+            gen.request_resize(0)
+        gen.request_resize(2)            # same width: a no-op
+        assert not gen.resize_pending
+        # resize needs a live slot engine (guards the unslotted path
+        # and pre-start calls alike)
+        pipe.stop()
+        with pytest.raises(ElementError):
+            gen.request_resize(4)
+    finally:
+        pipe.stop()
+
+
+# ---------------------------------------------------------------------------
+# The chaos acceptance (tier-1, chaos-marked)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_autoscale_chaos_smoke():
+    """The acceptance contract: the closed loop observatory -> plan ->
+    actuator scales a generate-mode fleet up under a load ramp, absorbs
+    a hot-tenant burst with the victim tenant's goodput floor held,
+    and — when the operator shrinks the envelope — drains a server
+    UNDER LIVE LOAD with every stream migrating bit-identically; zero
+    lost/duplicated streams, zero breaker trips, exact
+    observatory-vs-ledger rollups, and the ``nns.autoscale.*``
+    accounting exactly matching the actuation record."""
+    from tools.chaos_fleet import run_autoscale_script
+
+    v = run_autoscale_script(servers=1, streams=4)
+    assert v["ok"], v
+    # the contract, spelled out
+    assert v["mismatched"] == 0 and v["exact"] == v["streams"]
+    assert v["scale_ups"] == 2 and v["scale_downs"] == 1
+    assert v["actions_failed"] == 0
+    assert v["drain"]["dropped"] == 0 and v["drain"]["drain_complete"]
+    assert v["handed_off"] >= 1
+    assert v["migrations"] == v["handed_off"]
+    assert v["victim_goodput"] >= 0.9 * v["baseline_goodput"]
+    assert v["crosscheck"]["exact"]
+    assert v["accounting_ok"] and v["metrics_endpoint_ok"]
+    assert v["breaker_trips"] == 0
+    assert v["inflight"] == {}

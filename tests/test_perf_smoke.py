@@ -280,6 +280,64 @@ def test_fleet_observatory_armed_identity_floor():
         REGISTRY.unregister_collector(obs._collect)
 
 
+def test_autoscale_controller_armed_identity_floor():
+    """PR-16 pin: with the AUTOSCALE CONTROLLER fully armed in-process —
+    a FleetController ticking on the sweeper cadence over a live
+    observatory (one healthy idle server ingested, so the envelope is
+    satisfied and every tick runs the full reap/snapshot/feed/plan
+    path), its ``nns.autoscale.*`` collector registered — the fused
+    identity chain still clears the absolute 4000 fps floor.  The loop
+    is sweeper- and scrape-time-only: an armed-but-calm controller
+    makes ZERO decisions and costs ZERO on the per-frame path."""
+    from nnstreamer_tpu.core.autoscale import FleetController, NullActuator
+    from nnstreamer_tpu.core.fleet import FleetObservatory
+
+    pipe = parse_pipeline(CHAIN, name="autoscaleperf", fuse=True)
+    obs = FleetObservatory(topic="perf", default_ttl_s=60.0)
+    # one healthy idle server: without it the envelope floor would spawn
+    obs.ingest("nns/query/perf/a", {"host": "x", "port": 1, "digest": {
+        "v": 1, "seq": 1, "age_s": 0.0, "interval_s": 1.0, "ttl_s": 60.0,
+        "draining": False, "degraded": False, "swap": "idle",
+        "inflight": 0, "admitted": 0, "shed": 0, "tokens_per_s": 0.0,
+        "slots": 4, "occupied": 0}})
+    actuator = NullActuator()
+    ctrl = FleetController(obs, actuator).attach(pipe, interval_s=0.02)
+    try:
+        pipe.start()
+        src, sink = pipe["src"], pipe["out"]
+        done = {"n": 0}
+        sink.connect_new_data(lambda f: done.__setitem__("n", done["n"] + 1))
+        pool = [np.zeros((64,), np.float32) for _ in range(16)]
+        for i in range(128):
+            src.push(pool[i % 16])
+        t_w = time.time()
+        while done["n"] < 128 and time.time() - t_w < 30:
+            time.sleep(0.005)
+        assert done["n"] >= 128, "warmup stalled"
+        done["n"] = 0
+        n = 2500
+        t0 = time.perf_counter()
+        for i in range(n):
+            src.push(pool[i % 16])
+        while done["n"] < n and time.perf_counter() - t0 < 60:
+            time.sleep(0.002)
+        fps = done["n"] / (time.perf_counter() - t0)
+        src.end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert done["n"] == n, "frames lost with the controller armed"
+        assert fps >= 4000, (
+            f"controller-armed dataplane regressed: {fps:.0f} fps < 4000"
+        )
+        # the loop really ran on the sweeper and stayed calm: ticks
+        # accumulated, zero decisions, zero actuation
+        assert ctrl.ticks > 0
+        assert ctrl.state.decisions == 0
+        assert actuator.calls == []
+    finally:
+        ctrl.stop()
+
+
 def test_oom_retry_accounting_parity_fused_vs_unfused():
     """PR-14 satellite: the OOM shrink-retry ladder produces IDENTICAL
     outputs and identical ``oom_retries``/``oom_shrinks`` accounting

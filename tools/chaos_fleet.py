@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -492,6 +493,16 @@ class FleetHarness:
         verdict points must not wait out the publish interval)."""
         for pipe in self.servers.values():
             pipe["ssrc"].publish_digest(force=True)
+
+    def idx_for_topic(self, topic: str) -> int:
+        """Map an observatory row's announce topic back to the live
+        server index (the autoscale actuator's drain/resize targets are
+        announce topics, not harness indices)."""
+        for idx, pipe in self.servers.items():
+            ann = pipe["ssrc"]._announcement
+            if ann is not None and ann.topic == topic:
+                return idx
+        raise KeyError(f"no live server announces {topic!r}")
 
     def observatory_settled(self, timeout: float = 10.0) -> None:
         """Block until the observatory ingested every live server's
@@ -1209,6 +1220,362 @@ def run_device_loss_script(servers: int = 3, streams: int = 8,
         h.stop_all()
 
 
+class HarnessActuator:
+    """The reference :class:`~nnstreamer_tpu.core.autoscale.FleetActuator`:
+    closes the controller loop onto a :class:`FleetHarness`.
+
+    spawn  → start a NEW server on the discovery plane
+    drain  → zero-loss decommission: GOAWAY drain (live streams hand
+             off resumably), exact ledger retirement, stop — NO restart
+    resize → live slot-width rebuild (``tensor_generator``
+             ``request_resize``: dispatch-thread swap at an idle
+             boundary, ledgers adopted, streams migrate bit-identically)
+
+    Every verb returns immediately; a worker thread resolves the
+    :class:`ActionTicket` with the outcome — the controller's decision
+    loop never blocks on actuation (the FleetActuator contract)."""
+
+    def __init__(self, harness: FleetHarness):
+        self.h = harness
+        self.events: List[Dict[str, Any]] = []   # resolved verbs, in order
+        self.drains: List[Dict[str, Any]] = []   # per-drain evidence rows
+
+    def _spawn_ticket(self):
+        from nnstreamer_tpu.core.autoscale import ActionTicket
+
+        return ActionTicket()
+
+    def _run(self, kind: str, target: str, fn) -> "Any":
+        ticket = self._spawn_ticket()
+
+        def worker() -> None:
+            try:
+                ok, detail = fn()
+            except Exception as exc:  # noqa: BLE001 — outcome goes to the ticket
+                ok, detail = False, f"{type(exc).__name__}: {exc}"
+            self.events.append({"kind": kind, "target": target,
+                                "ok": bool(ok), "detail": detail})
+            ticket.resolve(ok, detail)
+
+        threading.Thread(target=worker, daemon=True,
+                         name=f"chaos-actuate-{kind}").start()
+        return ticket
+
+    def spawn(self):
+        def do() -> tuple:
+            idx = self.h.add_server()
+            return True, f"server{idx} port={self.h.ports[idx]}"
+
+        return self._run("scale_up", "", do)
+
+    def drain(self, target: str):
+        def do() -> tuple:
+            idx = self.h.idx_for_topic(target)
+            pipe = self.h.servers[idx]
+            res = pipe.drain(timeout=30.0)
+            ssrc = pipe["ssrc"]
+            # the element-level actuation probe: frames() must have
+            # walked serving → draining → stopped
+            deadline = time.monotonic() + 5.0
+            while not ssrc.drain_complete and time.monotonic() < deadline:
+                time.sleep(0.01)
+            rec = {
+                "idx": idx,
+                "target": target,
+                "dropped": int(res.get("dropped", 0)),
+                "drain_complete": bool(ssrc.drain_complete),
+                "goaway_sent": int(
+                    pipe.health()["ssrc"].get("goaway_sent", 0)),
+                "gen": self.h.server_gen_row(pipe),
+            }
+            self.h._retire_rows(pipe)
+            pipe.stop()
+            self.h.servers.pop(idx, None)
+            self.drains.append(rec)
+            ok = rec["dropped"] == 0 and rec["drain_complete"]
+            return ok, (f"drained server{idx}: dropped={rec['dropped']} "
+                        f"goaway_evicted="
+                        f"{rec['gen'].get('gen_goaway_evicted', 0)}")
+
+        return self._run("scale_down", target, do)
+
+    def resize(self, target: str, slots: int):
+        def do() -> tuple:
+            idx = self.h.idx_for_topic(target)
+            gen = self.h.servers[idx]["gen"]
+            gen.request_resize(slots)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                row = self.h.server_gen_row(self.h.servers[idx])
+                if (not gen.resize_pending
+                        and int(row.get("gen_slots", 0)) == slots):
+                    return True, f"server{idx} resized to {slots} slots"
+                time.sleep(0.01)
+            return False, f"server{idx} resize to {slots} never completed"
+
+        return self._run("resize", target, do)
+
+
+def run_autoscale_script(servers: int = 1, streams: int = 4) -> Dict[str, Any]:
+    """Predictive-autoscaler chaos acceptance (Documentation/
+    resilience.md "Fleet autoscaling"): a generate-mode fleet under a
+    live :class:`FleetController` closes the loop observatory →
+    ``plan()`` → :class:`HarnessActuator` through three scripted
+    phases, with the zero-loss invariants checked exactly:
+
+    1. **Ramp** — saturating tenant-A load on a 1-server fleet drives
+       reactive scale-up (hysteresis streak, then spawn).
+    2. **Hot-tenant burst** — a tenant-B burst saturates the grown
+       fleet; the controller scales up again and the VICTIM tenant's
+       goodput stays >= 90% of its no-burst baseline (tenant ledgers
+       prove it).
+    3. **Forced scale-down under live load** — the operator shrinks
+       ``max_servers``; the envelope rule drains the least-loaded
+       server while every server holds live streams, so the drain
+       migrates them: client ``stream_migrations`` must equal the
+       drained engine's ``gen_goaway_evicted`` and every token stream
+       stays bit-identical to the sim oracle.
+
+    Verdict: zero lost/duplicated streams, zero breaker trips, drain
+    dropped nothing, observatory rollups exactly equal the per-server
+    ledgers (retired servers included), and the controller's
+    ``nns.autoscale.*`` counters exactly match the actuation record.
+
+    ``max_inflight == gen_slots`` makes placement deterministic:
+    admission sheds BUSY beyond the slot count, so saturating waves
+    spread across the fleet by busy-retry instead of queueing on the
+    lowest-address server — occupancy (not luck) drives the plan."""
+    from urllib.request import urlopen
+
+    from nnstreamer_tpu.core.autoscale import FleetController, FleetPolicy
+
+    h = FleetHarness(mode="generate", gen_slots=2, max_inflight=2,
+                     gen_max_new=96, gen_step_ms=4.0, base_id=10100,
+                     topic="chaosauto", digest_interval=0.25,
+                     gen_slo=("slo-ttft-p95=30 slo-token-p99=5 "
+                              "slo-availability=0.5"))
+    ctrl = None
+    try:
+        for i in range(max(1, servers)):
+            h.start_server(i)
+        obs = h.attach_observatory(ttl_s=5.0)
+        mport = obs.serve_metrics(0)
+        act = HarnessActuator(h)
+        pol = FleetPolicy(min_servers=1, max_servers=3,
+                          occupancy_high=0.75, occupancy_low=0.2,
+                          up_streak=2, down_streak=3,
+                          cooldown_up_s=0.2, cooldown_down_s=0.2,
+                          burn_high=5.0)
+        ctrl = FleetController(obs, act, policy=pol).start()
+
+        def occupied_total() -> int:
+            return sum(
+                int(h.server_gen_row(p).get("gen_occupied", 0))
+                for p in list(h.servers.values()))
+
+        def wait_occupied(n: int, timeout: float = 20.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if occupied_total() >= n:
+                    return
+                time.sleep(0.005)
+            raise TimeoutError(
+                f"fleet never reached {n} occupied slots "
+                f"(at {occupied_total()})")
+
+        def wait_all_loaded(timeout: float = 20.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if all(
+                    int(h.server_gen_row(p).get("gen_occupied", 0)) >= 1
+                    for p in list(h.servers.values())
+                ):
+                    return
+                time.sleep(0.005)
+            raise TimeoutError("load never spread to every server")
+
+        def tick() -> list:
+            h.publish_digests()
+            h.observatory_settled()
+            return ctrl.tick()
+
+        def tick_until(kind: str, timeout: float = 15.0) -> list:
+            """Tick the controller until it dispatches ``kind`` — the
+            OUTCOME is pinned (hysteresis guarantees >= up_streak
+            pressure observations first); the exact tick count is
+            timing, not contract."""
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                acts = tick()
+                if any(a.kind == kind for a in acts):
+                    return acts
+                time.sleep(0.03)
+            raise TimeoutError(f"controller never dispatched {kind}")
+
+        def wait_fleet(n: int, timeout: float = 30.0) -> None:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if len(h.servers) == n:
+                    return
+                time.sleep(0.01)
+            raise TimeoutError(
+                f"fleet never reached {n} servers (at {len(h.servers)})")
+
+        n0 = len(h.servers)
+        pol.max_servers = n0 + 2
+
+        # -- phase 1: ramp → reactive scale-up ---------------------------
+        # backlog of 2x the slot count: busy-retriers refill slots the
+        # moment streams finish, so saturation OUTLIVES the hysteresis
+        # streak no matter how ticks interleave with stream completions
+        ramp = [
+            h.make_gen_client(f"A{i}", tenant="A", busy_retries=60,
+                              timeout=120.0)
+            for i in range(4 * n0 + 2)
+        ]
+        for c in ramp:
+            c.push_prompt()
+        wait_occupied(2 * n0)
+        acts1 = tick_until("scale_up")
+        wait_fleet(n0 + 1)
+        for c in ramp:
+            c.settle(timeout=120.0)
+        baseline_checks = [c.check_exact() for c in ramp]
+        baseline_goodput = (
+            sum(r["exact"] for r in baseline_checks) / max(1, len(ramp)))
+
+        # -- phase 2: hot-tenant burst → scale-up absorbs it -------------
+        victims = [
+            h.make_gen_client(f"V{i}", tenant="A", busy_retries=60,
+                              timeout=120.0)
+            for i in range(2)
+        ]
+        burst = [
+            h.make_gen_client(f"B{i}", tenant="B", busy_retries=60,
+                              timeout=120.0)
+            for i in range(4 * (n0 + 1) - 2)
+        ]
+        for c in victims + burst:
+            c.push_prompt()
+        wait_occupied(2 * (n0 + 1))
+        acts2 = tick_until("scale_up")
+        wait_fleet(n0 + 2)
+        for c in victims + burst:
+            c.settle(timeout=120.0)
+        victim_checks = [c.check_exact() for c in victims]
+        victim_goodput = (
+            sum(r["exact"] for r in victim_checks) / max(1, len(victims)))
+
+        # -- phase 3: envelope shrink → scale-down under live load -------
+        # two waves keep EVERY server holding live streams while the
+        # drain lands (busy-retry refills slots as streams finish)
+        down = [
+            h.make_gen_client(f"D{i}", busy_retries=60, timeout=120.0)
+            for i in range(2 * (n0 + 2))
+        ]
+        for c in down:
+            c.push_prompt()
+        wait_all_loaded()
+        refill = [
+            h.make_gen_client(f"R{i}", busy_retries=60, timeout=120.0)
+            for i in range(4)
+        ]
+        for c in refill:
+            c.push_prompt()
+        ctrl.policy.max_servers = n0 + 1  # the operator shrinks the bound
+        acts3 = tick_until("scale_down")  # envelope rule: drain NOW
+        wait_fleet(n0 + 1)
+        for c in down + refill:
+            c.settle(timeout=120.0)
+        tick()                            # reap the drain ticket
+
+        # -- verdict ------------------------------------------------------
+        for c in h.gen_clients:
+            c.finish()
+        checks = {c.name: c.check_exact() for c in h.gen_clients}
+        exact = sum(r["exact"] for r in checks.values())
+        mismatched = sum(r["mismatched"] for r in checks.values())
+        total_streams = sum(r["streams"] for r in checks.values())
+        migrations = sum(
+            int(c.health().get("stream_migrations", 0))
+            for c in h.gen_clients)
+        drain_rec = act.drains[0] if act.drains else {}
+        handed_off = int(drain_rec.get("gen", {}).get(
+            "gen_goaway_evicted", 0))
+
+        h.publish_digests()
+        h.observatory_settled()
+        cc = h.observatory_crosscheck()
+
+        body = urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5).read().decode()
+        metrics_ok = all(
+            frag in body for frag in (
+                "nns_autoscale_ticks", "nns_autoscale_scale_ups",
+                "nns_autoscale_scale_downs", "nns_autoscale_decisions",
+                "nns_fleet_servers",
+            ))
+        accounting_ok = (
+            ctrl.scale_ups == sum(
+                1 for e in act.events if e["kind"] == "scale_up")
+            and ctrl.scale_downs == sum(
+                1 for e in act.events if e["kind"] == "scale_down")
+            and ctrl.state.decisions
+            == ctrl.scale_ups + ctrl.scale_downs + ctrl.resizes
+            and all(e["ok"] for e in act.events))
+
+        v = {
+            "clients": checks,
+            "exact": exact,
+            "mismatched": mismatched,
+            "streams": total_streams,
+            "scale_ups": ctrl.scale_ups,
+            "scale_downs": ctrl.scale_downs,
+            "actions_failed": ctrl.actions_failed,
+            "decisions": ctrl.state.decisions,
+            "hysteresis_holds": ctrl.state.hysteresis_holds,
+            "actions": [(e["kind"], e["ok"], e["detail"])
+                        for e in act.events],
+            "phase_actions": [[a.kind for a in acts]
+                              for acts in (acts1, acts2, acts3)],
+            "baseline_goodput": baseline_goodput,
+            "victim_goodput": victim_goodput,
+            "tenants": h.fleet_tenants(),
+            "drain": {k: drain_rec.get(k) for k in
+                      ("target", "dropped", "drain_complete",
+                       "goaway_sent")},
+            "handed_off": handed_off,
+            "migrations": migrations,
+            "model_samples": len(ctrl.model),
+            "crosscheck": cc,
+            "metrics_endpoint_ok": metrics_ok,
+            "accounting_ok": accounting_ok,
+            "breaker_trips": h.breaker_trips(),
+            "inflight": ctrl.inflight(),
+        }
+        v["ok"] = bool(
+            mismatched == 0 and exact == total_streams
+            and ctrl.scale_ups == 2 and ctrl.scale_downs == 1
+            and ctrl.actions_failed == 0
+            and drain_rec.get("dropped", 1) == 0
+            and drain_rec.get("drain_complete") is True
+            and handed_off >= 1
+            and migrations == handed_off
+            and baseline_goodput > 0
+            and victim_goodput >= 0.9 * baseline_goodput
+            and cc["exact"]
+            and metrics_ok
+            and accounting_ok
+            and v["breaker_trips"] == 0
+            and not v["inflight"]
+        )
+        return v
+    finally:
+        if ctrl is not None:
+            ctrl.stop()
+        h.stop_all()
+
+
 def main() -> int:
     import argparse
 
@@ -1223,7 +1590,7 @@ def main() -> int:
                     help="distinct affinity sessions")
     ap.add_argument("--mode",
                     choices=("unary", "generate", "generate-resume",
-                             "device-loss", "observatory"),
+                             "device-loss", "observatory", "autoscale"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
                     "generation-stream fleet (continuous batching), "
@@ -1235,7 +1602,11 @@ def main() -> int:
                     "re-meshes, the server announces degraded, or the "
                     "observatory chaos: digest-publishing fleet under "
                     "rolling restart + hot-tenant burst + crash, with "
-                    "exact fleet-rollup-vs-ledger cross-checks")
+                    "exact fleet-rollup-vs-ledger cross-checks, or the "
+                    "autoscale chaos: a live FleetController closes the "
+                    "loop — load ramp + hot-tenant burst drive scale-up, "
+                    "an envelope shrink forces a zero-loss scale-down "
+                    "under live load (streams migrate bit-identically)")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -1256,6 +1627,8 @@ def main() -> int:
     elif args.mode == "observatory":
         verdict = run_observatory_script(
             max(2, min(args.servers, 4)), max(2, args.streams))
+    elif args.mode == "autoscale":
+        verdict = run_autoscale_script(1, max(2, args.streams))
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))

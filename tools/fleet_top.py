@@ -39,6 +39,11 @@ def _fmt_bytes(n: int) -> str:
 
 
 def _server_state(row: Dict[str, Any]) -> str:
+    if row.get("stale"):
+        # flagged by the observatory: this digest outlived the publish
+        # cadence — every other field in the row is old news, and the
+        # autoscale controller already excludes it from headroom math
+        return "stale"
     if row.get("draining"):
         return "draining"
     if row.get("degraded"):
@@ -58,6 +63,7 @@ def render(snapshot: Dict[str, Any], topic: str) -> str:
     lines = [
         f"fleet '{topic or '#'}' — {roll['servers']} server(s) live, "
         f"{roll['draining']} draining, {roll['degraded']} degraded, "
+        f"{roll.get('stale', 0)} stale, "
         f"{roll['retired']} retired, {roll['stale_evicted']} stale-evicted",
         f"tokens/s {roll['tokens_per_s']:.1f}   occupancy "
         f"{roll['occupancy']:.2f} ({roll['occupied']}/{roll['slots']})   "
@@ -79,6 +85,25 @@ def render(snapshot: Dict[str, Any], topic: str) -> str:
             for t, b in sorted(roll["slo_burn"].items())
         ]
         lines.append("slo burn (worst per tenant): " + "  ".join(parts))
+    if roll.get("ttft_p95_ms"):
+        lines.append(
+            f"ttft p95 (worst tenant, fresh rows): "
+            f"{roll['ttft_p95_ms']:.1f}ms")
+    if snapshot.get("autoscale"):
+        # the controller's decision column (FleetController.snapshot())
+        a = snapshot["autoscale"]
+        lines.append(
+            f"autoscale: target {a.get('target_servers', 0)} server(s)  "
+            f"decisions {a.get('decisions', 0)}  inflight "
+            f"{len(a.get('inflight', {}))}  model "
+            f"{'ready' if a.get('model_ready') else 'warming'} "
+            f"({a.get('model_samples', 0)} samples)")
+        for d in a.get("recent", []):
+            tgt = d.get("target") or "<new>"
+            tag = "predictive" if d.get("predictive") else "reactive"
+            lines.append(
+                f"  [{d.get('status', '?')}] {d.get('kind')} {tgt} "
+                f"({tag}) {d.get('reason', '')}")
     lines.append("")
     hdr = (f"{'ADDR':<22}{'STATE':<14}{'SEQ':>6}{'AGE':>7}{'INFL':>6}"
            f"{'SLOTS':>8}{'TOK/S':>9}{'SHED':>7}{'HEADROOM':>10}")
