@@ -50,6 +50,8 @@ complete a zero-loss drain).
 
 from __future__ import annotations
 
+import json
+import math
 import threading
 import time
 from collections import deque
@@ -65,6 +67,254 @@ log = get_logger("autoscale")
 SCALE_UP = "scale_up"
 SCALE_DOWN = "scale_down"
 RESIZE = "resize"
+
+#: control-plane view levels (the fail-static ladder, worst first)
+PLANE_OK = "ok"
+PLANE_DEGRADED = "degraded"
+PLANE_BLIND = "blind"
+_PLANE_RANK = {PLANE_OK: 0, PLANE_DEGRADED: 1, PLANE_BLIND: 2}
+
+
+# ---------------------------------------------------------------------------
+# Fencing (controller duplication safety)
+# ---------------------------------------------------------------------------
+class StaleEpochError(RuntimeError):
+    """Typed reject: a fenced control command carried a lease epoch older
+    than one this target already accepted — the sender is a deposed
+    controller (partitioned old leader, duplicated deployment).  The
+    command is REFUSED before it can touch any stream or ledger."""
+
+    def __init__(self, offered: int, current: int):
+        super().__init__(
+            f"stale lease epoch {offered} < fence {current}: command "
+            "refused (issuer no longer holds the leader lease)")
+        self.offered = int(offered)
+        self.current = int(current)
+
+
+class FencingToken:
+    """A target's side of lease fencing: remember the highest lease
+    epoch ever accepted and refuse anything older.  ``epoch=None`` is
+    the local/operator bypass (a human on the box outranks the lease
+    machinery); every refusal is counted exactly."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.rejects = 0
+
+    def check(self, epoch: Optional[int]) -> None:
+        """Admit ``epoch`` (advancing the fence) or raise
+        :class:`StaleEpochError`.  Same-epoch commands are admitted:
+        the lease guarantees one holder per epoch."""
+        if epoch is None:
+            return
+        epoch = int(epoch)
+        with self._lock:
+            if epoch < self.epoch:
+                self.rejects += 1
+                raise StaleEpochError(epoch, self.epoch)
+            self.epoch = epoch
+
+
+# ---------------------------------------------------------------------------
+# Leader lease (at most one actuating controller, by construction)
+# ---------------------------------------------------------------------------
+class LeaderLease:
+    """Epoch-numbered, TTL'd leader lease over one retained document.
+
+    Pure local logic under explicit clock values (the fake-clock truth
+    table in ``tests/test_autoscale.py`` pins every transition); the
+    transport is a pluggable ``publish(payload) -> bool`` callable
+    (:class:`LeaseChannel` binds it to the retained MQTT topic).
+
+    Rules:
+
+    * **acquire** — only when the lease topic is provably vacant: the
+      last seen lease has outlived its TTL, or nothing was seen for a
+      full TTL of watching (retained redelivery must get its chance).
+      The new epoch is ``max(every epoch ever seen) + 1`` — strictly
+      monotonic across takeovers.
+    * **renew** — the holder re-publishes every ``ttl/3``; a renewal is
+      confirmed by a successful publish or by observing its own
+      retained echo.
+    * **self-fence** — a holder whose renewals go unconfirmed for a
+      full TTL steps down on its own: a partitioned old leader stops
+      actuating BEFORE the standby's takeover epoch can land
+      (fail-static, not split-brain).
+    * **split lease** — a same-epoch foreign lease (amnesiac broker,
+      dueling brokers) resolves deterministically: the lower owner id
+      wins everywhere; a fresh foreign lease always refuses an acquire.
+    """
+
+    def __init__(self, owner: str, ttl_s: float = 5.0,
+                 publish: Optional[Callable[[dict], bool]] = None):
+        self.owner = str(owner)
+        self.ttl_s = float(ttl_s)
+        self.publish = publish
+        self.held = False
+        self.epoch = 0
+        self._max_epoch = 0
+        self._seen: Optional[Dict[str, Any]] = None
+        self._seen_ts = 0.0
+        self._watch_start: Optional[float] = None
+        self._confirmed_ts: Optional[float] = None
+        self._renew_due_ts = 0.0
+        self._lock = threading.RLock()
+        # exact transition ledger (exported as nns.autoscale.lease_*)
+        self.acquires = 0
+        self.renewals = 0
+        self.steals = 0
+        self.losses = 0
+        self.refusals = 0
+        self.self_fences = 0
+
+    def payload(self) -> dict:
+        return {"owner": self.owner, "epoch": self.epoch,
+                "ttl_s": self.ttl_s}
+
+    def _try_publish(self) -> bool:
+        if self.publish is None:
+            return True
+        try:
+            return bool(self.publish(self.payload()))
+        except OSError:
+            return False
+
+    def observe(self, payload: dict, now: float) -> None:
+        """Inbound retained lease doc (subscription callback, or the
+        truth table injecting a peer's view)."""
+        try:
+            owner = str(payload["owner"])
+            epoch = int(payload["epoch"])
+            ttl = float(payload.get("ttl_s", self.ttl_s))
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            self._max_epoch = max(self._max_epoch, epoch)
+            if owner == self.owner:
+                if self.held and epoch == self.epoch:
+                    self._confirmed_ts = now  # our own retained echo
+                return
+            self._seen = {"owner": owner, "epoch": epoch, "ttl_s": ttl}
+            self._seen_ts = now
+            if not self.held:
+                return
+            if epoch > self.epoch:
+                # a higher-epoch leader exists: we were deposed while
+                # partitioned — step down instantly
+                self.held = False
+                self.losses += 1
+            elif epoch == self.epoch and owner < self.owner:
+                # split lease: deterministic winner is the lower owner
+                # id, on BOTH sides — exactly one controller survives
+                self.held = False
+                self.losses += 1
+
+    def note_connected(self, now: float) -> None:
+        """Transport (re)connected: restart the vacancy watch so a
+        standby waits out retained redelivery before declaring the
+        topic empty, and re-assert a held lease into an amnesiac
+        broker."""
+        with self._lock:
+            self._watch_start = now
+            if self.held and self._try_publish():
+                self._confirmed_ts = now
+
+    def release(self) -> None:
+        """Voluntary stepdown (tests/operator): not counted as a loss."""
+        with self._lock:
+            self.held = False
+
+    def attempt(self, now: float) -> bool:
+        """One lease step per controller tick: renew when held, acquire
+        when provably vacant, self-fence when unconfirmed past a full
+        TTL.  Returns whether the lease is held after the step."""
+        with self._lock:
+            if self._watch_start is None:
+                self._watch_start = now
+            if self.held:
+                if now >= self._renew_due_ts and self._try_publish():
+                    self.renewals += 1
+                    self._confirmed_ts = now
+                    self._renew_due_ts = now + self.ttl_s / 3.0
+                if (self._confirmed_ts is not None
+                        and now - self._confirmed_ts > self.ttl_s):
+                    self.held = False
+                    self.self_fences += 1
+                    self.losses += 1
+                return self.held
+            # -- standby: is the topic provably vacant? -------------------
+            foreign = False
+            if self._seen is not None:
+                if now - self._seen_ts <= float(self._seen["ttl_s"]):
+                    self.refusals += 1
+                    return False
+                foreign = self._seen["owner"] != self.owner
+            elif now - self._watch_start < self.ttl_s:
+                return False
+            prev = self.epoch
+            self.epoch = max(self._max_epoch, self.epoch) + 1
+            if not self._try_publish():
+                self.epoch = prev  # transport refused; stay standby
+                return False
+            self._max_epoch = max(self._max_epoch, self.epoch)
+            self.held = True
+            self.acquires += 1
+            if foreign:
+                self.steals += 1
+            self._confirmed_ts = now
+            self._renew_due_ts = now + self.ttl_s / 3.0
+            return True
+
+
+class LeaseChannel:
+    """MQTT binding for :class:`LeaderLease`: one retained lease doc on
+    ``nns/ctl/<fleet>/lease`` — deliberately OUTSIDE the ``nns/query/#``
+    announce prefix, so discovery subscribers never try to parse it.
+    Subscribing to the same topic the lease publishes on gives every
+    controller (holder and standby) the same retained view, and the
+    reconnect hook re-arms the vacancy watch + re-asserts a held lease
+    after broker amnesia."""
+
+    def __init__(self, host: str, port: int, fleet_topic: str,
+                 lease: LeaderLease,
+                 brokers: Optional[List[Tuple[str, int]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..distributed.mqtt import MqttClient
+
+        self.topic = f"nns/ctl/{fleet_topic or 'all'}/lease"
+        self.lease = lease
+        self._clock = clock
+        self._client = MqttClient(host, port, brokers=brokers)
+        lease.publish = self._publish
+        self._client.subscribe(self.topic, self._on_msg, qos=1)
+        self._client.on_connect(
+            lambda: lease.note_connected(self._clock()))
+
+    @property
+    def connected(self) -> bool:
+        return self._client.connected.is_set()
+
+    def _publish(self, payload: dict) -> bool:
+        if not self._client.connected.is_set():
+            return False
+        self._client.publish(
+            self.topic, json.dumps(payload).encode(), retain=True, qos=1)
+        return True
+
+    def _on_msg(self, topic: str, payload: bytes) -> None:
+        if not payload:
+            return
+        try:
+            doc = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            log.warning("undecodable lease doc on %s", topic)
+            return
+        self.lease.observe(doc, self._clock())
+
+    def close(self) -> None:
+        self._client.close()
 
 
 @dataclass
@@ -99,6 +349,12 @@ class FleetPolicy:
     #: and the TTFT objective it projects against (0 = never predict)
     predict_min_samples: int = 8
     ttft_slo_ms: float = 0.0
+    #: fail-static ladder thresholds (:func:`assess_plane`): the view is
+    #: DEGRADED once more than this fraction of present rows is stale,
+    #: or fresh coverage falls below this fraction of the last-known
+    #: fleet (BLIND = no fresh rows at all)
+    plane_stale_fraction_max: float = 0.5
+    plane_quorum_fraction: float = 0.5
 
 
 @dataclass
@@ -139,11 +395,97 @@ class ControllerState:
     inflight_skips: int = 0
     predictive_decisions: int = 0
     reactive_decisions: int = 0
+    # -- fail-static ladder (assess_plane + plan(plane=...)) --------------
+    #: actions the ladder froze instead of dispatching, total and by
+    #: assessed reason (backs the reason-labeled ``nns.autoscale.frozen``)
+    frozen: int = 0
+    frozen_by_reason: Dict[str, int] = field(default_factory=dict)
+    #: fleet size of the last TRUSTED view (grown on any fresh sighting,
+    #: shrunk only by observed tombstone retirements) — the quorum
+    #: baseline that makes "half the fleet went invisible" detectable
+    known_fleet: int = 0
+    #: rollup retirement counter baseline (-1 = not yet baselined)
+    seen_retired: int = -1
 
 
 def _fresh_rows(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
     return [r for r in snapshot.get("servers", ())
             if not r.get("stale")]
+
+
+@dataclass(frozen=True)
+class PlaneStatus:
+    """One assessed control-plane view level with its exact reasons —
+    what :func:`plan` gates on and what the freeze counter labels."""
+
+    level: str = PLANE_OK
+    reasons: Tuple[str, ...] = ()
+
+    @property
+    def ok(self) -> bool:
+        return self.level == PLANE_OK
+
+
+def assess_plane(snapshot: Dict[str, Any], policy: FleetPolicy,
+                 state: ControllerState,
+                 connected: bool = True) -> PlaneStatus:
+    """Grade the observatory view for the fail-static ladder.
+
+    DEGRADED (freeze destructive actions — drain/resize/ceiling) when
+    the broker is disconnected, more than ``plane_stale_fraction_max``
+    of present rows is stale, or fresh coverage fell below
+    ``plane_quorum_fraction`` of the last-known fleet without observed
+    tombstones explaining the departures.  BLIND (freeze everything)
+    when not a single fresh row remains — a cold or fully blinded
+    controller is no controller.
+
+    ``state.known_fleet`` is the quorum baseline: it grows on any fresh
+    sighting and shrinks only by tombstone retirements counted in the
+    rollup — so an intentional drain never reads as coverage loss, but
+    a partition that silently ages half the fleet into eviction does."""
+    rows = list(snapshot.get("servers") or ())
+    fresh = [r for r in rows if not r.get("stale")]
+    roll = snapshot.get("rollup") or {}
+    retired = int(roll.get("retired", 0) or 0)
+    if state.seen_retired < 0:
+        state.seen_retired = retired  # first sight: baseline only
+    elif retired > state.seen_retired:
+        state.known_fleet = max(
+            0, state.known_fleet - (retired - state.seen_retired))
+        state.seen_retired = retired
+    elif retired < state.seen_retired:
+        # resurrection reversal: a retired server re-announced and the
+        # rollup un-counted it — re-baseline DOWN too, or the next real
+        # retirement would be swallowed by the stale baseline
+        state.seen_retired = retired
+    state.known_fleet = max(state.known_fleet, len(fresh))
+
+    reasons: List[str] = []
+    if not connected:
+        reasons.append("broker_disconnected")
+    if rows:
+        stale_fraction = 1.0 - len(fresh) / len(rows)
+        if stale_fraction > policy.plane_stale_fraction_max:
+            reasons.append("stale_fraction")
+    if state.known_fleet > 0:
+        quorum = max(1, math.ceil(
+            state.known_fleet * policy.plane_quorum_fraction))
+        if len(fresh) < quorum:
+            reasons.append("below_quorum")
+    if not fresh:
+        return PlaneStatus(PLANE_BLIND, tuple(reasons) + ("no_fresh_rows",))
+    if reasons:
+        return PlaneStatus(PLANE_DEGRADED, tuple(reasons))
+    return PlaneStatus(PLANE_OK)
+
+
+def _freeze(state: ControllerState, plane: PlaneStatus) -> List[Action]:
+    """Count one impulse the fail-static ladder froze (per assessed
+    reason, so the labeled counter tells outage causes apart)."""
+    state.frozen += 1
+    for r in plane.reasons or (plane.level,):
+        state.frozen_by_reason[r] = state.frozen_by_reason.get(r, 0) + 1
+    return []
 
 
 def _drain_target(fresh: List[Dict[str, Any]],
@@ -190,7 +532,8 @@ def _emit(state: ControllerState, now: float, action: Action
 
 def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
          state: Optional[ControllerState] = None, now: float = 0.0,
-         model: Optional["PerfModel"] = None) -> List[Action]:
+         model: Optional["PerfModel"] = None,
+         plane: Optional[PlaneStatus] = None) -> List[Action]:
     """ONE decision step: pure in its inputs (snapshot + policy +
     explicit state and clock), deterministic, side-effect-free beyond
     the explicit ``state``.  Returns the actions to dispatch this tick
@@ -201,9 +544,23 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
     (reactive observed signals first, then the predictive projection)
     → scale-down pressure.  Hysteresis streaks gate both directions,
     cooldowns gate re-fire, the envelope clamps the result, and no
-    target with an action already in flight is ever picked again."""
+    target with an action already in flight is ever picked again.
+
+    ``plane`` (from :func:`assess_plane`) arms the fail-static ladder:
+    a DEGRADED view freezes the destructive kinds (drain, resize, the
+    ceiling drain), a BLIND view freezes everything — a telemetry
+    outage must never amplify into a fleet outage.  ``plane=None``
+    (the pure truth table, operators driving plan() by hand) means a
+    trusted view.  Frozen impulses are counted, never silently lost;
+    hysteresis streaks keep accumulating under a freeze so a healed
+    plane acts on the first trusted tick."""
     if state is None:
         state = ControllerState()
+    frozen: Tuple[str, ...] = ()
+    if plane is not None and plane.level == PLANE_BLIND:
+        frozen = (SCALE_UP, SCALE_DOWN, RESIZE)
+    elif plane is not None and plane.level == PLANE_DEGRADED:
+        frozen = (SCALE_DOWN, RESIZE)
     roll = snapshot.get("rollup") or {}
     fresh = _fresh_rows(snapshot)
     n = len(fresh)
@@ -227,6 +584,11 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
 
     # -- envelope floor: below min is an outage, act immediately --------
     if n_eff < policy.min_servers:
+        if SCALE_UP in frozen:
+            # a blind controller seeing "zero servers" must NOT spawn:
+            # the fleet may be fine and merely invisible (cold start,
+            # broker death) — cold/blind controller == no controller
+            return _freeze(state, plane)
         if _cool(state, policy, SCALE_UP, now):
             state.cooldown_skips += 1
             return []
@@ -239,6 +601,8 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
     # zero-loss drains (no hysteresis: the envelope is a hard edict;
     # the cooldown still paces it to one drain per window) ---------------
     if n_eff > policy.max_servers:
+        if SCALE_DOWN in frozen:
+            return _freeze(state, plane)
         if _cool(state, policy, SCALE_DOWN, now):
             state.cooldown_skips += 1
             return []
@@ -291,6 +655,8 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
                     < policy.resize_max_slots
                 ]
                 if cands:
+                    if RESIZE in frozen:
+                        return _freeze(state, plane)
                     if _cool(state, policy, RESIZE, now):
                         state.cooldown_skips += 1
                         return []
@@ -309,6 +675,8 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
                         f"{tgt.get('addr')} {cur}->{new}"))
             state.envelope_clamps += 1
             return []
+        if SCALE_UP in frozen:
+            return _freeze(state, plane)
         if _cool(state, policy, SCALE_UP, now):
             state.cooldown_skips += 1
             return []
@@ -331,6 +699,8 @@ def plan(snapshot: Dict[str, Any], policy: FleetPolicy,
     if n_eff <= policy.min_servers:
         state.envelope_clamps += 1
         return []
+    if SCALE_DOWN in frozen:
+        return _freeze(state, plane)
     if _cool(state, policy, SCALE_DOWN, now):
         state.cooldown_skips += 1
         return []
@@ -482,17 +852,25 @@ class FleetActuator:
     harness's in-process implementation (``tools/chaos_fleet.py``
     ``HarnessActuator``) is the reference; a real plane maps them to
     its scheduler.  Every verb returns an :class:`ActionTicket` and
-    must NEVER block the calling thread."""
+    must NEVER block the calling thread.
 
-    def spawn(self) -> ActionTicket:
+    ``epoch`` is the issuing controller's lease epoch (fencing): the
+    actuator forwards it to the target's fenced entry points
+    (``request_drain(epoch=...)``/``request_resize(..., epoch=...)``),
+    which refuse stale epochs with :class:`StaleEpochError`.  ``0``
+    (the no-lease default) is below every real epoch, so an unleased
+    controller can never out-fence a leased one."""
+
+    def spawn(self, epoch: int = 0) -> ActionTicket:
         raise NotImplementedError
 
-    def drain(self, target: str) -> ActionTicket:
+    def drain(self, target: str, epoch: int = 0) -> ActionTicket:
         """Zero-loss decommission of the server announcing under
         ``target``: request_drain → GOAWAY handoffs → stop."""
         raise NotImplementedError
 
-    def resize(self, target: str, slots: int) -> ActionTicket:
+    def resize(self, target: str, slots: int,
+               epoch: int = 0) -> ActionTicket:
         raise NotImplementedError
 
 
@@ -502,22 +880,25 @@ class NullActuator(FleetActuator):
 
     def __init__(self) -> None:
         self.calls: List[Tuple[str, str, int]] = []
+        self.epochs: List[int] = []
 
-    def _ticket(self, kind: str, target: str = "",
-                slots: int = 0) -> ActionTicket:
+    def _ticket(self, kind: str, target: str = "", slots: int = 0,
+                epoch: int = 0) -> ActionTicket:
         self.calls.append((kind, target, slots))
+        self.epochs.append(int(epoch))
         t = ActionTicket()
         t.resolve(True)
         return t
 
-    def spawn(self) -> ActionTicket:
-        return self._ticket(SCALE_UP)
+    def spawn(self, epoch: int = 0) -> ActionTicket:
+        return self._ticket(SCALE_UP, epoch=epoch)
 
-    def drain(self, target: str) -> ActionTicket:
-        return self._ticket(SCALE_DOWN, target)
+    def drain(self, target: str, epoch: int = 0) -> ActionTicket:
+        return self._ticket(SCALE_DOWN, target, epoch=epoch)
 
-    def resize(self, target: str, slots: int) -> ActionTicket:
-        return self._ticket(RESIZE, target, slots)
+    def resize(self, target: str, slots: int,
+               epoch: int = 0) -> ActionTicket:
+        return self._ticket(RESIZE, target, slots, epoch=epoch)
 
 
 # ---------------------------------------------------------------------------
@@ -536,7 +917,8 @@ class FleetController:
     def __init__(self, observatory, actuator: FleetActuator,
                  policy: Optional[FleetPolicy] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 recorder=None, model: Optional[PerfModel] = None):
+                 recorder=None, model: Optional[PerfModel] = None,
+                 lease: Optional[LeaderLease] = None):
         self.observatory = observatory
         self.actuator = actuator
         self.policy = policy or FleetPolicy()
@@ -544,6 +926,10 @@ class FleetController:
         self.state = ControllerState()
         self.model = model or PerfModel(
             min_samples=self.policy.predict_min_samples)
+        #: leader lease (None = single-controller deployment): a
+        #: controller without the lease is a pure standby — it reaps
+        #: its old tickets but neither plans nor actuates
+        self.lease = lease
         self._recorder = recorder
         self._pipe = None
         self._lock = threading.Lock()
@@ -556,6 +942,10 @@ class FleetController:
         self.scale_downs = 0
         self.resizes = 0
         self.actions_failed = 0
+        self.standby_ticks = 0
+        #: last assessed plane status (freeze-entry incidents fire on
+        #: transitions to a WORSE level, once per episode)
+        self.plane = PlaneStatus()
         self._collector_registered = False
 
     # -- wiring -----------------------------------------------------------
@@ -587,19 +977,55 @@ class FleetController:
 
     # -- the loop ---------------------------------------------------------
     def tick(self) -> List[Action]:
-        """One decision step: reap tickets, snapshot, feed the model,
-        plan, dispatch.  Returns the actions dispatched this tick."""
+        """One decision step: reap tickets, renew/acquire the lease,
+        assess the plane, snapshot, feed the model, plan, dispatch.
+        Returns the actions dispatched this tick.  Without the lease
+        the tick is a standby heartbeat (reap only); with a degraded
+        or blind plane the planner runs but the fail-static ladder
+        freezes (and counts) what it would have done."""
         now = self.clock()
         with self._lock:
             self.ticks += 1
             self._reap_locked(now)
+            if self.lease is not None and not self.lease.attempt(now):
+                # standby: no plan, no actuation — at most one
+                # actuating controller by construction
+                self.standby_ticks += 1
+                return []
             snap = self.observatory.snapshot()
+            connected = bool(
+                getattr(self.observatory, "plane_connected", True))
+            plane = assess_plane(snap, self.policy, self.state,
+                                 connected=connected)
+            self._note_plane_locked(plane, now)
             self._feed_model(snap)
             actions = plan(snap, self.policy, self.state, now,
-                           model=self.model)
+                           model=self.model, plane=plane)
             for a in actions:
                 self._dispatch_locked(a, now)
             return actions
+
+    def _note_plane_locked(self, plane: PlaneStatus, now: float) -> None:
+        """Freeze-entry incident: fire once per degradation episode
+        (every transition to a WORSE level), not per frozen impulse —
+        the flight recorder's ring then holds the fleet context that
+        led INTO the outage, and heals are logged, not dumped."""
+        prev = self.plane
+        self.plane = plane
+        if _PLANE_RANK[plane.level] > _PLANE_RANK[prev.level]:
+            detail = (f"plane {prev.level} -> {plane.level}: "
+                      f"{','.join(plane.reasons) or 'unknown'}; "
+                      "fail-static freeze armed")
+            log.warning("autoscale %s", detail)
+            if self._recorder is not None:
+                self._recorder.dump("autoscale_freeze", "autoscale",
+                                    detail=detail, logger=log)
+            elif self._pipe is not None:
+                self._pipe.incident("autoscale_freeze", "autoscale",
+                                    detail)
+        elif _PLANE_RANK[plane.level] < _PLANE_RANK[prev.level]:
+            log.info("autoscale plane healed: %s -> %s", prev.level,
+                     plane.level)
 
     def _feed_model(self, snap: Dict[str, Any]) -> None:
         roll = snap.get("rollup") or {}
@@ -614,18 +1040,22 @@ class FleetController:
             float(roll.get("ttft_p95_ms", 0.0) or 0.0))
 
     def _dispatch_locked(self, a: Action, now: float) -> None:
+        # fencing: every actuation carries the issuing lease epoch, so
+        # a target that already saw a newer leader refuses this one
+        epoch = self.lease.epoch if self.lease is not None else 0
         try:
             if a.kind == SCALE_UP:
-                ticket = self.actuator.spawn()
+                ticket = self.actuator.spawn(epoch=epoch)
                 self._spawn_seq += 1
                 key = f"!spawn:{self._spawn_seq}"
                 self.scale_ups += 1
             elif a.kind == SCALE_DOWN:
-                ticket = self.actuator.drain(a.target)
+                ticket = self.actuator.drain(a.target, epoch=epoch)
                 key = a.target
                 self.scale_downs += 1
             else:
-                ticket = self.actuator.resize(a.target, a.slots)
+                ticket = self.actuator.resize(a.target, a.slots,
+                                              epoch=epoch)
                 key = a.target
                 self.resizes += 1
         except Exception as e:  # noqa: BLE001 — actuator bug must not kill the loop
@@ -684,6 +1114,18 @@ class FleetController:
                 "inflight": dict(self.state.inflight),
                 "model_samples": len(self.model),
                 "model_ready": self.model.ready,
+                # control-plane column (fleet_top): plane level + why,
+                # leader identity, frozen-impulse count
+                "plane_level": self.plane.level,
+                "plane_reasons": list(self.plane.reasons),
+                "plane_connected": bool(
+                    getattr(self.observatory, "plane_connected", True)),
+                "frozen": self.state.frozen,
+                "standby_ticks": self.standby_ticks,
+                "lease": (
+                    {"owner": self.lease.owner, "held": self.lease.held,
+                     "epoch": self.lease.epoch}
+                    if self.lease is not None else None),
                 "recent": [
                     {"kind": a.kind, "target": a.target,
                      "reason": a.reason, "status": status,
@@ -696,6 +1138,7 @@ class FleetController:
     # -- registry export (ONE collector; scrape-time only) ----------------
     def _collect(self) -> List[Sample]:
         s = self.state
+        lease = self.lease
         vals: Tuple[Tuple[str, float, str], ...] = (
             ("nns.autoscale.ticks", self.ticks, "counter"),
             ("nns.autoscale.decisions", s.decisions, "counter"),
@@ -720,10 +1163,34 @@ class FleetController:
             ("nns.autoscale.model_ready",
              1 if self.model.ready else 0, "gauge"),
             ("nns.autoscale.target_servers", s.target_servers, "gauge"),
+            # fail-static ladder + leader lease (PR-17)
+            ("nns.autoscale.frozen", s.frozen, "counter"),
+            ("nns.autoscale.plane_level",
+             _PLANE_RANK[self.plane.level], "gauge"),
+            ("nns.autoscale.standby_ticks", self.standby_ticks,
+             "counter"),
+            ("nns.autoscale.lease_held",
+             1 if (lease is not None and lease.held) else 0, "gauge"),
+            ("nns.autoscale.lease_epoch",
+             lease.epoch if lease is not None else 0, "gauge"),
+            ("nns.autoscale.lease_acquires",
+             lease.acquires if lease is not None else 0, "counter"),
+            ("nns.autoscale.lease_steals",
+             lease.steals if lease is not None else 0, "counter"),
+            ("nns.autoscale.lease_losses",
+             lease.losses if lease is not None else 0, "counter"),
+            ("nns.autoscale.lease_refusals",
+             lease.refusals if lease is not None else 0, "counter"),
         )
         base = {"fleet": getattr(self.observatory, "topic", "") or "all"}
         out: List[Sample] = []
         for mname, v, kind in vals:
             assert mname in METRICS and metric_kind(mname) == kind, mname
             out.append(Sample(mname, dict(base), float(v), kind))
+        # reason-labeled freeze breakdown (same catalogued name; the
+        # unlabeled total above is the sum across reasons)
+        for reason, count in sorted(s.frozen_by_reason.items()):
+            out.append(Sample(
+                "nns.autoscale.frozen", dict(base, reason=reason),
+                float(count), "counter"))
         return out

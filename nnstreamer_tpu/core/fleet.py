@@ -377,14 +377,47 @@ class FleetObservatory:
         self.resurrected = 0     # retired rows that came back alive
         self.digests = 0         # digests ingested, lifetime
         self.servers_seen = 0    # distinct announce instances ever seen
+        # control-plane health: WHEN telemetry last arrived and WHETHER
+        # the broker link is up — rows aging into the stale tier is a
+        # symptom; this is the cause, surfaced explicitly
+        self._plane_born_ts = self.clock()
+        self._last_ingest_ts: Optional[float] = None
+
+    # -- control-plane health ------------------------------------------------
+    @property
+    def plane_connected(self) -> bool:
+        """True while the broker connection is up.  Direct-feed mode
+        (tests/bench calling :meth:`ingest` with no broker) reads
+        connected: there is no link to lose."""
+        client = self._client
+        return client is None or client.connected.is_set()
+
+    @property
+    def plane_reconnects(self) -> int:
+        client = self._client
+        return getattr(client, "reconnects", 0) if client is not None else 0
+
+    def plane_ingest_age_s(self, now: Optional[float] = None) -> float:
+        """Seconds since ANY discovery-plane traffic was ingested
+        (dup-seq redeliveries and tombstones count — they prove the
+        plane is moving); age since construction when nothing arrived
+        yet."""
+        now = self.clock() if now is None else now
+        last = self._last_ingest_ts
+        return max(0.0, now - (self._plane_born_ts if last is None
+                               else last))
 
     # -- wiring -------------------------------------------------------------
-    def start(self, broker_host: str, broker_port: int) -> "FleetObservatory":
+    def start(self, broker_host: str, broker_port: int,
+              brokers: Optional[List[Tuple[str, int]]] = None,
+              ) -> "FleetObservatory":
         """Subscribe to ``nns/query/<topic>/#`` on the broker and
-        register the ``nns.fleet.*`` registry collector."""
+        register the ``nns.fleet.*`` registry collector.  ``brokers``
+        is the ordered failover list handed to the MQTT client."""
         from ..distributed.mqtt import MqttClient
 
-        self._client = MqttClient(broker_host, broker_port)
+        self._client = MqttClient(broker_host, broker_port,
+                                  brokers=brokers)
         # empty topic = EVERY announce topic: MQTT matches level by
         # level, so the pattern must be nns/query/# (nns/query//# would
         # only match servers whose topic= is literally empty)
@@ -451,6 +484,10 @@ class FleetObservatory:
             return False
         now = self.clock()
         with self._lock:
+            # any decodable digest proves the plane is moving — set
+            # BEFORE the dup-seq dedupe (a re-announced broker redelivers
+            # retained state with an already-seen seq)
+            self._last_ingest_ts = now
             self._evict_stale_locked(now)
             row = self._rows.get(topic)
             if row is None:
@@ -486,6 +523,7 @@ class FleetObservatory:
         """The server deleted its retained announce (clean stop): retire
         its row — counters survive in the retired accumulator."""
         with self._lock:
+            self._last_ingest_ts = self.clock()
             row = self._rows.pop(topic, None)
             if row is not None:
                 self._retire_locked(row, stale=False, pop=False)
@@ -629,6 +667,11 @@ class FleetObservatory:
                 "stale_evicted": self.stale_evicted,
                 "retired_evicted": self.retired_evicted,
                 "servers_seen": self.servers_seen,
+                # control-plane health (explicit broker-loss signal)
+                "plane_connected": 1 if self.plane_connected else 0,
+                "plane_ingest_age_s": round(
+                    self.plane_ingest_age_s(now), 3),
+                "plane_reconnects": self.plane_reconnects,
             }
             tenants: Dict[str, Dict[str, int]] = {
                 t: dict(r) for t, r in self._retired_tenants.items()
@@ -714,6 +757,9 @@ class FleetObservatory:
         ("stale_evicted", "nns.fleet.stale_evicted"),
         ("retired_evicted", "nns.fleet.retired_evicted"),
         ("ttft_p95_ms", "nns.fleet.ttft_p95_ms"),
+        ("plane_connected", "nns.fleet.plane_connected"),
+        ("plane_ingest_age_s", "nns.fleet.plane_ingest_age_s"),
+        ("plane_reconnects", "nns.fleet.plane_reconnects"),
     )
 
     def _collect(self) -> List[Sample]:

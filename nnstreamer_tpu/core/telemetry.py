@@ -285,6 +285,11 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.fleet.stale": ("gauge", "live-but-stale servers (digest older than the stale threshold; excluded from headroom)"),
     "nns.fleet.retired_evicted": ("counter", "retired-server snapshots evicted by the ledger cap (aggregates preserved)"),
     "nns.fleet.ttft_p95_ms": ("gauge", "worst per-server p95 time to first token across fresh digests, ms"),
+    # control-plane health (explicit broker-loss signal — rows aging
+    # stale silently is not a diagnosis)
+    "nns.fleet.plane_connected": ("gauge", "1 while the observatory's broker connection is up"),
+    "nns.fleet.plane_ingest_age_s": ("gauge", "seconds since the observatory last ingested any digest"),
+    "nns.fleet.plane_reconnects": ("counter", "observatory broker reconnects (restart/failover dials that succeeded)"),
 
     # -- fleet autoscaling (core/autoscale.py FleetController) -------------
     "nns.autoscale.ticks": ("counter", "controller decision-loop evaluations"),
@@ -303,6 +308,25 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.autoscale.model_samples": ("gauge", "observations banked by the performance model"),
     "nns.autoscale.model_ready": ("gauge", "1 when the predictive model has enough samples to act"),
     "nns.autoscale.target_servers": ("gauge", "fleet size the controller is steering toward"),
+    # fail-static ladder + leader lease (control-plane resilience)
+    "nns.autoscale.frozen": ("counter", "actions the fail-static ladder froze instead of dispatching (reason= label breaks down the cause)"),
+    "nns.autoscale.plane_level": ("gauge", "assessed control-plane view: 0 ok / 1 degraded / 2 blind"),
+    "nns.autoscale.standby_ticks": ("counter", "ticks spent standby (leader lease not held)"),
+    "nns.autoscale.lease_held": ("gauge", "1 while this controller holds the leader lease"),
+    "nns.autoscale.lease_epoch": ("gauge", "this controller's lease epoch (monotonic across takeovers)"),
+    "nns.autoscale.lease_acquires": ("counter", "leader-lease acquisitions (vacant grant or expiry takeover)"),
+    "nns.autoscale.lease_steals": ("counter", "expired foreign leases taken over"),
+    "nns.autoscale.lease_losses": ("counter", "leaderships lost (superseding epoch, split-lease resolution, or self-fence)"),
+    "nns.autoscale.lease_refusals": ("counter", "acquire attempts refused because a fresh foreign lease exists"),
+
+    # -- control-plane resilience, target side (fencing + failover) --------
+    "nns.query.reannounces": ("counter", "retained announces re-published after a broker reconnect"),
+    "nns.query.plane_reconnects": ("counter", "announce-client broker reconnects (restart or failover)"),
+    "nns.query.digest_publish_failures": ("counter", "digest publishes refused while the broker was unreachable"),
+    "nns.query.stale_epoch_rejects": ("counter", "fenced drain commands refused for a stale lease epoch"),
+    "nns.query.fence_epoch": ("gauge", "highest lease epoch this server has accepted"),
+    "nns.gen.stale_epoch_rejects": ("counter", "fenced resize commands refused for a stale lease epoch"),
+    "nns.gen.fence_epoch": ("gauge", "highest lease epoch this generator has accepted"),
 
     "nns.source.pending": ("gauge", "frames pushed but not yet pulled (appsrc)"),
     "nns.sink.rendered": ("counter", "logical frames rendered by the sink"),
@@ -416,6 +440,14 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "memory_shed": "nns.query.memory_shed",
     # fleet observatory (discovery-plane digests, serversrc health row)
     "digests_published": "nns.query.digests",
+    # control-plane resilience (serversrc + generator health rows)
+    "reannounces": "nns.query.reannounces",
+    "plane_reconnects": "nns.query.plane_reconnects",
+    "digest_publish_failures": "nns.query.digest_publish_failures",
+    "stale_epoch_rejects": "nns.query.stale_epoch_rejects",
+    "fence_epoch": "nns.query.fence_epoch",
+    "gen_stale_epoch_rejects": "nns.gen.stale_epoch_rejects",
+    "gen_fence_epoch": "nns.gen.fence_epoch",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by

@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 from ..core.log import get_logger
 from .mqtt import MqttClient
@@ -29,11 +29,23 @@ class Announcement:
     """A live retained announce; ``clear()`` tombstones it."""
 
     def __init__(self, broker_host: str, broker_port: int, topic: str,
-                 info: dict, logger=None):
+                 info: dict, logger=None,
+                 brokers: Optional[Iterable[Tuple[str, int]]] = None):
         self.topic = topic
         self.log = logger or log
         self.info = dict(info)
-        self._client = MqttClient(broker_host, broker_port)
+        # guards info-merge + publish: update() runs on element threads
+        # while _reannounce() runs on the MQTT reader thread
+        self._lock = threading.Lock()
+        # exact count of retained re-publishes forced by broker
+        # reconnects (restart amnesia / failover to an empty standby)
+        self.reannounces = 0
+        self._client = MqttClient(broker_host, broker_port, brokers=brokers)
+        # a restarted broker forgot every retained message; a failed-over
+        # standby never had them.  Re-publishing the CURRENT announce on
+        # every reconnect reconverges the discovery plane within one
+        # digest interval — subscribers dedupe redeliveries by seq.
+        self._client.on_connect(self._reannounce)
         self._client.publish(
             topic, json.dumps(self.info).encode(), retain=True, qos=1
         )
@@ -45,7 +57,30 @@ class Announcement:
                 topic,
             )
 
-    def update(self, patch: dict, wait_ack: bool = True) -> None:
+    @property
+    def connected(self) -> bool:
+        client = self._client
+        return client is not None and client.connected.is_set()
+
+    @property
+    def reconnects(self) -> int:
+        client = self._client
+        return client.reconnects if client is not None else 0
+
+    def _reannounce(self) -> None:
+        client = self._client
+        if client is None:
+            return
+        with self._lock:
+            payload = json.dumps(self.info).encode()
+            self.reannounces += 1
+        try:
+            client.publish(self.topic, payload, retain=True, qos=1)
+        except OSError:
+            pass  # connection flapped again; the next reconnect retries
+
+    def update(self, patch: dict, wait_ack: bool = True,
+               require_connected: bool = False) -> None:
         """Merge ``patch`` into the announce and re-publish it retained:
         the discovery plane carries live server STATE (draining flag,
         load summary), not just topology — late discoverers see the
@@ -54,13 +89,23 @@ class Announcement:
         ``wait_ack=False`` skips the QoS-1 ack wait: a state update
         published from a serving thread (the serversrc's drain entry)
         must not stall behind a slow broker — the publish is still
-        QoS-1 on the socket, only the confirmation wait is elided."""
+        QoS-1 on the socket, only the confirmation wait is elided.
+
+        ``require_connected=True`` raises :class:`ConnectionError` when
+        the broker is unreachable at publish time — the merge into
+        ``self.info`` still happens (the reconnect re-announce carries
+        it), but the caller gets an exact failure signal it can count
+        instead of silently queueing into the reconnect backlog."""
         if self._client is None:
             return
-        self.info.update(patch)
-        self._client.publish(
-            self.topic, json.dumps(self.info).encode(), retain=True, qos=1
-        )
+        with self._lock:
+            self.info.update(patch)
+            payload = json.dumps(self.info).encode()
+            if require_connected and not self._client.connected.is_set():
+                raise ConnectionError(
+                    f"announce broker unreachable; {self.topic} update "
+                    "deferred to the reconnect re-announce")
+            self._client.publish(self.topic, payload, retain=True, qos=1)
         if wait_ack and self._client.drain(5.0):
             self.log.warning(
                 "endpoint announce update on %s unacknowledged by the "

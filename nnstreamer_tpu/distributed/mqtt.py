@@ -24,7 +24,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..core.log import get_logger
 
@@ -596,8 +596,18 @@ class MqttClient:
                  keepalive: int = 60, timeout: float = 10.0,
                  reconnect: bool = True, retransmit_s: float = 2.0,
                  reconnect_delay_s: float = 0.1,
-                 clean_session: bool = True):
+                 clean_session: bool = True,
+                 brokers: Optional[Iterable[Tuple[str, int]]] = None):
         self._host, self._port, self._timeout = host, port, timeout
+        # ordered failover list: (host, port) first, extras after.  The
+        # reconnect loop dials each in turn per failed attempt, so a dead
+        # primary fails over within one dial timeout — clients never need
+        # to know which broker of the set is the live one.
+        self._brokers: List[Tuple[str, int]] = [(host, int(port))]
+        for h, p in (brokers or ()):
+            if (h, int(p)) not in self._brokers:
+                self._brokers.append((h, int(p)))
+        self._broker_i = 0
         self._cid = client_id or f"nns-tpu-{id(self) & 0xFFFFFF:x}"
         # clean_session=False + a stable client_id = persistent session:
         # the broker keeps subscriptions and queues/retransmits QoS-1
@@ -624,8 +634,26 @@ class MqttClient:
         self._pending: Dict[int, list] = {}
         self._pending_lock = threading.Lock()
         self.connected = threading.Event()
+        # connection-plane accounting (exact): successful reconnects and
+        # retained QoS-1 publishes superseded while the broker was away
+        self.reconnects = 0
+        self.coalesced = 0
+        self._on_connect: List[Callable[[], None]] = []
         self._sock: Optional[socket.socket] = None
-        self._connect()  # first connect failure raises to the caller
+        # first connect walks the failover list too: a dead primary with
+        # a live standby must not fail construction.  Raises only when
+        # EVERY broker refused.
+        err: Optional[OSError] = None
+        for i in range(len(self._brokers)):
+            self._broker_i = i
+            try:
+                self._connect()
+                err = None
+                break
+            except OSError as e:
+                err = e
+        if err is not None:
+            raise err
         self._reader = threading.Thread(
             target=self._read_loop, name="mqtt-client", daemon=True
         )
@@ -639,7 +667,20 @@ class MqttClient:
         self._pinger.start()
 
     # -- connection ---------------------------------------------------------
+    @property
+    def broker(self) -> Tuple[str, int]:
+        """The (host, port) this client last connected (or dialed) to."""
+        return self._brokers[self._broker_i]
+
+    def on_connect(self, cb: Callable[[], None]) -> None:
+        """Register a callback fired (from the reader thread) after every
+        successful RE-connect, once the session is resumed — the hook an
+        :class:`~..distributed.hybrid.Announcement` uses to re-publish its
+        retained state into a restarted (amnesiac) or failed-over broker."""
+        self._on_connect.append(cb)
+
     def _connect(self) -> None:
+        self._host, self._port = self._brokers[self._broker_i]
         sock = socket.create_connection(
             (self._host, self._port), timeout=self._timeout
         )
@@ -692,11 +733,23 @@ class MqttClient:
                 self._connect()
                 log.info("mqtt client reconnected to %s:%d",
                          self._host, self._port)
+                self.reconnects += 1
                 self._resume_session()
+                for cb in list(self._on_connect):
+                    try:
+                        cb()
+                    except Exception:  # hook bugs must not kill the reader
+                        log.exception("mqtt on_connect hook failed")
                 return
             except OSError:
-                self._stop.wait(backoff)
-                backoff = min(backoff * 2, 2.0)
+                # failover: advance to the next broker in the ordered list
+                # before the next dial; back off only after a full cycle
+                # of the list has been refused, so a live standby broker
+                # is reached within one dial per dead predecessor
+                self._broker_i = (self._broker_i + 1) % len(self._brokers)
+                if self._broker_i == 0:
+                    self._stop.wait(backoff)
+                    backoff = min(backoff * 2, 2.0)
 
     # -- io -----------------------------------------------------------------
     def _send(self, data: bytes) -> None:
@@ -740,6 +793,19 @@ class MqttClient:
         if qos == 1:
             pid = self._next_pid()
             with self._pending_lock:
+                if retain:
+                    # retained semantics are last-writer-wins: a newer
+                    # retained publish on the same topic supersedes any
+                    # still-unacked one, so the outage backlog is bounded
+                    # at ONE entry per retained topic and a reconnect
+                    # never replays a stale announce/digest over a fresh
+                    # one (subscribers additionally dedupe by seq)
+                    for old_pid in [
+                        p for p, e in self._pending.items()
+                        if e[2] and e[0] == topic
+                    ]:
+                        del self._pending[old_pid]
+                        self.coalesced += 1
                 self._pending[pid] = [topic, payload, retain, time.monotonic()]
             try:
                 self._send(_publish_packet(topic, payload, retain, 1, pid))

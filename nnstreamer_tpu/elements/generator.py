@@ -39,7 +39,7 @@ carries tokens (B, n) int32 plus meta ``stream_seq`` (source frame seq),
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -162,6 +162,11 @@ class TensorGenerator(Element):
         # boundary after every live stream handed off resumably
         self._resize_target = 0
         self._resizes = 0
+        # fenced actuation (core/autoscale.py LeaderLease): a resize
+        # carrying a stale lease epoch is REFUSED — a deposed
+        # controller's in-flight commands must not race the new leader
+        from ..core.autoscale import FencingToken
+        self._fence = FencingToken()
 
     def start(self):
         import jax
@@ -367,6 +372,10 @@ class TensorGenerator(Element):
             # device-loss resilience: 1 while serving in a reduced
             # configuration (mirrored on the discovery plane)
             "degraded": 1 if self._degraded else 0,
+            # fenced actuation: stale-epoch resize refusals + the
+            # highest lease epoch this generator has obeyed
+            "gen_stale_epoch_rejects": self._fence.rejects,
+            "gen_fence_epoch": self._fence.epoch,
         }
         if self._engine is not None:
             info.update(self._engine.snapshot())
@@ -429,14 +438,19 @@ class TensorGenerator(Element):
         return chunks
 
     # -- autoscale resize actuation (core/autoscale.py) ---------------------
-    def request_resize(self, slots: int) -> None:
+    def request_resize(self, slots: int, epoch: Optional[int] = None) -> None:
         """Arm a ZERO-LOSS slot-width resize (any thread): live streams
         are flushed as resumable GOAWAY chunks (clients migrate or
         resume them here — remaining tokens bit-identical, the resume
         signature deliberately excludes the slot width), then the slot
         model + engine rebuild at the new width on the dispatch thread's
         next idle boundary.  Poll :attr:`resize_pending` / the
-        ``gen_resizes`` health counter for completion."""
+        ``gen_resizes`` health counter for completion.
+
+        ``epoch`` is the commanding controller's lease epoch; a stale
+        epoch raises :class:`~..core.autoscale.StaleEpochError` BEFORE
+        any stream is touched (``None`` = unfenced operator command)."""
+        self._fence.check(epoch)
         slots = int(slots)
         if slots < 1:
             raise ElementError(f"{self.name}: resize slots must be >= 1")
@@ -487,7 +501,11 @@ class TensorGenerator(Element):
         bit-identically at either width."""
         from ..core.slots import SlotEngine
 
-        target, self._resize_target = self._resize_target, 0
+        # NOTE: _resize_target stays set until the swap lands (or the
+        # rollback commits) — resize_pending is the actuation-complete
+        # signal controllers poll, so clearing it before the rebuild
+        # would let a poller read the OLD width as the settled result
+        target = self._resize_target
         old = self._engine
         try:
             model, params, max_seq = self._build_slot_model(target)
@@ -502,6 +520,8 @@ class TensorGenerator(Element):
                     "resize_failed", self.name,
                     f"slot resize {self._slots}->{target} model build "
                     "failed; serving at the old width")
+            if self._resize_target == target:
+                self._resize_target = 0
             return
         old.stop()
         self._params = params
@@ -530,6 +550,10 @@ class TensorGenerator(Element):
         # the actuated width, not the parse-time one
         self.props["slots"] = target
         self._resizes += 1
+        # a request_resize racing the swap may have armed a NEWER
+        # target — only clear our own
+        if self._resize_target == target:
+            self._resize_target = 0
 
     # -- device-loss resilience (degrade, don't die) -------------------------
     def _place_on_survivor(self, params, mesh):

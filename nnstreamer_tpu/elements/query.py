@@ -108,6 +108,14 @@ class TensorQueryServerSrc(SourceElement):
         "dest-port": Property(
             int, 0, "MQTT broker port (0 = announcing disabled)"
         ),
+        # control-plane resilience: ordered standby brokers the announce
+        # client fails over to when the primary dies; on every
+        # (re)connect the retained announce + current digest re-publish,
+        # so a restarted or failed-over broker reconverges within one
+        # digest interval
+        "dest-brokers": Property(
+            str, "", "failover broker list 'host:port,host:port' tried "
+            "in order after dest-host:dest-port (empty = primary only)"),
         "block-ingress": Property(
             bool, False,
             "inject each wire micro-batch as ONE BatchFrame so the server "
@@ -202,12 +210,25 @@ class TensorQueryServerSrc(SourceElement):
         # fleet observatory: the telemetry-digest publisher (armed in
         # start() when announcing; polled from the watchdog sweeper)
         self._digest = None
+        # lease fencing (core/autoscale.py): highest controller epoch
+        # this server has accepted; stale-epoch drains are refused with
+        # a typed reject before touching any stream or ledger
+        from ..core.autoscale import FencingToken
 
-    def request_drain(self) -> None:
+        self._fence = FencingToken()
+
+    def request_drain(self, epoch=None) -> None:
         """Begin the rolling-restart drain of THIS server: GOAWAY to new
         requests, finish in-flight ones (bounded by ``drain-deadline``),
         close listeners, end the stream.  ``Pipeline.drain()`` triggers
-        the same path for the whole server pipeline."""
+        the same path for the whole server pipeline.
+
+        ``epoch`` is the issuing controller's lease epoch: an epoch
+        older than one already accepted raises
+        :class:`~nnstreamer_tpu.core.autoscale.StaleEpochError` and the
+        server keeps serving untouched (``None`` = local/operator
+        command, never fenced)."""
+        self._fence.check(epoch)
         self._drain_requested.set()
 
     @property
@@ -335,11 +356,16 @@ class TensorQueryServerSrc(SourceElement):
         ann = self._announcement
         if ann is None:
             return
+        # require_connected: during a broker outage the update merges
+        # into the announce (the reconnect re-announce will carry it)
+        # but raises — the DigestPublisher counts EXACTLY one
+        # publish failure per missed interval instead of queueing
+        # blindly into the reconnect backlog
         ann.update({
             "digest": digest,
             "draining": bool(digest.get("draining", False)),
             "degraded": bool(digest.get("degraded", False)),
-        }, wait_ack=False)
+        }, wait_ack=False, require_connected=True)
 
     def publish_digest(self, force: bool = True):
         """Publish a digest NOW (chaos harness / operator hook; the
@@ -361,6 +387,18 @@ class TensorQueryServerSrc(SourceElement):
             # a bind-all address is not dialable; announce loopback and
             # let multi-host deployments set host= to a reachable address
             host = "127.0.0.1"
+        brokers = []
+        for spec in str(self.props["dest-brokers"]).split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            bh, _, bp = spec.rpartition(":")
+            try:
+                brokers.append((bh, int(bp)))
+            except ValueError:
+                raise ElementError(
+                    f"{self.name}: dest-brokers entry {spec!r} "
+                    "(want host:port)") from None
         # instance id must be unique across the POD, not just this
         # process: element names repeat (every pipeline calls its entry
         # "src"), so pid+uuid disambiguates both in- and cross-process
@@ -379,6 +417,7 @@ class TensorQueryServerSrc(SourceElement):
                 "inflight": 0,
             },
             logger=self.log,
+            brokers=brokers or None,
         )
 
     def _announce_state(self, draining: bool) -> None:
@@ -462,9 +501,16 @@ class TensorQueryServerSrc(SourceElement):
     def health_info(self) -> dict:
         """Admission/load-shed counters merged into Pipeline.health()."""
         info = {"lifecycle": self._lc_state,
-                "degraded": 1 if self._degraded else 0}
+                "degraded": 1 if self._degraded else 0,
+                "stale_epoch_rejects": self._fence.rejects,
+                "fence_epoch": self._fence.epoch}
+        ann = self._announcement
+        if ann is not None:
+            info["reannounces"] = ann.reannounces
+            info["plane_reconnects"] = ann.reconnects
         if self._digest is not None:
             info["digests_published"] = self._digest.published
+            info["digest_publish_failures"] = self._digest.publish_failures
         if self._core is not None:
             info.update(self._core.liveness_snapshot())
         p = self._pipeline

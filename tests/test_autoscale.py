@@ -406,7 +406,7 @@ class _FakeObservatory:
 
 
 class _FailingActuator(NullActuator):
-    def spawn(self):
+    def spawn(self, epoch=0):
         t = ActionTicket()
         self.calls.append((SCALE_UP, "", 0))
         t.resolve(False, "quota exceeded")
@@ -414,7 +414,7 @@ class _FailingActuator(NullActuator):
 
 
 class _RaisingActuator(NullActuator):
-    def spawn(self):
+    def spawn(self, epoch=0):
         raise RuntimeError("deploy plane down")
 
 
@@ -491,11 +491,13 @@ class TestFleetController:
         samples = ctrl._collect()
         names = {s.name for s in samples}
         want = {m for m in METRICS if m.startswith("nns.autoscale.")}
-        assert names == want and len(want) == 16
+        assert names == want and len(want) == 25
         by_name = {s.name: s for s in samples}
         assert by_name["nns.autoscale.ticks"].value == 1.0
         assert by_name["nns.autoscale.scale_ups"].value == 1.0
         assert by_name["nns.autoscale.actions_inflight"].value == 1.0
+        # only the per-reason frozen breakdown carries extra labels,
+        # and nothing froze in this healthy-plane tick
         assert all(s.labels == {"fleet": "fake"} for s in samples)
 
     def test_incident_dumped_per_action(self):
@@ -754,6 +756,46 @@ def test_generator_resize_rejects_bad_width():
         pipe.stop()
 
 
+def test_resize_pending_holds_until_swap_lands():
+    """``resize_pending`` is the actuation-complete signal controllers
+    poll: it must stay set through the WHOLE rebuild.  (Regression: it
+    used to clear at the START of the apply, so a poller could read
+    the OLD width as the settled result while the swap was still in
+    flight.)"""
+    import threading
+
+    from nnstreamer_tpu.pipeline import parse_pipeline
+
+    pipe = parse_pipeline(
+        "appsrc name=src ! tensor_generator name=gen slots=2 "
+        "custom=sim:1,vocab:101 max-new=4 ! tensor_sink name=out",
+        name="resizepend")
+    pipe.start()
+    try:
+        gen = pipe["gen"]
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = gen._build_slot_model
+
+        def slow_build(slots):
+            entered.set()
+            assert gate.wait(10.0)
+            return orig(slots)
+
+        gen._build_slot_model = slow_build
+        gen.request_resize(4)
+        assert entered.wait(10.0)   # dispatch thread is inside the build
+        assert gen.resize_pending   # ...and the signal still holds
+        gate.set()
+        deadline = time.monotonic() + 10.0
+        while gen.resize_pending and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not gen.resize_pending
+        assert int(pipe.health()["gen"]["gen_slots"]) == 4
+    finally:
+        pipe.stop()
+
+
 # ---------------------------------------------------------------------------
 # The chaos acceptance (tier-1, chaos-marked)
 # ---------------------------------------------------------------------------
@@ -783,3 +825,49 @@ def test_autoscale_chaos_smoke():
     assert v["accounting_ok"] and v["metrics_endpoint_ok"]
     assert v["breaker_trips"] == 0
     assert v["inflight"] == {}
+
+
+@pytest.mark.chaos
+def test_partition_chaos_smoke():
+    """The fail-static acceptance contract: the control plane is
+    killed (broker death + amnesia restart), blinded, partitioned, and
+    duplicated (two live leased controllers) while a generate-mode
+    fleet keeps serving — the dataplane is provably untouched (zero
+    lost/duplicated tokens), zero drains land on alive-but-invisible
+    servers, exactly one epoch's actions apply (stale-epoch rejects
+    counted), and fleet rollups are integer-exact after heal."""
+    from tools.chaos_fleet import run_partition_script
+
+    v = run_partition_script(servers=3, streams=6, seed=0, lease_ttl=4.0)
+    assert v["ok"], v
+    # the contract, spelled out
+    assert v["mismatched"] == 0 and v["exact"] == v["streams"]
+    # exactly one leader elected; the standby was refused, not queued
+    assert v["election"]["epoch1"] == 1
+    assert v["election"]["standby_refusals"] >= 1
+    assert v["standby_actions"] == 0
+    # broker death sensed: planner froze fail-static, then reconverged
+    assert v["broker_outage"]["plane_lost_sensed"]
+    assert v["broker_outage"]["frozen"] >= 1
+    assert "broker_disconnected" in v["broker_outage"]["frozen_reasons"]
+    assert v["broker_outage"]["blind_level"] == "blind"
+    assert all(n >= 1 for n in v["broker_outage"]["reconnects"].values())
+    assert all(n >= 1 for n in v["broker_outage"]["reannounces"].values())
+    assert v["broker_outage"]["crosscheck_exact"]
+    # partition: below-quorum freeze, no drains of invisible servers
+    assert "below_quorum" in v["partition"]["frozen_reasons"]
+    assert v["partition"]["drains_while_invisible"] == 0
+    assert v["partition"]["crosscheck_after_heal"]
+    # fenced drain under the first epoch only; zero drops
+    assert v["scale_down"]["dropped"] == 0
+    assert v["scale_down"]["drain_complete"]
+    assert all(e == v["election"]["epoch1"]
+               for e in v["scale_down"]["epochs"])
+    # takeover: new epoch fences the deposed leader's commands
+    assert v["fencing"]["epoch2"] == 2
+    assert v["fencing"]["steals"] == 1
+    assert v["fencing"]["self_fences"] == 1
+    assert v["fencing"]["stale_reject"]
+    assert v["fencing"]["gen_stale_epoch_rejects"] >= 1
+    assert v["crosscheck_final"]
+    assert v["breaker_trips"] == 0

@@ -1,0 +1,616 @@
+"""Fail-static control plane (Documentation/resilience.md
+"Control-plane resilience").
+
+Truth tables and e2e pins for the machinery that lets the discovery/
+control plane die without taking the dataplane with it:
+
+* ``FencingToken`` / ``StaleEpochError`` — targets refuse commands from
+  deposed controllers, exactly counted.
+* ``LeaderLease`` — the fake-clock truth table: acquire only on provable
+  vacancy, renew, expire -> steal with strict epoch monotonicity,
+  split-lease resolution, self-fence before takeover.
+* ``assess_plane`` + ``plan(plane=...)`` — the degradation ladder:
+  DEGRADED freezes destructive actions, BLIND freezes everything, every
+  frozen impulse is counted by reason.
+* ``MqttClient`` broker-list failover, reconnect/reannounce counters,
+  retained-publish coalescing during an outage.
+* ``FleetObservatory`` broker-loss sensing (``plane_connected``,
+  ingest age) and ``DigestPublisher`` exact failure accounting.
+* e2e: a stale-epoch drain reject leaves the target server's streams
+  and ledgers bit-untouched.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from nnstreamer_tpu.core.autoscale import (
+    FencingToken,
+    FleetPolicy,
+    ControllerState,
+    LeaderLease,
+    LeaseChannel,
+    PlaneStatus,
+    StaleEpochError,
+    assess_plane,
+    plan,
+)
+
+
+# ---------------------------------------------------------------------------
+# FencingToken: the target's side of lease fencing
+# ---------------------------------------------------------------------------
+class TestFencingToken:
+    def test_admits_and_advances(self):
+        f = FencingToken()
+        assert f.epoch == 0 and f.rejects == 0
+        f.check(0)          # unleased controllers carry epoch 0
+        f.check(3)          # first leased command advances the fence
+        assert f.epoch == 3
+        f.check(3)          # same epoch: the lease guarantees one holder
+        assert f.rejects == 0
+
+    def test_stale_epoch_typed_reject(self):
+        f = FencingToken()
+        f.check(5)
+        with pytest.raises(StaleEpochError) as ei:
+            f.check(2)
+        assert ei.value.offered == 2 and ei.value.current == 5
+        assert f.rejects == 1
+        assert f.epoch == 5            # a reject never moves the fence
+        with pytest.raises(StaleEpochError):
+            f.check(4)
+        assert f.rejects == 2
+
+    def test_none_is_operator_bypass(self):
+        f = FencingToken()
+        f.check(7)
+        f.check(None)                  # a human on the box outranks it
+        assert f.epoch == 7 and f.rejects == 0
+
+
+# ---------------------------------------------------------------------------
+# LeaderLease: fake-clock truth table
+# ---------------------------------------------------------------------------
+class TestLeaderLease:
+    def test_cold_acquire_waits_full_ttl_vacancy_watch(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0)
+        assert not ls.attempt(100.0)       # watch starts: not provably vacant
+        assert not ls.attempt(105.9)       # retained redelivery gets its TTL
+        assert ls.attempt(106.0)
+        assert ls.held and ls.epoch == 1 and ls.acquires == 1
+        assert ls.steals == 0
+
+    def test_renewal_cadence(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0)
+        ls.attempt(0.0)
+        assert ls.attempt(6.0) and ls.renewals == 0  # acquire counted apart
+        assert ls.attempt(7.0) and ls.renewals == 0  # renew not due (ttl/3)
+        assert ls.attempt(8.1) and ls.renewals == 1
+        assert ls.attempt(8.2) and ls.renewals == 1  # due-stamp paces it
+
+    def test_fresh_foreign_lease_refuses_acquire(self):
+        ls = LeaderLease("ctl-b", ttl_s=6.0)
+        ls.observe({"owner": "ctl-a", "epoch": 4, "ttl_s": 6.0}, now=10.0)
+        assert not ls.attempt(12.0)
+        assert ls.refusals == 1 and not ls.held
+
+    def test_expired_foreign_lease_is_stolen_epoch_monotonic(self):
+        ls = LeaderLease("ctl-b", ttl_s=6.0)
+        ls.observe({"owner": "ctl-a", "epoch": 4, "ttl_s": 6.0}, now=10.0)
+        assert not ls.attempt(15.0)              # still inside its TTL
+        assert ls.attempt(16.1)                  # provably expired
+        assert ls.held and ls.steals == 1
+        assert ls.epoch == 5                     # max-ever-seen + 1
+
+    def test_deposed_by_higher_epoch(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0)
+        ls.attempt(0.0)
+        ls.attempt(6.0)
+        assert ls.held
+        ls.observe({"owner": "ctl-b", "epoch": 9, "ttl_s": 6.0}, now=7.0)
+        assert not ls.held and ls.losses == 1
+
+    def test_split_lease_lower_owner_wins(self):
+        # amnesiac broker: both sides believe they hold the same epoch.
+        # Deterministic resolution — the LOWER owner id survives.
+        hi = LeaderLease("ctl-b", ttl_s=6.0)
+        hi.attempt(0.0)
+        hi.attempt(6.0)
+        hi.observe({"owner": "ctl-a", "epoch": hi.epoch, "ttl_s": 6.0},
+                   now=7.0)
+        assert not hi.held and hi.losses == 1
+        lo = LeaderLease("ctl-a", ttl_s=6.0)
+        lo.attempt(0.0)
+        lo.attempt(6.0)
+        lo.observe({"owner": "ctl-b", "epoch": lo.epoch, "ttl_s": 6.0},
+                   now=7.0)
+        assert lo.held and lo.losses == 0
+
+    def test_self_fence_before_standby_takeover(self):
+        # renewals unconfirmed (dead transport) for a full TTL => the
+        # holder steps down ON ITS OWN — and since the standby must also
+        # wait out the seen lease's TTL, the old leader is fenced before
+        # the takeover epoch can land.
+        sent = {"n": 0}
+
+        def dead_publish(payload):
+            sent["n"] += 1
+            return False
+
+        ls = LeaderLease("ctl-a", ttl_s=6.0, publish=lambda p: True)
+        ls.attempt(0.0)
+        ls.attempt(6.0)
+        assert ls.held
+        ls.publish = dead_publish
+        assert ls.attempt(8.1)              # renewal attempt fails quietly
+        assert ls.renewals == 0             # failed renewals never count
+        assert not ls.attempt(12.2)         # ttl past last confirmation
+        assert ls.self_fences == 1 and ls.losses == 1 and not ls.held
+
+    def test_failed_publish_rolls_back_acquire(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0, publish=lambda p: False)
+        assert not ls.attempt(0.0)
+        assert not ls.attempt(6.1)          # vacancy proven, publish refused
+        assert not ls.held and ls.epoch == 0 and ls.acquires == 0
+
+    def test_note_connected_reasserts_without_renewal(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0, publish=lambda p: True)
+        ls.attempt(0.0)
+        ls.attempt(6.0)
+        ls.note_connected(11.0)             # re-assert into amnesiac broker
+        # the reconnect refreshed the confirmation clock: no self-fence
+        assert ls.attempt(12.5) and ls.self_fences == 0
+
+    def test_own_retained_echo_confirms(self):
+        ls = LeaderLease("ctl-a", ttl_s=6.0, publish=lambda p: True)
+        ls.attempt(0.0)
+        ls.attempt(6.0)
+        ls.observe(ls.payload(), now=11.0)  # broker echoes our own doc
+        assert ls.attempt(11.5) and ls.held and ls.self_fences == 0
+
+
+# ---------------------------------------------------------------------------
+# assess_plane + plan(plane=...): the fail-static ladder
+# ---------------------------------------------------------------------------
+def _snap(fresh=0, stale=0, retired=0):
+    rows = [
+        {"topic": f"t{i}", "addr": f"h:{i}", "stale": False, "slots": 2,
+         "occupied": 1}
+        for i in range(fresh)
+    ] + [
+        {"topic": f"s{i}", "addr": f"h:9{i}", "stale": True}
+        for i in range(stale)
+    ]
+    return {"servers": rows, "rollup": {"retired": retired}}
+
+
+class TestAssessPlane:
+    def test_healthy(self):
+        st = ControllerState()
+        p = assess_plane(_snap(fresh=3), FleetPolicy(), st)
+        assert p.ok and p.reasons == ()
+        assert st.known_fleet == 3
+
+    def test_broker_disconnected_degrades(self):
+        st = ControllerState()
+        p = assess_plane(_snap(fresh=3), FleetPolicy(), st, connected=False)
+        assert p.level == "degraded" and p.reasons == ("broker_disconnected",)
+
+    def test_stale_fraction_degrades(self):
+        st = ControllerState()
+        p = assess_plane(_snap(fresh=1, stale=2), FleetPolicy(), st)
+        assert p.level == "degraded" and "stale_fraction" in p.reasons
+
+    def test_silent_coverage_loss_is_below_quorum(self):
+        st = ControllerState()
+        assert assess_plane(_snap(fresh=4), FleetPolicy(), st).ok
+        # half the fleet vanished with NO tombstones: partition, not drain
+        p = assess_plane(_snap(fresh=1), FleetPolicy(), st)
+        assert p.level == "degraded" and "below_quorum" in p.reasons
+
+    def test_tombstoned_departure_is_not_coverage_loss(self):
+        st = ControllerState()
+        assert assess_plane(_snap(fresh=4), FleetPolicy(), st).ok
+        # two servers drained cleanly: retired counter explains them
+        p = assess_plane(_snap(fresh=2, retired=2), FleetPolicy(), st)
+        assert p.ok and st.known_fleet == 2
+
+    def test_resurrection_rebaselines_retired(self):
+        st = ControllerState()
+        assert assess_plane(_snap(fresh=3), FleetPolicy(), st).ok
+        # a row ages out (retired=1) then the server re-announces and the
+        # rollup un-counts it (retired back to 0) — the baseline must
+        # follow it DOWN, or the next real retirement is swallowed
+        assess_plane(_snap(fresh=2, retired=1), FleetPolicy(), st)
+        assess_plane(_snap(fresh=3, retired=0), FleetPolicy(), st)
+        assert st.seen_retired == 0 and st.known_fleet == 3
+        p = assess_plane(_snap(fresh=2, retired=1), FleetPolicy(), st)
+        assert p.ok and st.known_fleet == 2
+
+    def test_blind_when_no_fresh_rows(self):
+        st = ControllerState()
+        assess_plane(_snap(fresh=2), FleetPolicy(), st)
+        p = assess_plane(_snap(stale=2), FleetPolicy(), st)
+        assert p.level == "blind" and "no_fresh_rows" in p.reasons
+
+
+class TestPlanFreeze:
+    def test_degraded_freezes_ceiling_drain(self):
+        pol = FleetPolicy(min_servers=1, max_servers=1,
+                          cooldown_down_s=0.0)
+        st = ControllerState()
+        plane = PlaneStatus("degraded", ("broker_disconnected",))
+        acts = plan(_snap(fresh=2), pol, st, now=1.0, plane=plane)
+        assert acts == [] and st.frozen == 1
+        assert st.frozen_by_reason == {"broker_disconnected": 1}
+
+    def test_degraded_still_allows_floor_spawn(self):
+        pol = FleetPolicy(min_servers=3, cooldown_up_s=0.0)
+        st = ControllerState()
+        plane = PlaneStatus("degraded", ("below_quorum",))
+        acts = plan(_snap(fresh=2), pol, st, now=1.0, plane=plane)
+        assert [a.kind for a in acts] == ["scale_up"]
+        assert st.frozen == 0
+
+    def test_blind_freezes_everything(self):
+        pol = FleetPolicy(min_servers=1, cooldown_up_s=0.0)
+        st = ControllerState()
+        plane = PlaneStatus("blind", ("no_fresh_rows",))
+        # a blind controller seeing "zero servers" must NOT spawn
+        acts = plan(_snap(), pol, st, now=1.0, plane=plane)
+        assert acts == [] and st.frozen == 1
+        assert st.frozen_by_reason == {"no_fresh_rows": 1}
+
+    def test_healed_plane_acts_first_trusted_tick(self):
+        pol = FleetPolicy(min_servers=1, max_servers=1,
+                          cooldown_down_s=0.0)
+        st = ControllerState()
+        plane = PlaneStatus("degraded", ("stale_fraction",))
+        assert plan(_snap(fresh=2), pol, st, now=1.0, plane=plane) == []
+        acts = plan(_snap(fresh=2), pol, st, now=2.0, plane=PlaneStatus())
+        assert [a.kind for a in acts] == ["scale_down"]
+
+
+# ---------------------------------------------------------------------------
+# MqttClient: broker-list failover, reconnect + retained coalescing
+# ---------------------------------------------------------------------------
+def _blackhole_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port  # nothing listens: dials fail with ConnectionRefused
+
+
+class TestBrokerFailover:
+    def test_failover_dials_past_dead_broker(self):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker, MqttClient
+
+        broker = MiniBroker()
+        dead = _blackhole_port()
+        try:
+            c = MqttClient("127.0.0.1", dead,
+                           brokers=[("127.0.0.1", dead),
+                                    ("127.0.0.1", broker.port)])
+            try:
+                assert c.connected.wait(5.0)
+                got = threading.Event()
+                c.subscribe("fo/t", lambda t, p: got.set(), qos=1)
+                c.publish("fo/t", b"x", qos=1)
+                assert got.wait(5.0)
+            finally:
+                c.close()
+        finally:
+            broker.close()
+
+    def test_reconnect_counts_and_resubscribes(self):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker, MqttClient
+
+        broker = MiniBroker()
+        port = broker.port
+        c = MqttClient("127.0.0.1", port)
+        try:
+            assert c.connected.wait(5.0)
+            seen = []
+            c.subscribe("rc/t", lambda t, p: seen.append(p), qos=1)
+            broker.close()                       # die...
+            deadline = time.monotonic() + 5.0
+            while c.connected.is_set() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not c.connected.is_set()
+            broker = MiniBroker(port=port)       # ...and come back, amnesiac
+            assert c.connected.wait(10.0)
+            assert c.reconnects == 1
+            assert broker.wait_subscriber("rc/t", 5.0)  # re-subscribed
+            c2 = MqttClient("127.0.0.1", port)
+            try:
+                c2.publish("rc/t", b"after", qos=1)
+                deadline = time.monotonic() + 5.0
+                while not seen and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert seen == [b"after"]
+            finally:
+                c2.close()
+        finally:
+            c.close()
+            broker.close()
+
+    def test_retained_coalescing_bounds_outage_backlog(self):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker, MqttClient
+
+        broker = MiniBroker()
+        port = broker.port
+        c = MqttClient("127.0.0.1", port)
+        try:
+            assert c.connected.wait(5.0)
+            broker.close()
+            deadline = time.monotonic() + 5.0
+            while c.connected.is_set() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # an announce republished every interval during the outage:
+            # only the NEWEST retained doc matters, and only it is kept
+            for i in range(5):
+                c.publish("co/t", b"v%d" % i, retain=True, qos=1)
+            assert c.coalesced == 4
+            assert c.unacked() == 1
+        finally:
+            c.close()
+            broker.close()
+
+
+class TestReannounce:
+    def test_announce_survives_broker_amnesia(self):
+        from nnstreamer_tpu.distributed.hybrid import Announcement
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker, MqttClient
+
+        broker = MiniBroker()
+        port = broker.port
+        ann = None
+        sub = None
+        try:
+            ann = Announcement("127.0.0.1", port, "nns/query/ra/s0",
+                               {"host": "h", "port": 1, "seq": 1})
+            assert ann.connected and ann.reannounces == 0
+            broker.close()                       # retained store dies with it
+            deadline = time.monotonic() + 5.0
+            while ann.connected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            ann.update({"seq": 2}, wait_ack=False)  # merged while dark
+            broker = MiniBroker(port=port)
+            deadline = time.monotonic() + 10.0
+            while ((not ann.connected or ann.reannounces < 1)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert ann.reconnects == 1 and ann.reannounces == 1
+            # the re-announce carried the CURRENT merged info: a late
+            # subscriber sees seq=2 from retained state alone
+            got = []
+            done = threading.Event()
+
+            def on_msg(topic, payload):
+                got.append(json.loads(payload.decode()))
+                done.set()
+
+            sub = MqttClient("127.0.0.1", port)
+            sub.subscribe("nns/query/ra/#", on_msg, qos=1)
+            assert done.wait(5.0)
+            assert got[0]["seq"] == 2
+        finally:
+            if sub is not None:
+                sub.close()
+            if ann is not None:
+                ann.clear()
+            broker.close()
+
+
+class TestLeaseChannel:
+    def test_retained_lease_doc_reaches_standby(self):
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        broker = MiniBroker()
+        ch_a = ch_b = None
+        try:
+            la = LeaderLease("ctl-a", ttl_s=2.0)
+            ch_a = LeaseChannel("127.0.0.1", broker.port, "cp", la)
+            t0 = time.monotonic()
+            while not la.attempt(time.monotonic() - t0):
+                time.sleep(0.02)
+            assert la.held and la.epoch == 1
+            lb = LeaderLease("ctl-b", ttl_s=2.0)
+            ch_b = LeaseChannel("127.0.0.1", broker.port, "cp", lb)
+            deadline = time.monotonic() + 5.0
+            while lb._seen is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert lb._seen == {"owner": "ctl-a", "epoch": 1, "ttl_s": 2.0}
+            assert not lb.attempt(time.monotonic())
+            assert lb.refusals == 1
+        finally:
+            if ch_a is not None:
+                ch_a.close()
+            if ch_b is not None:
+                ch_b.close()
+            broker.close()
+
+
+# ---------------------------------------------------------------------------
+# Observatory broker-loss sensing + digest failure accounting
+# ---------------------------------------------------------------------------
+class TestPlaneSensing:
+    def test_direct_feed_reads_connected(self):
+        from nnstreamer_tpu.core.fleet import FleetObservatory
+
+        obs = FleetObservatory(topic="pf", clock=lambda: 100.0)
+        assert obs.plane_connected          # no link to lose
+        assert obs.plane_ingest_age_s(now=103.0) == 3.0
+        obs.ingest("nns/query/pf/s0",
+                   {"host": "h", "port": 1, "digest": {"seq": 1}})
+        assert obs.plane_ingest_age_s(now=100.5) == 0.5
+        roll = obs.rollup()
+        assert roll["plane_connected"] == 1
+        assert roll["plane_ingest_age_s"] == 0.0
+
+    def test_broker_death_clears_plane_connected(self):
+        from nnstreamer_tpu.core.fleet import FleetObservatory
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        broker = MiniBroker()
+        obs = FleetObservatory(topic="pf2")
+        try:
+            obs.start("127.0.0.1", broker.port)
+            deadline = time.monotonic() + 5.0
+            while not obs.plane_connected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert obs.plane_connected
+            broker.close()
+            deadline = time.monotonic() + 5.0
+            while obs.plane_connected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not obs.plane_connected
+            assert obs.rollup()["plane_connected"] == 0
+        finally:
+            obs.stop()
+            broker.close()
+
+    def test_digest_publisher_counts_outage_failures_exactly(self):
+        from nnstreamer_tpu.core.fleet import DigestPublisher
+
+        clk = {"t": 0.0}
+        sink = []
+        broken = {"on": False}
+
+        def publish(d):
+            if broken["on"]:
+                raise ConnectionError("announce channel dark")
+            sink.append(d)
+
+        pub = DigestPublisher(lambda: {"gen_tokens": 0}, publish,
+                              interval_s=1.0, clock=lambda: clk["t"])
+        pub.poll(force=True)
+        assert pub.published == 1 and pub.publish_failures == 0
+        broken["on"] = True
+        for _ in range(3):                 # outage: one failure per poll,
+            clk["t"] += 1.0                # never more (no retry storm)
+            pub.poll()
+        assert pub.publish_failures == 3 and pub.published == 1
+        broken["on"] = False
+        clk["t"] += 1.0
+        pub.poll()
+        assert pub.published == 2
+        # seq stays monotonic ACROSS the failures: a consumer can tell
+        # the post-outage digest is newer than the last delivered one
+        assert sink[-1]["seq"] > sink[0]["seq"]
+        assert sink[-1]["seq"] == pub.seq
+
+
+# ---------------------------------------------------------------------------
+# e2e: a stale-epoch reject leaves the target bit-untouched
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_stale_epoch_drain_reject_leaves_server_untouched():
+    """A deposed controller's drain lands on a serving server and is
+    REFUSED: no drain state entered, no stream evicted, every ledger
+    and token stream bit-identical to the oracle — then a current-epoch
+    resize still works (the fence rejected the command, not the
+    controller plane)."""
+    import sys
+    sys.path.insert(0, "tools") if "tools" not in sys.path else None
+    from tools.chaos_fleet import FleetHarness
+
+    h = FleetHarness(mode="generate", gen_slots=4, gen_max_new=32,
+                     gen_step_ms=2.0, base_id=10300, topic="fencee2e",
+                     digest_interval=0.25)
+    try:
+        h.start_server(0)
+        pipe = h.servers[0]
+        ssrc, gen = pipe["ssrc"], pipe["gen"]
+        clients = [h.make_gen_client(f"F{i}") for i in range(2)]
+        for c in clients:
+            c.push_prompt()
+        # a NEWER controller (epoch 3) has already actuated this target;
+        # now the deposed epoch-1 leader's in-flight drain arrives
+        ssrc._fence.check(3)
+        with pytest.raises(StaleEpochError):
+            ssrc.request_drain(epoch=1)
+        assert not ssrc._drain_requested.is_set()
+        assert ssrc.health_info()["stale_epoch_rejects"] == 1
+        assert ssrc.health_info()["fence_epoch"] == 3
+        # same refusal on the engine's fenced resize entry
+        slots0 = int(h.server_gen_row(pipe).get("gen_slots", 0))
+        gen._fence.check(3)
+        with pytest.raises(StaleEpochError):
+            gen.request_resize(slots0 + 2, epoch=2)
+        assert int(h.server_gen_row(pipe).get("gen_slots", 0)) == slots0
+        # the dataplane never noticed: streams complete bit-exactly
+        for c in clients:
+            c.settle(timeout=60.0)
+        checks = [c.check_exact() for c in clients]
+        assert all(r["mismatched"] == 0 for r in checks)
+        assert sum(r["exact"] for r in checks) == len(clients)
+        assert not pipe.draining
+        # and the CURRENT epoch still actuates normally
+        gen.request_resize(slots0 + 2, epoch=3)
+        deadline = time.monotonic() + 10.0
+        while (int(h.server_gen_row(pipe).get("gen_slots", 0)) != slots0 + 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert int(h.server_gen_row(pipe).get("gen_slots", 0)) == slots0 + 2
+        for c in clients:
+            c.finish()
+    finally:
+        h.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# fleet_top: the control-plane line
+# ---------------------------------------------------------------------------
+class TestFleetTopControlPlane:
+    def _snapshot(self, **auto):
+        return {
+            "rollup": {
+                "servers": 1, "draining": 0, "degraded": 0, "stale": 0,
+                "retired": 0, "stale_evicted": 0, "tokens_per_s": 0.0,
+                "occupancy": 0.0, "occupied": 0, "slots": 2,
+                "slot_headroom": 2, "mem_headroom_bytes": 0, "inflight": 0,
+                "tokens": 0, "admitted": 0, "shed": 0,
+                "plane_connected": auto.pop("plane_connected", 1),
+                "plane_ingest_age_s": 0.4, "plane_reconnects": 2,
+            },
+            "servers": [{"addr": "h:1", "topic": "t0", "seq": 3,
+                         "seen_s": 0.1}],
+            "autoscale": auto or None,
+        }
+
+    def test_render_shows_broker_and_lease(self):
+        from tools.fleet_top import render
+
+        out = render(self._snapshot(
+            plane_level="ok", plane_reasons=[], frozen=0,
+            lease={"owner": "ctl-a", "epoch": 3, "held": True}), "t")
+        assert "control plane: broker up" in out
+        assert "reconnects 2" in out
+        assert "lease ctl-a epoch 3 (leader)" in out
+        assert "DEGRADED" not in out
+
+    def test_render_shows_freeze_state(self):
+        from tools.fleet_top import render
+
+        out = render(self._snapshot(
+            plane_connected=0, plane_level="degraded",
+            plane_reasons=["broker_disconnected"], frozen=4,
+            lease={"owner": "ctl-b", "epoch": 5, "held": False}), "t")
+        assert "broker DOWN" in out
+        assert "lease ctl-b epoch 5 (standby)" in out
+        assert "[DEGRADED: broker_disconnected  frozen 4]" in out
+
+    def test_render_without_controller_still_has_plane_line(self):
+        from tools.fleet_top import render
+
+        snap = self._snapshot()
+        snap.pop("autoscale")
+        out = render(snap, "t")
+        assert "control plane: broker up" in out

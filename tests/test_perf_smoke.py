@@ -755,3 +755,60 @@ def test_sharded_serving_floors():
         f"dp:2 aggregate throughput only {res['dp2_speedup']}x the "
         f"single-server dataplane (floor 1.5x; measured ~1.9x): {res}"
     )
+
+
+def test_control_plane_armed_identity_floor():
+    """PR-17 pin: with the WHOLE control plane armed and healthy — a
+    live broker, a leader-elected lease renewing over its retained
+    topic, a broker-backed observatory ingesting digests, and a ticking
+    controller running the fail-static plane assessment — the fused
+    identity chain still clears the absolute 4000 fps floor.  Lease
+    renewal, plane grading, and freeze bookkeeping all live on the
+    controller's slow cadence and broker reader threads: none of it may
+    show up on the per-frame hot path."""
+    import threading
+
+    from nnstreamer_tpu.core.autoscale import (
+        FleetController, FleetPolicy, LeaderLease, LeaseChannel,
+        NullActuator)
+    from nnstreamer_tpu.core.fleet import FleetObservatory
+    from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+    broker = MiniBroker()
+    obs = FleetObservatory(topic="perfcp", default_ttl_s=5.0)
+    chan = None
+    stop = threading.Event()
+    try:
+        obs.start("127.0.0.1", broker.port)
+        lease = LeaderLease("perf-ctl", ttl_s=1.0)
+        chan = LeaseChannel("127.0.0.1", broker.port, "perfcp", lease)
+        ctrl = FleetController(obs, NullActuator(),
+                               policy=FleetPolicy(min_servers=0),
+                               lease=lease)
+        t0 = time.monotonic()
+        while not lease.held and time.monotonic() - t0 < 10.0:
+            ctrl.tick()          # vacancy watch, then acquire
+            time.sleep(0.02)
+        assert lease.held, "lease never acquired against a live broker"
+
+        def churn():
+            while not stop.is_set():
+                ctrl.tick()      # renew + assess_plane every 20ms
+                time.sleep(0.02)
+
+        th = threading.Thread(target=churn, daemon=True)
+        th.start()
+        fps = _passthrough_fps(True)
+        stop.set()
+        th.join(timeout=5.0)
+        assert lease.held and lease.self_fences == 0
+        assert fps >= 4000, (
+            f"armed control plane invaded the dataplane: {fps:.0f} fps "
+            "< 4000"
+        )
+    finally:
+        stop.set()
+        if chan is not None:
+            chan.close()
+        obs.stop()
+        broker.close()

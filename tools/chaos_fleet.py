@@ -296,6 +296,7 @@ class FleetHarness:
         # served, exactly like the per-tenant rows above)
         self.retired_admission: List[Dict[str, int]] = []
         self.server_starts = 0
+        self._blackhole: Optional[int] = None  # dead port (partitions)
 
     # -- servers ------------------------------------------------------------
     def start_server(self, idx: int, port: int = 0):
@@ -476,6 +477,77 @@ class FleetHarness:
             total["admitted"] += r["admitted"]
             total["shed"] += r["shed"]
         return total
+
+    # -- control-plane chaos (broker death / network partition) -------------
+    def blackhole_port(self) -> int:
+        """A bound-then-released localhost port: dialing it is REFUSED
+        immediately (no listener), so pointing a client's broker list at
+        it is a deterministic, timeout-free network partition."""
+        if self._blackhole is None:
+            import socket as _socket
+
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            self._blackhole = s.getsockname()[1]
+            s.close()
+        return self._blackhole
+
+    @staticmethod
+    def sever_client(client, port: int) -> None:
+        """Partition one MqttClient: point its failover list at a dead
+        port and cut the live socket — its reconnect loop dials the
+        void until :meth:`restore_client`.  Only the CONTROL plane is
+        touched; data-plane TCP connections are not this client's."""
+        import socket as _socket
+
+        client._brokers = [("127.0.0.1", int(port))]
+        client._broker_i = 0
+        with client._wlock:
+            sock = client._sock
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    @staticmethod
+    def restore_client(client, host: str, port: int) -> None:
+        """Heal a partition made by :meth:`sever_client`: the reconnect
+        loop's next dial (bounded by its 2s backoff cap) reconnects,
+        resumes the session, and fires the re-announce hooks."""
+        client._brokers = [(host, int(port))]
+        client._broker_i = 0
+
+    def kill_broker(self) -> None:
+        """Broker process death: every connection is torn down and the
+        retained store dies with the process (amnesia — only persistent
+        QoS-1 sessions survive via the port-keyed store).  Every client
+        enters its reconnect loop; :meth:`revive_broker` rebinds the
+        SAME port, standing in for a restarted or failed-over broker."""
+        self.broker.close()
+
+    def revive_broker(self) -> None:
+        from nnstreamer_tpu.distributed.mqtt import MiniBroker
+
+        self.broker = MiniBroker(port=self.broker.port)
+
+    def partition_server(self, idx: int) -> None:
+        """Cut server ``idx``'s announce/digest client off the broker
+        (its clients keep serving: the DATA plane is untouched)."""
+        ann = self.servers[idx]["ssrc"]._announcement
+        self.sever_client(ann._client, self.blackhole_port())
+
+    def heal_server(self, idx: int, timeout: float = 10.0) -> None:
+        """Heal ``idx``'s partition and wait until it re-announced."""
+        ann = self.servers[idx]["ssrc"]._announcement
+        before = ann.reannounces
+        self.restore_client(ann._client, "127.0.0.1", self.broker.port)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if ann.connected and ann.reannounces > before:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"server {idx} never re-announced after heal")
 
     # -- fleet observatory --------------------------------------------------
     def attach_observatory(self, ttl_s: float = 10.0):
@@ -1233,7 +1305,14 @@ class HarnessActuator:
 
     Every verb returns immediately; a worker thread resolves the
     :class:`ActionTicket` with the outcome — the controller's decision
-    loop never blocks on actuation (the FleetActuator contract)."""
+    loop never blocks on actuation (the FleetActuator contract).
+
+    Every verb carries the issuing controller's lease ``epoch`` (PR-17
+    fencing): the drain entry goes through the serversrc's fenced
+    ``request_drain`` and the resize through the generator's fenced
+    ``request_resize``, so a stale-epoch command from a deposed
+    controller is REFUSED by the target with a typed
+    :class:`StaleEpochError` — visible in the resolved event."""
 
     def __init__(self, harness: FleetHarness):
         self.h = harness
@@ -1245,7 +1324,7 @@ class HarnessActuator:
 
         return ActionTicket()
 
-    def _run(self, kind: str, target: str, fn) -> "Any":
+    def _run(self, kind: str, target: str, epoch: int, fn) -> "Any":
         ticket = self._spawn_ticket()
 
         def worker() -> None:
@@ -1254,6 +1333,7 @@ class HarnessActuator:
             except Exception as exc:  # noqa: BLE001 — outcome goes to the ticket
                 ok, detail = False, f"{type(exc).__name__}: {exc}"
             self.events.append({"kind": kind, "target": target,
+                                "epoch": int(epoch),
                                 "ok": bool(ok), "detail": detail})
             ticket.resolve(ok, detail)
 
@@ -1261,17 +1341,20 @@ class HarnessActuator:
                          name=f"chaos-actuate-{kind}").start()
         return ticket
 
-    def spawn(self):
+    def spawn(self, epoch: int = 0):
         def do() -> tuple:
             idx = self.h.add_server()
             return True, f"server{idx} port={self.h.ports[idx]}"
 
-        return self._run("scale_up", "", do)
+        return self._run("scale_up", "", epoch, do)
 
-    def drain(self, target: str):
+    def drain(self, target: str, epoch: int = 0):
         def do() -> tuple:
             idx = self.h.idx_for_topic(target)
             pipe = self.h.servers[idx]
+            # the fenced drain entry FIRST: a stale epoch raises here,
+            # before any stream is touched
+            pipe["ssrc"].request_drain(epoch=epoch)
             res = pipe.drain(timeout=30.0)
             ssrc = pipe["ssrc"]
             # the element-level actuation probe: frames() must have
@@ -1297,13 +1380,13 @@ class HarnessActuator:
                         f"goaway_evicted="
                         f"{rec['gen'].get('gen_goaway_evicted', 0)}")
 
-        return self._run("scale_down", target, do)
+        return self._run("scale_down", target, epoch, do)
 
-    def resize(self, target: str, slots: int):
+    def resize(self, target: str, slots: int, epoch: int = 0):
         def do() -> tuple:
             idx = self.h.idx_for_topic(target)
             gen = self.h.servers[idx]["gen"]
-            gen.request_resize(slots)
+            gen.request_resize(slots, epoch=epoch)
             deadline = time.monotonic() + 15.0
             while time.monotonic() < deadline:
                 row = self.h.server_gen_row(self.h.servers[idx])
@@ -1313,7 +1396,7 @@ class HarnessActuator:
                 time.sleep(0.01)
             return False, f"server{idx} resize to {slots} never completed"
 
-        return self._run("resize", target, do)
+        return self._run("resize", target, epoch, do)
 
 
 def run_autoscale_script(servers: int = 1, streams: int = 4) -> Dict[str, Any]:
@@ -1467,23 +1550,44 @@ def run_autoscale_script(servers: int = 1, streams: int = 4) -> Dict[str, Any]:
             sum(r["exact"] for r in victim_checks) / max(1, len(victims)))
 
         # -- phase 3: envelope shrink → scale-down under live load -------
-        # two waves keep EVERY server holding live streams while the
-        # drain lands (busy-retry refills slots as streams finish)
+        # streams are SHORT (they dry up in under a second), so a
+        # static wave cannot keep the fleet loaded long enough for the
+        # drain decision to land on a busy server — a pump tops up
+        # every client's in-flight streams instead, keeping the fleet
+        # saturated (2x clients >> fleet slots, busy-retries spill the
+        # excess onto whichever server has a free slot)
         down = [
             h.make_gen_client(f"D{i}", busy_retries=60, timeout=120.0)
             for i in range(2 * (n0 + 2))
         ]
-        for c in down:
-            c.push_prompt()
-        wait_all_loaded()
         refill = [
             h.make_gen_client(f"R{i}", busy_retries=60, timeout=120.0)
             for i in range(4)
         ]
-        for c in refill:
-            c.push_prompt()
+        pumps = down + refill
+
+        def pump() -> None:
+            for c in pumps:
+                while len(c.prompts) - c.finished() < 2:
+                    c.push_prompt()
+
+        pump()
+        wait_all_loaded()
         ctrl.policy.max_servers = n0 + 1  # the operator shrinks the bound
-        acts3 = tick_until("scale_down")  # envelope rule: drain NOW
+        # envelope rule: drain NOW — but only take the decision tick
+        # while EVERY server holds live streams ("drain under live
+        # load" is the contract; a momentarily idle server would be
+        # picked as least-loaded and hand off nothing)
+        deadline = time.monotonic() + 30.0
+        while True:
+            pump()
+            wait_all_loaded()
+            acts3 = tick()
+            if any(a.kind == "scale_down" for a in acts3):
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError("controller never dispatched scale_down")
+            time.sleep(0.03)
         wait_fleet(n0 + 1)
         for c in down + refill:
             c.settle(timeout=120.0)
@@ -1576,6 +1680,330 @@ def run_autoscale_script(servers: int = 1, streams: int = 4) -> Dict[str, Any]:
         h.stop_all()
 
 
+def run_partition_script(servers: int = 3, streams: int = 6,
+                         seed: int = 0,
+                         lease_ttl: float = 4.0) -> Dict[str, Any]:
+    """Fail-static control-plane chaos (Documentation/resilience.md
+    "Control-plane resilience"): the discovery/control plane is killed,
+    blinded, partitioned, and duplicated while a generate-mode fleet
+    keeps serving — and the dataplane is provably untouched.
+
+    Script, with TWO live controllers throughout:
+
+    1. **Election** — two leased controllers on one retained lease
+       topic: exactly one acquires (epoch 1), the standby's refusals
+       are counted.
+    2. **Broker death mid-load** — the broker dies at a seeded decode
+       point and later restarts on the same port with amnesia.  While
+       it is down, the leader's view degrades to BLIND and the
+       fail-static ladder freezes BOTH a tempted ceiling drain
+       (``broker_disconnected``) and the cold-controller floor spawn
+       (``no_fresh_rows``); streams keep decoding over direct TCP.
+       After the restart every server re-announces and the fleet
+       rollups are integer-exact again.
+    3. **Partition** — one server's control-plane link is severed; its
+       digest goes stale and is TTL-evicted while the server keeps
+       serving.  A tempted ceiling drain is frozen (``below_quorum``):
+       zero drains while part of the fleet is alive but invisible.
+       After the heal the rollups are integer-exact (resurrection
+       reversal), and only then does the envelope drain actually run —
+       carrying the leader's epoch.
+    4. **Fencing** — the leader is partitioned off the lease topic: it
+       self-fences within one TTL, the standby promotes with epoch 2,
+       actuates a fenced resize, and the old epoch's resize is REFUSED
+       by the target with a typed stale-epoch reject — ledgers and
+       slot width bit-untouched.
+
+    Verdict (exact): zero lost/duplicated tokens, zero drains of
+    alive-but-invisible servers, exactly one epoch's actions applied,
+    stale-epoch rejects counted, fleet rollups integer-exact after
+    every heal."""
+    import random
+
+    from nnstreamer_tpu.core.autoscale import (
+        FleetController, FleetPolicy, LeaderLease, LeaseChannel,
+        StaleEpochError,
+    )
+
+    h = FleetHarness(mode="generate", gen_slots=max(4, streams),
+                     gen_max_new=64, gen_step_ms=3.0, base_id=10200,
+                     topic="chaospart", digest_interval=0.25)
+    rng = random.Random(seed)
+    chan1 = chan2 = None
+    try:
+        for i in range(max(2, servers)):
+            h.start_server(i)
+        obs = h.attach_observatory(ttl_s=2.0)
+        act1, act2 = HarnessActuator(h), HarnessActuator(h)
+        # reactive rules disabled (streaks unreachable): every scale
+        # impulse in this script is a scripted envelope change, so the
+        # freeze/act counts are exact, not timing-dependent
+        pol = FleetPolicy(min_servers=1, max_servers=len(h.servers),
+                          up_streak=99, down_streak=99,
+                          cooldown_up_s=0.05, cooldown_down_s=0.05,
+                          plane_quorum_fraction=0.9)
+        lease1 = LeaderLease("ctl-a", ttl_s=lease_ttl)
+        lease2 = LeaderLease("ctl-b", ttl_s=lease_ttl)
+        chan1 = LeaseChannel("127.0.0.1", h.broker.port, h.topic, lease1)
+        chan2 = LeaseChannel("127.0.0.1", h.broker.port, h.topic, lease2)
+        ctrl1 = FleetController(obs, act1, policy=pol, lease=lease1)
+        ctrl2 = FleetController(obs, act2, policy=pol, lease=lease2)
+
+        def tick_ctrl(ctrl) -> list:
+            h.publish_digests()
+            return ctrl.tick()
+
+        # -- phase 1: election -------------------------------------------
+        deadline = time.monotonic() + lease_ttl + 10.0
+        while not lease1.held and time.monotonic() < deadline:
+            ctrl1.tick()  # vacancy watch: acquires after one full TTL
+            time.sleep(0.05)
+        for _ in range(3):
+            ctrl2.tick()  # standby: sees the fresh lease, refuses
+            time.sleep(0.02)
+        epoch1 = lease1.epoch
+
+        # -- phase 2: broker death mid-generate-load ---------------------
+        clients = [
+            h.make_gen_client(f"C{i}", routing="least-inflight",
+                              timeout=120.0)
+            for i in range(max(2, streams))
+        ]
+        traces = [c.push_prompt() for c in clients]
+        t_kill = 4 * rng.randint(1, 3)  # seeded mid-decode kill point
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(c.tokens_done(t) >= t_kill
+                   for c, t in zip(clients, traces)):
+                break
+            time.sleep(0.005)
+        ctrl1.tick()  # fresh lease renewal right before the outage
+        frozen0 = ctrl1.state.frozen
+        h.kill_broker()
+        # wait until the plane loss is SENSED everywhere (observatory
+        # gauge + every server's announce client)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if (not obs.plane_connected and not any(
+                    p["ssrc"]._announcement.connected
+                    for p in h.servers.values())):
+                break
+            time.sleep(0.02)
+        plane_lost_sensed = not obs.plane_connected
+        # digest publishes during the outage fail EXACTLY (counted per
+        # missed interval, never queued blindly)
+        h.publish_digests()
+        pf_outage = sum(
+            p["ssrc"]._digest.publish_failures
+            for p in h.servers.values())
+        # tempted ceiling drain while disconnected -> frozen, DEGRADED
+        pol.max_servers = len(h.servers) - 1
+        ctrl1.tick()
+        # wait out the observatory TTL: full blindness, where even the
+        # floor-spawn impulse of an (apparently) empty fleet is frozen
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            snap = obs.snapshot()
+            if not [r for r in snap.get("servers", ())
+                    if not r.get("stale")]:
+                break
+            time.sleep(0.05)
+        pol.min_servers = 1
+        ctrl1.tick()
+        frozen_outage = ctrl1.state.frozen - frozen0
+        frozen_reasons = dict(ctrl1.state.frozen_by_reason)
+        blind_level = ctrl1.plane.level
+        pol.max_servers = len(h.servers)  # disarm before the heal
+        # streams decoded through the whole outage: dataplane untouched
+        for c in clients:
+            c.settle(timeout=120.0)
+
+        h.revive_broker()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if (obs.plane_connected and all(
+                    p["ssrc"]._announcement.reannounces >= 1
+                    and p["ssrc"]._announcement.connected
+                    for p in h.servers.values())):
+                break
+            time.sleep(0.05)
+        reannounces = {
+            idx: p["ssrc"]._announcement.reannounces
+            for idx, p in h.servers.items()}
+        reconnects = {
+            idx: p["ssrc"]._announcement.reconnects
+            for idx, p in h.servers.items()}
+        h.publish_digests()
+        h.observatory_settled()
+        cc_outage = h.observatory_crosscheck()
+
+        # -- phase 3: partition one server, freeze, heal, then drain -----
+        victim = max(h.servers)
+        victim_topic = h.servers[victim]["ssrc"]._announcement.topic
+        frozen1 = ctrl1.state.frozen
+        h.partition_server(victim)
+        # second wave lands WHILE the victim is invisible — it must
+        # keep serving (clients still hold its direct TCP endpoint)
+        for c in clients:
+            c.push_prompt()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            rows = {r["topic"]: r for r in obs.servers()}
+            row = rows.get(victim_topic)
+            if row is None or row.get("stale"):
+                break
+            h.publish_digests()
+            time.sleep(0.05)
+        # tempt a ceiling drain BELOW the visible coverage (2 fresh
+        # rows): without the ladder this would shrink a fleet the
+        # controller can only half see
+        pol.max_servers = 1
+        tick_ctrl(ctrl1)
+        frozen_partition = ctrl1.state.frozen - frozen1
+        partition_reasons = dict(ctrl1.state.frozen_by_reason)
+        drains_during_partition = len(act1.drains)
+        for c in clients:
+            c.settle(timeout=120.0)
+        h.heal_server(victim)
+        pol.max_servers = len(h.servers) - 1  # the legit envelope drain
+        h.publish_digests()
+        h.observatory_settled()
+        cc_heal = h.observatory_crosscheck()
+        # the envelope drain may now actually run — carrying epoch 1
+        deadline = time.monotonic() + 20.0
+        acts = []
+        while time.monotonic() < deadline:
+            acts = tick_ctrl(ctrl1)
+            if any(a.kind == "scale_down" for a in acts):
+                break
+            time.sleep(0.05)
+        deadline = time.monotonic() + 30.0
+        while len(h.servers) > pol.max_servers and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        tick_ctrl(ctrl1)  # reap the drain ticket
+        drain_rec = act1.drains[0] if act1.drains else {}
+
+        # -- phase 4: depose the leader; fenced takeover -----------------
+        h.sever_client(chan1._client, h.blackhole_port())
+        deadline = time.monotonic() + 3.0 * lease_ttl + 10.0
+        while (not (lease2.held and lease1.self_fences >= 1)
+               and time.monotonic() < deadline):
+            ctrl1.tick()  # self-fences once renewals go unconfirmed
+            ctrl2.tick()  # promotes after the seen lease expires
+            time.sleep(0.05)
+        epoch2 = lease2.epoch
+        # the new leader actuates a fenced resize; the OLD epoch's
+        # command is then refused by the target, width untouched
+        tgt = min(h.servers)
+        gen = h.servers[tgt]["gen"]
+        slots0 = int(h.server_gen_row(h.servers[tgt]).get("gen_slots", 0))
+        gen.request_resize(slots0 + 2, epoch=epoch2)
+        deadline = time.monotonic() + 15.0
+        while gen.resize_pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stale_rejected = False
+        try:
+            gen.request_resize(slots0, epoch=epoch1)
+        except StaleEpochError:
+            stale_rejected = True
+        tgt_row = h.server_gen_row(h.servers[tgt])
+
+        # -- verdict ------------------------------------------------------
+        for c in clients:
+            c.finish()
+        checks = [c.check_exact() for c in clients]
+        exact = sum(r["exact"] for r in checks)
+        mismatched = sum(r["mismatched"] for r in checks)
+        total = 2 * len(clients)  # two prompts per client
+        h.publish_digests()
+        h.observatory_settled()
+        cc_final = h.observatory_crosscheck()
+        v = {
+            "streams": total,
+            "exact": exact,
+            "mismatched": mismatched,
+            "tokens": sum(r["tokens"] for r in checks),
+            "seed": seed,
+            "kill_point": t_kill,
+            "election": {
+                "leader": lease1.owner, "epoch1": epoch1,
+                "standby_refusals": lease2.refusals,
+                "standby_ticks": ctrl2.standby_ticks,
+            },
+            "broker_outage": {
+                "plane_lost_sensed": plane_lost_sensed,
+                "digest_publish_failures": pf_outage,
+                "frozen": frozen_outage,
+                "frozen_reasons": frozen_reasons,
+                "blind_level": blind_level,
+                "reconnects": reconnects,
+                "reannounces": reannounces,
+                "crosscheck_exact": cc_outage["exact"],
+            },
+            "partition": {
+                "victim": victim_topic,
+                "frozen": frozen_partition,
+                "frozen_reasons": partition_reasons,
+                "drains_while_invisible": drains_during_partition,
+                "crosscheck_after_heal": cc_heal["exact"],
+            },
+            "scale_down": {
+                "target": drain_rec.get("target"),
+                "dropped": drain_rec.get("dropped"),
+                "drain_complete": drain_rec.get("drain_complete"),
+                "epochs": [e["epoch"] for e in act1.events],
+            },
+            "fencing": {
+                "epoch2": epoch2,
+                "steals": lease2.steals,
+                "self_fences": lease1.self_fences,
+                "stale_reject": stale_rejected,
+                "gen_stale_epoch_rejects": int(
+                    tgt_row.get("gen_stale_epoch_rejects", 0)),
+                "slots_after": int(tgt_row.get("gen_slots", 0)),
+            },
+            "standby_actions": len(act2.events),
+            "crosscheck_final": cc_final["exact"],
+            "breaker_trips": h.breaker_trips(),
+        }
+        v["ok"] = bool(
+            mismatched == 0 and exact == total
+            and epoch1 == 1 and epoch2 == 2
+            and lease2.refusals >= 1
+            and lease1.self_fences == 1 and lease2.steals == 1
+            and plane_lost_sensed
+            and pf_outage >= 1
+            and frozen_outage >= 2
+            and "broker_disconnected" in frozen_reasons
+            and "no_fresh_rows" in frozen_reasons
+            and blind_level == "blind"
+            and all(n >= 1 for n in reannounces.values())
+            and cc_outage["exact"]
+            and frozen_partition >= 1
+            and "below_quorum" in partition_reasons
+            and drains_during_partition == 0
+            and cc_heal["exact"]
+            and drain_rec.get("dropped", 1) == 0
+            and drain_rec.get("drain_complete") is True
+            and all(e == epoch1 for e in v["scale_down"]["epochs"])
+            and stale_rejected
+            and v["fencing"]["gen_stale_epoch_rejects"] == 1
+            and v["fencing"]["slots_after"] == slots0 + 2
+            and len(act2.events) == 0
+            and cc_final["exact"]
+        )
+        return v
+    finally:
+        for chan in (chan1, chan2):
+            if chan is not None:
+                try:
+                    chan.close()
+                except Exception:  # allow-silent: teardown best-effort
+                    pass
+        h.stop_all()
+
+
 def main() -> int:
     import argparse
 
@@ -1590,7 +2018,8 @@ def main() -> int:
                     help="distinct affinity sessions")
     ap.add_argument("--mode",
                     choices=("unary", "generate", "generate-resume",
-                             "device-loss", "observatory", "autoscale"),
+                             "device-loss", "observatory", "autoscale",
+                             "partition"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
                     "generation-stream fleet (continuous batching), "
@@ -1606,7 +2035,11 @@ def main() -> int:
                     "autoscale chaos: a live FleetController closes the "
                     "loop — load ramp + hot-tenant burst drive scale-up, "
                     "an envelope shrink forces a zero-loss scale-down "
-                    "under live load (streams migrate bit-identically)")
+                    "under live load (streams migrate bit-identically), "
+                    "or the partition chaos: broker death/restart "
+                    "mid-load, a partitioned server subset, and two "
+                    "leased controllers — fail-static freezes, fenced "
+                    "takeover, exact stale-epoch rejects")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -1629,6 +2062,10 @@ def main() -> int:
             max(2, min(args.servers, 4)), max(2, args.streams))
     elif args.mode == "autoscale":
         verdict = run_autoscale_script(1, max(2, args.streams))
+    elif args.mode == "partition":
+        verdict = run_partition_script(
+            max(2, min(args.servers, 4)), max(2, min(args.streams, 8)),
+            args.seed)
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))

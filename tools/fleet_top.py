@@ -73,6 +73,26 @@ def render(snapshot: Dict[str, Any], topic: str) -> str:
         f"totals (retired incl.): tokens {roll['tokens']}  admitted "
         f"{roll['admitted']}  shed {roll['shed']}",
     ]
+    # control plane: is the view itself trustworthy?  Broker link state
+    # + ingest age come from the observatory rollup; lease/freeze state
+    # rides the autoscale block when a controller owns this snapshot.
+    plane = ("up" if roll.get("plane_connected", 1) else "DOWN")
+    cp = (f"control plane: broker {plane}  last ingest "
+          f"{roll.get('plane_ingest_age_s', 0.0):.1f}s ago  reconnects "
+          f"{roll.get('plane_reconnects', 0)}")
+    a = snapshot.get("autoscale") or {}
+    if a:
+        lease = a.get("lease")
+        if lease:
+            held = "leader" if lease.get("held") else "standby"
+            cp += (f"  lease {lease.get('owner', '?')} "
+                   f"epoch {lease.get('epoch', 0)} ({held})")
+        level = a.get("plane_level", "ok")
+        if level != "ok" or a.get("frozen", 0):
+            reasons = ",".join(a.get("plane_reasons", [])) or "-"
+            cp += (f"  [{level.upper()}: {reasons}  frozen "
+                   f"{a.get('frozen', 0)}]")
+    lines.append(cp)
     if roll.get("tenants"):
         parts = [
             f"{t or '<unnamed>'}: {r['admitted']}/{r['shed']}"
