@@ -61,6 +61,67 @@ def prompt_digest(prompt) -> str:
     return h.hexdigest()
 
 
+#: default shared-prefix chunk grain (tokens).  Chosen as a multiple of
+#: the slot engine's default ``prefill_chunk`` (32): a warm attach must
+#: leave the REMAINING prompt on the exact chunk grid a cold run would
+#: have used, or XLA program identity (and thus bit-exactness) breaks.
+#: Servers round their configured grain UP to a prefill_chunk multiple;
+#: clients only need a consistent value to compute the same route key.
+PREFIX_GRAIN = 64
+
+
+def prefix_digests(prompt, grain: int) -> list:
+    """Chain digests at every FULL ``grain``-token boundary of a
+    normalized (1, Tp) int32 prompt: ``d_0 = H(g, 0, chunk_0)``,
+    ``d_i = H(d_{i-1}, g, i, chunk_i)``.
+
+    Each digest identifies its chunk AND the chunk's entire left
+    context — KV pages for positions ``[i*g, (i+1)*g)`` depend on every
+    token before them, so a flat per-chunk hash would alias pages from
+    different prefixes.  The trailing partial chunk (and the final
+    token, which must always be prefilled to produce first-token
+    logits) gets no digest."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+    a = a.reshape(1, -1)
+    g = max(1, int(grain))
+    out = []
+    prev = b""
+    for i in range(int(a.shape[1]) // g):
+        h = hashlib.sha1()
+        h.update(prev)
+        h.update(f"|g={g}|i={i}|".encode())
+        h.update(a[:, i * g:(i + 1) * g].tobytes())
+        d = h.hexdigest()
+        out.append(d)
+        prev = d.encode()
+    return out
+
+
+def prefix_route_key(prompt, grain: int = PREFIX_GRAIN,
+                     declared: int = 0) -> str:
+    """Fleet routing key for ``affinity-key=prefix``: the chain digest of
+    the prompt's shared-prefix region, so every prompt sharing that
+    prefix rendezvous-hashes (``core/routing.py``) to the SAME server
+    and the prefix cache actually hits at fleet scale.
+
+    ``declared`` is the client-declared prefix length in tokens (0 =
+    undeclared: assume the first grain is the shared region).  Prompts
+    shorter than one grain fall back to the whole-prompt digest — they
+    can never share cached pages, so spreading them is correct."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(prompt, dtype=np.int32))
+    a = a.reshape(1, -1)
+    g = max(1, int(grain))
+    n = int(declared) if declared else g
+    k = min(max(0, n), int(a.shape[1])) // g
+    if k <= 0:
+        return prompt_digest(a)
+    return prefix_digests(a[:, :k * g], g)[-1]
+
+
 def resume_signature(kind: str, **cfg: Any) -> str:
     """Opaque signature of everything that determines the TOKEN sequence
     (model family + params seed + sampling rule + generation length).

@@ -147,6 +147,35 @@ def pipeline_digest_stats(pipe) -> Dict[str, Any]:
     if slo_burn:
         stats["slo_burn"] = {
             t: round(float(b), 3) for t, b in slo_burn.items()}
+    # shared-prefix cache advert: per-server hit/miss counters plus a
+    # bounded MRU list of hot prefix digests, so peers (and the
+    # observatory rollup) can see WHERE a shared prefix is already warm.
+    # Duck-typed off the elements — only armed slotted generators grow a
+    # prefix_digest_info(); everything else is silently skipped.
+    pfx = {"hits": 0, "misses": 0, "entries": 0}
+    pfx_hot: List[str] = []
+    have_pfx = False
+    for el in getattr(pipe, "elements", {}).values():
+        info_fn = getattr(el, "prefix_digest_info", None)
+        if info_fn is None:
+            continue
+        try:
+            info = info_fn()
+        except Exception:
+            log.exception("prefix digest scan failed for %s",
+                          getattr(el, "name", el))
+            continue
+        if not isinstance(info, dict):
+            continue
+        have_pfx = True
+        for k in ("hits", "misses", "entries"):
+            pfx[k] += int(info.get(k, 0) or 0)
+        for d in info.get("hot", ()):
+            if d not in pfx_hot:
+                pfx_hot.append(d)
+    if have_pfx:
+        pfx["hot"] = pfx_hot[:8]
+        stats["prefix"] = pfx
     mon = getattr(pipe, "memory_monitor", None)
     if mon is not None:
         snap = mon.snapshot()
@@ -265,6 +294,17 @@ class DigestPublisher:
         slo_burn = stats.get("slo_burn")
         if slo_burn:
             digest["slo_burn"] = dict(slo_burn)
+        # shared-prefix cache advert (armed slotted generators only):
+        # exact hit/miss counters for the fleet rollup plus the bounded
+        # hot-digest list peers use to find warm prefixes
+        pfx = stats.get("prefix")
+        if isinstance(pfx, dict):
+            digest["prefix"] = {
+                "hits": int(pfx.get("hits", 0) or 0),
+                "misses": int(pfx.get("misses", 0) or 0),
+                "entries": int(pfx.get("entries", 0) or 0),
+                "hot": [str(d) for d in pfx.get("hot", ())][:8],
+            }
         # size bound: the announce is a control-plane message — an
         # oversized digest drops its per-tenant maps LOUDLY rather than
         # growing without bound (rollups then under-report those maps,
@@ -272,6 +312,7 @@ class DigestPublisher:
         if len(json.dumps(digest)) > DIGEST_MAX_BYTES:
             digest.pop("tenants", None)
             digest.pop("slo_burn", None)
+            digest.pop("prefix", None)
             digest["truncated"] = True
         return digest
 
@@ -366,6 +407,8 @@ class FleetObservatory:
         self._retired_tokens = 0
         self._retired_admitted = 0
         self._retired_shed = 0
+        self._retired_prefix_hits = 0
+        self._retired_prefix_misses = 0
         self._retired_tenants: Dict[str, Dict[str, int]] = {}
         from collections import OrderedDict
 
@@ -534,10 +577,13 @@ class FleetObservatory:
     def _retire_locked(self, row: _ServerRow, stale: bool,
                        pop: bool = True) -> None:
         d = row.digest
+        pfx = d.get("prefix") or {}
         contrib = {
             "tokens": int(d.get("tokens", 0) or 0),
             "admitted": int(d.get("admitted", 0) or 0),
             "shed": int(d.get("shed", 0) or 0),
+            "prefix_hits": int(pfx.get("hits", 0) or 0),
+            "prefix_misses": int(pfx.get("misses", 0) or 0),
             "tenants": {
                 t: {"admitted": int(r.get("admitted", 0)),
                     "shed": int(r.get("shed", 0))}
@@ -547,6 +593,8 @@ class FleetObservatory:
         self._retired_tokens += contrib["tokens"]
         self._retired_admitted += contrib["admitted"]
         self._retired_shed += contrib["shed"]
+        self._retired_prefix_hits += contrib["prefix_hits"]
+        self._retired_prefix_misses += contrib["prefix_misses"]
         for t, r in contrib["tenants"].items():
             agg = self._retired_tenants.setdefault(
                 t, {"admitted": 0, "shed": 0})
@@ -577,6 +625,8 @@ class FleetObservatory:
         self._retired_tokens -= contrib["tokens"]
         self._retired_admitted -= contrib["admitted"]
         self._retired_shed -= contrib["shed"]
+        self._retired_prefix_hits -= int(contrib.get("prefix_hits", 0))
+        self._retired_prefix_misses -= int(contrib.get("prefix_misses", 0))
         for t, r in contrib["tenants"].items():
             agg = self._retired_tenants.get(t)
             if agg is None:
@@ -662,6 +712,9 @@ class FleetObservatory:
                 "tokens": self._retired_tokens,
                 "admitted": self._retired_admitted,
                 "shed": self._retired_shed,
+                "prefix_hits": self._retired_prefix_hits,
+                "prefix_misses": self._retired_prefix_misses,
+                "prefix_entries": 0,
                 "digests": self.digests,
                 "retired": self.retired,
                 "stale_evicted": self.stale_evicted,
@@ -714,6 +767,10 @@ class FleetObservatory:
                 roll["tokens"] += int(d.get("tokens", 0) or 0)
                 roll["admitted"] += int(d.get("admitted", 0) or 0)
                 roll["shed"] += int(d.get("shed", 0) or 0)
+                pfx = d.get("prefix") or {}
+                roll["prefix_hits"] += int(pfx.get("hits", 0) or 0)
+                roll["prefix_misses"] += int(pfx.get("misses", 0) or 0)
+                roll["prefix_entries"] += int(pfx.get("entries", 0) or 0)
                 for t, trow in (d.get("tenants") or {}).items():
                     agg = tenants.setdefault(t, {"admitted": 0, "shed": 0})
                     agg["admitted"] += int(trow.get("admitted", 0))
@@ -722,6 +779,9 @@ class FleetObservatory:
                     slo_burn[t] = max(slo_burn.get(t, 0.0), float(b))
             roll["occupancy"] = round(
                 roll["occupied"] / roll["slots"], 4) if roll["slots"] else 0.0
+            lookups = roll["prefix_hits"] + roll["prefix_misses"]
+            roll["prefix_hit_ratio"] = round(
+                roll["prefix_hits"] / lookups, 4) if lookups else 0.0
             roll["tokens_per_s"] = round(roll["tokens_per_s"], 3)
             roll["tenants"] = tenants
             roll["slo_burn"] = {
@@ -752,6 +812,10 @@ class FleetObservatory:
         ("tokens", "nns.fleet.tokens"),
         ("admitted", "nns.fleet.admitted"),
         ("shed", "nns.fleet.shed"),
+        ("prefix_hits", "nns.fleet.prefix_hits"),
+        ("prefix_misses", "nns.fleet.prefix_misses"),
+        ("prefix_hit_ratio", "nns.fleet.prefix_hit_ratio"),
+        ("prefix_entries", "nns.fleet.prefix_entries"),
         ("digests", "nns.fleet.digests"),
         ("retired", "nns.fleet.retired"),
         ("stale_evicted", "nns.fleet.stale_evicted"),

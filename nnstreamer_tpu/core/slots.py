@@ -43,7 +43,9 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from .continuity import GOAWAY_META, RESUME_META, prompt_digest
+from .continuity import (
+    GOAWAY_META, PREFIX_GRAIN, RESUME_META, prefix_digests, prompt_digest,
+)
 from .liveness import ThreadBeat
 from .log import get_logger
 from .resilience import DeviceLostError, DeviceOomError, device_call
@@ -72,6 +74,206 @@ def lru_bucket(lru: "OrderedDict", key, build, cap: int):
     return fn
 
 
+class PrefixEntry:
+    """One published grain chunk of a shared prefix: the immutable page
+    blob (a COPY — never a view into a live slot) for prompt positions
+    ``[index*grain, (index+1)*grain)``, keyed by its chain digest, plus
+    the refcount that fences reclamation."""
+
+    __slots__ = (
+        "digest", "index", "pages", "tokens", "nbytes", "refs",
+        "last_used",
+    )
+
+    def __init__(self, digest: str, index: int, pages, tokens: int,
+                 nbytes: int, now: float):
+        self.digest = digest
+        self.index = int(index)
+        self.pages = pages          # model-opaque blob (attach interprets)
+        self.tokens = int(tokens)
+        self.nbytes = int(nbytes)
+        self.refs = 0
+        self.last_used = now
+
+
+class PrefixCache:
+    """Refcounted shared-prefix page pool (ROADMAP item 4): the KV bytes
+    the dominant traffic shape (long shared system prompt + short user
+    suffix) keeps recomputing, published ONCE and attached by every
+    later stream.
+
+    * keyed by chunk-grain CHAIN digests
+      (:func:`~.continuity.prefix_digests`): entry *i* is valid only
+      under the exact prefix that produced chunks ``0..i-1``, so pages
+      from different prefixes can never alias;
+    * **publish** stores copies exported at the grain boundary by the
+      prefilling stream (the slot keeps its private pages — eviction of
+      a published entry never touches a live slot);
+    * **acquire** pins (``refs += 1``) the longest run of consecutive
+      cached chunks from index 0; the engine holds the pins for the
+      stream's whole slot occupancy and releases them with the slot, so
+      *a cached page is never reclaimed under a live reader* — eviction
+      (LRU past ``cap_entries``/``cap_bytes``) and :meth:`trim` only
+      ever take ``refs == 0`` entries;
+    * :meth:`trim` is the FIRST rung of the PR-14 ``nns.mem.*``
+      pressure ladder (``Pipeline.enable_memory_monitor``): cached
+      prefixes are pure recomputable capacity — the most reclaimable
+      bytes on the chip.
+
+    Accounting is exact (the fleet observatory cross-checks integer
+    totals): one hit or one miss per ELIGIBLE lookup (a prompt with at
+    least one full grain chunk), one publish per entry stored, one
+    eviction per entry reclaimed, however it left."""
+
+    def __init__(self, grain: int = PREFIX_GRAIN, cap_entries: int = 256,
+                 cap_bytes: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.grain = max(1, int(grain))
+        self.cap_entries = max(1, int(cap_entries))
+        self.cap_bytes = max(0, int(cap_bytes))
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, PrefixEntry]" = OrderedDict()
+        self.bytes = 0
+        # exact counters (lock-held writes, GIL-atomic reads)
+        self.hits = 0
+        self.misses = 0
+        self.publishes = 0
+        self.evictions = 0
+        self.hit_tokens = 0   # prefill tokens skipped via attach
+
+    @staticmethod
+    def _nbytes(pages) -> int:
+        """Byte accounting over a model-opaque page blob (dict/list
+        nesting of array-likes; non-arrays count a nominal 8)."""
+        n = 0
+        stack = [pages]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, dict):
+                stack.extend(x.values())
+            elif isinstance(x, (list, tuple)):
+                stack.extend(x)
+            else:
+                n += int(getattr(x, "nbytes", 8))
+        return n
+
+    def acquire(self, digests: List[str]) -> List[PrefixEntry]:
+        """Pin the longest run of consecutive cached chunks from index
+        0 for the given chain digests.  Counts ONE hit (+`hit_tokens`)
+        when the run is non-empty, else ONE miss.  Callers MUST balance
+        with :meth:`release` exactly once."""
+        with self._lock:
+            run: List[PrefixEntry] = []
+            for i, d in enumerate(digests):
+                e = self._entries.get(d)
+                if e is None or e.index != i:
+                    break
+                run.append(e)
+            if run:
+                now = self.clock()
+                for e in run:
+                    e.refs += 1
+                    e.last_used = now
+                    self._entries.move_to_end(e.digest)
+                self.hits += 1
+                self.hit_tokens += sum(e.tokens for e in run)
+            else:
+                self.misses += 1
+            return run
+
+    def release(self, entries: List[PrefixEntry]) -> None:
+        with self._lock:
+            for e in entries:
+                e.refs = max(0, e.refs - 1)
+
+    def contains(self, digest: str) -> bool:
+        with self._lock:
+            return digest in self._entries
+
+    def publish(self, digest: str, index: int, pages,
+                tokens: int) -> bool:
+        """Store one exported grain chunk.  False (not stored) when the
+        digest is already present or every evictable entry is pinned and
+        the caps leave no room — the publisher loses nothing either way
+        (its slot keeps its private pages)."""
+        nbytes = self._nbytes(pages)
+        with self._lock:
+            if digest in self._entries:
+                return False
+            if not self._make_room_locked(nbytes):
+                return False
+            e = PrefixEntry(
+                digest, index, pages, tokens, nbytes, self.clock())
+            self._entries[digest] = e
+            self.bytes += nbytes
+            self.publishes += 1
+            return True
+
+    def _make_room_locked(self, incoming: int) -> bool:
+        def over() -> bool:
+            return (len(self._entries) + 1 > self.cap_entries
+                    or (self.cap_bytes > 0
+                        and self.bytes + incoming > self.cap_bytes))
+
+        while over():
+            victim = next(
+                (e for e in self._entries.values() if e.refs == 0), None)
+            if victim is None:
+                return False  # everything pinned: refuse, never reclaim
+            self._evict_locked(victim)
+        return True
+
+    def _evict_locked(self, e: PrefixEntry) -> None:
+        del self._entries[e.digest]
+        self.bytes -= e.nbytes
+        self.evictions += 1
+
+    def trim(self) -> int:
+        """Reclaim every COLD (``refs == 0``) entry — the memory
+        pressure ladder's first rung.  Pinned entries survive by
+        construction.  Returns entries freed (the monitor's unit)."""
+        with self._lock:
+            cold = [e for e in self._entries.values() if e.refs == 0]
+            for e in cold:
+                self._evict_locked(e)
+            return len(cold)
+
+    def clear(self) -> int:
+        """Drop EVERYTHING (device-loss remesh: the pages' placements
+        died with the mesh).  Only called after every reader was handed
+        off — any stale pin is force-released with its entry."""
+        with self._lock:
+            n = len(self._entries)
+            self.evictions += n
+            self._entries.clear()
+            self.bytes = 0
+            return n
+
+    def hot_digests(self, k: int = 8) -> List[str]:
+        """Most-recently-used entry digests, truncated for the bounded
+        discovery digest (core/fleet.py advertises them so operators
+        can see WHICH prefixes a server holds)."""
+        with self._lock:
+            es = sorted(
+                self._entries.values(), key=lambda e: -e.last_used)[:k]
+            return [e.digest[:12] for e in es]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "prefix_hits": self.hits,
+                "prefix_misses": self.misses,
+                "prefix_publishes": self.publishes,
+                "prefix_evictions": self.evictions,
+                "prefix_entries": len(self._entries),
+                "prefix_refs": sum(
+                    e.refs for e in self._entries.values()),
+                "prefix_bytes": self.bytes,
+                "prefix_hit_tokens": self.hit_tokens,
+            }
+
+
 class GenStream:
     """One generation stream: a prompt waiting for / occupying a slot.
 
@@ -91,6 +293,11 @@ class GenStream:
         # prefix on a RESUME), the checkpoint to restart decode from,
         # and the per-chunk resume state stamped into emitted meta
         "prefill_src", "resume_tok", "resume_gen", "resume_info",
+        # shared-prefix cache (PrefixCache): the chain digests of this
+        # stream's eligible prefix chunks, the pinned entries it
+        # attached (released with the slot), and the next chunk index
+        # to consider publishing as prefill crosses grain boundaries
+        "prefix_digests", "prefix_entries", "prefix_pub_i",
     )
 
     def __init__(self, sid: int, frame, prompt, max_new: int, chunk: int,
@@ -123,6 +330,9 @@ class GenStream:
         self.resume_tok = 0               # last prefix token (resume only)
         self.resume_gen = 0               # tokens already delivered (resume)
         self.resume_info: Optional[Dict[str, Any]] = None
+        self.prefix_digests: List[str] = []
+        self.prefix_entries: List[Any] = []
+        self.prefix_pub_i = 0
 
     @property
     def finished(self) -> bool:
@@ -198,6 +408,28 @@ class SimSlotModel:
         cache = {"pos": cache["pos"].copy()}
         cache["pos"][int(slot)] = 0
         self._prefill_carry[int(slot)] = 0
+        return cache
+
+    def export_prefix(self, cache, slot, start: int, stop: int):
+        """Sim twin of ``SlotModel.export_prefix``: the oracle's only
+        per-prefix state is the running prompt sum, so a chunk's "pages"
+        are the CUMULATIVE carry at ``stop`` (the engine exports exactly
+        at the grain-boundary moment ``prefill_pos == stop``, where the
+        live carry covers precisely positions ``[0, stop)``)."""
+        del cache, start
+        return {"carry": int(self._prefill_carry.get(int(slot), 0)),
+                "n": int(stop)}
+
+    def attach_prefix(self, cache, slot, pages_list, n: int):
+        """Sim twin of ``SlotModel.attach_prefix``: restore the carry
+        from the LAST chunk (cumulative encoding) and set the slot's
+        position to ``n`` — indistinguishable from a cold prefill paused
+        at ``prefill_pos == n``, so token 1 still covers the whole
+        prompt."""
+        np = self._np
+        cache = {"pos": cache["pos"].copy()}
+        cache["pos"][int(slot)] = np.int64(n)
+        self._prefill_carry[int(slot)] = int(pages_list[-1]["carry"])
         return cache
 
     def prefill_fn(self, n: int):
@@ -292,7 +524,8 @@ class SlotEngine:
                  name: str = "slots",
                  resume_sig: Optional[str] = None,
                  on_device_lost: Optional[Callable[..., Any]] = None,
-                 slo=None):
+                 slo=None,
+                 prefix_cache: Optional[PrefixCache] = None):
         import numpy as np
 
         self._np = np
@@ -307,6 +540,18 @@ class SlotEngine:
         self.jit_bucket_max = int(jit_bucket_max or self.JIT_BUCKET_MAX)
         self.clock = clock
         self.name = name
+        # shared-prefix page pool (None = off: ZERO behavior change —
+        # no digesting, no attach, no publish, no snapshot keys).  The
+        # grain must land on the chunked-prefill grid, or warm and cold
+        # runs would see different chunk boundaries (different XLA
+        # programs / float reduction orders) and bit-exactness breaks.
+        self.prefix = prefix_cache
+        if prefix_cache is not None and (
+                prefix_cache.grain % self.prefill_chunk != 0):
+            raise ValueError(
+                f"prefix grain {prefix_cache.grain} must be a multiple "
+                f"of prefill_chunk {self.prefill_chunk} (bit-exactness "
+                "requires identical prefill chunk boundaries)")
         # stream continuity (core/continuity.py): with a signature armed,
         # every chunk carries resume state in meta, and a drain hands
         # live streams off as resumable GOAWAY final chunks instead of
@@ -406,6 +651,9 @@ class SlotEngine:
                 log.warning(
                     "%s: engine stopped with %d stream(s) abandoned",
                     self.name, abandoned)
+            if self.prefix is not None:
+                for s in self._streams.values():
+                    self._release_prefix(s)
             self._waiting.clear()
             self._streams.clear()
             self._occupants = [None] * self.slots
@@ -565,7 +813,7 @@ class SlotEngine:
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             occupied = sum(1 for s in self._occupants if s is not None)
-            return {
+            snap = {
                 "gen_slots": self.slots,
                 "gen_occupied": occupied,
                 "gen_waiting": len(self._waiting),
@@ -588,6 +836,11 @@ class SlotEngine:
                 "gen_device_lost_evicted": self.device_lost_evicted,
                 "gen_remeshes": self.remeshes,
             }
+        # armed cache only: with the cache off the snapshot is
+        # byte-identical to the pre-prefix engine (zero behavior change)
+        if self.prefix is not None:
+            snap.update(self.prefix.snapshot())
+        return snap
 
     # -- pump internals -----------------------------------------------------
     def _prefill_fn(self, n: int):
@@ -658,9 +911,19 @@ class SlotEngine:
             s, self._take(s, s.pending_n) if s.pending_n else None,
             final=True, extra_meta=extra_meta)
 
+    def _release_prefix(self, s: GenStream) -> None:
+        """Unpin the stream's attached prefix entries (exactly once:
+        the list empties).  The pin spans the WHOLE slot occupancy —
+        that is the refcount contract ("never reclaimed under a live
+        reader"), not merely the attach moment."""
+        if self.prefix is not None and s.prefix_entries:
+            self.prefix.release(s.prefix_entries)
+            s.prefix_entries = []
+
     def _free_slot(self, s: GenStream) -> None:
         """Release the stream's slot (lock held): pages become reusable
         without touching neighbors; the idle mask clears outside."""
+        self._release_prefix(s)
         if s.slot is not None:
             self._occupants[s.slot] = None
         self._streams.pop(s.sid, None)
@@ -832,6 +1095,15 @@ class SlotEngine:
         if clear_jit_lrus:
             self._prefill_lru.clear()
             self._decode_lru.clear()
+            # a REPLACEMENT model invalidates published pages too (their
+            # device placements died with the mesh); every reader was
+            # handed off above, so nothing is pinned
+            if self.prefix is not None:
+                dropped = self.prefix.clear()
+                if dropped:
+                    log.warning(
+                        "%s: dropped %d cached prefix entr(ies) with "
+                        "the replaced model", self.name, dropped)
 
     def _handle_device_lost(self, err: DeviceLostError) -> None:
         """A mesh member died under the batch: hand EVERY live stream
@@ -1046,6 +1318,59 @@ class SlotEngine:
                     else:
                         self._emit_boundary(s)
 
+    # -- shared-prefix cache (attach on join, publish at boundaries) --------
+    def _attach_prefix(self, s: GenStream, slot: int) -> None:
+        """First-touch lookup (pump thread, right after the slot reset):
+        digest the prefill source at grain boundaries, pin the longest
+        cached run, and write its pages into the slot — prefill then
+        starts at the first uncached token instead of token 0.
+
+        The attach is capped at ``tp - 1`` chunks' worth so at least the
+        final prompt token always prefills (its logits feed the
+        unchanged token-1 pick).  RESUME joins share the path: their
+        ``prefill_src`` starts with the same prompt bytes, so a resumed
+        stream landing on a warm server skips the prefix too — and on a
+        cache-COLD server simply prefills everything, bit-identically
+        (the cache changes WHERE prefill starts, never what any chunk
+        computes)."""
+        pc = self.prefix
+        tp = int(s.prefill_src.shape[1])
+        max_chunks = (tp - 1) // pc.grain
+        if max_chunks <= 0:
+            return  # too short to share: neither a hit nor a miss
+        s.prefix_digests = prefix_digests(
+            s.prefill_src, pc.grain)[:max_chunks]
+        entries = pc.acquire(s.prefix_digests)
+        s.prefix_pub_i = len(entries)
+        if not entries:
+            return
+        n = sum(e.tokens for e in entries)
+        self._cache = self.model.attach_prefix(
+            self._cache, slot, [e.pages for e in entries], n)
+        s.prefix_entries = entries
+        s.prefill_pos = n
+
+    def _publish_prefix(self, s: GenStream, slot: int) -> None:
+        """After each prefill chunk: when ``prefill_pos`` lands exactly
+        on the next unpublished grain boundary, export that chunk's
+        pages (a copy — donation-safe) and publish them under its chain
+        digest.  The boundary moment is guaranteed to occur for every
+        eligible chunk because the grain is a prefill_chunk multiple
+        (and the sim twin's cumulative carry is only correct AT the
+        boundary)."""
+        pc = self.prefix
+        g = pc.grain
+        while s.prefix_pub_i < len(s.prefix_digests):
+            i = s.prefix_pub_i
+            if (i + 1) * g != s.prefill_pos:
+                return  # boundary not (yet) reached this chunk
+            d = s.prefix_digests[i]
+            if not pc.contains(d):
+                pages = self.model.export_prefix(
+                    self._cache, slot, i * g, (i + 1) * g)
+                pc.publish(d, i, pages, g)
+            s.prefix_pub_i += 1
+
     def _prefill_one(self, s: GenStream) -> None:
         """One chunked-prefill step for a joining stream: reset pages on
         first touch, run one chunk, pick token 1 when the prompt is
@@ -1062,6 +1387,14 @@ class SlotEngine:
         slot = np.int32(s.slot)
         if s.prefill_pos == 0:
             self._cache = self.model.reset_slot(self._cache, slot)
+            if self.prefix is not None:
+                self._attach_prefix(s, int(s.slot))
+                if s.prefill_pos >= s.prefill_src.shape[1]:
+                    # defensive: attach is capped at tp-1, so the final
+                    # prompt token (whose logits pick token 1) always
+                    # prefills — this branch is unreachable by design
+                    raise AssertionError(
+                        "prefix attach covered the whole prompt")
         tp = s.prefill_src.shape[1]
         n = min(self.prefill_chunk, tp - s.prefill_pos)
         toks = s.prefill_src[:, s.prefill_pos:s.prefill_pos + n].astype(
@@ -1069,6 +1402,8 @@ class SlotEngine:
         self._cache, logits = self._device_step(
             self._prefill_fn(n), self.params, self._cache, toks, slot)
         s.prefill_pos += n
+        if self.prefix is not None:
+            self._publish_prefix(s, int(s.slot))
         with self._lock:
             self.prefill_chunks += 1
         if s.prefill_pos < tp:

@@ -211,6 +211,20 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.gen.resume_rejects": ("counter", "RESUME requests refused (signature/digest/shape mismatch)"),
     "nns.gen.resizes": ("counter", "zero-loss slot-width rebuilds (autoscale resize actuation)"),
 
+    # -- shared-prefix KV cache (core/slots.py PrefixCache) ----------------
+    "nns.prefix.hits": ("counter", "eligible prompts that attached cached prefix pages"),
+    "nns.prefix.misses": ("counter", "eligible prompts that found no cached prefix chunk"),
+    "nns.prefix.publishes": ("counter", "prefix grain chunks published for reuse"),
+    "nns.prefix.evictions": ("counter", "cached prefix entries reclaimed (LRU cap, trim, or remesh)"),
+    "nns.prefix.entries": ("gauge", "live cached prefix entries"),
+    "nns.prefix.refs": ("gauge", "pins held by live reader streams (refcounted entries)"),
+    "nns.prefix.bytes": ("gauge", "bytes held by the shared-prefix page pool"),
+    "nns.prefix.hit_tokens": ("counter", "prefill tokens skipped via prefix attach"),
+    "nns.fleet.prefix_hits": ("counter", "prefix-cache hits fleet-wide (retired servers included)"),
+    "nns.fleet.prefix_misses": ("counter", "prefix-cache misses fleet-wide (retired servers included)"),
+    "nns.fleet.prefix_hit_ratio": ("gauge", "fleet prefix-cache hit ratio (hits / eligible lookups)"),
+    "nns.fleet.prefix_entries": ("gauge", "cached prefix entries fleet-wide (live servers)"),
+
     # -- mesh-sharded serving (backends/jax_xla.py mesh= prop) -------------
     "nns.mesh.devices": ("gauge", "devices in the filter's serving mesh (0 = unsharded)"),
     "nns.mesh.dp": ("gauge", "data-parallel axis size of the serving mesh"),
@@ -410,6 +424,15 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "gen_goaway_evicted": "nns.gen.goaway_evicted",
     "gen_resume_rejects": "nns.gen.resume_rejects",
     "gen_resizes": "nns.gen.resizes",
+    # shared-prefix KV cache (engine.snapshot carries these only when armed)
+    "prefix_hits": "nns.prefix.hits",
+    "prefix_misses": "nns.prefix.misses",
+    "prefix_publishes": "nns.prefix.publishes",
+    "prefix_evictions": "nns.prefix.evictions",
+    "prefix_entries": "nns.prefix.entries",
+    "prefix_refs": "nns.prefix.refs",
+    "prefix_bytes": "nns.prefix.bytes",
+    "prefix_hit_tokens": "nns.prefix.hit_tokens",
     "mesh_devices": "nns.mesh.devices",
     "mesh_dp": "nns.mesh.dp",
     "mesh_tp": "nns.mesh.tp",

@@ -133,6 +133,28 @@ class TensorGenerator(Element):
             str, "",
             "decode the slot batch tensor-parallel across a device mesh: "
             "'tp:N' (slots >= 1 required; empty = unsharded)"),
+        # shared-prefix KV cache (core/slots.py PrefixCache): prompts
+        # sharing a long common prefix (system prompt / few-shot header)
+        # attach refcounted published pages instead of re-prefilling
+        # them — the TTFT collapse for the dominant traffic shape.
+        # OFF by default: zero behavior change until armed.
+        "prefix-cache": Property(
+            str, "off",
+            "shared-prefix KV page pool: 'on' publishes each prompt's "
+            "prefix pages at grain boundaries and attaches them to later "
+            "prompts sharing the prefix, skipping their prefill entirely "
+            "(slots >= 1; warm streams stay bit-identical to cold "
+            "prefill; 'off' = the pre-cache path, byte-identical "
+            "behavior)"),
+        "prefix-grain": Property(
+            int, 0,
+            "prefix chunk grain in tokens (0 = the wire default, 64); "
+            "rounded UP to a prefill-chunk multiple so warm and cold "
+            "runs share the exact prefill chunk grid (bit-exactness)"),
+        "prefix-cap": Property(
+            int, 256,
+            "max cached prefix entries (LRU among unreferenced entries "
+            "past the cap; pinned entries are never reclaimed)"),
     }
 
     def __init__(self, name=None):
@@ -156,6 +178,7 @@ class TensorGenerator(Element):
         self._zoo_props = {}      # parsed custom dialect (rebuild hook)
         self._slots = 0
         self._sim = False
+        self._prefix_pool = None  # PrefixCache (prefix-cache=on, slotted)
         self._slo = None          # SloTracker (slo-* props; slotted only)
         # autoscale resize actuation (core/autoscale.py): the requested
         # slot width, applied on the DISPATCH thread at the next idle
@@ -287,6 +310,7 @@ class TensorGenerator(Element):
             self._slots = slots
             self._sim = sim
             self._slo = self._build_slo()
+            self._prefix_pool = self._build_prefix_pool()
             self._engine = SlotEngine(
                 model, params,
                 max_seq=self._max_seq,
@@ -298,9 +322,14 @@ class TensorGenerator(Element):
                 resume_sig=self._resume_sig,
                 on_device_lost=self._rebuild_on_device_loss,
                 slo=self._slo,
+                prefix_cache=self._prefix_pool,
             )
             self._engine.start()
             return
+        if self.props["prefix-cache"] == "on":
+            raise ElementError(
+                f"{self.name}: prefix-cache=on needs slots >= 1 (the "
+                "pool lives in the slot engine)")
         if props.get("sim", "") not in ("", "0", "false"):
             raise ElementError(
                 f"{self.name}: custom sim: needs slots >= 1 (the sim "
@@ -316,6 +345,7 @@ class TensorGenerator(Element):
         if self._engine is not None:
             self._engine.stop()
             self._engine = None
+        self._prefix_pool = None  # restart is deliberately cache-cold
         self._prefill = self._decode = self._params = None
         self._jit_chunks.clear()
 
@@ -353,6 +383,57 @@ class TensorGenerator(Element):
         except ValueError as e:
             raise ElementError(f"{self.name}: {e}") from None
         return tracker if tracker.armed else None
+
+    def _build_prefix_pool(self):
+        """PrefixCache from the prefix-* props (None = off: the engine
+        takes the byte-identical pre-cache path).  The grain rounds UP
+        to a prefill-chunk multiple — warm and cold runs must share the
+        exact prefill chunk grid or bit-exactness breaks.  A fresh pool
+        per start(): a supervision restart is deliberately CACHE-COLD
+        (streams migrated here still resume bit-exactly; they just pay
+        one cold prefill)."""
+        mode = self.props["prefix-cache"]
+        if mode not in ("off", "on"):
+            raise ElementError(
+                f"{self.name}: prefix-cache={mode!r} — want off|on")
+        if mode != "on":
+            return None
+        from ..core.continuity import PREFIX_GRAIN
+        from ..core.slots import PrefixCache
+
+        pchunk = max(1, int(self.props["prefill-chunk"]))
+        grain = int(self.props["prefix-grain"]) or PREFIX_GRAIN
+        grain = ((max(1, grain) + pchunk - 1) // pchunk) * pchunk
+        cap = int(self.props["prefix-cap"])
+        if cap < 1:
+            raise ElementError(
+                f"{self.name}: prefix-cap must be >= 1, got {cap}")
+        return PrefixCache(grain=grain, cap_entries=cap)
+
+    def trim_prefix_cache(self) -> int:
+        """Memory-pressure trim hook (``Pipeline.enable_memory_monitor``
+        runs it FIRST in the ladder): drop every unreferenced cached
+        prefix — recomputable capacity is the cheapest relief on the
+        chip.  Returns entries freed."""
+        pool = self._prefix_pool
+        return pool.trim() if pool is not None else 0
+
+    def prefix_digest_info(self) -> Optional[Dict[str, Any]]:
+        """Bounded cached-prefix advertisement for the discovery digest
+        (core/fleet.py): exact hit/miss counters for the observatory's
+        fleet rollup plus the hottest entry digests, so routing
+        dashboards can see WHICH prefixes this server holds.  None when
+        the cache is off (the digest then carries no prefix block)."""
+        pool = self._prefix_pool
+        if pool is None:
+            return None
+        snap = pool.snapshot()
+        return {
+            "hits": snap["prefix_hits"],
+            "misses": snap["prefix_misses"],
+            "entries": snap["prefix_entries"],
+            "hot": pool.hot_digests(),
+        }
 
     # -- observability ------------------------------------------------------
     def health_info(self) -> Dict[str, Any]:
@@ -537,6 +618,11 @@ class TensorGenerator(Element):
             resume_sig=self._resume_sig,
             on_device_lost=self._rebuild_on_device_loss,
             slo=self._slo,
+            # the pool survives a width resize: published pages are
+            # (1, n, ...) slot-width-independent blobs from the SAME
+            # params, and its counters must stay monotonic for the
+            # observatory's exact fleet totals
+            prefix_cache=self._prefix_pool,
         )
         # the server's lifetime ledger survives the rebuild — digests
         # and the observatory's exact fleet totals must stay monotonic

@@ -36,6 +36,7 @@ from ..core.liveness import (
     deadline_remaining,
     parse_tenant_quotas,
 )
+from ..core.continuity import prefix_route_key
 from ..core.routing import (
     TIER_DEGRADED,
     TIER_DOWN,
@@ -727,6 +728,13 @@ class TensorQueryClient(Element):
             "current servers (stateful generation streams stay on one "
             "host; fleet resize remaps the provable minimum of keys).  "
             "Failover still applies when the owner is unhealthy.  "
+            "The special value 'prefix' routes by the prompt's "
+            "grain-aligned prefix digest (core/continuity.py "
+            "prefix_route_key) when the meta carries no literal "
+            "'prefix' key, so clients sharing a prompt prefix land on "
+            "the server whose shared-prefix KV cache is already warm "
+            "(generator prefix-cache=on); a frame meta 'prefix_tokens' "
+            "int declares how many leading tokens are shared.  "
             "Empty = no affinity"),
         # per-tenant admission (server side pairs these with
         # tenant-quota/tenant-quotas on the serversrc)
@@ -1454,11 +1462,34 @@ class TensorQueryClient(Element):
                   else frame_or_batch)
             meta = getattr(f0, "meta", None)
             val = meta.get(akey) if meta is not None else None
+            if val is None and akey == "prefix":
+                # prefix affinity: derive the route key from the prompt
+                # tensor itself (wire-default grain — client and server
+                # must agree with no negotiation channel), so every
+                # client sharing a prompt prefix lands on the one
+                # rendezvous owner whose prefix KV pages are warm
+                val = self._prefix_affinity_key(f0, meta)
             if val is not None:
                 owner = rendezvous_owner(str(val), ps.targets)
                 self._note_affinity(str(val), ps.targets[owner])
         return order_remotes(
             policy, tiers, first, n, inflight, scores, owner)
+
+    @staticmethod
+    def _prefix_affinity_key(frame, meta) -> Optional[str]:
+        """Route key for ``affinity-key=prefix``: the chain digest of
+        the prompt's declared (meta ``prefix_tokens``, rounded down to
+        the wire grain) or first-grain prefix.  None — fall back to the
+        plain policy order — when the frame carries no usable prompt
+        tensor; an unroutable frame must never fail the send path."""
+        tensors = getattr(frame, "tensors", None)
+        if not tensors:
+            return None
+        try:
+            declared = int((meta or {}).get("prefix_tokens", 0) or 0)
+            return prefix_route_key(tensors[0], declared=declared)
+        except Exception:
+            return None
 
     def _inflight_begin(self, addr: str) -> None:
         with self._breakers_lock:

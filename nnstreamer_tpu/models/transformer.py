@@ -534,6 +534,59 @@ class SlotModel:
 
         return jax.tree.map(zero_row, cache)
 
+    # -- shared-prefix page export / attach ---------------------------------
+    # (core/slots.py PrefixCache): a slot's low KV pages for positions
+    # [start, stop) are immutable once prefill has passed them — prefill
+    # and decode only ever write FORWARD of the per-slot index — so they
+    # can be published for reuse by later streams sharing the prefix.
+    def export_prefix(self, cache, slot: int, start: int, stop: int):
+        """COPY one slot's KV pages for positions ``[start, stop)``.
+
+        The result is a fresh pytree (slice outputs are new buffers, and
+        per-slot position counters are replaced by a placeholder), so a
+        later donated prefill/decode step consuming the source cache can
+        never invalidate a published entry.  Opaque to the engine —
+        only :meth:`attach_prefix` interprets it."""
+        n = int(stop) - int(start)
+
+        def cut(c):
+            if c.ndim < 2:
+                # per-slot write positions: recomputed (= n) on attach
+                return jnp.zeros((1,), c.dtype)
+            return jax.lax.dynamic_slice(
+                c, (slot, int(start)) + (0,) * (c.ndim - 2),
+                (1, n) + tuple(c.shape[2:]))
+
+        return jax.tree.map(cut, cache)
+
+    def attach_prefix(self, cache, slot: int, pages_list, n: int):
+        """Write published prefix pages (ordered per-grain chunks
+        covering ``[0, n)``) into one freshly-reset slot and set its
+        write position to ``n``.
+
+        Bit-exactness by construction: the pages are the verbatim
+        buffers a cold prefill produced at the same chunk boundaries, so
+        the slot's state (pages ``[0, n)`` + zeros above + position
+        ``n``) is indistinguishable from a cold run paused at
+        ``prefill_pos == n`` — every subsequent prefill/decode program
+        is the same XLA program on the same inputs."""
+
+        def cat(*ps):
+            if ps[0].ndim < 2:
+                return ps[0]
+            return ps[0] if len(ps) == 1 else jnp.concatenate(ps, axis=1)
+
+        pages = jax.tree.map(cat, *pages_list)
+
+        def put(c, p):
+            if c.ndim < 2:
+                return jax.lax.dynamic_update_slice(
+                    c, jnp.full((1,), n, c.dtype), (slot,))
+            return jax.lax.dynamic_update_slice(
+                c, p.astype(c.dtype), (slot, 0) + (0,) * (c.ndim - 2))
+
+        return jax.tree.map(put, cache, pages)
+
     # -- prefill (chunked, one slot at a time) ------------------------------
     def _prefill_chunk(self, params, cache, toks, slot):
         sl = jax.tree.map(

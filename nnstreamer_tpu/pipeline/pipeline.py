@@ -416,6 +416,22 @@ class Pipeline:
         from ..core.buffer import DEVICE_POOL, FRAME_POOL
         from ..core.liveness import MemoryPressureMonitor
 
+        def trim_prefixes() -> int:
+            # cold shared-prefix entries are the cheapest HBM to give
+            # back (refcounted pages under live readers are never
+            # touched) — so they go FIRST on the trim ladder, before
+            # frame/staging pools and compiled-program caches.
+            freed = 0
+            for el in self.elements.values():
+                trim = getattr(el, "trim_prefix_cache", None)
+                if trim is not None:
+                    try:
+                        freed += int(trim() or 0)
+                    except Exception:
+                        self.log.exception(
+                            "trim_prefix_cache failed for %s", el.name)
+            return freed
+
         def trim_backends() -> int:
             freed = 0
             for el in self.elements.values():
@@ -439,7 +455,8 @@ class Pipeline:
             min_poll_s=min_poll_s, host_limit_bytes=host_limit_bytes,
             on_pressure=lambda snap: self.incident(
                 "memory_pressure", self.name, snap),
-            trim_hooks=(FRAME_POOL.trim, DEVICE_POOL.trim, trim_backends),
+            trim_hooks=(trim_prefixes, FRAME_POOL.trim, DEVICE_POOL.trim,
+                        trim_backends),
             **kwargs,
         )
         self._mem_monitor = mon

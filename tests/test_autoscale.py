@@ -800,6 +800,9 @@ def test_resize_pending_holds_until_swap_lands():
 # The chaos acceptance (tier-1, chaos-marked)
 # ---------------------------------------------------------------------------
 @pytest.mark.chaos
+@pytest.mark.slow  # tier-1 budget: ~16s; partition_chaos_smoke below keeps
+# a controller chaos e2e in tier-1 (plus the prefix/resume chaos smokes);
+# the full burst-absorption script stays in the full suite
 def test_autoscale_chaos_smoke():
     """The acceptance contract: the closed loop observatory -> plan ->
     actuator scales a generate-mode fleet up under a load ramp, absorbs

@@ -373,6 +373,24 @@ class TestMainIntegration:
         assert proxy["sharded_ratio"] >= 0.85
         assert proxy["dp2_speedup"] >= 1.5
 
+    def test_prefix_cache_axis_separates_evidence(self, cache_paths):
+        """A row banked before the shared-prefix KV cache existed
+        (then-implicit prefix_cache=0 via _SIG_DEFAULTS) must NEVER
+        stand in for a warm-prefix run: cold-cache TTFT/throughput
+        under a prefix_cache=1 config would mislabel the dataplane
+        that produced the number — and vice versa."""
+        assert bench._SIG_DEFAULTS["prefix_cache"] == 0
+        assert "prefix_cache" in bench._SIG_KEYS
+        cold = _row()  # no prefix_cache key -> then-implicit 0
+        bench.bank_row(cold)
+        warm_meta = {**HEADLINE_META, "prefix_cache": 1}
+        got, _since, _src = bench.lookup_banked(warm_meta, METRIC)
+        assert got is None  # cold evidence never serves a warm config
+        # explicit 0 and the implicit default are the SAME signature
+        assert bench._sig(cold) == bench._sig({**cold, "prefix_cache": 0})
+        got, _since, _src = bench.lookup_banked(HEADLINE_META, METRIC)
+        assert got["value"] == 1821.1
+
     def test_mesh_axis_separates_evidence(
         self, cache_paths, monkeypatch, capsys
     ):

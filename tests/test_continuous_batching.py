@@ -378,8 +378,13 @@ def _run_pipeline_stream(prompts, n, chunk, slots, fuse=True,
 
 
 class TestSlottedElementParity:
-    @pytest.mark.parametrize("fuse", [True, False],
-                             ids=["fused", "unfused"])
+    @pytest.mark.parametrize("fuse", [
+        pytest.param(True, id="fused"),
+        # tier-1 budget: ~18s second full compile; unfused slotted
+        # bit-parity stays tier-1 via the prefix element-wiring [unfused]
+        # pin, which drives the same unfused slotted dataplane
+        pytest.param(False, marks=pytest.mark.slow, id="unfused"),
+    ])
     def test_slotted_bit_identical_to_seed_paths(self, rng, fuse):
         """Slotted decode vs seed generate:<N> AND vs the unslotted
         streaming path: tokens and chunk meta bit-identical per stream,
@@ -485,7 +490,13 @@ def _stream_client(port, ct, prompt, results, key, timeout=120,
 
 
 class TestMultiplexedServing:
-    @pytest.mark.parametrize("ct", ["grpc", "tcp"])
+    @pytest.mark.parametrize("ct", [
+        # tier-1 budget: ~15s; same multiplex contract over a second
+        # transport — grpc framing stays tier-1 via the remote-stream
+        # roundtrip test, so only the tcp variant runs in tier-1
+        pytest.param("grpc", marks=pytest.mark.slow),
+        "tcp",
+    ])
     def test_concurrent_streams_share_slots_exact(self, rng, ct,
                                                   module_leak_check):
         """N concurrent InvokeStream/tcp-stream clients multiplex into

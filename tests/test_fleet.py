@@ -34,6 +34,7 @@ import numpy as np
 import pytest
 
 from nnstreamer_tpu.core import routing
+from nnstreamer_tpu.core.continuity import PREFIX_GRAIN, prefix_route_key
 from nnstreamer_tpu.core.liveness import (
     ServerBusyError,
     TenantAdmissionController,
@@ -101,6 +102,119 @@ class TestRendezvousAffinity:
         assert sorted(moved) == sorted(departed)
         assert len(moved) <= math.ceil(
             1.25 * len(self.KEYS) / len(self.FLEET8))
+
+
+# ---------------------------------------------------------------------------
+# Prefix-affinity routing (PR 18): the remap math over REAL prefix
+# digests, and the tier discipline for a draining/degraded prefix owner
+# ---------------------------------------------------------------------------
+class TestPrefixAffinityRouting:
+    FLEET8 = [(f"10.0.0.{i}", 7000 + i) for i in range(8)]
+
+    @staticmethod
+    def _digest_keys(n=600, seed=5):
+        """Route keys as the query client computes them: grain-aligned
+        chain digests of synthetic prompts (not opaque session strings —
+        the remap math must hold over the ACTUAL key distribution)."""
+        rng = np.random.default_rng(seed)
+        return [
+            prefix_route_key(
+                rng.integers(0, 997, (1, PREFIX_GRAIN + 17)).astype(
+                    np.int32))
+            for _ in range(n)
+        ]
+
+    def test_shared_prefix_maps_to_one_owner_distinct_prefixes_spread(
+            self):
+        """The tentpole's routing premise: clients sharing a prompt
+        prefix compute the SAME route key (suffix divergence past the
+        first grain is invisible to it) and so land on the one server
+        whose prefix KV pages are warm, while distinct prefixes spread
+        across the fleet."""
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, 997, (1, PREFIX_GRAIN + 40)).astype(
+            np.int32)
+        fork = base.copy()
+        fork[0, PREFIX_GRAIN + 5] ^= 1  # diverge AFTER the first grain
+        assert prefix_route_key(base) == prefix_route_key(fork)
+        other = base.copy()
+        other[0, 3] ^= 1                # diverge INSIDE the prefix
+        assert prefix_route_key(base) != prefix_route_key(other)
+        owners = Counter(
+            routing.rendezvous_owner(k, self.FLEET8)
+            for k in self._digest_keys())
+        assert set(owners) == set(range(8)), (
+            "distinct prefixes must spread over every server")
+
+    def test_join_steals_only_the_prefix_digests_the_newcomer_wins(self):
+        """Minimal remap over prefix digests: a scale-up invalidates
+        ONLY the warm prefix pages for keys the newcomer now owns
+        (~1/N of them) — every other key keeps its warm server."""
+        keys = self._digest_keys()
+        before = routing.ownership_map(keys, self.FLEET8)
+        grown = self.FLEET8 + [("10.0.0.8", 7008)]
+        after = routing.ownership_map(keys, grown)
+        moved = [k for k in keys if before[k] != after[k]]
+        for k in moved:
+            assert grown[after[k]] == ("10.0.0.8", 7008), (
+                "a prefix digest may only move TO the joining server")
+        assert len(moved) <= math.ceil(1.35 * len(keys) / len(grown))
+
+    def test_leave_moves_exactly_the_departed_servers_digests(self):
+        """A scale-down re-homes EXACTLY the departed server's prefix
+        digests; every surviving server keeps its warm set bit-for-bit
+        (compare by endpoint — indices shift after the removal)."""
+        keys = self._digest_keys()
+        before = routing.ownership_map(keys, self.FLEET8)
+        survivors = self.FLEET8[:3] + self.FLEET8[4:]  # drop index 3
+        after = routing.ownership_map(keys, survivors)
+        departed = [k for k in keys if before[k] == 3]
+        moved = [
+            k for k in keys
+            if self.FLEET8[before[k]] != survivors[after[k]]
+        ]
+        assert sorted(moved) == sorted(departed)
+
+    def test_draining_owner_fails_over_in_tier_without_remap_thrash(
+            self):
+        """A draining (then degraded) prefix owner's traffic fails over
+        to healthy remotes WITHOUT counting affinity remaps: the owner
+        assignment is a pure function of the endpoint set, so tier
+        demotion — a routing-order concern — must not thrash the
+        `affinity_remaps` ledger, and the owner still outranks remotes
+        in worse tiers (pages are warm there; it is wounded, not
+        gone)."""
+        el = _client_with_pool(3, **{"affinity-key": "prefix"})
+        from nnstreamer_tpu.core.buffer import TensorFrame
+
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(0, 997, (1, PREFIX_GRAIN + 8)).astype(
+            np.int32)
+        f = TensorFrame([prompt])
+        key = prefix_route_key(prompt)
+        owner = routing.rendezvous_owner(key, el._pstate.targets)
+        addr = "{}:{}".format(*el._pstate.targets[owner])
+        # healthy owner: promoted to the very front, zero remaps
+        for first in range(3):
+            assert el._route_order(el._pstate, f, first)[0] == owner
+        assert el._affinity_remaps == 0
+        for hint in ({"draining": True}, {"degraded": True}):
+            with el._breakers_lock:
+                el._endpoint_hints = {addr: hint}
+                el._hints_ts = time.monotonic()
+            for first in range(3):
+                order = el._route_order(el._pstate, f, first)
+                assert order[-1] == owner, (
+                    f"{hint}: owner must yield to healthy remotes")
+                assert set(order[:2]) == {i for i in range(3)
+                                          if i != owner}
+        # repeated failover routing counted ZERO owner changes
+        assert el._affinity_remaps == 0
+        # ...and a frame declaring a longer shared prefix still routes
+        # deterministically (meta prefix_tokens -> deeper chain digest)
+        f2 = TensorFrame([prompt], meta={"prefix_tokens": PREFIX_GRAIN})
+        el._route_order(el._pstate, f2, 0)
+        assert el._affinity_remaps == 0
 
 
 # ---------------------------------------------------------------------------

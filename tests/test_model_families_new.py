@@ -15,8 +15,8 @@ from nnstreamer_tpu.pipeline import parse_pipeline
 
 
 class TestDeepLab:
-    @pytest.mark.slow  # tier-1 budget: ~19s deeplab build; the
-    # pipeline-with-segment-decoder e2e below keeps deeplab covered
+    @pytest.mark.slow  # tier-1 budget: ~19s deeplab build; zoo-breadth
+    # family, full correctness stays in the full suite
     def test_build_shapes(self):
         fn, params, in_spec, out_spec = build(
             "deeplab", {"dtype": "float32", "size": "65", "classes": "5"}
@@ -26,6 +26,8 @@ class TestDeepLab:
         assert out.shape == (65, 65, 5)
         assert np.isfinite(np.asarray(out)).all()
 
+    @pytest.mark.slow  # tier-1 budget: ~29s compile-bound CNN e2e; zoo
+    # breadth, not a serving-dataplane contract — full suite keeps it
     def test_pipeline_with_segment_decoder(self):
         fn, params, in_spec, out_spec = build(
             "deeplab", {"dtype": "float32", "size": "33", "classes": "5"}

@@ -84,6 +84,8 @@ class TestYolo:
 
 
 class TestPoseNet:
+    @pytest.mark.slow  # tier-1 budget: ~20s posenet build+decode; zoo
+    # breadth, not a serving-dataplane contract — full suite keeps it
     def test_shapes_and_decode(self):
         from nnstreamer_tpu.decoders.pose import PoseEstimation
         from nnstreamer_tpu.core.buffer import TensorFrame

@@ -85,6 +85,8 @@ class TestRingAttention:
         ref = reference_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    @pytest.mark.slow  # tier-1 budget: ~11s training-side grad compile; the
+    # forward ring-vs-reference parity tests above stay tier-1
     def test_grad_flows_through_ring(self, mesh8):
         B, T, H, D = 2, 16, 2, 8
         rng = jax.random.PRNGKey(1)
@@ -153,6 +155,9 @@ class TestGraftEntry:
         ge.dryrun_multichip(8)
         assert "dryrun_multichip OK" in capsys.readouterr().out
 
+    @pytest.mark.slow  # tier-1 budget: ~24s full graft-entry jit; the entry
+    # wraps the same model forward the zoo tier-1 tests compile, so this
+    # joins dryrun_multichip_8 in the full suite
     def test_entry_compiles(self):
         import __graft_entry__ as ge
 

@@ -51,6 +51,8 @@ def _run_and_compare(mesh_axes, cfg, batch):
 
 
 class TestPipelineParallel:
+    @pytest.mark.slow  # tier-1 budget: ~22s compile-bound axis combo;
+    # test_moe_capacity_drop_runs keeps the pp train-step compile in tier-1
     def test_pp_sp_tp(self):
         cfg = PipelineConfig(n_layers=2, n_experts=0, n_microbatches=2)
         l1, l2 = _run_and_compare(
@@ -58,6 +60,8 @@ class TestPipelineParallel:
         )
         assert l2 < l1  # one adamw step reduces loss on the same batch
 
+    @pytest.mark.slow  # tier-1 budget: ~19s compile-bound axis combo vs the
+    # same loss oracle; tier-1 keeps the cheaper capacity-drop moe compile
     def test_dp_pp_ep_moe(self):
         # capacity_factor high enough that no token drops => exact oracle
         cfg = PipelineConfig(
@@ -67,6 +71,8 @@ class TestPipelineParallel:
             {"dp": 2, "pp": 2, "sp": 1, "tp": 1, "ep": 2}, cfg, batch=4
         )
 
+    @pytest.mark.slow  # tier-1 budget: ~19s; degenerate all-1 mesh of the
+    # same oracle comparison — pure compile cost, no extra coverage vs above
     def test_all_axes_single_device(self):
         cfg = PipelineConfig(n_layers=2, n_experts=2, n_microbatches=2,
                              capacity_factor=8.0)
@@ -86,6 +92,9 @@ class TestPipelineParallel:
 
 
 class TestElasticResume:
+    @pytest.mark.slow  # tier-1 budget: ~42s (two full train-step compiles);
+    # serving-side resume bit-parity stays tier-1 via test_prefix_cache
+    # cache_cold_resume + the chaos rolling-restart smokes
     def test_resume_is_bit_identical(self, tmp_path):
         """Preemption recovery: save after step 2, restore into a FRESH
         train step on the same mesh, continue — losses must match the

@@ -223,6 +223,9 @@ class TestEngineResumeParity:
             assert frames[0].meta[RESUME_META]["sig"] == "SIG"
             assert e.resumes == 1
 
+    @pytest.mark.slow  # tier-1 budget: ~35s+25s O(boundaries) zoo sweep;
+    # tier-1 keeps the sim every-point sweep plus the real-model single-kill
+    # parity pins (test_kill_mid_stream_resumes_bit_exact, prefix cold-resume)
     @pytest.mark.parametrize("extra", [None, SAMPLING],
                              ids=["greedy", "seeded-topk"])
     def test_zoo_resume_bit_parity_every_boundary(self, rng, extra):

@@ -63,6 +63,8 @@ class TestStreamingGeneration:
         assert all(f.meta["stream_seq"] is not None for f in frames)
         assert len(frames) == -(-n // chunk)
 
+    @pytest.mark.slow  # tier-1 budget: ~12s extra (3,T) compile of the same
+    # stream-vs-oneshot parity; chunks_equal_oneshot_tokens stays tier-1
     def test_batched_prompts(self, rng):
         prompt = rng.integers(0, PROPS["vocab"], (3, 5)).astype(np.int32)
         n, chunk = 8, 3
@@ -72,6 +74,9 @@ class TestStreamingGeneration:
         assert toks.shape == (3, n)
         np.testing.assert_array_equal(toks, _oneshot(prompt, n))
 
+    @pytest.mark.slow  # tier-1 budget: ~18s; seeded stream-vs-oneshot parity
+    # stays tier-1 on the slotted engine (test_sampling_parity_slotted) and
+    # the seeded prefix warm-hit pin, both of which run this sampler
     def test_sampling_stream_matches_oneshot(self, rng):
         """temperature/top-k sampling: per-step key folding must line up
         across the chunk boundaries (gen_seed dialect)."""
@@ -120,6 +125,9 @@ class TestStreamingGeneration:
         frames = _run_stream(prompt, 0, 4)
         assert frames == []
 
+    @pytest.mark.slow  # tier-1 budget: ~16s; block-splitting order/parity
+    # stays tier-1 via the slotted block-split test, which exercises the
+    # same prompt-block fan-out on the serving engine
     def test_block_of_prompts_streams_in_order(self, rng):
         """A BatchFrame of prompts: each logical prompt streams its own
         chunk sequence, in prompt order (lazy chain, BATCH_AWARE)."""

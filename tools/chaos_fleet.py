@@ -138,15 +138,16 @@ class GenClientHandle:
     def element(self):
         return self.pipe["q"]
 
-    def push_prompt(self, key: Optional[str] = None):
+    def push_prompt(self, key: Optional[str] = None, prompt=None):
         import numpy as np
 
         from nnstreamer_tpu.core.buffer import TensorFrame
         from nnstreamer_tpu.core.telemetry import TRACE_ID_META, new_trace_id
 
         self._seq += 1
-        prompt = (np.arange(4, dtype=np.int32)[None] * 13
-                  + self._seq) % self._h.gen_vocab
+        if prompt is None:
+            prompt = (np.arange(4, dtype=np.int32)[None] * 13
+                      + self._seq) % self._h.gen_vocab
         trace = new_trace_id()
         meta: Dict[str, Any] = {TRACE_ID_META: trace}
         if key is not None:
@@ -257,7 +258,7 @@ class FleetHarness:
                  mode: str = "unary", gen_slots: int = 2,
                  gen_max_new: int = 24, gen_vocab: int = 997,
                  gen_step_ms: float = 1.0, digest_interval: float = 0.0,
-                 gen_slo: str = ""):
+                 gen_slo: str = "", gen_extra: str = ""):
         from nnstreamer_tpu.distributed.mqtt import MiniBroker
 
         self.topic = topic
@@ -278,6 +279,9 @@ class FleetHarness:
         # generator (e.g. "slo-ttft-p95=10 slo-availability=0.9")
         self.digest_interval = digest_interval
         self.gen_slo = gen_slo
+        # extra generator props appended verbatim (mode="generate"
+        # only) — the prefix chaos arms "prefix-cache=on ..." here
+        self.gen_extra = gen_extra
         self.observatory = None
         self.broker = MiniBroker()
         self.servers: Dict[int, Any] = {}   # idx -> pipeline (live only)
@@ -314,7 +318,9 @@ class FleetHarness:
                 f"custom=sim:1,sim_step_ms:{self.gen_step_ms},"
                 f"sim_per_slot_ms:0.05,sim_prefill_ms:0.02,"
                 f"vocab:{self.gen_vocab} "
-                f"max-new={self.gen_max_new} chunk=4 {slo}! "
+                f"max-new={self.gen_max_new} chunk=4 {slo}"
+                + (f"{self.gen_extra} " if self.gen_extra else "")
+                + "! "
             )
         else:
             core = (
@@ -613,6 +619,15 @@ class FleetHarness:
         admitted_exact = roll["admitted"] == ledger_adm["admitted"]
         shed_exact = roll["shed"] == ledger_adm["shed"]
         tenants_exact = roll["tenants"] == ledger_tenants
+        # shared-prefix cache counters (PR 18): integer-exact against
+        # the summed engine ledgers, retired servers included; fleets
+        # with the cache unarmed compare 0 == 0
+        gen = self.fleet_gen()
+        prefix_exact = (
+            int(roll.get("prefix_hits", 0))
+            == int(gen.get("prefix_hits", 0))
+            and int(roll.get("prefix_misses", 0))
+            == int(gen.get("prefix_misses", 0)))
         return {
             "rollup_tokens": roll["tokens"],
             "ledger_tokens": self.fleet_tokens(),
@@ -627,8 +642,12 @@ class FleetHarness:
             "stale_evicted": roll["stale_evicted"],
             "retired": roll["retired"],
             "slo_burn": roll["slo_burn"],
+            "rollup_prefix_hits": int(roll.get("prefix_hits", 0)),
+            "ledger_prefix_hits": int(gen.get("prefix_hits", 0)),
+            "rollup_prefix_misses": int(roll.get("prefix_misses", 0)),
+            "ledger_prefix_misses": int(gen.get("prefix_misses", 0)),
             "exact": bool(tokens_exact and admitted_exact and shed_exact
-                          and tenants_exact),
+                          and tenants_exact and prefix_exact),
         }
 
     # -- clients ------------------------------------------------------------
@@ -1164,6 +1183,156 @@ def run_observatory_script(servers: int = 3, streams: int = 8) -> Dict[str, Any]
             and shed_b > 0
             and roll["drain"]["dropped"] == 0
             and metrics_ok
+            and v["breaker_trips"] == 0
+        )
+        return v
+    finally:
+        h.stop_all()
+
+
+def run_prefix_script(servers: int = 3, clients: int = 6,
+                      seed: int = 0) -> Dict[str, Any]:
+    """Shared-prefix cache chaos (PR 18, Documentation/performance.md
+    "Shared prefix cache"): N clients share one prompt prefix;
+    ``affinity-key=prefix`` routes them all to the one rendezvous owner
+    whose prefix KV pages are warm.  A rolling restart of that owner
+    lands MID-decode: live streams migrate to cache-cold servers and
+    must stay bit-exact, the restarted owner comes back deliberately
+    cache-cold, and one re-warm wave restores the hit path.
+
+    Exactness contract: every stream's tokens equal the sim oracle
+    bit-for-bit (zero lost, zero duplicated — a stale or cross-slot
+    prefix page is exact-fail); after the warm wave the fleet ledger
+    shows EXACTLY one miss and ``clients-1`` hits at 64 cached tokens
+    each; the observatory's fleet prefix_hits/prefix_misses rollup is
+    integer-exact against the summed per-server ledgers (retired rows
+    included); the final fleet hit ratio clears 0.5 despite the
+    cache-cold failovers; zero drain drops, zero breaker trips."""
+    import numpy as np
+
+    # the shared prefix must span the WIRE grain (PREFIX_GRAIN=64): the
+    # client's route key is the first-grain chain digest, so shorter
+    # "shared" prefixes would fall back to full-prompt digests and
+    # scatter the clients; the server caches at a finer 8-token grain
+    # (prompts are 67 tokens -> 64 cached tokens per warm hit)
+    grain, prefix_len = 8, 64
+    h = FleetHarness(mode="generate", gen_slots=max(4, clients),
+                     gen_max_new=48, gen_step_ms=3.0, base_id=10400,
+                     topic="chaospfx", affinity_key="prefix",
+                     digest_interval=0.25,
+                     gen_extra=(f"prefix-cache=on prefix-grain={grain} "
+                                "prefill-chunk=4"))
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, h.gen_vocab, (1, prefix_len)).astype(np.int32)
+
+    def mk_prompt(i: int):
+        # shared prefix + unique 3-token suffix: route keys collide
+        # (same first grains), oracles do NOT (suffix changes the sum)
+        suffix = np.int32([[(7 + 13 * i) % h.gen_vocab,
+                            (3 * i + 1) % h.gen_vocab,
+                            (i * i + 5) % h.gen_vocab]])
+        return np.concatenate([shared, suffix], axis=1)
+
+    try:
+        for i in range(servers):
+            h.start_server(i)
+        h.attach_observatory(ttl_s=10.0)
+        cs = [h.make_gen_client(f"P{i}", affinity=True, timeout=120.0)
+              for i in range(clients)]
+
+        # -- phase A: prime — the first stream misses and publishes ----
+        cs[0].push_prompt(prompt=mk_prompt(0))
+        cs[0].settle(timeout=120.0)
+
+        # -- phase B: warm wave — every other client hits the cache ----
+        for i in range(1, clients):
+            cs[i].push_prompt(prompt=mk_prompt(i))
+        for c in cs:
+            c.settle(timeout=120.0)
+        warm = h.fleet_gen()
+        warm_snap = {k: int(warm.get(k, 0)) for k in (
+            "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+            "prefix_publishes")}
+
+        # -- phase C: roll the warm owner mid-decode -------------------
+        traces = [c.push_prompt(prompt=mk_prompt(100 + i))
+                  for i, c in enumerate(cs)]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(c.tokens_done(t) >= 8 for c, t in zip(cs, traces)):
+                break
+            time.sleep(0.005)
+        owner = max(
+            h.servers,
+            key=lambda i: h.servers[i].health()["gen"].get(
+                "gen_occupied", 0))
+        roll = h.rolling_restart(owner)
+        for c in cs:
+            c.settle(timeout=120.0)
+
+        # -- phase D: re-warm the deliberately cache-cold owner --------
+        cs[0].push_prompt(prompt=mk_prompt(200))
+        cs[0].settle(timeout=120.0)
+        for i in range(1, clients):
+            cs[i].push_prompt(prompt=mk_prompt(200 + i))
+        for c in cs:
+            c.settle(timeout=120.0)
+        for c in cs:
+            c.finish()
+
+        checks = [c.check_exact() for c in cs]
+        exact = sum(r["exact"] for r in checks)
+        mismatched = sum(r["mismatched"] for r in checks)
+        res = {
+            k: sum(int(c.health().get(k, 0)) for c in cs)
+            for k in ("stream_resumes", "stream_migrations",
+                      "resume_failures")
+        }
+        h.publish_digests()
+        h.observatory_settled()
+        cc = h.observatory_crosscheck()
+        gen = h.fleet_gen()
+        pfx = {k: int(gen.get(k, 0)) for k in (
+            "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+            "prefix_publishes", "prefix_evictions")}
+        lookups = pfx["prefix_hits"] + pfx["prefix_misses"]
+        ratio = (pfx["prefix_hits"] / lookups) if lookups else 0.0
+        v = {
+            "clients": clients,
+            "streams": sum(r["streams"] for r in checks),
+            "exact": exact,
+            "mismatched": mismatched,
+            "tokens": sum(r["tokens"] for r in checks),
+            "warm_wave": warm_snap,
+            "fleet_prefix": pfx,
+            "hit_ratio": round(ratio, 4),
+            "migrations": res["stream_migrations"],
+            "resumes": res["stream_resumes"],
+            "resume_failures": res["resume_failures"],
+            "rolling_restart": {
+                "goaway_sent": roll["health"].get("goaway_sent", 0),
+                "drain_dropped": roll["drain"]["dropped"],
+            },
+            "crosscheck": cc,
+            "breaker_trips": h.breaker_trips(),
+        }
+        v["ok"] = bool(
+            mismatched == 0 and exact == v["streams"]
+            # warm-wave ledger is EXACT: one publish-miss, then a hit
+            # at 16 cached tokens for every other client
+            and warm_snap["prefix_misses"] == 1
+            and warm_snap["prefix_hits"] == clients - 1
+            and warm_snap["prefix_hit_tokens"]
+            == (clients - 1) * prefix_len
+            and warm_snap["prefix_publishes"] >= 1
+            # the roll landed on live streams and every handoff resumed
+            and res["stream_migrations"] >= 1
+            and res["resume_failures"] == 0
+            and roll["drain"]["dropped"] == 0
+            # cache-cold failovers tolerated, but the fleet still
+            # serves mostly warm
+            and ratio >= 0.5
+            and cc["exact"]
             and v["breaker_trips"] == 0
         )
         return v
@@ -2019,7 +2188,7 @@ def main() -> int:
     ap.add_argument("--mode",
                     choices=("unary", "generate", "generate-resume",
                              "device-loss", "observatory", "autoscale",
-                             "partition"),
+                             "partition", "prefix"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
                     "generation-stream fleet (continuous batching), "
@@ -2039,7 +2208,12 @@ def main() -> int:
                     "or the partition chaos: broker death/restart "
                     "mid-load, a partitioned server subset, and two "
                     "leased controllers — fail-static freezes, fenced "
-                    "takeover, exact stale-epoch rejects")
+                    "takeover, exact stale-epoch rejects, or the "
+                    "shared-prefix cache chaos: N clients share one "
+                    "prompt prefix, prefix-affinity routes them to the "
+                    "warm owner, a mid-decode rolling restart forces "
+                    "bit-exact cache-cold failover and a re-warm, with "
+                    "exact hit/miss ledgers and observatory rollups")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -2065,6 +2239,10 @@ def main() -> int:
     elif args.mode == "partition":
         verdict = run_partition_script(
             max(2, min(args.servers, 4)), max(2, min(args.streams, 8)),
+            args.seed)
+    elif args.mode == "prefix":
+        verdict = run_prefix_script(
+            max(2, min(args.servers, 4)), max(2, min(args.streams, 12)),
             args.seed)
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)

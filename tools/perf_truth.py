@@ -153,6 +153,15 @@ def _axes() -> Dict[str, Axis]:
              lambda: _bench().measure_generate_throughput(
                  slots=4, streams=4, max_new=24, chunk=8,
                  timeout_s=180.0)["tokens_per_s"]),
+        # shared-prefix KV cache: cold/warm TTFT ratio at 256 shared
+        # tokens on the CPU-proxy zoo transformer.  The hard product
+        # floor (warm <= 0.5x cold, i.e. ratio >= 2.0) is pinned in
+        # pytest -m perf over the SAME harness; this axis additionally
+        # trend-gates the measured distribution.
+        Axis("prefix_ttft_speedup", "bench.measure_prefix_ttft", "x",
+             False, 3, 2,
+             lambda: _bench().measure_prefix_ttft(
+                 trials=3)["prefix_ttft_speedup"]),
         # mesh plumbing on a single-device-equivalent proxy mesh: fps
         # ratio sharded/unsharded (1.0 = free; interleaved rounds cancel
         # ambient load).  The dp:2 aggregate floor lives in pytest -m
