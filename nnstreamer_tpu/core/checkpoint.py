@@ -3,28 +3,68 @@
 The reference's checkpoint story is model-save/load-path on tensor_trainer
 plus deterministic datarepo sample indices (SURVEY §5.4) — final-state only.
 TPU fleets are preemptible, so the TPU build adds what §5.3 calls out as
-missing: periodic full-state checkpoints (params + optimizer state + epoch)
-that a restarted pipeline resumes from.
+missing: periodic full-state checkpoints (params + optimizer state + step +
+data cursor) that a restarted pipeline resumes from.
 
-Layout: ``<dir>/step_<N>/`` per checkpoint (Orbax StandardCheckpointer),
-newest-wins resume via :func:`latest_step`.
+Layout: ``<dir>/step_<N>/`` per checkpoint (Orbax StandardCheckpointer)
+plus a **completion marker** ``<dir>/step_<N>.ok`` written atomically
+*after* the Orbax save finishes.  A crash mid-save leaves a step dir with
+no marker; :func:`latest_step` only ever selects marked steps, so a torn
+save can never be resumed (the write/commit split exists so the trainer
+can fault-inject the gap between them).  The marker doubles as the
+checkpoint's metadata record — a small JSON dict (the trainer stores its
+data cursor there), read back via :func:`load_meta`.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_MARK_RE = re.compile(r"^step_(\d+)\.ok$")
 
 
 def _step_dir(path: str, step: int) -> str:
     return os.path.join(os.path.abspath(path), f"step_{step}")
 
 
-def save_state(path: str, step: int, state: Any) -> str:
-    """Save a pytree as checkpoint `step` under `path`; returns the dir."""
+def _marker_path(path: str, step: int) -> str:
+    return _step_dir(path, step) + ".ok"
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-atomic file write: temp sibling in the same directory,
+    fsync, then ``os.replace`` — a crash at any instant leaves either
+    the old complete file or the new complete file, never a torn one
+    (the datareposink pattern, shared here so the trainer's model saves
+    and checkpoint markers use the one idiom)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    tmp = os.path.join(d, f".{base}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(d)
+
+
+def write_state(path: str, step: int, state: Any) -> str:
+    """Write checkpoint ``step`` under ``path`` WITHOUT committing it:
+    the Orbax save runs to completion but no marker is written, so
+    :func:`latest_step` will not select it until :func:`commit_state`
+    runs.  Callers that don't need the split use :func:`save_state`."""
     import orbax.checkpoint as ocp
 
     d = _step_dir(path, step)
@@ -34,16 +74,50 @@ def save_state(path: str, step: int, state: Any) -> str:
     return d
 
 
+def commit_state(path: str, step: int,
+                 meta: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically publish checkpoint ``step`` by writing its completion
+    marker (with optional JSON ``meta`` — the trainer's data cursor).
+    Only after this returns can :func:`latest_step` select the step."""
+    marker = _marker_path(path, step)
+    payload = dict(meta or {})
+    payload["step"] = int(step)
+    atomic_write_bytes(marker, json.dumps(payload).encode())
+    return marker
+
+
+def save_state(path: str, step: int, state: Any,
+               meta: Optional[Dict[str, Any]] = None) -> str:
+    """Save + commit a pytree as checkpoint ``step``; returns the dir."""
+    d = write_state(path, step, state)
+    commit_state(path, step, meta)
+    return d
+
+
 def latest_step(path: str) -> Optional[int]:
-    """Newest complete checkpoint step under `path`, or None."""
+    """Newest COMPLETE (marker-committed) checkpoint step under
+    ``path``, or None.  Torn saves — a step dir without its ``.ok``
+    marker — are never selected."""
     if not os.path.isdir(path):
         return None
     steps = []
     for name in os.listdir(path):
         m = _STEP_RE.match(name)
-        if m and os.path.isdir(os.path.join(path, name)):
+        if (m and os.path.isdir(os.path.join(path, name))
+                and os.path.isfile(_marker_path(path, int(m.group(1))))):
             steps.append(int(m.group(1)))
     return max(steps) if steps else None
+
+
+def load_meta(path: str, step: int) -> Dict[str, Any]:
+    """The metadata dict committed with checkpoint ``step`` (empty for
+    a missing/unreadable marker — pre-marker-era checkpoints restore
+    with no cursor)."""
+    try:
+        with open(_marker_path(path, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 
 def restore_state(path: str, step: int, template: Any) -> Any:
@@ -56,15 +130,33 @@ def restore_state(path: str, step: int, template: Any) -> Any:
 
 
 def prune(path: str, keep: int) -> None:
-    """Delete all but the newest `keep` checkpoints."""
+    """Delete all but the newest `keep` COMPLETE checkpoints.  Torn
+    saves (unmarked dirs) and orphaned markers are always removed —
+    they can never be resumed, so retaining them only wastes disk."""
     import shutil
 
     if keep <= 0 or not os.path.isdir(path):
         return
-    steps = sorted(
-        int(m.group(1))
-        for m in (_STEP_RE.match(n) for n in os.listdir(path))
-        if m and os.path.isdir(os.path.join(path, m.group(0)))
-    )
-    for s in steps[:-keep]:
+    complete, torn, orphans = [], [], []
+    names = os.listdir(path)
+    dirs = {int(m.group(1)) for m in map(_STEP_RE.match, names)
+            if m and os.path.isdir(os.path.join(path, m.group(0)))}
+    marks = {int(m.group(1)) for m in map(_MARK_RE.match, names) if m}
+    for s in dirs:
+        (complete if s in marks else torn).append(s)
+    orphans = sorted(marks - dirs)
+    for s in sorted(complete)[:-keep]:
+        # marker FIRST: a crash between the two deletes must leave a
+        # torn (never-resumed) dir, not a marked dir with no data
+        try:
+            os.remove(_marker_path(path, s))
+        except OSError:
+            pass
         shutil.rmtree(_step_dir(path, s), ignore_errors=True)
+    for s in torn:
+        shutil.rmtree(_step_dir(path, s), ignore_errors=True)
+    for s in orphans:
+        try:
+            os.remove(_marker_path(path, s))
+        except OSError:
+            pass

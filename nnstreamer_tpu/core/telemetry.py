@@ -354,6 +354,24 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "nns.pool.device_reuse_rate": ("gauge", "staging-buffer reuse fraction"),
     "nns.pool.rings_evicted": ("counter", "staging-buffer rings evicted by the key-space LRU"),
     "nns.pool.trims": ("counter", "staging-pool memory-pressure trims"),
+    # -- continuous learning (elements/trainer.py + elements/validator.py) --
+    "nns.train.steps": ("counter", "optimizer steps taken (monotone across resumes)"),
+    "nns.train.samples": ("counter", "samples consumed by train steps"),
+    "nns.train.epochs": ("counter", "training epochs completed"),
+    "nns.train.loss": ("gauge", "most recent training loss"),
+    "nns.train.checkpoints": ("counter", "durable (marker-committed) checkpoints written"),
+    "nns.train.resumes": ("counter", "trainer starts that resumed from a durable checkpoint"),
+    "nns.train.replay_skipped": ("counter", "already-trained samples skipped on resume (exactly-once accounting)"),
+    "nns.train.gap_samples": ("counter", "partial-epoch samples dropped realigning after a mid-stream restart"),
+    "nns.train.pauses": ("counter", "memory-watermark pauses of the train loop"),
+    "nns.train.paused": ("gauge", "1 while train steps are paused (pressure or operator)"),
+    "nns.train.restarts": ("counter", "trainer-backend revivals through the supervisor"),
+    "nns.train.alive": ("gauge", "1 while the training thread is running"),
+    "nns.train.validations": ("counter", "held-out validation passes over candidate checkpoints"),
+    "nns.train.val_score": ("gauge", "most recent held-out validation score (gate metric)"),
+    "nns.train.promotions": ("counter", "candidates promoted into the serving filter"),
+    "nns.train.promotions_refused": ("counter", "candidates refused by the validation gate (regression)"),
+    "nns.train.promote_failures": ("counter", "promotion attempts that failed (old model kept serving)"),
     # flight recorder
     "nns.flight.dumps": ("counter", "flight-recorder incident dumps written"),
 }
@@ -471,6 +489,24 @@ HEALTH_KEY_METRICS: Dict[str, str] = {
     "fence_epoch": "nns.query.fence_epoch",
     "gen_stale_epoch_rejects": "nns.gen.stale_epoch_rejects",
     "gen_fence_epoch": "nns.gen.fence_epoch",
+    # continuous learning (tensor_trainer + model_validator health rows)
+    "train_steps": "nns.train.steps",
+    "train_samples": "nns.train.samples",
+    "train_epochs": "nns.train.epochs",
+    "train_loss": "nns.train.loss",
+    "train_checkpoints": "nns.train.checkpoints",
+    "train_resumes": "nns.train.resumes",
+    "train_replay_skipped": "nns.train.replay_skipped",
+    "train_gap_samples": "nns.train.gap_samples",
+    "train_pauses": "nns.train.pauses",
+    "train_paused": "nns.train.paused",
+    "train_restarts": "nns.train.restarts",
+    "train_alive": "nns.train.alive",
+    "train_validations": "nns.train.validations",
+    "train_val_score": "nns.train.val_score",
+    "train_promotions": "nns.train.promotions",
+    "train_promotions_refused": "nns.train.promotions_refused",
+    "train_promote_failures": "nns.train.promote_failures",
 }
 
 #: non-numeric / structured health keys handled specially (or skipped) by

@@ -21,6 +21,7 @@ for _mod in (
     "sparse",
     "datarepo",
     "trainer",
+    "validator",
     "generator",
     "query",
     "edge",

@@ -1,0 +1,305 @@
+"""model_validator: the promotion gate of the continuous-learning loop.
+
+Closes the data-collection → train → validate → serve loop in ONE
+pipeline graph (ROADMAP item 7): downstream of ``tensor_trainer``, each
+epoch-stats frame triggers a validation pass — the newest durable
+checkpoint is scored on a held-out datarepo split with the SAME loss the
+trainer optimizes (``trainer.jax_trainer.make_loss_fn``) — and a
+candidate that improves on the best promoted score is exported
+(crash-atomic msgpack) and promoted into a co-hosted serving
+``tensor_filter`` through the staged hot swap (PR-5): stage + schema
+validation + warmup off the hot path, swap at a frame boundary, and an
+observation-window error burst rolls back with zero frame loss.
+
+Gate semantics (degrade, don't die):
+
+* **Refused on regression** — a candidate that does not improve the
+  held-out ``metric`` (loss or accuracy) by at least ``min-delta`` over
+  the best PROMOTED score is refused (counted, bus warning) and the
+  serving filter keeps its current model.
+* **Promotion failure keeps serving** — an export/reload failure (the
+  ``trainer.promote`` fault site) counts ``train_promote_failures`` and
+  records a flight-recorder incident; it never kills the pipeline or
+  touches the serving model.
+* **Bad promotion rolls back** — a model that validates clean but
+  error-bursts in serving is the filter's observation window's job; the
+  swap rolls back to the previous model (``nns.filter.rollbacks``).
+
+The target filter must serve the same arch (``framework=jax-xla
+custom=arch:<zoo-name>,... is-updatable=true``) so the promoted msgpack
+params load into its template.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.resilience import FAULTS
+from ..pipeline.element import Element, ElementError, Property, element
+from ..pipeline.pipeline import BusMessage
+
+
+@element("model_validator")
+class ModelValidator(Element):
+    PROPERTIES = {
+        "checkpoint-path": Property(str, "", "trainer checkpoint dir to score"),
+        "model-config": Property(str, "", "trainer model config (file or inline JSON)"),
+        "data-location": Property(str, "", "held-out datarepo data file"),
+        "data-json": Property(str, "", "held-out datarepo meta file"),
+        "holdout-start": Property(int, 0, "first held-out sample index"),
+        "holdout-stop": Property(int, -1, "one past the last held-out sample (-1 = end)"),
+        "num-inputs": Property(int, 1, "input tensors per sample"),
+        "num-labels": Property(int, 1, "label tensors per sample"),
+        "metric": Property(str, "loss", "gate metric: loss | accuracy"),
+        "min-delta": Property(
+            float, 0.0, "required improvement over the best promoted score"
+        ),
+        "validate-every": Property(int, 1, "validate every Nth stats frame"),
+        "target": Property(str, "", "co-hosted tensor_filter to promote into"),
+        "promote-path": Property(str, "", "msgpack export path for promotion"),
+        "auto-promote": Property(
+            bool, True, "false = score + gate only, never reload the target"
+        ),
+    }
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._cfg: Dict[str, Any] = {}
+        self._fn = None
+        self._template = None          # zoo params template (restore shape)
+        self._opt_template = None      # optimizer-state template (restore shape)
+        self._loss_fn = None
+        self._eval = None
+        self._holdout: Optional[List[Tuple[list, list]]] = None
+        self._seen = 0                 # stats frames observed
+        self._last_validated: Optional[int] = None
+        # element-lifetime accounting (the nns.train.* validation surface)
+        self.validations = 0
+        self.val_score = 0.0
+        self.promotions = 0
+        self.promotions_refused = 0
+        self.promote_failures = 0
+        self.best_score: Optional[float] = None  # best PROMOTED score
+        self.last_ticket = None
+
+    def start(self):
+        cfg_text = self.props["model-config"] or "{}"
+        if os.path.isfile(cfg_text):
+            with open(cfg_text) as f:
+                self._cfg = json.load(f)
+        else:
+            self._cfg = json.loads(cfg_text)
+        if "arch" not in self._cfg:
+            raise ElementError(
+                f"{self.name}: model-config must name an 'arch'")
+        if not self.props["checkpoint-path"]:
+            raise ElementError(f"{self.name}: checkpoint-path is required")
+        if self.props["metric"] not in ("loss", "accuracy"):
+            raise ElementError(
+                f"{self.name}: metric={self.props['metric']!r} (want loss|accuracy)")
+        # model + scorer build lazily (first validation) — start() must
+        # stay cheap and the held-out repo may still be being written
+
+    def _build(self) -> None:
+        if self._fn is not None:
+            return
+        import jax
+        import optax
+
+        from .. import models as zoo
+        from ..trainer.jax_trainer import make_loss_fn
+
+        arch_props = {
+            k: str(v) for k, v in self._cfg.get("arch_props", {}).items()
+        }
+        self._fn, self._template, _, _ = zoo.build(self._cfg["arch"], arch_props)
+        # the checkpoint pytree is {"params", "opt_state"}: rebuild the
+        # trainer's optimizer from the SAME config so the restore
+        # template matches structurally (the opt_state is discarded)
+        tx = {
+            "adam": optax.adam, "adamw": optax.adamw, "sgd": optax.sgd,
+        }[self._cfg.get("optimizer", "adam")](
+            float(self._cfg.get("learning_rate", 1e-3)))
+        self._opt_template = jax.jit(tx.init)(self._template)
+        self._loss_fn = make_loss_fn(
+            self._fn, self._cfg.get("loss", "softmax_ce"))
+        self._eval = jax.jit(self._loss_fn)
+
+    def _load_holdout(self) -> List[Tuple[list, list]]:
+        """Read the held-out slice straight from the datarepo flat-binary
+        layout (meta ``tensors``/``sample_size``; one fixed-size record
+        per sample) — no second pipeline needed to score a candidate."""
+        if self._holdout is not None:
+            return self._holdout
+        from ..core.types import TensorSpec
+
+        data, meta_path = self.props["data-location"], self.props["data-json"]
+        if not data or not meta_path:
+            raise ElementError(
+                f"{self.name}: data-location= and data-json= are required")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        specs = [TensorSpec.from_string(s) for s in meta["tensors"]]
+        sample_size = int(meta["sample_size"])
+        size = os.path.getsize(data)
+        total = min(int(meta["total_samples"]),
+                    size // sample_size if sample_size else 0)
+        start = max(0, int(self.props["holdout-start"]))
+        stop = int(self.props["holdout-stop"])
+        stop = total if stop < 0 else min(stop, total)
+        if start >= stop:
+            raise ElementError(
+                f"{self.name}: empty holdout [{start}, {stop})")
+        n_in = int(self.props["num-inputs"])
+        samples = []
+        with open(data, "rb") as f:
+            f.seek(start * sample_size)
+            for _ in range(start, stop):
+                buf = f.read(sample_size)
+                tensors, off = [], 0
+                for s in specs:
+                    nb = s.nbytes
+                    tensors.append(
+                        np.frombuffer(buf[off:off + nb], dtype=s.dtype)
+                        .reshape(s.shape))
+                    off += nb
+                samples.append((tensors[:n_in], tensors[n_in:]))
+        self._holdout = samples
+        self.log.info("%s: held-out split loaded: %d sample(s) [%d, %d)",
+                      self.name, len(samples), start, stop)
+        return samples
+
+    def _score(self, cid: int) -> float:
+        """Held-out score of checkpoint ``cid`` under the gate metric."""
+        from ..core import checkpoint as ckpt
+
+        self._build()
+        state = ckpt.restore_state(
+            self.props["checkpoint-path"], cid,
+            {"params": self._template, "opt_state": self._opt_template})
+        params = state["params"]
+        samples = self._load_holdout()
+        batch = int(self._cfg.get("batch_size", 32))
+        losses, accs, weights = [], [], []
+        for i in range(0, len(samples), batch):
+            chunk = samples[i:i + batch]
+            xs = [np.stack([s[0][t] for s in chunk])
+                  for t in range(len(chunk[0][0]))]
+            ys = [np.stack([s[1][t] for s in chunk])
+                  for t in range(len(chunk[0][1]))]
+            loss, acc = self._eval(params, xs, ys)
+            losses.append(float(loss))
+            accs.append(float(acc))
+            weights.append(len(chunk))
+        w = np.asarray(weights, np.float64)
+        score = float(np.average(
+            losses if self.props["metric"] == "loss" else accs, weights=w))
+        self._scored_params = params  # promoted as-is on a gate pass
+        return score
+
+    def _improves(self, score: float) -> bool:
+        if self.best_score is None:
+            return True
+        delta = float(self.props["min-delta"])
+        if self.props["metric"] == "loss":
+            return score <= self.best_score - delta
+        return score >= self.best_score + delta
+
+    def _promote(self, cid: int, score: float) -> None:
+        """Export the scored params (crash-atomic msgpack) and stage them
+        into the target filter via the validated hot swap.  Any failure
+        here keeps the old model serving."""
+        from flax import serialization
+
+        from ..core.checkpoint import atomic_write_bytes
+
+        FAULTS.check("trainer.promote")
+        path = self.props["promote-path"]
+        atomic_write_bytes(path, serialization.to_bytes(self._scored_params))
+        pipe = self._pipeline
+        target = pipe[self.props["target"]]
+        self.last_ticket = target.request_reload(path)
+        self.promotions += 1
+        self.best_score = score
+        self.log.info(
+            "%s: promoted checkpoint %d (%s=%.6f) into %s",
+            self.name, cid, self.props["metric"], score,
+            self.props["target"],
+        )
+        if pipe is not None:
+            pipe.post(BusMessage("element", self.name, {
+                "promotion": {"checkpoint": cid, "score": score,
+                              "target": self.props["target"]},
+            }))
+
+    def handle_frame(self, pad, frame):
+        out = [(0, frame)] if (
+            self.srcpads and self.srcpads[0].is_linked) else []
+        self._seen += 1
+        every = max(1, int(self.props["validate-every"]))
+        if self._seen % every:
+            return out
+        from ..core import checkpoint as ckpt
+
+        cid = ckpt.latest_step(self.props["checkpoint-path"])
+        if cid is None or cid == self._last_validated:
+            return out  # nothing new and durable to judge
+        score = self._score(cid)
+        self._last_validated = cid
+        self.validations += 1
+        self.val_score = score
+        pipe = self._pipeline
+        if pipe is not None:
+            pipe.post(BusMessage("element", self.name, {
+                "validation": {"checkpoint": cid, "score": score,
+                               "metric": self.props["metric"]},
+            }))
+        if not self._improves(score):
+            # validation regression: refuse promotion, keep serving the
+            # current model (counted — the gate must be auditable)
+            self.promotions_refused += 1
+            self.log.warning(
+                "%s: promotion refused for checkpoint %d: %s=%.6f does "
+                "not improve on %.6f (min-delta=%s)",
+                self.name, cid, self.props["metric"], score,
+                self.best_score, self.props["min-delta"],
+            )
+            if pipe is not None:
+                pipe.post(BusMessage("warning", self.name, {
+                    "promotion_refused": {
+                        "checkpoint": cid, "score": score,
+                        "best": self.best_score,
+                    },
+                }))
+            return out
+        if (self.props["auto-promote"] and self.props["target"]
+                and self.props["promote-path"] and pipe is not None):
+            try:
+                self._promote(cid, score)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — promotion boundary
+                # degrade, don't die: the serving filter keeps its model
+                self.promote_failures += 1
+                self.log.error(
+                    "%s: promotion of checkpoint %d failed (old model "
+                    "keeps serving): %s", self.name, cid, e,
+                )
+                pipe.post(BusMessage("warning", self.name, {
+                    "promotion_failed": {"checkpoint": cid, "error": e},
+                }))
+                pipe.incident("promotion_failed", self.name, repr(e))
+        return out
+
+    def health_info(self) -> Dict[str, Any]:
+        return {
+            "train_validations": self.validations,
+            "train_val_score": float(self.val_score),
+            "train_promotions": self.promotions,
+            "train_promotions_refused": self.promotions_refused,
+            "train_promote_failures": self.promote_failures,
+        }

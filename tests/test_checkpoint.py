@@ -29,8 +29,36 @@ class TestCheckpointStore:
         ckpt.prune(d, keep=2)
         assert sorted(
             n for n in os.listdir(d) if n.startswith("step_")
-        ) == ["step_3", "step_4"]
+        ) == ["step_3", "step_3.ok", "step_4", "step_4.ok"]
         assert ckpt.latest_step(d) == 4
+
+    def test_torn_save_never_selected(self, tmp_path):
+        """A crash between the Orbax write and the completion marker
+        (the torn-save window) must leave the previous durable step as
+        the resume point — never the torn one."""
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "ck")
+        ckpt.save_state(d, 1, {"w": jnp.zeros(2)}, meta={"cursor": {"step": 1}})
+        ckpt.write_state(d, 2, {"w": jnp.ones(2)})  # no commit: torn
+        assert os.path.isdir(os.path.join(d, "step_2"))
+        assert ckpt.latest_step(d) == 1
+        assert ckpt.load_meta(d, 1)["cursor"] == {"step": 1}
+        ckpt.prune(d, keep=3)  # torn dirs are reclaimed
+        assert not os.path.exists(os.path.join(d, "step_2"))
+        assert ckpt.latest_step(d) == 1
+
+    def test_orphan_marker_never_selected(self, tmp_path):
+        """A marker without its step dir (half-pruned by a crash) is
+        invisible to latest_step and reclaimed by prune."""
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "ck")
+        ckpt.save_state(d, 1, {"w": jnp.zeros(2)})
+        ckpt.commit_state(d, 5, {})  # orphan: no step_5 dir
+        assert ckpt.latest_step(d) == 1
+        ckpt.prune(d, keep=3)
+        assert not os.path.exists(os.path.join(d, "step_5.ok"))
 
     def test_prune_ignores_stray_files(self, tmp_path):
         import jax.numpy as jnp

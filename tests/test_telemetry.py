@@ -910,3 +910,26 @@ def test_health_schema_lint_clean():
         sys.path.pop(0)
     bad = check_health_schema.scan()
     assert not bad, "health/metric schema problems:\n" + "\n".join(bad)
+
+
+def test_train_health_keys_map_to_explicit_train_metrics():
+    """The one-health-collector-path contract for continuous learning:
+    every tensor_trainer / model_validator health key has an EXPLICIT
+    ``nns.train.*`` mapping in HEALTH_KEY_METRICS backed by a registered
+    metric — none may leak into the generic ``nns.health.*`` fallback
+    namespace where dashboards would never find it."""
+    from nnstreamer_tpu.core.telemetry import HEALTH_KEY_METRICS
+    from nnstreamer_tpu.pipeline.element import make_element
+
+    for factory, name in (("tensor_trainer", "train"),
+                          ("model_validator", "gate")):
+        el = make_element(factory, name)
+        keys = el.health_info().keys()
+        assert keys, f"{factory} reports no health keys"
+        for key in keys:
+            mname = HEALTH_KEY_METRICS.get(key)
+            assert mname is not None, (
+                f"{factory} health key {key!r} has no explicit metric "
+                "mapping (would fall back to nns.health.*)")
+            assert mname.startswith("nns.train."), (key, mname)
+            assert mname in METRICS, f"{mname} not registered in METRICS"

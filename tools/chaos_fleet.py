@@ -2173,6 +2173,344 @@ def run_partition_script(servers: int = 3, streams: int = 6,
         h.stop_all()
 
 
+def run_train_script(seed: int = 0) -> Dict[str, Any]:
+    """Continuous-learning chaos (the crash-safe in-pipeline training
+    contract, Documentation/resilience.md "Continuous learning"):
+
+    * **kill mid-epoch → exact-step resume** — a ``trainer.step`` fault
+      kills the training thread mid-epoch-2; the durable (marker-
+      committed) epoch-1 checkpoint is the resume point, the replayed
+      stream fast-forwards by the cursor (zero samples retrained), and
+      the final checkpoint is BIT-IDENTICAL to an uninterrupted control
+      run (every param leaf, exact compare).
+    * **gated promotion** — the closed loop in ONE pipeline (datareposrc
+      → tensor_trainer → model_validator ∥ appsrc → tensor_filter):
+      the validator scores the newest durable checkpoint on a held-out
+      split and promotes it into the co-hosted serving filter through
+      the staged hot swap; a regressed candidate is REFUSED (counted,
+      model untouched); a candidate that validates clean but error-
+      bursts in serving (``filter.reload.post`` faults) rolls back
+      inside the observation window with zero frame loss.
+    * **memory pressure → resumable pause** — injected watermark
+      pressure pauses train steps (counted, incident) while the
+      co-hosted filter keeps serving; pressure clears, training resumes
+      and finishes with every sample incorporated.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from nnstreamer_tpu import models as zoo
+    from nnstreamer_tpu.core import checkpoint as ckpt
+    from nnstreamer_tpu.core.buffer import TensorFrame
+    from nnstreamer_tpu.core.checkpoint import atomic_write_bytes
+    from nnstreamer_tpu.core.resilience import FAULTS
+    from nnstreamer_tpu.pipeline import parse_pipeline
+    from nnstreamer_tpu.trainer.jax_trainer import JaxTrainer
+
+    n_train, n_hold, classes, batch, epochs = 32, 16, 4, 8, 3
+    steps_per_epoch = n_train // batch
+    tmp = tempfile.mkdtemp(prefix="nns_chaos_train_")
+    v: Dict[str, Any] = {"mode": "train"}
+    checks: Dict[str, bool] = {}
+    try:
+        # -- deterministic learnable dataset (banded images, datarepo) -------
+        rng = np.random.default_rng(seed)
+        data_path = os.path.join(tmp, "data.bin")
+        json_path = os.path.join(tmp, "data.json")
+        frames = []
+        for i in range(n_train + n_hold):
+            label = i % classes
+            img = rng.normal(0.2, 0.05, (28, 28, 1)).astype(np.float32)
+            img[label * 5 : label * 5 + 4, :, :] += 0.8
+            frames.append((img, np.int32([label])))
+        wpipe = parse_pipeline(
+            f"appsrc name=src ! datareposink location={data_path} "
+            f"json={json_path}"
+        )
+        wpipe.start()
+        for img, label in frames:
+            wpipe["src"].push([img, label])
+        wpipe["src"].end_of_stream()
+        wpipe.wait(timeout=60)
+        wpipe.stop()
+
+        cfg = {
+            "arch": "mnist_cnn", "arch_props": {"classes": str(classes)},
+            "optimizer": "adam", "learning_rate": 3e-3,
+            "batch_size": batch, "loss": "softmax_ce",
+        }
+        cfg_path = os.path.join(tmp, "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+
+        def backend_props(ck_dir: str, resume: bool = False):
+            return {
+                "model-config": json.dumps(cfg), "num-inputs": 1,
+                "num-labels": 1, "num-training-samples": n_train,
+                "num-validation-samples": 0, "epochs": epochs,
+                "checkpoint-path": ck_dir, "checkpoint-interval": 1,
+                "checkpoint-keep": 0, "resume": resume,
+            }
+
+        def feed(tr) -> None:
+            # the deterministic datarepo replay, at API grain: every
+            # frame carries the (epoch, sample_index) meta the resume
+            # fast-forward keys on
+            for ep in range(epochs):
+                for i in range(n_train):
+                    fr = TensorFrame([frames[i][0], frames[i][1]])
+                    fr.meta["epoch"] = ep
+                    fr.meta["sample_index"] = i
+                    tr.push_data(fr)
+            tr.end_of_data()
+
+        def run_backend(ck_dir: str, resume: bool = False) -> JaxTrainer:
+            tr = JaxTrainer()
+            tr.create(backend_props(ck_dir, resume))
+            tr.start()
+            feed(tr)
+            tr._thread.join(timeout=300)
+            return tr
+
+        # -- phase 1: kill mid-epoch, resume exactly -------------------------
+        ck_ctl, ck_chaos = os.path.join(tmp, "ck_ctl"), os.path.join(tmp, "ck")
+        control = run_backend(ck_ctl)
+        checks["control_clean"] = (
+            control.error is None and control.status.epoch_count == epochs
+            and ckpt.latest_step(ck_ctl) == epochs
+        )
+        # fire on the 6th optimizer step: mid-epoch-2, after the epoch-1
+        # checkpoint committed — the torn tail past it must be discarded
+        FAULTS.arm("trainer.step", exc=RuntimeError("chaos: kill mid-epoch"),
+                   after=steps_per_epoch + 1, times=1)
+        killed = run_backend(ck_chaos)
+        FAULTS.reset()
+        durable = ckpt.latest_step(ck_chaos)
+        checks["killed_mid_epoch"] = killed.error is not None
+        checks["durable_is_epoch1"] = durable == 1
+        resumed = run_backend(ck_chaos, resume=True)
+        checks["resume_clean"] = (
+            resumed.error is None and resumed.resumes == 1
+            and resumed.status.epoch_count == epochs
+            and ckpt.latest_step(ck_chaos) == epochs
+        )
+        # zero samples retrained: epoch 1 is skipped via the cursor, and
+        # the (epoch, sample_index) ledger holds no duplicates
+        checks["replay_exact"] = (
+            resumed.replay_skipped == n_train
+            and resumed.gap_samples == 0
+            and len(resumed.trained_log) == len(set(resumed.trained_log))
+            and all(ep >= 1 for ep, _ in resumed.trained_log)
+        )
+        # bit-identical at checkpoint grain: restore the final state of
+        # both runs and compare every leaf exactly
+        import jax
+        import optax
+
+        fn0, template, _, _ = zoo.build("mnist_cnn",
+                                        {"classes": str(classes)})
+        opt_template = jax.jit(optax.adam(cfg["learning_rate"]).init)(template)
+        tpl = {"params": template, "opt_state": opt_template}
+        leaves_a = jax.tree_util.tree_leaves(
+            ckpt.restore_state(ck_ctl, epochs, tpl))
+        leaves_b = jax.tree_util.tree_leaves(
+            ckpt.restore_state(ck_chaos, epochs, tpl))
+        bitwise = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(leaves_a, leaves_b)
+        ) and len(leaves_a) == len(leaves_b)
+        checks["params_bit_identical"] = bitwise
+        v["resume"] = {
+            "durable_step_after_kill": durable,
+            "resumed_at_step": resumed.resumed_at,
+            "replay_skipped": resumed.replay_skipped,
+            "final_steps": resumed.steps,
+            "params_bit_identical": bitwise,
+        }
+
+        # -- phase 2: the closed loop — gate, promote, refuse, roll back -----
+        base_path = os.path.join(tmp, "base.msgpack")
+        from flax import serialization
+
+        atomic_write_bytes(base_path, serialization.to_bytes(template))
+        ck_loop = os.path.join(tmp, "ck_loop")
+        promoted_path = os.path.join(tmp, "promoted.msgpack")
+        pipe = parse_pipeline(
+            f"datareposrc name=data location={data_path} json={json_path} "
+            f"stop-sample-index={n_train - 1} epochs={epochs} ! "
+            f"tensor_trainer name=train framework=jax model-config={cfg_path} "
+            f"num-inputs=1 num-labels=1 num-training-samples={n_train} "
+            f"epochs={epochs} checkpoint-path={ck_loop} "
+            "checkpoint-interval=1 checkpoint-keep=0 ! "
+            f"model_validator name=gate checkpoint-path={ck_loop} "
+            f"model-config={cfg_path} data-location={data_path} "
+            f"data-json={json_path} holdout-start={n_train} metric=accuracy "
+            f"target=serve promote-path={promoted_path} ! "
+            "tensor_sink name=tstats "
+            f"appsrc name=src ! tensor_filter name=serve framework=jax-xla "
+            f"model={base_path} custom=arch:mnist_cnn,classes:{classes} "
+            "is-updatable=true staged-reload=true observation-window=3 "
+            "rollback-error-burst=3 ! tensor_sink name=out"
+        )
+        pipe.start()
+        pushed = 0
+
+        def pump(until, deadline_s: float, tag: str) -> None:
+            nonlocal pushed
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                pipe["src"].push(frames[pushed % len(frames)][0])
+                pushed += 1
+                if until():
+                    return
+                time.sleep(0.02)
+            raise TimeoutError(f"train chaos: {tag} not reached")
+
+        gate, serve = pipe["gate"], pipe["serve"]
+        # ...and let the post-swap observation window close on clean
+        # frames — the NEXT swap must not inherit an open window
+        pump(lambda: gate.promotions >= 1
+             and serve.health_info()["model_version"] >= 1
+             and serve.health_info()["swap_state"] == "idle",
+             180.0, "good promotion")
+        h = serve.health_info()
+        checks["good_promotion"] = (
+            gate.validations >= 1 and gate.promotions == 1
+            and h["model_version"] == 1 and h["rollbacks"] == 0
+        )
+        good_score = gate.best_score
+        v["promotion"] = {
+            "validations": gate.validations, "score": good_score,
+            "model_version": h["model_version"],
+        }
+        # a regressed candidate: the UNTRAINED params, planted as a newer
+        # durable checkpoint — the gate must refuse it
+        ckpt.save_state(ck_loop, 90, {"params": template,
+                                      "opt_state": opt_template},
+                        meta={"cursor": {"unit": "epoch", "step": 0,
+                                         "epoch": 90}})
+        gate.handle_frame(None, TensorFrame([np.zeros(5, np.float64)]))
+        h = serve.health_info()
+        checks["regression_refused"] = (
+            gate.promotions_refused == 1 and gate.promotions == 1
+            and h["model_version"] == 1
+        )
+        v["refusal"] = {"refused": gate.promotions_refused,
+                        "score": gate.val_score, "best": gate.best_score}
+        # a candidate that validates clean but error-bursts in serving:
+        # re-plant the promoted (good) params as a newer checkpoint, arm
+        # the post-swap observation fault — the window must roll back,
+        # and the retained old model must serve every faulted frame
+        with open(promoted_path, "rb") as f:
+            good_params = serialization.from_bytes(template, f.read())
+        ckpt.save_state(ck_loop, 91, {"params": good_params,
+                                      "opt_state": opt_template},
+                        meta={"cursor": {"unit": "epoch", "step": 0,
+                                         "epoch": 91}})
+        FAULTS.arm("filter.reload.post",
+                   exc=RuntimeError("chaos: bad rollout"), times=3)
+        gate.handle_frame(None, TensorFrame([np.zeros(5, np.float64)]))
+        pump(lambda: serve.health_info()["rollbacks"] >= 1,
+             120.0, "rollback")
+        FAULTS.reset()
+        # settle the serving chain, then the ledger must balance exactly
+        pipe["src"].end_of_stream()
+        pipe.wait(timeout=120)
+        h = serve.health_info()
+        served = len(pipe["out"].frames)
+        train_h = pipe["train"].health_info()
+        checks["rollback_exact"] = (
+            h["rollbacks"] == 1 and h["swaps"] == 2
+            and h["model_version"] == 1 and gate.promotions == 2
+        )
+        checks["zero_frame_loss"] = served == pushed
+        checks["trainer_accounting"] = (
+            train_h["train_epochs"] == epochs
+            and train_h["train_steps"] == epochs * steps_per_epoch
+            and train_h["train_checkpoints"] == epochs
+            and train_h["train_samples"] == epochs * n_train
+        )
+        v["rollback"] = {"rollbacks": h["rollbacks"], "swaps": h["swaps"],
+                         "model_version": h["model_version"],
+                         "served": served, "pushed": pushed}
+        pipe.stop()
+
+        # -- phase 3: memory pressure pauses training, serving lives on ------
+        ck_p = os.path.join(tmp, "ck_pause")
+        pressure = {"on": True}
+        pipe2 = parse_pipeline(
+            f"datareposrc name=data location={data_path} json={json_path} "
+            f"stop-sample-index={n_train - 1} epochs=2 ! "
+            f"tensor_trainer name=train framework=jax model-config={cfg_path} "
+            f"num-inputs=1 num-labels=1 num-training-samples={n_train} "
+            f"epochs=2 checkpoint-path={ck_p} checkpoint-interval=1 ! "
+            "tensor_sink name=tsink "
+            f"appsrc name=src ! tensor_filter name=serve framework=jax-xla "
+            f"model={base_path} custom=arch:mnist_cnn,classes:{classes} ! "
+            "tensor_sink name=out"
+        )
+        pipe2.enable_memory_monitor(
+            high=0.90, low=0.75, sustain_s=0.0, min_poll_s=0.05,
+            sample=lambda: ((95, 100, 0) if pressure["on"] else (10, 100, 0)),
+        )
+        pipe2.start()
+        trainer2 = pipe2["train"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if trainer2.health_info()["train_paused"]:
+                break
+            time.sleep(0.02)
+        th = trainer2.health_info()
+        checks["pressure_paused"] = th["train_paused"] == 1 and th["train_pauses"] == 1
+        steps_frozen = th["train_steps"]
+        served_during_pause = 0
+        for _ in range(30):  # co-hosted serving must not starve
+            pipe2["src"].push(frames[0][0])
+            served_during_pause += 1
+            time.sleep(0.01)
+        deadline = time.monotonic() + 30
+        while (len(pipe2["out"].frames) < served_during_pause
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        th = trainer2.health_info()
+        checks["paused_is_frozen"] = (
+            th["train_steps"] == steps_frozen and th["train_paused"] == 1
+        )
+        checks["serving_alive_under_pressure"] = (
+            len(pipe2["out"].frames) == served_during_pause
+        )
+        pressure["on"] = False  # clears: training resumes, zero loss
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            th = trainer2.health_info()
+            if th["train_epochs"] == 2 and not th["train_alive"]:
+                break
+            time.sleep(0.05)
+        checks["pause_resumed_zero_loss"] = (
+            th["train_epochs"] == 2 and th["train_paused"] == 0
+            and th["train_samples"] == 2 * n_train
+            and th["train_pauses"] == 1
+        )
+        v["pressure"] = {
+            "pauses": th["train_pauses"],
+            "steps_at_pause": steps_frozen,
+            "served_while_paused": served_during_pause,
+            "samples_trained": th["train_samples"],
+        }
+        pipe2["src"].end_of_stream()
+        pipe2.wait(timeout=60)
+        pipe2.stop()
+
+        v["checks"] = checks
+        v["ok"] = all(checks.values())
+        return v
+    finally:
+        FAULTS.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     import argparse
 
@@ -2188,7 +2526,7 @@ def main() -> int:
     ap.add_argument("--mode",
                     choices=("unary", "generate", "generate-resume",
                              "device-loss", "observatory", "autoscale",
-                             "partition", "prefix"),
+                             "partition", "prefix", "train"),
                     default="unary",
                     help="unary request fleet (default), long-lived "
                     "generation-stream fleet (continuous batching), "
@@ -2213,7 +2551,13 @@ def main() -> int:
                     "prompt prefix, prefix-affinity routes them to the "
                     "warm owner, a mid-decode rolling restart forces "
                     "bit-exact cache-cold failover and a re-warm, with "
-                    "exact hit/miss ledgers and observatory rollups")
+                    "exact hit/miss ledgers and observatory rollups, or "
+                    "the continuous-learning chaos: a trainer killed "
+                    "mid-epoch resumes bit-exactly from the durable "
+                    "checkpoint, the validation gate refuses a regressed "
+                    "candidate, a bad promotion rolls back with zero "
+                    "frame loss, and injected memory pressure pauses "
+                    "training while co-hosted serving lives on")
     ap.add_argument("--streams", type=int, default=12,
                     help="generation streams per client (--mode "
                     "generate) or concurrent streams (generate-resume)")
@@ -2244,6 +2588,8 @@ def main() -> int:
         verdict = run_prefix_script(
             max(2, min(args.servers, 4)), max(2, min(args.streams, 12)),
             args.seed)
+    elif args.mode == "train":
+        verdict = run_train_script(args.seed)
     else:
         verdict = run_default_script(args.servers, args.frames, args.keys)
     print(json.dumps(verdict, indent=1, sort_keys=True))
